@@ -1,0 +1,22 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Reference tests require N physical GPUs under torchrun (SURVEY.md section 4);
+here every distributed test runs on one host, with Pallas kernels executing
+under TPU interpret mode (simulated DMA/semaphores).
+"""
+
+from triton_distributed_tpu.core.platform import force_cpu
+
+# Must run before any JAX backend is created (safe here: conftest is imported
+# before test modules). Overrides the container sitecustomize's force-selected
+# TPU platform as well.
+force_cpu(8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from triton_distributed_tpu.core import mesh as mesh_lib
+
+    return mesh_lib.tp_mesh(8)

@@ -1,0 +1,133 @@
+"""Primitive-level tests (reference analogue: test_distributed_wait.py,
+test_notify.py, test_nvshmem_api.py — SURVEY.md section 4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.core import compilation, mesh as mesh_lib
+from triton_distributed_tpu.core.utils import assert_allclose
+from triton_distributed_tpu import lang
+
+
+def _run(mesh, kernel_fn, x, out_shape, scratch_shapes, collective_id=7):
+    def f(xs):
+        return pl.pallas_call(
+            kernel_fn,
+            out_shape=out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=scratch_shapes,
+            compiler_params=compilation.compiler_params(collective_id=collective_id),
+            interpret=compilation.interpret_mode(),
+        )(xs)
+
+    g = compilation.jit_shard_map(f, mesh, in_specs=P("tp"), out_specs=P("tp"))
+    return g(x)
+
+
+def test_ring_push(mesh8):
+    """Each device pushes its shard to its right neighbor (putmem_signal)."""
+    n = 8
+    shape = (8, 128)
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        lang.collective_prologue("tp")
+        _, right = lang.ring_neighbors("tp")
+        copy = lang.remote_copy(x_ref, o_ref, send_sem, recv_sem, right)
+        copy.wait()
+
+    x = jnp.arange(n * shape[0] * shape[1], dtype=jnp.float32).reshape(n * shape[0], shape[1])
+    out = _run(
+        mesh8, kernel, x,
+        jax.ShapeDtypeStruct((shape[0], shape[1]), jnp.float32),
+        [pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+    )
+    expect = jnp.roll(x.reshape(n, *shape), 1, axis=0).reshape(n * shape[0], shape[1])
+    assert_allclose(out, expect, atol=0, rtol=0)
+
+
+def test_notify_wait_producer_consumer(mesh8):
+    """Producer rank pushes data + notifies; consumer waits then reads
+    (tutorial-01 equivalent: the reference's producer-consumer queue)."""
+
+    def kernel(x_ref, o_ref, ready_sem, send_sem, recv_sem):
+        lang.collective_prologue("tp")
+        me = lang.rank("tp")
+        n = lang.num_ranks("tp")
+        dst = jax.lax.rem(me + 1, n)
+        # push data into neighbor's output buffer (completion sems consumed),
+        # then notify the consumer with a REGULAR semaphore — the dl.notify /
+        # dl.wait pair of the reference, decoupled from the DMA itself.
+        copy = lang.remote_copy(x_ref, o_ref, send_sem, recv_sem, dst)
+        copy.wait()
+        lang.notify(ready_sem, dst, inc=1)
+        # consumer side: wait for the producer's notify, then scale the data.
+        lang.wait(ready_sem, 1)
+
+        def scale(scratch, sem):
+            lang.local_copy(o_ref, scratch, sem).wait()
+            scratch[:] = scratch[:] * 2.0
+            lang.local_copy(scratch, o_ref, sem).wait()
+
+        pl.run_scoped(scale, pltpu.VMEM((8, 128), jnp.float32), pltpu.SemaphoreType.DMA)
+
+    x = jnp.tile(jnp.arange(8, dtype=jnp.float32)[:, None], (8, 128))
+    x = (x + jnp.repeat(jnp.arange(8, dtype=jnp.float32), 8)[:, None])  # rank-dependent
+    out = _run(
+        mesh8, kernel, x,
+        jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        [pltpu.SemaphoreType.REGULAR, pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+    )
+    expect = 2.0 * jnp.roll(x.reshape(8, 8, 128), 1, axis=0).reshape(64, 128)
+    assert_allclose(out, expect, atol=0, rtol=0)
+
+
+def test_barrier_all(mesh8):
+    """barrier_all: no rank proceeds until all arrive (smoke: completes, and
+    post-barrier remote reads see pre-barrier writes)."""
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem, bar):
+        lang.collective_prologue("tp")
+        me = lang.rank("tp")
+        n = lang.num_ranks("tp")
+        # everyone pushes to right neighbor, then a full barrier, then doubles
+        _, right = lang.ring_neighbors("tp")
+        lang.remote_copy(x_ref, o_ref, send_sem, recv_sem, right).wait()
+        lang.barrier_all("tp", bar)
+
+        def scale(scratch, sem):
+            lang.local_copy(o_ref, scratch, sem).wait()
+            scratch[:] = scratch[:] + 1.0
+            lang.local_copy(scratch, o_ref, sem).wait()
+
+        pl.run_scoped(scale, pltpu.VMEM((8, 128), jnp.float32), pltpu.SemaphoreType.DMA)
+
+    x = jnp.ones((64, 128), jnp.float32)
+    out = _run(
+        mesh8, kernel, x,
+        jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        [pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.REGULAR],
+    )
+    assert_allclose(out, jnp.full((64, 128), 2.0, jnp.float32), atol=0, rtol=0)
+
+
+def test_rank_num_ranks(mesh8):
+    def kernel(x_ref, o_ref):
+        def body(scratch, sem):
+            scratch[:] = jnp.zeros_like(scratch)
+            scratch[0, 0] = lang.rank("tp").astype(jnp.float32)
+            scratch[0, 1] = jnp.float32(lang.num_ranks("tp"))
+            lang.local_copy(scratch, o_ref, sem).wait()
+        pl.run_scoped(body, pltpu.VMEM((1, 128), jnp.float32), pltpu.SemaphoreType.DMA)
+
+    x = jnp.zeros((8, 128), jnp.float32)
+    out = _run(mesh8, kernel, x, jax.ShapeDtypeStruct((1, 128), jnp.float32), [])
+    got = np.asarray(out)
+    for r in range(8):
+        assert got[r, 0] == r
+        assert got[r, 1] == 8

@@ -1,0 +1,37 @@
+"""triton_distributed_tpu: a TPU-native compute–communication overlapping
+framework (JAX / XLA / Pallas / pjit).
+
+Brand-new implementation of the capabilities of Triton-distributed
+(ByteDance Seed) for TPU: tile-granular signal/wait primitives woven into
+Pallas kernels, a library of overlapped collectives and distributed
+attention/MoE ops, tensor-/expert-/sequence-parallel layers, and an
+end-to-end Qwen3-style inference engine — all designed for the TPU execution
+model (MXU, VMEM pipelines, ICI remote DMA, XLA SPMD) rather than translated
+from the reference's CUDA/NVSHMEM architecture.
+
+Layer map (vs SURVEY.md section 1):
+
+- ``core``     runtime bring-up, mesh, symmetric buffers, test/perf utils
+- ``lang``     the distributed primitive vocabulary used inside kernels
+- ``comm``     collectives as fused Pallas kernels (AG, RS, AR, A2A)
+- ``ops``      overlapped compute kernels (AG-GEMM, GEMM-RS, MoE, attention)
+- ``layers``   TP/EP/SP layers as functional pytree modules
+- ``models``   model configs, KV cache, Qwen3, inference engine
+- ``parallel`` shard_map/pjit conventions and sharding rules
+- ``tune``     contextual autotuner
+- ``tools``    profiling, AOT serialization, perf (SOL) models
+"""
+
+__version__ = "0.1.0"
+
+from . import core
+from .core import mesh as mesh_lib
+from .core.platform import (
+    initialize_distributed,
+    finalize_distributed,
+    force_cpu,
+    init_seed,
+)
+from .core.mesh import make_mesh, tp_mesh, TP_AXIS, EP_AXIS, SP_AXIS, DP_AXIS, PP_AXIS
+from .core.utils import assert_allclose, dist_print, perf_func, rand_tensor
+from .core.symm import symm_buffer, symm_signal, SymmetricBuffer

@@ -1,0 +1,1 @@
+from . import compilation, mesh, platform, symm, utils

@@ -1,0 +1,108 @@
+"""Kernel compilation policy: one switch between real-TPU Mosaic compilation
+and CPU interpret-mode simulation.
+
+The reference ships an entire codegen backend per vendor
+(``backends/nvidia/backend/compiler.py:355-736`` stages ttir->ttgir->llir->ptx
+->cubin and links NVSHMEM bitcode).  On TPU that whole layer collapses into
+Pallas -> Mosaic, so the only policy left is *how* a kernel is executed:
+
+- on TPU: compiled by Mosaic (optionally with a VMEM limit / cost estimate);
+- on CPU: executed under TPU interpret mode, which simulates HBM/VMEM,
+  local+remote DMA, and semaphores — this is what makes every distributed
+  test runnable on a laptop-style 8-device virtual mesh (a capability the
+  reference lacks: its tests require N physical GPUs, SURVEY.md section 4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+from . import platform
+
+
+def interpret_params(
+    *,
+    detect_races: bool = False,
+    dma_execution_mode: str = "eager",
+) -> pltpu.InterpretParams:
+    return pltpu.InterpretParams(
+        detect_races=detect_races,
+        dma_execution_mode=dma_execution_mode,
+    )
+
+
+_race_detection = {"enabled": False}
+
+
+def enable_race_detection(on: bool = True) -> None:
+    """Globally enable interpret-mode race detection for subsequent kernels.
+
+    TPU-native stand-in for the reference's reliance on external
+    ``compute-sanitizer`` (SURVEY.md section 5): the Pallas interpreter's
+    vector-clock race detector flags unsynchronized accesses to the same
+    buffer across devices/cores.
+    """
+    _race_detection["enabled"] = bool(on)
+
+
+def interpret_mode() -> pltpu.InterpretParams | bool:
+    """The value to pass as ``pallas_call(..., interpret=...)``.
+
+    False on real TPU (compile with Mosaic); InterpretParams on CPU.
+    """
+    if platform.on_cpu():
+        return interpret_params(detect_races=_race_detection["enabled"])
+    return False
+
+
+def compiler_params(
+    *,
+    collective: bool = True,
+    collective_id: int = 0,
+    vmem_limit_bytes: int | None = None,
+    dimension_semantics: tuple[str, ...] | None = None,
+) -> pltpu.CompilerParams:
+    kw: dict[str, Any] = dict(has_side_effects=collective)
+    if collective:
+        kw["collective_id"] = collective_id
+    if vmem_limit_bytes is not None:
+        kw["vmem_limit_bytes"] = vmem_limit_bytes
+    if dimension_semantics is not None:
+        kw["dimension_semantics"] = dimension_semantics
+    return pltpu.CompilerParams(**kw)
+
+
+def jit_shard_map(fn, mesh, in_specs, out_specs, *, static_argnums=(), donate_argnums=()):
+    """``jax.jit(jax.shard_map(fn))`` with the conventions all our collective
+    kernels need (check_vma off: Pallas outputs have no vma annotations)."""
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+    )
+    return jax.jit(mapped, static_argnums=static_argnums, donate_argnums=donate_argnums)
+
+
+def aot_compile(jitted, *example_args, **example_kwargs):
+    """Ahead-of-time compile a jitted function (reference: the 1.7k-LoC AOT
+    C toolchain ``tools/compile_aot.py`` + ``triton_aot_runtime.cc``; on TPU
+    this is `.lower().compile()` — see ``tools/aot.py`` for serialization)."""
+    return jitted.lower(*example_args, **example_kwargs).compile()
+
+
+def reset_interpret_state() -> None:
+    """Reset interpreter shared state after an exception inside a kernel."""
+    try:
+        from jax._src.pallas.mosaic.interpret import interpret_pallas_call as _ipc
+
+        _ipc.reset_tpu_interpret_mode_state()  # type: ignore[attr-defined]
+    except Exception:
+        pass
+
+
+@functools.cache
+def supports_remote_dma() -> bool:
+    """Whether device-to-device Pallas RDMA is available (multi-device mesh)."""
+    return jax.device_count() > 1 or platform.on_cpu()

@@ -1,0 +1,93 @@
+"""Mesh construction and axis conventions.
+
+The reference derives its communicator structure from torch process groups
+(``utils.py:190`` builds one TP group over all ranks) plus NVSHMEM teams, and
+encodes intra/inter-node hierarchy in a ``CommScope`` enum
+(``DistributedAttrDefs.td:45``).  On TPU the equivalent object is a
+`jax.sharding.Mesh`: axes over ICI within a slice, an outer axis over DCN for
+multi-slice.  This module standardizes axis names so kernels, layers, and
+models agree:
+
+- ``tp``: tensor parallel (ICI, innermost — highest-bandwidth axis)
+- ``ep``: expert parallel (may alias tp for inference MoE)
+- ``sp``: sequence/context parallel
+- ``dp``: data parallel (outermost; may ride DCN across slices)
+- ``pp``: pipeline parallel (not in the reference's scope; provided for mesh
+  completeness so users can lay out their own schedules)
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+TP_AXIS = "tp"
+EP_AXIS = "ep"
+SP_AXIS = "sp"
+DP_AXIS = "dp"
+PP_AXIS = "pp"
+
+# Intra-slice axes ride ICI; inter-slice axes ride DCN. Mirrors the
+# reference's CommScope{GPU, INTRA_NODE, INTER_NODE} distinction.
+ICI_AXES = (TP_AXIS, EP_AXIS, SP_AXIS)
+DCN_AXES = (DP_AXIS, PP_AXIS)
+
+
+def make_mesh(
+    axis_sizes: Mapping[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a Mesh from named axis sizes, e.g. ``{"dp": 2, "tp": 4}``.
+
+    Axis order in the mapping is the device-grid order (outermost first).
+    Defaults to a 1-D ``tp`` mesh over all devices — the reference's default
+    "one TP group over WORLD_SIZE" shape.
+    """
+    devs = np.array(devices if devices is not None else jax.devices())
+    if axis_sizes is None:
+        axis_sizes = {TP_AXIS: devs.size}
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(int(s) for s in axis_sizes.values())
+    total = int(np.prod(sizes))
+    if total != devs.size:
+        raise ValueError(
+            f"mesh axes {dict(axis_sizes)} require {total} devices, "
+            f"have {devs.size}"
+        )
+    return Mesh(devs.reshape(sizes), names)
+
+
+def tp_mesh(tp: int | None = None) -> Mesh:
+    n = tp or jax.device_count()
+    return make_mesh({TP_AXIS: n})
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard(mesh: Mesh, x: jax.Array, *spec) -> jax.Array:
+    """Place ``x`` with the given PartitionSpec on the mesh."""
+    return jax.device_put(x, sharding(mesh, *spec))
+
+
+def is_dcn_axis(axis: str) -> bool:
+    """Whether collectives over this axis are expected to cross DCN.
+
+    Used by ops to choose hierarchical algorithms (Pallas RDMA over ICI,
+    XLA collectives over DCN) — the TPU analogue of the reference's 2D/3D
+    intra+inter-node kernel hierarchies (``allgather.py:442-601``).
+    """
+    return axis in DCN_AXES

@@ -1,0 +1,191 @@
+"""Platform/bootstrap layer: backend selection, distributed init, device query.
+
+TPU-native replacement for the reference's runtime bring-up
+(``python/triton_dist/utils.py:174-200`` ``initialize_distributed``: torchrun
+env -> NCCL process group -> NVSHMEM UID init).  On TPU a single call to
+:func:`initialize_distributed` covers all three: `jax.distributed.initialize`
+is the rendezvous, XLA's SPMD runtime is the communication backend, and the
+"symmetric heap" is simply the identically-shaped per-device shards of arrays
+laid out by `jax.sharding` (see ``core/symm.py``).
+
+This module also owns the CPU-simulation story (SURVEY.md section 4): any test
+can run on a virtual N-device CPU mesh, in which case Pallas kernels execute
+under TPU interpret mode (``core/compilation.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+
+_DEFAULT_VIRTUAL_DEVICES = 8
+
+_initialized = False
+
+
+def force_cpu(num_devices: int = _DEFAULT_VIRTUAL_DEVICES) -> None:
+    """Force the CPU backend with ``num_devices`` virtual devices.
+
+    Must be called before any JAX backend is initialized.  Note: a plain
+    ``JAX_PLATFORMS=cpu`` env var is not sufficient in environments whose
+    sitecustomize force-selects a platform via ``jax.config``; we therefore
+    set the config explicitly as well.
+    """
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    want = f"--xla_force_host_platform_device_count={num_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", want, flags
+        )
+    else:
+        flags = (flags + " " + want).strip()
+    os.environ["XLA_FLAGS"] = flags
+    jax.config.update("jax_platforms", "cpu")
+
+
+def backend() -> str:
+    return jax.default_backend()
+
+
+def on_cpu() -> bool:
+    return backend() == "cpu"
+
+
+def on_tpu() -> bool:
+    # The "axon" platform is a tunneled TPU PJRT plugin; treat it as TPU.
+    return backend() in ("tpu", "axon")
+
+
+def is_multichip() -> bool:
+    return jax.device_count() > 1
+
+
+@dataclasses.dataclass(frozen=True)
+class DistContext:
+    """Handle returned by :func:`initialize_distributed`.
+
+    Plays the role of the reference's ``TP_GROUP`` (a torch ProcessGroup): a
+    value tests thread through to ops.  On TPU the actual communicator is the
+    mesh + XLA runtime, so this only carries identity/topology facts.
+    """
+
+    rank: int                 # process index (multi-host), not device index
+    world: int                # number of processes
+    devices: tuple[jax.Device, ...]
+    local_devices: tuple[jax.Device, ...]
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    seed: int | None = 42,
+) -> DistContext:
+    """Bring up the distributed runtime.
+
+    Single-host (including the CPU-simulated mesh and the single-chip case):
+    a no-op beyond seeding.  Multi-host (a real pod slice or multi-host CPU
+    rendezvous): calls ``jax.distributed.initialize``, which replaces both the
+    NCCL bootstrap and the NVSHMEM UID exchange of the reference.
+
+    Environment variables honored (mirroring torchrun-style launches):
+    ``COORDINATOR_ADDRESS``, ``NUM_PROCESSES``, ``PROCESS_ID``.
+    """
+    global _initialized
+
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
+
+    # _initialized tracks only the multi-host runtime: a prior single-host
+    # call (e.g. for seeding) must not swallow a later real rendezvous.
+    if coordinator_address and not _initialized:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+
+    if seed is not None:
+        init_seed(seed)
+
+    return DistContext(
+        rank=jax.process_index(),
+        world=jax.process_count(),
+        devices=tuple(jax.devices()),
+        local_devices=tuple(jax.local_devices()),
+    )
+
+
+def finalize_distributed() -> None:
+    """Tear down the multi-host runtime (reference: ``utils.py:153-155``)."""
+    global _initialized
+    if _initialized and jax.process_count() > 1:
+        jax.distributed.shutdown()
+    _initialized = False
+
+
+_seed_state: dict[str, int] = {"seed": 42}
+
+
+def init_seed(seed: int) -> None:
+    """Deterministic seeding (reference: ``utils.py:75-94`` ``init_seed``).
+
+    JAX PRNG is already deterministic and functional; we keep a process-wide
+    base seed so helpers like ``rand_tensor`` can derive per-call keys, and
+    seed numpy for host-side shuffles.
+    """
+    _seed_state["seed"] = int(seed)
+    np.random.seed(seed)
+
+
+def base_key() -> jax.Array:
+    return jax.random.key(_seed_state["seed"])
+
+
+def device_kind() -> str:
+    d = jax.devices()[0]
+    return getattr(d, "device_kind", d.platform)
+
+
+def topology_summary() -> dict:
+    """Topology probe (reference: NVLink/PCIe/NUMA probes ``utils.py:587-862``).
+
+    On TPU the relevant facts are the mesh-relevant ones: device count, chip
+    kind, process count, and (when available) the physical coords that tell
+    you which axes ride ICI vs DCN.
+    """
+    devs = jax.devices()
+    info: dict = {
+        "backend": backend(),
+        "num_devices": len(devs),
+        "num_processes": jax.process_count(),
+        "device_kind": device_kind(),
+    }
+    coords = []
+    for d in devs:
+        coords.append(getattr(d, "coords", None))
+    if any(c is not None for c in coords):
+        info["coords"] = coords
+    return info
+
+
+def devices_array(shape: Sequence[int] | None = None) -> np.ndarray:
+    """Device grid for building a Mesh; defaults to a 1-D grid of all devices."""
+    devs = np.array(jax.devices())
+    if shape is not None:
+        devs = devs.reshape(tuple(shape))
+    return devs

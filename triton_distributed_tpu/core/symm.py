@@ -1,0 +1,76 @@
+"""Symmetric buffers: the TPU answer to the NVSHMEM symmetric heap.
+
+Reference model (``utils.py:122-171``): every rank allocates an identically
+shaped tensor on a symmetric heap; any rank can address any peer's copy by
+(rank, offset) (``nvshmem_create_tensor`` / ``get_peer_tensor``), and signal
+flags live in separate symmetric u64 arrays.
+
+TPU model: under `shard_map` every device executes the same program over its
+own shard.  An array sharded so that each device holds the same local shape
+IS a symmetric buffer: Pallas remote DMA addresses a peer's shard by logical
+device id (``lang.primitives.remote_copy``), which is exactly ``symm_at``.
+Signals are Pallas semaphores scoped to a kernel, or tiny int32 symmetric
+arrays when a flag must persist across kernels.
+
+Because Pallas semaphores do not outlive a kernel invocation, the reference's
+"producer kernel signals, consumer kernel waits" split becomes either (a) one
+fused kernel containing both sides (our default — see ``ops/``), or (b) a
+persistent int32 flag array updated/polled by separate kernels (used by the
+double-buffered layers, e.g. ``layers/allgather_layer.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class SymmetricBuffer:
+    """A per-device identically-shaped workspace + its mesh placement.
+
+    ``data`` is a global array whose per-device shard has shape
+    ``local_shape``; kernel code addresses peers' shards via remote DMA.
+    """
+
+    data: jax.Array
+    mesh: Mesh
+    axis: str
+    local_shape: tuple[int, ...]
+
+    @property
+    def num_ranks(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def symm_buffer(
+    mesh: Mesh,
+    axis: str,
+    local_shape: Sequence[int],
+    dtype=jnp.bfloat16,
+    *,
+    fill: float | int = 0,
+) -> SymmetricBuffer:
+    """Allocate a symmetric workspace: every device along ``axis`` holds a
+    ``local_shape`` shard (reference: ``nvshmem_create_tensor``)."""
+    local_shape = tuple(int(d) for d in local_shape)
+    n = mesh.shape[axis]
+    global_shape = (local_shape[0] * n, *local_shape[1:])
+    spec = [None] * len(local_shape)
+    spec[0] = axis
+    arr = jnp.full(global_shape, fill, dtype=dtype)
+    arr = jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+    return SymmetricBuffer(data=arr, mesh=mesh, axis=axis, local_shape=local_shape)
+
+
+def symm_signal(mesh: Mesh, axis: str, n_flags: int = 1) -> SymmetricBuffer:
+    """Persistent int32 signal flags, one row of ``n_flags`` per device
+    (reference: symmetric u64 signal arrays, ``nvshmem_create_tensor`` with
+    dtype uint64).  Values are counts, matching TPU counting-semaphore
+    semantics rather than arbitrary magic values (SURVEY.md section 7,
+    "Semaphore semantics mismatch")."""
+    return symm_buffer(mesh, axis, (1, n_flags), dtype=jnp.int32, fill=0)

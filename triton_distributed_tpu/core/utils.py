@@ -1,0 +1,132 @@
+"""Host-side utilities: tensor factories, comparison, timing, printing.
+
+TPU-native counterpart of the reference's ``python/triton_dist/utils.py``
+grab-bag: ``_make_tensor`` (:217), ``assert_allclose`` (:865-894),
+``perf_func`` (:269-281), ``dist_print`` (:284).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import time
+from typing import Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import platform
+
+
+def rand_tensor(
+    shape: tuple[int, ...],
+    dtype=jnp.bfloat16,
+    *,
+    key: jax.Array | None = None,
+    scale: float = 1.0,
+) -> jax.Array:
+    """Random test tensor (reference ``_make_tensor``): normal data scaled to
+    keep bf16 matmuls in a numerically friendly range."""
+    if key is None:
+        # Derive a fresh key from the process-wide seed + a counter.
+        rand_tensor._counter += 1  # type: ignore[attr-defined]
+        key = jax.random.fold_in(platform.base_key(), rand_tensor._counter)  # type: ignore[attr-defined]
+    x = jax.random.normal(key, shape, dtype=jnp.float32) * scale
+    return x.astype(dtype)
+
+
+rand_tensor._counter = 0  # type: ignore[attr-defined]
+
+
+def assert_allclose(
+    actual,
+    expected,
+    *,
+    atol: float = 1e-2,
+    rtol: float = 1e-2,
+    max_mismatch_report: int = 10,
+    name: str = "tensor",
+) -> None:
+    """Comparison with a rich mismatch dump (reference ``utils.py:865-894``)."""
+    a = np.asarray(jax.device_get(actual), dtype=np.float64)
+    e = np.asarray(jax.device_get(expected), dtype=np.float64)
+    if a.shape != e.shape:
+        raise AssertionError(f"{name}: shape mismatch {a.shape} vs {e.shape}")
+    err = np.abs(a - e)
+    tol = atol + rtol * np.abs(e)
+    # NaN-safe: treat any non-finite error (NaN/inf in actual or expected
+    # disagreement) as a mismatch — `err > tol` alone is False for NaN.
+    bad = ~(err <= tol)
+    if bad.any():
+        idxs = np.argwhere(bad)
+        n_bad = len(idxs)
+        lines = [
+            f"{name}: {n_bad}/{a.size} mismatched "
+            f"({100.0 * n_bad / a.size:.3f}%), atol={atol} rtol={rtol}",
+            f"  max abs err {err.max():.6g} at {tuple(np.unravel_index(err.argmax(), a.shape))}",
+        ]
+        for i in idxs[:max_mismatch_report]:
+            t = tuple(i)
+            lines.append(f"  [{t}] actual={a[t]:.6g} expected={e[t]:.6g} err={err[t]:.6g}")
+        raise AssertionError("\n".join(lines))
+
+
+def dist_print(*args, rank: int | None = None, allowed_ranks: Iterable[int] | None = None, **kw):
+    """Per-process serialized printing (reference ``dist_print``).
+
+    In JAX's SPMD model there is one Python process per host (not per device),
+    so this filters by process index rather than device rank.
+    """
+    me = jax.process_index()
+    if rank is not None and me != rank:
+        return
+    if allowed_ranks is not None and me not in set(allowed_ranks):
+        return
+    print(f"[proc {me}/{jax.process_count()}]", *args, **kw)
+    sys.stdout.flush()
+
+
+def perf_func(
+    func: Callable[[], object],
+    iters: int = 50,
+    warmup_iters: int = 10,
+) -> tuple[object, float]:
+    """Wall-clock timing of a device thunk, returning (last_output, ms/iter).
+
+    Reference ``perf_func`` uses CUDA events; on TPU the dispatch is async so
+    we block on the final output. Per-kernel timing belongs to the profiler
+    (``tools/profile.py``).
+    """
+    out = None
+    for _ in range(warmup_iters):
+        out = func()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = func()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / max(iters, 1)
+    return out, dt * 1e3
+
+
+@contextlib.contextmanager
+def timer(name: str = ""):
+    t0 = time.perf_counter()
+    yield
+    dist_print(f"{name}: {(time.perf_counter() - t0) * 1e3:.3f} ms", rank=0)
+
+
+def sleep_async(ms: float):
+    """Straggler injection (reference ``utils.py:1010`` ``sleep_async``): a
+    host-side delay a test can insert on one rank to simulate skew.  Device-
+    side delay injection lives in the straggler option of allreduce."""
+    time.sleep(ms / 1e3)
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(a: int, b: int) -> int:
+    return cdiv(a, b) * b

@@ -1,0 +1,276 @@
+"""The distributed primitive vocabulary, usable *inside* Pallas TPU kernels.
+
+This module is the API contract of the framework, standing in for the whole
+device-language stack of the reference:
+
+- ``dl.rank/num_ranks/symm_at/notify/wait/consume_token``
+  (``python/triton_dist/language/distributed_ops.py:56-111``)
+- the ``libshmem_device`` facade's put/get/signal/barrier families
+  (``python/triton_dist/language/extra/libshmem_device.py``,
+  ``backends/nvidia/language/cuda/libnvshmem_device.py:101-965``)
+- the PTX intrinsics layer (``language_extra.py``) — not needed on TPU:
+  Mosaic provides fences/atomics semantics via semaphores and DMA ordering.
+
+Semantics mapping (see also docs/primitives.md):
+
+==================  =====================================================
+reference           TPU-native (this module)
+==================  =====================================================
+rank()              ``rank(axis)`` -> `jax.lax.axis_index`
+num_ranks()         ``num_ranks(axis)`` -> `jax.lax.axis_size`
+symm_at(ptr, r)     remote refs are addressed by logical device id in
+                    ``remote_copy``/``notify``; ``symm_at`` returns the id
+notify(ptr, r, op)  ``notify(sem, device_id, inc)`` — semaphore signal at a
+                    peer; counting (ADD) semantics.  SET-to-value protocols
+                    are re-expressed as counts (SURVEY.md section 7).
+wait(ptr, n, val)   ``wait(sem, value)`` — blocking semaphore wait
+consume_token(t)    ``consume_token(x, token)`` — ordering no-op; Pallas
+                    ref/DMA dataflow already orders compute after waits
+putmem_signal       ``remote_copy(src, dst, send_sem, recv_sem, dst_rank)``
+                    — RDMA with completion semaphores on both sides
+getmem              TPU RDMA is push-only; pull = peer pushes (use
+                    ``remote_copy`` from the owner) or XLA collectives
+barrier_all         ``barrier_all(axis)`` — all-to-all semaphore barrier
+fence/quiet         DMA completion semaphores subsume memory fencing
+==================  =====================================================
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# ---------------------------------------------------------------------------
+# identity
+
+
+def rank(axis: str) -> jax.Array:
+    """This device's index along a mesh axis (reference ``dl.rank``)."""
+    return jax.lax.axis_index(axis)
+
+
+def num_ranks(axis: str) -> int:
+    """Number of devices along a mesh axis (reference ``dl.num_ranks``)."""
+    return jax.lax.axis_size(axis)
+
+
+def symm_at(peer: jax.Array | int) -> jax.Array | int:
+    """Resolve a peer's symmetric address: on TPU, remote memory is addressed
+    by logical device id in the RDMA/semaphore ops, so the "remote pointer"
+    IS the id (reference ``dl.symm_at`` -> ``nvshmem_ptr``)."""
+    return peer
+
+
+# ---------------------------------------------------------------------------
+# signal / wait
+
+
+def notify(
+    sem,
+    device_id: jax.Array | int | None = None,
+    *,
+    inc: int | jax.Array = 1,
+) -> None:
+    """Signal a (possibly remote) semaphore (reference ``dl.notify``;
+    ``NotifyOp`` lowering ``DistributedOpToLLVM.cpp:233-430``).
+
+    ``device_id=None`` signals the local semaphore.  Only ADD (counting)
+    semantics exist on TPU; protocols written against SET re-encode the
+    expected value as an arrival count.
+    """
+    if device_id is None:
+        pltpu.semaphore_signal(sem, inc=inc)
+    else:
+        pltpu.semaphore_signal(
+            sem,
+            inc=inc,
+            device_id=device_id,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+
+def wait(sem, value: int | jax.Array = 1) -> None:
+    """Block until ``sem >= value``, consuming ``value`` (reference
+    ``dl.wait``; spin-wait lowering ``DistributedOpToLLVM.cpp:146-219``)."""
+    pltpu.semaphore_wait(sem, value)
+
+
+def peek(sem) -> jax.Array:
+    """Non-blocking semaphore read (no reference analogue — the LL protocols
+    poll flags in data; on TPU you can poll the count directly)."""
+    return pltpu.semaphore_read(sem)
+
+
+def consume_token(x: Any, token: Any = None) -> Any:
+    """Ordering fence between a wait and a use (reference
+    ``dl.consume_token``, lowered to an artificial data dependency).
+
+    Pallas orders a ``wait`` before subsequent reads of the refs it guards,
+    so this is an identity kept for API parity and readability.
+    """
+    del token
+    return x
+
+
+# ---------------------------------------------------------------------------
+# data movement
+
+
+def remote_copy(
+    src,
+    dst,
+    send_sem,
+    recv_sem,
+    device_id: jax.Array | int,
+    *,
+    start: bool = True,
+):
+    """Push ``src`` (local ref/slice) into ``dst`` (peer's symmetric ref) —
+    the reference's ``putmem_signal`` family (``nvshmem_wrapper.cu``,
+    ``libnvshmem_device.py``): bulk RDMA plus a completion signal visible to
+    the receiver (``recv_sem``) and to the sender (``send_sem``).
+
+    Returns the descriptor; call ``.wait()`` (or ``wait_send``/``wait_recv``)
+    to block.  ``start=False`` returns an unstarted descriptor.
+    """
+    copy = pltpu.make_async_remote_copy(
+        src_ref=src,
+        dst_ref=dst,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=device_id,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    if start:
+        copy.start()
+    return copy
+
+
+def local_copy(src, dst, sem, *, start: bool = True):
+    """Async local DMA (HBM<->VMEM) — the reference's cp.async / copy-engine
+    path collapses to this on TPU."""
+    copy = pltpu.make_async_copy(src, dst, sem)
+    if start:
+        copy.start()
+    return copy
+
+
+# ---------------------------------------------------------------------------
+# barriers
+
+
+def barrier_all(axis: str, sem=None) -> None:
+    """Full barrier over a mesh axis (reference ``barrier_all`` /
+    ``barrier_all_intra_node_atomic_cas_block``, ``common_ops.py:135-205``).
+
+    Hub (arrive/release) design rather than all-to-all: every rank signals
+    rank 0; rank 0 waits for n-1 arrivals, then releases every other rank
+    with one signal each.  With counting semaphores this is safe under
+    REPEATED use of the same semaphore (and across kernel invocations
+    sharing the global barrier semaphore): arrivals only ever target rank
+    0's semaphore and releases only non-zero ranks', so a fast rank's
+    round-k+1 signals can never satisfy a slow rank's round-k wait — the
+    flaw of the naive all-to-all counting barrier.  O(n) messages, 2 hops.
+
+    Uses the global barrier semaphore unless an explicit REGULAR semaphore
+    is passed.  Kernels using the implicit barrier semaphore must set a
+    ``collective_id`` in their CompilerParams.
+    """
+    if sem is None:
+        sem = pltpu.get_barrier_semaphore()
+    me = rank(axis)
+    n = num_ranks(axis)
+    if n == 1:
+        return
+
+    @pl.when(me != 0)
+    def _():
+        # arrive at the hub, then wait for the release
+        pltpu.semaphore_signal(
+            sem, inc=1, device_id=0, device_id_type=pltpu.DeviceIdType.LOGICAL
+        )
+        pltpu.semaphore_wait(sem, 1)
+
+    @pl.when(me == 0)
+    def _():
+        pltpu.semaphore_wait(sem, n - 1)
+
+        def release(i, _):
+            pltpu.semaphore_signal(
+                sem, inc=1, device_id=i + 1,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            return 0
+
+        jax.lax.fori_loop(0, n - 1, release, 0)
+
+
+def barrier_neighbors(axis: str, sem=None) -> None:
+    """Barrier with ring neighbors only — cheaper than ``barrier_all`` when a
+    kernel only exchanges with adjacent ranks (the common ring case).
+
+    CAVEAT — no round separation: a fast neighbor's next-round signals can
+    satisfy this round's wait, so the only guarantee under repeated use is
+    that neighbors are within one round of each other.  That is sufficient
+    for ring kernels whose per-chunk writes are individually gated by DMA
+    semaphores (the normal pattern), but NOT a true barrier.  Use
+    ``barrier_all`` (round-safe hub design) when in doubt;
+    ``collective_prologue`` defaults to it.
+    """
+    if sem is None:
+        sem = pltpu.get_barrier_semaphore()
+    me = rank(axis)
+    n = num_ranks(axis)
+    if n == 1:
+        return
+    left = jax.lax.rem(me + n - 1, n)
+    right = jax.lax.rem(me + 1, n)
+    pltpu.semaphore_signal(sem, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(sem, inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(sem, 2)
+
+
+def collective_prologue(axis: str, *, neighbors_only: bool = False) -> None:
+    """Entry barrier every collective kernel must run before its first remote
+    write.
+
+    Rationale (same on real TPU and in interpret mode): a remote DMA may land
+    in a peer's buffer before that peer has entered the kernel — on hardware
+    the buffer may still be read by the peer's *previous* computation (XLA
+    reuses buffers), and in interpret mode the buffer may not exist yet.  The
+    reference has the same invariant: every op starts with
+    ``local_copy_and_barrier_all`` / ``barrier_all_on_stream``
+    (``allgather_gemm.py:101-117``, ``common_ops.py``).
+
+    ``neighbors_only=True`` is sufficient for ring kernels where only ring
+    neighbors ever write to us.
+    """
+    if neighbors_only:
+        barrier_neighbors(axis)
+    else:
+        barrier_all(axis)
+
+
+# ---------------------------------------------------------------------------
+# ring topology helpers
+
+
+def ring_neighbors(axis: str) -> tuple[jax.Array, jax.Array]:
+    """(left, right) logical ids on the ring along ``axis``."""
+    me = rank(axis)
+    n = num_ranks(axis)
+    return jax.lax.rem(me + n - 1, n), jax.lax.rem(me + 1, n)
+
+
+def ring_src_rank(axis: str, step: jax.Array | int) -> jax.Array:
+    """Rank whose chunk arrives at this device after ``step`` forwarding hops
+    in a +1 ring (chunk origin at ring distance step+1 to the left)."""
+    me = rank(axis)
+    n = num_ranks(axis)
+    return jax.lax.rem(me + (2 * n) - step - 1, n)

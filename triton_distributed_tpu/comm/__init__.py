@@ -1,0 +1,5 @@
+"""Collectives as fused Pallas TPU kernels (reference: the kernel library's
+communication half — allgather/reduce_scatter/allreduce/all-to-all files in
+``python/triton_dist/kernels/nvidia/``)."""
+
+from .allgather import AllGatherMethod, all_gather, choose_method

@@ -1,0 +1,261 @@
+"""AllGather collectives as Pallas TPU kernels.
+
+TPU-native re-design of the reference's AllGather zoo
+(``python/triton_dist/kernels/nvidia/allgather.py:46-601`` — copy-engine
+full-mesh push/pull, 1D ring, 2D ring; ``low_latency_allgather.py:47-994`` —
+device push kernels with LL flag-in-data protocol, multimem).  On TPU:
+
+- the copy-engine producer stream becomes in-kernel async remote DMA chains;
+- LL flag-in-data packing becomes DMA completion semaphores (no flags woven
+  into payload — the DMA system signals per-transfer);
+- multimem/NVLS broadcast has no ICI equivalent; the bidirectional ring uses
+  both ICI directions for full bisection bandwidth instead;
+- method auto-selection by message size mirrors
+  ``get_auto_all_gather_method`` (``allgather.py:57``).
+
+All variants gather dim 0.  Each kernel is written to be *consumable at chunk
+granularity*: received chunks land directly in their final offset of the
+output buffer and are individually gated by a per-chunk DMA semaphore — the
+property the fused AG-GEMM consumer (``ops/ag_gemm.py``) relies on, exactly
+like the reference consumer GEMM waits on per-rank flags
+(``allgather_gemm.py:146-215``).
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core import compilation
+from ..core.mesh import TP_AXIS
+from ..lang import primitives as dl
+from ..lang.primitives import Team
+
+
+class AllGatherMethod(enum.Enum):
+    """Mirrors the reference's ``AllGatherMethod`` enum (``allgather.py:46``);
+    TPU has no intra/inter-node split at this level (DCN hierarchy lives in
+    ``hierarchical_all_gather``)."""
+
+    AUTO = "auto"
+    PUSH_1SHOT = "push_1shot"   # full-mesh push: lowest latency, small msgs
+    RING_1D = "ring_1d"         # unidirectional ring: simple, one ICI dir
+    RING_BIDIR = "ring_bidir"   # bidirectional ring: full bisection bandwidth
+
+
+# One-shot push beats the ring below roughly one MTU-ish chunk per hop; the
+# reference switches methods by size the same way (allgather.py:57-78).
+_PUSH_BYTES_THRESHOLD = 256 * 1024
+
+
+def choose_method(nbytes_per_shard: int, num_ranks: int) -> AllGatherMethod:
+    if num_ranks <= 2:
+        return AllGatherMethod.PUSH_1SHOT
+    if nbytes_per_shard <= _PUSH_BYTES_THRESHOLD:
+        return AllGatherMethod.PUSH_1SHOT
+    return AllGatherMethod.RING_BIDIR
+
+
+def _chunk(ref, idx, m):
+    return ref.at[pl.ds(idx * m, m)]
+
+
+def _wait_recv_chunk(out_ref, recv_sems, chunk_idx, m):
+    """Block until the remote write of chunk ``chunk_idx`` has fully landed.
+
+    A DMA semaphore counts bytes; constructing a same-shaped local descriptor
+    and waiting it consumes exactly the incoming transfer's count.
+    """
+    pltpu.make_async_copy(
+        _chunk(out_ref, chunk_idx, m),
+        _chunk(out_ref, chunk_idx, m),
+        recv_sems.at[chunk_idx],
+    ).wait()
+
+
+def _wait_send(out_ref, send_sem, chunk_idx, m):
+    pltpu.make_async_copy(
+        _chunk(out_ref, chunk_idx, m), _chunk(out_ref, chunk_idx, m), send_sem
+    ).wait()
+
+
+def _ag_push_kernel(team: Team, m, x_ref, out_ref, local_sem, send_sem, recv_sems):
+    """One-shot full-mesh push (reference ``All2All_IntraNode`` copy-engine
+    path ``allgather.py:81-139`` and NVSHMEM broadcast push kernels in
+    ``low_latency_allgather.py``): every rank RDMAs its shard into all peers'
+    output at its own offset, then waits for all n-1 incoming shards."""
+    me, n = team.rank(), team.size
+    # own shard into place (async local DMA; overlaps the barrier)
+    local = dl.local_copy(x_ref, _chunk(out_ref, me, m), local_sem)
+    dl.collective_prologue(team)
+    local.wait()
+    # push to every peer (static loop; ICI routes concurrently)
+    for off in range(1, n):
+        dst = jax.lax.rem(me + off, n)
+        dl.remote_copy(
+            _chunk(out_ref, me, m),
+            _chunk(out_ref, me, m),
+            send_sem,
+            recv_sems.at[me],
+            team.device_id(dst),
+        )
+    for off in range(1, n):
+        src = jax.lax.rem(me + n - off, n)
+        _wait_recv_chunk(out_ref, recv_sems, src, m)
+    for _ in range(n - 1):
+        _wait_send(out_ref, send_sem, me, m)
+
+
+def _ag_ring_kernel(team: Team, m, x_ref, out_ref, local_sem, send_sem, recv_sems):
+    """Unidirectional ring (reference ``Ring1D_IntraNode``,
+    ``allgather.py:141-200``): each step forwards the chunk received last step
+    to the right neighbor; n-1 steps, each chunk takes rank-distance hops."""
+    me, n = team.rank(), team.size
+    _, right = team.neighbor_ranks()
+    right_id = team.device_id(right)
+    local = dl.local_copy(x_ref, _chunk(out_ref, me, m), local_sem)
+    dl.collective_prologue(team, neighbors_only=True)
+    local.wait()
+    for step in range(n - 1):
+        c_send = jax.lax.rem(me + n - step, n)
+        dl.remote_copy(
+            _chunk(out_ref, c_send, m),
+            _chunk(out_ref, c_send, m),
+            send_sem,
+            recv_sems.at[c_send],
+            right_id,
+        )
+        c_recv = jax.lax.rem(me + n - step - 1, n)
+        _wait_recv_chunk(out_ref, recv_sems, c_recv, m)
+        _wait_send(out_ref, send_sem, c_send, m)
+
+
+def _ag_ring_bidir_kernel(
+    team: Team, m, x_ref, out_ref, local_sem, send_sems, recv_sems
+):
+    """Bidirectional ring: clockwise stream carries ceil((n-1)/2) chunks,
+    counter-clockwise floor((n-1)/2), using both ICI directions — the TPU
+    answer to the reference's NUMA-aware 2D ring (``allgather.py:203-260``),
+    where the hierarchy exists to use both NVLink directions/planes."""
+    me, n = team.rank(), team.size
+    left, right = team.neighbor_ranks()
+    left_id, right_id = team.device_id(left), team.device_id(right)
+    n_right = (n - 1 + 1) // 2   # chunks travelling clockwise
+    n_left = (n - 1) // 2        # chunks travelling counter-clockwise
+    local = dl.local_copy(x_ref, _chunk(out_ref, me, m), local_sem)
+    dl.collective_prologue(team, neighbors_only=True)
+    local.wait()
+    for step in range(max(n_right, n_left)):
+        if step < n_right:  # forward (me - step) clockwise
+            c = jax.lax.rem(me + n - step, n)
+            dl.remote_copy(
+                _chunk(out_ref, c, m), _chunk(out_ref, c, m),
+                send_sems.at[0], recv_sems.at[c], right_id,
+            )
+        if step < n_left:   # forward (me + step) counter-clockwise
+            c = jax.lax.rem(me + step, n)
+            dl.remote_copy(
+                _chunk(out_ref, c, m), _chunk(out_ref, c, m),
+                send_sems.at[1], recv_sems.at[c], left_id,
+            )
+        if step < n_right:
+            c = jax.lax.rem(me + n - step - 1, n)
+            _wait_recv_chunk(out_ref, recv_sems, c, m)
+            c = jax.lax.rem(me + n - step, n)
+            _wait_send(out_ref, send_sems.at[0], c, m)
+        if step < n_left:
+            c = jax.lax.rem(me + step + 1, n)
+            _wait_recv_chunk(out_ref, recv_sems, c, m)
+            c = jax.lax.rem(me + step, n)
+            _wait_send(out_ref, send_sems.at[1], c, m)
+
+
+_KERNELS = {
+    AllGatherMethod.PUSH_1SHOT: (_ag_push_kernel, False),
+    AllGatherMethod.RING_1D: (_ag_ring_kernel, False),
+    AllGatherMethod.RING_BIDIR: (_ag_ring_bidir_kernel, True),
+}
+
+
+@functools.lru_cache(maxsize=None)
+def _build_all_gather(
+    mesh: Mesh,
+    axis: str,
+    method: AllGatherMethod,
+    shard_shape: tuple[int, ...],
+    dtype: jnp.dtype,
+):
+    """Build + jit the collective once per (mesh, axis, method, shape, dtype).
+
+    Cached so steady-state calls hit the jit cache instead of re-tracing
+    (jax.jit caches by function identity; a fresh closure every call would
+    recompile every call)."""
+    team = Team.of(mesh, axis)
+    n = team.size
+    m_local = shard_shape[0]
+    kern, two_send_sems = _KERNELS[method]
+    kernel = functools.partial(kern, team, m_local)
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n * m_local, *shard_shape[1:]), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),                       # local copy
+            pltpu.SemaphoreType.DMA((2,)) if two_send_sems
+            else pltpu.SemaphoreType.DMA(()),                  # send(s)
+            pltpu.SemaphoreType.DMA((n,)),                     # per-chunk recv
+        ],
+        compiler_params=compilation.compiler_params(
+            collective=True,
+            collective_id=compilation.collective_id("allgather"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+
+    ndim = len(shard_shape)
+    return compilation.jit_shard_map(
+        call, mesh,
+        in_specs=P(axis, *([None] * (ndim - 1))),
+        out_specs=P(*([None] * ndim)),
+    )
+
+
+def all_gather(
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = TP_AXIS,
+    *,
+    method: AllGatherMethod = AllGatherMethod.AUTO,
+) -> jax.Array:
+    """Gather dim 0 of ``x`` (sharded over ``axis``) on every device.
+
+    Entry point mirroring the reference's host-side dispatchers
+    (``allgather.py`` / ``fast_allgather``).  Returns the replicated gathered
+    array; golden equivalent is ``jax.lax.all_gather(..., tiled=True)``.
+    """
+    n = mesh.shape[axis]
+    if n == 1:
+        return x
+
+    m_total = x.shape[0]
+    if m_total % n:
+        raise ValueError(f"dim0 {m_total} not divisible by {axis}={n}")
+    m_local = m_total // n
+    shard_shape = (m_local, *x.shape[1:])
+
+    if method == AllGatherMethod.AUTO:
+        nbytes = int(jnp.dtype(x.dtype).itemsize) * m_local
+        for d in shard_shape[1:]:
+            nbytes *= d
+        method = choose_method(nbytes, n)
+
+    fn = _build_all_gather(mesh, axis, method, shard_shape, jnp.dtype(x.dtype))
+    return fn(x)

@@ -59,6 +59,41 @@ def interpret_mode() -> pltpu.InterpretParams | bool:
     return False
 
 
+# Stable collective_id per kernel family: Mosaic keys the global barrier
+# semaphore by collective_id, so two different collectives in flight must not
+# share one (the reference's analogue is distinct symmetric flag arrays per
+# op context).  The registry is FIXED, not first-call-ordered: every process
+# of a multi-host program must agree on family -> id regardless of which
+# kernels it happens to trace first.
+_COLLECTIVE_IDS: dict[str, int] = {
+    "test": 0,
+    "allgather": 1,
+    "reduce_scatter": 2,
+    "allreduce": 3,
+    "all_to_all": 4,
+    "ag_gemm": 5,
+    "gemm_rs": 6,
+    "ag_group_gemm": 7,
+    "moe_reduce_rs": 8,
+    "flash_decode": 9,
+    "sp_ag_attention": 10,
+    "ep_dispatch": 11,
+    "ep_combine": 12,
+    "barrier": 13,
+}
+
+
+def collective_id(family: str) -> int:
+    try:
+        return _COLLECTIVE_IDS[family]
+    except KeyError:
+        raise KeyError(
+            f"unknown collective family {family!r}; register it in "
+            "core.compilation._COLLECTIVE_IDS (ids must be identical on "
+            "every process)"
+        ) from None
+
+
 def compiler_params(
     *,
     collective: bool = True,

@@ -37,12 +37,72 @@ fence/quiet         DMA completion semaphores subsume memory fencing
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# ---------------------------------------------------------------------------
+# teams: axis-rank -> logical device id translation
+
+
+@dataclasses.dataclass(frozen=True)
+class Team:
+    """A communicator over one mesh axis (reference: NVSHMEM teams / the
+    torch TP process group, ``utils.py:190``).
+
+    Pallas remote DMA and semaphore ops address peers by *linearized logical
+    device id* over the whole mesh, while collective algorithms think in
+    *ranks along one axis*.  On a multi-axis mesh (e.g. ``{"dp":2,"tp":4}``)
+    those differ: tp-rank 1 seen from device (dp=1, tp=0) is logical id 5,
+    not 1.  ``Team.device_id`` performs that translation by holding every
+    mesh axis's (name, size) and substituting the peer's rank only on the
+    team axis; all other coordinates are this device's own.
+    """
+
+    axes: tuple[tuple[str, int], ...]  # full mesh (name, size), outermost first
+    axis: str                          # the team (collective) axis
+
+    @classmethod
+    def of(cls, mesh, axis: str) -> "Team":
+        return cls(
+            tuple((str(n), int(mesh.shape[n])) for n in mesh.axis_names),
+            axis,
+        )
+
+    @property
+    def size(self) -> int:
+        return dict(self.axes)[self.axis]
+
+    def rank(self) -> jax.Array:
+        return jax.lax.axis_index(self.axis)
+
+    def device_id(self, peer_rank: jax.Array | int) -> jax.Array | int:
+        """Logical device id of the team member with rank ``peer_rank``."""
+        if len(self.axes) == 1:
+            return peer_rank
+        lid = None
+        for name, s in self.axes:
+            idx = peer_rank if name == self.axis else jax.lax.axis_index(name)
+            lid = idx if lid is None else lid * s + idx
+        return lid
+
+    def neighbor_ranks(self) -> tuple[jax.Array, jax.Array]:
+        """(left, right) team ranks on the ring."""
+        me, n = self.rank(), self.size
+        return jax.lax.rem(me + n - 1, n), jax.lax.rem(me + 1, n)
+
+
+def _as_team(axis: "str | Team") -> Team:
+    if isinstance(axis, Team):
+        return axis
+    # Single-axis view: correct when the mesh has only this axis; callers on
+    # multi-axis meshes must pass a Team built with Team.of(mesh, axis).
+    return Team(((axis, jax.lax.axis_size(axis)),), axis)
+
 
 # ---------------------------------------------------------------------------
 # identity
@@ -163,7 +223,7 @@ def local_copy(src, dst, sem, *, start: bool = True):
 # barriers
 
 
-def barrier_all(axis: str, sem=None) -> None:
+def barrier_all(axis: "str | Team", sem=None) -> None:
     """Full barrier over a mesh axis (reference ``barrier_all`` /
     ``barrier_all_intra_node_atomic_cas_block``, ``common_ops.py:135-205``).
 
@@ -180,10 +240,11 @@ def barrier_all(axis: str, sem=None) -> None:
     is passed.  Kernels using the implicit barrier semaphore must set a
     ``collective_id`` in their CompilerParams.
     """
+    team = _as_team(axis)
     if sem is None:
         sem = pltpu.get_barrier_semaphore()
-    me = rank(axis)
-    n = num_ranks(axis)
+    me = team.rank()
+    n = team.size
     if n == 1:
         return
 
@@ -191,7 +252,8 @@ def barrier_all(axis: str, sem=None) -> None:
     def _():
         # arrive at the hub, then wait for the release
         pltpu.semaphore_signal(
-            sem, inc=1, device_id=0, device_id_type=pltpu.DeviceIdType.LOGICAL
+            sem, inc=1, device_id=team.device_id(0),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
         pltpu.semaphore_wait(sem, 1)
 
@@ -201,7 +263,7 @@ def barrier_all(axis: str, sem=None) -> None:
 
         def release(i, _):
             pltpu.semaphore_signal(
-                sem, inc=1, device_id=i + 1,
+                sem, inc=1, device_id=team.device_id(i + 1),
                 device_id_type=pltpu.DeviceIdType.LOGICAL,
             )
             return 0
@@ -209,7 +271,7 @@ def barrier_all(axis: str, sem=None) -> None:
         jax.lax.fori_loop(0, n - 1, release, 0)
 
 
-def barrier_neighbors(axis: str, sem=None) -> None:
+def barrier_neighbors(axis: "str | Team", sem=None) -> None:
     """Barrier with ring neighbors only — cheaper than ``barrier_all`` when a
     kernel only exchanges with adjacent ranks (the common ring case).
 
@@ -221,22 +283,20 @@ def barrier_neighbors(axis: str, sem=None) -> None:
     ``barrier_all`` (round-safe hub design) when in doubt;
     ``collective_prologue`` defaults to it.
     """
+    team = _as_team(axis)
     if sem is None:
         sem = pltpu.get_barrier_semaphore()
-    me = rank(axis)
-    n = num_ranks(axis)
-    if n == 1:
+    if team.size == 1:
         return
-    left = jax.lax.rem(me + n - 1, n)
-    right = jax.lax.rem(me + 1, n)
-    pltpu.semaphore_signal(sem, inc=1, device_id=left,
+    left, right = team.neighbor_ranks()
+    pltpu.semaphore_signal(sem, inc=1, device_id=team.device_id(left),
                            device_id_type=pltpu.DeviceIdType.LOGICAL)
-    pltpu.semaphore_signal(sem, inc=1, device_id=right,
+    pltpu.semaphore_signal(sem, inc=1, device_id=team.device_id(right),
                            device_id_type=pltpu.DeviceIdType.LOGICAL)
     pltpu.semaphore_wait(sem, 2)
 
 
-def collective_prologue(axis: str, *, neighbors_only: bool = False) -> None:
+def collective_prologue(axis: "str | Team", *, neighbors_only: bool = False) -> None:
     """Entry barrier every collective kernel must run before its first remote
     write.
 
@@ -261,16 +321,16 @@ def collective_prologue(axis: str, *, neighbors_only: bool = False) -> None:
 # ring topology helpers
 
 
-def ring_neighbors(axis: str) -> tuple[jax.Array, jax.Array]:
-    """(left, right) logical ids on the ring along ``axis``."""
-    me = rank(axis)
-    n = num_ranks(axis)
-    return jax.lax.rem(me + n - 1, n), jax.lax.rem(me + 1, n)
+def ring_neighbors(axis: "str | Team") -> tuple[jax.Array, jax.Array]:
+    """(left, right) logical device ids of ring neighbors along ``axis``."""
+    team = _as_team(axis)
+    left, right = team.neighbor_ranks()
+    return team.device_id(left), team.device_id(right)
 
 
-def ring_src_rank(axis: str, step: jax.Array | int) -> jax.Array:
+def ring_src_rank(axis: "str | Team", step: jax.Array | int) -> jax.Array:
     """Rank whose chunk arrives at this device after ``step`` forwarding hops
     in a +1 ring (chunk origin at ring distance step+1 to the left)."""
-    me = rank(axis)
-    n = num_ranks(axis)
+    team = _as_team(axis)
+    me, n = team.rank(), team.size
     return jax.lax.rem(me + (2 * n) - step - 1, n)

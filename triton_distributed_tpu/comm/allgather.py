@@ -67,22 +67,11 @@ def _chunk(ref, idx, m):
 
 
 def _wait_recv_chunk(out_ref, recv_sems, chunk_idx, m):
-    """Block until the remote write of chunk ``chunk_idx`` has fully landed.
-
-    A DMA semaphore counts bytes; constructing a same-shaped local descriptor
-    and waiting it consumes exactly the incoming transfer's count.
-    """
-    pltpu.make_async_copy(
-        _chunk(out_ref, chunk_idx, m),
-        _chunk(out_ref, chunk_idx, m),
-        recv_sems.at[chunk_idx],
-    ).wait()
+    dl.wait_recv(_chunk(out_ref, chunk_idx, m), recv_sems.at[chunk_idx])
 
 
 def _wait_send(out_ref, send_sem, chunk_idx, m):
-    pltpu.make_async_copy(
-        _chunk(out_ref, chunk_idx, m), _chunk(out_ref, chunk_idx, m), send_sem
-    ).wait()
+    dl.wait_send(_chunk(out_ref, chunk_idx, m), send_sem)
 
 
 def _ag_push_kernel(team: Team, m, x_ref, out_ref, local_sem, send_sem, recv_sems):
@@ -133,7 +122,8 @@ def _ag_ring_kernel(team: Team, m, x_ref, out_ref, local_sem, send_sem, recv_sem
         )
         c_recv = jax.lax.rem(me + n - step - 1, n)
         _wait_recv_chunk(out_ref, recv_sems, c_recv, m)
-        _wait_send(out_ref, send_sem, c_send, m)
+    for _ in range(n - 1):  # drain sends off the critical path
+        _wait_send(out_ref, send_sem, me, m)
 
 
 def _ag_ring_bidir_kernel(
@@ -167,13 +157,13 @@ def _ag_ring_bidir_kernel(
         if step < n_right:
             c = jax.lax.rem(me + n - step - 1, n)
             _wait_recv_chunk(out_ref, recv_sems, c, m)
-            c = jax.lax.rem(me + n - step, n)
-            _wait_send(out_ref, send_sems.at[0], c, m)
         if step < n_left:
             c = jax.lax.rem(me + step + 1, n)
             _wait_recv_chunk(out_ref, recv_sems, c, m)
-            c = jax.lax.rem(me + step, n)
-            _wait_send(out_ref, send_sems.at[1], c, m)
+    for _ in range(n_right):  # drain sends off the critical path
+        _wait_send(out_ref, send_sems.at[0], me, m)
+    for _ in range(n_left):
+        _wait_send(out_ref, send_sems.at[1], me, m)
 
 
 _KERNELS = {

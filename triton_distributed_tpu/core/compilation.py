@@ -37,6 +37,29 @@ def interpret_params(
 
 _race_detection = {"enabled": False}
 
+_shims_installed = {"done": False}
+
+
+def _install_interpret_shims() -> None:
+    """Make ``pltpu.emit_pipeline`` usable under CPU interpret mode.
+
+    The pipeline emitter asks the backend for the TPU generation to pick VMEM
+    tilings; on the CPU backend there is no TPU, so we pin a v5-class answer
+    (tilings are a performance detail — interpret mode only checks
+    semantics).  Scoped to the CPU backend; on real TPU nothing is touched.
+    """
+    if _shims_installed["done"] or not platform.on_cpu():
+        return
+    from jax._src.pallas.mosaic import pipeline as _mosaic_pipeline
+
+    # fail loudly if a jax upgrade moves the symbol (a silent no-op here
+    # would surface as an unrelated backend-query error inside emit_pipeline)
+    assert hasattr(_mosaic_pipeline, "_get_tpu_generation"), (
+        "jax internals changed: update core.compilation._install_interpret_shims"
+    )
+    _mosaic_pipeline._get_tpu_generation = lambda: 5
+    _shims_installed["done"] = True
+
 
 def enable_race_detection(on: bool = True) -> None:
     """Globally enable interpret-mode race detection for subsequent kernels.
@@ -55,6 +78,7 @@ def interpret_mode() -> pltpu.InterpretParams | bool:
     False on real TPU (compile with Mosaic); InterpretParams on CPU.
     """
     if platform.on_cpu():
+        _install_interpret_shims()
         return interpret_params(detect_races=_race_detection["enabled"])
     return False
 

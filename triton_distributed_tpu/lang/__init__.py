@@ -3,6 +3,7 @@ contract vs the reference's dl.* / libshmem_device)."""
 from .primitives import (
     Team,
     rank, num_ranks, symm_at, notify, wait, peek, consume_token,
-    remote_copy, local_copy, barrier_all, barrier_neighbors,
+    remote_copy, local_copy, wait_recv, wait_send,
+    barrier_all, barrier_neighbors,
     ring_neighbors, ring_src_rank, collective_prologue,
 )

@@ -219,6 +219,25 @@ def local_copy(src, dst, sem, *, start: bool = True):
     return copy
 
 
+def wait_recv(dst_ref, sem) -> None:
+    """Block until a remote write into ``dst_ref`` has fully landed.
+
+    A DMA semaphore counts bytes; constructing a same-shaped local descriptor
+    and waiting it consumes exactly the incoming transfer's count.  This is
+    the consumer side of ``remote_copy`` when producer and consumer are
+    different points in the program (the reference's ``dl.wait`` on ready
+    flags / ``signal_wait_until``).
+    """
+    pltpu.make_async_copy(dst_ref, dst_ref, sem).wait()
+
+
+def wait_send(src_ref, sem) -> None:
+    """Drain one outgoing ``remote_copy`` of ``src_ref``'s shape/size (the
+    reference's ``nvshmem_quiet`` per-transfer analogue).  Counting
+    semantics: call once per outstanding send of this size."""
+    pltpu.make_async_copy(src_ref, src_ref, sem).wait()
+
+
 # ---------------------------------------------------------------------------
 # barriers
 

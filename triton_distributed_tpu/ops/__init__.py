@@ -1,0 +1,5 @@
+"""Overlapped compute kernels (reference: the compute half of
+``python/triton_dist/kernels/nvidia/`` — AG-GEMM, GEMM-RS, MoE group-GEMM,
+distributed flash-decode, SP attention)."""
+
+from .ag_gemm import AgGemmConfig, ag_gemm

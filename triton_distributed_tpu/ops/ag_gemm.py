@@ -1,0 +1,246 @@
+"""Fused AllGather-GEMM: tile-granular communication/compute overlap.
+
+The canonical op of the framework (reference:
+``python/triton_dist/kernels/nvidia/allgather_gemm.py`` — producer AG +
+consumer persistent GEMM that ``dl.wait``s per-rank readiness flags before
+consuming each rank's tiles, rank-swizzled so the local chunk is computed
+first, ``allgather_gemm.py:146-215``; host entry ``ag_gemm:534``, context
+``AllGatherGEMMTensorParallelContext:405``).
+
+TPU design — ONE Pallas kernel per device instead of producer stream +
+consumer kernel:
+
+- the ring AG is issued as async remote DMA *inside* the kernel: each step
+  forwards the chunk received last step to the right neighbor, so the ICI
+  transfer of chunk s+1 rides under the MXU matmul of chunk s;
+- per-chunk DMA recv semaphores play the role of the reference's readiness
+  flags (``ready_ptr`` spin-waits);
+- the consumer is an inner ``emit_pipeline`` blocked matmul (VMEM
+  double-buffered by the pipeline emitter) — the Pallas analogue of the
+  reference's persistent tile loop;
+- chunk consumption order is the ring arrival order starting with the local
+  shard — the same "self first, then by arrival distance" swizzle as
+  ``allgather_gemm.py:205-215``.
+
+Computes ``C[M, N_loc] = AllGather(A_shard)[M, K] @ B_loc[K, N_loc]`` — the
+column-parallel half of a TP layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core import compilation
+from ..core.mesh import TP_AXIS
+from ..lang import primitives as dl
+from ..lang.primitives import Team
+
+
+@dataclasses.dataclass(frozen=True)
+class AgGemmConfig:
+    """Tile sizes for the consumer matmul (the autotuner's knobs — reference
+    tunes BLOCK_SIZE_M/N/K + num_stages via ``@triton.autotune``)."""
+
+    bm: int = 256
+    bn: int = 512
+    bk: int = 512
+
+    def clip(self, m_loc: int, k: int, n_loc: int) -> "AgGemmConfig":
+        def pick(b, dim):
+            b = min(b, dim)
+            while dim % b:
+                b //= 2
+            return max(b, 1)
+
+        return AgGemmConfig(
+            bm=pick(self.bm, m_loc), bn=pick(self.bn, n_loc),
+            bk=pick(self.bk, k),
+        )
+
+
+def _matmul_body(nk: int, out_dtype, a_ref, b_ref, c_ref, acc_ref):
+    """Inner pipeline body: blocked matmul with f32 accumulation.
+
+    Grid is (m, n, k) with k innermost so the accumulator stays resident per
+    (m, n) tile — the MXU hot loop, reference ``allgather_gemm.py:216-260``.
+    """
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jnp.dot(
+        a_ref[...], b_ref[...], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(k == nk - 1)
+    def _():
+        c_ref[...] = acc_ref[...].astype(out_dtype)
+
+
+def _ag_gemm_kernel(
+    team: Team,
+    m_loc: int,
+    k_dim: int,
+    n_loc: int,
+    cfg: AgGemmConfig,
+    out_dtype,
+    a_ref,      # (m_loc, k)   local A shard             [ANY]
+    b_ref,      # (k, n_loc)   local B (column) shard    [ANY]
+    ag_ref,     # (n*m_loc, k) gathered-A workspace      [ANY, output]
+    c_ref,      # (n*m_loc, n_loc) C output              [ANY, output]
+    local_sem,
+    send_sem,
+    recv_sems,  # per-chunk arrival gates (== reference ready flags)
+    acc_ref,    # (bm, bn) f32 accumulator               [VMEM scratch]
+):
+    me, n = team.rank(), team.size
+    _, right = team.neighbor_ranks()
+    right_id = team.device_id(right)
+
+    grid = (m_loc // cfg.bm, n_loc // cfg.bn, k_dim // cfg.bk)
+    nk = grid[2]
+    pipeline = pltpu.emit_pipeline(
+        functools.partial(_matmul_body, nk, out_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((cfg.bm, cfg.bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((cfg.bk, cfg.bn), lambda i, j, k: (k, j)),
+        ],
+        out_specs=[pl.BlockSpec((cfg.bm, cfg.bn), lambda i, j, k: (i, j))],
+    )
+
+    def chunk_rows(ref, r):
+        return ref.at[pl.ds(r * m_loc, m_loc)]
+
+    local = dl.local_copy(a_ref, chunk_rows(ag_ref, me), local_sem)
+    dl.collective_prologue(team, neighbors_only=True)
+    local.wait()
+
+    for s in range(n):
+        r = jax.lax.rem(me + n - s, n) if s else me
+        if s > 0:
+            # arrival gate for chunk r (reference: dl.wait on ready flags)
+            dl.wait_recv(chunk_rows(ag_ref, r), recv_sems.at[r])
+        if s < n - 1 and n > 1:
+            # forward on the ring BEFORE computing, so the transfer of the
+            # next chunk rides under this chunk's matmul
+            dl.remote_copy(
+                chunk_rows(ag_ref, r),
+                chunk_rows(ag_ref, r),
+                send_sem,
+                recv_sems.at[r],
+                right_id,
+            )
+        pipeline(
+            chunk_rows(ag_ref, r),
+            b_ref,
+            chunk_rows(c_ref, r),
+            scratches=[acc_ref],
+        )
+
+    for s in range(n - 1):
+        dl.wait_send(chunk_rows(ag_ref, me), send_sem)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_ag_gemm(
+    mesh: Mesh,
+    axis: str,
+    m_loc: int,
+    k_dim: int,
+    n_loc: int,
+    dtype: jnp.dtype,
+    out_dtype: jnp.dtype,
+    cfg: AgGemmConfig,
+):
+    team = Team.of(mesh, axis)
+    n = team.size
+
+    kernel = functools.partial(
+        _ag_gemm_kernel, team, m_loc, k_dim, n_loc, cfg, out_dtype
+    )
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((n * m_loc, k_dim), dtype),       # gathered A
+            jax.ShapeDtypeStruct((n * m_loc, n_loc), out_dtype),   # C
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((n,)),
+            pltpu.VMEM((cfg.bm, cfg.bn), jnp.float32),
+        ],
+        compiler_params=compilation.compiler_params(
+            collective=True,
+            collective_id=compilation.collective_id("ag_gemm"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+
+    return compilation.jit_shard_map(
+        call, mesh,
+        in_specs=(P(axis, None), P(None, axis)),
+        out_specs=(P(), P(None, axis)),
+    )
+
+
+def ag_gemm(
+    a: jax.Array,
+    b: jax.Array,
+    mesh: Mesh,
+    axis: str = TP_AXIS,
+    *,
+    config: AgGemmConfig | None = None,
+    out_dtype=None,
+    return_gathered: bool = False,
+):
+    """Overlapped ``AllGather(a) @ b`` (reference host entry ``ag_gemm:534``).
+
+    ``a``: (M, K) sharded on dim 0 over ``axis`` (the activations).
+    ``b``: (K, N) sharded on dim 1 over ``axis`` (column-parallel weight).
+    Returns C = (M, N) sharded on dim 1; with ``return_gathered`` also the
+    replicated gathered A (the reference keeps it in ctx workspace for reuse,
+    e.g. by the attention layer).
+    """
+    cfg = config or AgGemmConfig()
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
+    n = mesh.shape[axis]
+
+    m_tot, k_dim = a.shape
+    k2, n_tot = b.shape
+    if k2 != k_dim:
+        raise ValueError(f"inner dims mismatch: {a.shape} @ {b.shape}")
+    if m_tot % n or n_tot % n:
+        raise ValueError(f"M={m_tot}, N={n_tot} must divide {axis}={n}")
+
+    if n == 1:
+        c = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
+        return (c, a) if return_gathered else c
+
+    # clip BEFORE the cache lookup so configs that normalize to the same
+    # effective tiles share one compiled kernel
+    cfg = cfg.clip(m_tot // n, k_dim, n_tot // n)
+    fn = _build_ag_gemm(
+        mesh, axis, m_tot // n, k_dim, n_tot // n,
+        jnp.dtype(a.dtype), out_dtype, cfg,
+    )
+    gathered, c = fn(a, b)
+    return (c, gathered) if return_gathered else c

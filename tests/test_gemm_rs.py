@@ -1,0 +1,63 @@
+"""GEMM-RS vs golden `matmul + psum-scatter` (reference ``test_gemm_rs.py``)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.core.mesh import TP_AXIS, make_mesh, shard
+from triton_distributed_tpu.core.utils import assert_allclose, rand_tensor
+from triton_distributed_tpu.ops import GemmRsConfig, gemm_rs
+
+
+def _golden(a, b):
+    return jnp.dot(a.astype(jnp.float32), b.astype(jnp.float32))
+
+
+@pytest.mark.parametrize("m,k,n,dtype", [
+    (64, 256, 128, jnp.float32),
+    (128, 512, 256, jnp.bfloat16),
+])
+def test_gemm_rs_matches_golden(mesh8, m, k, n, dtype):
+    a = rand_tensor((m, k), dtype, scale=0.1)
+    b = rand_tensor((k, n), dtype, scale=0.1)
+    a_s = shard(mesh8, a, None, TP_AXIS)
+    b_s = shard(mesh8, b, TP_AXIS)
+    c = gemm_rs(a_s, b_s, mesh8, TP_AXIS,
+                config=GemmRsConfig(bm=8, bn=64, bk=32))
+    assert c.shape == (m, n)
+    tol = 1e-3 if dtype == jnp.float32 else 1e-1
+    assert_allclose(c.astype(jnp.float32), _golden(a, b).astype(c.dtype),
+                    atol=tol, rtol=tol, name="gemm_rs")
+
+
+def test_gemm_rs_repeat(mesh8):
+    a = rand_tensor((64, 256), jnp.float32, scale=0.1)
+    b = rand_tensor((256, 128), jnp.float32, scale=0.1)
+    a_s = shard(mesh8, a, None, TP_AXIS)
+    b_s = shard(mesh8, b, TP_AXIS)
+    cfg = GemmRsConfig(bm=8, bn=64, bk=32)
+    c1 = gemm_rs(a_s, b_s, mesh8, TP_AXIS, config=cfg)
+    c2 = gemm_rs(a_s, b_s, mesh8, TP_AXIS, config=cfg)
+    assert_allclose(c1, c2, atol=0, rtol=0, name="gemm_rs-repeat")
+
+
+@pytest.mark.parametrize("nranks", [2, 3])
+def test_gemm_rs_small_rings(nranks):
+    mesh = make_mesh({TP_AXIS: nranks}, devices=jax.devices()[:nranks])
+    m, k, n = 12 * nranks, 16 * nranks, 128
+    a = rand_tensor((m, k), jnp.float32, scale=0.1)
+    b = rand_tensor((k, n), jnp.float32, scale=0.1)
+    a_s = jax.device_put(a, NamedSharding(mesh, P(None, TP_AXIS)))
+    b_s = jax.device_put(b, NamedSharding(mesh, P(TP_AXIS)))
+    c = gemm_rs(a_s, b_s, mesh, TP_AXIS)
+    assert_allclose(c, _golden(a, b).astype(c.dtype), atol=1e-3, rtol=1e-3,
+                    name=f"gemm_rs-{nranks}")
+
+
+def test_gemm_rs_single_device():
+    mesh1 = make_mesh({TP_AXIS: 1}, devices=jax.devices()[:1])
+    a = rand_tensor((16, 128), jnp.float32)
+    b = rand_tensor((128, 128), jnp.float32)
+    c = gemm_rs(a, b, mesh1, TP_AXIS)
+    assert_allclose(c, _golden(a, b).astype(c.dtype), atol=1e-4, rtol=1e-4)

@@ -3,3 +3,4 @@ communication half — allgather/reduce_scatter/allreduce/all-to-all files in
 ``python/triton_dist/kernels/nvidia/``)."""
 
 from .allgather import AllGatherMethod, all_gather, choose_method
+from .reduce_scatter import ReduceScatterConfig, reduce_scatter

@@ -128,5 +128,24 @@ def cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
 
+def clip_block(block: int, dim: int) -> int:
+    """Largest divisor of ``dim`` that is <= ``block`` — used to normalize
+    tile-size configs to a problem.  Warns when the result degenerates below
+    the TPU sublane granule (8): a 1-element block inflates the pipeline
+    grid and violates Mosaic's lane tiling on real hardware."""
+    import warnings
+
+    b = min(block, dim)
+    while dim % b:
+        b -= 1
+    if b < min(dim, 8):
+        warnings.warn(
+            f"tile size {block} clipped to degenerate {b} for dim {dim}; "
+            "pick a block sharing a large divisor with the problem dim",
+            stacklevel=3,
+        )
+    return b
+
+
 def round_up(a: int, b: int) -> int:
     return cdiv(a, b) * b

@@ -3,3 +3,4 @@
 distributed flash-decode, SP attention)."""
 
 from .ag_gemm import AgGemmConfig, ag_gemm
+from .gemm_rs import GemmRsConfig, gemm_rs

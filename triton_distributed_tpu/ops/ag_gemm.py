@@ -39,8 +39,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core import compilation
 from ..core.mesh import TP_AXIS
+from ..core.utils import clip_block
 from ..lang import primitives as dl
 from ..lang.primitives import Team
+from . import blocks
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,37 +55,10 @@ class AgGemmConfig:
     bk: int = 512
 
     def clip(self, m_loc: int, k: int, n_loc: int) -> "AgGemmConfig":
-        def pick(b, dim):
-            b = min(b, dim)
-            while dim % b:
-                b //= 2
-            return max(b, 1)
-
         return AgGemmConfig(
-            bm=pick(self.bm, m_loc), bn=pick(self.bn, n_loc),
-            bk=pick(self.bk, k),
+            bm=clip_block(self.bm, m_loc), bn=clip_block(self.bn, n_loc),
+            bk=clip_block(self.bk, k),
         )
-
-
-def _matmul_body(nk: int, out_dtype, a_ref, b_ref, c_ref, acc_ref):
-    """Inner pipeline body: blocked matmul with f32 accumulation.
-
-    Grid is (m, n, k) with k innermost so the accumulator stays resident per
-    (m, n) tile — the MXU hot loop, reference ``allgather_gemm.py:216-260``.
-    """
-    k = pl.program_id(2)
-
-    @pl.when(k == 0)
-    def _():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    acc_ref[...] += jnp.dot(
-        a_ref[...], b_ref[...], preferred_element_type=jnp.float32
-    )
-
-    @pl.when(k == nk - 1)
-    def _():
-        c_ref[...] = acc_ref[...].astype(out_dtype)
 
 
 def _ag_gemm_kernel(
@@ -106,16 +81,8 @@ def _ag_gemm_kernel(
     _, right = team.neighbor_ranks()
     right_id = team.device_id(right)
 
-    grid = (m_loc // cfg.bm, n_loc // cfg.bn, k_dim // cfg.bk)
-    nk = grid[2]
-    pipeline = pltpu.emit_pipeline(
-        functools.partial(_matmul_body, nk, out_dtype),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((cfg.bm, cfg.bk), lambda i, j, k: (i, k)),
-            pl.BlockSpec((cfg.bk, cfg.bn), lambda i, j, k: (k, j)),
-        ],
-        out_specs=[pl.BlockSpec((cfg.bm, cfg.bn), lambda i, j, k: (i, j))],
+    pipeline = blocks.make_matmul_pipeline(
+        m_loc, n_loc, k_dim, cfg.bm, cfg.bn, cfg.bk, out_dtype
     )
 
     def chunk_rows(ref, r):
@@ -229,7 +196,9 @@ def ag_gemm(
     if k2 != k_dim:
         raise ValueError(f"inner dims mismatch: {a.shape} @ {b.shape}")
     if m_tot % n or n_tot % n:
-        raise ValueError(f"M={m_tot}, N={n_tot} must divide {axis}={n}")
+        raise ValueError(
+            f"M={m_tot} and N={n_tot} must be divisible by {axis}={n}"
+        )
 
     if n == 1:
         c = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)

@@ -1,0 +1,73 @@
+"""Shared inner-pipeline building blocks for overlapped ops.
+
+One home for the MXU accumulate/flush matmul body and elementwise bodies
+used by ``ag_gemm``, ``gemm_rs``, ``reduce_scatter`` and the MoE ops — the
+TPU analogue of the reference's shared tile loops (the `tl.dot` hot loop in
+``allgather_gemm.py:216-260`` replicated per op there; we keep one copy).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def matmul_body(nk: int, out_dtype, a_ref, b_ref, c_ref, acc_ref):
+    """Blocked matmul step with f32 accumulation.
+
+    Grid must be (m, n, k) with k innermost so the accumulator block stays
+    resident per (m, n) tile; ``acc_ref`` is a (bm, bn) f32 VMEM scratch.
+    """
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jnp.dot(
+        a_ref[...], b_ref[...], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(k == nk - 1)
+    def _():
+        c_ref[...] = acc_ref[...].astype(out_dtype)
+
+
+def add_body(a_ref, b_ref, o_ref):
+    o_ref[...] = a_ref[...] + b_ref[...]
+
+
+def make_matmul_pipeline(m: int, n: int, k: int, bm: int, bn: int, bk: int,
+                         out_dtype):
+    """An ``emit_pipeline`` computing C[m,n] = A[m,k] @ B[k,n] blockwise.
+
+    Call as ``pipe(a_ref, b_ref, c_ref, scratches=[acc_ref])`` with an
+    (bm, bn) f32 VMEM accumulator.
+    """
+    grid = (m // bm, n // bn, k // bk)
+    return pltpu.emit_pipeline(
+        functools.partial(matmul_body, grid[2], out_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))],
+    )
+
+
+def make_add_pipeline(m: int, n: int, bm: int, bn: int):
+    """An ``emit_pipeline`` computing O[m,n] = A + B blockwise."""
+    return pltpu.emit_pipeline(
+        add_body,
+        grid=(m // bm, n // bn),
+        in_specs=[
+            pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+            pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        ],
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j: (i, j))],
+    )

@@ -1,0 +1,227 @@
+"""Fused GEMM-ReduceScatter: the mirror image of AG-GEMM.
+
+Reference: ``python/triton_dist/kernels/nvidia/gemm_reduce_scatter.py``
+(producer persistent GEMM writes tiles and ``notify``s per-tile barriers
+``kernel_gemm_rs_producer_persistent:130``; consumer RS; host entry
+``gemm_rs:576``) + the paired ring reduce in ``reduce_scatter.py:688-882``.
+
+TPU design — one kernel per device interleaving three activities:
+
+1. blocked matmul (inner ``emit_pipeline``) of the output chunk that must
+   leave next, in ring order starting with the chunk that travels farthest
+   (rank me-1), so compute runs ahead of the wire;
+2. ring forwarding: received partial + freshly computed local contribution
+   are combined by a tiled add pipeline and pushed right — each chunk visits
+   every rank once (bandwidth-optimal, like the reference ring);
+3. the matmul of step s overlaps the in-flight transfer of step s-1 —
+   compute-communication overlap without a producer stream.
+
+Computes ``ReduceScatter_M(A[M, K_loc] @ B_loc[K_loc, N])`` — the
+row-parallel half of a TP layer: A is K-sharded, B row-sharded, the M-sharded
+sum comes out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core import compilation
+from ..core.mesh import TP_AXIS
+from ..core.utils import clip_block
+from ..lang import primitives as dl
+from ..lang.primitives import Team
+from . import blocks
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmRsConfig:
+    bm: int = 256
+    bn: int = 512
+    bk: int = 512
+
+    def clip(self, m_loc: int, k_loc: int, n_dim: int) -> "GemmRsConfig":
+        return GemmRsConfig(
+            bm=clip_block(self.bm, m_loc), bn=clip_block(self.bn, n_dim),
+            bk=clip_block(self.bk, k_loc),
+        )
+
+
+def _gemm_rs_kernel(
+    team: Team,
+    m_loc: int,
+    k_loc: int,
+    n_dim: int,
+    cfg: GemmRsConfig,
+    out_dtype,
+    a_ref,       # (n*m_loc, k_loc) local A (K-shard)          [ANY]
+    b_ref,       # (k_loc, n) local B (row shard)              [ANY]
+    out_ref,     # (m_loc, n) reduced output chunk             [ANY]
+    mm_buf,      # (2, m_loc, n) fresh local contributions     [HBM scratch]
+    recv_buf,    # (2, m_loc, n) incoming partials             [HBM scratch]
+    send_buf,    # (2, m_loc, n) outgoing accumulated          [HBM scratch]
+    send_sems,   # (2,) per-parity send completion (see reduce_scatter.py)
+    recv_sems,   # (2,)
+    ack_sems,    # (2,) consumption credits (REGULAR)
+    acc_ref,     # (bm, bn) f32                                 [VMEM scratch]
+):
+    me, n = team.rank(), team.size
+    left, right = team.neighbor_ranks()
+    left_id, right_id = team.device_id(left), team.device_id(right)
+
+    mm = blocks.make_matmul_pipeline(
+        m_loc, n_dim, k_loc, cfg.bm, cfg.bn, cfg.bk, out_dtype
+    )
+    add = blocks.make_add_pipeline(m_loc, n_dim, cfg.bm, cfg.bn)
+
+    def a_chunk(c):
+        return a_ref.at[pl.ds(c * m_loc, m_loc)]
+
+    dl.collective_prologue(team, neighbors_only=True)
+
+    # step 0: matmul the chunk that travels farthest; its raw value IS the
+    # step-0 payload (no partial to add yet)
+    j0 = jax.lax.rem(me + n - 1, n)
+    mm(a_chunk(j0), b_ref, mm_buf.at[0], scratches=[acc_ref])
+    dl.remote_copy(mm_buf.at[0], recv_buf.at[0], send_sems.at[0],
+                   recv_sems.at[0], right_id)
+
+    for s in range(1, n):
+        j = jax.lax.rem(me + n - s - 1, n)
+        slot_in = (s - 1) % 2
+        slot_out = s % 2
+        if s == 2:
+            # mm is about to rewrite mm_buf[0], whose step-0 payload may
+            # still be on the wire (the only send ever issued from mm_buf)
+            dl.wait_send(mm_buf.at[0], send_sems.at[0])
+        # local contribution for chunk j — INDEPENDENT of the in-flight
+        # transfer s-1, so the MXU hides the wire time (the whole point)
+        mm(a_chunk(j), b_ref, mm_buf.at[slot_out], scratches=[acc_ref])
+        dl.wait_recv(recv_buf.at[slot_in], recv_sems.at[slot_in])
+        last = s == n - 1
+        if last:
+            add(recv_buf.at[slot_in], mm_buf.at[slot_out], out_ref)
+        else:
+            if s >= 3:
+                # send_buf[slot_out]'s step s-2 send must be off the wire
+                dl.wait_send(send_buf.at[slot_out], send_sems.at[slot_out])
+            if s >= 2:
+                # right must have consumed what we sent into its recv
+                # slot_out two steps ago
+                dl.wait(ack_sems.at[slot_out], 1)
+            add(recv_buf.at[slot_in], mm_buf.at[slot_out],
+                send_buf.at[slot_out])
+            dl.remote_copy(send_buf.at[slot_out], recv_buf.at[slot_out],
+                           send_sems.at[slot_out], recv_sems.at[slot_out],
+                           right_id)
+        dl.notify(ack_sems.at[slot_in], left_id)
+
+    # Drain (counting per parity: issued minus in-loop waits).
+    # n==2: only the parity-0 step-0 send is outstanding.
+    # n==3: step-0's wait happened at s==2; parity-1 (step 1) outstanding.
+    # n>=4: one send outstanding on each parity.
+    if n == 2:
+        dl.wait_send(send_buf.at[0], send_sems.at[0])
+    elif n == 3:
+        dl.wait_send(send_buf.at[1], send_sems.at[1])
+    else:
+        dl.wait_send(send_buf.at[0], send_sems.at[0])
+        dl.wait_send(send_buf.at[1], send_sems.at[1])
+    # Ack credits: one per send received; in-loop waits covered sends
+    # 0..n-4, so the last two sends' credits are outstanding (one for n==2).
+    if n == 2:
+        dl.wait(ack_sems.at[0], 1)
+    else:
+        dl.wait(ack_sems.at[(n - 3) % 2], 1)
+        dl.wait(ack_sems.at[(n - 2) % 2], 1)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_gemm_rs(
+    mesh: Mesh,
+    axis: str,
+    m_loc: int,
+    k_loc: int,
+    n_dim: int,
+    dtype: jnp.dtype,
+    out_dtype: jnp.dtype,
+    cfg: GemmRsConfig,
+):
+    team = Team.of(mesh, axis)
+    n = team.size
+    kernel = functools.partial(
+        _gemm_rs_kernel, team, m_loc, k_loc, n_dim, cfg, out_dtype
+    )
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m_loc, n_dim), out_dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.HBM((2, m_loc, n_dim), out_dtype),
+            pltpu.HBM((2, m_loc, n_dim), out_dtype),
+            pltpu.HBM((2, m_loc, n_dim), out_dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+            pltpu.VMEM((cfg.bm, cfg.bn), jnp.float32),
+        ],
+        compiler_params=compilation.compiler_params(
+            collective=True,
+            collective_id=compilation.collective_id("gemm_rs"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+    return compilation.jit_shard_map(
+        call, mesh,
+        in_specs=(P(None, axis), P(axis, None)),
+        out_specs=P(axis, None),
+    )
+
+
+def gemm_rs(
+    a: jax.Array,
+    b: jax.Array,
+    mesh: Mesh,
+    axis: str = TP_AXIS,
+    *,
+    config: GemmRsConfig | None = None,
+    out_dtype=None,
+) -> jax.Array:
+    """Overlapped ``ReduceScatter(a @ b)`` (reference host entry
+    ``gemm_rs:576``).
+
+    ``a``: (M, K) sharded on dim 1 over ``axis`` (activations, K-parallel).
+    ``b``: (K, N) sharded on dim 0 over ``axis`` (row-parallel weight).
+    Returns (M, N) sharded on dim 0: the reduced sum, row-chunk r on rank r.
+    """
+    cfg = config or GemmRsConfig()
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
+    n = mesh.shape[axis]
+
+    m_tot, k_dim = a.shape
+    k2, n_dim = b.shape
+    if k2 != k_dim:
+        raise ValueError(f"inner dims mismatch: {a.shape} @ {b.shape}")
+    if n == 1:
+        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
+    if m_tot % n or k_dim % n:
+        raise ValueError(
+            f"M={m_tot} and K={k_dim} must be divisible by {axis}={n}"
+        )
+
+    m_loc, k_loc = m_tot // n, k_dim // n
+    cfg = cfg.clip(m_loc, k_loc, n_dim)
+    fn = _build_gemm_rs(
+        mesh, axis, m_loc, k_loc, n_dim, jnp.dtype(a.dtype), out_dtype, cfg
+    )
+    return fn(a, b)

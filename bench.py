@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Driver benchmark: one JSON line on stdout.
+
+On a single real TPU chip the distributed overlap cannot be exercised, so the
+headline single-chip metric is the framework's MXU matmul pipeline (the inner
+loop of AG-GEMM / GEMM-RS, tutorial-07 shapes: hidden=7168 bf16) measured as
+TFLOP/s against the XLA ``jnp.matmul`` baseline.  ``vs_baseline`` is the
+throughput ratio (>= 1.0 means our Pallas pipeline matches XLA's own GEMM).
+
+With more than one device available, the fused AG-GEMM benchmark runs
+instead: overlapped AllGather+GEMM wall-time vs the non-overlapped
+``jax.lax.all_gather`` + ``jnp.matmul`` baseline (BASELINE.json target:
+>= 90% of compute throughput with the collective fully hidden).
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+
+
+def _bench(fn, iters=16, warmup=3):
+    """Per-iteration seconds (slope timing — see core.utils.perf_func)."""
+    from triton_distributed_tpu.core.utils import perf_func
+
+    _, ms = perf_func(fn, iters=iters, warmup_iters=warmup)
+    return ms / 1e3
+
+
+def bench_single_chip():
+    from triton_distributed_tpu.ops.matmul import matmul
+
+    m = n = k = 7168  # tutorial-07 hidden size, square problem
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (m, k), dtype=jnp.bfloat16)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (k, n), dtype=jnp.bfloat16)
+
+    flops = 2.0 * m * n * k
+    t_ours = _bench(lambda: matmul(a, b))
+    t_xla = _bench(lambda: jnp.matmul(a, b))
+    tflops = flops / t_ours / 1e12
+    return {
+        "metric": "single_chip_gemm_7168_bf16",
+        "value": round(tflops, 2),
+        "unit": "TFLOP/s",
+        "vs_baseline": round(t_xla / t_ours, 4),
+    }
+
+
+def bench_multi_chip():
+    from triton_distributed_tpu.core import mesh as mesh_lib
+    from triton_distributed_tpu.ops.ag_gemm import ag_gemm
+
+    mesh = mesh_lib.tp_mesh()
+    ntp = mesh.shape["tp"]
+    m, k, n = 4096, 7168, 7168  # e2e_dense.md MLP M=4096 shape
+    key = jax.random.PRNGKey(0)
+    a = mesh_lib.shard(
+        mesh, jax.random.normal(key, (m, k), dtype=jnp.bfloat16), "tp", None
+    )
+    b = mesh_lib.shard(
+        mesh,
+        jax.random.normal(jax.random.fold_in(key, 1), (k, n), dtype=jnp.bfloat16),
+        None,
+        "tp",
+    )
+
+    t_fused = _bench(lambda: ag_gemm(a, b, mesh))
+
+    @jax.jit
+    def baseline(a, b):
+        ag = jax.lax.with_sharding_constraint(
+            a, mesh_lib.replicated(mesh)
+        )
+        return jnp.matmul(ag, b, preferred_element_type=jnp.float32).astype(a.dtype)
+
+    t_base = _bench(lambda: baseline(a, b))
+    tflops = 2.0 * m * n * k / ntp / t_fused / 1e12
+    return {
+        "metric": f"ag_gemm_m{m}_k{k}_n{n}_tp{ntp}",
+        "value": round(tflops, 2),
+        "unit": "TFLOP/s/chip",
+        "vs_baseline": round(t_base / t_fused, 4),
+    }
+
+
+def main():
+    if jax.device_count() > 1:
+        result = bench_multi_chip()
+    else:
+        result = bench_single_chip()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

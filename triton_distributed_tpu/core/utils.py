@@ -87,26 +87,56 @@ def dist_print(*args, rank: int | None = None, allowed_ranks: Iterable[int] | No
     sys.stdout.flush()
 
 
+def sync(x) -> None:
+    """Force device completion of ``x``.
+
+    ``jax.block_until_ready`` alone is not trustworthy on tunneled device
+    backends (observed on the axon TPU tunnel: it returns immediately); a
+    one-element ``device_get`` genuinely round-trips.  One tiny fetch is done
+    per addressable shard of every leaf so every participating device's queue
+    is drained, not just device 0's.  Costs fixed host<->device latency —
+    cancel it with slope timing (``perf_func``).
+    """
+    jax.block_until_ready(x)
+    for leaf in jax.tree_util.tree_leaves(x):
+        if hasattr(leaf, "addressable_shards"):
+            for s in leaf.addressable_shards:
+                jax.device_get(s.data.reshape(-1)[:1])
+        elif hasattr(leaf, "reshape"):
+            jax.device_get(leaf.reshape(-1)[:1])
+        else:
+            jax.device_get(leaf)
+
+
 def perf_func(
     func: Callable[[], object],
-    iters: int = 50,
-    warmup_iters: int = 10,
+    iters: int = 16,
+    warmup_iters: int = 3,
 ) -> tuple[object, float]:
     """Wall-clock timing of a device thunk, returning (last_output, ms/iter).
 
-    Reference ``perf_func`` uses CUDA events; on TPU the dispatch is async so
-    we block on the final output. Per-kernel timing belongs to the profiler
-    (``tools/profile.py``).
+    Reference ``perf_func`` (``utils.py:269-281``) uses CUDA events; here the
+    per-iteration time is the two-point slope between a 1-iteration and a
+    (1+iters)-iteration run, each ended by one :func:`sync` — the fixed
+    sync/tunnel overhead cancels, surviving backends where async dispatch
+    can't be flushed precisely.
     """
-    out = None
-    for _ in range(warmup_iters):
+    out = func()
+    for _ in range(warmup_iters - 1):
         out = func()
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = func()
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / max(iters, 1)
+    sync(out)
+
+    def run(k: int) -> float:
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(k):
+            o = func()
+        sync(o)
+        return time.perf_counter() - t0
+
+    t1 = min(run(1), run(1))
+    t2 = min(run(1 + iters), run(1 + iters))
+    dt = max(t2 - t1, 1e-9) / max(iters, 1)
     return out, dt * 1e3
 
 
@@ -129,19 +159,30 @@ def cdiv(a: int, b: int) -> int:
 
 
 def clip_block(block: int, dim: int) -> int:
-    """Largest divisor of ``dim`` that is <= ``block`` — used to normalize
-    tile-size configs to a problem.  Warns when the result degenerates below
-    the TPU sublane granule (8): a 1-element block inflates the pipeline
-    grid and violates Mosaic's lane tiling on real hardware."""
+    """Largest sublane-aligned divisor of ``dim`` that is <= ``block`` — used
+    to normalize tile-size configs to a problem.
+
+    Prefers divisors that are multiples of the TPU sublane granule (8) so the
+    tile stays legal for Mosaic's lane tiling on real hardware; only when
+    ``dim`` admits no aligned divisor does it fall back to the plain largest
+    divisor, with a warning (CPU interpret mode accepts any size, so silent
+    misalignment here would surface only on real TPU)."""
     import warnings
 
     b = min(block, dim)
+    if dim >= 8:
+        for cand in range(b, 7, -1):
+            if dim % cand == 0 and cand % 8 == 0:
+                return cand
     while dim % b:
         b -= 1
-    if b < min(dim, 8):
+    # b == dim (a single whole-dim tile) is safe: Mosaic pads a full dim to
+    # the granule; only a *partial* unaligned tile mis-strides.
+    if dim >= 8 and b < dim:
         warnings.warn(
-            f"tile size {block} clipped to degenerate {b} for dim {dim}; "
-            "pick a block sharing a large divisor with the problem dim",
+            f"tile size {block} clipped to non-sublane-aligned {b} for dim "
+            f"{dim} (no divisor that is a multiple of 8 and <= {block}); "
+            "this may mis-tile under Mosaic on real TPU",
             stacklevel=3,
         )
     return b

@@ -1,0 +1,65 @@
+"""Local blocked matmul as a standalone Pallas kernel.
+
+The single-chip building block under every fused op: the same
+``blocks.make_matmul_pipeline`` MXU loop that ``ag_gemm``/``gemm_rs`` run
+per chunk, exposed as a plain op.  Reference analogue: the non-distributed
+persistent GEMM the consumer kernels are built around
+(``python/triton_dist/kernels/nvidia/allgather_gemm.py:216-260``); on TPU it
+doubles as the single-chip benchmark kernel (``bench.py``) and the n=1
+fallback of the distributed ops.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..core import compilation
+from ..core.utils import clip_block
+from . import blocks
+
+
+def _matmul_kernel(m, n, k, bm, bn, bk, out_dtype, a_ref, b_ref, c_ref, acc_ref):
+    pipe = blocks.make_matmul_pipeline(m, n, k, bm, bn, bk, out_dtype)
+    pipe(a_ref, b_ref, c_ref, scratches=[acc_ref])
+
+
+@functools.lru_cache(maxsize=None)
+def _build_matmul(m, n, k, bm, bn, bk, dtype, out_dtype):
+    kernel = functools.partial(_matmul_kernel, m, n, k, bm, bn, bk, out_dtype)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=compilation.compiler_params(collective=False),
+        interpret=compilation.interpret_mode(),
+    )
+    return jax.jit(call)
+
+
+def matmul(
+    a: jax.Array,
+    b: jax.Array,
+    *,
+    bm: int = 512,
+    bn: int = 512,
+    bk: int = 512,
+    out_dtype=None,
+) -> jax.Array:
+    """C = A @ B with f32 accumulation, blocked for the MXU."""
+    (m, k), (k2, n) = a.shape, b.shape
+    if k2 != k:
+        raise ValueError(f"inner dims mismatch: {a.shape} @ {b.shape}")
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
+    bm, bn, bk = clip_block(bm, m), clip_block(bn, n), clip_block(bk, k)
+    fn = _build_matmul(m, n, k, bm, bn, bk, jnp.dtype(a.dtype), out_dtype)
+    return fn(a, b)

@@ -1,16 +1,20 @@
-"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+"""Test harness: force a virtual CPU mesh before JAX initializes.
 
 Reference tests require N physical GPUs under torchrun (SURVEY.md section 4);
 here every distributed test runs on one host, with Pallas kernels executing
 under TPU interpret mode (simulated DMA/semaphores).
+
+10 devices = the widest test mesh (8) + 2 spares; spare devices keep spare
+XLA client threads so interpret-mode collective kernels can't starve at full
+mesh occupancy (see ``core.platform.force_cpu``).
 """
 
-from triton_distributed_tpu.core.platform import force_cpu
+from triton_distributed_tpu.core.platform import force_cpu, SPARE_VIRTUAL_DEVICES
 
 # Must run before any JAX backend is created (safe here: conftest is imported
 # before test modules). Overrides the container sitecustomize's force-selected
 # TPU platform as well.
-force_cpu(8)
+force_cpu(8 + SPARE_VIRTUAL_DEVICES)
 
 import pytest  # noqa: E402
 

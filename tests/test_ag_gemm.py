@@ -45,6 +45,21 @@ def test_ag_gemm_return_gathered(mesh8):
                     name="c")
 
 
+@pytest.mark.parametrize("nranks", [2, 3])
+def test_ag_gemm_small_rings(nranks):
+    # 2 ranks is the exact shape of the interpret-mode occupancy deadlock
+    # found in round 1 (VERDICT.md weak #2): keep it covered.
+    mesh = make_mesh({TP_AXIS: nranks}, devices=jax.devices()[:nranks])
+    m, k, n = 16 * nranks, 128, 128 * nranks
+    a = rand_tensor((m, k), jnp.float32, scale=0.1)
+    b = rand_tensor((k, n), jnp.float32, scale=0.1)
+    a_s = jax.device_put(a, NamedSharding(mesh, P(TP_AXIS)))
+    b_s = jax.device_put(b, NamedSharding(mesh, P(None, TP_AXIS)))
+    c = ag_gemm(a_s, b_s, mesh, TP_AXIS)
+    assert_allclose(c, _golden(a, b).astype(c.dtype), atol=1e-3, rtol=1e-3,
+                    name=f"ag_gemm-{nranks}")
+
+
 def test_ag_gemm_single_device():
     mesh1 = make_mesh({TP_AXIS: 1}, devices=jax.devices()[:1])
     a = rand_tensor((16, 128), jnp.float32)

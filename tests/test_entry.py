@@ -1,0 +1,35 @@
+"""Driver entry points stay green: ``entry()`` compiles and runs, and the
+multichip dryrun completes quickly on the virtual mesh (VERDICT.md round-1
+gate: MULTICHIP must be self-bootstrapping and finish in well under 60 s)."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root for __graft_entry__
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_entry_jits_and_runs():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (256, 512)
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+
+def test_dryrun_body_8_devices():
+    t0 = time.time()
+    graft._dryrun_body(8)
+    assert time.time() - t0 < 60, "dryrun(8) must finish well under a minute"
+
+
+def test_dryrun_body_2_devices():
+    graft._dryrun_body(2)
+
+
+def test_dryrun_multichip_inline_path():
+    # with a 10-device platform, dryrun_multichip(4) takes the inline path
+    graft.dryrun_multichip(4)

@@ -47,18 +47,41 @@ def make_mesh(
     Defaults to a 1-D ``tp`` mesh over all devices — the reference's default
     "one TP group over WORLD_SIZE" shape.
     """
+    from . import platform
+
     devs = np.array(devices if devices is not None else jax.devices())
     if axis_sizes is None:
-        axis_sizes = {TP_AXIS: devs.size}
+        n = devs.size
+        if devices is None and platform.on_cpu():
+            # On the virtual CPU platform, a default-sized mesh leaves the
+            # spare devices idle (see below); callers wanting all devices
+            # pass explicit sizes.
+            n = max(1, n - platform.SPARE_VIRTUAL_DEVICES)
+        axis_sizes = {TP_AXIS: n}
     names = tuple(axis_sizes.keys())
     sizes = tuple(int(s) for s in axis_sizes.values())
     total = int(np.prod(sizes))
-    if total != devs.size:
+    if total > devs.size:
         raise ValueError(
             f"mesh axes {dict(axis_sizes)} require {total} devices, "
             f"have {devs.size}"
         )
-    return Mesh(devs.reshape(sizes), names)
+    if total < devs.size and not platform.on_cpu():
+        # On real hardware a smaller-than-world mesh is almost always a
+        # mis-sized axis map — and on multi-host it would silently exclude
+        # some processes' devices (every process must use all-global-device
+        # meshes). Keep the loud error there.
+        raise ValueError(
+            f"mesh axes {dict(axis_sizes)} cover {total} of {devs.size} "
+            f"devices; pass an explicit `devices=` slice to build a "
+            f"sub-mesh deliberately"
+        )
+    # CPU backend: extra devices beyond the mesh are deliberately allowed
+    # and left idle — spare devices keep spare XLA client threads, which
+    # interpret-mode collective kernels need to make progress when every
+    # mesh device's execution thread blocks in a semaphore wait
+    # (exact-occupancy starvation; see platform.force_cpu).
+    return Mesh(devs[:total].reshape(sizes), names)
 
 
 def tp_mesh(tp: int | None = None) -> Mesh:

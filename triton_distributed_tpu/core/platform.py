@@ -24,6 +24,11 @@ import numpy as np
 
 _DEFAULT_VIRTUAL_DEVICES = 8
 
+# Spare virtual devices to request beyond the widest mesh (see force_cpu):
+# spare devices = spare XLA client threads = interpret-mode kernels can make
+# progress even when every mesh device's thread is blocked in a wait.
+SPARE_VIRTUAL_DEVICES = 2
+
 _initialized = False
 
 
@@ -34,6 +39,17 @@ def force_cpu(num_devices: int = _DEFAULT_VIRTUAL_DEVICES) -> None:
     ``JAX_PLATFORMS=cpu`` env var is not sufficient in environments whose
     sitecustomize force-selects a platform via ``jax.config``; we therefore
     set the config explicitly as well.
+
+    IMPORTANT — request MORE devices than the widest mesh you will build
+    (2 spares is enough; see ``SPARE_VIRTUAL_DEVICES``).  The XLA CPU
+    client's execution thread pool is sized by the device count; an
+    interpret-mode collective kernel occupies one pool thread per mesh
+    device while blocked in a semaphore wait, and kernel progress (buffer
+    allocation's device-to-host copies, async dispatch of producer
+    computations) needs at least one FREE pool thread.  A mesh at exact
+    platform occupancy can therefore deadlock — observed as threads parked
+    in ``semaphore_wait`` and ``_allocate_buffer``.  ``make_mesh`` leaves
+    extra devices idle, so over-provisioning is always safe.
     """
     import re
 

@@ -1,0 +1,57 @@
+"""Golden tests for the local blocked Pallas matmul (`ops/matmul.py`).
+
+Mirrors the reference's per-kernel golden strategy (SURVEY.md section 4):
+compare against XLA's own jnp.matmul with f32 accumulation across shapes
+that exercise block clipping (non-multiples of the default tiles) and both
+dtypes the framework cares about.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from triton_distributed_tpu.ops.matmul import matmul
+
+
+def _golden(a, b, out_dtype):
+    return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
+
+
+@pytest.mark.parametrize(
+    "m,k,n",
+    [
+        (256, 256, 256),     # single tile after clipping
+        (1024, 512, 1024),   # multi-tile, exact multiples
+        (384, 640, 896),     # forces clip_block on every dim
+    ],
+)
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_matmul_golden(m, k, n, dtype):
+    ka, kb = jax.random.split(jax.random.key(0))
+    a = jax.random.normal(ka, (m, k), dtype=dtype)
+    b = jax.random.normal(kb, (k, n), dtype=dtype)
+    got = matmul(a, b)
+    want = _golden(a, b, dtype)
+    assert got.dtype == want.dtype and got.shape == want.shape
+    # identical f32 accumulation order is not guaranteed; tolerances scaled
+    # for bf16 inputs at k<=640
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+    assert jnp.allclose(got.astype(jnp.float32), want.astype(jnp.float32),
+                        rtol=tol, atol=tol * 8)
+
+
+def test_matmul_out_dtype():
+    ka, kb = jax.random.split(jax.random.key(1))
+    a = jax.random.normal(ka, (256, 256), dtype=jnp.bfloat16)
+    b = jax.random.normal(kb, (256, 256), dtype=jnp.bfloat16)
+    got = matmul(a, b, out_dtype=jnp.float32)
+    assert got.dtype == jnp.float32
+    want = _golden(a, b, jnp.float32)
+    assert jnp.allclose(got, want, rtol=2e-2, atol=1e-1)
+
+
+def test_matmul_shape_mismatch():
+    a = jnp.zeros((128, 64), jnp.float32)
+    b = jnp.zeros((128, 64), jnp.float32)
+    with pytest.raises(ValueError, match="inner dims mismatch"):
+        matmul(a, b)

@@ -49,10 +49,11 @@ def make_mesh(
     """
     from . import platform
 
+    explicit_devices = devices is not None
     devs = np.array(devices if devices is not None else jax.devices())
     if axis_sizes is None:
         n = devs.size
-        if devices is None and platform.on_cpu():
+        if not explicit_devices and platform.on_cpu():
             # On the virtual CPU platform, a default-sized mesh leaves the
             # spare devices idle (see below); callers wanting all devices
             # pass explicit sizes.
@@ -66,27 +67,30 @@ def make_mesh(
             f"mesh axes {dict(axis_sizes)} require {total} devices, "
             f"have {devs.size}"
         )
-    if total < devs.size and not platform.on_cpu():
-        # On real hardware a smaller-than-world mesh is almost always a
-        # mis-sized axis map — and on multi-host it would silently exclude
-        # some processes' devices (every process must use all-global-device
-        # meshes). Keep the loud error there.
+    if total < devs.size and (explicit_devices or not platform.on_cpu()):
+        # An explicitly passed device list must be covered exactly (a
+        # mismatch means a typo'd axis map, and silently shrinking a test's
+        # ring would mask the bugs it exists to catch).  On real hardware
+        # the same applies to the default list: a smaller-than-world mesh
+        # on multi-host would silently exclude some processes' devices.
         raise ValueError(
             f"mesh axes {dict(axis_sizes)} cover {total} of {devs.size} "
-            f"devices; pass an explicit `devices=` slice to build a "
-            f"sub-mesh deliberately"
+            f"devices; pass an explicit `devices=` slice of exactly "
+            f"{total} to build a sub-mesh deliberately"
         )
-    # CPU backend: extra devices beyond the mesh are deliberately allowed
-    # and left idle — spare devices keep spare XLA client threads, which
-    # interpret-mode collective kernels need to make progress when every
-    # mesh device's execution thread blocks in a semaphore wait
-    # (exact-occupancy starvation; see platform.force_cpu).
+    # CPU backend with the default device list: extra devices beyond the
+    # mesh are deliberately allowed and left idle — spare devices keep
+    # spare XLA client threads, which interpret-mode collective kernels
+    # need to make progress when every mesh device's execution thread
+    # blocks in a semaphore wait (exact-occupancy starvation; see
+    # platform.force_cpu).
     return Mesh(devs[:total].reshape(sizes), names)
 
 
 def tp_mesh(tp: int | None = None) -> Mesh:
-    n = tp or jax.device_count()
-    return make_mesh({TP_AXIS: n})
+    # tp=None routes through make_mesh's default sizing so the CPU
+    # platform's spare-device subtraction applies (deadlock avoidance).
+    return make_mesh({TP_AXIS: tp} if tp is not None else None)
 
 
 def axis_size(mesh: Mesh, axis: str) -> int:

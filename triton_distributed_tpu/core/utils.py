@@ -8,6 +8,7 @@ grab-bag: ``_make_tensor`` (:217), ``assert_allclose`` (:865-894),
 from __future__ import annotations
 
 import contextlib
+import functools
 import sys
 import time
 from typing import Callable, Iterable
@@ -108,6 +109,18 @@ def sync(x) -> None:
             jax.device_get(leaf)
 
 
+def timed_run(func: Callable[[], object], k: int) -> float:
+    """Wall seconds for k back-to-back calls of ``func`` ended by one
+    :func:`sync` — the building block of slope timing (``perf_func`` and
+    ``bench.py`` both difference two of these to cancel the sync cost)."""
+    t0 = time.perf_counter()
+    o = None
+    for _ in range(k):
+        o = func()
+    sync(o)
+    return time.perf_counter() - t0
+
+
 def perf_func(
     func: Callable[[], object],
     iters: int = 16,
@@ -126,14 +139,7 @@ def perf_func(
         out = func()
     sync(out)
 
-    def run(k: int) -> float:
-        t0 = time.perf_counter()
-        o = None
-        for _ in range(k):
-            o = func()
-        sync(o)
-        return time.perf_counter() - t0
-
+    run = functools.partial(timed_run, func)
     t1 = min(run(1), run(1))
     t2 = min(run(1 + iters), run(1 + iters))
     dt = max(t2 - t1, 1e-9) / max(iters, 1)

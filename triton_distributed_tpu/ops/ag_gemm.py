@@ -50,8 +50,8 @@ class AgGemmConfig:
     """Tile sizes for the consumer matmul (the autotuner's knobs — reference
     tunes BLOCK_SIZE_M/N/K + num_stages via ``@triton.autotune``)."""
 
-    bm: int = 256
-    bn: int = 512
+    bm: int = 1024
+    bn: int = 1024
     bk: int = 512
 
     def clip(self, m_loc: int, k: int, n_loc: int) -> "AgGemmConfig":

@@ -42,8 +42,8 @@ from . import blocks
 
 @dataclasses.dataclass(frozen=True)
 class GemmRsConfig:
-    bm: int = 256
-    bn: int = 512
+    bm: int = 1024
+    bn: int = 1024
     bk: int = 512
 
     def clip(self, m_loc: int, k_loc: int, n_dim: int) -> "GemmRsConfig":

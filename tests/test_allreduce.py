@@ -1,0 +1,126 @@
+"""AllReduce kernels (one-shot push, fused two-shot) and fused GEMM+AR vs
+stacked-sum goldens (reference ``test_allreduce.py`` /
+``kernels/nvidia/allreduce.py``)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.comm import (
+    AllReduceConfig,
+    AllReduceMethod,
+    all_reduce,
+)
+from triton_distributed_tpu.comm.allreduce import choose_method
+from triton_distributed_tpu.core.mesh import TP_AXIS, make_mesh, shard
+from triton_distributed_tpu.core.utils import assert_allclose, rand_tensor
+from triton_distributed_tpu.ops import gemm_ar
+
+CFG = AllReduceConfig(bm=8, bn=128)
+
+
+def _golden(x, n):
+    m = x.shape[0] // n
+    return x.reshape(n, m, x.shape[1]).astype(jnp.float32).sum(0)
+
+
+@pytest.mark.parametrize("method", [
+    AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT,
+])
+@pytest.mark.parametrize("m,r,dtype", [
+    (64, 128, jnp.float32),
+    (128, 256, jnp.bfloat16),
+])
+def test_all_reduce_matches_golden(mesh8, method, m, r, dtype):
+    n = 8
+    x = rand_tensor((n * m, r), dtype, scale=0.1)
+    xs = shard(mesh8, x, TP_AXIS)
+    out = all_reduce(xs, mesh8, TP_AXIS, method=method, config=CFG)
+    assert out.shape == (m, r)
+    tol = 1e-4 if dtype == jnp.float32 else 1e-1
+    assert_allclose(out.astype(jnp.float32), _golden(x, n),
+                    atol=tol, rtol=tol, name=f"allreduce-{method.value}")
+
+
+@pytest.mark.parametrize("method", [
+    AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT,
+])
+def test_all_reduce_repeat(mesh8, method):
+    """Second in-process invocation: drains must leave no semaphore residue."""
+    n, m, r = 8, 64, 128
+    x = rand_tensor((n * m, r), jnp.float32, scale=0.1)
+    xs = shard(mesh8, x, TP_AXIS)
+    out1 = all_reduce(xs, mesh8, TP_AXIS, method=method, config=CFG)
+    out2 = all_reduce(xs, mesh8, TP_AXIS, method=method, config=CFG)
+    assert_allclose(out1, out2, atol=0, rtol=0, name="ar-repeat")
+
+
+@pytest.mark.parametrize("nring", [2, 3, 4])
+@pytest.mark.parametrize("method", [
+    AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT,
+])
+def test_all_reduce_small_rings(nring, method):
+    """n in {2,3,4} exercises every drain-accounting branch."""
+    mesh = make_mesh({TP_AXIS: nring}, devices=jax.devices()[:nring])
+    m = 16 * nring  # divisible by nring (two-shot chunks) and sublane-aligned
+    x = rand_tensor((nring * m, 128), jnp.float32, scale=0.1)
+    xs = jax.device_put(x, NamedSharding(mesh, P(TP_AXIS)))
+    out = all_reduce(xs, mesh, TP_AXIS, method=method, config=CFG)
+    assert_allclose(out.astype(jnp.float32), _golden(x, nring),
+                    atol=1e-4, rtol=1e-4, name=f"ar-n{nring}")
+
+
+def test_all_reduce_auto_select():
+    # tiny -> one-shot; big -> two-shot; n<=2 always one-shot
+    assert choose_method(4 * 1024, 8) == AllReduceMethod.ONE_SHOT
+    assert choose_method(64 * 1024 * 1024, 8) == AllReduceMethod.TWO_SHOT
+    assert choose_method(64 * 1024 * 1024, 2) == AllReduceMethod.ONE_SHOT
+
+
+def test_all_reduce_single_rank():
+    mesh1 = make_mesh({TP_AXIS: 1}, devices=jax.devices()[:1])
+    x = rand_tensor((32, 128), jnp.float32)
+    assert_allclose(all_reduce(x, mesh1, TP_AXIS), x, atol=0, rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# fused GEMM + AllReduce
+
+
+def _gemm_golden(a, b):
+    return jnp.matmul(a.astype(jnp.float32), b.astype(jnp.float32))
+
+
+@pytest.mark.parametrize("m,k,n_dim", [(64, 128, 128), (128, 256, 256)])
+def test_gemm_ar_matches_golden(mesh8, m, k, n_dim):
+    a = rand_tensor((m, k), jnp.float32, scale=0.1)
+    b = rand_tensor((k, n_dim), jnp.float32, scale=0.1)
+    a_s = shard(mesh8, a, None, TP_AXIS)
+    b_s = shard(mesh8, b, TP_AXIS, None)
+    out = gemm_ar(a_s, b_s, mesh8, TP_AXIS)
+    assert out.shape == (m, n_dim)
+    assert_allclose(out.astype(jnp.float32), _gemm_golden(a, b),
+                    atol=1e-3, rtol=1e-3, name="gemm_ar")
+
+
+@pytest.mark.parametrize("nring", [2, 3])
+def test_gemm_ar_small_rings(nring):
+    mesh = make_mesh({TP_AXIS: nring}, devices=jax.devices()[:nring])
+    m, k, n_dim = 16 * nring, 32 * nring, 128
+    a = rand_tensor((m, k), jnp.float32, scale=0.1)
+    b = rand_tensor((k, n_dim), jnp.float32, scale=0.1)
+    a_s = jax.device_put(a, NamedSharding(mesh, P(None, TP_AXIS)))
+    b_s = jax.device_put(b, NamedSharding(mesh, P(TP_AXIS, None)))
+    out = gemm_ar(a_s, b_s, mesh, TP_AXIS)
+    assert_allclose(out.astype(jnp.float32), _gemm_golden(a, b),
+                    atol=1e-3, rtol=1e-3, name=f"gemm_ar-n{nring}")
+
+
+def test_gemm_ar_repeat(mesh8):
+    m, k, n_dim = 64, 128, 128
+    a = shard(mesh8, rand_tensor((m, k), jnp.float32, scale=0.1), None, TP_AXIS)
+    b = shard(mesh8, rand_tensor((k, n_dim), jnp.float32, scale=0.1), TP_AXIS, None)
+    out1 = gemm_ar(a, b, mesh8, TP_AXIS)
+    out2 = gemm_ar(a, b, mesh8, TP_AXIS)
+    assert_allclose(out1, out2, atol=0, rtol=0, name="gemm_ar-repeat")

@@ -3,4 +3,5 @@ communication half — allgather/reduce_scatter/allreduce/all-to-all files in
 ``python/triton_dist/kernels/nvidia/``)."""
 
 from .allgather import AllGatherMethod, all_gather, choose_method
+from .allreduce import AllReduceConfig, AllReduceMethod, all_reduce
 from .reduce_scatter import ReduceScatterConfig, reduce_scatter

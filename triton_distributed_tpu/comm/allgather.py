@@ -36,6 +36,8 @@ from ..core import compilation
 from ..core.mesh import TP_AXIS
 from ..lang import primitives as dl
 from ..lang.primitives import Team
+from . import ring
+from .ring import chunk as _chunk
 
 
 class AllGatherMethod(enum.Enum):
@@ -60,10 +62,6 @@ def choose_method(nbytes_per_shard: int, num_ranks: int) -> AllGatherMethod:
     if nbytes_per_shard <= _PUSH_BYTES_THRESHOLD:
         return AllGatherMethod.PUSH_1SHOT
     return AllGatherMethod.RING_BIDIR
-
-
-def _chunk(ref, idx, m):
-    return ref.at[pl.ds(idx * m, m)]
 
 
 def _wait_recv_chunk(out_ref, recv_sems, chunk_idx, m):
@@ -111,19 +109,8 @@ def _ag_ring_kernel(team: Team, m, x_ref, out_ref, local_sem, send_sem, recv_sem
     local = dl.local_copy(x_ref, _chunk(out_ref, me, m), local_sem)
     dl.collective_prologue(team, neighbors_only=True)
     local.wait()
-    for step in range(n - 1):
-        c_send = jax.lax.rem(me + n - step, n)
-        dl.remote_copy(
-            _chunk(out_ref, c_send, m),
-            _chunk(out_ref, c_send, m),
-            send_sem,
-            recv_sems.at[c_send],
-            right_id,
-        )
-        c_recv = jax.lax.rem(me + n - step - 1, n)
-        _wait_recv_chunk(out_ref, recv_sems, c_recv, m)
-    for _ in range(n - 1):  # drain sends off the critical path
-        _wait_send(out_ref, send_sem, me, m)
+    ring.ag_ring_phase(team, out_ref, m, send_sem, recv_sems, right_id)
+    ring.ag_ring_drain(team, out_ref, m, send_sem)
 
 
 def _ag_ring_bidir_kernel(

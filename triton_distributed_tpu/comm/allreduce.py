@@ -1,0 +1,298 @@
+"""AllReduce collectives as Pallas TPU kernels.
+
+TPU-native re-design of the reference's AllReduce family
+(``python/triton_dist/kernels/allreduce.py:28`` method enum;
+``python/triton_dist/kernels/nvidia/allreduce.py`` — one-shot push ``:365``,
+two-shot push ``:477``, double-tree ``:224``, multimem variants ``:557-693``,
+size-based auto-selection ``get_auto_allreduce_method:1042-1078``):
+
+- **ONE_SHOT** — every rank pushes its full partial into a per-source slot on
+  every peer, then reduces all n slots locally in one f32 pass.  (n-1) wire
+  copies of the full payload but a single hop: latency-optimal for small
+  tensors (the reference's headline small-M case, BASELINE.md 1.37x at
+  M=128).
+- **TWO_SHOT** — ReduceScatter ring followed by AllGather ring *in one
+  kernel*: each chunk crosses the wire 2(n-1)/n times — bandwidth-optimal.
+  No barrier is needed between the phases: phase 1 only writes out-chunk
+  ``me`` and every phase-2 write is gated by its own per-chunk DMA
+  semaphore.  The reference's DoubleTree / TwoShot_Multimem play this role
+  on NVLink; on the ICI torus the ring IS the optimal embedding, and
+  multimem (NVLS in-switch reduction) has no TPU equivalent.
+- The LL (flag-in-data) protocol variants collapse into DMA completion
+  semaphores, as everywhere in this framework (SURVEY.md section 7).
+
+Semantics (functional): input global ``(n*M, R)`` over ``axis`` — each
+device's shard is its (M, R) partial addend; output global ``(M, R)``
+replicated: every device holds the element-wise sum of all n partials.
+Golden: ``x.reshape(n, M, R).sum(0)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core import compilation
+from ..core.mesh import TP_AXIS
+from ..core.utils import clip_block
+from ..lang import primitives as dl
+from ..lang.primitives import Team
+from ..ops import blocks
+from . import ring
+from .ring import chunk as _chunk
+
+
+class AllReduceMethod(enum.Enum):
+    """TPU translation of the reference enum (``kernels/allreduce.py:28``):
+    the TMA/multimem/LL axes collapse (no TPU analogue); what remains is the
+    algorithmic choice the auto-selector makes by size."""
+
+    AUTO = "auto"
+    ONE_SHOT = "one_shot"   # full-mesh push + local n-way sum (latency)
+    TWO_SHOT = "two_shot"   # fused RS ring + AG ring (bandwidth)
+
+
+# One-shot moves (n-1)*bytes over each link but in a single hop; two-shot
+# moves ~2*bytes per link in 2(n-1) latency-chained steps.  Crossover sits
+# where wire time starts to dominate hop latency — same reasoning as the
+# reference's nbytes switch (``allreduce.py:1042-1078``).
+_ONE_SHOT_BYTES_THRESHOLD = 512 * 1024
+
+
+def choose_method(nbytes_per_rank: int, num_ranks: int) -> AllReduceMethod:
+    if num_ranks <= 2 or nbytes_per_rank <= _ONE_SHOT_BYTES_THRESHOLD:
+        return AllReduceMethod.ONE_SHOT
+    return AllReduceMethod.TWO_SHOT
+
+
+@dataclasses.dataclass(frozen=True)
+class AllReduceConfig:
+    bm: int = 256   # reduction-pipeline tile rows
+    bn: int = 512   # reduction-pipeline tile cols
+
+    def clip(self, m: int, r: int) -> "AllReduceConfig":
+        return AllReduceConfig(
+            bm=clip_block(self.bm, m), bn=clip_block(self.bn, r)
+        )
+
+
+def _ar_one_shot_kernel(
+    team: Team,
+    m: int,
+    r_dim: int,
+    cfg: AllReduceConfig,
+    out_dtype,
+    x_ref,       # (m, r) local partial addend                  [ANY]
+    out_ref,     # (m, r) full reduced result                   [ANY]
+    slots,       # (n, m, r) one landing slot per source rank   [HBM scratch]
+    local_sem,   # own-slot local DMA
+    send_sem,    # outgoing pushes (n-1 of identical shape)
+    recv_sems,   # (n,) per-source arrival
+):
+    """Reference ``allreduce_one_shot_push_intra_node_kernel``
+    (``allreduce.py:365``): symmetric-buffer scatter of the full payload,
+    then each rank reduces everything locally.  The reference reduces inside
+    the same kernel with vectorized loads over the symmetric region; here the
+    n slots are summed by one f32 emit_pipeline pass."""
+    me, n = team.rank(), team.size
+    # own partial into its slot (async local DMA; overlaps the barrier and
+    # the remote pushes — the pushes read x_ref, not the slot, so the wire
+    # never waits on this copy; the slot exists so the n-way reduction can
+    # use static slot indices)
+    local = dl.local_copy(x_ref, slots.at[me], local_sem)
+    dl.collective_prologue(team)
+    # push to every peer's slot[me] (static loop; ICI routes concurrently)
+    for off in range(1, n):
+        dst = jax.lax.rem(me + off, n)
+        dl.remote_copy(
+            x_ref, slots.at[me], send_sem, recv_sems.at[me],
+            team.device_id(dst),
+        )
+    local.wait()
+    for off in range(1, n):
+        src = jax.lax.rem(me + n - off, n)
+        dl.wait_recv(slots.at[src], recv_sems.at[src])
+    reduce = blocks.make_sum_pipeline(n, m, r_dim, cfg.bm, cfg.bn, out_dtype)
+    reduce(*[slots.at[i] for i in range(n)], out_ref)
+    for _ in range(n - 1):  # drain sends off the critical path
+        dl.wait_send(x_ref, send_sem)
+
+
+def _ar_two_shot_kernel(
+    team: Team,
+    m_chunk: int,
+    r_dim: int,
+    cfg: AllReduceConfig,
+    out_dtype,
+    x_ref,        # (n*m_chunk, r) local partial addend         [ANY]
+    out_ref,      # (n*m_chunk, r) full reduced result          [ANY]
+    recv_buf,     # (2, m_chunk, r) incoming RS partials        [HBM scratch]
+    send_buf,     # (2, m_chunk, r) outgoing RS accumulated     [HBM scratch]
+    rs_send_sems,  # (2,) per-parity RS send completion
+    rs_recv_sems,  # (2,) per-parity RS arrival
+    ack_sems,      # (2,) RS consumption credits (REGULAR)
+    ag_send_sem,   # AG phase sends
+    ag_recv_sems,  # (n,) AG per-chunk arrival
+):
+    """Fused two-shot (reference ``allreduce_two_shot_push_intra_node_kernel``
+    ``allreduce.py:477``): phase 1 is the ring ReduceScatter of
+    ``comm/reduce_scatter.py`` with its final accumulation landing in
+    out-chunk ``me``; phase 2 is the unidirectional AG ring of
+    ``comm/allgather.py`` forwarding reduced chunks to their final offsets.
+    Phases need no separating barrier — phase-1 writes only chunk ``me`` and
+    every phase-2 consume is gated by its per-chunk DMA semaphore."""
+    me, n = team.rank(), team.size
+    left, right = team.neighbor_ranks()
+    left_id, right_id = team.device_id(left), team.device_id(right)
+
+    add = blocks.make_add_pipeline(m_chunk, r_dim, cfg.bm, cfg.bn)
+    tosum = blocks.make_sum_pipeline(2, m_chunk, r_dim, cfg.bm, cfg.bn,
+                                     out_dtype)
+
+    def x_chunk(c):
+        return _chunk(x_ref, c, m_chunk)
+
+    dl.collective_prologue(team, neighbors_only=True)
+
+    # ---- phase 1: ring ReduceScatter (comm/reduce_scatter.py flow) ----
+    j0 = jax.lax.rem(me + n - 1, n)
+    dl.remote_copy(x_chunk(j0), recv_buf.at[0], rs_send_sems.at[0],
+                   rs_recv_sems.at[0], right_id)
+
+    for s in range(1, n):
+        j = jax.lax.rem(me + n - s - 1, n)   # chunk being accumulated here
+        slot_in = (s - 1) % 2
+        dl.wait_recv(recv_buf.at[slot_in], rs_recv_sems.at[slot_in])
+        last = s == n - 1
+        if last:
+            # j == me here: the fully reduced chunk lands in its final
+            # output offset (possibly with a dtype cast)
+            tosum(recv_buf.at[slot_in], x_chunk(j), _chunk(out_ref, me, m_chunk))
+        else:
+            slot_out = s % 2
+            if s >= 2:
+                dl.wait_send(send_buf.at[slot_out], rs_send_sems.at[slot_out])
+                dl.wait(ack_sems.at[slot_out], 1)
+            add(recv_buf.at[slot_in], x_chunk(j), send_buf.at[slot_out])
+            dl.remote_copy(send_buf.at[slot_out], recv_buf.at[slot_out],
+                           rs_send_sems.at[slot_out],
+                           rs_recv_sems.at[slot_out], right_id)
+        dl.notify(ack_sems.at[slot_in], left_id)
+
+    # ---- phase 2: ring AllGather of reduced chunks ----
+    ring.ag_ring_phase(team, out_ref, m_chunk, ag_send_sem, ag_recv_sems,
+                       right_id)
+
+    # ---- drains (RS send accounting identical to comm/reduce_scatter.py) ----
+    dl.wait_send(send_buf.at[0], rs_send_sems.at[0])
+    if n > 2:
+        dl.wait_send(send_buf.at[1], rs_send_sems.at[1])
+    ring.rs_ack_drain(ack_sems, n)
+    ring.ag_ring_drain(team, out_ref, m_chunk, ag_send_sem)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_all_reduce(
+    mesh: Mesh,
+    axis: str,
+    method: AllReduceMethod,
+    m: int,
+    r_dim: int,
+    dtype: jnp.dtype,
+    out_dtype: jnp.dtype,
+    cfg: AllReduceConfig,
+):
+    team = Team.of(mesh, axis)
+    n = team.size
+    if method == AllReduceMethod.ONE_SHOT:
+        kernel = functools.partial(_ar_one_shot_kernel, team, m, r_dim, cfg,
+                                   out_dtype)
+        scratch_shapes = [
+            pltpu.HBM((n, m, r_dim), dtype),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((n,)),
+        ]
+    else:
+        m_chunk = m // n
+        kernel = functools.partial(_ar_two_shot_kernel, team, m_chunk, r_dim,
+                                   cfg, out_dtype)
+        scratch_shapes = [
+            pltpu.HBM((2, m_chunk, r_dim), dtype),
+            pltpu.HBM((2, m_chunk, r_dim), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((n,)),
+        ]
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m, r_dim), out_dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=scratch_shapes,
+        compiler_params=compilation.compiler_params(
+            collective=True,
+            collective_id=compilation.collective_id("allreduce"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+    return compilation.jit_shard_map(
+        call, mesh, in_specs=P(axis, None), out_specs=P(None, None)
+    )
+
+
+def all_reduce(
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = TP_AXIS,
+    *,
+    method: AllReduceMethod = AllReduceMethod.AUTO,
+    config: AllReduceConfig | None = None,
+    out_dtype=None,
+) -> jax.Array:
+    """Sum-AllReduce over ``axis`` (reference host entry ``all_reduce``,
+    ``kernels/nvidia/allreduce.py:1054-1078``).
+
+    ``x``: global ``(n*M, R)``, device r's shard = its (M, R) partial addend.
+    Returns global ``(M, R)`` replicated on every device: the element-wise
+    sum.  Golden: ``x.reshape(n, M, R).sum(0)``.
+
+    Accumulation precision: ONE_SHOT sums all n partials in f32 in one pass;
+    TWO_SHOT accumulates the n-1 ring steps in the wire (input) dtype with
+    only the final combine in f32 — the standard ring-AR bandwidth/precision
+    trade (NCCL rings and the reference's two-shot behave the same; carrying
+    f32 partials would double the wire bytes for bf16).  Under AUTO, results
+    for bf16 inputs therefore differ slightly across the size threshold.
+    """
+    n = mesh.shape[axis]
+    m_stack = x.shape[0]
+    if m_stack % n:
+        raise ValueError(f"dim0 {m_stack} not divisible by {axis}={n}")
+    m = m_stack // n
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(x.dtype)
+    if n == 1:
+        return x.astype(out_dtype)
+
+    if method == AllReduceMethod.AUTO:
+        nbytes = int(jnp.dtype(x.dtype).itemsize) * m * x.shape[1]
+        method = choose_method(nbytes, n)
+    if method == AllReduceMethod.TWO_SHOT and m % n:
+        # two-shot chunks rows n ways; fall back rather than pad
+        method = AllReduceMethod.ONE_SHOT
+
+    cfg = (config or AllReduceConfig()).clip(
+        m // n if method == AllReduceMethod.TWO_SHOT else m, x.shape[1]
+    )
+    fn = _build_all_reduce(
+        mesh, axis, method, m, x.shape[1], jnp.dtype(x.dtype), out_dtype, cfg
+    )
+    return fn(x)

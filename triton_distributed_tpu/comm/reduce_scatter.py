@@ -33,6 +33,7 @@ from ..core.utils import clip_block
 from ..lang import primitives as dl
 from ..lang.primitives import Team
 from ..ops import blocks
+from . import ring
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,11 +107,7 @@ def _rs_ring_kernel(
     dl.wait_send(send_buf.at[0], send_sems.at[0])
     if n > 2:
         dl.wait_send(send_buf.at[1], send_sems.at[1])
-    if n == 2:
-        dl.wait(ack_sems.at[0], 1)
-    else:
-        dl.wait(ack_sems.at[(n - 3) % 2], 1)
-        dl.wait(ack_sems.at[(n - 2) % 2], 1)
+    ring.rs_ack_drain(ack_sems, n)
 
 
 @functools.lru_cache(maxsize=None)

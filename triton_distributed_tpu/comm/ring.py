@@ -1,0 +1,69 @@
+"""Shared ring-phase building blocks for collective kernels.
+
+One home for the two most delicate, previously copy-pasted pieces of the
+collective kernels (the semaphore/drain accounting differs by ring size and
+MUST stay identical everywhere it is used):
+
+- the unidirectional AllGather forward ring (``allgather._ag_ring_kernel``,
+  phase 2 of two-shot AllReduce and of fused GEMM+AR);
+- the ACK-credit drain accounting of the ring ReduceScatter family
+  (``reduce_scatter``, ``gemm_rs``, two-shot AllReduce, fused GEMM+AR).
+
+Reference analogue: the per-tile barrier/flag bookkeeping shared across
+``reduce_scatter.py`` / ``gemm_reduce_scatter.py`` / ``allreduce.py`` in
+``python/triton_dist/kernels/nvidia/``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental import pallas as pl
+
+from ..lang import primitives as dl
+from ..lang.primitives import Team
+
+
+def chunk(ref, idx, m):
+    """Rows ``[idx*m, (idx+1)*m)`` of ``ref`` (dim-0 chunk view)."""
+    return ref.at[pl.ds(idx * m, m)]
+
+
+def ag_ring_phase(team: Team, out_ref, m: int, send_sem, recv_sems, right_id):
+    """Unidirectional AG ring over chunks already placed at final offsets.
+
+    Precondition: out-chunk ``me`` holds this rank's contribution.  Each of
+    the n-1 steps forwards the chunk received last step (step 0: own chunk)
+    to the right neighbor and waits for the incoming one.  Pair with
+    :func:`ag_ring_drain` after the last consume.
+    """
+    me, n = team.rank(), team.size
+    for step in range(n - 1):
+        c_send = jax.lax.rem(me + n - step, n)
+        dl.remote_copy(
+            chunk(out_ref, c_send, m), chunk(out_ref, c_send, m),
+            send_sem, recv_sems.at[c_send], right_id,
+        )
+        c_recv = jax.lax.rem(me + n - step - 1, n)
+        dl.wait_recv(chunk(out_ref, c_recv, m), recv_sems.at[c_recv])
+
+
+def ag_ring_drain(team: Team, out_ref, m: int, send_sem):
+    """Drain the n-1 sends of :func:`ag_ring_phase` off the critical path."""
+    me, n = team.rank(), team.size
+    for _ in range(n - 1):
+        dl.wait_send(chunk(out_ref, me, m), send_sem)
+
+
+def rs_ack_drain(ack_sems, n: int):
+    """Consume the outstanding ACK credits of a ring-RS at kernel exit.
+
+    The in-loop ``wait(ack_sems[slot_out])`` at steps ``s >= 2`` covered the
+    credits for sends 0..n-4; the credits for the last two sends (one when
+    n == 2) arrive after the loop and must be consumed so repeated
+    invocations start balanced.
+    """
+    if n == 2:
+        dl.wait(ack_sems.at[0], 1)
+    else:
+        dl.wait(ack_sems.at[(n - 3) % 2], 1)
+        dl.wait(ack_sems.at[(n - 2) % 2], 1)

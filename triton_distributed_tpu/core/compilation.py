@@ -104,6 +104,7 @@ _COLLECTIVE_IDS: dict[str, int] = {
     "ep_dispatch": 11,
     "ep_combine": 12,
     "barrier": 13,
+    "gemm_ar": 14,
 }
 
 

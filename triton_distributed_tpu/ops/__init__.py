@@ -3,4 +3,5 @@
 distributed flash-decode, SP attention)."""
 
 from .ag_gemm import AgGemmConfig, ag_gemm
+from .gemm_ar import GemmArConfig, gemm_ar
 from .gemm_rs import GemmRsConfig, gemm_rs

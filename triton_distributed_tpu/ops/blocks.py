@@ -60,6 +60,29 @@ def make_matmul_pipeline(m: int, n: int, k: int, bm: int, bn: int, bk: int,
     )
 
 
+def sum_body(out_dtype, *refs):
+    *in_refs, o_ref = refs
+    acc = in_refs[0][...].astype(jnp.float32)
+    for r in in_refs[1:]:
+        acc += r[...].astype(jnp.float32)
+    o_ref[...] = acc.astype(out_dtype)
+
+
+def make_sum_pipeline(num_in: int, m: int, n: int, bm: int, bn: int, out_dtype):
+    """An ``emit_pipeline`` computing O[m,n] = sum of ``num_in`` same-shaped
+    inputs with f32 accumulation (the one-shot AllReduce local reduction).
+
+    Call as ``pipe(in0, in1, ..., out_ref)``.
+    """
+    spec = pl.BlockSpec((bm, bn), lambda i, j: (i, j))
+    return pltpu.emit_pipeline(
+        functools.partial(sum_body, out_dtype),
+        grid=(m // bm, n // bn),
+        in_specs=[spec] * num_in,
+        out_specs=[spec],
+    )
+
+
 def make_add_pipeline(m: int, n: int, bm: int, bn: int):
     """An ``emit_pipeline`` computing O[m,n] = A + B blockwise."""
     return pltpu.emit_pipeline(

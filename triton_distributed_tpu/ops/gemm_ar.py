@@ -1,24 +1,25 @@
-"""Fused GEMM-ReduceScatter: the mirror image of AG-GEMM.
+"""Fused GEMM-AllReduce: row-parallel GEMM with the full sum on every rank.
 
-Reference: ``python/triton_dist/kernels/nvidia/gemm_reduce_scatter.py``
-(producer persistent GEMM writes tiles and ``notify``s per-tile barriers
-``kernel_gemm_rs_producer_persistent:130``; consumer RS; host entry
-``gemm_rs:576``) + the paired ring reduce in ``reduce_scatter.py:688-882``.
+Reference: the GEMM + AllReduce path of the TP MLP
+(``python/triton_dist/layers/nvidia/tp_mlp.py:177`` dispatches to
+``all_reduce`` after the down-projection when M is small;
+``kernels/nvidia/allreduce.py:695-780`` host entries) — the reference's best
+small-M configuration (1.37x at M=128, BASELINE.md).
 
-TPU design — one kernel per device interleaving three activities:
+TPU design — the compute-ahead-of-wire ring of ``ops/gemm_rs.py`` extended
+by the in-kernel AllGather phase of ``comm/allreduce.py``'s two-shot kernel:
 
-1. blocked matmul (inner ``emit_pipeline``) of the output chunk that must
-   leave next, in ring order starting with the chunk that travels farthest
-   (rank me-1), so compute runs ahead of the wire;
-2. ring forwarding: received partial + freshly computed local contribution
-   are combined by a tiled add pipeline and pushed right — each chunk visits
-   every rank once (bandwidth-optimal, like the reference ring);
-3. the matmul of step s overlaps the in-flight transfer of step s-1 —
-   compute-communication overlap without a producer stream.
+1. phase 1 (fused GEMM+RS): per ring step, matmul the output chunk that must
+   leave next and fold it into the travelling partial — compute of step s
+   hides the wire time of step s-1; the fully reduced chunk ``me`` lands in
+   its final offset of the replicated output;
+2. phase 2 (AG ring): reduced chunks are forwarded to their final offsets on
+   every rank.  No inter-phase barrier: phase-1 writes only chunk ``me`` and
+   each phase-2 consume is gated by its own per-chunk DMA semaphore.
 
-Computes ``ReduceScatter_M(A[M, K_loc] @ B_loc[K_loc, N])`` — the
-row-parallel half of a TP layer: A is K-sharded, B row-sharded, the M-sharded
-sum comes out.
+Computes ``AllReduce_sum(A[M, K_loc] @ B_loc[K_loc, N])`` replicated — the
+row-parallel half of a TP layer when the caller wants the full activation on
+every rank (sequence parallelism off).
 """
 
 from __future__ import annotations
@@ -33,6 +34,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..comm import ring
+from ..comm.ring import chunk as _chunk
 from ..core import compilation
 from ..core.mesh import TP_AXIS
 from ..core.utils import clip_block
@@ -42,35 +44,37 @@ from . import blocks
 
 
 @dataclasses.dataclass(frozen=True)
-class GemmRsConfig:
+class GemmArConfig:
     bm: int = 1024
     bn: int = 1024
     bk: int = 512
 
-    def clip(self, m_loc: int, k_loc: int, n_dim: int) -> "GemmRsConfig":
-        return GemmRsConfig(
+    def clip(self, m_loc: int, k_loc: int, n_dim: int) -> "GemmArConfig":
+        return GemmArConfig(
             bm=clip_block(self.bm, m_loc), bn=clip_block(self.bn, n_dim),
             bk=clip_block(self.bk, k_loc),
         )
 
 
-def _gemm_rs_kernel(
+def _gemm_ar_kernel(
     team: Team,
     m_loc: int,
     k_loc: int,
     n_dim: int,
-    cfg: GemmRsConfig,
+    cfg: GemmArConfig,
     out_dtype,
-    a_ref,       # (n*m_loc, k_loc) local A (K-shard)          [ANY]
-    b_ref,       # (k_loc, n) local B (row shard)              [ANY]
-    out_ref,     # (m_loc, n) reduced output chunk             [ANY]
-    mm_buf,      # (2, m_loc, n) fresh local contributions     [HBM scratch]
-    recv_buf,    # (2, m_loc, n) incoming partials             [HBM scratch]
-    send_buf,    # (2, m_loc, n) outgoing accumulated          [HBM scratch]
-    send_sems,   # (2,) per-parity send completion (see reduce_scatter.py)
-    recv_sems,   # (2,)
-    ack_sems,    # (2,) consumption credits (REGULAR)
-    acc_ref,     # (bm, bn) f32                                 [VMEM scratch]
+    a_ref,        # (n*m_loc, k_loc) local A (K-shard)          [ANY]
+    b_ref,        # (k_loc, n) local B (row shard)              [ANY]
+    out_ref,      # (n*m_loc, n) full reduced result            [ANY]
+    mm_buf,       # (2, m_loc, n) fresh local contributions     [HBM scratch]
+    recv_buf,     # (2, m_loc, n) incoming partials             [HBM scratch]
+    send_buf,     # (2, m_loc, n) outgoing accumulated          [HBM scratch]
+    send_sems,    # (2,) per-parity RS send completion
+    recv_sems,    # (2,) per-parity RS arrival
+    ack_sems,     # (2,) RS consumption credits (REGULAR)
+    ag_send_sem,  # AG phase sends
+    ag_recv_sems,  # (n,) AG per-chunk arrival
+    acc_ref,      # (bm, bn) f32                                 [VMEM scratch]
 ):
     me, n = team.rank(), team.size
     left, right = team.neighbor_ranks()
@@ -82,12 +86,12 @@ def _gemm_rs_kernel(
     add = blocks.make_add_pipeline(m_loc, n_dim, cfg.bm, cfg.bn)
 
     def a_chunk(c):
-        return a_ref.at[pl.ds(c * m_loc, m_loc)]
+        return _chunk(a_ref, c, m_loc)
 
     dl.collective_prologue(team, neighbors_only=True)
 
-    # step 0: matmul the chunk that travels farthest; its raw value IS the
-    # step-0 payload (no partial to add yet)
+    # ---- phase 1: fused GEMM + ring ReduceScatter (ops/gemm_rs.py flow,
+    # final accumulation landing in out-chunk ``me``) ----
     j0 = jax.lax.rem(me + n - 1, n)
     mm(a_chunk(j0), b_ref, mm_buf.at[0], scratches=[acc_ref])
     dl.remote_copy(mm_buf.at[0], recv_buf.at[0], send_sems.at[0],
@@ -98,23 +102,18 @@ def _gemm_rs_kernel(
         slot_in = (s - 1) % 2
         slot_out = s % 2
         if s == 2:
-            # mm is about to rewrite mm_buf[0], whose step-0 payload may
-            # still be on the wire (the only send ever issued from mm_buf)
             dl.wait_send(mm_buf.at[0], send_sems.at[0])
-        # local contribution for chunk j — INDEPENDENT of the in-flight
-        # transfer s-1, so the MXU hides the wire time (the whole point)
         mm(a_chunk(j), b_ref, mm_buf.at[slot_out], scratches=[acc_ref])
         dl.wait_recv(recv_buf.at[slot_in], recv_sems.at[slot_in])
         last = s == n - 1
         if last:
-            add(recv_buf.at[slot_in], mm_buf.at[slot_out], out_ref)
+            # j == me: reduced chunk lands at its final replicated offset
+            add(recv_buf.at[slot_in], mm_buf.at[slot_out],
+                _chunk(out_ref, me, m_loc))
         else:
             if s >= 3:
-                # send_buf[slot_out]'s step s-2 send must be off the wire
                 dl.wait_send(send_buf.at[slot_out], send_sems.at[slot_out])
             if s >= 2:
-                # right must have consumed what we sent into its recv
-                # slot_out two steps ago
                 dl.wait(ack_sems.at[slot_out], 1)
             add(recv_buf.at[slot_in], mm_buf.at[slot_out],
                 send_buf.at[slot_out])
@@ -123,10 +122,11 @@ def _gemm_rs_kernel(
                            right_id)
         dl.notify(ack_sems.at[slot_in], left_id)
 
-    # Drain (counting per parity: issued minus in-loop waits).
-    # n==2: only the parity-0 step-0 send is outstanding.
-    # n==3: step-0's wait happened at s==2; parity-1 (step 1) outstanding.
-    # n>=4: one send outstanding on each parity.
+    # ---- phase 2: ring AllGather of reduced chunks ----
+    ring.ag_ring_phase(team, out_ref, m_loc, ag_send_sem, ag_recv_sems,
+                       right_id)
+
+    # ---- drains (RS send accounting identical to ops/gemm_rs.py) ----
     if n == 2:
         dl.wait_send(send_buf.at[0], send_sems.at[0])
     elif n == 3:
@@ -135,10 +135,11 @@ def _gemm_rs_kernel(
         dl.wait_send(send_buf.at[0], send_sems.at[0])
         dl.wait_send(send_buf.at[1], send_sems.at[1])
     ring.rs_ack_drain(ack_sems, n)
+    ring.ag_ring_drain(team, out_ref, m_loc, ag_send_sem)
 
 
 @functools.lru_cache(maxsize=None)
-def _build_gemm_rs(
+def _build_gemm_ar(
     mesh: Mesh,
     axis: str,
     m_loc: int,
@@ -146,16 +147,16 @@ def _build_gemm_rs(
     n_dim: int,
     dtype: jnp.dtype,
     out_dtype: jnp.dtype,
-    cfg: GemmRsConfig,
+    cfg: GemmArConfig,
 ):
     team = Team.of(mesh, axis)
     n = team.size
     kernel = functools.partial(
-        _gemm_rs_kernel, team, m_loc, k_loc, n_dim, cfg, out_dtype
+        _gemm_ar_kernel, team, m_loc, k_loc, n_dim, cfg, out_dtype
     )
     call = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((m_loc, n_dim), out_dtype),
+        out_shape=jax.ShapeDtypeStruct((n * m_loc, n_dim), out_dtype),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
@@ -168,38 +169,40 @@ def _build_gemm_rs(
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((n,)),
             pltpu.VMEM((cfg.bm, cfg.bn), jnp.float32),
         ],
         compiler_params=compilation.compiler_params(
             collective=True,
-            collective_id=compilation.collective_id("gemm_rs"),
+            collective_id=compilation.collective_id("gemm_ar"),
         ),
         interpret=compilation.interpret_mode(),
     )
     return compilation.jit_shard_map(
         call, mesh,
         in_specs=(P(None, axis), P(axis, None)),
-        out_specs=P(axis, None),
+        out_specs=P(None, None),
     )
 
 
-def gemm_rs(
+def gemm_ar(
     a: jax.Array,
     b: jax.Array,
     mesh: Mesh,
     axis: str = TP_AXIS,
     *,
-    config: GemmRsConfig | None = None,
+    config: GemmArConfig | None = None,
     out_dtype=None,
 ) -> jax.Array:
-    """Overlapped ``ReduceScatter(a @ b)`` (reference host entry
-    ``gemm_rs:576``).
+    """Overlapped ``AllReduce(a @ b)`` (reference: ``tp_mlp.py:177`` GEMM+AR
+    dispatch; ``kernels/nvidia/allreduce.py:695-780``).
 
     ``a``: (M, K) sharded on dim 1 over ``axis`` (activations, K-parallel).
     ``b``: (K, N) sharded on dim 0 over ``axis`` (row-parallel weight).
-    Returns (M, N) sharded on dim 0: the reduced sum, row-chunk r on rank r.
+    Returns (M, N) replicated on every rank: the full sum.
     """
-    cfg = config or GemmRsConfig()
+    cfg = config or GemmArConfig()
     out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
     n = mesh.shape[axis]
 
@@ -216,7 +219,7 @@ def gemm_rs(
 
     m_loc, k_loc = m_tot // n, k_dim // n
     cfg = cfg.clip(m_loc, k_loc, n_dim)
-    fn = _build_gemm_rs(
+    fn = _build_gemm_ar(
         mesh, axis, m_loc, k_loc, n_dim, jnp.dtype(a.dtype), out_dtype, cfg
     )
     return fn(a, b)

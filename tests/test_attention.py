@@ -1,0 +1,165 @@
+"""Local attention kernels vs naive f32 goldens (reference per-kernel golden
+strategy, SURVEY.md section 4): flash prefill (causal/full, GQA, soft-cap),
+split-KV decode with state merging, RoPE."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from triton_distributed_tpu.ops.attention import (
+    decode_attention,
+    decode_attention_state,
+    flash_attention,
+    merge_decode_states,
+)
+from triton_distributed_tpu.ops.rope import apply_rope_at
+
+
+def _naive_attention(q, k, v, causal, sm_scale=None, soft_cap=0.0, kv_len=None):
+    b, h, sq, d = q.shape
+    hk = k.shape[1]
+    g = h // hk
+    kf = jnp.repeat(k.astype(jnp.float32), g, axis=1)
+    vf = jnp.repeat(v.astype(jnp.float32), g, axis=1)
+    qf = q.astype(jnp.float32)
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if soft_cap:
+        s = jnp.tanh(s / soft_cap) * soft_cap
+    skv = k.shape[2]
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, skv), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    if kv_len is not None:
+        s = jnp.where(jnp.arange(skv) < kv_len, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("h,hk", [(4, 4), (8, 2)])
+def test_flash_attention_golden(causal, h, hk):
+    b, s, d = 2, 256, 64
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.float32)
+    k = jax.random.normal(kk, (b, hk, s, d), jnp.float32)
+    v = jax.random.normal(kv, (b, hk, s, d), jnp.float32)
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+    want = _naive_attention(q, k, v, causal)
+    assert jnp.allclose(out, want, atol=2e-5, rtol=2e-5), (
+        jnp.abs(out - want).max()
+    )
+
+
+def test_flash_attention_blocks_smaller_than_seq():
+    """Multiple q and kv blocks exercise the online-softmax rescaling."""
+    b, h, s, d = 1, 2, 512, 64
+    kq, kk, kv = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.float32)
+    k = jax.random.normal(kk, (b, h, s, d), jnp.float32)
+    v = jax.random.normal(kv, (b, h, s, d), jnp.float32)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=64)
+    want = _naive_attention(q, k, v, True)
+    assert jnp.allclose(out, want, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_soft_cap_and_scale():
+    b, h, s, d = 1, 2, 128, 64
+    kq, kk, kv = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.float32)
+    k = jax.random.normal(kk, (b, h, s, d), jnp.float32)
+    v = jax.random.normal(kv, (b, h, s, d), jnp.float32)
+    out = flash_attention(q, k, v, causal=False, sm_scale=0.2, soft_cap=30.0,
+                          block_q=64, block_k=64)
+    want = _naive_attention(q, k, v, False, sm_scale=0.2, soft_cap=30.0)
+    assert jnp.allclose(out, want, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_bf16():
+    b, h, s, d = 1, 4, 256, 128
+    kq, kk, kv = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, h, s, d), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    want = _naive_attention(q, k, v, True)
+    assert out.dtype == jnp.bfloat16
+    assert jnp.allclose(out.astype(jnp.float32), want, atol=5e-2, rtol=5e-2)
+
+
+# ---------------------------------------------------------------------------
+# split-KV decode
+
+
+@pytest.mark.parametrize("n_split", [1, 4])
+@pytest.mark.parametrize("h,hk", [(4, 4), (8, 2)])
+def test_decode_attention_golden(n_split, h, hk):
+    b, skv, d = 2, 512, 64
+    kv_len = 300  # padded cache: positions >= kv_len masked
+    kq, kk, kv = jax.random.split(jax.random.key(4), 3)
+    q = jax.random.normal(kq, (b, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, hk, skv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, hk, skv, d), jnp.float32)
+    out = decode_attention(q, k, v, kv_len, n_split=n_split)
+    want = _naive_attention(
+        q[:, :, None], k, v, causal=False, kv_len=kv_len
+    )[:, :, 0]
+    assert jnp.allclose(out, want, atol=2e-5, rtol=2e-5), (
+        jnp.abs(out - want).max()
+    )
+
+
+def test_decode_state_merge_associative():
+    """Merging per-split states equals single-split state — the invariant the
+    distributed flash-decode rides (merge splits locally, then ranks)."""
+    b, h, hk, skv, d = 1, 4, 2, 256, 64
+    kq, kk, kv = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(kq, (b, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, hk, skv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, hk, skv, d), jnp.float32)
+    num4, m4, l4 = decode_attention_state(q, k, v, skv, n_split=4)
+    num, m, l = merge_decode_states(num4, m4, l4)
+    out = (num[..., 0, :] / l[..., 0][..., None])
+    want = decode_attention(q, k, v, skv, n_split=1)
+    assert jnp.allclose(out, want, atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# RoPE
+
+
+def test_rope_matches_complex_rotation():
+    s, d = 64, 32
+    x = jax.random.normal(jax.random.key(6), (2, 4, s, d), jnp.float32)
+    pos = jnp.arange(s)
+    got = apply_rope_at(x, pos, theta=10_000.0)
+    # golden: complex multiply on (x1 + i x2)
+    half = d // 2
+    inv_freq = 1.0 / (10_000.0 ** (jnp.arange(half) / half))
+    ang = pos[:, None] * inv_freq
+    z = x[..., :half] + 1j * x[..., half:]
+    zr = z * jnp.exp(1j * ang)
+    want = jnp.concatenate([zr.real, zr.imag], axis=-1)
+    assert jnp.allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_rope_preserves_norm_and_dtype():
+    x = jax.random.normal(jax.random.key(7), (1, 2, 16, 64), jnp.bfloat16)
+    got = apply_rope_at(x, jnp.arange(16))
+    assert got.dtype == jnp.bfloat16
+    n0 = jnp.linalg.norm(x.astype(jnp.float32), axis=-1)
+    n1 = jnp.linalg.norm(got.astype(jnp.float32), axis=-1)
+    assert jnp.allclose(n0, n1, atol=0.5, rtol=5e-2)
+
+
+def test_rope_relative_property():
+    """Scores depend only on relative distance: q_i . k_j after rope at
+    (i, j) equals after rope at (i+t, j+t)."""
+    d = 64
+    q = jax.random.normal(jax.random.key(8), (1, 1, 1, d), jnp.float32)
+    k = jax.random.normal(jax.random.key(9), (1, 1, 1, d), jnp.float32)
+    def score(pq, pk):
+        qr = apply_rope_at(q, jnp.array([pq]))
+        kr = apply_rope_at(k, jnp.array([pk]))
+        return (qr * kr).sum()
+    assert jnp.allclose(score(5, 3), score(25, 23), atol=1e-4, rtol=1e-4)

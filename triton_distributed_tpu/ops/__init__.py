@@ -3,5 +3,12 @@
 distributed flash-decode, SP attention)."""
 
 from .ag_gemm import AgGemmConfig, ag_gemm
+from .attention import (
+    decode_attention,
+    decode_attention_state,
+    flash_attention,
+    merge_decode_states,
+)
 from .gemm_ar import GemmArConfig, gemm_ar
 from .gemm_rs import GemmRsConfig, gemm_rs
+from .rope import apply_rope, apply_rope_at, rope_freqs

@@ -1,0 +1,373 @@
+"""Local attention kernels: flash-attention prefill and split-KV decode.
+
+The single-chip attention building blocks under the distributed attention
+ops (``sp_attention``, ``flash_decode``) and the TP attention layer —
+the role the reference's Triton flash kernels play
+(``python/triton_dist/kernels/nvidia/flash_decode.py:130`` split-KV decode
+stage, ``sp_ag_attention_intra_node.py:256`` consumer causal flash-attn).
+
+TPU design notes:
+
+- The online-softmax tiling is blocked on the query axis only; each (batch,
+  q-head, q-block) grid cell streams the full K/V slice for its kv-head
+  through VMEM.  At d=128, seq 8k, bf16 that is 2 MiB each for K and V —
+  well inside VMEM — and lets the MXU run (bq, d) x (d, bk) matmuls
+  back-to-back.  Longer sequences belong to the SP/CP ops, which chunk KV
+  across devices before this kernel runs.
+- GQA is folded into the BlockSpec index maps (q-head -> kv-head integer
+  division), not a data relayout like the reference's BLOCK_H head packing
+  (``flash_decode.py:130``): Mosaic prefetches the right kv slice per grid
+  cell and replication never materializes.
+- Softmax statistics are carried in f32 VMEM scratch across kv blocks; the
+  causal variant bounds the kv loop at the diagonal block (a traced
+  ``fori_loop`` bound, not a mask over the full sequence).
+- ``soft_cap`` (tanh logit capping, reference ``flash_decode.py:161``) is
+  applied inside the tile loop when set.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..core import compilation
+from ..core.utils import clip_block
+
+_NEG_INF = -1e30
+
+
+def _attn_kernel(
+    seq_kv: int,
+    bq: int,
+    bk: int,
+    causal: bool,
+    sm_scale: float,
+    soft_cap: float,
+    q_ref,    # (1, bq, d)    VMEM
+    k_ref,    # (1, seq_kv, d) VMEM
+    v_ref,    # (1, seq_kv, d) VMEM
+    o_ref,    # (1, bq, d)    VMEM
+    m_ref,    # (bq, 128) f32 running max        [VMEM scratch]
+    l_ref,    # (bq, 128) f32 running denominator [VMEM scratch]
+    acc_ref,  # (bq, d) f32 output accumulator    [VMEM scratch]
+):
+    iq = pl.program_id(1)
+    m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (bq, d)
+
+    def body(j, _):
+        k = k_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)    # (bk, d)
+        v = v_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)    # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, bk)
+        if soft_cap:
+            s = jnp.tanh(s / soft_cap) * soft_cap
+        if causal:
+            # rows are absolute q positions, cols absolute kv positions
+            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                                   # (bq, 1)
+        m_cur = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)                                  # (bq, bk)
+        l_ref[...] = l_ref[...] * alpha + p.sum(axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
+        return 0
+
+    if causal:
+        # kv blocks at or left of this q-block's diagonal
+        nkv = (iq * bq + bq + bk - 1) // bk
+    else:
+        nkv = seq_kv // bk
+    jax.lax.fori_loop(0, nkv, body, 0)
+    o_ref[0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_flash_attention(
+    b, h, hk, seq_q, seq_kv, d, bq, bk, causal, sm_scale, soft_cap, dtype
+):
+    group = h // hk
+    kernel = functools.partial(
+        _attn_kernel, seq_kv, bq, bk, causal, sm_scale, soft_cap
+    )
+    call = pl.pallas_call(
+        kernel,
+        grid=(b * h, seq_q // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, iq: (bh, iq, 0)),
+            # GQA in the index map: q-head bh%h -> kv-head (bh%h)//group
+            pl.BlockSpec(
+                (1, seq_kv, d),
+                lambda bh, iq: ((bh // h) * hk + (bh % h) // group, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, seq_kv, d),
+                lambda bh, iq: ((bh // h) * hk + (bh % h) // group, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, iq: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, seq_q, d), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=compilation.compiler_params(
+            collective=False,
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+    return jax.jit(call)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    soft_cap: float = 0.0,
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jax.Array:
+    """Blocked online-softmax attention (local; no collectives).
+
+    ``q``: (B, H, Sq, D); ``k``/``v``: (B, Hkv, Skv, D) with H a multiple of
+    Hkv (GQA).  ``causal`` aligns the LAST q position with the last kv
+    position (decode-style suffix alignment when Sq < Skv is NOT applied —
+    use :func:`decode_attention` for single-token decode).
+    Golden: softmax(q k^T * scale + mask) v in f32.
+    """
+    b, h, seq_q, d = q.shape
+    bk_, hk, seq_kv, dk = k.shape
+    if (bk_, dk) != (b, d) or v.shape != k.shape:
+        raise ValueError(f"shape mismatch: q={q.shape} k={k.shape} v={v.shape}")
+    if h % hk:
+        raise ValueError(f"GQA requires H % Hkv == 0, got {h} % {hk}")
+    if causal and seq_q != seq_kv:
+        raise ValueError(
+            "causal prefill requires Sq == Skv (decode uses decode_attention)"
+        )
+    sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
+    bq = clip_block(min(block_q, seq_q), seq_q)
+    bkv = clip_block(min(block_k, seq_kv), seq_kv)
+    fn = _build_flash_attention(
+        b, h, hk, seq_q, seq_kv, d, bq, bkv, bool(causal), sm_scale,
+        float(soft_cap), jnp.dtype(q.dtype),
+    )
+    out = fn(
+        q.reshape(b * h, seq_q, d),
+        k.reshape(b * hk, seq_kv, d),
+        v.reshape(b * hk, seq_kv, d),
+    )
+    return out.reshape(b, h, seq_q, d)
+
+
+# ---------------------------------------------------------------------------
+# split-KV decode
+
+
+def _decode_kernel(
+    bk: int,
+    sm_scale: float,
+    soft_cap: float,
+    kv_len_ref,  # (1, 1) int32 valid kv length                  [SMEM]
+    q_ref,    # (1, g, d)  VMEM — one kv-head's query group
+    k_ref,    # (1, sp, d) VMEM — this split's K slice
+    v_ref,    # (1, sp, d) VMEM
+    o_ref,    # (1, g, d)  partial numerator (unnormalized)
+    m_ref,    # (1, g, 128) f32 running max
+    l_ref,    # (1, g, 128) f32 denominator
+    acc_ref,  # (g, d) f32
+    m_s,      # (g, 128) f32 scratch
+    l_s,      # (g, 128) f32 scratch
+):
+    """One grid cell = (batch*kv_head, split): flash pass over the split's
+    KV slice producing the (m, l, acc) softmax state — the merge across
+    splits (and across ranks, in ``ops/flash_decode``) is associative
+    (reference split-KV stage ``flash_decode.py:130`` + combine ``:482``)."""
+    split = pl.program_id(1)
+    sp = k_ref.shape[1]
+    kv_len = kv_len_ref[0, 0]
+    m_s[...] = jnp.full_like(m_s, _NEG_INF)
+    l_s[...] = jnp.zeros_like(l_s)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (g, d)
+
+    def body(j, _):
+        k = k_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (g, bk)
+        if soft_cap:
+            s = jnp.tanh(s / soft_cap) * soft_cap
+        kpos = split * sp + j * bk + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(kpos < kv_len, s, _NEG_INF)
+        m_prev = m_s[:, :1]
+        m_cur = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        # fully-masked tile: m_cur is still _NEG_INF and exp(s - m_cur)
+        # would be exp(0)=1 per masked position, silently averaging V;
+        # force p to 0 so an empty split contributes l=0 (and an all-empty
+        # cache yields 0/0=nan rather than a plausible wrong value)
+        p = jnp.where(m_cur > _NEG_INF / 2, jnp.exp(s - m_cur), 0.0)
+        l_s[...] = l_s[...] * alpha + p.sum(axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_s[...] = jnp.broadcast_to(m_cur, m_s.shape)
+        return 0
+
+    jax.lax.fori_loop(0, sp // bk, body, 0)
+    # emit the state: numerator in o, statistics for the cross-split merge
+    o_ref[0, 0] = acc_ref[...].astype(o_ref.dtype)
+    m_ref[0, 0] = m_s[...]
+    l_ref[0, 0] = l_s[...]
+
+
+@functools.lru_cache(maxsize=None)
+def _build_decode(b, h, hk, seq_kv, d, n_split, bk, sm_scale, soft_cap, dtype):
+    group = h // hk
+    sp = seq_kv // n_split
+    kernel = functools.partial(_decode_kernel, bk, sm_scale, soft_cap)
+    call = pl.pallas_call(
+        kernel,
+        grid=(b * hk, n_split),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, group, d), lambda bh, s: (bh, 0, 0)),
+            pl.BlockSpec((1, sp, d), lambda bh, s: (bh, s, 0)),
+            pl.BlockSpec((1, sp, d), lambda bh, s: (bh, s, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, group, d), lambda bh, s: (bh, s, 0, 0)),
+            pl.BlockSpec((1, 1, group, 128), lambda bh, s: (bh, s, 0, 0)),
+            pl.BlockSpec((1, 1, group, 128), lambda bh, s: (bh, s, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * hk, n_split, group, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * hk, n_split, group, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b * hk, n_split, group, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+        ],
+        compiler_params=compilation.compiler_params(
+            collective=False,
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+    return jax.jit(call)
+
+
+def decode_attention_state(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_len: jax.Array | int,
+    *,
+    n_split: int = 1,
+    sm_scale: float | None = None,
+    soft_cap: float = 0.0,
+    block_k: int = 512,
+):
+    """Split-KV decode pass returning the mergeable softmax state.
+
+    ``q``: (B, H, D) single decode token; ``k``/``v``: (B, Hkv, Skv, D)
+    cache (positions >= ``kv_len`` masked).  Returns ``(num, m, l)`` with
+    ``num``: (B, H, n_split, D) unnormalized numerators, ``m``/``l``:
+    (B, H, n_split) statistics.  Merging over any set of states (splits or
+    ranks) with :func:`merge_decode_states` then dividing gives exact
+    attention — associativity is what the distributed flash-decode rides.
+    """
+    b, h, d = q.shape
+    bk_, hk, seq_kv, dk = k.shape
+    if (bk_, dk) != (b, d) or v.shape != k.shape:
+        raise ValueError(f"shape mismatch: q={q.shape} k={k.shape} v={v.shape}")
+    if h % hk:
+        raise ValueError(f"GQA requires H % Hkv == 0, got {h} % {hk}")
+    if seq_kv % n_split:
+        raise ValueError(f"Skv={seq_kv} not divisible by n_split={n_split}")
+    group = h // hk
+    sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
+    sp = seq_kv // n_split
+    bk = clip_block(min(block_k, sp), sp)
+    fn = _build_decode(
+        b, h, hk, seq_kv, d, n_split, bk, sm_scale, float(soft_cap),
+        jnp.dtype(q.dtype),
+    )
+    kv_len = jnp.full((1, 1), kv_len, jnp.int32)
+    num, m, l = fn(
+        kv_len,
+        q.reshape(b * hk, group, d),
+        k.reshape(b * hk, seq_kv, d),
+        v.reshape(b * hk, seq_kv, d),
+    )
+    num = num.reshape(b, hk, n_split, group, d).transpose(0, 1, 3, 2, 4)
+    m = m[..., 0].reshape(b, hk, n_split, group).transpose(0, 1, 3, 2)
+    l = l[..., 0].reshape(b, hk, n_split, group).transpose(0, 1, 3, 2)
+    return (
+        num.reshape(b, h, n_split, d),
+        m.reshape(b, h, n_split),
+        l.reshape(b, h, n_split),
+    )
+
+
+def merge_decode_states(num, m, l):
+    """Combine split-KV softmax states over the split axis (reference
+    inter-rank combine ``flash_decode.py:482``): rescale each partial
+    numerator and denominator by exp(m_i - m*) and sum.  ``num``:
+    (..., S, D); ``m``/``l``: (..., S).  Returns (num, m, l) with the split
+    axis reduced to size 1 — associative, so states may be merged in any
+    grouping (splits first, then ranks)."""
+    m_star = m.max(axis=-1, keepdims=True)            # (..., 1)
+    scale = jnp.exp(m - m_star)                       # (..., S)
+    num = (num * scale[..., None]).sum(axis=-2, keepdims=True)
+    l = (l * scale).sum(axis=-1, keepdims=True)
+    return num, m_star, l
+
+
+def decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_len: jax.Array | int,
+    *,
+    n_split: int = 1,
+    sm_scale: float | None = None,
+    soft_cap: float = 0.0,
+) -> jax.Array:
+    """Single-token decode attention over a (possibly padded) KV cache.
+
+    Thin entry over :func:`decode_attention_state` + merge + normalize;
+    returns (B, H, D).
+    """
+    num, m, l = decode_attention_state(
+        q, k, v, kv_len, n_split=n_split, sm_scale=sm_scale, soft_cap=soft_cap
+    )
+    num, _, l = merge_decode_states(num, m, l)
+    out = num[..., 0, :] / l[..., 0][..., None]
+    return out.astype(q.dtype)

@@ -1,0 +1,65 @@
+"""Rotary position embeddings (RoPE), rotate-half convention.
+
+The reference applies RoPE inside its attention layer with a dedicated
+Triton kernel (``python/triton_dist/layers/nvidia/tp_attn.py:78-150``).  On
+TPU a hand-written kernel would be a pessimization: RoPE is a pure
+elementwise+transpose pattern that XLA fuses directly into the surrounding
+attention matmuls, so the TPU-native form IS the jnp expression below
+(SURVEY.md section 7: "elementwise epilogues collapse into XLA fusion").
+
+Convention: GPT-NeoX / LLaMA / Qwen rotate-half — the head dim is split in
+two halves, rotated as complex pairs (x1, x2) -> (x1 cos - x2 sin,
+x2 cos + x1 sin).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_freqs(
+    positions: jax.Array,
+    head_dim: int,
+    *,
+    theta: float = 10_000.0,
+    dtype=jnp.float32,
+) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) tables of shape ``positions.shape + (head_dim // 2,)``."""
+    half = head_dim // 2
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(
+    x: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+) -> jax.Array:
+    """Rotate ``x`` (..., seq, head_dim) by tables (..., seq, head_dim//2).
+
+    Tables broadcast over leading axes, so one (seq, half) table serves a
+    (B, H, seq, D) activation.
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1f * cos - x2f * sin, x2f * cos + x1f * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def apply_rope_at(
+    x: jax.Array,
+    positions: jax.Array,
+    *,
+    theta: float = 10_000.0,
+) -> jax.Array:
+    """Convenience: rotate ``x`` (..., seq, head_dim) at absolute
+    ``positions`` (seq,) or broadcastable."""
+    cos, sin = rope_freqs(positions, x.shape[-1], theta=theta)
+    return apply_rope(x, cos, sin)

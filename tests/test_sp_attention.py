@@ -1,0 +1,102 @@
+"""Ring (sequence-parallel) attention vs single-device flash golden
+(reference ``test_sp_ag_attention`` strategy)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.core.mesh import SP_AXIS, make_mesh
+from triton_distributed_tpu.ops.attention import (
+    flash_attention,
+    flash_attention_chunk,
+    finalize_attention_state,
+    init_attention_state,
+)
+from triton_distributed_tpu.ops.sp_attention import sp_attention
+
+
+def _inputs(b, h, hk, s, d, key=0, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(jax.random.key(key), 3)
+    q = jax.random.normal(kq, (b, h, s, d), dtype)
+    k = jax.random.normal(kk, (b, hk, s, d), dtype)
+    v = jax.random.normal(kv, (b, hk, s, d), dtype)
+    return q, k, v
+
+
+def _mesh(n):
+    return make_mesh({SP_AXIS: n}, devices=jax.devices()[:n])
+
+
+def _shard(mesh, *xs):
+    spec = NamedSharding(mesh, P(None, None, SP_AXIS, None))
+    return tuple(jax.device_put(x, spec) for x in xs)
+
+
+def test_chunk_state_equals_full_attention():
+    """Folding KV chunks sequentially must reproduce one-shot flash."""
+    b, h, s, d, c = 1, 2, 256, 64, 4
+    q, k, v = _inputs(b, h, h, s, d)
+    sc = s // c
+    state = init_attention_state(b, h, s, d)
+    for j in range(c):
+        state = flash_attention_chunk(
+            q, k[:, :, j * sc:(j + 1) * sc], v[:, :, j * sc:(j + 1) * sc],
+            state, q_offset=0, kv_offset=j * sc,
+            causal=True, block_q=64, block_k=64,
+        )
+    got = finalize_attention_state(state, q.dtype)
+    want = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    assert jnp.allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+@pytest.mark.parametrize("causal", [True, False])
+def test_sp_attention_matches_flash(n, causal):
+    b, h, s, d = 1, 4, 512, 64
+    q, k, v = _inputs(b, h, h, s, d, key=1)
+    mesh = _mesh(n)
+    qs, ks, vs = _shard(mesh, q, k, v)
+    out = sp_attention(qs, ks, vs, mesh, causal=causal,
+                       block_q=128, block_k=128)
+    want = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+    assert out.shape == q.shape
+    assert jnp.allclose(jax.device_get(out), want, atol=2e-5, rtol=2e-5), (
+        jnp.abs(jax.device_get(out) - want).max()
+    )
+
+
+def test_sp_attention_gqa():
+    n, b, h, hk, s, d = 4, 1, 8, 2, 512, 64
+    q, k, v = _inputs(b, h, hk, s, d, key=2)
+    mesh = _mesh(n)
+    spec_q = NamedSharding(mesh, P(None, None, SP_AXIS, None))
+    qs = jax.device_put(q, spec_q)
+    ks, vs = _shard(mesh, k, v)
+    out = sp_attention(qs, ks, vs, mesh, causal=True,
+                       block_q=128, block_k=128)
+    want = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    assert jnp.allclose(jax.device_get(out), want, atol=2e-5, rtol=2e-5)
+
+
+def test_sp_attention_bf16():
+    n, b, h, s, d = 4, 1, 4, 512, 128
+    q, k, v = _inputs(b, h, h, s, d, key=3, dtype=jnp.bfloat16)
+    mesh = _mesh(n)
+    qs, ks, vs = _shard(mesh, q, k, v)
+    out = sp_attention(qs, ks, vs, mesh, causal=True)
+    want = flash_attention(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    assert jnp.allclose(
+        jax.device_get(out).astype(jnp.float32),
+        want.astype(jnp.float32), atol=5e-2, rtol=5e-2,
+    )
+
+
+def test_sp_attention_single_rank_fallback():
+    b, h, s, d = 1, 2, 256, 64
+    q, k, v = _inputs(b, h, h, s, d, key=4)
+    mesh = _mesh(1)
+    out = sp_attention(q, k, v, mesh, causal=True, block_q=128, block_k=128)
+    want = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    assert jnp.allclose(out, want, atol=0, rtol=0)

@@ -6,9 +6,14 @@ from .ag_gemm import AgGemmConfig, ag_gemm
 from .attention import (
     decode_attention,
     decode_attention_state,
+    finalize_attention_state,
     flash_attention,
+    flash_attention_chunk,
+    init_attention_state,
     merge_decode_states,
 )
+from .flash_decode import sp_flash_decode
 from .gemm_ar import GemmArConfig, gemm_ar
 from .gemm_rs import GemmRsConfig, gemm_rs
 from .rope import apply_rope, apply_rope_at, rope_freqs
+from .sp_attention import sp_attention

@@ -18,9 +18,10 @@ TPU design notes:
   division), not a data relayout like the reference's BLOCK_H head packing
   (``flash_decode.py:130``): Mosaic prefetches the right kv slice per grid
   cell and replication never materializes.
-- Softmax statistics are carried in f32 VMEM scratch across kv blocks; the
-  causal variant bounds the kv loop at the diagonal block (a traced
-  ``fori_loop`` bound, not a mask over the full sequence).
+- Softmax statistics are carried as f32 ``fori_loop`` values across kv
+  tiles (one shared tile body, ``_tile_update``, serves prefill, chunked,
+  and decode kernels); the causal variants bound the kv loop at the
+  diagonal block (a traced loop bound, not a mask over the full sequence).
 - ``soft_cap`` (tanh logit capping, reference ``flash_decode.py:161``) is
   applied inside the tile loop when set.
 """
@@ -40,6 +41,40 @@ from ..core.utils import clip_block
 _NEG_INF = -1e30
 
 
+def _init_carry(bq: int, d: int):
+    """Fresh online-softmax loop carry: (m, l, acc) as f32 values."""
+    return (
+        jnp.full((bq, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((bq, 1), jnp.float32),
+        jnp.zeros((bq, d), jnp.float32),
+    )
+
+
+def _tile_update(q, k, v, mask, soft_cap, carry):
+    """One online-softmax tile step, shared by every attention kernel here.
+
+    ``q``: (bq, d) f32 pre-scaled queries; ``k``/``v``: (bk, d) tile;
+    ``mask``: (bq, bk) bool (True = keep) or None; ``carry``: (m, l, acc)
+    from :func:`_init_carry`.  A fully-masked row keeps p = 0 so it
+    contributes a zero denominator instead of silently averaging V.
+    """
+    m_prev, l_prev, acc = carry
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (bq, bk)
+    if soft_cap:
+        s = jnp.tanh(s / soft_cap) * soft_cap
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m_cur = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_cur)
+    p = jnp.where(m_cur > _NEG_INF / 2, jnp.exp(s - m_cur), 0.0)
+    l_cur = l_prev * alpha + p.sum(axis=1, keepdims=True)
+    acc = acc * alpha + jax.lax.dot(p, v, preferred_element_type=jnp.float32)
+    return m_cur, l_cur, acc
+
+
 def _attn_kernel(
     seq_kv: int,
     bq: int,
@@ -51,49 +86,29 @@ def _attn_kernel(
     k_ref,    # (1, seq_kv, d) VMEM
     v_ref,    # (1, seq_kv, d) VMEM
     o_ref,    # (1, bq, d)    VMEM
-    m_ref,    # (bq, 128) f32 running max        [VMEM scratch]
-    l_ref,    # (bq, 128) f32 running denominator [VMEM scratch]
-    acc_ref,  # (bq, d) f32 output accumulator    [VMEM scratch]
 ):
     iq = pl.program_id(1)
-    m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
-    l_ref[...] = jnp.zeros_like(l_ref)
-    acc_ref[...] = jnp.zeros_like(acc_ref)
-
+    d = q_ref.shape[-1]
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (bq, d)
 
-    def body(j, _):
+    def body(j, carry):
         k = k_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)    # (bk, d)
         v = v_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)    # (bk, d)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (bq, bk)
-        if soft_cap:
-            s = jnp.tanh(s / soft_cap) * soft_cap
+        mask = None
         if causal:
             # rows are absolute q positions, cols absolute kv positions
             qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
-        m_prev = m_ref[:, :1]                                   # (bq, 1)
-        m_cur = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur)                                  # (bq, bk)
-        l_ref[...] = l_ref[...] * alpha + p.sum(axis=1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32
-        )
-        m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
-        return 0
+            mask = qpos >= kpos
+        return _tile_update(q, k, v, mask, soft_cap, carry)
 
     if causal:
         # kv blocks at or left of this q-block's diagonal
         nkv = (iq * bq + bq + bk - 1) // bk
     else:
         nkv = seq_kv // bk
-    jax.lax.fori_loop(0, nkv, body, 0)
-    o_ref[0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+    _, l, acc = jax.lax.fori_loop(0, nkv, body, _init_carry(bq, d))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
 
 
 @functools.lru_cache(maxsize=None)
@@ -121,11 +136,6 @@ def _build_flash_attention(
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, iq: (bh, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, seq_q, d), dtype),
-        scratch_shapes=[
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, d), jnp.float32),
-        ],
         compiler_params=compilation.compiler_params(
             collective=False,
             dimension_semantics=("parallel", "arbitrary"),
@@ -180,6 +190,179 @@ def flash_attention(
 
 
 # ---------------------------------------------------------------------------
+# chunked prefill with carried softmax state (the ring-attention step)
+
+
+def _attn_chunk_kernel(
+    seq_c: int,
+    bq: int,
+    bk: int,
+    causal: bool,
+    sm_scale: float,
+    soft_cap: float,
+    off_ref,   # (2,) int32 [q_off, kv_off] absolute offsets     [SMEM]
+    q_ref,     # (1, bq, d)     VMEM
+    k_ref,     # (1, seq_c, d)  VMEM — this chunk's K
+    v_ref,     # (1, seq_c, d)  VMEM
+    m_in,      # (1, bq)  f32 carried max
+    l_in,      # (1, bq)  f32 carried denominator
+    acc_in,    # (1, bq, d) f32 carried numerator
+    m_out,
+    l_out,
+    acc_out,
+):
+    """One online-softmax pass of a KV *chunk* against a q block, reading and
+    writing the carried (m, l, acc) state — the consumer step of ring/SP
+    attention (reference ``sp_ag_attention_intra_node.py:256``: consumer
+    causal flash-attn over per-chunk arrivals).  Causality is enforced in
+    ABSOLUTE positions via the scalar offsets, so the same kernel serves
+    every (rank, ring-step) pair; chunks entirely in the future contribute
+    zero blocks (the kv loop bound clamps to 0) and the state passes
+    through."""
+    iq = pl.program_id(1)
+    q_off, kv_off = off_ref[0], off_ref[1]
+    q = q_ref[0].astype(jnp.float32) * sm_scale        # (bq, d)
+    m0 = m_in[0][:, None]                              # (bq, 1)
+    l0 = l_in[0][:, None]
+    acc0 = acc_in[0]                                   # (bq, d)
+
+    def body(j, carry):
+        k = k_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)
+        mask = None
+        if causal:
+            qpos = q_off + iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], bk), 0
+            )
+            kpos = kv_off + j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], bk), 1
+            )
+            mask = qpos >= kpos
+        return _tile_update(q, k, v, mask, soft_cap, carry)
+
+    if causal:
+        # kv blocks whose first position is <= this q block's last position
+        q_max = q_off + iq * bq + bq - 1
+        nkv = jnp.clip((q_max - kv_off) // bk + 1, 0, seq_c // bk)
+    else:
+        nkv = seq_c // bk
+    m1, l1, acc1 = jax.lax.fori_loop(0, nkv, body, (m0, l0, acc0))
+    m_out[0] = m1[:, 0]
+    l_out[0] = l1[:, 0]
+    acc_out[0] = acc1
+
+
+@functools.lru_cache(maxsize=None)
+def _build_attn_chunk(b, h, hk, seq_q, seq_c, d, bq, bk, causal, sm_scale,
+                      soft_cap):
+    group = h // hk
+    kernel = functools.partial(
+        _attn_chunk_kernel, seq_c, bq, bk, causal, sm_scale, soft_cap
+    )
+    kv_spec = pl.BlockSpec(
+        (1, seq_c, d),
+        lambda bh, iq: ((bh // h) * hk + (bh % h) // group, 0, 0),
+    )
+    state2_spec = pl.BlockSpec((1, bq), lambda bh, iq: (bh, iq))
+    state3_spec = pl.BlockSpec((1, bq, d), lambda bh, iq: (bh, iq, 0))
+    call = pl.pallas_call(
+        kernel,
+        grid=(b * h, seq_q // bq),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, d), lambda bh, iq: (bh, iq, 0)),
+            kv_spec,
+            kv_spec,
+            state2_spec,
+            state2_spec,
+            state3_spec,
+        ],
+        out_specs=[state2_spec, state2_spec, state3_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, seq_q, d), jnp.float32),
+        ],
+        compiler_params=compilation.compiler_params(
+            collective=False,
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+    return jax.jit(call)
+
+
+def init_attention_state(b: int, h: int, seq_q: int, d: int):
+    """Fresh (m, l, acc) carried state for :func:`flash_attention_chunk`."""
+    return (
+        jnp.full((b, h, seq_q), _NEG_INF, jnp.float32),
+        jnp.zeros((b, h, seq_q), jnp.float32),
+        jnp.zeros((b, h, seq_q, d), jnp.float32),
+    )
+
+
+def flash_attention_chunk(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    state,
+    q_offset: jax.Array | int,
+    kv_offset: jax.Array | int,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    soft_cap: float = 0.0,
+    block_q: int = 512,
+    block_k: int = 512,
+):
+    """Fold one KV chunk into a carried attention state.
+
+    ``q``: (B, H, Sq, D) at absolute positions ``q_offset + [0, Sq)``;
+    ``k``/``v``: (B, Hkv, Sc, D) chunk at ``kv_offset + [0, Sc)``;
+    ``state``: from :func:`init_attention_state` or a previous call.
+    Returns the updated state; normalize with
+    :func:`finalize_attention_state` after the last chunk.
+    """
+    b, h, seq_q, d = q.shape
+    bk_, hk, seq_c, dk = k.shape
+    if (bk_, dk) != (b, d) or v.shape != k.shape:
+        raise ValueError(f"shape mismatch: q={q.shape} k={k.shape} v={v.shape}")
+    if h % hk:
+        raise ValueError(f"GQA requires H % Hkv == 0, got {h} % {hk}")
+    sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
+    bq = clip_block(min(block_q, seq_q), seq_q)
+    bk = clip_block(min(block_k, seq_c), seq_c)
+    fn = _build_attn_chunk(
+        b, h, hk, seq_q, seq_c, d, bq, bk, bool(causal), sm_scale,
+        float(soft_cap),
+    )
+    m, l, acc = state
+    offs = jnp.stack([
+        jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)
+    ])
+    m1, l1, acc1 = fn(
+        offs,
+        q.reshape(b * h, seq_q, d),
+        k.reshape(b * hk, seq_c, d),
+        v.reshape(b * hk, seq_c, d),
+        m.reshape(b * h, seq_q),
+        l.reshape(b * h, seq_q),
+        acc.reshape(b * h, seq_q, d),
+    )
+    return (
+        m1.reshape(b, h, seq_q),
+        l1.reshape(b, h, seq_q),
+        acc1.reshape(b, h, seq_q, d),
+    )
+
+
+def finalize_attention_state(state, dtype) -> jax.Array:
+    """Normalize a carried state into the attention output (B, H, Sq, D)."""
+    m, l, acc = state
+    return (acc / l[..., None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
 # split-KV decode
 
 
@@ -191,12 +374,9 @@ def _decode_kernel(
     q_ref,    # (1, g, d)  VMEM — one kv-head's query group
     k_ref,    # (1, sp, d) VMEM — this split's K slice
     v_ref,    # (1, sp, d) VMEM
-    o_ref,    # (1, g, d)  partial numerator (unnormalized)
-    m_ref,    # (1, g, 128) f32 running max
-    l_ref,    # (1, g, 128) f32 denominator
-    acc_ref,  # (g, d) f32
-    m_s,      # (g, 128) f32 scratch
-    l_s,      # (g, 128) f32 scratch
+    o_ref,    # (1, 1, g, d)   partial numerator (unnormalized)
+    m_ref,    # (1, 1, g, 128) f32 running max
+    l_ref,    # (1, 1, g, 128) f32 denominator
 ):
     """One grid cell = (batch*kv_head, split): flash pass over the split's
     KV slice producing the (m, l, acc) softmax state — the merge across
@@ -204,45 +384,25 @@ def _decode_kernel(
     (reference split-KV stage ``flash_decode.py:130`` + combine ``:482``)."""
     split = pl.program_id(1)
     sp = k_ref.shape[1]
+    g, d = q_ref.shape[1], q_ref.shape[2]
     kv_len = kv_len_ref[0, 0]
-    m_s[...] = jnp.full_like(m_s, _NEG_INF)
-    l_s[...] = jnp.zeros_like(l_s)
-    acc_ref[...] = jnp.zeros_like(acc_ref)
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (g, d)
 
-    def body(j, _):
+    def body(j, carry):
         k = k_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)
         v = v_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (g, bk)
-        if soft_cap:
-            s = jnp.tanh(s / soft_cap) * soft_cap
         kpos = split * sp + j * bk + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1
+            jnp.int32, (g, bk), 1
         )
-        s = jnp.where(kpos < kv_len, s, _NEG_INF)
-        m_prev = m_s[:, :1]
-        m_cur = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_cur)
-        # fully-masked tile: m_cur is still _NEG_INF and exp(s - m_cur)
-        # would be exp(0)=1 per masked position, silently averaging V;
-        # force p to 0 so an empty split contributes l=0 (and an all-empty
-        # cache yields 0/0=nan rather than a plausible wrong value)
-        p = jnp.where(m_cur > _NEG_INF / 2, jnp.exp(s - m_cur), 0.0)
-        l_s[...] = l_s[...] * alpha + p.sum(axis=1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32
-        )
-        m_s[...] = jnp.broadcast_to(m_cur, m_s.shape)
-        return 0
+        # an entirely masked split contributes l=0 and drops out of the
+        # merge (see _tile_update's guard)
+        return _tile_update(q, k, v, kpos < kv_len, soft_cap, carry)
 
-    jax.lax.fori_loop(0, sp // bk, body, 0)
+    m1, l1, acc1 = jax.lax.fori_loop(0, sp // bk, body, _init_carry(g, d))
     # emit the state: numerator in o, statistics for the cross-split merge
-    o_ref[0, 0] = acc_ref[...].astype(o_ref.dtype)
-    m_ref[0, 0] = m_s[...]
-    l_ref[0, 0] = l_s[...]
+    o_ref[0, 0] = acc1.astype(o_ref.dtype)
+    m_ref[0, 0] = jnp.broadcast_to(m1, (g, 128))
+    l_ref[0, 0] = jnp.broadcast_to(l1, (g, 128))
 
 
 @functools.lru_cache(maxsize=None)
@@ -268,11 +428,6 @@ def _build_decode(b, h, hk, seq_kv, d, n_split, bk, sm_scale, soft_cap, dtype):
             jax.ShapeDtypeStruct((b * hk, n_split, group, d), jnp.float32),
             jax.ShapeDtypeStruct((b * hk, n_split, group, 128), jnp.float32),
             jax.ShapeDtypeStruct((b * hk, n_split, group, 128), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((group, d), jnp.float32),
-            pltpu.VMEM((group, 128), jnp.float32),
-            pltpu.VMEM((group, 128), jnp.float32),
         ],
         compiler_params=compilation.compiler_params(
             collective=False,

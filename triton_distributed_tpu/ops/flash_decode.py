@@ -1,0 +1,128 @@
+"""Distributed (sequence-parallel) flash-decode.
+
+Reference: ``python/triton_dist/kernels/nvidia/flash_decode.py`` — per-rank
+GQA split-KV decode stage (``:130``), inter-rank softmax-state combine
+(``kernel_inter_rank_flash_decode:482``), consumed by
+``layers/nvidia/sp_flash_decode_layer.py:44``.  Each rank owns a slice of
+the KV cache along the sequence axis, computes partial attention over its
+slice, and the partials are combined exactly via the associative
+(numerator, max, denominator) merge.
+
+TPU design split:
+
+- the heavy, bandwidth-bound work — streaming the local KV slice — is the
+  Pallas split-KV kernel (``ops/attention.decode_attention_state``);
+- the cross-rank combine exchanges only the tiny state pytree
+  ((B, H, D) numerator + two (B, H) scalars per rank, a few KB), which is
+  latency-bound: that is XLA-collective territory (``lax.all_gather`` over
+  the mesh axis), not hand-rolled DMA — the reference needs a custom
+  inter-rank kernel only because NVSHMEM symmetric staging is its one
+  cross-GPU path (SURVEY.md section 7).
+
+Ranks whose slice is entirely beyond ``kv_len`` contribute a zero
+denominator and drop out of the merge (see the masked-tile guard in
+``_decode_kernel``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core import compilation
+from ..core.mesh import SP_AXIS
+from .attention import (
+    decode_attention,
+    decode_attention_state,
+    merge_decode_states,
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sp_flash_decode(
+    mesh: Mesh,
+    axis: str,
+    shapes_key,   # (b, h, hk, s_loc, d, n_split, sm_scale, soft_cap, dtype)
+):
+    b, h, hk, s_loc, d, n_split, sm_scale, soft_cap, dtype = shapes_key
+
+    def local_fn(q, k_loc, v_loc, kv_len):
+        r = jax.lax.axis_index(axis)
+        # this rank covers absolute kv positions [r*s_loc, (r+1)*s_loc)
+        len_loc = jnp.clip(kv_len[0] - r * s_loc, 0, s_loc)
+        num, m, l = decode_attention_state(
+            q, k_loc, v_loc, len_loc,
+            n_split=n_split, sm_scale=sm_scale, soft_cap=soft_cap,
+        )
+        num, m, l = merge_decode_states(num, m, l)     # splits -> one state
+        # tiny state exchange: (n, B, H, D) + 2x (n, B, H)
+        nums = jax.lax.all_gather(num[..., 0, :], axis)
+        ms = jax.lax.all_gather(m[..., 0], axis)
+        ls = jax.lax.all_gather(l[..., 0], axis)
+        num, _, l = merge_decode_states(
+            jnp.moveaxis(nums, 0, -2), jnp.moveaxis(ms, 0, -1),
+            jnp.moveaxis(ls, 0, -1),
+        )
+        out = num[..., 0, :] / l[..., 0][..., None]
+        return out.astype(dtype)
+
+    return compilation.jit_shard_map(
+        local_fn, mesh,
+        in_specs=(
+            P(None, None, None),        # q replicated
+            P(None, None, axis, None),  # K cache: sequence-sharded
+            P(None, None, axis, None),  # V cache
+            P(None),                    # kv_len replicated
+        ),
+        out_specs=P(None, None, None),
+    )
+
+
+def sp_flash_decode(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_len: jax.Array | int,
+    mesh: Mesh,
+    axis: str = SP_AXIS,
+    *,
+    n_split: int = 1,
+    sm_scale: float | None = None,
+    soft_cap: float = 0.0,
+) -> jax.Array:
+    """Decode attention over a sequence-sharded KV cache (reference host
+    entry ``flash_decode.py:537-587`` + ``sp_flash_decode_layer.py:44``).
+
+    ``q``: (B, H, D) replicated decode token; ``k``/``v``: (B, Hkv, S, D)
+    global cache sharded on the sequence dim over ``axis``; ``kv_len``: the
+    GLOBAL number of valid cache positions.  Returns (B, H, D) replicated.
+    Golden: full-cache ``decode_attention`` on one device.
+    """
+    n = mesh.shape[axis]
+    b, h, d = q.shape
+    _, hk, s_tot, _ = k.shape
+    if v.shape != k.shape:
+        raise ValueError(f"shape mismatch: k={k.shape} v={v.shape}")
+    if n == 1:
+        return decode_attention(
+            q, k, v, kv_len, n_split=n_split, sm_scale=sm_scale,
+            soft_cap=soft_cap,
+        )
+    if s_tot % n:
+        raise ValueError(f"cache seq {s_tot} not divisible by {axis}={n}")
+    s_loc = s_tot // n
+    if n_split > 1 and s_loc % n_split:
+        raise ValueError(
+            f"local cache {s_loc} not divisible by n_split={n_split}"
+        )
+    sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
+    fn = _build_sp_flash_decode(
+        mesh, axis,
+        (b, h, hk, s_loc, d, n_split, sm_scale, float(soft_cap),
+         jnp.dtype(q.dtype)),
+    )
+    kv_len = jnp.asarray(kv_len, jnp.int32).reshape(1)
+    return fn(q, k, v, kv_len)

@@ -1,0 +1,139 @@
+"""Sequence-parallel (ring) attention for long-context prefill.
+
+Reference: ``python/triton_dist/kernels/nvidia/sp_ag_attention_intra_node.py``
+— producer copy-engine AllGather of per-rank KV chunks (``:105``) feeding a
+consumer causal flash-attention that waits on per-chunk arrival signals
+(``:256``); host entry ``:430-521``.
+
+TPU design — ring attention over ICI instead of AG-into-workspace:
+
+- every rank holds the (Sq/n) query rows and (S/n) KV rows of its sequence
+  shard; KV chunks rotate around the ring via ``lax.ppermute`` while each
+  station folds the resident chunk into its carried online-softmax state
+  with the Pallas chunk kernel (``ops/attention.flash_attention_chunk``);
+- overlap comes from XLA's async collective-permute: the rotation of chunk
+  s+1 and the flash pass over chunk s both depend only on chunk s, so the
+  scheduler runs wire and MXU concurrently — the role the reference's
+  producer/consumer split plays on CUDA (SURVEY.md section 7: "XLA
+  schedules what the reference hand-stages");
+- the n-step rotation moves each chunk over every link once
+  (bandwidth-optimal, like the reference's full AG) but peak memory stays
+  at ONE extra chunk instead of the whole gathered sequence — the property
+  that makes million-token contexts shardable at all;
+- causality is enforced in absolute positions inside the chunk kernel, so
+  future chunks cost zero flash work (the kv loop clamps to 0 blocks) yet
+  keep rotating for the ranks that need them.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core import compilation
+from ..core.mesh import SP_AXIS
+from .attention import (
+    finalize_attention_state,
+    flash_attention,
+    flash_attention_chunk,
+    init_attention_state,
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sp_attention(mesh: Mesh, axis: str, shapes_key):
+    (b, h, hk, s_loc, d, causal, sm_scale, soft_cap, bq, bk, dtype) = shapes_key
+    n = mesh.shape[axis]
+
+    def local_fn(q_loc, k_loc, v_loc):
+        r = jax.lax.axis_index(axis)
+
+        def fold(state, k_c, v_c, s):
+            # chunk resident after s rotations came from rank (r - s) mod n
+            src = jax.lax.rem(r - s + n, n)
+            return flash_attention_chunk(
+                q_loc, k_c, v_c, state,
+                q_offset=r * s_loc, kv_offset=src * s_loc,
+                causal=causal, sm_scale=sm_scale, soft_cap=soft_cap,
+                block_q=bq, block_k=bk,
+            )
+
+        # own chunk first, then n-1 rotate-and-fold steps (no final wasted
+        # rotation)
+        state0 = fold(init_attention_state(b, h, s_loc, d), k_loc, v_loc, 0)
+
+        def step(carry, s):
+            k_c, v_c, state = carry
+            # the incoming rotation for step s and the fold of step s-1
+            # both hang off step s-1's chunk — XLA overlaps wire and MXU.
+            # (Interpret mode runs the permute rendezvous and the Pallas
+            # barriers on the same client thread pool; that is safe ONLY
+            # with spare virtual devices — see platform.force_cpu.)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            k_c = jax.lax.ppermute(k_c, axis, perm)
+            v_c = jax.lax.ppermute(v_c, axis, perm)
+            return (k_c, v_c, fold(state, k_c, v_c, s)), None
+
+        (k_f, v_f, state), _ = jax.lax.scan(
+            step, (k_loc, v_loc, state0), jnp.arange(1, n)
+        )
+        del k_f, v_f
+        return finalize_attention_state(state, dtype)
+
+    return compilation.jit_shard_map(
+        local_fn, mesh,
+        in_specs=(
+            P(None, None, axis, None),
+            P(None, None, axis, None),
+            P(None, None, axis, None),
+        ),
+        out_specs=P(None, None, axis, None),
+    )
+
+
+def sp_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = SP_AXIS,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    soft_cap: float = 0.0,
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jax.Array:
+    """Attention over a sequence-sharded (B, H, S, D) tensor set (reference
+    host entry ``sp_ag_attention_intra_node.py:430-521``).
+
+    ``q``: (B, H, S, D) and ``k``/``v``: (B, Hkv, S, D), all sharded on the
+    sequence dim over ``axis``.  Returns (B, H, S, D) with the same
+    sharding.  Golden: single-device ``flash_attention`` on the gathered
+    arrays.
+    """
+    n = mesh.shape[axis]
+    b, h, s_tot, d = q.shape
+    _, hk, sk, _ = k.shape
+    if v.shape != k.shape or sk != s_tot:
+        raise ValueError(f"shape mismatch: q={q.shape} k={k.shape} v={v.shape}")
+    if h % hk:
+        raise ValueError(f"GQA requires H % Hkv == 0, got {h} % {hk}")
+    if n == 1:
+        return flash_attention(
+            q, k, v, causal=causal, sm_scale=sm_scale, soft_cap=soft_cap,
+            block_q=block_q, block_k=block_k,
+        )
+    if s_tot % n:
+        raise ValueError(f"seq {s_tot} not divisible by {axis}={n}")
+    s_loc = s_tot // n
+    sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
+    fn = _build_sp_attention(
+        mesh, axis,
+        (b, h, hk, s_loc, d, bool(causal), sm_scale, float(soft_cap),
+         min(block_q, s_loc), min(block_k, s_loc), jnp.dtype(q.dtype)),
+    )
+    return fn(q, k, v)

@@ -1,0 +1,134 @@
+"""TP_MLP / TP_Attn layers vs dense (unsharded) goldens — the analogue of
+the reference's torch_fwd-vs-dist_triton_fwd layer tests
+(``layers/nvidia/tp_mlp.py`` ``torch_fwd``)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.core.mesh import TP_AXIS, make_mesh, shard
+from triton_distributed_tpu.layers import TPAttn, TPMLP, rms_norm
+from triton_distributed_tpu.ops.attention import flash_attention
+from triton_distributed_tpu.ops.rope import apply_rope_at
+
+
+def _mesh(n):
+    return make_mesh({TP_AXIS: n}, devices=jax.devices()[:n])
+
+
+def _mlp_golden(x, g, u, d):
+    h = jax.nn.silu(x @ g) * (x @ u)
+    return (h @ d).astype(x.dtype)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_tp_mlp_forward(n):
+    mesh = _mesh(n)
+    layer = TPMLP(mesh)
+    K, I, M = 128, 256, 16 * n * n  # M divisible by n (ag) and n*n (rs rows)
+    kx, kw = jax.random.split(jax.random.key(0))
+    g = jax.random.normal(kw, (K, I), jnp.float32) * 0.05
+    u = jax.random.normal(jax.random.fold_in(kw, 1), (K, I), jnp.float32) * 0.05
+    d = jax.random.normal(jax.random.fold_in(kw, 2), (I, K), jnp.float32) * 0.05
+    params = layer.shard_params(g, u, d)
+    x = jax.random.normal(kx, (M, K), jnp.float32) * 0.1
+    xs = jax.device_put(x, NamedSharding(mesh, P(TP_AXIS, None)))
+    out = layer.forward(params, xs)
+    assert out.shape == (M, K)
+    want = _mlp_golden(x, g, u, d)
+    assert jnp.allclose(jax.device_get(out), want, atol=2e-4, rtol=2e-4), (
+        jnp.abs(jax.device_get(out) - want).max()
+    )
+
+
+def test_tp_mlp_forward_ar(mesh8):
+    layer = TPMLP(mesh8)
+    K, I, M = 128, 256, 64
+    kx, kw = jax.random.split(jax.random.key(1))
+    g = jax.random.normal(kw, (K, I), jnp.float32) * 0.05
+    u = jax.random.normal(jax.random.fold_in(kw, 1), (K, I), jnp.float32) * 0.05
+    d = jax.random.normal(jax.random.fold_in(kw, 2), (I, K), jnp.float32) * 0.05
+    params = layer.shard_params(g, u, d)
+    x = jax.random.normal(kx, (M, K), jnp.float32) * 0.1
+    out = layer.forward_ar(params, x)
+    assert out.shape == (M, K)
+    want = _mlp_golden(x, g, u, d)
+    assert jnp.allclose(jax.device_get(out), want, atol=2e-4, rtol=2e-4)
+
+
+def test_tp_mlp_init_shapes(mesh8):
+    layer = TPMLP(mesh8)
+    params = layer.init(jax.random.key(2), hidden=128, intermediate=512)
+    assert params.gate_up.shape == (128, 1024)
+    assert params.down.shape == (512, 128)
+    assert params.gate_up.sharding.spec == P(None, TP_AXIS)
+
+
+def _attn_golden(x, wq, wk, wv, wo, h, hk, d, batch, theta,
+                 qk_eps=None):
+    m = x.shape[0]
+    seq = m // batch
+    q = (x @ wq).reshape(batch, seq, h, d).transpose(0, 2, 1, 3)
+    k = (x @ wk).reshape(batch, seq, hk, d).transpose(0, 2, 1, 3)
+    v = (x @ wv).reshape(batch, seq, hk, d).transpose(0, 2, 1, 3)
+    if qk_eps is not None:
+        q = rms_norm(q, jnp.ones((d,), q.dtype), qk_eps)
+        k = rms_norm(k, jnp.ones((d,), k.dtype), qk_eps)
+    pos = jnp.arange(seq)
+    q = apply_rope_at(q, pos, theta=theta)
+    k = apply_rope_at(k, pos, theta=theta)
+    o = flash_attention(q, k, v, causal=True)
+    o = o.transpose(0, 2, 1, 3).reshape(m, h * d)
+    return (o @ wo).astype(x.dtype)
+
+
+@pytest.mark.parametrize("n,h,hk", [(2, 4, 2), (4, 8, 4), (8, 8, 8)])
+def test_tp_attn_forward(n, h, hk):
+    mesh = _mesh(n)
+    K, d, batch = 128, 64, 1
+    layer = TPAttn(mesh, num_heads=h, num_kv_heads=hk, head_dim=d)
+    seq = 32 * n * n  # M=batch*seq divisible by n (ag) and n*n (rs rows)
+    kx, kw = jax.random.split(jax.random.key(3))
+    wq = jax.random.normal(kw, (K, h * d), jnp.float32) * 0.05
+    wk = jax.random.normal(jax.random.fold_in(kw, 1), (K, hk * d), jnp.float32) * 0.05
+    wv = jax.random.normal(jax.random.fold_in(kw, 2), (K, hk * d), jnp.float32) * 0.05
+    wo = jax.random.normal(jax.random.fold_in(kw, 3), (h * d, K), jnp.float32) * 0.05
+    params = layer.shard_params(wq, wk, wv, wo)
+    x = jax.random.normal(kx, (batch * seq, K), jnp.float32) * 0.1
+    xs = jax.device_put(x, NamedSharding(mesh, P(TP_AXIS, None)))
+    out = layer.forward(params, xs, batch=batch)
+    assert out.shape == x.shape
+    want = _attn_golden(x, wq, wk, wv, wo, h, hk, d, batch, layer.rope_theta)
+    assert jnp.allclose(jax.device_get(out), want, atol=2e-4, rtol=2e-4), (
+        jnp.abs(jax.device_get(out) - want).max()
+    )
+
+
+def test_tp_attn_forward_ar_with_qk_norm(mesh8):
+    n, K, d, batch = 8, 128, 64, 2
+    h = hk = 8
+    layer = TPAttn(mesh8, num_heads=h, num_kv_heads=hk, head_dim=d,
+                   qk_norm_eps=1e-6)
+    seq = 32
+    kx, kw = jax.random.split(jax.random.key(4))
+    wq = jax.random.normal(kw, (K, h * d), jnp.float32) * 0.05
+    wk = jax.random.normal(jax.random.fold_in(kw, 1), (K, hk * d), jnp.float32) * 0.05
+    wv = jax.random.normal(jax.random.fold_in(kw, 2), (K, hk * d), jnp.float32) * 0.05
+    wo = jax.random.normal(jax.random.fold_in(kw, 3), (h * d, K), jnp.float32) * 0.05
+    params = layer.shard_params(wq, wk, wv, wo,
+                                jnp.ones((d,), jnp.float32),
+                                jnp.ones((d,), jnp.float32))
+    x = jax.random.normal(kx, (batch * seq, K), jnp.float32) * 0.1
+    out = layer.forward_ar(params, x, batch=batch)
+    want = _attn_golden(x, wq, wk, wv, wo, h, hk, d, batch, layer.rope_theta,
+                        qk_eps=1e-6)
+    assert jnp.allclose(jax.device_get(out), want, atol=2e-4, rtol=2e-4)
+
+
+def test_rms_norm_golden():
+    x = jax.random.normal(jax.random.key(5), (4, 64), jnp.float32)
+    w = jax.random.normal(jax.random.key(6), (64,), jnp.float32)
+    got = rms_norm(x, w, eps=1e-6)
+    want = x / jnp.sqrt((x * x).mean(-1, keepdims=True) + 1e-6) * w
+    assert jnp.allclose(got, want, atol=1e-5, rtol=1e-5)

@@ -35,3 +35,4 @@ from .core.platform import (
 from .core.mesh import make_mesh, tp_mesh, TP_AXIS, EP_AXIS, SP_AXIS, DP_AXIS, PP_AXIS
 from .core.utils import assert_allclose, dist_print, perf_func, rand_tensor
 from .core.symm import symm_buffer, symm_signal, SymmetricBuffer
+from .layers import TPAttn, TPAttnParams, TPMLP, TPMLPParams, rms_norm

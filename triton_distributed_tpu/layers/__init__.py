@@ -1,0 +1,7 @@
+"""TP/EP/SP layers as functional pytree modules (reference:
+``python/triton_dist/layers/nvidia/`` — TP_MLP, TP_Attn, EP A2A,
+SP flash-decode, low-latency AG layers)."""
+
+from .norm import rms_norm
+from .tp_attn import TPAttn, TPAttnParams
+from .tp_mlp import TPMLP, TPMLPParams, fuse_column_shards

@@ -1,0 +1,24 @@
+"""Normalization layers (RMSNorm) as jnp expressions.
+
+The reference carries a Triton ``layer_norm`` kernel for the QK-norm path
+(``python/triton_dist/layers/nvidia/tp_attn.py:219-226``); on TPU a
+reduction+elementwise chain is exactly what XLA fuses into neighbouring
+matmuls, so the native form is the expression below (SURVEY.md section 7).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array | None = None,
+             eps: float = 1e-6) -> jax.Array:
+    """RMSNorm over the last axis, computed in f32 (Qwen/LLaMA convention:
+    the scale multiplies the normalized value in f32, result cast back)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    return out.astype(x.dtype)

@@ -1,0 +1,162 @@
+"""Tensor-parallel attention (QKV column-parallel over heads, O row-parallel).
+
+Reference: ``python/triton_dist/layers/nvidia/tp_attn.py:78-274`` — fused
+wqkv per rank ([q_r | k_r | v_r], ``:99-104``), ``dist_triton_fwd`` =
+AG-GEMM -> QK-norm -> RoPE -> flash-attn -> GEMM-RS (``:203-237``),
+``dist_triton_AR_fwd`` = local GEMM -> attention -> GEMM+AllReduce
+(``:239-273``).
+
+TPU design mirrors ``layers/tp_mlp.py``: the two fused collective GEMMs
+bracket a per-rank block (QKV split, optional QK RMSNorm, RoPE, local
+flash-attention over this rank's heads) that runs under ``shard_map`` —
+head-parallelism means attention never needs communication, exactly the
+property the reference exploits.
+
+Prefill only; the decode path (KV cache append + ``decode_attention``)
+lives in ``models/`` where the cache is owned.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.mesh import TP_AXIS
+from ..ops import ag_gemm, gemm_ar, gemm_rs
+from ..ops.attention import flash_attention
+from ..ops.rope import apply_rope_at
+from .norm import rms_norm
+from .tp_mlp import fuse_column_shards, replicated_column_gemm
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TPAttnParams:
+    """wqkv: (K, (H + 2*Hkv) * D) rank-blocked [q_r | k_r | v_r];
+    wo: (H*D, K) row-sharded; q_norm/k_norm: (D,) or None."""
+
+    wqkv: jax.Array
+    wo: jax.Array
+    q_norm: jax.Array | None
+    k_norm: jax.Array | None
+
+
+@dataclasses.dataclass(frozen=True)
+class TPAttn:
+    mesh: Mesh
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    axis: str = TP_AXIS
+    rope_theta: float = 10_000.0
+    qk_norm_eps: float | None = None   # set to enable Qwen3-style QK norm
+
+    @property
+    def tp(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def __post_init__(self):
+        n = self.tp
+        if self.num_heads % n or self.num_kv_heads % n:
+            raise ValueError(
+                f"heads ({self.num_heads}, kv {self.num_kv_heads}) must be "
+                f"divisible by {self.axis}={n}"
+            )
+
+    # -- parameter construction ------------------------------------------
+
+    def shard_params(self, wq, wk, wv, wo, q_norm=None, k_norm=None
+                     ) -> TPAttnParams:
+        """Full weights: wq (K, H*D), wk/wv (K, Hkv*D), wo (H*D, K)."""
+        n = self.tp
+        wqkv = fuse_column_shards([wq, wk, wv], n)
+        return TPAttnParams(
+            wqkv=jax.device_put(
+                wqkv, NamedSharding(self.mesh, P(None, self.axis))
+            ),
+            wo=jax.device_put(
+                wo, NamedSharding(self.mesh, P(self.axis, None))
+            ),
+            q_norm=q_norm, k_norm=k_norm,
+        )
+
+    def init(self, key: jax.Array, hidden: int, dtype=jnp.bfloat16,
+             scale: float = 0.02) -> TPAttnParams:
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        h, hk, d = self.num_heads, self.num_kv_heads, self.head_dim
+        wq = jax.random.normal(kq, (hidden, h * d), dtype) * scale
+        wk = jax.random.normal(kk, (hidden, hk * d), dtype) * scale
+        wv = jax.random.normal(kv, (hidden, hk * d), dtype) * scale
+        wo = jax.random.normal(ko, (h * d, hidden), dtype) * scale
+        qn = kn = None
+        if self.qk_norm_eps is not None:
+            qn = jnp.ones((d,), dtype)
+            kn = jnp.ones((d,), dtype)
+        return self.shard_params(wq, wk, wv, wo, qn, kn)
+
+    # -- forward ----------------------------------------------------------
+
+    def _local_attention(self, qkv, q_norm, k_norm, batch: int, seq: int):
+        """Per-rank: split rank-local [q_r | k_r | v_r] columns, QK-norm,
+        RoPE, causal flash-attention over this rank's heads."""
+        n = self.tp
+        h_loc = self.num_heads // n
+        hk_loc = self.num_kv_heads // n
+        d = self.head_dim
+
+        def local(qkv_loc):
+            q, k, v = jnp.split(
+                qkv_loc, [h_loc * d, (h_loc + hk_loc) * d], axis=-1
+            )
+            # (M, h*d) -> (B, heads, S, d)
+            def to_heads(x, nh):
+                return x.reshape(batch, seq, nh, d).transpose(0, 2, 1, 3)
+
+            q, k, v = to_heads(q, h_loc), to_heads(k, hk_loc), to_heads(v, hk_loc)
+            if self.qk_norm_eps is not None:
+                q = rms_norm(q, q_norm, self.qk_norm_eps)
+                k = rms_norm(k, k_norm, self.qk_norm_eps)
+            pos = jnp.arange(seq)
+            q = apply_rope_at(q, pos, theta=self.rope_theta)
+            k = apply_rope_at(k, pos, theta=self.rope_theta)
+            out = flash_attention(q, k, v, causal=True)
+            return out.transpose(0, 2, 1, 3).reshape(batch * seq, h_loc * d)
+
+        # check_vma off: the Pallas flash kernel's outputs carry no vma
+        return jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=P(None, self.axis), out_specs=P(None, self.axis),
+            check_vma=False,
+        )(qkv)
+
+    def forward(self, params: TPAttnParams, x: jax.Array,
+                batch: int = 1) -> jax.Array:
+        """AG-GEMM -> local attention -> GEMM-RS (reference
+        ``dist_triton_fwd``).
+
+        ``x``: (M, K) sharded on dim 0, M = batch * seq flattened tokens.
+        Returns (M, K) sharded on dim 0.
+        """
+        m, _ = x.shape
+        seq = m // batch
+        qkv = ag_gemm(x, params.wqkv, self.mesh, self.axis)
+        attn = self._local_attention(qkv, params.q_norm, params.k_norm,
+                                     batch, seq)
+        return gemm_rs(attn, params.wo, self.mesh, self.axis)
+
+    def forward_ar(self, params: TPAttnParams, x: jax.Array,
+                   batch: int = 1) -> jax.Array:
+        """Local GEMM -> local attention -> fused GEMM+AllReduce (reference
+        ``dist_triton_AR_fwd``; small-M path).
+
+        ``x``: (M, K) replicated.  Returns (M, K) replicated.
+        """
+        m, _ = x.shape
+        seq = m // batch
+        qkv = replicated_column_gemm(self.mesh, self.axis, x, params.wqkv)
+        attn = self._local_attention(qkv, params.q_norm, params.k_norm,
+                                     batch, seq)
+        return gemm_ar(attn, params.wo, self.mesh, self.axis)

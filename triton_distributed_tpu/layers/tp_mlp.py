@@ -1,0 +1,150 @@
+"""Tensor-parallel MLP (gate/up column-parallel, down row-parallel).
+
+Reference: ``python/triton_dist/layers/nvidia/tp_mlp.py:51-241`` — fused
+gate_up weight per rank, ``dist_triton_fwd`` = AG-GEMM -> act -> GEMM-RS
+(``:143-167``), ``dist_triton_AR_fwd`` = local GEMMs -> AllReduce
+(``:168-191``, the small-M path).
+
+TPU design: a functional pytree of sharded arrays + a static config.  The
+two fused collective GEMMs are the framework's overlapped Pallas ops; the
+per-rank split/activation between them runs under ``shard_map`` so the
+rank-blocked fused gate_up layout ([gate_r | up_r] per rank, exactly the
+reference's ``torch.cat`` layout) never needs a global relayout.
+
+Sharding map (M = flattened tokens, K = hidden, I = intermediate):
+
+- ``forward``    x: (M, K) M-sharded  ->  (M, K) M-sharded   (SP in/out)
+- ``forward_ar`` x: (M, K) replicated ->  (M, K) replicated  (AR out)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import compilation
+from ..core.mesh import TP_AXIS
+from ..ops import ag_gemm, gemm_ar, gemm_rs
+
+
+def fuse_column_shards(parts, n: int) -> jax.Array:
+    """Fuse column-parallel weights into the per-rank-blocked layout.
+
+    ``parts``: list of (K, I_j) arrays, each to be column-sharded n ways.
+    Returns (K, sum_j I_j) whose global column order is
+    [p0_r0 | p1_r0 | ... | p0_r1 | p1_r1 | ...] — rank r's shard holds its
+    slice of every part contiguously (reference ``tp_mlp.py:77-80``).
+    """
+    for p in parts:
+        if p.shape[1] % n:
+            raise ValueError(
+                f"column count {p.shape[1]} not divisible by {n} shards"
+            )
+    blocks = []
+    for r in range(n):
+        for p in parts:
+            i = p.shape[1] // n
+            blocks.append(p[:, r * i:(r + 1) * i])
+    return jnp.concatenate(blocks, axis=1)
+
+
+def replicated_column_gemm(mesh: Mesh, axis: str, x: jax.Array,
+                           w: jax.Array) -> jax.Array:
+    """Local GEMM of replicated activations against a column-sharded weight:
+    (M, K) replicated @ (K, N) P(None, axis) -> (M, N) P(None, axis).  The
+    no-communication first half of the AR forward paths (MLP and Attn)."""
+    def local_gemm(x_loc, w_loc):
+        return jnp.dot(
+            x_loc, w_loc, preferred_element_type=jnp.float32
+        ).astype(x_loc.dtype)
+
+    return compilation.jit_shard_map(
+        local_gemm, mesh,
+        in_specs=(P(None, None), P(None, axis)),
+        out_specs=P(None, axis),
+    )(x, w)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TPMLPParams:
+    """gate_up: (K, 2I) rank-blocked [gate_r | up_r]; down: (I, K)."""
+
+    gate_up: jax.Array
+    down: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class TPMLP:
+    """Static layer config; params travel separately (functional style)."""
+
+    mesh: Mesh
+    axis: str = TP_AXIS
+    act: str = "silu"
+
+    @property
+    def tp(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    # -- parameter construction ------------------------------------------
+
+    def shard_params(self, gate, up, down) -> TPMLPParams:
+        """Build sharded params from full (replicated) weights:
+        gate/up (K, I), down (I, K)."""
+        n = self.tp
+        gate_up = fuse_column_shards([gate, up], n)
+        return TPMLPParams(
+            gate_up=jax.device_put(
+                gate_up, NamedSharding(self.mesh, P(None, self.axis))
+            ),
+            down=jax.device_put(
+                down, NamedSharding(self.mesh, P(self.axis, None))
+            ),
+        )
+
+    def init(self, key: jax.Array, hidden: int, intermediate: int,
+             dtype=jnp.bfloat16, scale: float = 0.02) -> TPMLPParams:
+        kg, ku, kd = jax.random.split(key, 3)
+        g = jax.random.normal(kg, (hidden, intermediate), dtype) * scale
+        u = jax.random.normal(ku, (hidden, intermediate), dtype) * scale
+        d = jax.random.normal(kd, (intermediate, hidden), dtype) * scale
+        return self.shard_params(g, u, d)
+
+    # -- forward passes ---------------------------------------------------
+
+    def _act_combine(self, fused: jax.Array) -> jax.Array:
+        """Per-rank split of the rank-blocked [gate_r | up_r] columns and
+        gated activation; local columns only, so it runs under shard_map."""
+        act = dict(silu=jax.nn.silu, gelu=jax.nn.gelu, relu=jax.nn.relu)[self.act]
+
+        def local(o_loc):
+            wg, w1 = jnp.split(o_loc, 2, axis=-1)
+            return act(wg) * w1
+
+        return jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=P(None, self.axis), out_specs=P(None, self.axis),
+        )(fused)
+
+    def forward(self, params: TPMLPParams, x: jax.Array) -> jax.Array:
+        """AG-GEMM -> act -> GEMM-RS (reference ``dist_triton_fwd``).
+
+        ``x``: (M, K) sharded on dim 0 (sequence-parallel activations).
+        Returns (M, K) sharded on dim 0.
+        """
+        fused = ag_gemm(x, params.gate_up, self.mesh, self.axis)
+        h = self._act_combine(fused)
+        return gemm_rs(h, params.down, self.mesh, self.axis)
+
+    def forward_ar(self, params: TPMLPParams, x: jax.Array) -> jax.Array:
+        """Local GEMM -> act -> fused GEMM+AllReduce (reference
+        ``dist_triton_AR_fwd``; preferred at small M, BASELINE.md).
+
+        ``x``: (M, K) replicated.  Returns (M, K) replicated.
+        """
+        fused = replicated_column_gemm(self.mesh, self.axis, x, params.gate_up)
+        h = self._act_combine(fused)
+        return gemm_ar(h, params.down, self.mesh, self.axis)

@@ -1,0 +1,327 @@
+"""EP (expert-parallel) All-to-All: MoE token dispatch and combine.
+
+Reference: ``python/triton_dist/kernels/nvidia/low_latency_all_to_all.py``
+— single-kernel A2A with per-peer ``putmem_nbi_block`` of exact byte
+counts, split counts pushed alongside, parity double-buffered signal sets
+(``all_to_all_kernel:36-120``); and ``ep_a2a.py:37-150`` (dispatch via
+gathered splits + recv offsets, ``:244-310``).
+
+TPU re-design — the parts land on different machinery:
+
+- **splits / offsets** (a few ints per peer) ride ``lax.all_to_all``
+  outside the kernel: latency-bound metadata is XLA-collective territory,
+  and its arrival ORDERS the data kernel (the kernel consumes the
+  exchanged counts, so no flag protocol is needed);
+- **token payloads** (the bandwidth) move in a Pallas kernel as a traced
+  NUMBER of fixed-shape row chunks per peer (dynamic ``fori_loop`` trip
+  over static-size DMAs) — TPU descriptors need static shapes, so
+  "variable length" becomes "variable chunk count", the moral equivalent
+  of the reference's byte-exact ``putmem`` at chunk granularity;
+- the parity double-buffer + signal-SET protocol collapses into DMA
+  completion semaphores and the entry barrier (counting semantics,
+  SURVEY.md section 7): every invocation's waits consume exactly that
+  invocation's chunk arrivals, so repeated calls need no call_count.
+
+Layouts (E experts total, epr = E/n per rank, rank r owns experts
+[r*epr, (r+1)*epr)):
+
+- dispatch in:  x (T, H) tokens SORTED by expert id; splits (E,) row
+  counts per expert (reference keeps the same sorted+splits convention).
+- dispatch out: recv (n, Z, H) landing zones by source rank (zone p holds
+  the rows rank p sent me, padded to the chunk multiple) + recv_splits
+  (n, epr): per-source per-owned-expert counts.
+- combine in:   y (n, Z, H) processed tokens still in zone layout.
+- combine out:  (T, H) rows back in the original sorted-by-expert order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core import compilation
+from ..core.mesh import EP_AXIS
+from ..lang import primitives as dl
+from ..lang.primitives import Team
+
+
+@dataclasses.dataclass(frozen=True)
+class AllToAllConfig:
+    chunk: int = 128   # rows per DMA descriptor (the static payload shape)
+
+
+def _cdiv(a, b):
+    return (a + b - 1) // b
+
+
+def _a2a_push_kernel(
+    team: Team,
+    chunk: int,
+    z: int,            # zone rows (chunk multiple)
+    h: int,
+    counts_ref,   # (n,) int32 rows to SEND to each peer          [SMEM]
+    offs_ref,     # (n,) int32 row offset of each peer's rows in x [SMEM]
+    expected_ref,  # (n,) int32 rows each peer sends ME            [SMEM]
+    dst_offs_ref,  # (n,) int32 row offset at which peer p wants MY rows
+                   # (dispatch: me*0 in zone layout; combine: p's original
+                   # offset for my rows)                           [SMEM]
+    x_ref,        # (T + chunk, H) source rows                     [ANY]
+    out_ref,      # dispatch: (n, z, h); combine: (T + chunk, h)   [ANY]
+    send_sem,
+    recv_sems,    # (n,) per-source arrival
+    *,
+    zones: bool,  # True: land in out_ref[me]; False: flat at dst_offs
+):
+    """Push ``counts[p]`` rows (as ceil/chunk fixed-shape DMAs) to every
+    peer ``p`` and wait for ``expected[p]`` rows from each — the shared body
+    of dispatch (zone landing) and combine (scatter-back landing)."""
+    me, n = team.rank(), team.size
+
+    dl.collective_prologue(team)
+
+    def send_chunk_to(dst, c, src_off, dst_off):
+        src = x_ref.at[pl.ds(src_off + c * chunk, chunk)]
+        if zones:
+            dst_ref = out_ref.at[me, pl.ds(dst_off + c * chunk, chunk)]
+        else:
+            dst_ref = out_ref.at[pl.ds(dst_off + c * chunk, chunk)]
+        dl.remote_copy(src, dst_ref, send_sem, recv_sems.at[me],
+                       team.device_id(dst))
+
+    total_sent = jnp.int32(0)
+    for p in range(n):
+        # stagger destinations so the ring isn't hot-spotted
+        dst = jax.lax.rem(me + jnp.int32(p), jnp.int32(n))
+        cnt = counts_ref[dst]
+        nch = _cdiv(cnt, chunk)
+
+        def body(c, _, dst=dst):
+            send_chunk_to(dst, c, offs_ref[dst], dst_offs_ref[dst])
+            return 0
+
+        jax.lax.fori_loop(0, nch, body, 0)
+        total_sent += nch
+
+    # wait for every peer's rows (chunk-count arrivals per source)
+    for p in range(n):
+        nch = _cdiv(expected_ref[p], chunk)
+
+        def wait_body(c, _, p=p):
+            if zones:
+                probe = out_ref.at[p, pl.ds(0, chunk)]
+            else:
+                probe = out_ref.at[pl.ds(0, chunk)]
+            dl.wait_recv(probe, recv_sems.at[p])
+            return 0
+
+        jax.lax.fori_loop(0, nch, wait_body, 0)
+
+    # drain sends off the critical path
+    def drain(c, _):
+        dl.wait_send(x_ref.at[pl.ds(0, chunk)], send_sem)
+        return 0
+
+    jax.lax.fori_loop(0, total_sent, drain, 0)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_dispatch(mesh: Mesh, axis: str, t_pad: int, h: int, epr: int,
+                    chunk: int, z: int, dtype: jnp.dtype):
+    team = Team.of(mesh, axis)
+    n = team.size
+    kernel = functools.partial(
+        _a2a_push_kernel, team, chunk, z, h, zones=True
+    )
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, z, h), dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((n,)),
+        ],
+        compiler_params=compilation.compiler_params(
+            collective=True,
+            collective_id=compilation.collective_id("ep_dispatch"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+
+    def local_fn(x_loc, splits_loc):
+        # per-peer row counts/offsets from my sorted splits
+        per_peer = splits_loc.reshape(n, epr).sum(axis=1)          # (n,)
+        offs = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(per_peer)[:-1]]
+        ).astype(jnp.int32)
+        # tiny metadata exchange; also ORDERS the data kernel after it
+        expected = jax.lax.all_to_all(per_peer, axis, 0, 0)        # (n,)
+        recv_splits = jax.lax.all_to_all(
+            splits_loc.reshape(n, epr), axis, 0, 0
+        )                                                          # (n, epr)
+        zeros = jnp.zeros((n,), jnp.int32)  # zone landing offset is 0
+        recv = call(per_peer.astype(jnp.int32), offs,
+                    expected.astype(jnp.int32), zeros, x_loc)
+        return recv, recv_splits.astype(jnp.int32)
+
+    return compilation.jit_shard_map(
+        local_fn, mesh,
+        in_specs=(P(axis, None), P(axis)),
+        out_specs=(P(axis, None, None), P(axis, None)),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_combine(mesh: Mesh, axis: str, t_pad: int, h: int, epr: int,
+                   chunk: int, z: int, dtype: jnp.dtype):
+    team = Team.of(mesh, axis)
+    n = team.size
+    kernel = functools.partial(
+        _a2a_push_kernel, team, chunk, z, h, zones=False
+    )
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((t_pad + chunk, h), dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((n,)),
+        ],
+        compiler_params=compilation.compiler_params(
+            collective=True,
+            collective_id=compilation.collective_id("ep_combine"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+
+    def local_fn(y_loc, splits_loc):
+        # same metadata as dispatch, roles reversed: I send zone p's rows
+        # (expected[p] of them) back to p at p's original offset for me
+        per_peer = splits_loc.reshape(n, epr).sum(axis=1)
+        offs = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(per_peer)[:-1]]
+        ).astype(jnp.int32)
+        expected = jax.lax.all_to_all(per_peer, axis, 0, 0)
+        ret_offs = jax.lax.all_to_all(offs, axis, 0, 0)            # (n,)
+        # zone p starts at row p*z of the flattened zone slab
+        zone_offs = (jnp.arange(n, dtype=jnp.int32) * z)
+        out = call(expected.astype(jnp.int32), zone_offs,
+                   per_peer.astype(jnp.int32), ret_offs.astype(jnp.int32),
+                   y_loc.reshape(n * z, h))
+        return out
+
+    return compilation.jit_shard_map(
+        local_fn, mesh,
+        in_specs=(P(axis, None, None), P(axis)),
+        out_specs=P(axis, None),
+    )
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def ep_dispatch(
+    x: jax.Array,
+    splits: jax.Array,
+    mesh: Mesh,
+    axis: str = EP_AXIS,
+    *,
+    config: AllToAllConfig | None = None,
+):
+    """Dispatch sorted tokens to their expert-owner ranks (reference
+    ``all_to_all_single`` host entry ``low_latency_all_to_all.py:183-198``,
+    ``ep_a2a.py:37-150``).
+
+    ``x``: global (n*T, H) over ``axis`` — each rank's (T, H) shard holds
+    its tokens sorted by expert id (T = static worst case, rows beyond the
+    real token count are padding).  ``splits``: global (n*E,) int32 — each
+    rank's (E,) per-expert row counts (padding rows NOT counted).
+
+    Returns ``(recv, recv_splits)``: ``recv`` global (n*n, Z, H) — rank
+    r's slab ``recv[r*n:(r+1)*n]`` is its n landing zones by source rank;
+    ``recv_splits`` global (n*n, epr) — rank r's block gives, per source
+    rank, the counts for each of r's own experts.
+    """
+    cfg = config or AllToAllConfig()
+    n = mesh.shape[axis]
+    tn, h = x.shape
+    if tn % n:
+        raise ValueError(f"token dim {tn} not divisible by {axis}={n}")
+    t = tn // n
+    e_tot = splits.shape[0] // n
+    if splits.shape[0] % n or e_tot % n:
+        raise ValueError(
+            f"splits {splits.shape} must be (n*E,) with E divisible by n"
+        )
+    epr = e_tot // n
+    if n == 1:
+        return (
+            x.reshape(1, t, h),
+            splits.reshape(1, e_tot)[:, :epr],
+        )
+    chunk = min(cfg.chunk, _round_up(t, 8))
+    z = _round_up(t, chunk) + chunk   # worst case: every token to one peer
+    t_pad = _round_up(t, chunk) + chunk
+    x_p = jnp.pad(x.reshape(n, t, h), ((0, 0), (0, t_pad - t), (0, 0)))
+    x_p = x_p.reshape(n * t_pad, h)
+    fn = _build_dispatch(mesh, axis, t_pad, h, epr, chunk, z,
+                         jnp.dtype(x.dtype))
+    recv, recv_splits = fn(x_p, splits.astype(jnp.int32))
+    return recv.reshape(n * n, z, h), recv_splits.reshape(n * n, epr)
+
+
+def ep_combine(
+    y: jax.Array,
+    splits: jax.Array,
+    mesh: Mesh,
+    axis: str = EP_AXIS,
+    *,
+    token_dim: int,
+    config: AllToAllConfig | None = None,
+) -> jax.Array:
+    """Return processed tokens to their owner ranks, restoring the original
+    sorted-by-expert order (reference combine path ``ep_a2a.py:244-310``).
+
+    ``y``: global (n*n, Z, H) — the zone layout ``ep_dispatch`` produced
+    (rows processed in place).  ``splits``: the SAME global (n*E,) given to
+    dispatch.  ``token_dim``: T, the per-rank token row count.  Returns
+    global (n*T, H) over ``axis``.
+    """
+    cfg = config or AllToAllConfig()
+    n = mesh.shape[axis]
+    if n == 1:
+        return y.reshape(-1, y.shape[-1])[:token_dim]
+    nz, z, h = y.shape
+    if nz != n * n:
+        raise ValueError(f"zone dim {nz} != n*n = {n * n}")
+    e_tot = splits.shape[0] // n
+    epr = e_tot // n
+    t = token_dim
+    chunk = min(cfg.chunk, _round_up(t, 8))
+    t_pad = _round_up(t, chunk) + chunk
+    fn = _build_combine(mesh, axis, t_pad, h, epr, chunk, z,
+                        jnp.dtype(y.dtype))
+    out = fn(y.reshape(n, n, z, h).reshape(n * n, z, h),
+             splits.astype(jnp.int32))
+    out = out.reshape(n, t_pad + chunk, h)[:, :t]
+    return out.reshape(n * t, h)

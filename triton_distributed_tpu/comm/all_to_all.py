@@ -68,31 +68,20 @@ def _a2a_push_kernel(
     counts_ref,   # (n,) int32 rows to SEND to each peer          [SMEM]
     offs_ref,     # (n,) int32 row offset of each peer's rows in x [SMEM]
     expected_ref,  # (n,) int32 rows each peer sends ME            [SMEM]
-    dst_offs_ref,  # (n,) int32 row offset at which peer p wants MY rows
-                   # (dispatch: me*0 in zone layout; combine: p's original
-                   # offset for my rows)                           [SMEM]
-    x_ref,        # (T + chunk, H) source rows                     [ANY]
-    out_ref,      # dispatch: (n, z, h); combine: (T + chunk, h)   [ANY]
+    x_ref,        # source rows                                    [ANY]
+    out_ref,      # (n, z, h) landing zones by source rank         [ANY]
     send_sem,
     recv_sems,    # (n,) per-source arrival
-    *,
-    zones: bool,  # True: land in out_ref[me]; False: flat at dst_offs
 ):
     """Push ``counts[p]`` rows (as ceil/chunk fixed-shape DMAs) to every
-    peer ``p`` and wait for ``expected[p]`` rows from each — the shared body
-    of dispatch (zone landing) and combine (scatter-back landing)."""
+    peer ``p``'s zone ``me`` and wait for ``expected[p]`` rows from each —
+    the shared body of dispatch and combine (combine swaps the count
+    roles).  Zones are per-SOURCE, so the chunk round-up of one sender can
+    never spill into another sender's rows — the reason both directions
+    land in zones and exact packing is a local gather afterwards."""
     me, n = team.rank(), team.size
 
     dl.collective_prologue(team)
-
-    def send_chunk_to(dst, c, src_off, dst_off):
-        src = x_ref.at[pl.ds(src_off + c * chunk, chunk)]
-        if zones:
-            dst_ref = out_ref.at[me, pl.ds(dst_off + c * chunk, chunk)]
-        else:
-            dst_ref = out_ref.at[pl.ds(dst_off + c * chunk, chunk)]
-        dl.remote_copy(src, dst_ref, send_sem, recv_sems.at[me],
-                       team.device_id(dst))
 
     total_sent = jnp.int32(0)
     for p in range(n):
@@ -102,7 +91,10 @@ def _a2a_push_kernel(
         nch = _cdiv(cnt, chunk)
 
         def body(c, _, dst=dst):
-            send_chunk_to(dst, c, offs_ref[dst], dst_offs_ref[dst])
+            src = x_ref.at[pl.ds(offs_ref[dst] + c * chunk, chunk)]
+            dst_ref = out_ref.at[me, pl.ds(c * chunk, chunk)]
+            dl.remote_copy(src, dst_ref, send_sem, recv_sems.at[me],
+                           team.device_id(dst))
             return 0
 
         jax.lax.fori_loop(0, nch, body, 0)
@@ -113,11 +105,7 @@ def _a2a_push_kernel(
         nch = _cdiv(expected_ref[p], chunk)
 
         def wait_body(c, _, p=p):
-            if zones:
-                probe = out_ref.at[p, pl.ds(0, chunk)]
-            else:
-                probe = out_ref.at[pl.ds(0, chunk)]
-            dl.wait_recv(probe, recv_sems.at[p])
+            dl.wait_recv(out_ref.at[p, pl.ds(0, chunk)], recv_sems.at[p])
             return 0
 
         jax.lax.fori_loop(0, nch, wait_body, 0)
@@ -130,19 +118,13 @@ def _a2a_push_kernel(
     jax.lax.fori_loop(0, total_sent, drain, 0)
 
 
-@functools.lru_cache(maxsize=None)
-def _build_dispatch(mesh: Mesh, axis: str, t_pad: int, h: int, epr: int,
-                    chunk: int, z: int, dtype: jnp.dtype):
-    team = Team.of(mesh, axis)
-    n = team.size
-    kernel = functools.partial(
-        _a2a_push_kernel, team, chunk, z, h, zones=True
-    )
-    call = pl.pallas_call(
+def _make_push_call(team: Team, chunk: int, z: int, h: int, n: int,
+                    family: str, dtype: jnp.dtype):
+    kernel = functools.partial(_a2a_push_kernel, team, chunk, z, h)
+    return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n, z, h), dtype),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -155,25 +137,37 @@ def _build_dispatch(mesh: Mesh, axis: str, t_pad: int, h: int, epr: int,
         ],
         compiler_params=compilation.compiler_params(
             collective=True,
-            collective_id=compilation.collective_id("ep_dispatch"),
+            collective_id=compilation.collective_id(family),
         ),
         interpret=compilation.interpret_mode(),
     )
 
+
+def _per_peer_meta(splits_loc, n: int, epr: int):
+    """(counts to each peer, row offset of each peer's rows) from my
+    expert-sorted splits."""
+    per_peer = splits_loc.reshape(n, epr).sum(axis=1)
+    offs = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(per_peer)[:-1]]
+    ).astype(jnp.int32)
+    return per_peer.astype(jnp.int32), offs
+
+
+@functools.lru_cache(maxsize=None)
+def _build_dispatch(mesh: Mesh, axis: str, t_pad: int, h: int, epr: int,
+                    chunk: int, z: int, dtype: jnp.dtype):
+    team = Team.of(mesh, axis)
+    n = team.size
+    call = _make_push_call(team, chunk, z, h, n, "ep_dispatch", dtype)
+
     def local_fn(x_loc, splits_loc):
-        # per-peer row counts/offsets from my sorted splits
-        per_peer = splits_loc.reshape(n, epr).sum(axis=1)          # (n,)
-        offs = jnp.concatenate(
-            [jnp.zeros((1,), jnp.int32), jnp.cumsum(per_peer)[:-1]]
-        ).astype(jnp.int32)
+        per_peer, offs = _per_peer_meta(splits_loc, n, epr)
         # tiny metadata exchange; also ORDERS the data kernel after it
         expected = jax.lax.all_to_all(per_peer, axis, 0, 0)        # (n,)
         recv_splits = jax.lax.all_to_all(
             splits_loc.reshape(n, epr), axis, 0, 0
         )                                                          # (n, epr)
-        zeros = jnp.zeros((n,), jnp.int32)  # zone landing offset is 0
-        recv = call(per_peer.astype(jnp.int32), offs,
-                    expected.astype(jnp.int32), zeros, x_loc)
+        recv = call(per_peer, offs, expected.astype(jnp.int32), x_loc)
         return recv, recv_splits.astype(jnp.int32)
 
     return compilation.jit_shard_map(
@@ -184,50 +178,28 @@ def _build_dispatch(mesh: Mesh, axis: str, t_pad: int, h: int, epr: int,
 
 
 @functools.lru_cache(maxsize=None)
-def _build_combine(mesh: Mesh, axis: str, t_pad: int, h: int, epr: int,
-                   chunk: int, z: int, dtype: jnp.dtype):
+def _build_combine(mesh: Mesh, axis: str, h: int, epr: int,
+                   chunk: int, z: int, t: int, dtype: jnp.dtype):
     team = Team.of(mesh, axis)
     n = team.size
-    kernel = functools.partial(
-        _a2a_push_kernel, team, chunk, z, h, zones=False
-    )
-    call = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((t_pad + chunk, h), dtype),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((n,)),
-        ],
-        compiler_params=compilation.compiler_params(
-            collective=True,
-            collective_id=compilation.collective_id("ep_combine"),
-        ),
-        interpret=compilation.interpret_mode(),
-    )
+    call = _make_push_call(team, chunk, z, h, n, "ep_combine", dtype)
 
     def local_fn(y_loc, splits_loc):
-        # same metadata as dispatch, roles reversed: I send zone p's rows
-        # (expected[p] of them) back to p at p's original offset for me
-        per_peer = splits_loc.reshape(n, epr).sum(axis=1)
-        offs = jnp.concatenate(
-            [jnp.zeros((1,), jnp.int32), jnp.cumsum(per_peer)[:-1]]
-        ).astype(jnp.int32)
+        # roles reversed: I send zone p's rows (expected[p] of them) back
+        # to p, landing in p's RETURN zone for me; p repacks locally.
+        per_peer, offs = _per_peer_meta(splits_loc, n, epr)
         expected = jax.lax.all_to_all(per_peer, axis, 0, 0)
-        ret_offs = jax.lax.all_to_all(offs, axis, 0, 0)            # (n,)
-        # zone p starts at row p*z of the flattened zone slab
         zone_offs = (jnp.arange(n, dtype=jnp.int32) * z)
-        out = call(expected.astype(jnp.int32), zone_offs,
-                   per_peer.astype(jnp.int32), ret_offs.astype(jnp.int32),
-                   y_loc.reshape(n * z, h))
-        return out
+        back = call(expected.astype(jnp.int32), zone_offs, per_peer,
+                    y_loc.reshape(n * z, h))
+        # exact repack (local gather): sorted row r came back in zone p at
+        # position r - offs[p], where p is r's destination peer
+        ridx = jnp.arange(t)
+        cum = jnp.cumsum(per_peer)
+        p_of = jnp.searchsorted(cum, ridx, side="right")
+        p_of = jnp.clip(p_of, 0, n - 1)
+        within = ridx - jnp.take(offs, p_of)
+        return jnp.take(back.reshape(n * z, h), p_of * z + within, axis=0)
 
     return compilation.jit_shard_map(
         local_fn, mesh,
@@ -318,10 +290,5 @@ def ep_combine(
     epr = e_tot // n
     t = token_dim
     chunk = min(cfg.chunk, _round_up(t, 8))
-    t_pad = _round_up(t, chunk) + chunk
-    fn = _build_combine(mesh, axis, t_pad, h, epr, chunk, z,
-                        jnp.dtype(y.dtype))
-    out = fn(y.reshape(n, n, z, h).reshape(n * n, z, h),
-             splits.astype(jnp.int32))
-    out = out.reshape(n, t_pad + chunk, h)[:, :t]
-    return out.reshape(n * t, h)
+    fn = _build_combine(mesh, axis, h, epr, chunk, z, t, jnp.dtype(y.dtype))
+    return fn(y, splits.astype(jnp.int32))

@@ -2,6 +2,7 @@
 ``python/triton_dist/layers/nvidia/`` — TP_MLP, TP_Attn, EP A2A,
 SP flash-decode, low-latency AG layers)."""
 
+from .moe import MoEMLP, MoEParams
 from .norm import rms_norm
 from .tp_attn import TPAttn, TPAttnParams
 from .tp_mlp import TPMLP, TPMLPParams, fuse_column_shards

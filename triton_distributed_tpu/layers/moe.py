@@ -1,0 +1,217 @@
+"""MoE MLP layer: top-k routed experts under TP or EP parallelism.
+
+Reference: the MoE stack of ``python/triton_dist`` — TP strategy =
+AG + group-GEMM then group-GEMM + RS (``allgather_group_gemm.py:398-605``,
+``moe_reduce_rs.py:486-816``); EP strategy = A2A dispatch -> local experts
+-> A2A combine (``ep_a2a.py:37-310``, ``layers/nvidia/ep_a2a_layer.py:40``);
+routing/index prep = ``moe_utils.py:94-360``.
+
+TPU design: routing and sorting are per-rank jnp (XLA sorts); the
+communication rides the framework's collectives (``ag_group_gemm`` /
+``moe_reduce_rs`` for TP, ``ep_dispatch``/``ep_combine`` for EP); the
+ragged expert GEMM is ``lax.ragged_dot`` everywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..comm.all_to_all import AllToAllConfig, ep_combine, ep_dispatch
+from ..core.mesh import TP_AXIS
+from ..ops.group_gemm import ag_group_gemm, moe_reduce_rs
+from ..ops.moe_utils import (
+    flatten_topk,
+    global_presort_index,
+    sort_by_expert,
+    topk_route,
+    unsort_combine,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MoEParams:
+    """router: (K, E) replicated; w_up: (E, K, F); w_dn: (E, F, K) —
+    expert weights sharded on F (TP) or on E (EP)."""
+
+    router: jax.Array
+    w_up: jax.Array
+    w_dn: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEMLP:
+    mesh: Mesh
+    num_experts: int
+    top_k: int = 2
+    axis: str = TP_AXIS
+    act: str = "silu"
+
+    @property
+    def n(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def _act(self):
+        return dict(silu=jax.nn.silu, gelu=jax.nn.gelu, relu=jax.nn.relu)[self.act]
+
+    # -- parameter construction ------------------------------------------
+
+    def shard_params_tp(self, router, w_up, w_dn) -> MoEParams:
+        """TP layout: every rank holds all experts, F-sharded."""
+        return MoEParams(
+            router=jax.device_put(
+                router, NamedSharding(self.mesh, P(None, None))
+            ),
+            w_up=jax.device_put(
+                w_up, NamedSharding(self.mesh, P(None, None, self.axis))
+            ),
+            w_dn=jax.device_put(
+                w_dn, NamedSharding(self.mesh, P(None, self.axis, None))
+            ),
+        )
+
+    def shard_params_ep(self, router, w_up, w_dn) -> MoEParams:
+        """EP layout: experts partitioned across ranks (rank r owns the
+        contiguous expert block [r*E/n, (r+1)*E/n))."""
+        return MoEParams(
+            router=jax.device_put(
+                router, NamedSharding(self.mesh, P(None, None))
+            ),
+            w_up=jax.device_put(
+                w_up, NamedSharding(self.mesh, P(self.axis, None, None))
+            ),
+            w_dn=jax.device_put(
+                w_dn, NamedSharding(self.mesh, P(self.axis, None, None))
+            ),
+        )
+
+    def init(self, key: jax.Array, hidden: int, ffn: int, *,
+             ep: bool = False, dtype=jnp.float32,
+             scale: float = 0.02) -> MoEParams:
+        kr, ku, kd = jax.random.split(key, 3)
+        e = self.num_experts
+        router = jax.random.normal(kr, (hidden, e), dtype) * scale
+        w_up = jax.random.normal(ku, (e, hidden, ffn), dtype) * scale
+        w_dn = jax.random.normal(kd, (e, ffn, hidden), dtype) * scale
+        return (self.shard_params_ep if ep else self.shard_params_tp)(
+            router, w_up, w_dn
+        )
+
+    # -- routing prep (shared) -------------------------------------------
+
+    def _route_and_sort(self, x, router):
+        """Per-rank: route own tokens, flatten top-k, sort by expert.
+        Returns globally stacked (x_sorted, splits, wflat, unsort)."""
+        e, k = self.num_experts, self.top_k
+
+        def local(x_loc, router_rep):
+            logits = x_loc @ router_rep
+            eid, wts = topk_route(logits, k)
+            xr, eflat, wflat = flatten_topk(x_loc, eid, wts)
+            xs, splits, unsort = sort_by_expert(xr, eflat, e)
+            return xs, splits, wflat, unsort
+
+        return jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(self.axis, None), P(None, None)),
+            out_specs=(P(self.axis, None), P(self.axis), P(self.axis),
+                       P(self.axis)),
+        )(x, router)
+
+    # -- TP forward -------------------------------------------------------
+
+    def forward_tp(self, params: MoEParams, x: jax.Array) -> jax.Array:
+        """Route -> AG + group-GEMM (up) -> act -> group-GEMM + RS (down).
+
+        ``x``: (M, K) sharded on dim 0 over ``axis``.  Returns the same.
+        """
+        n = self.n
+        x_sorted, splits, wflat, unsort = self._route_and_sort(
+            x, params.router
+        )
+        h, total_splits, perm = ag_group_gemm(
+            x_sorted, params.w_up, splits, self.mesh, self.axis
+        )
+        h = self._act()(h)
+        t_per_rank = x_sorted.shape[0] // n
+        presort = global_presort_index(
+            perm, unsort.reshape(n, t_per_rank)
+        )
+        return moe_reduce_rs(
+            h, params.w_dn, total_splits, presort, wflat, self.top_k,
+            self.mesh, self.axis,
+        )
+
+    # -- EP forward -------------------------------------------------------
+
+    def forward_ep(self, params: MoEParams, x: jax.Array,
+                   *, a2a_config: AllToAllConfig | None = None) -> jax.Array:
+        """Route -> A2A dispatch -> local expert MLP -> A2A combine ->
+        weighted top-k fold (reference ``ep_a2a_layer.py:40``).
+
+        ``x``: (M, K) sharded on dim 0 over ``axis``.  Returns the same.
+        """
+        n = self.n
+        e, k = self.num_experts, self.top_k
+        epr = e // n
+        x_sorted, splits, wflat, unsort = self._route_and_sort(
+            x, params.router
+        )
+        recv, recv_splits = ep_dispatch(
+            x_sorted, splits, self.mesh, self.axis, config=a2a_config
+        )
+        z = recv.shape[1]
+        act = self._act()
+
+        def local_experts(zones, rsplits, w_up_loc, w_dn_loc):
+            # zones: (n, Z, K); rsplits: (n, epr).  Compact zone rows into
+            # one expert-major run for a single ragged_dot, then scatter
+            # back to zone layout for the combine.
+            kdim = zones.shape[-1]
+            flat = zones.reshape(n * z, kdim)
+            # owned-expert index of each zone row; padding rows map to epr
+            # (one past the last expert) and stable-sort to the tail
+            j = jnp.arange(z)
+            cum = jnp.cumsum(rsplits, axis=1)                        # (n, epr)
+            eid = jax.vmap(
+                lambda c: jnp.searchsorted(c, j, side="right")
+            )(cum)                                                   # (n, z)
+            order = jnp.argsort(eid.reshape(n * z), stable=True)
+            compact = jnp.take(flat, order, axis=0)
+            gsz = rsplits.sum(axis=0).astype(jnp.int32)              # (epr,)
+            h_loc = act(jax.lax.ragged_dot(compact, w_up_loc, gsz))
+            y = jax.lax.ragged_dot(h_loc, w_dn_loc, gsz)
+            # rows past sum(gsz) belong to no expert; zero them before the
+            # scatter so padding rows stay inert through the combine
+            valid = jnp.arange(n * z) < gsz.sum()
+            y = jnp.where(valid[:, None], y, 0)
+            return jnp.zeros_like(flat).at[order].set(y).reshape(n, z, kdim)
+
+        processed = jax.shard_map(
+            local_experts, mesh=self.mesh,
+            in_specs=(P(self.axis, None, None), P(self.axis, None),
+                      P(self.axis, None, None), P(self.axis, None, None)),
+            out_specs=P(self.axis, None, None),
+        )(
+            recv.reshape(n, n, z, -1).reshape(n * n, z, -1),
+            recv_splits.reshape(n * n, epr),
+            params.w_up, params.w_dn,
+        )
+        back = ep_combine(
+            processed, splits, self.mesh, self.axis,
+            token_dim=x_sorted.shape[0] // n, config=a2a_config,
+        )
+
+        # per-rank: unsort and weighted fold
+        def fold(y_loc, unsort_loc, w_loc):
+            return unsort_combine(y_loc, unsort_loc, w_loc, k)
+
+        return jax.shard_map(
+            fold, mesh=self.mesh,
+            in_specs=(P(self.axis, None), P(self.axis), P(self.axis)),
+            out_specs=P(self.axis, None),
+        )(back, unsort, wflat)

@@ -15,5 +15,14 @@ from .attention import (
 from .flash_decode import sp_flash_decode
 from .gemm_ar import GemmArConfig, gemm_ar
 from .gemm_rs import GemmRsConfig, gemm_rs
+from .group_gemm import ag_group_gemm, group_gemm, moe_reduce_rs
+from .moe_utils import (
+    expert_block_permutation,
+    flatten_topk,
+    global_presort_index,
+    sort_by_expert,
+    topk_route,
+    unsort_combine,
+)
 from .rope import apply_rope, apply_rope_at, rope_freqs
 from .sp_attention import sp_attention

@@ -1,0 +1,135 @@
+"""Grouped (per-expert) GEMM ops: local, AG-fused, and RS-fused MoE paths.
+
+Reference:
+
+- local scatter group-GEMM ``allgather_group_gemm.py:532`` (M-parallel
+  Triton kernel over expert row groups);
+- AG + group-GEMM ``allgather_group_gemm.py:398-605`` (tokens gathered
+  over TP, scattered to expert order, group-GEMM against the local expert
+  weight shard);
+- group-GEMM + ReduceScatter ``moe_reduce_rs.py:486,605,816`` (down
+  projection, top-k weighted reduce, RS over TP).
+
+TPU design: the ragged per-expert matmul is XLA's native
+``lax.ragged_dot`` — the hand-written Triton group GEMM collapses into it
+the way the codegen layers collapse into Pallas/Mosaic (SURVEY.md
+section 2.4); it tiles expert row groups onto the MXU with static shapes.
+The communication halves remain this framework's Pallas collectives
+(``comm.all_gather``, ``comm.reduce_scatter``), and the index plumbing is
+``ops.moe_utils``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..comm.allgather import all_gather
+from ..comm.reduce_scatter import reduce_scatter
+from ..core import compilation
+from ..core.mesh import TP_AXIS
+from .moe_utils import expert_block_permutation, unsort_combine
+
+
+def group_gemm(x_sorted: jax.Array, w: jax.Array,
+               splits: jax.Array) -> jax.Array:
+    """Per-expert matmul of expert-sorted rows (reference local group GEMM
+    ``allgather_group_gemm.py:532``).
+
+    ``x_sorted``: (T, K) rows grouped by expert; ``w``: (E, K, N);
+    ``splits``: (E,) int32 row counts (sum <= T; padding rows at the tail
+    multiply expert E-1 garbage-free — their outputs are never gathered).
+    Returns (T, N).
+    """
+    t, k = x_sorted.shape
+    e, k2, n_dim = w.shape
+    if k2 != k:
+        raise ValueError(f"inner dims mismatch: {x_sorted.shape} @ {w.shape}")
+    if splits.shape != (e,):
+        raise ValueError(f"splits {splits.shape} != (E,) = ({e},)")
+    return jax.lax.ragged_dot(x_sorted, w, splits.astype(jnp.int32))
+
+
+def ag_group_gemm(
+    x_sorted: jax.Array,
+    w: jax.Array,
+    splits: jax.Array,
+    mesh: Mesh,
+    axis: str = TP_AXIS,
+):
+    """AllGather tokens over ``axis``, merge to global expert order, and
+    group-GEMM against the column-sharded expert weights (reference
+    ``ag_group_gemm``, ``allgather_group_gemm.py:398-605``).
+
+    ``x_sorted``: global (n*T, K) over ``axis`` — each rank's shard sorted
+    by expert; ``splits``: global (n*E,) int32; ``w``: (E, K, N) with N
+    sharded over ``axis`` (column-parallel expert weights).
+
+    Returns ``(y, total_splits, perm)``: ``y`` (n*T, N) N-sharded rows in
+    GLOBAL expert order; ``total_splits`` (E,) and ``perm`` (n*T,) for the
+    downstream combine.
+    """
+    n = mesh.shape[axis]
+    e = w.shape[0]
+    if n == 1:
+        return group_gemm(x_sorted, w, splits), splits, jnp.arange(
+            x_sorted.shape[0]
+        )
+    gathered = all_gather(x_sorted, mesh, axis)          # (n*T, K) replicated
+    perm, total_splits = expert_block_permutation(
+        splits.reshape(n, e), x_sorted.shape[0] // n
+    )
+    x_glob = jnp.take(gathered, perm, axis=0)            # global expert order
+
+    def local(xg, w_loc):
+        return jax.lax.ragged_dot(xg, w_loc, total_splits)
+
+    y = compilation.jit_shard_map(
+        local, mesh,
+        in_specs=(P(None, None), P(None, None, axis)),
+        out_specs=P(None, axis),
+    )(x_glob, w)
+    return y, total_splits, perm
+
+
+def moe_reduce_rs(
+    y_sorted: jax.Array,
+    w: jax.Array,
+    total_splits: jax.Array,
+    presort_idx: jax.Array,
+    weights: jax.Array,
+    topk: int,
+    mesh: Mesh,
+    axis: str = TP_AXIS,
+) -> jax.Array:
+    """Down-project expert outputs, fold the top-k copies with their
+    routing weights, and ReduceScatter the partial sums back to token
+    owners (reference ``moe_reduce_rs.py:486-816``).
+
+    ``y_sorted``: (n*T, N) N-sharded rows in global expert order (from
+    :func:`ag_group_gemm`); ``w``: (E, N, K) with N sharded (row-parallel
+    down weights); ``presort_idx``: (n*T,) from
+    ``moe_utils.global_presort_index`` (global expert order -> original
+    pre-sort row order); ``weights``: (n*T,) routing weights in pre-sort
+    row order; ``topk``: routing copies per token.  Returns global
+    (n*T//topk, K) token rows sharded over ``axis``.
+    """
+    n = mesh.shape[axis]
+
+    def local(y_loc, w_loc):
+        # partial down-projection (this rank's N slice -> partial sums)
+        part = jax.lax.ragged_dot(y_loc, w_loc, total_splits)
+        # back to pre-sort order, weighted top-k fold: (n*T//topk, K)
+        return unsort_combine(part, presort_idx, weights, topk)
+
+    # out_specs P(axis): rank r's partial becomes row-block r — exactly the
+    # stacked-partials convention reduce_scatter consumes
+    partials = compilation.jit_shard_map(
+        local, mesh,
+        in_specs=(P(None, axis), P(None, axis, None)),
+        out_specs=P(axis, None),
+    )(y_sorted, w)
+    if n == 1:
+        return partials
+    return reduce_scatter(partials, mesh, axis)

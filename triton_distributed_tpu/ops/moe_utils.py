@@ -1,0 +1,113 @@
+"""MoE routing utilities: top-k routing, expert sorting, weighted combine.
+
+Reference: ``python/triton_dist/kernels/nvidia/moe_utils.py:94-360`` —
+``calc_gather_scatter_index_triton`` (histogram + argsort of top-k expert
+ids producing gather/scatter indices) and the weighted ``reduce_topk``
+kernels.  On TPU these index computations are sorts/segment-sums over a
+few thousand int32s — XLA compiles them natively (no kernel needed), and
+static shapes fall out of the fixed (T, k) routing tensors.
+
+Convention: routing REPLICATES each token k times (one row per chosen
+expert); ``sort_by_expert`` orders the replicated rows by expert id;
+``unsort_combine`` inverts the sort and sums the k copies with their
+routing weights — together the exact data flow of the reference's
+gather-scatter index pair.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def topk_route(logits: jax.Array, k: int, *, renormalize: bool = True):
+    """Softmax top-k routing (reference ``moe_utils.py`` router prep).
+
+    ``logits``: (T, E).  Returns ``(expert_ids, weights)`` both (T, k);
+    weights are the softmax probabilities of the chosen experts,
+    renormalized to sum to 1 per token when ``renormalize``.
+    """
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    weights, expert_ids = jax.lax.top_k(probs, k)
+    if renormalize:
+        weights = weights / weights.sum(axis=-1, keepdims=True)
+    return expert_ids.astype(jnp.int32), weights
+
+
+def flatten_topk(x: jax.Array, expert_ids: jax.Array, weights: jax.Array):
+    """Replicate tokens per routing choice: (T, H) + (T, k) ->
+    ``(x_rep (T*k, H), eid (T*k,), w (T*k,))``, row-major in (token, choice)
+    order so ``unsort_combine`` can fold the k copies back."""
+    t, k = expert_ids.shape
+    x_rep = jnp.repeat(x, k, axis=0)
+    return x_rep, expert_ids.reshape(t * k), weights.reshape(t * k)
+
+
+def sort_by_expert(x: jax.Array, expert_ids: jax.Array, num_experts: int):
+    """Stable-sort rows by expert id (reference
+    ``calc_gather_scatter_index``).
+
+    Returns ``(x_sorted, splits, unsort_idx)``: ``splits`` (num_experts,)
+    int32 row counts per expert; ``x_sorted[i] = x[sort_idx[i]]`` and
+    ``x_sorted[unsort_idx] == x`` (the scatter index for the return trip).
+    """
+    sort_idx = jnp.argsort(expert_ids, stable=True)
+    x_sorted = jnp.take(x, sort_idx, axis=0)
+    splits = jnp.bincount(expert_ids, length=num_experts).astype(jnp.int32)
+    unsort_idx = jnp.argsort(sort_idx, stable=True)
+    return x_sorted, splits, unsort_idx
+
+
+def unsort_combine(y_sorted: jax.Array, unsort_idx: jax.Array,
+                   weights: jax.Array, k: int) -> jax.Array:
+    """Invert :func:`sort_by_expert` and reduce the k routed copies with
+    their weights (reference ``reduce_topk`` kernels): (T*k, N) -> (T, N).
+    """
+    y = jnp.take(y_sorted, unsort_idx, axis=0)          # back to (token, choice)
+    tk, n_dim = y.shape
+    y = y.reshape(tk // k, k, n_dim)
+    w = weights.reshape(tk // k, k, 1).astype(y.dtype)
+    return (y * w).sum(axis=1)
+
+
+def global_presort_index(perm: jax.Array,
+                         per_rank_unsort: jax.Array) -> jax.Array:
+    """Compose the block-merge permutation with each rank's local unsort.
+
+    ``perm``: (n*T,) from :func:`expert_block_permutation` (global expert
+    order <- concatenated per-rank sorted blocks); ``per_rank_unsort``:
+    (n, T) each rank's ``unsort_idx`` from :func:`sort_by_expert`.  Returns
+    ``g`` (n*T,) such that ``y_global_sorted[g]`` enumerates rows in the
+    original pre-sort (rank-major, then token, then routing choice) order —
+    the index the weighted top-k fold consumes.
+    """
+    n, tkk = per_rank_unsort.shape
+    inv = jnp.argsort(perm, stable=True)
+    block_idx = (per_rank_unsort
+                 + jnp.arange(n, dtype=per_rank_unsort.dtype)[:, None] * tkk
+                 ).reshape(-1)
+    return jnp.take(inv, block_idx)
+
+
+def expert_block_permutation(splits_per_rank: jax.Array,
+                             tokens_per_rank: int):
+    """Permutation merging n per-rank expert-sorted blocks into one
+    globally expert-sorted order (the index prep of the reference's
+    AG + scatter group-GEMM, ``allgather_group_gemm.py:398-605``).
+
+    ``splits_per_rank``: (n, E) counts per (source rank, expert);
+    ``tokens_per_rank``: the STATIC per-rank row count (splits sum to it —
+    passed explicitly so the whole index prep stays jittable).  Returns
+    ``(perm, total_splits)``: gathering rows of the n concatenated sorted
+    blocks with ``perm`` yields global expert order (rank-major within an
+    expert); ``total_splits`` (E,) sums counts over ranks.
+    """
+    n, e = splits_per_rank.shape
+    # expert id of each row of the concatenated blocks
+    idx = jnp.arange(tokens_per_rank)
+    eids = jax.vmap(
+        lambda counts: jnp.searchsorted(jnp.cumsum(counts), idx, side="right")
+    )(splits_per_rank).reshape(n * tokens_per_rank).astype(jnp.int32)
+    perm = jnp.argsort(eids, stable=True)
+    total_splits = splits_per_rank.sum(axis=0).astype(jnp.int32)
+    return perm, total_splits

@@ -16,7 +16,7 @@ import __graft_entry__ as graft  # noqa: E402
 def test_entry_jits_and_runs():
     fn, args = graft.entry()
     out = jax.jit(fn)(*args)
-    assert out.shape == (256, 512)
+    assert out.shape == (1, 128, 1024)
     assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
 
 

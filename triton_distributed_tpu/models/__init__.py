@@ -1,0 +1,8 @@
+"""Model configs, KV cache, Qwen3 decoder, and the inference engine
+(reference: ``python/triton_dist/models/`` — config, kv_cache, qwen,
+engine)."""
+
+from .config import ModelConfig
+from .engine import Engine, sample_token
+from .kv_cache import KVCache, advance, init_cache, reset, with_length, write_prefill
+from .qwen import Qwen3, QwenLayerParams, QwenParams

@@ -1,0 +1,27 @@
+"""Model configuration (reference: ``python/triton_dist/models/config.py`` /
+the HF config fields ``models/qwen.py`` consumes)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Qwen3-family decoder hyperparameters (defaults: a tiny test model;
+    Qwen3-8B-sized values in the docstrings)."""
+
+    num_layers: int = 2            # 36
+    hidden: int = 128              # 4096
+    intermediate: int = 256        # 12288
+    num_heads: int = 8             # 32
+    num_kv_heads: int = 4          # 8
+    head_dim: int = 64             # 128
+    vocab: int = 512               # 151936
+    max_length: int = 512          # 32k
+    rope_theta: float = 1e6
+    rms_eps: float = 1e-6
+    qk_norm: bool = True           # Qwen3 normalizes Q/K per head
+    dtype: jnp.dtype = jnp.bfloat16

@@ -1,0 +1,123 @@
+"""Inference engine: jitted prefill/decode loop with donated cache buffers.
+
+Reference: ``python/triton_dist/models/engine.py:37-136`` — KV-cache init,
+CUDA-graph capture of the decode step, and the ``serve`` loop (prefill,
+then token-by-token decode with sampling).
+
+TPU translation: CUDA-graph capture becomes ``jax.jit`` with the KV cache
+DONATED (``donate_argnums``) — the compiled executable reuses the cache
+buffers in place, which is exactly what the reference's static graph
+buffers achieve; the first call compiles (the capture), subsequent calls
+replay.  Sampling (temperature / top-p) is jnp, reference
+``utils.py sample_token``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..core.mesh import TP_AXIS
+from .config import ModelConfig
+from .kv_cache import KVCache, init_cache, reset
+from .qwen import Qwen3, QwenParams
+
+
+def sample_token(
+    logits: jax.Array,
+    key: jax.Array,
+    *,
+    temperature: float = 0.0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Greedy / temperature / nucleus sampling over (B, V) f32 logits
+    (reference ``sample_token``)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p; mask the rest
+        cutoff_idx = jnp.argmax(cum >= top_p, axis=-1)
+        cutoff = jnp.take_along_axis(
+            sorted_logits, cutoff_idx[:, None], axis=-1
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@dataclasses.dataclass
+class Engine:
+    """Owns model definition, params, cache, and the compiled step fns."""
+
+    model: Qwen3
+    params: QwenParams
+    batch: int = 1
+    temperature: float = 0.0
+    top_p: float = 1.0
+
+    def __post_init__(self):
+        c = self.model.config
+        self.cache = init_cache(
+            self.model.mesh, c.num_layers, self.batch, c.num_kv_heads,
+            c.max_length, c.head_dim, c.dtype, self.model.axis,
+        )
+        # the CUDA-graph analogue: jit with the cache donated so decode
+        # steps update the cache buffers in place
+        self._prefill = jax.jit(self.model.prefill, donate_argnums=(1,))
+        self._decode = jax.jit(self.model.decode, donate_argnums=(1,))
+
+    @classmethod
+    def build(cls, config: ModelConfig, mesh: Mesh, *, key=None,
+              batch: int = 1, axis: str = TP_AXIS, **kw) -> "Engine":
+        model = Qwen3(config, mesh, axis)
+        params = model.init(key if key is not None else jax.random.key(0))
+        return cls(model, params, batch=batch, **kw)
+
+    def prefill(self, input_ids: jax.Array) -> jax.Array:
+        """Run the prompt; returns last-position logits (B, V)."""
+        max_len = self.model.config.max_length
+        if input_ids.shape[1] > max_len:
+            raise ValueError(
+                f"prompt length {input_ids.shape[1]} exceeds "
+                f"max_length={max_len}"
+            )
+        self.cache = reset(self.cache)
+        logits, self.cache = self._prefill(self.params, self.cache, input_ids)
+        return logits[:, -1]
+
+    def decode_step(self, tokens: jax.Array) -> jax.Array:
+        logits, self.cache = self._decode(self.params, self.cache, tokens)
+        return logits
+
+    def generate(self, input_ids: jax.Array, gen_len: int,
+                 key: jax.Array | None = None) -> jax.Array:
+        """Prefill + ``gen_len - 1`` decode steps (reference
+        ``Engine.serve``).  Returns (B, gen_len) generated token ids."""
+        max_len = self.model.config.max_length
+        if input_ids.shape[1] + gen_len > max_len:
+            # dynamic_update_slice CLAMPS out-of-range writes: past
+            # max_length the cache would silently corrupt, so refuse
+            raise ValueError(
+                f"prompt {input_ids.shape[1]} + gen_len {gen_len} exceeds "
+                f"max_length={max_len}"
+            )
+        key = key if key is not None else jax.random.key(0)
+        logits = self.prefill(input_ids)
+        outs = []
+        tok = sample_token(logits, key, temperature=self.temperature,
+                           top_p=self.top_p)
+        outs.append(tok)
+        for i in range(gen_len - 1):
+            logits = self.decode_step(tok)
+            key = jax.random.fold_in(key, i)
+            tok = sample_token(logits, key, temperature=self.temperature,
+                               top_p=self.top_p)
+            outs.append(tok)
+        return jnp.stack(outs, axis=1)

@@ -1,0 +1,71 @@
+"""KV cache as a functional pytree, head-sharded over TP.
+
+Reference: ``python/triton_dist/models/kv_cache.py:29`` — preallocated
+(L, B, max_len, Hkv/world, D) tensors plus a device offset, mutated in
+place.  TPU translation: the same preallocated layout as immutable arrays
+sharded ``P(None, None, tp, None, None)`` on the head axis; updates are
+``lax.dynamic_update_slice`` (head-sharded update against head-sharded
+cache — XLA keeps the write local to each rank), and in-place semantics
+come from buffer donation at the jit boundary (``Engine``), the TPU
+analogue of the reference's static CUDA-graph buffers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.mesh import TP_AXIS
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    """k/v: (L, B, Hkv, max_len, D) head-sharded; kv_len: () int32 valid
+    positions (shared across layers, like the reference's kv_offset)."""
+
+    k: jax.Array
+    v: jax.Array
+    kv_len: jax.Array
+
+
+def init_cache(mesh: Mesh, num_layers: int, batch: int, kv_heads: int,
+               max_length: int, head_dim: int, dtype=jnp.bfloat16,
+               axis: str = TP_AXIS) -> KVCache:
+    shape = (num_layers, batch, kv_heads, max_length, head_dim)
+    sharding = NamedSharding(mesh, P(None, None, axis, None, None))
+    return KVCache(
+        k=jax.device_put(jnp.zeros(shape, dtype), sharding),
+        v=jax.device_put(jnp.zeros(shape, dtype), sharding),
+        kv_len=jnp.zeros((), jnp.int32),
+    )
+
+
+def write_prefill(cache: KVCache, layer: int, k_new: jax.Array,
+                  v_new: jax.Array) -> KVCache:
+    """Write a full prefill's (B, Hkv, S, D) at positions [0, S)."""
+    idx = (layer, 0, 0, 0, 0)
+    return dataclasses.replace(
+        cache,
+        k=jax.lax.dynamic_update_slice(cache.k, k_new[None], idx),
+        v=jax.lax.dynamic_update_slice(cache.v, v_new[None], idx),
+    )
+
+
+def advance(cache: KVCache, steps: jax.Array | int) -> KVCache:
+    return dataclasses.replace(
+        cache, kv_len=cache.kv_len + jnp.asarray(steps, jnp.int32)
+    )
+
+
+def with_length(cache: KVCache, length: jax.Array | int) -> KVCache:
+    return dataclasses.replace(
+        cache, kv_len=jnp.asarray(length, jnp.int32)
+    )
+
+
+def reset(cache: KVCache) -> KVCache:
+    return dataclasses.replace(cache, kv_len=jnp.zeros((), jnp.int32))

@@ -1,0 +1,62 @@
+"""Collective kernels under the interpret-mode race detector — the
+framework's stand-in for the reference's compute-sanitizer runs
+(SURVEY.md section 5; ``core.compilation.enable_race_detection``).
+
+Shapes here are deliberately unique: the op builders lru-cache compiled
+calls, and a cached call would keep the interpret params it was built
+with — a fresh shape forces a rebuild under detect_races=True.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.comm import all_gather, all_reduce, reduce_scatter
+from triton_distributed_tpu.comm.allreduce import AllReduceConfig, AllReduceMethod
+from triton_distributed_tpu.comm.reduce_scatter import ReduceScatterConfig
+from triton_distributed_tpu.core import compilation
+from triton_distributed_tpu.core.mesh import TP_AXIS, make_mesh
+from triton_distributed_tpu.core.utils import rand_tensor
+
+
+@pytest.fixture
+def race_detection():
+    compilation.enable_race_detection(True)
+    yield
+    compilation.enable_race_detection(False)
+
+
+@pytest.fixture
+def mesh4():
+    return make_mesh({TP_AXIS: 4}, devices=jax.devices()[:4])
+
+
+def _shard(mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P(TP_AXIS, None)))
+
+
+def test_all_gather_race_free(race_detection, mesh4):
+    x = rand_tensor((4 * 40, 128), jnp.float32)  # unique shape: rebuild
+    out = jax.block_until_ready(all_gather(_shard(mesh4, x), mesh4))
+    assert out.shape == x.shape
+
+
+def test_reduce_scatter_race_free(race_detection, mesh4):
+    x = rand_tensor((4 * 32, 128), jnp.float32, scale=0.1)
+    out = jax.block_until_ready(reduce_scatter(
+        _shard(mesh4, x), mesh4, config=ReduceScatterConfig(bm=8, bn=128)
+    ))
+    assert out.shape == (32, 128)
+
+
+@pytest.mark.parametrize("method", [
+    AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT,
+])
+def test_all_reduce_race_free(race_detection, mesh4, method):
+    x = rand_tensor((4 * 32, 128), jnp.float32, scale=0.1)
+    out = jax.block_until_ready(all_reduce(
+        _shard(mesh4, x), mesh4, method=method,
+        config=AllReduceConfig(bm=8, bn=128),
+    ))
+    assert out.shape == (32, 128)

@@ -1,0 +1,104 @@
+"""Stress + straggler coverage (reference ``test/stress/stress_test_ag_gemm.py``
+randomized shapes and the straggler options of ``allreduce.py:146``):
+randomized-shape sweeps of the fused ops, and a host-callback-injected
+straggler rank that must not deadlock or corrupt any collective."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.comm import all_gather, all_reduce
+from triton_distributed_tpu.comm.allreduce import AllReduceConfig, AllReduceMethod
+from triton_distributed_tpu.core.mesh import TP_AXIS, make_mesh
+from triton_distributed_tpu.ops import ag_gemm, gemm_rs
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    return make_mesh({TP_AXIS: 4}, devices=jax.devices()[:4])
+
+
+def _straggle(x, mesh, lagger: int = 0, ms: float = 30.0):
+    """Delay one rank's entry into whatever consumes ``x`` next (reference
+    ``sleep_async`` straggler injection): a host callback sleeps on the
+    lagging rank, and its result is data-woven into the output."""
+    def local(x_loc):
+        r = jax.lax.axis_index(TP_AXIS)
+
+        def cb(rv):
+            if int(rv) == lagger:
+                time.sleep(ms / 1e3)
+            return np.zeros((), np.float32)
+
+        tok = jax.pure_callback(
+            cb, jax.ShapeDtypeStruct((), jnp.float32), r
+        )
+        return x_loc + tok.astype(x_loc.dtype)
+
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=P(TP_AXIS, None),
+        out_specs=P(TP_AXIS, None),
+    )(x)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_ag_gemm_randomized_shapes(mesh4, seed):
+    rng = np.random.default_rng(seed)
+    n = 4
+    m = 8 * n * int(rng.integers(1, 4))
+    k = 128 * int(rng.integers(1, 3))
+    nn = n * 64 * int(rng.integers(1, 3))
+    a = jnp.asarray(rng.standard_normal((m, k)).astype(np.float32) * 0.1)
+    b = jnp.asarray(rng.standard_normal((k, nn)).astype(np.float32) * 0.1)
+    a_s = jax.device_put(a, NamedSharding(mesh4, P(TP_AXIS, None)))
+    b_s = jax.device_put(b, NamedSharding(mesh4, P(None, TP_AXIS)))
+    out = ag_gemm(a_s, b_s, mesh4)
+    want = np.asarray(a) @ np.asarray(b)
+    assert np.allclose(np.asarray(jax.device_get(out)), want,
+                       atol=1e-3, rtol=1e-3)
+
+
+@pytest.mark.parametrize("lagger", [0, 2])
+def test_all_gather_with_straggler(mesh4, lagger):
+    n, m, r = 4, 32, 128
+    x = jnp.asarray(
+        np.random.default_rng(7).standard_normal((n * m, r)).astype(np.float32)
+    )
+    xs = jax.device_put(x, NamedSharding(mesh4, P(TP_AXIS, None)))
+    delayed = _straggle(xs, mesh4, lagger=lagger)
+    out = jax.block_until_ready(all_gather(delayed, mesh4))
+    assert np.allclose(np.asarray(jax.device_get(out)), np.asarray(x))
+
+
+def test_all_reduce_with_straggler(mesh4):
+    n, m, r = 4, 32, 128
+    x = jnp.asarray(
+        np.random.default_rng(8).standard_normal((n * m, r)).astype(np.float32)
+        * 0.1
+    )
+    xs = jax.device_put(x, NamedSharding(mesh4, P(TP_AXIS, None)))
+    delayed = _straggle(xs, mesh4, lagger=1)
+    out = jax.block_until_ready(all_reduce(
+        delayed, mesh4, method=AllReduceMethod.TWO_SHOT,
+        config=AllReduceConfig(bm=8, bn=128),
+    ))
+    want = np.asarray(x).reshape(n, m, r).sum(0)
+    assert np.allclose(np.asarray(jax.device_get(out)), want,
+                       atol=1e-4, rtol=1e-4)
+
+
+def test_gemm_rs_repeated_pressure(mesh4):
+    """Back-to-back fused invocations (semaphore reuse under load)."""
+    n, m, k, nn = 4, 64, 128, 128
+    rng = np.random.default_rng(9)
+    a = jnp.asarray(rng.standard_normal((m, k)).astype(np.float32) * 0.1)
+    b = jnp.asarray(rng.standard_normal((k, nn)).astype(np.float32) * 0.1)
+    a_s = jax.device_put(a, NamedSharding(mesh4, P(None, TP_AXIS)))
+    b_s = jax.device_put(b, NamedSharding(mesh4, P(TP_AXIS, None)))
+    outs = [jax.device_get(gemm_rs(a_s, b_s, mesh4)) for _ in range(5)]
+    for o in outs[1:]:
+        np.testing.assert_array_equal(np.asarray(outs[0]), np.asarray(o))

@@ -1,0 +1,80 @@
+"""Tools: AOT serialize round trip, SOL perf models, profiling helpers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.tools import (
+    annotate,
+    allreduce_sol_ms,
+    allgather_sol_ms,
+    aot_compile,
+    chip_spec,
+    gemm_sol_ms,
+    group_profile,
+    load,
+    overlap_efficiency,
+    save,
+)
+
+
+def test_aot_round_trip(tmp_path):
+    def f(x, y):
+        return jnp.sin(x) @ y
+
+    a = jnp.ones((16, 16), jnp.float32)
+    b = jnp.eye(16, dtype=jnp.float32)
+    compiled = aot_compile(f, a, b)
+    want = np.asarray(compiled(a, b))
+    p = str(tmp_path / "f.aotx")
+    save(compiled, p)
+    re = load(p)
+    try:
+        got = np.asarray(re(a, b))
+    except jax.errors.JaxRuntimeError as exc:
+        # XLA:CPU loader quirk (see tools/aot.py docstring): the reloaded
+        # executable binds to ALL virtual devices; the serialized artifact
+        # itself round-trips — executing it needs matching topology (TPU).
+        assert "shards" in str(exc)
+        pytest.xfail("XLA:CPU reload rebinds to the full device set")
+    np.testing.assert_allclose(got, want)
+
+
+def test_gemm_sol_monotonic():
+    t1 = gemm_sol_ms(1024, 1024, 1024, device_kind="TPU v5e")
+    t2 = gemm_sol_ms(2048, 2048, 2048, device_kind="TPU v5e")
+    assert 0 < t1 < t2
+    # bigger chip is faster
+    assert gemm_sol_ms(4096, 4096, 4096, device_kind="TPU v5p") < \
+        gemm_sol_ms(4096, 4096, 4096, device_kind="TPU v5e")
+
+
+def test_collective_sol_scaling():
+    # more ranks -> more wire per rank for AG
+    assert allgather_sol_ms(1 << 20, 8) > allgather_sol_ms(1 << 20, 2)
+    # AR moves ~2x the RS/AG volume at large n
+    ar = allreduce_sol_ms(1 << 24, 8)
+    ag = allgather_sol_ms((1 << 24) // 8, 8)
+    assert ar > ag
+
+
+def test_chip_spec_fallback():
+    assert chip_spec("TPU v5e").name == "TPU v5e"
+    assert chip_spec("weird-device").name == "unknown"
+
+
+def test_overlap_efficiency_bounds():
+    assert overlap_efficiency(10.0, 10.0, 5.0) == 1.0   # fully hidden
+    assert overlap_efficiency(15.0, 10.0, 5.0) == 0.0   # fully serial
+    assert 0.0 < overlap_efficiency(12.0, 10.0, 5.0) < 1.0
+
+
+def test_profile_and_annotate(tmp_path):
+    with group_profile("t", str(tmp_path)) as path:
+        with annotate("block"):
+            jnp.zeros((8,)).block_until_ready()
+    import os
+    assert path and os.path.isdir(path)
+    with group_profile("t2", str(tmp_path), enabled=False) as path2:
+        assert path2 is None

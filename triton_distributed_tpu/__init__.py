@@ -20,6 +20,9 @@ Layer map (vs SURVEY.md section 1):
 - ``parallel`` shard_map/pjit conventions and sharding rules
 - ``tune``     contextual autotuner
 - ``tools``    profiling, AOT serialization, perf (SOL) models
+
+(host-side helpers live in ``core.utils``; there is deliberately no
+separate ``utils`` package)
 """
 
 __version__ = "0.1.0"

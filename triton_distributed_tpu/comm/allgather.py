@@ -160,26 +160,41 @@ _KERNELS = {
 }
 
 
-@functools.lru_cache(maxsize=None)
-def _build_all_gather(
+def resolve_method(
+    method: AllGatherMethod,
+    shard_shape: tuple[int, ...],
+    dtype,
+    num_ranks: int,
+) -> AllGatherMethod:
+    """Resolve AUTO to a concrete method from per-shard bytes — the ONE
+    home of the size heuristic (used by the flat entry, the hierarchical
+    entry, and the persistent layer)."""
+    if method != AllGatherMethod.AUTO:
+        return method
+    nbytes = int(jnp.dtype(dtype).itemsize)
+    for d in shard_shape:
+        nbytes *= d
+    return choose_method(nbytes, num_ranks)
+
+
+def _build_ag_call(
     mesh: Mesh,
     axis: str,
     method: AllGatherMethod,
     shard_shape: tuple[int, ...],
     dtype: jnp.dtype,
 ):
-    """Build + jit the collective once per (mesh, axis, method, shape, dtype).
-
-    Cached so steady-state calls hit the jit cache instead of re-tracing
-    (jax.jit caches by function identity; a fresh closure every call would
-    recompile every call)."""
+    """The bare per-device Pallas call (no shard_map wrapper) — reused by
+    the flat and hierarchical entries.  (The persistent layer builds its
+    own variant with a workspace-aliased output; it shares the kernel
+    bodies via ``_KERNELS``.)"""
     team = Team.of(mesh, axis)
     n = team.size
     m_local = shard_shape[0]
     kern, two_send_sems = _KERNELS[method]
     kernel = functools.partial(kern, team, m_local)
 
-    call = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n * m_local, *shard_shape[1:]), dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
@@ -197,12 +212,94 @@ def _build_all_gather(
         interpret=compilation.interpret_mode(),
     )
 
+
+@functools.lru_cache(maxsize=None)
+def _build_all_gather(
+    mesh: Mesh,
+    axis: str,
+    method: AllGatherMethod,
+    shard_shape: tuple[int, ...],
+    dtype: jnp.dtype,
+):
+    """Build + jit the collective once per (mesh, axis, method, shape, dtype).
+
+    Cached so steady-state calls hit the jit cache instead of re-tracing
+    (jax.jit caches by function identity; a fresh closure every call would
+    recompile every call)."""
+    call = _build_ag_call(mesh, axis, method, shard_shape, dtype)
     ndim = len(shard_shape)
     return compilation.jit_shard_map(
         call, mesh,
         in_specs=P(axis, *([None] * (ndim - 1))),
         out_specs=P(*([None] * ndim)),
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_hierarchical(
+    mesh: Mesh,
+    inner_axis: str,
+    outer_axis: str,
+    method: AllGatherMethod,
+    shard_shape: tuple[int, ...],
+    dtype: jnp.dtype,
+):
+    n_in = mesh.shape[inner_axis]
+    n_out = mesh.shape[outer_axis]
+    call = _build_ag_call(mesh, inner_axis, method, shard_shape, dtype)
+    m_in = n_in * shard_shape[0]
+
+    def local(x_loc):
+        inner_g = call(x_loc)                            # ICI Pallas ring
+        outer_g = jax.lax.all_gather(inner_g, outer_axis)   # DCN via XLA
+        return outer_g.reshape(n_out * m_in, *shard_shape[1:])
+
+    ndim = len(shard_shape)
+    return compilation.jit_shard_map(
+        local, mesh,
+        in_specs=P((outer_axis, inner_axis), *([None] * (ndim - 1))),
+        out_specs=P(*([None] * ndim)),
+    )
+
+
+def hierarchical_all_gather(
+    x: jax.Array,
+    mesh: Mesh,
+    inner_axis: str,
+    outer_axis: str,
+    *,
+    method: AllGatherMethod = AllGatherMethod.AUTO,
+) -> jax.Array:
+    """Two-level AllGather over an (outer x inner) mesh — the reference's
+    2D inter-node AG (``allgather.py:442-601``: intra-node copy-engine ring
+    + cross-node staging).
+
+    TPU mapping: the ``inner_axis`` (ICI — within a slice) level is this
+    module's Pallas ring/push kernel; the ``outer_axis`` (DCN — across
+    slices) level is ``lax.all_gather``, because TPU remote DMA is
+    device-initiated only over ICI — cross-slice traffic must ride XLA's
+    DCN collectives (SURVEY.md section 7).  Rows come back in GLOBAL rank
+    order (outer-major), matching a flat AG over a combined axis.
+
+    ``x``: (n_out * n_in * M, R) sharded over both axes on dim 0.
+    """
+    n_in = mesh.shape[inner_axis]
+    n_out = mesh.shape[outer_axis]
+    if n_out == 1:
+        return all_gather(x, mesh, inner_axis, method=method)
+    m_total = x.shape[0]
+    if m_total % (n_in * n_out):
+        raise ValueError(
+            f"dim0 {m_total} not divisible by "
+            f"{outer_axis}*{inner_axis} = {n_out * n_in}"
+        )
+    m_local = m_total // (n_in * n_out)
+    shard_shape = (m_local, *x.shape[1:])
+    method = resolve_method(method, shard_shape, x.dtype, n_in)
+    fn = _build_hierarchical(
+        mesh, inner_axis, outer_axis, method, shard_shape, jnp.dtype(x.dtype)
+    )
+    return fn(x)
 
 
 def all_gather(
@@ -228,11 +325,7 @@ def all_gather(
     m_local = m_total // n
     shard_shape = (m_local, *x.shape[1:])
 
-    if method == AllGatherMethod.AUTO:
-        nbytes = int(jnp.dtype(x.dtype).itemsize) * m_local
-        for d in shard_shape[1:]:
-            nbytes *= d
-        method = choose_method(nbytes, n)
+    method = resolve_method(method, shard_shape, x.dtype, n)
 
     fn = _build_all_gather(mesh, axis, method, shard_shape, jnp.dtype(x.dtype))
     return fn(x)

@@ -146,10 +146,12 @@ def jit_shard_map(fn, mesh, in_specs, out_specs, *, static_argnums=(), donate_ar
 
 
 def aot_compile(jitted, *example_args, **example_kwargs):
-    """Ahead-of-time compile a jitted function (reference: the 1.7k-LoC AOT
-    C toolchain ``tools/compile_aot.py`` + ``triton_aot_runtime.cc``; on TPU
-    this is `.lower().compile()` — see ``tools/aot.py`` for serialization)."""
-    return jitted.lower(*example_args, **example_kwargs).compile()
+    """Ahead-of-time compile (reference: the 1.7k-LoC AOT C toolchain
+    ``tools/compile_aot.py`` + ``triton_aot_runtime.cc``).  Delegates to
+    ``tools.aot`` — the one home of the AOT path, including serialization."""
+    from ..tools.aot import aot_compile as _aot
+
+    return _aot(jitted, *example_args, **example_kwargs)
 
 
 def reset_interpret_state() -> None:

@@ -168,13 +168,13 @@ def clip_block(block: int, dim: int) -> int:
     """Largest sublane-aligned divisor of ``dim`` that is <= ``block`` — used
     to normalize tile-size configs to a problem.
 
-    Prefers divisors that are multiples of the TPU sublane granule (8) so the
-    tile stays legal for Mosaic's lane tiling on real hardware; only when
-    ``dim`` admits no aligned divisor does it fall back to the plain largest
-    divisor, with a warning (CPU interpret mode accepts any size, so silent
-    misalignment here would surface only on real TPU)."""
-    import warnings
-
+    Prefers divisors that are multiples of the TPU sublane granule (8) so
+    the tile stays legal for Mosaic's lane tiling on real hardware.  A
+    *partial* unaligned tile (dim >= 8 with no aligned divisor) raises:
+    CPU interpret mode would accept it silently and the misalignment would
+    only surface as a mis-tiled kernel on real TPU — pad the operand to the
+    8-row granule instead.  A single whole-dim tile (b == dim) is safe at
+    any size: Mosaic pads a full dim to the granule."""
     b = min(block, dim)
     if dim >= 8:
         for cand in range(b, 7, -1):
@@ -182,14 +182,11 @@ def clip_block(block: int, dim: int) -> int:
                 return cand
     while dim % b:
         b -= 1
-    # b == dim (a single whole-dim tile) is safe: Mosaic pads a full dim to
-    # the granule; only a *partial* unaligned tile mis-strides.
     if dim >= 8 and b < dim:
-        warnings.warn(
-            f"tile size {block} clipped to non-sublane-aligned {b} for dim "
-            f"{dim} (no divisor that is a multiple of 8 and <= {block}); "
-            "this may mis-tile under Mosaic on real TPU",
-            stacklevel=3,
+        raise ValueError(
+            f"tile size {block} would clip to non-sublane-aligned {b} for "
+            f"dim {dim} (no divisor that is a multiple of 8 and <= "
+            f"{block}); pad the dimension to a multiple of 8"
         )
     return b
 

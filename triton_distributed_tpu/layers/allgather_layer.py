@@ -1,0 +1,124 @@
+"""Persistent-workspace AllGather layer (double-buffered).
+
+Reference: ``python/triton_dist/layers/nvidia/low_latency_allgather_layer.py:30``
+— a layer owning a persistent symmetric workspace and parity signal sets so
+back-to-back AllGathers never reallocate and a consumer may keep reading
+call k's output while call k+1 runs.
+
+TPU translation: the workspace is a :class:`core.symm.SymmetricBuffer` pair
+(parity slots); each call writes its parity's buffer IN PLACE via Pallas
+``input_output_aliases`` + jit donation — the XLA-world equivalent of the
+reference's preallocated symmetric heap tensors.  The LL flag-in-data
+protocol collapses: Pallas semaphores are kernel-scoped and the entry
+barrier is 2 hops, so flags woven into payloads buy nothing on TPU
+(SURVEY.md section 7); what the layer keeps is the allocation-free steady
+state and the one-call-back read guarantee.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..comm import allgather as ag
+from ..core import compilation
+from ..core.mesh import TP_AXIS
+from ..lang.primitives import Team
+
+
+@functools.lru_cache(maxsize=None)
+def _build_ws_all_gather(
+    mesh: Mesh,
+    axis: str,
+    method: ag.AllGatherMethod,
+    shard_shape: tuple[int, ...],
+    dtype: jnp.dtype,
+):
+    """AG call writing into a caller-owned workspace (aliased in/out)."""
+    team = Team.of(mesh, axis)
+    n = team.size
+    m_local = shard_shape[0]
+    kern, two_send_sems = ag._KERNELS[method]
+    inner = functools.partial(kern, team, m_local)
+
+    def kernel(x_ref, ws_ref, out_ref, *scratch):
+        del ws_ref  # same memory as out_ref (aliased)
+        inner(x_ref, out_ref, *scratch)
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (n * m_local, *shard_shape[1:]), dtype
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        input_output_aliases={1: 0},
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)) if two_send_sems
+            else pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((n,)),
+        ],
+        compiler_params=compilation.compiler_params(
+            collective=True,
+            collective_id=compilation.collective_id("allgather"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+
+    ndim = len(shard_shape)
+    return compilation.jit_shard_map(
+        call, mesh,
+        in_specs=(P(axis, *([None] * (ndim - 1))), P(*([None] * ndim))),
+        out_specs=P(*([None] * ndim)),
+        donate_argnums=(1,),
+    )
+
+
+@dataclasses.dataclass
+class AllGatherLayer:
+    """Double-buffered persistent AG: ``layer(x)`` gathers dim 0 of the
+    ``axis``-sharded ``x`` into the current parity's workspace; the
+    PREVIOUS call's result stays intact until the call after next."""
+
+    mesh: Mesh
+    local_rows: int
+    trailing: tuple[int, ...]
+    dtype: jnp.dtype = jnp.bfloat16
+    axis: str = TP_AXIS
+    method: ag.AllGatherMethod = ag.AllGatherMethod.AUTO
+
+    def __post_init__(self):
+        n = self.mesh.shape[self.axis]
+        shape = (n * self.local_rows, *self.trailing)
+        method = ag.resolve_method(
+            self.method, (self.local_rows, *self.trailing), self.dtype, n
+        )
+        self._fn = _build_ws_all_gather(
+            self.mesh, self.axis, method,
+            (self.local_rows, *self.trailing), jnp.dtype(self.dtype),
+        )
+        from jax.sharding import NamedSharding
+
+        rep = NamedSharding(self.mesh, P(*([None] * (1 + len(self.trailing)))))
+        self._ws = [
+            jax.device_put(jnp.zeros(shape, self.dtype), rep)
+            for _ in range(2)
+        ]
+        self._calls = 0
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        slot = self._calls % 2
+        out = self._fn(x, self._ws[slot])
+        self._ws[slot] = out
+        self._calls += 1
+        return out

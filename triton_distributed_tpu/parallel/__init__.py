@@ -1,0 +1,36 @@
+"""Parallelism conventions: mesh axes, sharding helpers, and the shard_map
+wrapper — one namespace for how this framework spells SPMD.
+
+This is deliberately a facade over ``core``: the conventions themselves
+(axis names, the all-device mesh rule, check_vma-off shard_map for Pallas
+outputs) live next to the runtime; this module is the documented import
+surface the layers/models/tests use.  Reference analogue: the TP/EP group
+bookkeeping of ``python/triton_dist/utils.py:190`` (``TP_GROUP`` etc.),
+which on TPU collapses into named mesh axes + PartitionSpecs.
+
+Conventions:
+
+- axes: ``dp`` (data), ``tp`` (tensor), ``sp`` (sequence/context),
+  ``ep`` (expert), ``pp`` (pipeline); DCN-level axes are prefixed
+  ``dcn_`` (see ``is_dcn_axis``).
+- weights: column-parallel = P(None, tp); row-parallel = P(tp, None);
+  per-expert = P(ep, None, None).
+- activations: token-sharded = P(tp, None) (sequence parallel regions);
+  replicated = P(None, None) (small-M decode regions).
+"""
+
+from ..core.compilation import jit_shard_map
+from ..core.mesh import (
+    DP_AXIS,
+    EP_AXIS,
+    PP_AXIS,
+    SP_AXIS,
+    TP_AXIS,
+    axis_size,
+    is_dcn_axis,
+    make_mesh,
+    replicated,
+    shard,
+    sharding,
+    tp_mesh,
+)

@@ -1,0 +1,14 @@
+"""Tooling: AOT compile/serialize, SOL perf models, profiling (reference:
+``python/triton_dist/tools/`` + the profiling half of ``utils.py``)."""
+
+from .aot import aot_compile, deserialize, load, save, serialize
+from .perf_model import (
+    ChipSpec,
+    allgather_sol_ms,
+    allreduce_sol_ms,
+    chip_spec,
+    gemm_sol_ms,
+    overlap_efficiency,
+    reduce_scatter_sol_ms,
+)
+from .profile import annotate, group_profile, memory_stats

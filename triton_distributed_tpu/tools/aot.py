@@ -1,0 +1,69 @@
+"""Ahead-of-time compilation and executable serialization.
+
+Reference: the AOT toolchain — ``python/triton_dist/tools/compile_aot.py:249-470``
+(C header/source generation per kernel signature) and
+``csrc/triton_aot_runtime.cc`` (the hand-written loader/launcher runtime).
+
+On TPU that entire layer collapses into XLA's own AOT path: ``.lower()``
+``.compile()`` produces a serializable executable, and
+``jax.experimental.serialize_executable`` replaces the generated C runtime
+— the loader is ~10 lines instead of 1.7k LoC because XLA owns the launch
+ABI.  What remains worth shipping is the ergonomics: compile a step once,
+persist it next to the model, reload without retracing.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+import jax
+
+
+def aot_compile(fn: Callable | Any, *example_args, **example_kwargs):
+    """Trace + compile ``fn`` (jitted or plain) for the example arguments.
+
+    Returns the Compiled executable (callable with matching shapes).
+    """
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    return fn.lower(*example_args, **example_kwargs).compile()
+
+
+def serialize(compiled) -> bytes:
+    """Serialize a Compiled executable (+ its in/out trees) to bytes."""
+    import pickle
+
+    from jax.experimental import serialize_executable as se
+
+    payload, in_tree, out_tree = se.serialize(compiled)
+    return pickle.dumps((payload, in_tree, out_tree))
+
+
+def deserialize(data: bytes):
+    """Rebuild a callable executable from :func:`serialize` bytes.
+
+    Must run on a compatible device topology (same device kinds/counts) —
+    the same constraint the reference's cubin loader has.  Known quirk: the
+    XLA:CPU loader rebinds the executable to the full local device set, so
+    on a multi-device virtual CPU platform a 1-device executable reloads
+    expecting all-device sharded args; real-TPU reloads bind correctly.
+    """
+    import pickle
+
+    from jax.experimental import serialize_executable as se
+
+    payload, in_tree, out_tree = pickle.loads(data)
+    return se.deserialize_and_load(payload, in_tree, out_tree)
+
+
+def save(compiled, path: str) -> None:
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(serialize(compiled))
+    os.replace(tmp, path)
+
+
+def load(path: str):
+    with open(path, "rb") as f:
+        return deserialize(f.read())

@@ -1,0 +1,105 @@
+"""Speed-of-light (SOL) performance models for GEMM and collectives.
+
+Reference: ``python/triton_dist/tools`` perf models —
+``gemm_perf_model.py:232`` (``get_tensorcore_tflops`` / DRAM roofline) and
+``comm_perf_model.py:92-110`` (NVLink ring bandwidth models).  Same roles
+here with TPU hardware tables: the GEMM model takes
+max(MXU time, HBM time) and the collective models use the standard ring
+formulas over per-chip ICI bandwidth.
+
+Numbers are public per-chip peaks (bf16 dense MXU TFLOP/s, HBM GB/s,
+aggregate ICI GB/s per chip); unknown chips fall back conservatively.
+Used for "fraction of SOL" reporting in benches and the autotuner's sanity
+threshold, not for correctness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    name: str
+    bf16_tflops: float   # dense MXU peak
+    hbm_gbps: float      # HBM bandwidth
+    ici_gbps: float      # aggregate ICI bandwidth per chip (all links)
+
+
+# public TPU specs (approximate board peaks); alias lists cover the real
+# device_kind strings JAX reports ("TPU v5 lite" for v5e, "TPU v6 lite"/
+# "TPU v6e" for v6e, ...)
+_CHIPS = [
+    (("v5 lite", "v5e", "v5litepod"), ChipSpec("TPU v5e", 197.0, 819.0, 186.0)),
+    (("v5p", "v5"), ChipSpec("TPU v5p", 459.0, 2765.0, 536.0)),
+    (("v6 lite", "v6e", "trillium"), ChipSpec("TPU v6e", 918.0, 1640.0, 230.0)),
+    (("v4",), ChipSpec("TPU v4", 275.0, 1228.0, 268.0)),
+]
+
+_FALLBACK = ChipSpec("unknown", 180.0, 800.0, 180.0)
+
+
+def chip_spec(device_kind: str | None = None) -> ChipSpec:
+    if device_kind is None:
+        from ..core import platform
+
+        device_kind = platform.device_kind()
+    kind = device_kind.lower()
+    for aliases, spec in _CHIPS:
+        if any(a in kind for a in aliases):
+            return spec
+    return _FALLBACK
+
+
+def _dtype_bytes(dtype) -> int:
+    return int(jnp.dtype(dtype).itemsize)
+
+
+def gemm_sol_ms(m: int, n: int, k: int, dtype=jnp.bfloat16,
+                device_kind: str | None = None) -> float:
+    """Roofline GEMM time: max(FLOPs / MXU peak, bytes / HBM peak)
+    (reference ``get_tensorcore_tflops`` + ``estimate_gemm_sol_time_ms``)."""
+    spec = chip_spec(device_kind)
+    flops = 2.0 * m * n * k
+    t_flops = flops / (spec.bf16_tflops * 1e12)
+    b = _dtype_bytes(dtype)
+    bytes_moved = b * (m * k + k * n + m * n)
+    t_mem = bytes_moved / (spec.hbm_gbps * 1e9)
+    return max(t_flops, t_mem) * 1e3
+
+
+def allgather_sol_ms(nbytes_per_rank: int, num_ranks: int,
+                     device_kind: str | None = None) -> float:
+    """Ring AG: each rank receives (n-1)/n of the gathered payload over its
+    ICI links (reference ``comm_perf_model.py:92``)."""
+    spec = chip_spec(device_kind)
+    wire = nbytes_per_rank * (num_ranks - 1)
+    return wire / (spec.ici_gbps * 1e9) * 1e3
+
+
+def reduce_scatter_sol_ms(nbytes_per_rank: int, num_ranks: int,
+                          device_kind: str | None = None) -> float:
+    """Ring RS moves the same volume as ring AG."""
+    return allgather_sol_ms(nbytes_per_rank, num_ranks, device_kind)
+
+
+def allreduce_sol_ms(nbytes: int, num_ranks: int,
+                     device_kind: str | None = None) -> float:
+    """Two-shot (RS + AG) ring AR: 2 (n-1)/n * bytes per link."""
+    spec = chip_spec(device_kind)
+    wire = 2.0 * nbytes * (num_ranks - 1) / num_ranks
+    return wire / (spec.ici_gbps * 1e9) * 1e3
+
+
+def overlap_efficiency(t_measured_ms: float, t_gemm_ms: float,
+                       t_comm_ms: float) -> float:
+    """How much of the comm time the fused op hid:
+    1.0 = fully overlapped (t == max parts), 0.0 = fully serialized
+    (t == sum of parts)."""
+    lo = max(t_gemm_ms, t_comm_ms)
+    hi = t_gemm_ms + t_comm_ms
+    if hi == lo:
+        return 1.0
+    return float(min(1.0, max(0.0, (hi - t_measured_ms) / (hi - lo))))

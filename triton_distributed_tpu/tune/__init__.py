@@ -1,0 +1,9 @@
+"""Contextual autotuner (reference: ``python/triton_dist/autotuner.py``)."""
+
+from .autotuner import (
+    Autotuner,
+    TuneResult,
+    autotune,
+    matmul_tile_candidates,
+    tuned_matmul,
+)

@@ -1,0 +1,208 @@
+"""Contextual autotuner: measure candidate configs on the real device,
+agree across processes, persist winners.
+
+Reference: ``python/triton_dist/autotuner.py:97-256`` — the ``@autotune``
+decorator times each candidate config on the first real invocation
+(`contextual`: with the caller's actual tensors), synchronizes the choice
+across ranks, and caches per call-site key.
+
+TPU translation: candidates are whole JITTED THUNKS (a config change means
+a different Pallas grid, so the unit of timing is the compiled executable,
+not a kernel variant), timed with the slope method (``core.utils.perf_func``
+— robust to tunneled-backend sync cost).  Cross-process agreement takes the
+ALL-RANK MEAN of each candidate's time via ``jax.lax.pmean`` over a 1-chip
+mesh collective when multiple processes exist (every process must pick the
+same config or collective kernels would disagree on grids); single-process
+runs skip it.  Winners persist to a JSON cache keyed by (name, shapes,
+dtype, device kind) so steady-state serving never re-tunes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Any, Callable, Iterable, Sequence
+
+import jax
+
+from ..core import platform
+from ..core.utils import perf_func, dist_print
+
+_DEFAULT_CACHE = os.path.join(
+    os.path.expanduser("~"), ".cache", "triton_distributed_tpu",
+    "autotune.json",
+)
+
+
+def cache_path() -> str:
+    return os.environ.get("TDT_AUTOTUNE_CACHE", _DEFAULT_CACHE)
+
+
+@dataclasses.dataclass
+class TuneResult:
+    config: Any
+    time_ms: float
+    from_cache: bool
+
+
+class Autotuner:
+    """Process-wide tuner with a persistent JSON winner cache."""
+
+    def __init__(self, path: str | None = None):
+        self._path = path
+        self._mem: dict[str, int] = {}
+        self._times: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._disk: dict[str, int] | None = None
+
+    # -- persistence ------------------------------------------------------
+
+    def _load_disk(self) -> dict[str, int]:
+        if self._disk is None:
+            p = self._path or cache_path()
+            try:
+                with open(p) as f:
+                    self._disk = {k: int(v) for k, v in json.load(f).items()}
+            except (OSError, ValueError):
+                self._disk = {}
+        return self._disk
+
+    def _save_disk(self) -> None:
+        p = self._path or cache_path()
+        try:
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            tmp = p + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(self._load_disk(), f, indent=1, sort_keys=True)
+            os.replace(tmp, p)
+        except OSError:
+            pass  # caching is best-effort; tuning results stay in memory
+
+    # -- timing -----------------------------------------------------------
+
+    def _measure(self, thunk: Callable[[], Any], iters: int) -> float:
+        _, ms = perf_func(thunk, iters=iters, warmup_iters=2)
+        return ms
+
+    def _agree(self, times: list[float]) -> list[float]:
+        """Average candidate times over processes so every rank picks the
+        same winner (reference: the rank sync in ``autotuner.py:200-230``)."""
+        if jax.process_count() == 1:
+            return times
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(times)
+        mean = jax.pmap(  # one device per process suffices for the mean
+            lambda x: jax.lax.pmean(x, "p"), axis_name="p"
+        )(arr[None])[0]
+        return [float(t) for t in mean]
+
+    # -- entry ------------------------------------------------------------
+
+    def tune(
+        self,
+        name: str,
+        key: Sequence[Any],
+        candidates: Sequence[Any],
+        make_thunk: Callable[[Any], Callable[[], Any]],
+        *,
+        iters: int = 8,
+        verbose: bool = False,
+    ) -> TuneResult:
+        """Pick the fastest candidate for ``key``.
+
+        ``make_thunk(candidate)`` returns a zero-arg thunk running the op
+        with that candidate config (closing over the caller's REAL
+        arguments — that is the "contextual" part).  Invalid candidates may
+        raise during their first call and are skipped.
+        """
+        ck = json.dumps([name, *map(str, key)])
+        with self._lock:
+            if ck in self._mem:
+                return TuneResult(candidates[self._mem[ck]],
+                                  self._times.get(ck, float("nan")), True)
+            disk = self._load_disk()
+            if ck in disk and disk[ck] < len(candidates):
+                self._mem[ck] = disk[ck]
+                return TuneResult(candidates[disk[ck]], float("nan"), True)
+
+        times: list[float] = []
+        multi = jax.process_count() > 1
+        for cand in candidates:
+            try:
+                thunk = make_thunk(cand)
+                ms = self._measure(thunk, iters)
+            except Exception as exc:  # invalid tile/OOM candidate
+                if multi:
+                    # a per-rank skip would desynchronize ranks mid-collective
+                    # (peers are already blocked inside the failed candidate):
+                    # candidates must be valid on EVERY rank in multi-process
+                    # tuning, so fail loudly instead of hanging the job
+                    raise RuntimeError(
+                        f"autotune[{name}] candidate {cand} failed on this "
+                        f"process during multi-process tuning; prune invalid "
+                        f"candidates before tuning collectives"
+                    ) from exc
+                if verbose:
+                    dist_print(f"autotune[{name}] {cand}: failed ({exc})",
+                               rank=0)
+                ms = float("inf")
+            times.append(ms)
+            if verbose:
+                dist_print(f"autotune[{name}] {cand}: {ms:.3f} ms", rank=0)
+        times = self._agree(times)
+        best = min(range(len(candidates)), key=lambda i: times[i])
+        if times[best] == float("inf"):
+            raise RuntimeError(
+                f"autotune[{name}]: every candidate failed for key {key}"
+            )
+        with self._lock:
+            self._mem[ck] = best
+            self._times[ck] = times[best]
+            self._load_disk()[ck] = best
+            self._save_disk()
+        return TuneResult(candidates[best], times[best], False)
+
+
+_GLOBAL = Autotuner()
+
+
+def autotune(name, key, candidates, make_thunk, **kw) -> TuneResult:
+    """Tune via the process-global :class:`Autotuner`."""
+    return _GLOBAL.tune(name, key, candidates, make_thunk, **kw)
+
+
+def matmul_tile_candidates(m: int, n: int, k: int) -> list[tuple[int, int, int]]:
+    """Default (bm, bn, bk) sweep for GEMM-shaped ops: the measured-best
+    1024x1024x512 first (skip tuning cost when it fits), then smaller tiles
+    for problems where it does not."""
+    cands = [
+        (1024, 1024, 512), (512, 1024, 512), (1024, 512, 512),
+        (512, 512, 512), (512, 512, 1024), (256, 1024, 512),
+        (256, 512, 512), (256, 256, 512),
+    ]
+    return [c for c in cands if c[0] <= m and c[1] <= n and c[2] <= k] or [
+        (min(256, m), min(256, n), min(256, k))
+    ]
+
+
+def tuned_matmul(a: jax.Array, b: jax.Array, **kw):
+    """``ops.matmul`` with autotuned tiles (reference ``@autotune`` on the
+    GEMM kernels)."""
+    from ..core.utils import clip_block
+    from ..ops.matmul import matmul
+
+    (m, k), (_, n) = a.shape, b.shape
+    # surface unalignable dims HERE with the actionable pad message, not as
+    # an opaque "every candidate failed" after the sweep
+    for d in (m, n, k):
+        clip_block(1024, d)
+    cands = matmul_tile_candidates(m, n, k)
+    res = autotune(
+        "matmul", (m, n, k, str(a.dtype), platform.device_kind()), cands,
+        lambda c: (lambda: matmul(a, b, bm=c[0], bn=c[1], bk=c[2], **kw)),
+    )
+    bm, bn, bk = res.config
+    return matmul(a, b, bm=bm, bn=bn, bk=bk, **kw)

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Launch wrapper (reference: scripts/launch.sh -- the torchrun/nvshmem
+# bootstrap). On TPU the rendezvous is jax.distributed.initialize, driven
+# by three env vars; this script fills them for the common cases.
+#
+#   scripts/launch.sh sim 8 tutorials/07_ag_gemm.py   # virtual CPU mesh
+#   scripts/launch.sh pod <coordinator:port> <num_procs> <proc_id> prog.py
+#
+# Multi-host TPU pods: run this once per host with the same coordinator
+# address and per-host process ids (your scheduler usually sets these).
+set -euo pipefail
+
+mode="${1:?usage: launch.sh sim|pod ...}"
+shift
+case "$mode" in
+  sim)
+    n="${1:?sim needs a device count}"
+    shift
+    # +2 spares: interpret-mode kernels need free client threads
+    export TDT_SIM_DEVICES="$n"
+    exec python -c "
+from triton_distributed_tpu.core.platform import force_cpu, SPARE_VIRTUAL_DEVICES
+import os, runpy, sys
+force_cpu(int(os.environ['TDT_SIM_DEVICES']) + SPARE_VIRTUAL_DEVICES)
+sys.argv = sys.argv[1:]
+sys.path.insert(0, os.path.dirname(os.path.abspath(sys.argv[0])))
+runpy.run_path(sys.argv[0], run_name='__main__')
+" "$@"
+    ;;
+  pod)
+    export COORDINATOR_ADDRESS="${1:?pod needs coordinator host:port}"
+    export NUM_PROCESSES="${2:?pod needs process count}"
+    export PROCESS_ID="${3:?pod needs the local process id}"
+    shift 3
+    exec python "$@"
+    ;;
+  *)
+    echo "unknown mode: $mode (use sim|pod)" >&2
+    exit 2
+    ;;
+esac

@@ -1,0 +1,67 @@
+"""Tutorial 01 — the distributed primitive vocabulary (notify/wait/remote_copy).
+
+Reference: 01-distributed-notify-wait.rst.  A hand-written Pallas kernel:
+every rank pushes its block to its right neighbor and waits for the left
+neighbor's block — the minimal signal/wait producer-consumer pattern all
+the library kernels are built from.
+"""
+
+from common import bootstrap
+
+jax, mesh_lib = bootstrap()
+
+import functools
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.core import compilation
+from triton_distributed_tpu.lang import primitives as dl
+from triton_distributed_tpu.lang.primitives import Team
+
+
+def shift_kernel(team, x_ref, out_ref, send_sem, recv_sem):
+    # 1. barrier before the first remote write (EVERY collective kernel)
+    dl.collective_prologue(team, neighbors_only=True)
+    # 2. push my block into my RIGHT neighbor's output...
+    _, right = team.neighbor_ranks()
+    dl.remote_copy(x_ref, out_ref, send_sem, recv_sem, team.device_id(right))
+    # 3. ...and wait until my LEFT neighbor's block has landed in mine
+    dl.wait_recv(out_ref, recv_sem)
+    # 4. drain my own send so repeated calls start balanced
+    dl.wait_send(x_ref, send_sem)
+
+
+def main():
+    mesh = mesh_lib.tp_mesh(8)
+    team = Team.of(mesh, "tp")
+    call = pl.pallas_call(
+        functools.partial(shift_kernel, team),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(())] * 2,
+        compiler_params=compilation.compiler_params(
+            collective=True, collective_id=compilation.collective_id("test")
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+    fn = compilation.jit_shard_map(
+        call, mesh, in_specs=P("tp", None), out_specs=P("tp", None)
+    )
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(64, 128)
+    xs = mesh_lib.shard(mesh, x, "tp", None)
+    out = jax.device_get(fn(xs))
+    # rank r now holds rank r-1's block
+    import numpy as np
+
+    perm = np.array([7, 0, 1, 2, 3, 4, 5, 6])
+    np.testing.assert_array_equal(out.reshape(8, 8, 128),
+                                  np.asarray(x).reshape(8, 8, 128)[perm])
+    print("ring shift via notify/wait OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Tutorial 02 — AllGather methods (reference 02-intra-node-allgather.rst).
+
+Three kernels (one-shot push, unidirectional ring, bidirectional ring) and
+the size-based auto-selection; golden vs jax.lax.all_gather.
+"""
+
+from common import bootstrap
+
+jax, mesh_lib = bootstrap()
+
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.comm import AllGatherMethod, all_gather
+
+
+def main():
+    mesh = mesh_lib.tp_mesh(8)
+    x = jax.random.normal(jax.random.key(0), (8 * 32, 256), jnp.float32)
+    xs = mesh_lib.shard(mesh, x, "tp", None)
+    for method in (AllGatherMethod.PUSH_1SHOT, AllGatherMethod.RING_1D,
+                   AllGatherMethod.RING_BIDIR, AllGatherMethod.AUTO):
+        out = all_gather(xs, mesh, method=method)
+        np.testing.assert_allclose(np.asarray(jax.device_get(out)),
+                                   np.asarray(x))
+        print(f"{method.value:12s} OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Tutorial 03 — two-level (ICI + DCN) AllGather (reference
+03-inter-node-allgather.rst).
+
+Within a slice the Pallas ring rides ICI remote DMA; across slices there
+is no device-initiated DMA, so the outer level rides XLA's DCN
+collectives — the standard TPU multi-slice split.
+"""
+
+from common import bootstrap
+
+jax, mesh_lib = bootstrap()
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.comm.allgather import hierarchical_all_gather
+
+
+def main():
+    mesh = mesh_lib.make_mesh({"dcn": 2, "ici": 4},
+                              devices=jax.devices()[:8])
+    x = jax.random.normal(jax.random.key(0), (8 * 16, 256), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P(("dcn", "ici"), None)))
+    out = hierarchical_all_gather(xs, mesh, "ici", "dcn")
+    np.testing.assert_allclose(np.asarray(jax.device_get(out)), np.asarray(x))
+    print("hierarchical (2x4) AG OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""Shared tutorial bootstrap: a virtual 8-device CPU mesh (every tutorial
+runs on a laptop; on a real TPU slice delete the force_cpu call and the
+same code runs over ICI).  Reference tutorials require N GPUs + torchrun;
+here the mesh is simulated (SURVEY.md section 4)."""
+
+from triton_distributed_tpu.core.platform import force_cpu, SPARE_VIRTUAL_DEVICES
+
+MESH_DEVICES = 8
+
+
+def bootstrap():
+    # spares keep interpret-mode kernels deadlock-free at full occupancy
+    force_cpu(MESH_DEVICES + SPARE_VIRTUAL_DEVICES)
+    import jax
+
+    from triton_distributed_tpu.core import mesh as mesh_lib
+
+    return jax, mesh_lib

@@ -1,0 +1,207 @@
+// Multi-rank chrome-trace merge (native).
+//
+// Reference: python/triton_dist/utils.py:414-584 — process_trace_json
+// (":365", remap pid/tid by rank), _merge_json_v2 (":465", concatenate
+// per-rank traceEvents), ParallelJsonDumper (":414", a multiprocessing
+// pool to make Python JSON IO bearable).  That last class is the tell:
+// merging hundreds of MB of trace JSON is exactly the workload CPython
+// cannot do fast, so this framework's runtime does it natively — a single
+// pass per file, no JSON DOM, gzip via zlib.
+//
+// Merge semantics (chrome trace format): each input file holds
+// {"traceEvents": [...]}; the merged file concatenates all events with
+// every event's "pid" offset by rank*1000000 so per-rank process lanes
+// stay disjoint in the viewer (the reference's remap uses the same idea).
+//
+// C ABI (consumed via ctypes from tools/trace_merge.py):
+//   int tdt_merge_traces(const char** inputs, const int* ranks, int n,
+//                        const char* out_path, int gzip_out);
+// returns 0 on success, negative error codes otherwise.
+
+#include <zlib.h>
+
+#include <cctype>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// Read a whole file into a string; returns false on IO failure.
+bool read_file(const char* path, std::string* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (size < 0) {
+    std::fclose(f);
+    return false;
+  }
+  out->resize(static_cast<size_t>(size));
+  size_t got = size ? std::fread(&(*out)[0], 1, static_cast<size_t>(size), f) : 0;
+  std::fclose(f);
+  return got == static_cast<size_t>(size);
+}
+
+// Slice out the contents of the top-level "traceEvents" array
+// (between its matching '[' ']'), honoring strings/escapes.
+bool trace_events_span(const std::string& s, size_t* begin, size_t* end) {
+  size_t key = s.find("\"traceEvents\"");
+  if (key == std::string::npos) return false;
+  size_t open = s.find('[', key);
+  if (open == std::string::npos) return false;
+  int depth = 0;
+  bool in_str = false;
+  for (size_t i = open; i < s.size(); ++i) {
+    char c = s[i];
+    if (in_str) {
+      if (c == '\\') {
+        ++i;
+      } else if (c == '"') {
+        in_str = false;
+      }
+      continue;
+    }
+    if (c == '"') {
+      in_str = true;
+    } else if (c == '[') {
+      ++depth;
+    } else if (c == ']') {
+      if (--depth == 0) {
+        *begin = open + 1;
+        *end = i;  // exclusive
+        return true;
+      }
+    }
+  }
+  return false;
+}
+
+// Append `events` (the contents of a traceEvents array) to `out`,
+// rewriting each event's TOP-LEVEL integer "pid" by +offset.  One pass,
+// string-aware, object-depth-aware: "pid" keys nested inside "args" (or
+// deeper) pass through untouched, matching the Python fallback's
+// isinstance(ev["pid"], int) top-level-only semantics; float pids also
+// pass through (the fallback only remaps ints).
+void append_remapped(const std::string& ev, long long offset,
+                     std::string* out) {
+  size_t i = 0;
+  bool in_str = false;
+  int obj_depth = 0;  // 1 == inside one event object
+  while (i < ev.size()) {
+    char c = ev[i];
+    if (in_str) {
+      out->push_back(c);
+      if (c == '\\' && i + 1 < ev.size()) {
+        out->push_back(ev[i + 1]);
+        i += 2;
+        continue;
+      }
+      if (c == '"') in_str = false;
+      ++i;
+      continue;
+    }
+    if (c == '{') {
+      ++obj_depth;
+    } else if (c == '}') {
+      --obj_depth;
+    } else if (c == '"') {
+      if (obj_depth == 1 && ev.compare(i, 5, "\"pid\"") == 0) {
+        size_t j = i + 5;
+        while (j < ev.size() && std::isspace(static_cast<unsigned char>(ev[j])))
+          ++j;
+        if (j < ev.size() && ev[j] == ':') {
+          ++j;
+          while (j < ev.size() &&
+                 std::isspace(static_cast<unsigned char>(ev[j])))
+            ++j;
+          size_t num_start = j;
+          if (j < ev.size() && (ev[j] == '-' || std::isdigit(
+                  static_cast<unsigned char>(ev[j])))) {
+            if (ev[j] == '-') ++j;
+            while (j < ev.size() &&
+                   std::isdigit(static_cast<unsigned char>(ev[j])))
+              ++j;
+            bool is_int = j >= ev.size() ||
+                          (ev[j] != '.' && ev[j] != 'e' && ev[j] != 'E');
+            if (is_int) {
+              long long v =
+                  std::strtoll(ev.c_str() + num_start, nullptr, 10);
+              out->append(ev, i, num_start - i);
+              out->append(std::to_string(v + offset));
+              i = j;
+              continue;
+            }
+          }
+        }
+      }
+      in_str = true;
+      out->push_back(c);
+      ++i;
+      continue;
+    }
+    out->push_back(c);
+    ++i;
+  }
+}
+
+bool write_out(const std::string& data, const char* path, int gzip_out) {
+  if (gzip_out) {
+    gzFile g = gzopen(path, "wb6");
+    if (!g) return false;
+    bool ok = gzwrite(g, data.data(), static_cast<unsigned>(data.size())) ==
+              static_cast<int>(data.size());
+    gzclose(g);
+    return ok;
+  }
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return false;
+  bool ok = std::fwrite(data.data(), 1, data.size(), f) == data.size();
+  std::fclose(f);
+  return ok;
+}
+
+}  // namespace
+
+extern "C" int tdt_merge_traces(const char** inputs, const int* ranks,
+                                int n, const char* out_path, int gzip_out) {
+  if (n <= 0 || !inputs || !ranks || !out_path) return -1;
+  // the merged file keeps the FIRST input's envelope (displayTimeUnit,
+  // metadata, stackFrames, ...) with its traceEvents contents replaced by
+  // the concatenation of every input's remapped events — same policy as
+  // the Python fallback
+  std::string first_buf;
+  if (!read_file(inputs[0], &first_buf)) return -2;
+  size_t env_b = 0, env_e = 0;
+  if (!trace_events_span(first_buf, &env_b, &env_e)) return -3;
+
+  std::string events;
+  bool first = true;
+  std::string buf;
+  for (int k = 0; k < n; ++k) {
+    buf.clear();
+    if (!read_file(inputs[k], &buf)) return -2 - k * 10;
+    size_t b = 0, e = 0;
+    if (!trace_events_span(buf, &b, &e)) return -3 - k * 10;
+    // skip pure-whitespace event arrays
+    bool empty = true;
+    for (size_t i = b; i < e; ++i)
+      if (!std::isspace(static_cast<unsigned char>(buf[i]))) {
+        empty = false;
+        break;
+      }
+    if (empty) continue;
+    if (!first) events.push_back(',');
+    first = false;
+    append_remapped(buf.substr(b, e - b),
+                    static_cast<long long>(ranks[k]) * 1000000LL, &events);
+  }
+  std::string merged;
+  merged.reserve(first_buf.size() + events.size());
+  merged.append(first_buf, 0, env_b);
+  merged += events;
+  merged.append(first_buf, env_e, std::string::npos);
+  return write_out(merged, out_path, gzip_out) ? 0 : -4;
+}

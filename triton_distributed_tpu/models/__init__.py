@@ -2,7 +2,9 @@
 (reference: ``python/triton_dist/models/`` — config, kv_cache, qwen,
 engine)."""
 
+from .checkpoint import load_checkpoint, save_checkpoint
 from .config import ModelConfig
 from .engine import Engine, sample_token
 from .kv_cache import KVCache, advance, init_cache, reset, with_length, write_prefill
+from .loader import load_qwen_state_dict
 from .qwen import Qwen3, QwenLayerParams, QwenParams

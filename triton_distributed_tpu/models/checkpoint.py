@@ -1,0 +1,35 @@
+"""Checkpoint save/restore for model params (and any pytree).
+
+The reference has NO checkpoint path (SURVEY.md section 5: weights stream
+from the HF hub per run).  On TPU, serving restarts are routine (preemption)
+and re-sharding a large model from host weights is minutes of wall clock,
+so the framework ships the orbax-based path: sharded arrays are written
+per-shard and restored DIRECTLY into their target shardings — no host
+staging of the full model.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def save_checkpoint(path: str, pytree) -> None:
+    """Write ``pytree`` (e.g. ``QwenParams``) to ``path`` (a directory)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, pytree, force=True)
+
+
+def load_checkpoint(path: str, like):
+    """Restore a checkpoint into the structure/shardings of ``like``
+    (an abstract or concrete pytree with the target shardings)."""
+    import orbax.checkpoint as ocp
+
+    target = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if hasattr(x, "sharding") else x,
+        like,
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path, target)

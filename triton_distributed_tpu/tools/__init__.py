@@ -12,3 +12,4 @@ from .perf_model import (
     reduce_scatter_sol_ms,
 )
 from .profile import annotate, group_profile, memory_stats
+from .trace_merge import merge_traces

@@ -1,0 +1,127 @@
+"""Multi-rank trace merging: native fast path + Python fallback.
+
+Reference: ``python/triton_dist/utils.py:414-584`` — per-rank chrome
+traces are gathered, pid/tid-remapped (``process_trace_json:365``) and
+merged (``_merge_json_v2:465``), with a multiprocessing JSON dumper
+(``ParallelJsonDumper:414``) because CPython JSON IO is the bottleneck.
+Here the merge itself is native C++ (``csrc/trace_merge.cc``: single pass
+per file, no JSON DOM, zlib gzip), compiled on demand with the system
+toolchain and loaded via ctypes; when no compiler is available the
+pure-Python fallback produces identical output.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import gzip
+import json
+import os
+import subprocess
+from typing import Sequence
+
+_PID_OFFSET = 1_000_000
+
+_REPO_CSRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "csrc", "trace_merge.cc",
+)
+
+
+def _lib_path() -> str:
+    cache = os.environ.get(
+        "TDT_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "triton_distributed_tpu"),
+    )
+    return os.path.join(cache, "trace_merge.so")
+
+
+_lib: "ctypes.CDLL | None | bool" = None  # None=untried, False=unavailable
+
+
+def _load_native():
+    """Compile (once) and dlopen the native merger; False if impossible."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    so = _lib_path()
+    try:
+        if not os.path.exists(so) or (
+            os.path.exists(_REPO_CSRC)
+            and os.path.getmtime(_REPO_CSRC) > os.path.getmtime(so)
+        ):
+            os.makedirs(os.path.dirname(so), exist_ok=True)
+            tmp = so + f".tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _REPO_CSRC,
+                 "-lz"],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(so)
+        lib.tdt_merge_traces.restype = ctypes.c_int
+        lib.tdt_merge_traces.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        _lib = lib
+    except (OSError, subprocess.SubprocessError):
+        _lib = False
+    return _lib
+
+
+def _merge_python(inputs: Sequence[str], ranks: Sequence[int],
+                  out_path: str, gzip_out: bool) -> None:
+    envelope = None
+    events = []
+    for path, rank in zip(inputs, ranks):
+        with open(path) as f:
+            trace = json.load(f)
+        if envelope is None:
+            # keep the first input's non-event keys (displayTimeUnit, ...)
+            envelope = {k: v for k, v in trace.items() if k != "traceEvents"}
+        for ev in trace.get("traceEvents", []):
+            if isinstance(ev.get("pid"), int):
+                ev["pid"] += rank * _PID_OFFSET
+            events.append(ev)
+    envelope = dict(envelope or {})
+    envelope["traceEvents"] = events
+    data = json.dumps(envelope).encode()
+    opener = gzip.open if gzip_out else open
+    with opener(out_path, "wb") as f:
+        f.write(data)
+
+
+def merge_traces(
+    inputs: Sequence[str],
+    ranks: Sequence[int] | None = None,
+    out_path: str = "merged_trace.json.gz",
+    *,
+    gzip_out: bool | None = None,
+    native: bool = True,
+) -> str:
+    """Merge per-rank chrome traces into one file, offsetting each rank's
+    pids by ``rank * 1e6`` so process lanes stay disjoint in the viewer.
+
+    Returns ``out_path``.  ``gzip_out`` defaults to the ``.gz`` suffix.
+    """
+    if ranks is None:
+        ranks = list(range(len(inputs)))
+    if len(ranks) != len(inputs):
+        raise ValueError(f"{len(inputs)} inputs but {len(ranks)} ranks")
+    if gzip_out is None:
+        gzip_out = out_path.endswith(".gz")
+
+    lib = _load_native() if native else False
+    if lib:
+        arr = (ctypes.c_char_p * len(inputs))(
+            *[p.encode() for p in inputs]
+        )
+        rk = (ctypes.c_int * len(inputs))(*list(ranks))
+        rc = lib.tdt_merge_traces(arr, rk, len(inputs),
+                                  out_path.encode(), int(gzip_out))
+        if rc == 0:
+            return out_path
+        # fall through to the Python path on any native error
+    _merge_python(inputs, ranks, out_path, gzip_out)
+    return out_path

@@ -182,16 +182,27 @@ def bench_tp_mlp():
         "tp", None,
     )
 
-    from jax.sharding import PartitionSpec as P
-
     gate_up, down = params.gate_up, params.down
 
     @jax.jit
     def baseline(x, gu, dn):
         xg = jax.lax.with_sharding_constraint(x, mesh_lib.replicated(mesh))
         hkt = jnp.matmul(xg, gu, preferred_element_type=jnp.float32)
-        wg, w1 = jnp.split(hkt.astype(x.dtype), 2, axis=-1)
+        # gate_up is rank-blocked [gate_r | up_r] per rank: split per block,
+        # not down the global middle (same layout _act_combine consumes)
+        wg, w1 = (
+            hkt.astype(x.dtype)
+            .reshape(m, ntp, 2, i // ntp)
+            .swapaxes(1, 2)
+            .reshape(m, 2, i)[:, 0],
+            hkt.astype(x.dtype)
+            .reshape(m, ntp, 2, i // ntp)
+            .swapaxes(1, 2)
+            .reshape(m, 2, i)[:, 1],
+        )
         h = jax.nn.silu(wg) * w1
+        # back to the rank-blocked column order of the down weight's rows
+        h = h.reshape(m, ntp, i // ntp).reshape(m, i)
         out = jnp.matmul(h, dn, preferred_element_type=jnp.float32)
         return jax.lax.with_sharding_constraint(
             out.astype(x.dtype), mesh_lib.sharding(mesh, "tp", None)
@@ -222,10 +233,12 @@ def main():
         result = bench_tp_mlp()
     elif mode == "gemm":
         result = bench_single_chip()
-    elif jax.device_count() > 1:
+    elif mode == "auto" and jax.device_count() > 1:
         result = bench_multi_chip()
-    else:
+    elif mode == "auto":
         result = bench_single_chip()
+    else:
+        raise SystemExit(f"unknown bench mode {mode!r} (auto|gemm|attn|mlp)")
     print(json.dumps(result))
 
 

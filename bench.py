@@ -190,19 +190,8 @@ def bench_tp_mlp():
         hkt = jnp.matmul(xg, gu, preferred_element_type=jnp.float32)
         # gate_up is rank-blocked [gate_r | up_r] per rank: split per block,
         # not down the global middle (same layout _act_combine consumes)
-        wg, w1 = (
-            hkt.astype(x.dtype)
-            .reshape(m, ntp, 2, i // ntp)
-            .swapaxes(1, 2)
-            .reshape(m, 2, i)[:, 0],
-            hkt.astype(x.dtype)
-            .reshape(m, ntp, 2, i // ntp)
-            .swapaxes(1, 2)
-            .reshape(m, 2, i)[:, 1],
-        )
-        h = jax.nn.silu(wg) * w1
-        # back to the rank-blocked column order of the down weight's rows
-        h = h.reshape(m, ntp, i // ntp).reshape(m, i)
+        t = hkt.astype(x.dtype).reshape(m, ntp, 2, i // ntp)
+        h = (jax.nn.silu(t[:, :, 0]) * t[:, :, 1]).reshape(m, i)
         out = jnp.matmul(h, dn, preferred_element_type=jnp.float32)
         return jax.lax.with_sharding_constraint(
             out.astype(x.dtype), mesh_lib.sharding(mesh, "tp", None)

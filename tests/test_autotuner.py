@@ -36,7 +36,7 @@ def test_failing_candidates_skipped(tmp_path):
     tuner = Autotuner(path=str(tmp_path / "cache.json"))
 
     def make_thunk(c):
-        if c == "bad":
+        if c.startswith("bad"):
             def boom():
                 raise ValueError("invalid tile")
             return boom
@@ -46,7 +46,13 @@ def test_failing_candidates_skipped(tmp_path):
     assert res.config == "good"
 
     with pytest.raises(RuntimeError, match="every candidate failed"):
-        tuner.tune("toy", ("k3",), ["bad"], make_thunk, iters=1)
+        tuner.tune("toy", ("k3",), ["bad", "bad2"], make_thunk, iters=1)
+
+    # a single candidate short-circuits without measuring (nothing to pick)
+    probed = []
+    res1 = tuner.tune("toy", ("k4",), ["only"],
+                      lambda c: (lambda: probed.append(c)), iters=1)
+    assert res1.config == "only" and res1.from_cache and not probed
 
 
 def test_persistence_round_trip(tmp_path):
@@ -66,8 +72,14 @@ def test_persistence_round_trip(tmp_path):
     assert res.config == 1 and res.from_cache and not timed
 
 
-def test_tuned_matmul_correct():
+def test_tuned_matmul_correct(tmp_path, monkeypatch):
     import jax
+
+    from triton_distributed_tpu.tune import autotuner as at
+
+    # fresh global tuner: the module-level one memoizes the user's REAL
+    # disk cache on first load, which would leak into/out of this test
+    monkeypatch.setattr(at, "_GLOBAL", at.Autotuner(path=str(tmp_path / "m.json")))
 
     a = jax.random.normal(jax.random.key(0), (256, 128), jnp.float32)
     b = jax.random.normal(jax.random.key(1), (128, 256), jnp.float32)
@@ -75,3 +87,34 @@ def test_tuned_matmul_correct():
     want = jnp.matmul(a, b)
     assert np.allclose(np.asarray(got), np.asarray(want), atol=1e-4,
                        rtol=1e-4)
+
+
+def test_tuned_collective_ops_correct(tmp_path, monkeypatch):
+    """tuned_ag_gemm / tuned_gemm_rs sweep real collective invocations and
+    return correct results with the winning config."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from triton_distributed_tpu.core.mesh import TP_AXIS, make_mesh
+    from triton_distributed_tpu.tune import autotuner as at
+    from triton_distributed_tpu.tune import tuned_ag_gemm, tuned_gemm_rs
+
+    # fresh global tuner with an isolated cache file: the module-level one
+    # memoizes whatever disk cache it loaded first
+    monkeypatch.setattr(at, "_GLOBAL", at.Autotuner(path=str(tmp_path / "c.json")))
+    mesh = make_mesh({TP_AXIS: 4}, devices=jax.devices()[:4])
+    m, k, n = 4 * 24, 96, 4 * 40
+    a = jax.random.normal(jax.random.key(0), (m, k), jnp.float32) * 0.1
+    b = jax.random.normal(jax.random.key(1), (k, n), jnp.float32) * 0.1
+    a_ag = jax.device_put(a, NamedSharding(mesh, P(TP_AXIS, None)))
+    b_ag = jax.device_put(b, NamedSharding(mesh, P(None, TP_AXIS)))
+    out = tuned_ag_gemm(a_ag, b_ag, mesh, TP_AXIS)
+    want = np.asarray(a) @ np.asarray(b)
+    assert np.allclose(np.asarray(jax.device_get(out)), want, atol=1e-3,
+                       rtol=1e-3)
+
+    a_rs = jax.device_put(a, NamedSharding(mesh, P(None, TP_AXIS)))
+    b_rs = jax.device_put(b, NamedSharding(mesh, P(TP_AXIS, None)))
+    out2 = tuned_gemm_rs(a_rs, b_rs, mesh, TP_AXIS)
+    assert np.allclose(np.asarray(jax.device_get(out2)), want, atol=1e-3,
+                       rtol=1e-3)

@@ -5,5 +5,7 @@ from .autotuner import (
     TuneResult,
     autotune,
     matmul_tile_candidates,
+    tuned_ag_gemm,
+    tuned_gemm_rs,
     tuned_matmul,
 )

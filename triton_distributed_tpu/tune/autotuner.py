@@ -119,17 +119,30 @@ class Autotuner:
         raise during their first call and are skipped.
         """
         ck = json.dumps([name, *map(str, key)])
+        multi = jax.process_count() > 1
         with self._lock:
             if ck in self._mem:
+                # per-process memory: identical on every rank because SPMD
+                # programs issue the same tune() sequence
                 return TuneResult(candidates[self._mem[ck]],
                                   self._times.get(ck, float("nan")), True)
-            disk = self._load_disk()
-            if ck in disk and disk[ck] < len(candidates):
-                self._mem[ck] = disk[ck]
-                return TuneResult(candidates[disk[ck]], float("nan"), True)
+            # the DISK cache is per-node and may diverge across hosts (one
+            # node replaced / cache cleared): a hit on rank A while rank B
+            # measures would strand B's collective candidates -> only
+            # single-process runs consult it
+            if not multi:
+                disk = self._load_disk()
+                if ck in disk and disk[ck] < len(candidates):
+                    self._mem[ck] = disk[ck]
+                    return TuneResult(candidates[disk[ck]], float("nan"),
+                                      True)
+        if len(candidates) == 1:
+            # nothing to choose; skip the measurement entirely
+            with self._lock:
+                self._mem[ck] = 0
+            return TuneResult(candidates[0], float("nan"), True)
 
         times: list[float] = []
-        multi = jax.process_count() > 1
         for cand in candidates:
             try:
                 thunk = make_thunk(cand)
@@ -206,3 +219,62 @@ def tuned_matmul(a: jax.Array, b: jax.Array, **kw):
     )
     bm, bn, bk = res.config
     return matmul(a, b, bm=bm, bn=bn, bk=bk, **kw)
+
+
+def _tuned_collective(name, op, config_cls, cand_dims, a, b, mesh, axis, kw):
+    """Shared flow of the tuned fused-op wrappers: validate the per-rank
+    tile dims up front (so user shape errors surface with the actionable
+    message, not as 'every candidate failed'), build clipped candidates,
+    tune with the caller's real arrays, run with the winner."""
+    from ..core.utils import clip_block
+
+    n_ranks = mesh.shape[axis]
+    (m, k), (_, n) = a.shape, b.shape
+    dm, dn, dk = cand_dims(m, n, k, n_ranks)
+    for d in (dm, dn, dk):
+        clip_block(1024, d)   # raises the pad-to-granule message directly
+    cands = [config_cls(bm, bn, bk)
+             for bm, bn, bk in matmul_tile_candidates(dm, dn, dk)]
+    res = autotune(
+        name, (m, k, n, n_ranks, str(a.dtype), platform.device_kind()),
+        cands,
+        lambda c: (lambda: op(a, b, mesh, axis, config=c, **kw)),
+    )
+    return op(a, b, mesh, axis, config=res.config, **kw)
+
+
+def tuned_ag_gemm(a: jax.Array, b: jax.Array, mesh, axis: str = "tp", **kw):
+    """``ops.ag_gemm`` with autotuned consumer tiles — the fused-op analogue
+    of the reference's ``@triton.autotune`` on the AG-GEMM kernel.  Tuning
+    runs the REAL collective with the caller's arrays (contextual); all
+    candidates are valid on every rank by construction (same shapes
+    everywhere), satisfying the multi-process tuning contract."""
+    from ..ops.ag_gemm import AgGemmConfig, ag_gemm
+
+    if a.shape[0] % mesh.shape[axis] or b.shape[1] % mesh.shape[axis]:
+        raise ValueError(
+            f"M={a.shape[0]} and N={b.shape[1]} must be divisible by "
+            f"{axis}={mesh.shape[axis]}"
+        )
+    return _tuned_collective(
+        "ag_gemm", ag_gemm, AgGemmConfig,
+        lambda m, n, k, r: (max(m // r, 1), max(n // r, 1), k),
+        a, b, mesh, axis, kw,
+    )
+
+
+def tuned_gemm_rs(a: jax.Array, b: jax.Array, mesh, axis: str = "tp", **kw):
+    """``ops.gemm_rs`` with autotuned producer tiles (see
+    :func:`tuned_ag_gemm`)."""
+    from ..ops.gemm_rs import GemmRsConfig, gemm_rs
+
+    if a.shape[0] % mesh.shape[axis] or a.shape[1] % mesh.shape[axis]:
+        raise ValueError(
+            f"M={a.shape[0]} and K={a.shape[1]} must be divisible by "
+            f"{axis}={mesh.shape[axis]}"
+        )
+    return _tuned_collective(
+        "gemm_rs", gemm_rs, GemmRsConfig,
+        lambda m, n, k, r: (max(m // r, 1), n, max(k // r, 1)),
+        a, b, mesh, axis, kw,
+    )

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 
-def _bench_interleaved(engines: dict, iters: int = 64, rounds: int = 7) -> dict:
+def _bench_interleaved(engines: dict, iters: int = 64, rounds: int = 9) -> dict:
     """Per-engine per-round seconds/iter, measured in interleaved rounds.
 
     Returns ``{name: [round0_sec, round1_sec, ...]}`` (NaN for rounds where
@@ -30,7 +30,8 @@ def _bench_interleaved(engines: dict, iters: int = 64, rounds: int = 7) -> dict:
     so engine-vs-engine ratios are only meaningful when the engines are
     timed alternately within one process.  Within a round each engine is
     timed as the slope between a 1-iter and a (1+iters)-iter run so the
-    fixed sync/tunnel round-trip cancels (see core.utils.perf_func).
+    fixed sync/tunnel round-trip cancels (see core.utils.perf_func).  The
+    first round lands on the post-compile thermal ramp and is discarded.
     """
     from triton_distributed_tpu.core.utils import sync, timed_run
 
@@ -47,6 +48,9 @@ def _bench_interleaved(engines: dict, iters: int = 64, rounds: int = 7) -> dict:
             dt = (timed_run(fn, 1 + iters) - timed_run(fn, 1)) / iters
             # negative slope = sync noise swamped the round
             times[name].append(dt if dt > 0 else float("nan"))
+    for name in engines:
+        if len(times[name]) > 1:
+            times[name] = times[name][1:]  # drop the ramp round
     for name, fn in engines.items():
         if not any(t == t for t in times[name]):
             # pathological noise: fall back to amortized timing, one big run

@@ -119,38 +119,15 @@ def _ag_ring_bidir_kernel(
     """Bidirectional ring: clockwise stream carries ceil((n-1)/2) chunks,
     counter-clockwise floor((n-1)/2), using both ICI directions — the TPU
     answer to the reference's NUMA-aware 2D ring (``allgather.py:203-260``),
-    where the hierarchy exists to use both NVLink directions/planes."""
+    where the hierarchy exists to use both NVLink directions/planes.
+    Schedule + drain live in ``ring.bidir_ring_phase`` (shared with the
+    fused AG-GEMM's bidir variant)."""
     me, n = team.rank(), team.size
-    left, right = team.neighbor_ranks()
-    left_id, right_id = team.device_id(left), team.device_id(right)
-    n_right = (n - 1 + 1) // 2   # chunks travelling clockwise
-    n_left = (n - 1) // 2        # chunks travelling counter-clockwise
     local = dl.local_copy(x_ref, _chunk(out_ref, me, m), local_sem)
     dl.collective_prologue(team, neighbors_only=True)
     local.wait()
-    for step in range(max(n_right, n_left)):
-        if step < n_right:  # forward (me - step) clockwise
-            c = jax.lax.rem(me + n - step, n)
-            dl.remote_copy(
-                _chunk(out_ref, c, m), _chunk(out_ref, c, m),
-                send_sems.at[0], recv_sems.at[c], right_id,
-            )
-        if step < n_left:   # forward (me + step) counter-clockwise
-            c = jax.lax.rem(me + step, n)
-            dl.remote_copy(
-                _chunk(out_ref, c, m), _chunk(out_ref, c, m),
-                send_sems.at[1], recv_sems.at[c], left_id,
-            )
-        if step < n_right:
-            c = jax.lax.rem(me + n - step - 1, n)
-            _wait_recv_chunk(out_ref, recv_sems, c, m)
-        if step < n_left:
-            c = jax.lax.rem(me + step + 1, n)
-            _wait_recv_chunk(out_ref, recv_sems, c, m)
-    for _ in range(n_right):  # drain sends off the critical path
-        _wait_send(out_ref, send_sems.at[0], me, m)
-    for _ in range(n_left):
-        _wait_send(out_ref, send_sems.at[1], me, m)
+    ring.bidir_ring_phase(team, out_ref, m, send_sems, recv_sems)
+    ring.bidir_ring_drain(team, out_ref, m, send_sems)
 
 
 _KERNELS = {

@@ -54,6 +54,63 @@ def ag_ring_drain(team: Team, out_ref, m: int, send_sem):
         dl.wait_send(chunk(out_ref, me, m), send_sem)
 
 
+def bidir_ring_phase(team: Team, out_ref, m: int, send_sems, recv_sems,
+                     consume=None):
+    """Bidirectional AG ring over chunks at final offsets: the clockwise
+    stream carries ceil((n-1)/2) chunks, the counter-clockwise
+    floor((n-1)/2), using both ICI directions.  Forwarding happens
+    immediately after each arrival gate and BEFORE ``consume`` (the fused
+    ops' matmul), so the next transfer in each direction rides under the
+    current chunk's compute.  ``consume(r)`` is called per chunk in arrival
+    order (own chunk first); pass None for a pure collective.  Pair with
+    :func:`bidir_ring_drain`.
+
+    Precondition: out-chunk ``me`` holds this rank's contribution.
+    """
+    me, n = team.rank(), team.size
+    left, right = team.neighbor_ranks()
+    left_id, right_id = team.device_id(left), team.device_id(right)
+    n_cw = (n - 1 + 1) // 2   # chunks arriving clockwise (from the left)
+    n_ccw = (n - 1) // 2
+
+    def send(r, sem_idx, dst_id):
+        dl.remote_copy(chunk(out_ref, r, m), chunk(out_ref, r, m),
+                       send_sems.at[sem_idx], recv_sems.at[r], dst_id)
+
+    if n_cw >= 1:
+        send(me, 0, right_id)
+    if n_ccw >= 1:
+        send(me, 1, left_id)
+    if consume is not None:
+        consume(me)
+    for step in range(max(n_cw, n_ccw)):
+        if step < n_cw:
+            r = jax.lax.rem(me + n - step - 1, n)
+            dl.wait_recv(chunk(out_ref, r, m), recv_sems.at[r])
+            if step + 1 < n_cw:   # travels further clockwise
+                send(r, 0, right_id)
+            if consume is not None:
+                consume(r)
+        if step < n_ccw:
+            r = jax.lax.rem(me + step + 1, n)
+            dl.wait_recv(chunk(out_ref, r, m), recv_sems.at[r])
+            if step + 1 < n_ccw:
+                send(r, 1, left_id)
+            if consume is not None:
+                consume(r)
+
+
+def bidir_ring_drain(team: Team, out_ref, m: int, send_sems):
+    """Drain the n_cw + n_ccw sends of :func:`bidir_ring_phase`."""
+    me, n = team.rank(), team.size
+    n_cw = (n - 1 + 1) // 2
+    n_ccw = (n - 1) // 2
+    for _ in range(n_cw):
+        dl.wait_send(chunk(out_ref, me, m), send_sems.at[0])
+    for _ in range(n_ccw):
+        dl.wait_send(chunk(out_ref, me, m), send_sems.at[1])
+
+
 def rs_ack_drain(ack_sems, n: int):
     """Consume the outstanding ACK credits of a ring-RS at kernel exit.
 

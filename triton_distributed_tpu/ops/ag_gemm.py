@@ -37,6 +37,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..comm import ring
 from ..core import compilation
 from ..core.mesh import TP_AXIS
 from ..core.utils import clip_block
@@ -118,6 +119,49 @@ def _ag_gemm_kernel(
         dl.wait_send(chunk_rows(ag_ref, me), send_sem)
 
 
+def _ag_gemm_bidir_kernel(
+    team: Team,
+    m_loc: int,
+    k_dim: int,
+    n_loc: int,
+    cfg: AgGemmConfig,
+    out_dtype,
+    a_ref,
+    b_ref,
+    ag_ref,
+    c_ref,
+    local_sem,
+    send_sems,  # (2,) clockwise / counter-clockwise
+    recv_sems,
+    acc_ref,
+):
+    """Bidirectional-ring variant: both ICI directions carry chunks (the
+    fused analogue of ``comm/allgather._ag_ring_bidir_kernel``; the
+    reference's NUMA-aware 2D ring plays this role on NVLink).  The shared
+    ``ring.bidir_ring_phase`` forwards every arrival BEFORE its matmul, so
+    the next transfer in each direction rides under the current chunk's
+    compute; consumption order is arrival order: me, me-1, me+1, ..."""
+    me, n = team.rank(), team.size
+
+    pipeline = blocks.make_matmul_pipeline(
+        m_loc, n_loc, k_dim, cfg.bm, cfg.bn, cfg.bk, out_dtype
+    )
+
+    def chunk_rows(ref, r):
+        return ref.at[pl.ds(r * m_loc, m_loc)]
+
+    def consume(r):
+        pipeline(chunk_rows(ag_ref, r), b_ref, chunk_rows(c_ref, r),
+                 scratches=[acc_ref])
+
+    local = dl.local_copy(a_ref, chunk_rows(ag_ref, me), local_sem)
+    dl.collective_prologue(team, neighbors_only=True)
+    local.wait()
+    ring.bidir_ring_phase(team, ag_ref, m_loc, send_sems, recv_sems,
+                          consume=consume)
+    ring.bidir_ring_drain(team, ag_ref, m_loc, send_sems)
+
+
 @functools.lru_cache(maxsize=None)
 def _build_ag_gemm(
     mesh: Mesh,
@@ -128,12 +172,14 @@ def _build_ag_gemm(
     dtype: jnp.dtype,
     out_dtype: jnp.dtype,
     cfg: AgGemmConfig,
+    bidir: bool,
 ):
     team = Team.of(mesh, axis)
     n = team.size
 
+    kern = _ag_gemm_bidir_kernel if bidir else _ag_gemm_kernel
     kernel = functools.partial(
-        _ag_gemm_kernel, team, m_loc, k_dim, n_loc, cfg, out_dtype
+        kern, team, m_loc, k_dim, n_loc, cfg, out_dtype
     )
     call = pl.pallas_call(
         kernel,
@@ -151,7 +197,8 @@ def _build_ag_gemm(
         ),
         scratch_shapes=[
             pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)) if bidir
+            else pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((n,)),
             pltpu.VMEM((cfg.bm, cfg.bn), jnp.float32),
         ],
@@ -178,6 +225,7 @@ def ag_gemm(
     config: AgGemmConfig | None = None,
     out_dtype=None,
     return_gathered: bool = False,
+    bidir: bool | None = None,
 ):
     """Overlapped ``AllGather(a) @ b`` (reference host entry ``ag_gemm:534``).
 
@@ -186,6 +234,10 @@ def ag_gemm(
     Returns C = (M, N) sharded on dim 1; with ``return_gathered`` also the
     replicated gathered A (the reference keeps it in ctx workspace for reuse,
     e.g. by the attention layer).
+
+    ``bidir`` selects the two-direction ring (default for n >= 3: both ICI
+    directions carry chunks, halving the longest path; at n == 2 the single
+    transfer makes the streams identical).
     """
     cfg = config or AgGemmConfig()
     out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
@@ -204,12 +256,14 @@ def ag_gemm(
         c = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
         return (c, a) if return_gathered else c
 
+    if bidir is None:
+        bidir = n >= 3
     # clip BEFORE the cache lookup so configs that normalize to the same
     # effective tiles share one compiled kernel
     cfg = cfg.clip(m_tot // n, k_dim, n_tot // n)
     fn = _build_ag_gemm(
         mesh, axis, m_tot // n, k_dim, n_tot // n,
-        jnp.dtype(a.dtype), out_dtype, cfg,
+        jnp.dtype(a.dtype), out_dtype, cfg, bool(bidir),
     )
     gathered, c = fn(a, b)
     return (c, gathered) if return_gathered else c

@@ -235,8 +235,12 @@ def _tuned_collective(name, op, config_cls, cand_dims, a, b, mesh, axis, kw):
         clip_block(1024, d)   # raises the pad-to-granule message directly
     cands = [config_cls(bm, bn, bk)
              for bm, bn, bk in matmul_tile_candidates(dm, dn, dk)]
+    # kernel-selecting kwargs (e.g. ag_gemm's bidir) must key the cache:
+    # the two schedules want different tiles
+    kw_key = str(sorted(kw.items()))
     res = autotune(
-        name, (m, k, n, n_ranks, str(a.dtype), platform.device_kind()),
+        name,
+        (m, k, n, n_ranks, str(a.dtype), platform.device_kind(), kw_key),
         cands,
         lambda c: (lambda: op(a, b, mesh, axis, config=c, **kw)),
     )

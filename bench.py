@@ -216,6 +216,35 @@ def bench_tp_mlp():
     }
 
 
+def bench_group_gemm():
+    """Tile-scheduled Pallas grouped matmul vs XLA's ``lax.ragged_dot``
+    (MoE up-projection shapes: T=8192 routed rows, 8 local experts,
+    7168 -> 2048 bf16, uneven splits)."""
+    from triton_distributed_tpu.ops.group_gemm import grouped_matmul
+
+    t, k, n, e = 8192, 7168, 2048, 8
+    kx, kw = jax.random.split(jax.random.key(0))
+    x = jax.random.normal(kx, (t, k), jnp.bfloat16)
+    w = jax.random.normal(kw, (e, k, n), jnp.bfloat16)
+    splits = jnp.asarray([2048, 512, 1536, 0, 1024, 1408, 640, 1024],
+                         jnp.int32)
+
+    ours = jax.jit(lambda x, w, s: grouped_matmul(x, w, s))
+    ragged = jax.jit(lambda x, w, s: jax.lax.ragged_dot(x, w, s))
+    times = _bench_interleaved({
+        "ours": lambda: ours(x, w, splits),
+        "xla": lambda: ragged(x, w, splits),
+    }, iters=16)
+    flops = 2.0 * t * k * n
+    tflops = flops / _median(times["ours"]) / 1e12
+    return {
+        "metric": f"group_gemm_t{t}_k{k}_n{n}_e{e}",
+        "value": round(tflops, 2),
+        "unit": "TFLOP/s",
+        "vs_baseline": round(_median_ratio(times, "xla", "ours"), 4),
+    }
+
+
 def main():
     import sys
 
@@ -226,12 +255,16 @@ def main():
         result = bench_tp_mlp()
     elif mode == "gemm":
         result = bench_single_chip()
+    elif mode == "moe":
+        result = bench_group_gemm()
     elif mode == "auto" and jax.device_count() > 1:
         result = bench_multi_chip()
     elif mode == "auto":
         result = bench_single_chip()
     else:
-        raise SystemExit(f"unknown bench mode {mode!r} (auto|gemm|attn|mlp)")
+        raise SystemExit(
+            f"unknown bench mode {mode!r} (auto|gemm|attn|mlp|moe)"
+        )
     print(json.dumps(result))
 
 

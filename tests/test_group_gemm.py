@@ -9,10 +9,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_distributed_tpu.core.mesh import TP_AXIS, make_mesh
 from triton_distributed_tpu.ops.group_gemm import (
+    GroupGemmConfig,
     ag_group_gemm,
     group_gemm,
+    grouped_matmul,
     moe_reduce_rs,
 )
+from triton_distributed_tpu.ops.swizzle import grouped_tile_schedule
 from triton_distributed_tpu.ops.moe_utils import (
     expert_block_permutation,
     flatten_topk,
@@ -43,6 +46,93 @@ def test_group_gemm_golden():
     got = group_gemm(x, w, splits)
     want = _dense_group_golden(x, w, splits)
     assert np.allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "splits",
+    [
+        [16, 16, 16, 16],   # block-aligned
+        [10, 0, 30, 24],    # boundary-crossing + empty group
+        [5, 3, 0, 20],      # trailing rows past all groups -> zero-filled
+        [0, 0, 0, 0],       # fully empty
+        [64, 0, 0, 0],      # one group takes everything
+        [1, 1, 1, 1],       # many groups in one tile
+    ],
+)
+def test_grouped_matmul_golden(splits):
+    """Pallas tile-scheduled grouped matmul vs the dense loop, including
+    the zero-fill of rows past ``sum(splits)`` (reference semantics: the
+    aligned schedule of ``moe_ag_scatter_align_block_size`` never emits
+    work for pad rows)."""
+    t, k, n_dim, e = 64, 32, 48, 4
+    key = jax.random.key(3)
+    sp = jnp.asarray(splits, jnp.int32)
+    x = jax.random.normal(key, (t, k), jnp.float32)
+    w = jax.random.normal(jax.random.fold_in(key, 1), (e, k, n_dim),
+                          jnp.float32)
+    got = np.asarray(
+        grouped_matmul(x, w, sp, config=GroupGemmConfig(bm=16, bn=16, bk=16))
+    )
+    want = _dense_group_golden(x, w, splits)
+    assert np.allclose(got, want, atol=1e-4, rtol=1e-4)
+    # rows past the last group must be exactly zero, not garbage
+    tail = int(np.sum(splits))
+    assert np.array_equal(got[tail:], np.zeros((t - tail, n_dim), np.float32))
+
+
+def test_grouped_matmul_jit_and_dtype():
+    """Traced splits (the layer path) and bf16 in/out."""
+    t, k, n_dim, e = 32, 64, 32, 3
+    key = jax.random.key(4)
+    x = jax.random.normal(key, (t, k), jnp.bfloat16)
+    w = jax.random.normal(jax.random.fold_in(key, 1), (e, k, n_dim),
+                          jnp.bfloat16)
+    sp = jnp.asarray([8, 20, 4], jnp.int32)
+    cfg = GroupGemmConfig(bm=8, bn=16, bk=16)
+    f = jax.jit(lambda x, w, s: grouped_matmul(x, w, s, config=cfg))
+    got = np.asarray(f(x, w, sp), np.float32)
+    want = _dense_group_golden(x, w, np.asarray(sp))
+    assert np.allclose(got, want, atol=0.1, rtol=0.1)
+
+
+def test_grouped_tile_schedule_properties():
+    """Every occupied row is claimed by exactly one slot of its tile, every
+    tile has exactly one initializing slot, and pad slots are inert."""
+    rng = np.random.default_rng(7)
+    for _ in range(20):
+        e = int(rng.integers(1, 6))
+        bm = int(rng.choice([8, 16, 32]))
+        nt = int(rng.integers(1, 6))
+        t = nt * bm
+        splits = rng.multinomial(
+            int(rng.integers(0, t + 1)), np.ones(e) / e
+        ).astype(np.int32)
+        sched = jax.tree.map(
+            np.asarray,
+            grouped_tile_schedule(jnp.asarray(splits), t, bm),
+        )
+        num_slots = nt + e
+        assert all(a.shape == (num_slots,) for a in sched)
+        claimed = np.zeros(t, np.int32)
+        for s in range(num_slots):
+            lo, hi = sched.row_starts[s], sched.row_ends[s]
+            tile = sched.tile_ids[s]
+            assert 0 <= tile < nt
+            if lo < hi:
+                # slot rows live inside the slot's tile
+                assert lo >= tile * bm and hi <= (tile + 1) * bm
+                # and inside the slot's group's row range
+                g = sched.group_ids[s]
+                g_lo = splits[:g].sum()
+                assert lo >= g_lo and hi <= g_lo + splits[g]
+                claimed[lo:hi] += 1
+        covered = int(splits.sum())
+        assert np.array_equal(claimed[:covered], np.ones(covered, np.int32))
+        assert np.array_equal(claimed[covered:], np.zeros(t - covered, np.int32))
+        # exactly one initializer per tile, ordered tile-major
+        init_tiles = sched.tile_ids[sched.is_first == 1]
+        assert np.array_equal(np.sort(init_tiles), np.arange(nt))
+        assert np.array_equal(sched.tile_ids, np.sort(sched.tile_ids))
 
 
 def test_routing_sort_unsort_round_trip():

@@ -15,7 +15,13 @@ from .attention import (
 from .flash_decode import sp_flash_decode
 from .gemm_ar import GemmArConfig, gemm_ar
 from .gemm_rs import GemmRsConfig, gemm_rs
-from .group_gemm import ag_group_gemm, group_gemm, moe_reduce_rs
+from .group_gemm import (
+    GroupGemmConfig,
+    ag_group_gemm,
+    group_gemm,
+    grouped_matmul,
+    moe_reduce_rs,
+)
 from .moe_utils import (
     expert_block_permutation,
     flatten_topk,
@@ -26,3 +32,4 @@ from .moe_utils import (
 )
 from .rope import apply_rope, apply_rope_at, rope_freqs
 from .sp_attention import sp_attention
+from .swizzle import GroupedSchedule, grouped_tile_schedule, ring_chunk_order

@@ -44,6 +44,7 @@ from ..core.utils import clip_block
 from ..lang import primitives as dl
 from ..lang.primitives import Team
 from . import blocks
+from .swizzle import ring_chunk_order
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,7 +95,7 @@ def _ag_gemm_kernel(
     local.wait()
 
     for s in range(n):
-        r = jax.lax.rem(me + n - s, n) if s else me
+        r = ring_chunk_order(me, n, s)
         if s > 0:
             # arrival gate for chunk r (reference: dl.wait on ready flags)
             dl.wait_recv(chunk_rows(ag_ref, r), recv_sems.at[r])

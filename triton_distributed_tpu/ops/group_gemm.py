@@ -21,15 +21,160 @@ The communication halves remain this framework's Pallas collectives
 
 from __future__ import annotations
 
+import dataclasses
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..comm.allgather import all_gather
 from ..comm.reduce_scatter import reduce_scatter
 from ..core import compilation
 from ..core.mesh import TP_AXIS
+from ..core.utils import clip_block
 from .moe_utils import expert_block_permutation, unsort_combine
+from .swizzle import grouped_tile_schedule
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupGemmConfig:
+    """Tile sizes for :func:`grouped_matmul` (same knob set as the dense
+    ``matmul``).  The (256, 2048, 512) default measured 1.05-1.09x of
+    ``lax.ragged_dot`` on both MoE projection directions (T=8192, E=8,
+    7168<->2048 bf16, interleaved per-round ratios): the full-width N tile
+    reads each x m-tile once, and the short M tile keeps the f32
+    accumulator small enough to double-buffer."""
+
+    bm: int = 256
+    bn: int = 2048
+    bk: int = 512
+
+
+def _grouped_matmul_kernel(
+    bm: int, nk: int, out_dtype,
+    tile_ids, group_ids, row_starts, row_ends, is_first,  # scalar prefetch
+    x_ref,      # (bm, bk) rows of the current m-tile
+    w_ref,      # (bk, bn) current group's weight block (leading dim squeezed)
+    o_ref,      # (bm, bn) output tile (revisited per overlapping group)
+    acc_ref,    # (bm, bn) f32 scratch
+):
+    wi = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # pad slots (empty row range) skip the MXU work entirely; their
+    # epilogue then writes/adds the zeros left in acc
+    @pl.when(row_starts[wi] < row_ends[wi])
+    def _():
+        acc_ref[...] += jnp.dot(
+            x_ref[...], w_ref[...], preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kk == nk - 1)
+    def _():
+        # zero the rows of this tile that belong to other groups; their
+        # slots contribute them, so the adds across slots stay exact
+        row = tile_ids[wi] * bm + jax.lax.broadcasted_iota(
+            jnp.int32, (bm, 1), 0
+        )
+        mask = (row >= row_starts[wi]) & (row < row_ends[wi])
+        val = jnp.where(mask, acc_ref[...], 0.0).astype(out_dtype)
+
+        @pl.when(is_first[wi] == 1)
+        def _():
+            o_ref[...] = val
+
+        @pl.when(is_first[wi] == 0)
+        def _():
+            o_ref[...] = o_ref[...] + val
+
+
+@functools.lru_cache(maxsize=None)
+def _build_grouped_matmul(t, k, n_dim, e, bm, bn, bk, dtype, out_dtype):
+    nt, nj, nk = t // bm, n_dim // bn, k // bk
+    num_slots = nt + e
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(nj, num_slots, nk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda j, w, kk, tid, *_: (tid[w], kk)),
+            pl.BlockSpec(
+                (None, bk, bn), lambda j, w, kk, tid, gid, *_: (gid[w], kk, j)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (bm, bn), lambda j, w, kk, tid, *_: (tid[w], j)
+        ),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+    )
+    # bytes: x re-read per n-tile, w blocks once per slot, out written once
+    cost = pl.CostEstimate(
+        flops=2 * t * k * n_dim,
+        bytes_accessed=(t * k * nj * jnp.dtype(dtype).itemsize
+                        + num_slots * k * bn * jnp.dtype(dtype).itemsize
+                        + t * n_dim * jnp.dtype(out_dtype).itemsize),
+        transcendentals=0,
+    )
+    call = pl.pallas_call(
+        functools.partial(_grouped_matmul_kernel, bm, nk, out_dtype),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, n_dim), out_dtype),
+        cost_estimate=cost,
+        compiler_params=compilation.compiler_params(
+            collective=False,
+            # slots revisit output blocks, so both w and k are sequential
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+    return jax.jit(call)
+
+
+def grouped_matmul(
+    x_sorted: jax.Array,
+    w: jax.Array,
+    splits: jax.Array,
+    *,
+    config: GroupGemmConfig | None = None,
+    out_dtype=None,
+) -> jax.Array:
+    """Tile-scheduled Pallas grouped matmul: (T, K) x (E, K, N) -> (T, N).
+
+    The kernel half of the reference's aligned group GEMM
+    (``allgather_group_gemm.py:532`` consuming
+    ``moe_ag_scatter_align_block_size``'s block schedule): m-tiles are
+    enumerated by ``swizzle.grouped_tile_schedule`` into (tile, group) work
+    units delivered through scalar prefetch — the expert id picks the
+    weight block via the BlockSpec index map, boundary tiles are visited
+    once per overlapping group with other groups' rows masked, and rows
+    past ``sum(splits)`` come back zero-filled.  Where the reference pads
+    and physically reorders token ids so each CUDA block is single-expert,
+    the TPU kernel masks in VMEM and never copies ``x``.
+    """
+    t, k = x_sorted.shape
+    e, k2, n_dim = w.shape
+    if k2 != k:
+        raise ValueError(f"inner dims mismatch: {x_sorted.shape} @ {w.shape}")
+    if splits.shape != (e,):
+        raise ValueError(f"splits {splits.shape} != (E,) = ({e},)")
+    cfg = config or GroupGemmConfig()
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(
+        x_sorted.dtype
+    )
+    bm, bn, bk = (
+        clip_block(cfg.bm, t), clip_block(cfg.bn, n_dim), clip_block(cfg.bk, k)
+    )
+    sched = grouped_tile_schedule(splits, t, bm)
+    fn = _build_grouped_matmul(
+        t, k, n_dim, e, bm, bn, bk, jnp.dtype(x_sorted.dtype), out_dtype
+    )
+    return fn(*sched, x_sorted, w)
 
 
 def group_gemm(x_sorted: jax.Array, w: jax.Array,
@@ -40,7 +185,9 @@ def group_gemm(x_sorted: jax.Array, w: jax.Array,
     ``x_sorted``: (T, K) rows grouped by expert; ``w``: (E, K, N);
     ``splits``: (E,) int32 row counts (sum <= T; padding rows at the tail
     multiply expert E-1 garbage-free — their outputs are never gathered).
-    Returns (T, N).
+    Returns (T, N).  This is the XLA path (``lax.ragged_dot``);
+    :func:`grouped_matmul` is the tile-scheduled Pallas path with
+    explicit block-size control.
     """
     t, k = x_sorted.shape
     e, k2, n_dim = w.shape

@@ -54,17 +54,21 @@ def matmul(
     a: jax.Array,
     b: jax.Array,
     *,
-    bm: int = 1024,
-    bn: int = 1024,
+    bm: int = 512,
+    bn: int = 1792,
     bk: int = 512,
     out_dtype=None,
 ) -> jax.Array:
     """C = A @ B with f32 accumulation, blocked for the MXU.
 
-    Defaults (1024, 1024, 512) measured at 0.97-0.99x of XLA's own GEMM for
-    large bf16 problems on v5e (interleaved A/B timing, 7168^3); the
-    round-1 512x512 output tiles are HBM-bound and cost ~13% (VERDICT.md
-    weak #3).
+    Defaults (512, 1792, 512) measured at 1.03x of XLA's own GEMM at
+    7168^3 bf16 (median per-round interleaved ratio over 14 rounds; the
+    wide 14-lane-tile N block keeps the MXU fed while halving the
+    accumulator footprint vs 1024x1024, which measured 0.99x).  For shapes
+    1792 does not divide, ``clip_block`` degrades bn to the largest
+    sublane-aligned divisor (1024/512/...), recovering the round-1
+    behavior.  The round-1 512x512 output tiles are HBM-bound and cost
+    ~13% (VERDICT.md weak #3).
     """
     (m, k), (k2, n) = a.shape, b.shape
     if k2 != k:

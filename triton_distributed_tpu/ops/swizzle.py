@@ -1,0 +1,119 @@
+"""Tile scheduling: ring-arrival consumption orders and grouped-GEMM
+work-unit schedules.
+
+Reference: the threadblock-swizzle family —
+``kernels/nvidia/ag_gemm_threadblock_swizzle.py`` /
+``gemm_rs_threadblock_swizzle.py`` (tile visit orders following ring
+arrival), ``threadblock_swizzle_ag_moe.{py,cu,cc}`` (AG-MoE tile order,
+shipped in python/Triton/native-CUDA triplicate) and the host alignment op
+``csrc/lib/moe_utils.cu:61-314`` (``moe_ag_scatter_align_block_size``: pad
+each expert's token run to block multiples and emit per-block expert ids).
+
+On TPU the consumers differ, so the module splits in two:
+
+- **ring orders** (:func:`ring_chunk_order`): the chunk consumption
+  sequence of the fused collective kernels (``ops.ag_gemm``), self first
+  then by ring arrival — trace-time integer math, no kernel;
+- **grouped schedules** (:func:`grouped_tile_schedule`): the reference's
+  block-alignment kernel becomes a *jittable index computation* whose
+  outputs feed a Pallas kernel through scalar prefetch
+  (``ops.group_gemm.grouped_matmul``).  Instead of physically padding the
+  token array to block multiples (the reference materializes
+  ``sorted_token_ids`` with pad slots), the schedule enumerates
+  (m-tile, group) work units over the *unpadded* rows and the kernel masks
+  the rows of other groups — same tiling, no HBM copy of the inputs.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+def ring_chunk_order(rank, size: int, step: int):
+    """Chunk id consumed at ring step ``step`` by ``rank`` (0 = the local
+    shard, then counter-flow arrival order: me, me-1, me-2, ...).
+
+    The unidirectional-ring swizzle of ``ops.ag_gemm`` (reference:
+    rank-offset tile reordering, ``allgather_gemm.py:205-215``).  ``rank``
+    may be a traced scalar; ``size``/``step`` are trace-time ints.
+    """
+    if step == 0:
+        return rank
+    return jax.lax.rem(rank + size - step, size)
+
+
+class GroupedSchedule(NamedTuple):
+    """Scalar-prefetch arrays for :func:`ops.group_gemm.grouped_matmul`.
+
+    All int32 of length ``num_slots = num_rows//bm + num_groups`` (static).
+    Slot ``s`` multiplies m-tile ``tile_ids[s]`` by group ``group_ids[s]``'s
+    weights, contributing only rows in ``[row_starts[s], row_ends[s])``
+    (global row ids; empty for padding slots).  ``is_first[s]`` is 1 on the
+    first slot of each tile (the kernel initializes the output block there,
+    accumulating on later slots).  Slots are tile-major, so revisits of an
+    output block are always grid-adjacent.
+    """
+
+    tile_ids: jax.Array
+    group_ids: jax.Array
+    row_starts: jax.Array
+    row_ends: jax.Array
+    is_first: jax.Array
+
+
+def grouped_tile_schedule(group_sizes: jax.Array, num_rows: int,
+                          bm: int) -> GroupedSchedule:
+    """Work-unit schedule for a grouped matmul over expert-sorted rows.
+
+    ``group_sizes``: (E,) int32 row counts per group, contiguous from row 0
+    (sum <= num_rows; trailing rows belong to no group and are zero-filled
+    by the kernel).  ``num_rows`` must divide by ``bm``.
+
+    Jittable: every output has static shape ``(num_rows//bm + E,)``; the
+    values are data-dependent, which is exactly what scalar prefetch
+    exists for.  This is the reference's ``moe_ag_scatter_align_block_size``
+    re-derived for TPU: where the CUDA kernel pads token ids so every block
+    is single-expert, this schedule lets a block span a group boundary and
+    assigns it one work unit per overlapped group.
+    """
+    (num_groups,) = group_sizes.shape
+    if num_rows % bm:
+        raise ValueError(f"num_rows={num_rows} not divisible by bm={bm}")
+    nt = num_rows // bm
+    num_slots = nt + num_groups
+
+    sizes = group_sizes.astype(jnp.int32)
+    ends = jnp.cumsum(sizes)
+    starts = ends - sizes
+    tile_lo = jnp.arange(nt, dtype=jnp.int32) * bm
+
+    # groups intersecting tile t: first = first group ending past the tile
+    # start, last = last group starting before the tile end
+    first = jnp.searchsorted(ends, tile_lo, side="right").astype(jnp.int32)
+    last = (jnp.searchsorted(starts, tile_lo + bm, side="left") - 1).astype(
+        jnp.int32
+    )
+    per_tile = jnp.maximum(last - first + 1, 0)
+    # every tile gets >= 1 slot so uncovered trailing tiles still zero-fill
+    slots_per_tile = jnp.maximum(per_tile, 1)
+    slot_end = jnp.cumsum(slots_per_tile)
+    total = slot_end[nt - 1]
+
+    s = jnp.arange(num_slots, dtype=jnp.int32)
+    tile = jnp.minimum(
+        jnp.searchsorted(slot_end, s, side="right").astype(jnp.int32), nt - 1
+    )
+    rank_in_tile = s - (slot_end[tile] - slots_per_tile[tile])
+    group = jnp.clip(first[tile] + rank_in_tile, 0, num_groups - 1)
+
+    lo = tile * bm
+    row_start = jnp.maximum(starts[group], lo)
+    row_end = jnp.minimum(ends[group], lo + bm)
+    valid = s < total
+    row_start = jnp.where(valid, row_start, 0)
+    row_end = jnp.where(valid, row_end, 0)
+    is_first = ((rank_in_tile == 0) & valid).astype(jnp.int32)
+    return GroupedSchedule(tile, group, row_start, row_end, is_first)

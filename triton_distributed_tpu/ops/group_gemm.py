@@ -198,12 +198,27 @@ def group_gemm(x_sorted: jax.Array, w: jax.Array,
     return jax.lax.ragged_dot(x_sorted, w, splits.astype(jnp.int32))
 
 
+def _local_group_gemm(x, w, splits, config: GroupGemmConfig | None):
+    """Per-shard grouped matmul dispatch: the tile-scheduled Pallas kernel
+    on real TPU (measured 1.03-1.17x of ``ragged_dot``), ``ragged_dot``
+    under CPU interpret mode where simulating the Pallas grid costs more
+    than it models.  Pass ``config`` to force the Pallas path with explicit
+    tiles anywhere."""
+    from ..core import platform
+
+    if config is None and platform.on_cpu():
+        return jax.lax.ragged_dot(x, w, splits.astype(jnp.int32))
+    return grouped_matmul(x, w, splits, config=config)
+
+
 def ag_group_gemm(
     x_sorted: jax.Array,
     w: jax.Array,
     splits: jax.Array,
     mesh: Mesh,
     axis: str = TP_AXIS,
+    *,
+    config: GroupGemmConfig | None = None,
 ):
     """AllGather tokens over ``axis``, merge to global expert order, and
     group-GEMM against the column-sharded expert weights (reference
@@ -230,7 +245,7 @@ def ag_group_gemm(
     x_glob = jnp.take(gathered, perm, axis=0)            # global expert order
 
     def local(xg, w_loc):
-        return jax.lax.ragged_dot(xg, w_loc, total_splits)
+        return _local_group_gemm(xg, w_loc, total_splits, config)
 
     y = compilation.jit_shard_map(
         local, mesh,
@@ -249,6 +264,8 @@ def moe_reduce_rs(
     topk: int,
     mesh: Mesh,
     axis: str = TP_AXIS,
+    *,
+    config: GroupGemmConfig | None = None,
 ) -> jax.Array:
     """Down-project expert outputs, fold the top-k copies with their
     routing weights, and ReduceScatter the partial sums back to token
@@ -266,7 +283,7 @@ def moe_reduce_rs(
 
     def local(y_loc, w_loc):
         # partial down-projection (this rank's N slice -> partial sums)
-        part = jax.lax.ragged_dot(y_loc, w_loc, total_splits)
+        part = _local_group_gemm(y_loc, w_loc, total_splits, config)
         # back to pre-sort order, weighted top-k fold: (n*T//topk, K)
         return unsort_combine(part, presort_idx, weights, topk)
 

@@ -1,0 +1,392 @@
+// Native safetensors reader: mmap the file, parse the header, hand out
+// zero-copy tensor views.
+//
+// The native half of the framework's weight-ingest path
+// (models/safetensors_io.py) — the TPU-side analogue of the reference
+// keeping its hot host paths in native code (csrc/, shmem/ runtimes).
+// Reads the safetensors container format: 8-byte little-endian header
+// length, a flat JSON header {"name": {"dtype": "...", "shape": [...],
+// "data_offsets": [begin, end]}, ...}, then the raw byte buffer.  The
+// JSON subset needed is tiny, so the parser is self-contained — no
+// dependencies beyond libc.
+//
+// C ABI (consumed via ctypes):
+//   StFile* st_open(const char* path);        NULL on error
+//   const char* st_last_error(void);          message for the last failure
+//   long st_num_tensors(StFile*);
+//   const char* st_name(StFile*, long i);
+//   const char* st_dtype(StFile*, long i);    safetensors dtype tag (e.g. "BF16")
+//   long st_ndim(StFile*, long i);
+//   void st_shape(StFile*, long i, long long* out);
+//   const void* st_data(StFile*, long i);     pointer into the mapping
+//   long long st_nbytes(StFile*, long i);
+//   void st_close(StFile*);
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_error;
+
+struct Tensor {
+  std::string name;
+  std::string dtype;
+  std::vector<long long> shape;
+  uint64_t begin = 0;  // relative to the byte buffer
+  uint64_t end = 0;
+};
+
+struct Parser {
+  const char* p;
+  const char* lim;
+  bool fail = false;
+  std::string err;
+
+  void set_err(const std::string& m) {
+    if (!fail) {
+      fail = true;
+      err = m;
+    }
+  }
+  void ws() {
+    while (p < lim && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool eat(char c) {
+    ws();
+    if (p < lim && *p == c) {
+      ++p;
+      return true;
+    }
+    set_err(std::string("expected '") + c + "'");
+    return false;
+  }
+  bool peek(char c) {
+    ws();
+    return p < lim && *p == c;
+  }
+
+  std::string parse_string() {
+    if (!eat('"')) return "";
+    std::string out;
+    while (p < lim && *p != '"') {
+      char c = *p++;
+      if (c != '\\') {
+        out += c;
+        continue;
+      }
+      if (p >= lim) break;
+      char e = *p++;
+      switch (e) {
+        case '"': out += '"'; break;
+        case '\\': out += '\\'; break;
+        case '/': out += '/'; break;
+        case 'b': out += '\b'; break;
+        case 'f': out += '\f'; break;
+        case 'n': out += '\n'; break;
+        case 'r': out += '\r'; break;
+        case 't': out += '\t'; break;
+        case 'u': {
+          if (lim - p < 4) {
+            set_err("truncated \\u escape");
+            return out;
+          }
+          unsigned v = 0;
+          for (int i = 0; i < 4; ++i) {
+            char h = *p++;
+            v <<= 4;
+            if (h >= '0' && h <= '9') v |= h - '0';
+            else if (h >= 'a' && h <= 'f') v |= h - 'a' + 10;
+            else if (h >= 'A' && h <= 'F') v |= h - 'A' + 10;
+            else {
+              set_err("bad \\u escape");
+              return out;
+            }
+          }
+          // encode as UTF-8 (surrogate pairs unsupported: tensor names
+          // outside the BMP fail loudly rather than silently mis-read)
+          if (v >= 0xD800 && v <= 0xDFFF) {
+            set_err("surrogate pairs in names are not supported");
+            return out;
+          }
+          if (v < 0x80) out += static_cast<char>(v);
+          else if (v < 0x800) {
+            out += static_cast<char>(0xC0 | (v >> 6));
+            out += static_cast<char>(0x80 | (v & 0x3F));
+          } else {
+            out += static_cast<char>(0xE0 | (v >> 12));
+            out += static_cast<char>(0x80 | ((v >> 6) & 0x3F));
+            out += static_cast<char>(0x80 | (v & 0x3F));
+          }
+          break;
+        }
+        default:
+          set_err("bad escape");
+          return out;
+      }
+    }
+    if (p >= lim) {
+      set_err("unterminated string");
+      return out;
+    }
+    ++p;  // closing quote
+    return out;
+  }
+
+  long long parse_int() {
+    ws();
+    bool neg = false;
+    if (p < lim && *p == '-') {
+      neg = true;
+      ++p;
+    }
+    if (p >= lim || *p < '0' || *p > '9') {
+      set_err("expected integer");
+      return 0;
+    }
+    unsigned long long v = 0;
+    while (p < lim && *p >= '0' && *p <= '9') v = v * 10 + (*p++ - '0');
+    return neg ? -static_cast<long long>(v) : static_cast<long long>(v);
+  }
+
+  // skip any JSON value (used for __metadata__)
+  void skip_value() {
+    ws();
+    if (p >= lim) {
+      set_err("truncated value");
+      return;
+    }
+    char c = *p;
+    if (c == '"') {
+      parse_string();
+    } else if (c == '{') {
+      ++p;
+      if (peek('}')) {
+        ++p;
+        return;
+      }
+      while (!fail) {
+        parse_string();
+        if (!eat(':')) return;
+        skip_value();
+        if (peek(',')) {
+          ++p;
+          continue;
+        }
+        eat('}');
+        return;
+      }
+    } else if (c == '[') {
+      ++p;
+      if (peek(']')) {
+        ++p;
+        return;
+      }
+      while (!fail) {
+        skip_value();
+        if (peek(',')) {
+          ++p;
+          continue;
+        }
+        eat(']');
+        return;
+      }
+    } else if (c == 't' && lim - p >= 4 && !memcmp(p, "true", 4)) {
+      p += 4;
+    } else if (c == 'f' && lim - p >= 5 && !memcmp(p, "false", 5)) {
+      p += 5;
+    } else if (c == 'n' && lim - p >= 4 && !memcmp(p, "null", 4)) {
+      p += 4;
+    } else {
+      // number (possibly float — consume the usual charset)
+      const char* q = p;
+      while (p < lim && (strchr("+-.eE", *p) || (*p >= '0' && *p <= '9')))
+        ++p;
+      if (p == q) set_err("bad value");
+    }
+  }
+};
+
+struct StFile {
+  void* map = nullptr;
+  size_t map_len = 0;
+  const uint8_t* data = nullptr;  // byte buffer start
+  size_t data_len = 0;
+  std::vector<Tensor> tensors;
+};
+
+size_t dtype_size(const std::string& d) {
+  if (d == "F64" || d == "I64" || d == "U64") return 8;
+  if (d == "F32" || d == "I32" || d == "U32") return 4;
+  if (d == "F16" || d == "BF16" || d == "I16" || d == "U16") return 2;
+  if (d == "F8_E4M3" || d == "F8_E5M2" || d == "I8" || d == "U8" ||
+      d == "BOOL")
+    return 1;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void st_close(StFile* f);
+
+const char* st_last_error() { return g_error.c_str(); }
+
+StFile* st_open(const char* path) {
+  g_error.clear();
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) {
+    g_error = std::string("cannot open ") + path;
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 8) {
+    g_error = "file too short for a safetensors header";
+    close(fd);
+    return nullptr;
+  }
+  size_t len = static_cast<size_t>(st.st_size);
+  void* map = mmap(nullptr, len, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (map == MAP_FAILED) {
+    g_error = "mmap failed";
+    return nullptr;
+  }
+  const uint8_t* base = static_cast<const uint8_t*>(map);
+  uint64_t hlen;
+  memcpy(&hlen, base, 8);  // format is little-endian; so are our targets
+  if (hlen > len - 8) {
+    g_error = "header length exceeds file size";
+    munmap(map, len);
+    return nullptr;
+  }
+
+  auto* f = new StFile;
+  f->map = map;
+  f->map_len = len;
+  f->data = base + 8 + hlen;
+  f->data_len = len - 8 - hlen;
+
+  Parser ps{reinterpret_cast<const char*>(base + 8),
+            reinterpret_cast<const char*>(base + 8 + hlen)};
+  if (ps.eat('{') && !ps.peek('}')) {
+    while (!ps.fail) {
+      std::string name = ps.parse_string();
+      if (!ps.eat(':')) break;
+      if (name == "__metadata__") {
+        ps.skip_value();
+      } else {
+        Tensor t;
+        t.name = std::move(name);
+        if (!ps.eat('{')) break;
+        while (!ps.fail) {
+          std::string key = ps.parse_string();
+          if (!ps.eat(':')) break;
+          if (key == "dtype") {
+            t.dtype = ps.parse_string();
+          } else if (key == "shape") {
+            if (!ps.eat('[')) break;
+            if (ps.peek(']')) {
+              ++ps.p;
+            } else {
+              while (!ps.fail) {
+                t.shape.push_back(ps.parse_int());
+                if (ps.peek(',')) {
+                  ++ps.p;
+                  continue;
+                }
+                ps.eat(']');
+                break;
+              }
+            }
+          } else if (key == "data_offsets") {
+            if (!ps.eat('[')) break;
+            t.begin = static_cast<uint64_t>(ps.parse_int());
+            if (!ps.eat(',')) break;
+            t.end = static_cast<uint64_t>(ps.parse_int());
+            ps.eat(']');
+          } else {
+            ps.skip_value();
+          }
+          if (ps.peek(',')) {
+            ++ps.p;
+            continue;
+          }
+          ps.eat('}');
+          break;
+        }
+        f->tensors.push_back(std::move(t));
+      }
+      if (ps.peek(',')) {
+        ++ps.p;
+        continue;
+      }
+      ps.eat('}');
+      break;
+    }
+  }
+  if (ps.fail) {
+    g_error = "header parse error: " + ps.err;
+    st_close(f);
+    return nullptr;
+  }
+  // validate every tensor before handing out pointers
+  for (const Tensor& t : f->tensors) {
+    size_t es = dtype_size(t.dtype);
+    unsigned long long count = 1;
+    for (long long d : t.shape) {
+      if (d < 0) {
+        g_error = "negative dimension in tensor " + t.name;
+        st_close(f);
+        return nullptr;
+      }
+      count *= static_cast<unsigned long long>(d);
+    }
+    if (es == 0 || t.end < t.begin || t.end > f->data_len ||
+        t.end - t.begin != count * es) {
+      g_error = "inconsistent tensor entry: " + t.name;
+      st_close(f);
+      return nullptr;
+    }
+  }
+  return f;
+}
+
+long st_num_tensors(StFile* f) { return static_cast<long>(f->tensors.size()); }
+
+const char* st_name(StFile* f, long i) { return f->tensors[i].name.c_str(); }
+
+const char* st_dtype(StFile* f, long i) { return f->tensors[i].dtype.c_str(); }
+
+long st_ndim(StFile* f, long i) {
+  return static_cast<long>(f->tensors[i].shape.size());
+}
+
+void st_shape(StFile* f, long i, long long* out) {
+  const auto& s = f->tensors[i].shape;
+  for (size_t d = 0; d < s.size(); ++d) out[d] = s[d];
+}
+
+const void* st_data(StFile* f, long i) {
+  return f->data + f->tensors[i].begin;
+}
+
+long long st_nbytes(StFile* f, long i) {
+  return static_cast<long long>(f->tensors[i].end - f->tensors[i].begin);
+}
+
+void st_close(StFile* f) {
+  if (f->map) munmap(f->map, f->map_len);
+  delete f;
+}
+
+}  // extern "C"

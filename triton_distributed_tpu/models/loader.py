@@ -108,3 +108,25 @@ def load_qwen_state_dict(
         final_norm=rep(_vec(state_dict, prefix + "norm.weight", dt)),
         lm_head=rep(lm_head),
     )
+
+
+def load_qwen_from_safetensors(
+    model: Qwen3,
+    path: str,
+    *,
+    prefix: str = "model.",
+    native: bool | None = None,
+) -> QwenParams:
+    """Load sharded :class:`QwenParams` straight from safetensors weights
+    on disk (a file, an HF ``*.index.json``, or a checkpoint directory).
+
+    Tensors stream zero-copy from the mmap'd file(s) through
+    :mod:`models.safetensors_io` (native C++ reader when the toolchain is
+    available) into their sharded device layouts — host RSS stays at one
+    tensor, not one model.
+    """
+    from .safetensors_io import load_state_dict
+
+    return load_qwen_state_dict(
+        model, load_state_dict(path, native=native), prefix=prefix
+    )

@@ -1,0 +1,279 @@
+"""Safetensors weight files: native mmap reader, numpy fallback, writer.
+
+The file-level half of weight ingest (reference: weights stream from the
+HF hub through torch's loader, ``models/qwen.py:147-165``; its host-side
+native code lives in ``csrc/``).  Here the reader is native C++
+(``csrc/safetensors_reader.cc``: one mmap, header parsed without a JSON
+DOM, zero-copy tensor views served straight from the mapping), compiled
+on demand via ``tools.native`` with a pure-numpy fallback producing the
+same views through ``np.memmap``.  ``load_state_dict`` accepts a single
+``.safetensors`` file, an HF ``*.index.json``, or a directory of shards,
+and feeds ``loader.load_qwen_state_dict`` without materializing more
+than one device copy.
+
+Arrays returned by the readers are read-only views into the mapped file;
+the mapping lives as long as some returned array (or the
+:class:`SafetensorsFile`) is referenced.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import struct
+from typing import Iterator, Mapping
+
+import numpy as np
+
+_DTYPES: dict[str, np.dtype] = {}
+
+
+def _dtype_table() -> dict[str, np.dtype]:
+    if not _DTYPES:
+        import ml_dtypes
+
+        _DTYPES.update({
+            "F64": np.dtype(np.float64),
+            "F32": np.dtype(np.float32),
+            "F16": np.dtype(np.float16),
+            "BF16": np.dtype(ml_dtypes.bfloat16),
+            "F8_E4M3": np.dtype(ml_dtypes.float8_e4m3fn),
+            "F8_E5M2": np.dtype(ml_dtypes.float8_e5m2),
+            "I64": np.dtype(np.int64),
+            "I32": np.dtype(np.int32),
+            "I16": np.dtype(np.int16),
+            "I8": np.dtype(np.int8),
+            "U64": np.dtype(np.uint64),
+            "U32": np.dtype(np.uint32),
+            "U16": np.dtype(np.uint16),
+            "U8": np.dtype(np.uint8),
+            "BOOL": np.dtype(np.bool_),
+        })
+    return _DTYPES
+
+
+def _to_tag(dt: np.dtype) -> str:
+    for tag, d in _dtype_table().items():
+        if d == dt:
+            return tag
+    raise ValueError(f"dtype {dt} has no safetensors tag")
+
+
+def _load_lib():
+    from ..tools.native import load_native
+
+    lib = load_native("safetensors_reader.cc")
+    if lib and not getattr(lib, "_st_typed", False):
+        lib.st_open.restype = ctypes.c_void_p
+        lib.st_open.argtypes = [ctypes.c_char_p]
+        lib.st_last_error.restype = ctypes.c_char_p
+        lib.st_num_tensors.restype = ctypes.c_long
+        lib.st_num_tensors.argtypes = [ctypes.c_void_p]
+        lib.st_name.restype = ctypes.c_char_p
+        lib.st_name.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.st_dtype.restype = ctypes.c_char_p
+        lib.st_dtype.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.st_ndim.restype = ctypes.c_long
+        lib.st_ndim.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.st_shape.restype = None
+        lib.st_shape.argtypes = [
+            ctypes.c_void_p, ctypes.c_long, ctypes.POINTER(ctypes.c_longlong)
+        ]
+        lib.st_data.restype = ctypes.c_void_p
+        lib.st_data.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.st_nbytes.restype = ctypes.c_longlong
+        lib.st_nbytes.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.st_close.restype = None
+        lib.st_close.argtypes = [ctypes.c_void_p]
+        lib._st_typed = True
+    return lib
+
+
+class SafetensorsFile(Mapping):
+    """Dict-like zero-copy view of one ``.safetensors`` file.
+
+    ``native=None`` (default) uses the C++ reader when the toolchain is
+    available, else the numpy fallback; both produce identical read-only
+    arrays.  Close explicitly or let GC unmap; arrays handed out keep the
+    mapping alive through their ``base`` chain (numpy path) or a handle
+    reference (native path).
+    """
+
+    def __init__(self, path: str, *, native: bool | None = None):
+        self.path = path
+        self._arrays: dict[str, np.ndarray] = {}
+        self._handle = None
+        self._lib = None
+        lib = _load_lib() if native in (None, True) else False
+        if native is True and not lib:
+            raise RuntimeError("native safetensors reader unavailable")
+        if lib:
+            handle = lib.st_open(path.encode())
+            if not handle:
+                raise ValueError(
+                    f"{path}: {lib.st_last_error().decode(errors='replace')}"
+                )
+            self._lib, self._handle = lib, handle
+            self._read_native(lib, handle)
+        else:
+            self._read_numpy(path)
+
+    def _read_native(self, lib, handle) -> None:
+        table = _dtype_table()
+        for i in range(lib.st_num_tensors(handle)):
+            name = lib.st_name(handle, i).decode()
+            tag = lib.st_dtype(handle, i).decode()
+            if tag not in table:
+                raise ValueError(f"{self.path}: unsupported dtype {tag!r}")
+            ndim = lib.st_ndim(handle, i)
+            shape = (ctypes.c_longlong * max(ndim, 1))()
+            lib.st_shape(handle, i, shape)
+            nbytes = lib.st_nbytes(handle, i)
+            ptr = lib.st_data(handle, i)
+            if nbytes:
+                buf = (ctypes.c_ubyte * nbytes).from_address(ptr)
+                arr = np.frombuffer(buf, dtype=table[tag])
+            else:
+                arr = np.empty(0, dtype=table[tag])
+            arr = arr.reshape(tuple(shape[:ndim]))
+            arr.flags.writeable = False
+            # keep the mapping alive as long as any view is
+            arr = arr.view(_OwnedView)
+            arr._owner = self
+            self._arrays[name] = arr
+
+    def _read_numpy(self, path: str) -> None:
+        table = _dtype_table()
+        with open(path, "rb") as f:
+            (hlen,) = struct.unpack("<Q", f.read(8))
+            header = json.loads(f.read(hlen))
+        raw = np.memmap(path, dtype=np.uint8, mode="r", offset=8 + hlen)
+        for name, info in header.items():
+            if name == "__metadata__":
+                continue
+            tag = info["dtype"]
+            if tag not in table:
+                raise ValueError(f"{path}: unsupported dtype {tag!r}")
+            a, b = info["data_offsets"]
+            arr = raw[a:b].view(table[tag]).reshape(tuple(info["shape"]))
+            self._arrays[name] = arr
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self._arrays[name]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._arrays)
+
+    def __len__(self) -> int:
+        return len(self._arrays)
+
+    def close(self) -> None:
+        """Unmap.  Only safe once no returned array is referenced."""
+        self._arrays.clear()
+        if self._handle is not None:
+            self._lib.st_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            if self._handle is not None and self._lib is not None:
+                self._lib.st_close(self._handle)
+        except Exception:
+            pass
+
+
+class _OwnedView(np.ndarray):
+    """ndarray subclass carrying a reference to the mapping owner."""
+
+    _owner = None
+
+    def __array_finalize__(self, obj):
+        if obj is not None:
+            self._owner = getattr(obj, "_owner", None)
+
+
+class _ShardedDict(Mapping):
+    """Lazy union of per-shard :class:`SafetensorsFile` mappings."""
+
+    def __init__(self, files: dict[str, SafetensorsFile],
+                 weight_map: dict[str, str]):
+        self._files = files
+        self._map = weight_map
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self._files[self._map[name]][name]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._map)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+
+def load_state_dict(path: str, *, native: bool | None = None) -> Mapping:
+    """Open safetensors weights as a lazy name -> ndarray mapping.
+
+    ``path`` may be a ``.safetensors`` file, an HF ``*.index.json`` shard
+    index, or a directory containing either.
+    """
+    if os.path.isdir(path):
+        index = [f for f in sorted(os.listdir(path))
+                 if f.endswith(".index.json")]
+        if index:
+            path = os.path.join(path, index[0])
+        else:
+            shards = [f for f in sorted(os.listdir(path))
+                      if f.endswith(".safetensors")]
+            if not shards:
+                raise FileNotFoundError(f"no safetensors files under {path}")
+            files = {
+                f: SafetensorsFile(os.path.join(path, f), native=native)
+                for f in shards
+            }
+            wmap = {name: f for f, sf in files.items() for name in sf}
+            return _ShardedDict(files, wmap)
+    if path.endswith(".index.json"):
+        with open(path) as f:
+            wmap = json.load(f)["weight_map"]
+        base = os.path.dirname(path)
+        files = {
+            f: SafetensorsFile(os.path.join(base, f), native=native)
+            for f in sorted(set(wmap.values()))
+        }
+        return _ShardedDict(files, wmap)
+    return SafetensorsFile(path, native=native)
+
+
+def save_safetensors(arrays: Mapping[str, np.ndarray], path: str,
+                     *, metadata: dict[str, str] | None = None) -> None:
+    """Write a safetensors file (pure Python; the export direction is
+    cold).  Header is padded with spaces to 8-byte alignment like the
+    format's reference implementation."""
+    header: dict = {}
+    if metadata:
+        header["__metadata__"] = metadata
+    blobs = []
+    off = 0
+    for name, arr in arrays.items():
+        # NOT ascontiguousarray: it silently promotes 0-d to 1-d, and
+        # tobytes() below already emits C order for any layout
+        arr = np.asarray(arr)
+        nbytes = arr.nbytes
+        header[name] = {
+            "dtype": _to_tag(arr.dtype),
+            "shape": list(arr.shape),
+            "data_offsets": [off, off + nbytes],
+        }
+        blobs.append(arr.tobytes())
+        off += nbytes
+    hjson = json.dumps(header, separators=(",", ":")).encode()
+    pad = (8 - (len(hjson) % 8)) % 8
+    hjson += b" " * pad
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        for b in blobs:
+            f.write(b)
+    os.replace(tmp, path)

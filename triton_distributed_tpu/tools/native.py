@@ -1,0 +1,64 @@
+"""On-demand build + load of the framework's native C++ components.
+
+Reference: the reference ships its host-side native code prebuilt through
+``python/setup.py``'s cmake superbuild (``csrc/``, ``shmem/`` runtimes).
+Here the native pieces are small single-file C++ libraries (``csrc/``)
+compiled lazily with the system toolchain and loaded via ctypes — no
+build step, no bindings dependency — and every consumer keeps a
+pure-Python fallback for toolchain-less hosts.
+
+Shared by ``tools.trace_merge`` (chrome-trace merger) and
+``models.safetensors_io`` (weight-file reader).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_CSRC_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "csrc",
+)
+
+_loaded: dict[str, "ctypes.CDLL | bool"] = {}
+
+
+def cache_dir() -> str:
+    return os.environ.get(
+        "TDT_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "triton_distributed_tpu"),
+    )
+
+
+def load_native(src_name: str, *, ldflags: tuple[str, ...] = ()) -> (
+        "ctypes.CDLL | bool"):
+    """Compile ``csrc/<src_name>`` (once, rebuilt when the source is newer
+    than the cached .so) and dlopen it.  Returns False when the toolchain
+    or source is unavailable — callers fall back to their Python paths.
+    """
+    key = src_name + ":" + " ".join(ldflags)
+    if key in _loaded:
+        return _loaded[key]
+    src = os.path.join(_CSRC_DIR, src_name)
+    so = os.path.join(cache_dir(), os.path.splitext(src_name)[0] + ".so")
+    try:
+        if not os.path.exists(so) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(so)
+        ):
+            os.makedirs(os.path.dirname(so), exist_ok=True)
+            tmp = so + f".tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, src,
+                 *ldflags],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(so)
+    except (OSError, subprocess.SubprocessError):
+        lib = False
+    _loaded[key] = lib
+    return lib

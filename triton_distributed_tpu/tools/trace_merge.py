@@ -15,59 +15,27 @@ from __future__ import annotations
 import ctypes
 import gzip
 import json
-import os
-import subprocess
 from typing import Sequence
+
+from .native import load_native
 
 _PID_OFFSET = 1_000_000
 
-_REPO_CSRC = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "csrc", "trace_merge.cc",
-)
-
-
-def _lib_path() -> str:
-    cache = os.environ.get(
-        "TDT_NATIVE_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache",
-                     "triton_distributed_tpu"),
-    )
-    return os.path.join(cache, "trace_merge.so")
-
-
-_lib: "ctypes.CDLL | None | bool" = None  # None=untried, False=unavailable
+_typed = {"done": False}
 
 
 def _load_native():
-    """Compile (once) and dlopen the native merger; False if impossible."""
-    global _lib
-    if _lib is not None:
-        return _lib
-    so = _lib_path()
-    try:
-        if not os.path.exists(so) or (
-            os.path.exists(_REPO_CSRC)
-            and os.path.getmtime(_REPO_CSRC) > os.path.getmtime(so)
-        ):
-            os.makedirs(os.path.dirname(so), exist_ok=True)
-            tmp = so + f".tmp.{os.getpid()}"
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _REPO_CSRC,
-                 "-lz"],
-                check=True, capture_output=True, timeout=120,
-            )
-            os.replace(tmp, so)
-        lib = ctypes.CDLL(so)
+    """Build/load the native merger via ``tools.native``; False if
+    impossible."""
+    lib = load_native("trace_merge.cc", ldflags=("-lz",))
+    if lib and not _typed["done"]:
         lib.tdt_merge_traces.restype = ctypes.c_int
         lib.tdt_merge_traces.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int),
             ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
         ]
-        _lib = lib
-    except (OSError, subprocess.SubprocessError):
-        _lib = False
-    return _lib
+        _typed["done"] = True
+    return lib
 
 
 def _merge_python(inputs: Sequence[str], ranks: Sequence[int],

@@ -1,6 +1,6 @@
-"""Hierarchical (2-level) AllGather and the persistent double-buffered AG
-layer (reference ``allgather.py:442-601`` 2D AG;
-``low_latency_allgather_layer.py:30``)."""
+"""Hierarchical (2-level) collectives and the persistent double-buffered AG
+layer (reference ``allgather.py:442-601`` 2D AG; 2D RS
+``reduce_scatter.py:688-882``; ``low_latency_allgather_layer.py:30``)."""
 
 import jax
 import jax.numpy as jnp
@@ -9,6 +9,10 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_distributed_tpu.comm.allgather import hierarchical_all_gather
+from triton_distributed_tpu.comm.allreduce import hierarchical_all_reduce
+from triton_distributed_tpu.comm.reduce_scatter import (
+    hierarchical_reduce_scatter,
+)
 from triton_distributed_tpu.core.mesh import make_mesh
 from triton_distributed_tpu.layers.allgather_layer import AllGatherLayer
 
@@ -34,6 +38,51 @@ def test_hierarchical_single_outer_falls_back():
     xs = jax.device_put(x, NamedSharding(mesh, P(("dcn", "ici"), None)))
     out = hierarchical_all_gather(xs, mesh, "ici", "dcn")
     assert np.allclose(np.asarray(jax.device_get(out)), np.asarray(x))
+
+
+@pytest.mark.parametrize("n_out,n_in", [(2, 4), (2, 2), (4, 2)])
+def test_hierarchical_reduce_scatter_matches_flat(n_out, n_in):
+    """Output must match a flat RS over the combined outer-major axis:
+    global block g of the sum lands on global rank g."""
+    n = n_out * n_in
+    mesh = make_mesh({"dcn": n_out, "ici": n_in}, devices=jax.devices()[:n])
+    mp, r = 2 * n, 128   # per-device partial rows, divisible by N
+    x = jax.random.normal(jax.random.key(5), (n * mp, r), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P(("dcn", "ici"), None)))
+    out = hierarchical_reduce_scatter(xs, mesh, "ici", "dcn")
+    want = np.asarray(x).reshape(n, mp, r).sum(0)
+    assert out.shape == (mp, r)
+    np.testing.assert_allclose(np.asarray(jax.device_get(out)), want,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_hierarchical_reduce_scatter_single_outer_falls_back():
+    mesh = make_mesh({"dcn": 1, "ici": 4}, devices=jax.devices()[:4])
+    mp, r = 8, 128
+    x = jax.random.normal(jax.random.key(6), (4 * mp, r), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P(("dcn", "ici"), None)))
+    out = hierarchical_reduce_scatter(xs, mesh, "ici", "dcn")
+    want = np.asarray(x).reshape(4, mp, r).sum(0)
+    np.testing.assert_allclose(np.asarray(jax.device_get(out)), want,
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("n_out,n_in", [(2, 4), (2, 2), (4, 2)])
+def test_hierarchical_all_reduce_matches_sum(n_out, n_in):
+    n = n_out * n_in
+    mesh = make_mesh({"dcn": n_out, "ici": n_in}, devices=jax.devices()[:n])
+    m, r = 2 * n_in, 128   # per-device partial rows, divisible by n_in
+    x = jax.random.normal(jax.random.key(7), (n * m, r), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P(("dcn", "ici"), None)))
+    out = hierarchical_all_reduce(xs, mesh, "ici", "dcn")
+    want = np.asarray(x).reshape(n, m, r).sum(0)
+    assert out.shape == (m, r)
+    np.testing.assert_allclose(np.asarray(jax.device_get(out)), want,
+                               rtol=1e-5, atol=1e-5)
+    # repeat invocation: ring drains must leave the semaphores balanced
+    out2 = hierarchical_all_reduce(xs, mesh, "ici", "dcn")
+    np.testing.assert_allclose(np.asarray(jax.device_get(out2)), want,
+                               rtol=1e-5, atol=1e-5)
 
 
 def test_allgather_layer_double_buffer():

@@ -3,6 +3,20 @@ communication half — allgather/reduce_scatter/allreduce/all-to-all files in
 ``python/triton_dist/kernels/nvidia/``)."""
 
 from .all_to_all import AllToAllConfig, ep_combine, ep_dispatch
-from .allgather import AllGatherMethod, all_gather, choose_method
-from .allreduce import AllReduceConfig, AllReduceMethod, all_reduce
-from .reduce_scatter import ReduceScatterConfig, reduce_scatter
+from .allgather import (
+    AllGatherMethod,
+    all_gather,
+    choose_method,
+    hierarchical_all_gather,
+)
+from .allreduce import (
+    AllReduceConfig,
+    AllReduceMethod,
+    all_reduce,
+    hierarchical_all_reduce,
+)
+from .reduce_scatter import (
+    ReduceScatterConfig,
+    hierarchical_reduce_scatter,
+    reduce_scatter,
+)

@@ -250,6 +250,78 @@ def _build_all_reduce(
     )
 
 
+@functools.lru_cache(maxsize=None)
+def _build_hierarchical(
+    mesh: Mesh,
+    inner_axis: str,
+    outer_axis: str,
+    m: int,
+    r_dim: int,
+    dtype: jnp.dtype,
+    cfg: AllReduceConfig,
+):
+    from .allgather import AllGatherMethod, _build_ag_call, resolve_method
+    from .reduce_scatter import ReduceScatterConfig, _build_rs_call
+
+    n_in = mesh.shape[inner_axis]
+    m_loc = m // n_in
+    rs_cfg = ReduceScatterConfig(bm=cfg.bm, bn=cfg.bn).clip(m_loc, r_dim)
+    rs_call = _build_rs_call(mesh, inner_axis, m_loc, r_dim, dtype, rs_cfg)
+    ag_method = resolve_method(
+        AllGatherMethod.AUTO, (m_loc, r_dim), dtype, n_in
+    )
+    ag_call = _build_ag_call(mesh, inner_axis, ag_method, (m_loc, r_dim),
+                             dtype)
+
+    def local(x_loc):
+        part = rs_call(x_loc)                 # ICI ring ReduceScatter
+        part = jax.lax.psum(part, outer_axis)  # DCN via XLA
+        return ag_call(part)                  # ICI ring AllGather
+
+    return compilation.jit_shard_map(
+        local, mesh,
+        in_specs=P((outer_axis, inner_axis), None),
+        out_specs=P(None, None),
+    )
+
+
+def hierarchical_all_reduce(
+    x: jax.Array,
+    mesh: Mesh,
+    inner_axis: str,
+    outer_axis: str,
+    *,
+    config: AllReduceConfig | None = None,
+) -> jax.Array:
+    """Two-level AllReduce over an (outer x inner) mesh: RS ring on ICI,
+    ``psum`` across slices on DCN, AG ring on ICI — the ring-tree shape of
+    the reference's hierarchical AR (its DoubleTree/2D variants,
+    ``allreduce.py:224``, and the 2D RS hierarchy it composes with).
+
+    ``x``: global ``(N*M, R)`` over both axes (outer-major), each device's
+    (M, R) shard its partial addend; returns (M, R) replicated.  Golden:
+    ``x.reshape(N, M, R).sum(0)``.
+    """
+    n_in = mesh.shape[inner_axis]
+    n_out = mesh.shape[outer_axis]
+    if n_out == 1:
+        return all_reduce(x, mesh, inner_axis, config=config)
+    n = n_in * n_out
+    m_stack = x.shape[0]
+    if m_stack % n:
+        raise ValueError(f"dim0 {m_stack} not divisible by N={n}")
+    m = m_stack // n
+    if m % n_in:
+        raise ValueError(
+            f"partial rows {m} not divisible by {inner_axis}={n_in}"
+        )
+    cfg = (config or AllReduceConfig()).clip(m // n_in, x.shape[1])
+    fn = _build_hierarchical(
+        mesh, inner_axis, outer_axis, m, x.shape[1], jnp.dtype(x.dtype), cfg
+    )
+    return fn(x)
+
+
 def all_reduce(
     x: jax.Array,
     mesh: Mesh,

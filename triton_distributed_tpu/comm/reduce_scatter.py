@@ -110,8 +110,7 @@ def _rs_ring_kernel(
     ring.rs_ack_drain(ack_sems, n)
 
 
-@functools.lru_cache(maxsize=None)
-def _build_reduce_scatter(
+def _build_rs_call(
     mesh: Mesh,
     axis: str,
     m_loc: int,
@@ -119,10 +118,13 @@ def _build_reduce_scatter(
     dtype: jnp.dtype,
     cfg: ReduceScatterConfig,
 ):
+    """The bare per-device ring kernel: (n*m_loc, r) stacked partials in,
+    (m_loc, r) reduced chunk out.  Must run inside a shard_map over
+    ``axis`` (used directly by the hierarchical paths here and in
+    ``allreduce``)."""
     team = Team.of(mesh, axis)
-    n = team.size
     kernel = functools.partial(_rs_ring_kernel, team, m_loc, r_dim, cfg)
-    call = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((m_loc, r_dim), dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
@@ -140,9 +142,95 @@ def _build_reduce_scatter(
         ),
         interpret=compilation.interpret_mode(),
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_reduce_scatter(
+    mesh: Mesh,
+    axis: str,
+    m_loc: int,
+    r_dim: int,
+    dtype: jnp.dtype,
+    cfg: ReduceScatterConfig,
+):
+    call = _build_rs_call(mesh, axis, m_loc, r_dim, dtype, cfg)
     return compilation.jit_shard_map(
         call, mesh, in_specs=P(axis, None), out_specs=P(axis, None)
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_hierarchical(
+    mesh: Mesh,
+    inner_axis: str,
+    outer_axis: str,
+    m_partial: int,
+    r_dim: int,
+    dtype: jnp.dtype,
+    cfg: ReduceScatterConfig,
+):
+    n_in = mesh.shape[inner_axis]
+    n_out = mesh.shape[outer_axis]
+    blk = m_partial // (n_in * n_out)
+    call = _build_rs_call(mesh, inner_axis, m_partial // n_in, r_dim, dtype,
+                          cfg)
+
+    def local(x_loc):
+        # Row blocks arrive in flat (outer-major global rank) order; the
+        # inner scatter picks by inner rank first, so transpose the block
+        # grid to inner-major — then chunk i / sub-block o is exactly
+        # global block o*n_in + i.
+        xp = (x_loc.reshape(n_out, n_in, blk, r_dim)
+              .transpose(1, 0, 2, 3).reshape(m_partial, r_dim))
+        part = call(xp)                               # ICI Pallas ring
+        return jax.lax.psum_scatter(                  # DCN via XLA
+            part, outer_axis, scatter_dimension=0, tiled=True
+        )
+
+    return compilation.jit_shard_map(
+        local, mesh,
+        in_specs=P((outer_axis, inner_axis), None),
+        out_specs=P((outer_axis, inner_axis), None),
+    )
+
+
+def hierarchical_reduce_scatter(
+    x: jax.Array,
+    mesh: Mesh,
+    inner_axis: str,
+    outer_axis: str,
+    *,
+    config: ReduceScatterConfig | None = None,
+) -> jax.Array:
+    """Two-level ReduceScatter over an (outer x inner) mesh — the
+    reference's 2D intra+inter hierarchy (``reduce_scatter.py:688-882``,
+    ``ReduceScatter2DContext:46``: intra-node ring reduce + inter-node
+    p2p stage).
+
+    TPU mapping: the ``inner_axis`` (ICI) level is this module's ring
+    kernel; the ``outer_axis`` (DCN — across slices) level rides XLA's
+    ``psum_scatter``, since remote DMA is ICI-only (SURVEY.md section 7).
+    Semantics match a flat :func:`reduce_scatter` over the combined
+    outer-major axis: golden ``x.reshape(N, M, R).sum(0)`` scattered in
+    global rank order.
+    """
+    n_in = mesh.shape[inner_axis]
+    n_out = mesh.shape[outer_axis]
+    if n_out == 1:
+        return reduce_scatter(x, mesh, inner_axis, config=config)
+    n = n_in * n_out
+    m_stack = x.shape[0]
+    if m_stack % n:
+        raise ValueError(f"dim0 {m_stack} not divisible by N={n}")
+    m_partial = m_stack // n
+    if m_partial % n:
+        raise ValueError(f"partial rows {m_partial} not divisible by N={n}")
+    cfg = (config or ReduceScatterConfig()).clip(m_partial // n_in, x.shape[1])
+    fn = _build_hierarchical(
+        mesh, inner_axis, outer_axis, m_partial, x.shape[1],
+        jnp.dtype(x.dtype), cfg
+    )
+    return fn(x)
 
 
 def reduce_scatter(

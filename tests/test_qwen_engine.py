@@ -144,3 +144,18 @@ def test_sample_token_top_p():
     # top_p tight enough to keep only the argmax
     t = sample_token(logits, jax.random.key(1), temperature=1.0, top_p=0.5)
     assert int(t[0]) == 2
+
+
+def test_engine_serve_reports_throughput():
+    """serve = warmup + timed generate + stats (reference Engine.serve);
+    tokens must equal a plain greedy generate."""
+    n = 2
+    mesh = _mesh(n)
+    eng = Engine.build(CFG, mesh, key=jax.random.key(6), batch=1)
+    ids = jax.random.randint(jax.random.key(7), (1, 8), 0, CFG.vocab)
+    want = np.asarray(jax.device_get(eng.generate(ids, gen_len=4)))
+    tokens, stats = eng.serve(ids, gen_len=4)
+    np.testing.assert_array_equal(np.asarray(jax.device_get(tokens)), want)
+    assert stats["prefill_ms"] > 0
+    assert stats["decode_ms_per_token"] > 0
+    assert stats["decode_tokens_per_s"] > 0

@@ -108,16 +108,54 @@ class Engine:
                 f"prompt {input_ids.shape[1]} + gen_len {gen_len} exceeds "
                 f"max_length={max_len}"
             )
-        key = key if key is not None else jax.random.key(0)
         logits = self.prefill(input_ids)
+        return self.generate_from_logits(logits, gen_len, key)
+
+    def serve(self, input_ids: jax.Array, gen_len: int,
+              key: jax.Array | None = None):
+        """Timed generate with a throughput report (reference
+        ``Engine.serve:113``: prefill then graph-replayed decode, printing
+        tokens/s).  Returns ``(tokens, stats)`` where stats has
+        ``prefill_ms``, ``decode_ms_per_token``, ``decode_tokens_per_s``
+        (wall-clock, compile excluded by a 1-token warmup)."""
+        import time
+
+        b, prompt_len = input_ids.shape
+        # warmup/compile both steps outside the timed region (the
+        # reference's graph capture happens before its timed replay too);
+        # run through the stateful path — the donated cache buffers are
+        # consumed and replaced, and the timed prefill resets the length
+        jax.block_until_ready(self.prefill(input_ids))
+        jax.block_until_ready(self.decode_step(jnp.zeros((b,), jnp.int32)))
+
+        t0 = time.perf_counter()
+        logits = self.prefill(input_ids)
+        jax.block_until_ready(logits)
+        t1 = time.perf_counter()
+        tokens = self.generate_from_logits(logits, gen_len, key)
+        jax.block_until_ready(tokens)
+        t2 = time.perf_counter()
+        decode_steps = max(gen_len - 1, 1)
+        stats = {
+            "prefill_ms": (t1 - t0) * 1e3,
+            "decode_ms_per_token": (t2 - t1) * 1e3 / decode_steps,
+            "decode_tokens_per_s": b * decode_steps / max(t2 - t1, 1e-9),
+        }
+        return tokens, stats
+
+    def generate_from_logits(self, logits: jax.Array, gen_len: int,
+                             key: jax.Array | None = None) -> jax.Array:
+        """Decode loop given the prefill's last-position logits (the decode
+        half of :meth:`generate`; cache state must match)."""
+        key = key if key is not None else jax.random.key(0)
         outs = []
         tok = sample_token(logits, key, temperature=self.temperature,
                            top_p=self.top_p)
         outs.append(tok)
         for i in range(gen_len - 1):
-            logits = self.decode_step(tok)
+            step_logits = self.decode_step(tok)
             key = jax.random.fold_in(key, i)
-            tok = sample_token(logits, key, temperature=self.temperature,
+            tok = sample_token(step_logits, key, temperature=self.temperature,
                                top_p=self.top_p)
             outs.append(tok)
         return jnp.stack(outs, axis=1)

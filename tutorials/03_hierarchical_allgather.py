@@ -15,6 +15,10 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_distributed_tpu.comm.allgather import hierarchical_all_gather
+from triton_distributed_tpu.comm.allreduce import hierarchical_all_reduce
+from triton_distributed_tpu.comm.reduce_scatter import (
+    hierarchical_reduce_scatter,
+)
 
 
 def main():
@@ -25,6 +29,18 @@ def main():
     out = hierarchical_all_gather(xs, mesh, "ici", "dcn")
     np.testing.assert_allclose(np.asarray(jax.device_get(out)), np.asarray(x))
     print("hierarchical (2x4) AG OK")
+
+    # the whole two-level family shares the shape convention: inner level
+    # on the ICI Pallas rings, outer level on XLA's DCN collectives
+    want = np.asarray(x).reshape(8, 16, 256).sum(0)
+    rs = hierarchical_reduce_scatter(xs, mesh, "ici", "dcn")
+    np.testing.assert_allclose(np.asarray(jax.device_get(rs)), want,
+                               rtol=1e-5, atol=1e-5)
+    print("hierarchical (2x4) RS OK")
+    ar = hierarchical_all_reduce(xs, mesh, "ici", "dcn")
+    np.testing.assert_allclose(np.asarray(jax.device_get(ar)), want,
+                               rtol=1e-5, atol=1e-5)
+    print("hierarchical (2x4) AR OK")
 
 
 if __name__ == "__main__":

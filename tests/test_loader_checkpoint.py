@@ -108,6 +108,9 @@ def _mixed_arrays(rng):
         "c f16": rng.standard_normal((8,)).astype(np.float16),
         "d\"quoted\\name": np.arange(12, dtype=np.int32).reshape(3, 4),
         "e_unicode_é中": np.asarray([True, False, True]),
+        # non-BMP name: the writer must emit raw UTF-8 (not surrogate-pair
+        # escapes, which the native reader rejects by design)
+        "e_nonbmp_𝛼": np.asarray([1.0, 2.0], np.float32),
         "f_scalar": np.asarray(2.5, np.float32),
         "g_empty": np.zeros((0, 4), np.int64),
     }

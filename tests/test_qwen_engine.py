@@ -159,3 +159,12 @@ def test_engine_serve_reports_throughput():
     assert stats["prefill_ms"] > 0
     assert stats["decode_ms_per_token"] > 0
     assert stats["decode_tokens_per_s"] > 0
+
+
+def test_engine_serve_rejects_overlength():
+    n = 2
+    mesh = _mesh(n)
+    eng = Engine.build(CFG, mesh, key=jax.random.key(8), batch=1)
+    ids = jax.random.randint(jax.random.key(9), (1, 8), 0, CFG.vocab)
+    with pytest.raises(ValueError, match="max_length"):
+        eng.serve(ids, gen_len=CFG.max_length)

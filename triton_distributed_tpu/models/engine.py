@@ -121,6 +121,13 @@ class Engine:
         import time
 
         b, prompt_len = input_ids.shape
+        if prompt_len + gen_len > self.model.config.max_length:
+            # same refusal as generate(): out-of-range cache writes clamp
+            # and silently corrupt rather than raise
+            raise ValueError(
+                f"prompt {prompt_len} + gen_len {gen_len} exceeds "
+                f"max_length={self.model.config.max_length}"
+            )
         # warmup/compile both steps outside the timed region (the
         # reference's graph capture happens before its timed replay too);
         # run through the stateful path — the donated cache buffers are

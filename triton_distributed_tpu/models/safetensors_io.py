@@ -253,27 +253,28 @@ def save_safetensors(arrays: Mapping[str, np.ndarray], path: str,
     header: dict = {}
     if metadata:
         header["__metadata__"] = metadata
-    blobs = []
     off = 0
-    for name, arr in arrays.items():
-        # NOT ascontiguousarray: it silently promotes 0-d to 1-d, and
-        # tobytes() below already emits C order for any layout
-        arr = np.asarray(arr)
-        nbytes = arr.nbytes
+    items = [(name, np.asarray(arr)) for name, arr in arrays.items()]
+    for name, arr in items:
+        # np.asarray, NOT ascontiguousarray: the latter silently promotes
+        # 0-d to 1-d, and tobytes() below emits C order for any layout
         header[name] = {
             "dtype": _to_tag(arr.dtype),
             "shape": list(arr.shape),
-            "data_offsets": [off, off + nbytes],
+            "data_offsets": [off, off + arr.nbytes],
         }
-        blobs.append(arr.tobytes())
-        off += nbytes
-    hjson = json.dumps(header, separators=(",", ":")).encode()
+        off += arr.nbytes
+    # ensure_ascii=False: escaped non-BMP names would become surrogate
+    # pairs, which the native reader rejects; raw UTF-8 parses everywhere
+    hjson = json.dumps(header, separators=(",", ":"),
+                       ensure_ascii=False).encode()
     pad = (8 - (len(hjson) % 8)) % 8
     hjson += b" " * pad
     tmp = path + f".tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(struct.pack("<Q", len(hjson)))
         f.write(hjson)
-        for b in blobs:
-            f.write(b)
+        for _, arr in items:
+            # stream per tensor: peak RSS stays one tensor, not the model
+            f.write(arr.tobytes())
     os.replace(tmp, path)

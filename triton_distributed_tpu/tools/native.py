@@ -43,7 +43,16 @@ def load_native(src_name: str, *, ldflags: tuple[str, ...] = ()) -> (
     if key in _loaded:
         return _loaded[key]
     src = os.path.join(_CSRC_DIR, src_name)
-    so = os.path.join(cache_dir(), os.path.splitext(src_name)[0] + ".so")
+    # flags participate in the artifact name: a flags change must rebuild,
+    # not silently reuse a stale .so whose mtime looks current
+    stem = os.path.splitext(src_name)[0]
+    if ldflags:
+        import hashlib
+
+        stem += "-" + hashlib.sha1(
+            " ".join(ldflags).encode()
+        ).hexdigest()[:8]
+    so = os.path.join(cache_dir(), stem + ".so")
     try:
         if not os.path.exists(so) or (
             os.path.exists(src)

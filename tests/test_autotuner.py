@@ -118,3 +118,23 @@ def test_tuned_collective_ops_correct(tmp_path, monkeypatch):
     out2 = tuned_gemm_rs(a_rs, b_rs, mesh, TP_AXIS)
     assert np.allclose(np.asarray(jax.device_get(out2)), want, atol=1e-3,
                        rtol=1e-3)
+
+
+def test_sol_fraction_reported(tmp_path):
+    """A perf_model estimate turns the winner's time into a SOL fraction
+    on fresh tunes (reference: its perf models feed the autotuner)."""
+    tuner = Autotuner(path=str(tmp_path / "sol.json"))
+
+    def make_thunk(c):
+        def thunk():
+            time.sleep(0.001)
+            return jnp.zeros(())
+        return thunk
+
+    res = tuner.tune("toy_sol", ("k",), [1, 2], make_thunk, iters=2,
+                     sol_ms=0.5)
+    assert res.sol_fraction is not None and 0 < res.sol_fraction <= 1.5
+    # cached result carries no fresh measurement -> no fraction
+    res2 = tuner.tune("toy_sol", ("k",), [1, 2], make_thunk, iters=2,
+                      sol_ms=0.5)
+    assert res2.from_cache and res2.sol_fraction is None

@@ -45,6 +45,9 @@ class TuneResult:
     config: Any
     time_ms: float
     from_cache: bool
+    # speed-of-light fraction of the winner (sol_ms / time), when the
+    # caller supplied a model estimate and a fresh measurement ran
+    sol_fraction: float | None = None
 
 
 class Autotuner:
@@ -110,13 +113,17 @@ class Autotuner:
         *,
         iters: int = 8,
         verbose: bool = False,
+        sol_ms: float | None = None,
     ) -> TuneResult:
         """Pick the fastest candidate for ``key``.
 
         ``make_thunk(candidate)`` returns a zero-arg thunk running the op
         with that candidate config (closing over the caller's REAL
         arguments — that is the "contextual" part).  Invalid candidates may
-        raise during their first call and are skipped.
+        raise during their first call and are skipped.  ``sol_ms`` (a
+        ``tools.perf_model`` estimate) turns the winner's time into a
+        fraction-of-speed-of-light sanity number on the result (reference:
+        the SOL thresholds its perf models feed the autotuner/tests).
         """
         ck = json.dumps([name, *map(str, key)])
         multi = jax.process_count() > 1
@@ -176,7 +183,16 @@ class Autotuner:
             self._times[ck] = times[best]
             self._load_disk()[ck] = best
             self._save_disk()
-        return TuneResult(candidates[best], times[best], False)
+        frac = None
+        if sol_ms and times[best] > 0 and times[best] == times[best]:
+            frac = sol_ms / times[best]
+            if verbose:
+                dist_print(
+                    f"autotune[{name}] winner {candidates[best]}: "
+                    f"{times[best]:.3f} ms = {100 * frac:.0f}% of SOL",
+                    rank=0,
+                )
+        return TuneResult(candidates[best], times[best], False, frac)
 
 
 _GLOBAL = Autotuner()
@@ -214,9 +230,12 @@ def tuned_matmul(a: jax.Array, b: jax.Array, **kw):
     for d in (m, n, k):
         clip_block(1024, d)
     cands = matmul_tile_candidates(m, n, k)
+    from ..tools import perf_model
+
     res = autotune(
         "matmul", (m, n, k, str(a.dtype), platform.device_kind()), cands,
         lambda c: (lambda: matmul(a, b, bm=c[0], bn=c[1], bk=c[2], **kw)),
+        sol_ms=perf_model.gemm_sol_ms(m, n, k, a.dtype),
     )
     bm, bn, bk = res.config
     return matmul(a, b, bm=bm, bn=bn, bk=bk, **kw)
@@ -244,8 +263,30 @@ def _tuned_collective(name, op, config_cls, cand_dims, a, b, mesh, axis, kw):
         (m, k, n, n_ranks, str(a.dtype), platform.device_kind(), kw_key),
         cands,
         lambda c: (lambda: op(a, b, mesh, axis, config=c, **kw)),
+        sol_ms=_fused_sol_ms(name, m, n, k, n_ranks, a.dtype),
     )
     return op(a, b, mesh, axis, config=res.config, **kw)
+
+
+def _fused_sol_ms(name: str, m: int, n: int, k: int, r: int,
+                  dtype) -> float | None:
+    """Overlap-aware speed of light for a fused collective GEMM:
+    max(per-rank GEMM roofline, ring wire time) — a perfectly fused op
+    hides the smaller of the two entirely (``tools.perf_model``)."""
+    import jax.numpy as jnp
+
+    from ..tools import perf_model
+
+    b = int(jnp.dtype(dtype).itemsize)
+    if name == "ag_gemm":
+        t_gemm = perf_model.gemm_sol_ms(m, n // r, k, dtype)
+        t_comm = perf_model.allgather_sol_ms((m // r) * k * b, r)
+    elif name == "gemm_rs":
+        t_gemm = perf_model.gemm_sol_ms(m, n, k // r, dtype)
+        t_comm = perf_model.reduce_scatter_sol_ms((m // r) * n * b, r)
+    else:
+        return None
+    return max(t_gemm, t_comm)
 
 
 def tuned_ag_gemm(a: jax.Array, b: jax.Array, mesh, axis: str = "tp", **kw):

@@ -89,7 +89,7 @@ def sp_flash_decode(
     mesh: Mesh,
     axis: str = SP_AXIS,
     *,
-    n_split: int = 1,
+    n_split: int | None = None,
     sm_scale: float | None = None,
     soft_cap: float = 0.0,
 ) -> jax.Array:
@@ -114,6 +114,10 @@ def sp_flash_decode(
     if s_tot % n:
         raise ValueError(f"cache seq {s_tot} not divisible by {axis}={n}")
     s_loc = s_tot // n
+    if n_split is None:
+        from .attention import auto_n_split
+
+        n_split = auto_n_split(s_loc)
     if n_split > 1 and s_loc % n_split:
         raise ValueError(
             f"local cache {s_loc} not divisible by n_split={n_split}"

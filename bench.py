@@ -247,6 +247,41 @@ def bench_group_gemm():
     }
 
 
+def bench_decode():
+    """Split-KV decode attention vs XLA's unfused GQA decode (B=8 tokens
+    against an 8k cache, 32/8 heads, d=128 — a serving decode step)."""
+    from triton_distributed_tpu.ops.attention import decode_attention
+
+    b, h, hk, s, d = 8, 32, 8, 8192, 128
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (b, h, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, hk, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, hk, s, d), jnp.bfloat16)
+
+    @jax.jit
+    def xla_decode(q, k, v):
+        qh = q.reshape(b, hk, h // hk, d).astype(jnp.float32)
+        sc = jnp.einsum("bkgd,bksd->bkgs", qh, k.astype(jnp.float32))
+        p = jax.nn.softmax(sc * (d ** -0.5), -1)
+        out = jnp.einsum("bkgs,bksd->bkgd", p, v.astype(jnp.float32))
+        return out.reshape(b, h, d).astype(q.dtype)
+
+    ours = jax.jit(lambda q, k, v: decode_attention(q, k, v, s))
+    times = _bench_interleaved({
+        "ours": lambda: ours(q, k, v),
+        "xla": lambda: xla_decode(q, k, v),
+    }, iters=48)
+    # decode is KV-bandwidth bound; report achieved GB/s of cache read
+    nbytes = 2 * b * hk * s * d * 2
+    gbps = nbytes / _median(times["ours"]) / 1e9
+    return {
+        "metric": f"decode_attn_b{b}_h{h}_hk{hk}_s{s}_d{d}",
+        "value": round(gbps, 1),
+        "unit": "GB/s",
+        "vs_baseline": round(_median_ratio(times, "xla", "ours"), 4),
+    }
+
+
 def main():
     import sys
 
@@ -259,13 +294,15 @@ def main():
         result = bench_single_chip()
     elif mode == "moe":
         result = bench_group_gemm()
+    elif mode == "decode":
+        result = bench_decode()
     elif mode == "auto" and jax.device_count() > 1:
         result = bench_multi_chip()
     elif mode == "auto":
         result = bench_single_chip()
     else:
         raise SystemExit(
-            f"unknown bench mode {mode!r} (auto|gemm|attn|mlp|moe)"
+            f"unknown bench mode {mode!r} (auto|gemm|attn|mlp|moe|decode)"
         )
     print(json.dumps(result))
 

@@ -1,0 +1,89 @@
+"""End-to-end parity vs Hugging Face eager (the reference's
+``test_tp_e2e.py --check`` mode, which compares its distributed forward
+against the HF implementation on the same weights).
+
+Builds a tiny random-weight HF Qwen3, exports its state dict through this
+framework's loader, and compares prefill logits and a greedy decode step
+across the TP mesh — validating the RoPE/QK-norm/SwiGLU/GQA/cache
+conventions against the canonical implementation, not just against our
+own golden."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from triton_distributed_tpu.core.mesh import TP_AXIS, make_mesh
+from triton_distributed_tpu.models import ModelConfig, Qwen3, init_cache
+from triton_distributed_tpu.models.loader import load_qwen_state_dict
+
+CFG = ModelConfig(
+    num_layers=2, hidden=64, intermediate=128, num_heads=4, num_kv_heads=2,
+    head_dim=32, vocab=128, max_length=64, rope_theta=1e6, rms_eps=1e-6,
+    dtype=jnp.float32,
+)
+
+
+def _hf_model():
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=CFG.vocab,
+        hidden_size=CFG.hidden,
+        intermediate_size=CFG.intermediate,
+        num_hidden_layers=CFG.num_layers,
+        num_attention_heads=CFG.num_heads,
+        num_key_value_heads=CFG.num_kv_heads,
+        head_dim=CFG.head_dim,
+        max_position_embeddings=CFG.max_length,
+        rope_theta=CFG.rope_theta,
+        rms_norm_eps=CFG.rms_eps,
+        tie_word_embeddings=False,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen3ForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+@pytest.mark.parametrize("tp", [1, 2])
+def test_prefill_logits_match_hf(tp):
+    hf = _hf_model()
+    ids_np = np.array([[3, 17, 42, 7, 99, 5, 23, 81]], np.int64)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids_np)).logits.float().numpy()
+
+    mesh = make_mesh({TP_AXIS: tp}, devices=jax.devices()[:tp])
+    model = Qwen3(CFG, mesh)
+    params = load_qwen_state_dict(model, hf.state_dict())
+    cache = init_cache(mesh, CFG.num_layers, 1, CFG.num_kv_heads,
+                       CFG.max_length, CFG.head_dim, CFG.dtype)
+    got, _ = model.prefill(params, cache, jnp.asarray(ids_np, jnp.int32))
+    got = np.asarray(jax.device_get(got), np.float32)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
+
+
+def test_greedy_decode_matches_hf():
+    hf = _hf_model()
+    ids_np = np.array([[3, 17, 42, 7]], np.int64)
+    gen_len = 6
+    with torch.no_grad():
+        want = hf.generate(
+            torch.from_numpy(ids_np), max_new_tokens=gen_len, do_sample=False,
+            pad_token_id=0,
+        ).numpy()[:, ids_np.shape[1]:]
+
+    mesh = make_mesh({TP_AXIS: 2}, devices=jax.devices()[:2])
+    model = Qwen3(CFG, mesh)
+    params = load_qwen_state_dict(model, hf.state_dict())
+    from triton_distributed_tpu.models import Engine
+
+    eng = Engine(model, params, batch=1)
+    got = np.asarray(jax.device_get(
+        eng.generate(jnp.asarray(ids_np, jnp.int32), gen_len)
+    ))
+    np.testing.assert_array_equal(got, want)

@@ -31,9 +31,11 @@ DP_AXIS = "dp"
 PP_AXIS = "pp"
 
 # Intra-slice axes ride ICI; inter-slice axes ride DCN. Mirrors the
-# reference's CommScope{GPU, INTRA_NODE, INTER_NODE} distinction.
+# reference's CommScope{GPU, INTRA_NODE, INTER_NODE} distinction.  An axis
+# literally named "dcn" (or "dcn_*" — the convention the hierarchical
+# tutorials/tests use for the outer level) is always inter-slice.
 ICI_AXES = (TP_AXIS, EP_AXIS, SP_AXIS)
-DCN_AXES = (DP_AXIS, PP_AXIS)
+DCN_AXES = (DP_AXIS, PP_AXIS, "dcn")
 
 
 def make_mesh(
@@ -117,4 +119,4 @@ def is_dcn_axis(axis: str) -> bool:
     XLA collectives over DCN) — the TPU analogue of the reference's 2D/3D
     intra+inter-node kernel hierarchies (``allgather.py:442-601``).
     """
-    return axis in DCN_AXES
+    return axis in DCN_AXES or axis.startswith("dcn_")

@@ -20,6 +20,7 @@ Conventions:
 """
 
 from ..core.compilation import jit_shard_map
+from .pipeline import pipeline_forward
 from ..core.mesh import (
     DP_AXIS,
     EP_AXIS,

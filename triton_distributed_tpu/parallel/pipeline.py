@@ -1,0 +1,97 @@
+"""Pipeline parallelism: a microbatched GPipe-style forward over a ``pp``
+mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md section 2.5:
+"DP / PP / Ulysses — not present"); this module exists because a TPU
+framework's mesh story is incomplete without the ``pp`` axis the rest of
+the stack already names (``parallel.PP_AXIS``, the DCN classification,
+the driver's multichip dryrun).  The design is the standard SPMD
+pipeline: every stage runs the SAME program, activations hop stages via
+``lax.ppermute`` inside a ``lax.scan`` over ticks, and bubble ticks
+compute on don't-care values that the output selection never reads —
+compiler-friendly control flow, no host round trips.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.compilation import jit_shard_map
+from ..core.mesh import PP_AXIS
+
+
+def pipeline_forward(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = PP_AXIS,
+    *,
+    num_microbatches: int | None = None,
+) -> jax.Array:
+    """Run ``num_stages`` copies of ``stage_fn`` as a microbatched pipeline.
+
+    ``stage_params``: a pytree whose leaves have a leading stage axis of
+    size ``n = mesh.shape[axis]``, sharded ``P(axis, ...)`` — device s
+    holds stage s's parameters.  ``stage_fn(params_s, x_mb)`` must be
+    shape-preserving on the microbatch (the usual transformer-block
+    contract).  ``x``: (B, ...) full batch, replicated; ``B`` must divide
+    by ``num_microbatches`` (default: the stage count).  Returns the (B,
+    ...) result of applying stages 0..n-1 in order, replicated.
+
+    Schedule: GPipe forward — microbatch m enters stage s at tick m + s;
+    total ticks n - 1 + M.  Bubble ticks process zeros whose outputs are
+    never selected.
+    """
+    n = mesh.shape[axis]
+    if num_microbatches is None:
+        num_microbatches = n
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by num_microbatches={num_microbatches}"
+        )
+    if n == 1:
+        return stage_fn(jax.tree.map(lambda p: p[0], stage_params), x)
+    mb = b // num_microbatches
+    ticks = n - 1 + num_microbatches
+    fwd = [(i, i + 1) for i in range(n - 1)]
+
+    def local(params_stacked, x_rep):
+        params = jax.tree.map(lambda p: p[0], params_stacked)  # this stage
+        idx = jax.lax.axis_index(axis)
+        micro = x_rep.reshape(num_microbatches, mb, *x_rep.shape[1:])
+
+        def tick(buf, t):
+            # stage 0 injects microbatch t; later stages consume the hop
+            inject = micro[jnp.clip(t, 0, num_microbatches - 1)]
+            cur = jnp.where(idx == 0, inject, buf)
+            y = stage_fn(params, cur)
+            # hop to the next stage (stage 0 receives zeros: overwritten
+            # by the injection next tick)
+            return jax.lax.ppermute(y, axis, fwd), y
+
+        _, ys = jax.lax.scan(
+            tick, jnp.zeros((mb, *x_rep.shape[1:]), x_rep.dtype),
+            jnp.arange(ticks),
+        )
+        # microbatch m leaves the last stage at tick (n - 1) + m
+        out = ys[n - 1:].reshape(num_microbatches * mb, *x_rep.shape[1:])
+        # only the last stage's selection is the answer; broadcast it
+        return jax.lax.psum(
+            jnp.where(idx == n - 1, out, jnp.zeros_like(out)), axis
+        )
+
+    ndim_p = P(axis)
+    return jit_shard_map(
+        local, mesh,
+        in_specs=(
+            jax.tree.map(lambda _: ndim_p, stage_params),
+            P(*([None] * x.ndim)),
+        ),
+        out_specs=P(*([None] * x.ndim)),
+    )(stage_params, x)

@@ -96,18 +96,21 @@ class Engine:
         logits, self.cache = self._decode(self.params, self.cache, tokens)
         return logits
 
+    def _check_length(self, prompt_len: int, gen_len: int) -> None:
+        # dynamic_update_slice CLAMPS out-of-range writes: past max_length
+        # the cache would silently corrupt, so refuse up front
+        max_len = self.model.config.max_length
+        if prompt_len + gen_len > max_len:
+            raise ValueError(
+                f"prompt {prompt_len} + gen_len {gen_len} exceeds "
+                f"max_length={max_len}"
+            )
+
     def generate(self, input_ids: jax.Array, gen_len: int,
                  key: jax.Array | None = None) -> jax.Array:
         """Prefill + ``gen_len - 1`` decode steps (reference
         ``Engine.serve``).  Returns (B, gen_len) generated token ids."""
-        max_len = self.model.config.max_length
-        if input_ids.shape[1] + gen_len > max_len:
-            # dynamic_update_slice CLAMPS out-of-range writes: past
-            # max_length the cache would silently corrupt, so refuse
-            raise ValueError(
-                f"prompt {input_ids.shape[1]} + gen_len {gen_len} exceeds "
-                f"max_length={max_len}"
-            )
+        self._check_length(input_ids.shape[1], gen_len)
         logits = self.prefill(input_ids)
         return self.generate_from_logits(logits, gen_len, key)
 
@@ -121,13 +124,7 @@ class Engine:
         import time
 
         b, prompt_len = input_ids.shape
-        if prompt_len + gen_len > self.model.config.max_length:
-            # same refusal as generate(): out-of-range cache writes clamp
-            # and silently corrupt rather than raise
-            raise ValueError(
-                f"prompt {prompt_len} + gen_len {gen_len} exceeds "
-                f"max_length={self.model.config.max_length}"
-            )
+        self._check_length(prompt_len, gen_len)
         # warmup/compile both steps outside the timed region (the
         # reference's graph capture happens before its timed replay too);
         # run through the stateful path — the donated cache buffers are

@@ -45,11 +45,21 @@ class MoEParams:
 
 @dataclasses.dataclass(frozen=True)
 class MoEMLP:
+    """``swiglu=False``: experts are single up-projections with ``act``
+    applied to the output (the reference's group-GEMM data flow).
+    ``swiglu=True``: experts are gated MLPs — ``w_up`` carries fused
+    [gate | up] columns, (E, K, 2F); under TP the 2F columns are
+    rank-blocked ``[gate_r | up_r]`` per rank (same layout as
+    ``TPMLP.gate_up``) so the gating stays rank-local.  Qwen3-MoE experts
+    are SwiGLU."""
+
     mesh: Mesh
     num_experts: int
     top_k: int = 2
     axis: str = TP_AXIS
     act: str = "silu"
+    swiglu: bool = False
+    renormalize: bool = True
 
     @property
     def n(self) -> int:
@@ -57,6 +67,15 @@ class MoEMLP:
 
     def _act(self):
         return dict(silu=jax.nn.silu, gelu=jax.nn.gelu, relu=jax.nn.relu)[self.act]
+
+    def _combine(self, h: jax.Array) -> jax.Array:
+        """Post-up-projection nonlinearity on a LOCAL column block: plain
+        activation, or the gated split when ``swiglu`` (the local block is
+        [gate_r | up_r], so the split is down the middle)."""
+        if not self.swiglu:
+            return self._act()(h)
+        g, u = jnp.split(h, 2, axis=-1)
+        return self._act()(g) * u
 
     # -- parameter construction ------------------------------------------
 
@@ -89,13 +108,30 @@ class MoEMLP:
             ),
         )
 
+    def fuse_expert_gate_up(self, gate: jax.Array, up: jax.Array,
+                            *, ep: bool = False) -> jax.Array:
+        """Fuse per-expert (E, K, F) gate/up into the (E, K, 2F) layout
+        ``swiglu`` mode consumes: rank-blocked ``[gate_r | up_r]`` under TP
+        (F columns sharded), plain ``[gate | up]`` under EP (experts
+        sharded, F local)."""
+        from .tp_mlp import fuse_column_shards
+
+        n = 1 if ep else self.n
+        return jax.vmap(lambda g, u: fuse_column_shards([g, u], n))(gate, up)
+
     def init(self, key: jax.Array, hidden: int, ffn: int, *,
              ep: bool = False, dtype=jnp.float32,
              scale: float = 0.02) -> MoEParams:
         kr, ku, kd = jax.random.split(key, 3)
         e = self.num_experts
         router = jax.random.normal(kr, (hidden, e), dtype) * scale
-        w_up = jax.random.normal(ku, (e, hidden, ffn), dtype) * scale
+        if self.swiglu:
+            kg = jax.random.fold_in(ku, 1)
+            gate = jax.random.normal(kg, (e, hidden, ffn), dtype) * scale
+            up = jax.random.normal(ku, (e, hidden, ffn), dtype) * scale
+            w_up = self.fuse_expert_gate_up(gate, up, ep=ep)
+        else:
+            w_up = jax.random.normal(ku, (e, hidden, ffn), dtype) * scale
         w_dn = jax.random.normal(kd, (e, ffn, hidden), dtype) * scale
         return (self.shard_params_ep if ep else self.shard_params_tp)(
             router, w_up, w_dn
@@ -110,7 +146,7 @@ class MoEMLP:
 
         def local(x_loc, router_rep):
             logits = x_loc @ router_rep
-            eid, wts = topk_route(logits, k)
+            eid, wts = topk_route(logits, k, renormalize=self.renormalize)
             xr, eflat, wflat = flatten_topk(x_loc, eid, wts)
             xs, splits, unsort = sort_by_expert(xr, eflat, e)
             return xs, splits, wflat, unsort
@@ -136,7 +172,12 @@ class MoEMLP:
         h, total_splits, perm = ag_group_gemm(
             x_sorted, params.w_up, splits, self.mesh, self.axis
         )
-        h = self._act()(h)
+        # the nonlinearity reads only this rank's column block (under
+        # swiglu the block is [gate_r | up_r]) — keep it rank-local
+        h = jax.shard_map(
+            self._combine, mesh=self.mesh,
+            in_specs=P(None, self.axis), out_specs=P(None, self.axis),
+        )(h)
         t_per_rank = x_sorted.shape[0] // n
         presort = global_presort_index(
             perm, unsort.reshape(n, t_per_rank)
@@ -145,6 +186,33 @@ class MoEMLP:
             h, params.w_dn, total_splits, presort, wflat, self.top_k,
             self.mesh, self.axis,
         )
+
+    def forward_replicated(self, params: MoEParams, x: jax.Array) -> jax.Array:
+        """Small-M decode path: replicated tokens against the TP (F-sharded)
+        expert layout — local routed ragged GEMMs, then one psum; the MoE
+        analogue of the dense layer's AR decode path (``Qwen3._mlp_decode``).
+
+        ``x``: (B, K) replicated.  Returns (B, K) replicated.
+        """
+        e, k = self.num_experts, self.top_k
+
+        def local(x_rep, router_rep, w_up_loc, w_dn_loc):
+            eid, wts = topk_route(x_rep @ router_rep, k,
+                                  renormalize=self.renormalize)
+            xr, eflat, wflat = flatten_topk(x_rep, eid, wts)
+            xs, splits, unsort = sort_by_expert(xr, eflat, e)
+            h = self._combine(jax.lax.ragged_dot(xs, w_up_loc, splits))
+            y = jax.lax.ragged_dot(h, w_dn_loc, splits)
+            y = unsort_combine(y, unsort, wflat, k)
+            return jax.lax.psum(y, self.axis).astype(x_rep.dtype)
+
+        return jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(None, None), P(None, None),
+                      P(None, None, self.axis), P(None, self.axis, None)),
+            out_specs=P(None, None),
+            check_vma=False,
+        )(x, params.router, params.w_up, params.w_dn)
 
     # -- EP forward -------------------------------------------------------
 
@@ -165,7 +233,7 @@ class MoEMLP:
             x_sorted, splits, self.mesh, self.axis, config=a2a_config
         )
         z = recv.shape[1]
-        act = self._act()
+        combine = self._combine
 
         def local_experts(zones, rsplits, w_up_loc, w_dn_loc):
             # zones: (n, Z, K); rsplits: (n, epr).  Compact zone rows into
@@ -183,7 +251,7 @@ class MoEMLP:
             order = jnp.argsort(eid.reshape(n * z), stable=True)
             compact = jnp.take(flat, order, axis=0)
             gsz = rsplits.sum(axis=0).astype(jnp.int32)              # (epr,)
-            h_loc = act(jax.lax.ragged_dot(compact, w_up_loc, gsz))
+            h_loc = combine(jax.lax.ragged_dot(compact, w_up_loc, gsz))
             y = jax.lax.ragged_dot(h_loc, w_dn_loc, gsz)
             # rows past sum(gsz) belong to no expert; zero them before the
             # scatter so padding rows stay inert through the combine

@@ -25,3 +25,13 @@ class ModelConfig:
     rms_eps: float = 1e-6
     qk_norm: bool = True           # Qwen3 normalizes Q/K per head
     dtype: jnp.dtype = jnp.bfloat16
+
+    # MoE (Qwen3-MoE family): num_experts == 0 means dense layers
+    num_experts: int = 0           # 128 (Qwen3-30B-A3B)
+    top_k: int = 8
+    moe_intermediate: int = 0      # 768; per-expert SwiGLU width
+    norm_topk: bool = True         # renormalize routing weights over top-k
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0

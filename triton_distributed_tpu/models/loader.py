@@ -84,11 +84,27 @@ def load_qwen_state_dict(
             _w(state_dict, lp + "self_attn.o_proj.weight", dt),
             qn, kn,
         )
-        mlp = mlp_l.shard_params(
-            _w(state_dict, lp + "mlp.gate_proj.weight", dt),
-            _w(state_dict, lp + "mlp.up_proj.weight", dt),
-            _w(state_dict, lp + "mlp.down_proj.weight", dt),
-        )
+        if c.is_moe:
+            # HF Qwen3-MoE: mlp.gate (router, (E, K)) + per-expert
+            # gate/up/down projections
+            moe_l = model._moe_layer()
+            router = _w(state_dict, lp + "mlp.gate.weight", dt)
+            gates, ups, downs = [], [], []
+            for j in range(c.num_experts):
+                ep = lp + f"mlp.experts.{j}."
+                gates.append(_w(state_dict, ep + "gate_proj.weight", dt))
+                ups.append(_w(state_dict, ep + "up_proj.weight", dt))
+                downs.append(_w(state_dict, ep + "down_proj.weight", dt))
+            w_up = moe_l.fuse_expert_gate_up(
+                jnp.stack(gates), jnp.stack(ups)
+            )
+            mlp = moe_l.shard_params_tp(router, w_up, jnp.stack(downs))
+        else:
+            mlp = mlp_l.shard_params(
+                _w(state_dict, lp + "mlp.gate_proj.weight", dt),
+                _w(state_dict, lp + "mlp.up_proj.weight", dt),
+                _w(state_dict, lp + "mlp.down_proj.weight", dt),
+            )
         layers.append(QwenLayerParams(
             ln1=rep(_vec(state_dict, lp + "input_layernorm.weight", dt)),
             attn=attn,

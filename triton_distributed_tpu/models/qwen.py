@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.mesh import TP_AXIS
+from ..layers.moe import MoEMLP, MoEParams
 from ..layers.norm import rms_norm
 from ..layers.tp_attn import TPAttn, TPAttnParams
 from ..layers.tp_mlp import TPMLP, TPMLPParams
@@ -44,7 +45,7 @@ class QwenLayerParams:
     ln1: jax.Array
     attn: TPAttnParams
     ln2: jax.Array
-    mlp: TPMLPParams
+    mlp: "TPMLPParams | MoEParams"   # MoEParams when config.is_moe
 
 
 @jax.tree_util.register_dataclass
@@ -79,21 +80,48 @@ class Qwen3:
     def _mlp_layer(self) -> TPMLP:
         return TPMLP(self.mesh, axis=self.axis)
 
+    def _moe_layer(self) -> MoEMLP:
+        c = self.config
+        return MoEMLP(
+            self.mesh, num_experts=c.num_experts, top_k=c.top_k,
+            axis=self.axis, swiglu=True, renormalize=c.norm_topk,
+        )
+
+    def _mlp_forward(self, p, x: jax.Array) -> jax.Array:
+        """Prefill MLP: dense fused path or routed MoE (TP strategy)."""
+        if self.config.is_moe:
+            return self._moe_layer().forward_tp(p, x)
+        return self._mlp_layer().forward(p, x)
+
+    def _mlp_decode_step(self, p, x: jax.Array) -> jax.Array:
+        if self.config.is_moe:
+            return self._moe_layer().forward_replicated(p, x)
+        return self._mlp_decode(p, x)
+
     # -- parameters -------------------------------------------------------
 
     def init(self, key: jax.Array, scale: float = 0.02) -> QwenParams:
         c = self.config
-        attn_l, mlp_l = self._attn_layer(), self._mlp_layer()
+        attn_l = self._attn_layer()
         keys = jax.random.split(key, 2 * c.num_layers + 3)
         layers = []
         for li in range(c.num_layers):
+            if c.is_moe:
+                mlp = self._moe_layer().init(
+                    keys[2 * li + 1], c.hidden, c.moe_intermediate,
+                    dtype=c.dtype, scale=scale,
+                )
+            else:
+                mlp = self._mlp_layer().init(
+                    keys[2 * li + 1], c.hidden, c.intermediate,
+                    dtype=c.dtype, scale=scale,
+                )
             layers.append(QwenLayerParams(
                 ln1=jnp.ones((c.hidden,), c.dtype),
                 attn=attn_l.init(keys[2 * li], c.hidden, dtype=c.dtype,
                                  scale=scale),
                 ln2=jnp.ones((c.hidden,), c.dtype),
-                mlp=mlp_l.init(keys[2 * li + 1], c.hidden, c.intermediate,
-                               dtype=c.dtype, scale=scale),
+                mlp=mlp,
             ))
         rep = NamedSharding(self.mesh, P(None, None))
         embed = jax.device_put(
@@ -155,7 +183,6 @@ class Qwen3:
         (B, S).  Returns (logits (B, S, V), cache)."""
         c = self.config
         b, s = input_ids.shape
-        mlp_l = self._mlp_layer()
         x = params.embed[input_ids.reshape(-1)]
         x = jax.lax.with_sharding_constraint(
             x, NamedSharding(self.mesh, P(self.axis, None))
@@ -166,7 +193,7 @@ class Qwen3:
             )
             cache = write_prefill(cache, li, k_new, v_new)
             x = x + attn_out
-            x = x + mlp_l.forward(lp.mlp, rms_norm(x, lp.ln2, c.rms_eps))
+            x = x + self._mlp_forward(lp.mlp, rms_norm(x, lp.ln2, c.rms_eps))
         x = rms_norm(x, params.final_norm, c.rms_eps)
         logits = jnp.dot(x, params.lm_head,
                          preferred_element_type=jnp.float32)
@@ -270,7 +297,9 @@ class Qwen3:
                 lp.attn, rms_norm(x, lp.ln1, c.rms_eps), cache, li
             )
             x = x + attn_out
-            x = x + self._mlp_decode(lp.mlp, rms_norm(x, lp.ln2, c.rms_eps))
+            x = x + self._mlp_decode_step(
+                lp.mlp, rms_norm(x, lp.ln2, c.rms_eps)
+            )
         x = rms_norm(x, params.final_norm, c.rms_eps)
         logits = jnp.dot(x, params.lm_head,
                          preferred_element_type=jnp.float32)

@@ -125,25 +125,33 @@ def _hf_moe_model():
     return model
 
 
-@pytest.mark.parametrize("tp", [1, 2])
-def test_moe_prefill_logits_match_hf(tp):
-    """Qwen3-MoE: routed SwiGLU experts through the TP MoE path vs HF."""
+@pytest.mark.parametrize("tp,strategy", [(1, "tp"), (2, "tp"), (2, "ep")])
+def test_moe_prefill_logits_match_hf(tp, strategy):
+    """Qwen3-MoE: routed SwiGLU experts vs HF, under both parallelism
+    strategies (TP: experts F-sharded through AG+group-GEMM+RS; EP:
+    experts partitioned through A2A dispatch/combine)."""
+    import dataclasses
+
     hf = _hf_moe_model()
     ids_np = np.array([[3, 17, 42, 7, 99, 5, 23, 81]], np.int64)
     with torch.no_grad():
         want = hf(torch.from_numpy(ids_np)).logits.float().numpy()
 
+    cfg = dataclasses.replace(MOE_CFG, moe_strategy=strategy)
     mesh = make_mesh({TP_AXIS: tp}, devices=jax.devices()[:tp])
-    model = Qwen3(MOE_CFG, mesh)
+    model = Qwen3(cfg, mesh)
     params = load_qwen_state_dict(model, hf.state_dict())
-    cache = init_cache(mesh, MOE_CFG.num_layers, 1, MOE_CFG.num_kv_heads,
-                       MOE_CFG.max_length, MOE_CFG.head_dim, MOE_CFG.dtype)
+    cache = init_cache(mesh, cfg.num_layers, 1, cfg.num_kv_heads,
+                       cfg.max_length, cfg.head_dim, cfg.dtype)
     got, _ = model.prefill(params, cache, jnp.asarray(ids_np, jnp.int32))
     got = np.asarray(jax.device_get(got), np.float32)
     np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
 
 
-def test_moe_greedy_decode_matches_hf():
+@pytest.mark.parametrize("strategy", ["tp", "ep"])
+def test_moe_greedy_decode_matches_hf(strategy):
+    import dataclasses
+
     hf = _hf_moe_model()
     ids_np = np.array([[3, 17, 42, 7]], np.int64)
     gen_len = 6
@@ -153,8 +161,9 @@ def test_moe_greedy_decode_matches_hf():
             pad_token_id=0,
         ).numpy()[:, ids_np.shape[1]:]
 
+    cfg = dataclasses.replace(MOE_CFG, moe_strategy=strategy)
     mesh = make_mesh({TP_AXIS: 2}, devices=jax.devices()[:2])
-    model = Qwen3(MOE_CFG, mesh)
+    model = Qwen3(cfg, mesh)
     params = load_qwen_state_dict(model, hf.state_dict())
     from triton_distributed_tpu.models import Engine
 

@@ -214,6 +214,45 @@ class MoEMLP:
             check_vma=False,
         )(x, params.router, params.w_up, params.w_dn)
 
+    def forward_replicated_ep(self, params: MoEParams,
+                              x: jax.Array) -> jax.Array:
+        """Replicated small-batch decode against the EP (expert-partitioned)
+        layout: every rank routes all tokens identically, computes only the
+        contributions of the experts it owns, and one psum folds the routed
+        sum — the latency-path analogue of the reference's low-latency EP
+        decode (dispatching one-token batches over A2A would put two wire
+        hops on the critical path for a sub-tile payload).
+
+        ``x``: (B, K) replicated.  Returns (B, K) replicated.
+        """
+        e, k = self.num_experts, self.top_k
+        epr = e // self.n
+
+        def local(x_rep, router_rep, w_up_loc, w_dn_loc):
+            r = jax.lax.axis_index(self.axis)
+            eid, wts = topk_route(x_rep @ router_rep, k,
+                                  renormalize=self.renormalize)
+            xr, eflat, wflat = flatten_topk(x_rep, eid, wts)
+            # rows routed to other ranks' experts park on local slot 0
+            # with weight 0 — computed then discarded (B is tiny)
+            local_eid = eflat - r * epr
+            owned = (local_eid >= 0) & (local_eid < epr)
+            wflat = jnp.where(owned, wflat, 0.0)
+            local_eid = jnp.where(owned, local_eid, 0).astype(jnp.int32)
+            xs, splits, unsort = sort_by_expert(xr, local_eid, epr)
+            h = self._combine(jax.lax.ragged_dot(xs, w_up_loc, splits))
+            y = jax.lax.ragged_dot(h, w_dn_loc, splits)
+            y = unsort_combine(y, unsort, wflat, k)
+            return jax.lax.psum(y, self.axis).astype(x_rep.dtype)
+
+        return jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(None, None), P(None, None),
+                      P(self.axis, None, None), P(self.axis, None, None)),
+            out_specs=P(None, None),
+            check_vma=False,
+        )(x, params.router, params.w_up, params.w_dn)
+
     # -- EP forward -------------------------------------------------------
 
     def forward_ep(self, params: MoEParams, x: jax.Array,

@@ -31,6 +31,8 @@ class ModelConfig:
     top_k: int = 8
     moe_intermediate: int = 0      # 768; per-expert SwiGLU width
     norm_topk: bool = True         # renormalize routing weights over top-k
+    moe_strategy: str = "tp"       # "tp" (experts F-sharded) | "ep"
+                                   # (experts partitioned; A2A dispatch)
 
     @property
     def is_moe(self) -> bool:

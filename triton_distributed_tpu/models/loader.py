@@ -88,6 +88,7 @@ def load_qwen_state_dict(
             # HF Qwen3-MoE: mlp.gate (router, (E, K)) + per-expert
             # gate/up/down projections
             moe_l = model._moe_layer()
+            is_ep = c.moe_strategy == "ep"
             router = _w(state_dict, lp + "mlp.gate.weight", dt)
             gates, ups, downs = [], [], []
             for j in range(c.num_experts):
@@ -96,9 +97,11 @@ def load_qwen_state_dict(
                 ups.append(_w(state_dict, ep + "up_proj.weight", dt))
                 downs.append(_w(state_dict, ep + "down_proj.weight", dt))
             w_up = moe_l.fuse_expert_gate_up(
-                jnp.stack(gates), jnp.stack(ups)
+                jnp.stack(gates), jnp.stack(ups), ep=is_ep
             )
-            mlp = moe_l.shard_params_tp(router, w_up, jnp.stack(downs))
+            shard_fn = (moe_l.shard_params_ep if is_ep
+                        else moe_l.shard_params_tp)
+            mlp = shard_fn(router, w_up, jnp.stack(downs))
         else:
             mlp = mlp_l.shard_params(
                 _w(state_dict, lp + "mlp.gate_proj.weight", dt),

@@ -88,14 +88,24 @@ class Qwen3:
         )
 
     def _mlp_forward(self, p, x: jax.Array) -> jax.Array:
-        """Prefill MLP: dense fused path or routed MoE (TP strategy)."""
-        if self.config.is_moe:
-            return self._moe_layer().forward_tp(p, x)
+        """Prefill MLP: dense fused path or routed MoE (config strategy:
+        TP = experts F-sharded, AG + group-GEMM + RS; EP = experts
+        partitioned, A2A dispatch/combine)."""
+        c = self.config
+        if c.is_moe:
+            moe = self._moe_layer()
+            if c.moe_strategy == "ep":
+                return moe.forward_ep(p, x)
+            return moe.forward_tp(p, x)
         return self._mlp_layer().forward(p, x)
 
     def _mlp_decode_step(self, p, x: jax.Array) -> jax.Array:
-        if self.config.is_moe:
-            return self._moe_layer().forward_replicated(p, x)
+        c = self.config
+        if c.is_moe:
+            moe = self._moe_layer()
+            if c.moe_strategy == "ep":
+                return moe.forward_replicated_ep(p, x)
+            return moe.forward_replicated(p, x)
         return self._mlp_decode(p, x)
 
     # -- parameters -------------------------------------------------------
@@ -109,7 +119,7 @@ class Qwen3:
             if c.is_moe:
                 mlp = self._moe_layer().init(
                     keys[2 * li + 1], c.hidden, c.moe_intermediate,
-                    dtype=c.dtype, scale=scale,
+                    ep=c.moe_strategy == "ep", dtype=c.dtype, scale=scale,
                 )
             else:
                 mlp = self._mlp_layer().init(

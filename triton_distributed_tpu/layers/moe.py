@@ -35,8 +35,11 @@ from ..ops.moe_utils import (
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class MoEParams:
-    """router: (K, E) replicated; w_up: (E, K, F); w_dn: (E, F, K) —
-    expert weights sharded on F (TP) or on E (EP)."""
+    """router: (K, E) replicated; w_up: (E, K, F) — or the fused
+    (E, K, 2F) ``[gate | up]`` layout when the layer runs ``swiglu=True``
+    (rank-blocked ``[gate_r | up_r]`` under TP; build it with
+    ``MoEMLP.fuse_expert_gate_up``); w_dn: (E, F, K).  Expert weights are
+    sharded on F (TP) or on E (EP)."""
 
     router: jax.Array
     w_up: jax.Array
@@ -187,6 +190,35 @@ class MoEMLP:
             self.mesh, self.axis,
         )
 
+    def _replicated_local_step(self, ep: bool):
+        """Shared body of the small-M decode paths: route all tokens,
+        ragged expert GEMMs against this rank's weight slice, weighted
+        fold, one psum.  Under ``ep`` each rank additionally keeps only
+        the rows routed to experts it owns (foreign rows park on local
+        slot 0 with weight 0 — computed then discarded; B is tiny)."""
+        e, k = self.num_experts, self.top_k
+        epr = e // self.n
+
+        def local(x_rep, router_rep, w_up_loc, w_dn_loc):
+            eid, wts = topk_route(x_rep @ router_rep, k,
+                                  renormalize=self.renormalize)
+            xr, eflat, wflat = flatten_topk(x_rep, eid, wts)
+            num_local = e
+            if ep:
+                r = jax.lax.axis_index(self.axis)
+                local_eid = eflat - r * epr
+                owned = (local_eid >= 0) & (local_eid < epr)
+                wflat = jnp.where(owned, wflat, 0.0)
+                eflat = jnp.where(owned, local_eid, 0).astype(jnp.int32)
+                num_local = epr
+            xs, splits, unsort = sort_by_expert(xr, eflat, num_local)
+            h = self._combine(jax.lax.ragged_dot(xs, w_up_loc, splits))
+            y = jax.lax.ragged_dot(h, w_dn_loc, splits)
+            y = unsort_combine(y, unsort, wflat, k)
+            return jax.lax.psum(y, self.axis).astype(x_rep.dtype)
+
+        return local
+
     def forward_replicated(self, params: MoEParams, x: jax.Array) -> jax.Array:
         """Small-M decode path: replicated tokens against the TP (F-sharded)
         expert layout — local routed ragged GEMMs, then one psum; the MoE
@@ -194,20 +226,8 @@ class MoEMLP:
 
         ``x``: (B, K) replicated.  Returns (B, K) replicated.
         """
-        e, k = self.num_experts, self.top_k
-
-        def local(x_rep, router_rep, w_up_loc, w_dn_loc):
-            eid, wts = topk_route(x_rep @ router_rep, k,
-                                  renormalize=self.renormalize)
-            xr, eflat, wflat = flatten_topk(x_rep, eid, wts)
-            xs, splits, unsort = sort_by_expert(xr, eflat, e)
-            h = self._combine(jax.lax.ragged_dot(xs, w_up_loc, splits))
-            y = jax.lax.ragged_dot(h, w_dn_loc, splits)
-            y = unsort_combine(y, unsort, wflat, k)
-            return jax.lax.psum(y, self.axis).astype(x_rep.dtype)
-
         return jax.shard_map(
-            local, mesh=self.mesh,
+            self._replicated_local_step(ep=False), mesh=self.mesh,
             in_specs=(P(None, None), P(None, None),
                       P(None, None, self.axis), P(None, self.axis, None)),
             out_specs=P(None, None),
@@ -225,28 +245,8 @@ class MoEMLP:
 
         ``x``: (B, K) replicated.  Returns (B, K) replicated.
         """
-        e, k = self.num_experts, self.top_k
-        epr = e // self.n
-
-        def local(x_rep, router_rep, w_up_loc, w_dn_loc):
-            r = jax.lax.axis_index(self.axis)
-            eid, wts = topk_route(x_rep @ router_rep, k,
-                                  renormalize=self.renormalize)
-            xr, eflat, wflat = flatten_topk(x_rep, eid, wts)
-            # rows routed to other ranks' experts park on local slot 0
-            # with weight 0 — computed then discarded (B is tiny)
-            local_eid = eflat - r * epr
-            owned = (local_eid >= 0) & (local_eid < epr)
-            wflat = jnp.where(owned, wflat, 0.0)
-            local_eid = jnp.where(owned, local_eid, 0).astype(jnp.int32)
-            xs, splits, unsort = sort_by_expert(xr, local_eid, epr)
-            h = self._combine(jax.lax.ragged_dot(xs, w_up_loc, splits))
-            y = jax.lax.ragged_dot(h, w_dn_loc, splits)
-            y = unsort_combine(y, unsort, wflat, k)
-            return jax.lax.psum(y, self.axis).astype(x_rep.dtype)
-
         return jax.shard_map(
-            local, mesh=self.mesh,
+            self._replicated_local_step(ep=True), mesh=self.mesh,
             in_specs=(P(None, None), P(None, None),
                       P(self.axis, None, None), P(self.axis, None, None)),
             out_specs=P(None, None),

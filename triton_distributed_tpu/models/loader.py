@@ -43,6 +43,21 @@ def _vec(sd: Mapping, key: str, dtype) -> jnp.ndarray:
     return jnp.asarray(_as_np(sd[key]), dtype=dtype)
 
 
+def _np_fuse_gate_up(gate: np.ndarray, up: np.ndarray, n: int) -> np.ndarray:
+    """Host-side mirror of ``MoEMLP.fuse_expert_gate_up``: (E, K, F) pairs
+    -> (E, K, 2F) rank-blocked ``[gate_r | up_r]`` columns (plain
+    ``[gate | up]`` at n=1, the EP layout)."""
+    f = gate.shape[2]
+    if f % n:
+        raise ValueError(f"expert width {f} not divisible by {n} shards")
+    i = f // n
+    blocks = []
+    for r in range(n):
+        blocks.append(gate[:, :, r * i:(r + 1) * i])
+        blocks.append(up[:, :, r * i:(r + 1) * i])
+    return np.concatenate(blocks, axis=2)
+
+
 def load_qwen_state_dict(
     model: Qwen3,
     state_dict: Mapping,
@@ -86,22 +101,29 @@ def load_qwen_state_dict(
         )
         if c.is_moe:
             # HF Qwen3-MoE: mlp.gate (router, (E, K)) + per-expert
-            # gate/up/down projections
+            # gate/up/down projections.  Stack + fuse on HOST numpy: the
+            # expert stacks are the big tensors, and a device-side fuse
+            # would stage full unsharded (E, K, 2F) copies on one chip —
+            # device_put of the host array straight into the sharded
+            # layout keeps the no-single-device-replication guarantee.
             moe_l = model._moe_layer()
             is_ep = c.moe_strategy == "ep"
             router = _w(state_dict, lp + "mlp.gate.weight", dt)
             gates, ups, downs = [], [], []
             for j in range(c.num_experts):
                 ep = lp + f"mlp.experts.{j}."
-                gates.append(_w(state_dict, ep + "gate_proj.weight", dt))
-                ups.append(_w(state_dict, ep + "up_proj.weight", dt))
-                downs.append(_w(state_dict, ep + "down_proj.weight", dt))
-            w_up = moe_l.fuse_expert_gate_up(
-                jnp.stack(gates), jnp.stack(ups), ep=is_ep
-            )
+                gates.append(_as_np(state_dict[ep + "gate_proj.weight"]).T)
+                ups.append(_as_np(state_dict[ep + "up_proj.weight"]).T)
+                downs.append(_as_np(state_dict[ep + "down_proj.weight"]).T)
+            w_up = _np_fuse_gate_up(
+                np.stack(gates), np.stack(ups), 1 if is_ep else model.tp
+            ).astype(jnp.dtype(dt))
             shard_fn = (moe_l.shard_params_ep if is_ep
                         else moe_l.shard_params_tp)
-            mlp = shard_fn(router, w_up, jnp.stack(downs))
+            # numpy in: device_put shards straight from host memory
+            mlp = shard_fn(
+                router, w_up, np.stack(downs).astype(jnp.dtype(dt))
+            )
         else:
             mlp = mlp_l.shard_params(
                 _w(state_dict, lp + "mlp.gate_proj.weight", dt),

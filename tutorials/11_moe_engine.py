@@ -1,7 +1,7 @@
 """Tutorial 11 — serving a Qwen3-MoE model under both expert strategies.
 
-The same checkpoint (here: random init exported to safetensors and
-re-ingested, exercising the weight path) serves under:
+The same model (same init seed, so identical logical weights) serves
+under:
 
 - ``moe_strategy="tp"``: every rank holds all experts F-sharded; prefill
   routes through AG + group-GEMM (the tile-scheduled Pallas grouped

@@ -217,6 +217,52 @@ def _build_ag_gemm(
     )
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _ag_gemm_core(mesh, axis, cfg, bidir, out_dtype, a, b):
+    """Differentiable n>1 core (C only).  The VJP is the TP adjoint
+    duality: d/dA rides the *other* fused op (``gemm_rs``) and d/dB a
+    plain AllGather + local GEMM — so the backward pass overlaps its
+    collectives exactly like the forward (the training-step property the
+    reference leaves implicit in its torch autograd fallback)."""
+    n = mesh.shape[axis]
+    fn = _build_ag_gemm(
+        mesh, axis, a.shape[0] // n, a.shape[1], b.shape[1] // n,
+        jnp.dtype(a.dtype), out_dtype, cfg, bidir,
+    )
+    _, c = fn(a, b)
+    return c
+
+
+def _ag_gemm_fwd(mesh, axis, cfg, bidir, out_dtype, a, b):
+    return _ag_gemm_core(mesh, axis, cfg, bidir, out_dtype, a, b), (a, b)
+
+
+def _ag_gemm_bwd(mesh, axis, cfg, bidir, out_dtype, res, dc):
+    from ..comm.allgather import all_gather
+    from .gemm_rs import gemm_rs
+
+    a, b = res
+    # dA = dC @ B^T: (M, N)x(N, K) with N contracted over ranks -> the
+    # adjoint of the AllGather is a ReduceScatter: the other fused op
+    da = gemm_rs(dc, b.T, mesh, axis, out_dtype=a.dtype)
+    # dB = A^T @ dC: gather A once, local GEMM per N-shard
+    ag_a = all_gather(a, mesh, axis)
+
+    def local(ag, dcr):
+        return jnp.dot(ag.T, dcr,
+                       preferred_element_type=jnp.float32).astype(b.dtype)
+
+    db = compilation.jit_shard_map(
+        local, mesh,
+        in_specs=(P(None, None), P(None, axis)),
+        out_specs=P(None, axis),
+    )(ag_a, dc)
+    return da, db
+
+
+_ag_gemm_core.defvjp(_ag_gemm_fwd, _ag_gemm_bwd)
+
+
 def ag_gemm(
     a: jax.Array,
     b: jax.Array,
@@ -262,9 +308,13 @@ def ag_gemm(
     # clip BEFORE the cache lookup so configs that normalize to the same
     # effective tiles share one compiled kernel
     cfg = cfg.clip(m_tot // n, k_dim, n_tot // n)
-    fn = _build_ag_gemm(
-        mesh, axis, m_tot // n, k_dim, n_tot // n,
-        jnp.dtype(a.dtype), out_dtype, cfg, bool(bidir),
-    )
-    gathered, c = fn(a, b)
-    return (c, gathered) if return_gathered else c
+    if return_gathered:
+        # workspace-reuse path (e.g. the attention layer): not wired for
+        # autodiff — the gathered output has no defined cotangent
+        fn = _build_ag_gemm(
+            mesh, axis, m_tot // n, k_dim, n_tot // n,
+            jnp.dtype(a.dtype), out_dtype, cfg, bool(bidir),
+        )
+        gathered, c = fn(a, b)
+        return c, gathered
+    return _ag_gemm_core(mesh, axis, cfg, bool(bidir), out_dtype, a, b)

@@ -183,6 +183,50 @@ def _build_gemm_rs(
     )
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _gemm_rs_core(mesh, axis, cfg, out_dtype, a, b):
+    """Differentiable n>1 core.  Adjoint duality with ``ag_gemm``: the
+    ReduceScatter's transpose is an AllGather, so d/dA runs the other
+    fused op and the backward pass overlaps its wire exactly like the
+    forward."""
+    n = mesh.shape[axis]
+    fn = _build_gemm_rs(
+        mesh, axis, a.shape[0] // n, a.shape[1] // n, b.shape[1],
+        jnp.dtype(a.dtype), out_dtype, cfg,
+    )
+    return fn(a, b)
+
+
+def _gemm_rs_fwd(mesh, axis, cfg, out_dtype, a, b):
+    return _gemm_rs_core(mesh, axis, cfg, out_dtype, a, b), (a, b)
+
+
+def _gemm_rs_bwd(mesh, axis, cfg, out_dtype, res, dout):
+    from ..comm.allgather import all_gather
+    from .ag_gemm import ag_gemm
+
+    a, b = res
+    # dA = dOut @ B^T: dOut is row-scattered, so its adjoint gathers —
+    # exactly the fused AllGather-GEMM
+    da = ag_gemm(dout, b.T, mesh, axis, out_dtype=a.dtype)
+    # dB = A^T @ dOut: gather the scattered rows once, local K-shard GEMM
+    ag_dout = all_gather(dout, mesh, axis)
+
+    def local(ar, ag):
+        return jnp.dot(ar.T, ag,
+                       preferred_element_type=jnp.float32).astype(b.dtype)
+
+    db = compilation.jit_shard_map(
+        local, mesh,
+        in_specs=(P(None, axis), P(None, None)),
+        out_specs=P(axis, None),
+    )(a, ag_dout)
+    return da, db
+
+
+_gemm_rs_core.defvjp(_gemm_rs_fwd, _gemm_rs_bwd)
+
+
 def gemm_rs(
     a: jax.Array,
     b: jax.Array,
@@ -216,7 +260,4 @@ def gemm_rs(
 
     m_loc, k_loc = m_tot // n, k_dim // n
     cfg = cfg.clip(m_loc, k_loc, n_dim)
-    fn = _build_gemm_rs(
-        mesh, axis, m_loc, k_loc, n_dim, jnp.dtype(a.dtype), out_dtype, cfg
-    )
-    return fn(a, b)
+    return _gemm_rs_core(mesh, axis, cfg, out_dtype, a, b)

@@ -279,6 +279,30 @@ def hierarchical_all_gather(
     return fn(x)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _all_gather_core(mesh, axis, method, x):
+    shard_shape = (x.shape[0] // mesh.shape[axis], *x.shape[1:])
+    fn = _build_all_gather(mesh, axis, method, shard_shape,
+                           jnp.dtype(x.dtype))
+    return fn(x)
+
+
+def _ag_fwd(mesh, axis, method, x):
+    return _all_gather_core(mesh, axis, method, x), jnp.zeros((0,), x.dtype)
+
+
+def _ag_bwd(mesh, axis, method, wit, dout):
+    # In GLOBAL semantics the gather is the identity (it only changes the
+    # sharding from P(axis) to replicated), so the adjoint is the
+    # identity too; XLA turns the replicated-to-sharded cotangent into a
+    # local slice.  (The per-device RS-adjoint picture lives inside the
+    # fused ops' VJPs, which compute global matmul adjoints.)
+    return (dout.astype(wit.dtype),)
+
+
+_all_gather_core.defvjp(_ag_fwd, _ag_bwd)
+
+
 def all_gather(
     x: jax.Array,
     mesh: Mesh,
@@ -291,6 +315,7 @@ def all_gather(
     Entry point mirroring the reference's host-side dispatchers
     (``allgather.py`` / ``fast_allgather``).  Returns the replicated gathered
     array; golden equivalent is ``jax.lax.all_gather(..., tiled=True)``.
+    Differentiable (adjoint = ring ReduceScatter).
     """
     n = mesh.shape[axis]
     if n == 1:
@@ -303,6 +328,4 @@ def all_gather(
     shard_shape = (m_local, *x.shape[1:])
 
     method = resolve_method(method, shard_shape, x.dtype, n)
-
-    fn = _build_all_gather(mesh, axis, method, shard_shape, jnp.dtype(x.dtype))
-    return fn(x)
+    return _all_gather_core(mesh, axis, method, x)

@@ -322,6 +322,30 @@ def hierarchical_all_reduce(
     return fn(x)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _all_reduce_core(mesh, axis, method, out_dtype, cfg, x):
+    n = mesh.shape[axis]
+    fn = _build_all_reduce(
+        mesh, axis, method, x.shape[0] // n, x.shape[1],
+        jnp.dtype(x.dtype), out_dtype, cfg,
+    )
+    return fn(x)
+
+
+def _ar_fwd(mesh, axis, method, out_dtype, cfg, x):
+    return _all_reduce_core(mesh, axis, method, out_dtype, cfg, x), jnp.zeros((0,), x.dtype)
+
+
+def _ar_bwd(mesh, axis, method, out_dtype, cfg, wit, dout):
+    # global semantics: out = x.reshape(n, M, R).sum(0) (replicated) ->
+    # the adjoint tiles the cotangent over the stacked partials
+    n = mesh.shape[axis]
+    return (jnp.tile(dout, (n, 1)).astype(wit.dtype),)
+
+
+_all_reduce_core.defvjp(_ar_fwd, _ar_bwd)
+
+
 def all_reduce(
     x: jax.Array,
     mesh: Mesh,
@@ -364,7 +388,4 @@ def all_reduce(
     cfg = (config or AllReduceConfig()).clip(
         m // n if method == AllReduceMethod.TWO_SHOT else m, x.shape[1]
     )
-    fn = _build_all_reduce(
-        mesh, axis, method, m, x.shape[1], jnp.dtype(x.dtype), out_dtype, cfg
-    )
-    return fn(x)
+    return _all_reduce_core(mesh, axis, method, out_dtype, cfg, x)

@@ -233,6 +233,30 @@ def hierarchical_reduce_scatter(
     return fn(x)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _reduce_scatter_core(mesh, axis, cfg, x):
+    n = mesh.shape[axis]
+    fn = _build_reduce_scatter(
+        mesh, axis, x.shape[0] // (n * n), x.shape[1], jnp.dtype(x.dtype),
+        cfg,
+    )
+    return fn(x)
+
+
+def _rs_fwd(mesh, axis, cfg, x):
+    return _reduce_scatter_core(mesh, axis, cfg, x), jnp.zeros((0,), x.dtype)
+
+
+def _rs_bwd(mesh, axis, cfg, wit, dout):
+    # global semantics: out = x.reshape(n, M, R).sum(0) -> the adjoint
+    # broadcasts the cotangent back over the n stacked partials
+    n = mesh.shape[axis]
+    return (jnp.tile(dout, (n, 1)).astype(wit.dtype),)
+
+
+_reduce_scatter_core.defvjp(_rs_fwd, _rs_bwd)
+
+
 def reduce_scatter(
     x: jax.Array,
     mesh: Mesh,
@@ -260,7 +284,4 @@ def reduce_scatter(
         )
     m_loc = m_partial // n            # output rows per device
     cfg = (config or ReduceScatterConfig()).clip(m_loc, x.shape[1])
-    fn = _build_reduce_scatter(
-        mesh, axis, m_loc, x.shape[1], jnp.dtype(x.dtype), cfg
-    )
-    return fn(x)
+    return _reduce_scatter_core(mesh, axis, cfg, x)

@@ -136,6 +136,50 @@ def _build_grouped_matmul(t, k, n_dim, e, bm, bn, bk, dtype, out_dtype):
     return jax.jit(call)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _grouped_matmul_vjp(cfg: GroupGemmConfig, out_dtype, x_sorted, w,
+                        splits):
+    return _grouped_matmul_run(cfg, out_dtype, x_sorted, w, splits)
+
+
+def _grouped_matmul_run(cfg, out_dtype, x_sorted, w, splits):
+    t, k = x_sorted.shape
+    e, _, n_dim = w.shape
+    bm, bn, bk = (
+        clip_block(cfg.bm, t), clip_block(cfg.bn, n_dim), clip_block(cfg.bk, k)
+    )
+    sched = grouped_tile_schedule(splits, t, bm)
+    fn = _build_grouped_matmul(
+        t, k, n_dim, e, bm, bn, bk, jnp.dtype(x_sorted.dtype), out_dtype
+    )
+    return fn(*sched, x_sorted, w)
+
+
+def _gm_fwd(cfg, out_dtype, x_sorted, w, splits):
+    return _grouped_matmul_vjp(cfg, out_dtype, x_sorted, w, splits), (
+        x_sorted, w, splits
+    )
+
+
+def _gm_bwd(cfg, out_dtype, res, dy):
+    # fast Pallas forward, XLA backward: ragged_dot computes the same
+    # function, so its vjp supplies dx (grouped matmul against transposed
+    # expert weights) and dw (the grouped outer product)
+    import numpy as np
+
+    x_sorted, w, splits = res
+    _, vjp = jax.vjp(
+        lambda x_, w_: jax.lax.ragged_dot(x_, w_, splits.astype(jnp.int32)),
+        x_sorted, w,
+    )
+    dx, dw = vjp(dy.astype(x_sorted.dtype))
+    d_splits = np.zeros(splits.shape, dtype=jax.dtypes.float0)
+    return dx, dw, d_splits
+
+
+_grouped_matmul_vjp.defvjp(_gm_fwd, _gm_bwd)
+
+
 def grouped_matmul(
     x_sorted: jax.Array,
     w: jax.Array,
@@ -167,14 +211,7 @@ def grouped_matmul(
     out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(
         x_sorted.dtype
     )
-    bm, bn, bk = (
-        clip_block(cfg.bm, t), clip_block(cfg.bn, n_dim), clip_block(cfg.bk, k)
-    )
-    sched = grouped_tile_schedule(splits, t, bm)
-    fn = _build_grouped_matmul(
-        t, k, n_dim, e, bm, bn, bk, jnp.dtype(x_sorted.dtype), out_dtype
-    )
-    return fn(*sched, x_sorted, w)
+    return _grouped_matmul_vjp(cfg, out_dtype, x_sorted, w, splits)
 
 
 def group_gemm(x_sorted: jax.Array, w: jax.Array,

@@ -244,3 +244,56 @@ def test_moe_tp_training_step():
                                np.asarray(g_ref[0]), atol=1e-4, rtol=1e-3)
     np.testing.assert_allclose(np.asarray(jax.device_get(grads.w_dn)),
                                np.asarray(g_ref[1]), atol=1e-4, rtol=1e-3)
+
+
+def test_moe_ep_training_step():
+    """Gradients through the EP path: the A2A dispatch/combine pair are
+    each other's adjoints (a token permutation and its transpose), so the
+    backward pass re-runs the opposite A2A."""
+    from triton_distributed_tpu.comm.all_to_all import AllToAllConfig
+    from triton_distributed_tpu.layers.moe import MoEMLP
+
+    n = 4
+    mesh = _mesh(n)
+    t, hid, ffn, e, k = 8, 32, 16, 8, 2
+    layer = MoEMLP(mesh, num_experts=e, top_k=k, swiglu=True)
+    rng = np.random.default_rng(33)
+    x = jnp.asarray(rng.standard_normal((n * t, hid)).astype(np.float32) * 0.3)
+    router = jnp.asarray(rng.standard_normal((hid, e)).astype(np.float32))
+    gate = jnp.asarray(rng.standard_normal((e, hid, ffn)).astype(np.float32) * 0.3)
+    up = jnp.asarray(rng.standard_normal((e, hid, ffn)).astype(np.float32) * 0.3)
+    w_dn = jnp.asarray(rng.standard_normal((e, ffn, hid)).astype(np.float32) * 0.3)
+    params_ep = layer.shard_params_ep(
+        router, layer.fuse_expert_gate_up(gate, up, ep=True), w_dn
+    )
+    xs = jax.device_put(x, NamedSharding(mesh, P(TP_AXIS, None)))
+    cfg = AllToAllConfig(chunk=8)
+
+    def loss_ep(p, x):
+        y = layer.forward_ep(p, x, a2a_config=cfg)
+        return jnp.mean(y * y)
+
+    grads = jax.jit(jax.grad(loss_ep))(params_ep, xs)
+
+    # dense reference on unfused weights ([gate|up] plain concat under EP)
+    def loss_ref(w_up_f, w_dn_, x):
+        probs = jax.nn.softmax(x @ router, axis=-1)
+        top_w, top_e = jax.lax.top_k(probs, k)
+        top_w = top_w / top_w.sum(-1, keepdims=True)
+        out = jnp.zeros_like(x)
+        for j in range(k):
+            we = w_up_f[top_e[:, j]]              # (T, hid, 2ffn)
+            h = jnp.einsum("th,thf->tf", x, we)
+            act = jax.nn.silu(h[:, :ffn]) * h[:, ffn:]
+            y = jnp.einsum("tf,tfh->th", act, w_dn_[top_e[:, j]])
+            out = out + top_w[:, j:j + 1] * y
+        return jnp.mean(out * out)
+
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1)))(
+        jnp.asarray(np.asarray(params_ep.w_up)),
+        jnp.asarray(np.asarray(params_ep.w_dn)), x,
+    )
+    np.testing.assert_allclose(np.asarray(jax.device_get(grads.w_up)),
+                               np.asarray(g_ref[0]), atol=1e-4, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(jax.device_get(grads.w_dn)),
+                               np.asarray(g_ref[1]), atol=1e-4, rtol=1e-3)

@@ -212,6 +212,57 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _ep_dispatch_diff(mesh, axis, cfg, x, splits):
+    return _ep_dispatch_run(mesh, axis, cfg, x, splits)
+
+
+def _ep_dispatch_fwd(mesh, axis, cfg, x, splits):
+    out = _ep_dispatch_diff(mesh, axis, cfg, x, splits)
+    return out, (splits, x.shape[0] // mesh.shape[axis],
+                 jnp.zeros((0,), x.dtype))
+
+
+def _ep_dispatch_bwd(mesh, axis, cfg, res, cots):
+    # dispatch is a selection matrix S (each real token row lands in
+    # exactly one zone slot); its adjoint S^T is literally the combine.
+    # Padding rows on either side carry zero cotangent by construction.
+    import numpy as np
+
+    splits, t_loc, wit = res
+    d_recv, _ = cots   # recv_splits is integer output -> float0, dropped
+    dx = ep_combine(d_recv.astype(wit.dtype), splits, mesh, axis,
+                    token_dim=t_loc, config=cfg)
+    return dx, np.zeros(splits.shape, dtype=jax.dtypes.float0)
+
+
+_ep_dispatch_diff.defvjp(_ep_dispatch_fwd, _ep_dispatch_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _ep_combine_diff(mesh, axis, cfg, token_dim, y, splits):
+    return _ep_combine_run(mesh, axis, cfg, token_dim, y, splits)
+
+
+def _ep_combine_fwd(mesh, axis, cfg, token_dim, y, splits):
+    return _ep_combine_diff(mesh, axis, cfg, token_dim, y, splits), (
+        splits, jnp.zeros((0,), y.dtype)
+    )
+
+
+def _ep_combine_bwd(mesh, axis, cfg, token_dim, res, dback):
+    # combine = S^T, so its adjoint is the dispatch itself
+    import numpy as np
+
+    splits, wit = res
+    dy, _ = _ep_dispatch_run(mesh, axis, cfg, dback.astype(wit.dtype),
+                             splits)
+    return dy, np.zeros(splits.shape, dtype=jax.dtypes.float0)
+
+
+_ep_combine_diff.defvjp(_ep_combine_fwd, _ep_combine_bwd)
+
+
 def ep_dispatch(
     x: jax.Array,
     splits: jax.Array,
@@ -232,9 +283,14 @@ def ep_dispatch(
     Returns ``(recv, recv_splits)``: ``recv`` global (n*n, Z, H) — rank
     r's slab ``recv[r*n:(r+1)*n]`` is its n landing zones by source rank;
     ``recv_splits`` global (n*n, epr) — rank r's block gives, per source
-    rank, the counts for each of r's own experts.
+    rank, the counts for each of r's own experts.  Differentiable in
+    ``x`` (the adjoint is :func:`ep_combine`).
     """
     cfg = config or AllToAllConfig()
+    return _ep_dispatch_diff(mesh, axis, cfg, x, splits)
+
+
+def _ep_dispatch_run(mesh, axis, cfg, x, splits):
     n = mesh.shape[axis]
     tn, h = x.shape
     if tn % n:
@@ -277,9 +333,14 @@ def ep_combine(
     ``y``: global (n*n, Z, H) — the zone layout ``ep_dispatch`` produced
     (rows processed in place).  ``splits``: the SAME global (n*E,) given to
     dispatch.  ``token_dim``: T, the per-rank token row count.  Returns
-    global (n*T, H) over ``axis``.
+    global (n*T, H) over ``axis``.  Differentiable in ``y`` (the adjoint
+    is :func:`ep_dispatch`).
     """
     cfg = config or AllToAllConfig()
+    return _ep_combine_diff(mesh, axis, cfg, token_dim, y, splits)
+
+
+def _ep_combine_run(mesh, axis, cfg, token_dim, y, splits):
     n = mesh.shape[axis]
     if n == 1:
         return y.reshape(-1, y.shape[-1])[:token_dim]

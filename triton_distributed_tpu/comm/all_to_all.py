@@ -251,12 +251,13 @@ def _ep_combine_fwd(mesh, axis, cfg, token_dim, y, splits):
 
 
 def _ep_combine_bwd(mesh, axis, cfg, token_dim, res, dback):
-    # combine = S^T, so its adjoint is the dispatch itself
+    # combine = S^T, so its adjoint is the dispatch itself (via the
+    # differentiable wrapper so second-order AD keeps working)
     import numpy as np
 
     splits, wit = res
-    dy, _ = _ep_dispatch_run(mesh, axis, cfg, dback.astype(wit.dtype),
-                             splits)
+    dy, _ = _ep_dispatch_diff(mesh, axis, cfg, dback.astype(wit.dtype),
+                              splits)
     return dy, np.zeros(splits.shape, dtype=jax.dtypes.float0)
 
 

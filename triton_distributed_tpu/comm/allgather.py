@@ -315,7 +315,9 @@ def all_gather(
     Entry point mirroring the reference's host-side dispatchers
     (``allgather.py`` / ``fast_allgather``).  Returns the replicated gathered
     array; golden equivalent is ``jax.lax.all_gather(..., tiled=True)``.
-    Differentiable (adjoint = ring ReduceScatter).
+    Differentiable: in global semantics the gather only changes sharding,
+    so the adjoint is the identity (the ring-RS adjoints live inside the
+    fused ops' VJPs).
     """
     n = mesh.shape[axis]
     if n == 1:

@@ -145,13 +145,14 @@ def test_dispatch_combine_fp8_with_scales():
     scale sidecar, ``low_latency_all_to_all.py:36-120``).  The scale rides
     as an extra feature column, the TPU translation of the reference
     packing scales into the same message."""
+    from triton_distributed_tpu.ops.moe_utils import dequantize, quantize_e4m3
+
     n, t, h, e_tot = 4, 16, 64, 8
     x, splits, _ = _make_case(n, t, h, e_tot, seed=9)
     mesh = _mesh(n)
-    # quantize: per-row scale, payload in e4m3
-    absmax = np.abs(np.asarray(x)).max(axis=1, keepdims=True)
-    scale = (absmax / 448.0 + 1e-8).astype(np.float32)
-    x8 = jnp.asarray(np.asarray(x) / scale, jnp.float8_e4m3fn)
+    # quantize: per-row scale, payload in e4m3 (the packaged helper)
+    x8, scale_j = quantize_e4m3(x)
+    scale = np.asarray(scale_j)
     xs, ss = _shard(mesh, x8, splits)
     recv, _ = ep_dispatch(xs, ss, mesh, config=CFG)
     assert recv.dtype == jnp.float8_e4m3fn
@@ -170,5 +171,6 @@ def test_dispatch_combine_fp8_with_scales():
         np.asarray(jax.device_get(back_sc)), np.asarray(sc)
     )
     # dequantized round trip reproduces the original tokens to fp8 precision
-    deq = np.asarray(jax.device_get(back), np.float32) * scale
+    deq = np.asarray(dequantize(jnp.asarray(jax.device_get(back)),
+                                jnp.asarray(scale), jnp.float32))
     np.testing.assert_allclose(deq, np.asarray(x), rtol=0.07, atol=0.5)

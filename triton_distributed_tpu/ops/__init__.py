@@ -23,9 +23,11 @@ from .group_gemm import (
     moe_reduce_rs,
 )
 from .moe_utils import (
+    dequantize,
     expert_block_permutation,
     flatten_topk,
     global_presort_index,
+    quantize_e4m3,
     sort_by_expert,
     topk_route,
     unsort_combine,

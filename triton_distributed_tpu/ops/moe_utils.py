@@ -20,6 +20,28 @@ import jax
 import jax.numpy as jnp
 
 
+def quantize_e4m3(x: jax.Array, *, axis: int = -1):
+    """Per-row fp8 quantization for the low-latency A2A payload
+    (reference: the fp8 + scale-sidecar configuration of
+    ``low_latency_all_to_all.py:36-120``, its headline 137 us case).
+
+    Returns ``(x8, scale)``: ``x8 = x / scale`` in ``float8_e4m3fn`` and
+    ``scale`` f32 with the reduced ``axis`` kept at size 1, chosen so the
+    row's absmax maps to the e4m3 max (448).  Dispatch ``x8`` and
+    ``scale`` through the same A2A (the scale rides as a feature column)
+    and :func:`dequantize` on arrival.
+    """
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
+                     keepdims=True)
+    scale = absmax / 448.0 + 1e-12
+    return (x.astype(jnp.float32) / scale).astype(jnp.float8_e4m3fn), scale
+
+
+def dequantize(x8: jax.Array, scale: jax.Array, dtype=jnp.bfloat16):
+    """Inverse of :func:`quantize_e4m3`."""
+    return (x8.astype(jnp.float32) * scale).astype(dtype)
+
+
 def topk_route(logits: jax.Array, k: int, *, renormalize: bool = True):
     """Softmax top-k routing (reference ``moe_utils.py`` router prep).
 

@@ -163,3 +163,37 @@ def test_rope_relative_property():
         kr = apply_rope_at(k, jnp.array([pk]))
         return (qr * kr).sum()
     assert jnp.allclose(score(5, 3), score(25, 23), atol=1e-4, rtol=1e-4)
+
+
+import numpy as np
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_varlen_segments(causal):
+    """Packed variable-length batch via segment_ids (the reference's
+    cu_seqlens varlen path): each packed sequence must match its own
+    dense attention, and no probability mass leaks across the packing
+    boundary or into the padding tail."""
+    b, h, hk, s, d = 1, 4, 2, 64, 32
+    lens = [24, 28]                       # packed; 12 rows of padding
+    rng = np.random.default_rng(40)
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)).astype(np.float32) * 0.3)
+    k = jnp.asarray(rng.standard_normal((b, hk, s, d)).astype(np.float32) * 0.3)
+    v = jnp.asarray(rng.standard_normal((b, hk, s, d)).astype(np.float32) * 0.3)
+    seg = np.full((b, s), 99, np.int32)   # sentinel for padding
+    seg[0, :lens[0]] = 0
+    seg[0, lens[0]:lens[0] + lens[1]] = 1
+    out = flash_attention(q, k, v, causal=causal,
+                          segment_ids=jnp.asarray(seg),
+                          block_q=16, block_k=16)
+    # golden: dense attention per segment
+    start = 0
+    for seg_len in lens:
+        sl = slice(start, start + seg_len)
+        want = _naive_attention(q[:, :, sl], k[:, :, sl], v[:, :, sl],
+                                causal)
+        np.testing.assert_allclose(
+            np.asarray(out[:, :, sl], np.float32), np.asarray(want),
+            atol=2e-5, rtol=2e-5,
+        )
+        start += seg_len

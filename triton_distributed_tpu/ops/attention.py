@@ -80,16 +80,22 @@ def _attn_kernel(
     bq: int,
     bk: int,
     causal: bool,
+    has_segs: bool,
     sm_scale: float,
     soft_cap: float,
-    q_ref,    # (1, bq, d)    VMEM
-    k_ref,    # (1, seq_kv, d) VMEM
-    v_ref,    # (1, seq_kv, d) VMEM
-    o_ref,    # (1, bq, d)    VMEM
+    *refs,
+    # refs: q (1, bq, d), k (1, seq_kv, d), v (1, seq_kv, d),
+    # [seg_q (1, bq), seg_kv (1, seq_kv) when has_segs], o (1, bq, d)
 ):
+    if has_segs:
+        q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref = refs
+        sq_ref = sk_ref = None
     iq = pl.program_id(1)
     d = q_ref.shape[-1]
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (bq, d)
+    sq = sq_ref[0] if has_segs else None         # (bq,)
 
     def body(j, carry):
         k = k_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)    # (bk, d)
@@ -100,6 +106,12 @@ def _attn_kernel(
             qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             mask = qpos >= kpos
+        if has_segs:
+            # packed varlen: attend only within the same segment (the
+            # reference's cu_seqlens support, re-expressed as segment ids)
+            sk = sk_ref[0, pl.ds(j * bk, bk)]                  # (bk,)
+            seg_mask = sq[:, None] == sk[None, :]
+            mask = seg_mask if mask is None else (mask & seg_mask)
         return _tile_update(q, k, v, mask, soft_cap, carry)
 
     if causal:
@@ -113,27 +125,34 @@ def _attn_kernel(
 
 @functools.lru_cache(maxsize=None)
 def _build_flash_attention(
-    b, h, hk, seq_q, seq_kv, d, bq, bk, causal, sm_scale, soft_cap, dtype
+    b, h, hk, seq_q, seq_kv, d, bq, bk, causal, has_segs, sm_scale,
+    soft_cap, dtype
 ):
     group = h // hk
     kernel = functools.partial(
-        _attn_kernel, seq_kv, bq, bk, causal, sm_scale, soft_cap
+        _attn_kernel, seq_kv, bq, bk, causal, has_segs, sm_scale, soft_cap
     )
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, iq: (bh, iq, 0)),
+        # GQA in the index map: q-head bh%h -> kv-head (bh%h)//group
+        pl.BlockSpec(
+            (1, seq_kv, d),
+            lambda bh, iq: ((bh // h) * hk + (bh % h) // group, 0, 0),
+        ),
+        pl.BlockSpec(
+            (1, seq_kv, d),
+            lambda bh, iq: ((bh // h) * hk + (bh % h) // group, 0, 0),
+        ),
+    ]
+    if has_segs:
+        in_specs += [
+            pl.BlockSpec((1, bq), lambda bh, iq: (bh // h, iq)),
+            pl.BlockSpec((1, seq_kv), lambda bh, iq: (bh // h, 0)),
+        ]
     call = pl.pallas_call(
         kernel,
         grid=(b * h, seq_q // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, iq: (bh, iq, 0)),
-            # GQA in the index map: q-head bh%h -> kv-head (bh%h)//group
-            pl.BlockSpec(
-                (1, seq_kv, d),
-                lambda bh, iq: ((bh // h) * hk + (bh % h) // group, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, seq_kv, d),
-                lambda bh, iq: ((bh // h) * hk + (bh % h) // group, 0, 0),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, iq: (bh, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, seq_q, d), dtype),
         compiler_params=compilation.compiler_params(
@@ -153,6 +172,7 @@ def flash_attention(
     causal: bool = True,
     sm_scale: float | None = None,
     soft_cap: float = 0.0,
+    segment_ids: jax.Array | None = None,
     block_q: int = 512,
     block_k: int = 1024,
 ) -> jax.Array:
@@ -163,6 +183,13 @@ def flash_attention(
     position (decode-style suffix alignment when Sq < Skv is NOT applied —
     use :func:`decode_attention` for single-token decode).
     Golden: softmax(q k^T * scale + mask) v in f32.
+
+    ``segment_ids``: optional (B, S) int32 for PACKED variable-length
+    batches (the reference's cu_seqlens support,
+    ``sp_ag_attention_intra_node.py`` varlen path): positions attend only
+    within their segment.  Requires Sq == Skv.  Padding positions should
+    share a sentinel id; their rows compute self-attention garbage that
+    callers slice off.
 
     Default blocks 512x1024: doubling the kv block over 512x512 measured
     ~1.8x at (1, 32, 4096, 128) bf16 prefill — half the online-softmax
@@ -179,18 +206,30 @@ def flash_attention(
         raise ValueError(
             "causal prefill requires Sq == Skv (decode uses decode_attention)"
         )
+    if segment_ids is not None:
+        if seq_q != seq_kv:
+            raise ValueError("segment_ids requires Sq == Skv (packed batch)")
+        if segment_ids.shape != (b, seq_q):
+            raise ValueError(
+                f"segment_ids {segment_ids.shape} != (B, S) = ({b}, {seq_q})"
+            )
     sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
     bq = clip_block(min(block_q, seq_q), seq_q)
     bkv = clip_block(min(block_k, seq_kv), seq_kv)
     fn = _build_flash_attention(
-        b, h, hk, seq_q, seq_kv, d, bq, bkv, bool(causal), sm_scale,
-        float(soft_cap), jnp.dtype(q.dtype),
+        b, h, hk, seq_q, seq_kv, d, bq, bkv, bool(causal),
+        segment_ids is not None, sm_scale, float(soft_cap),
+        jnp.dtype(q.dtype),
     )
-    out = fn(
+    args = [
         q.reshape(b * h, seq_q, d),
         k.reshape(b * hk, seq_kv, d),
         v.reshape(b * hk, seq_kv, d),
-    )
+    ]
+    if segment_ids is not None:
+        segs = segment_ids.astype(jnp.int32)
+        args += [segs, segs]
+    out = fn(*args)
     return out.reshape(b, h, seq_q, d)
 
 

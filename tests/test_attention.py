@@ -4,6 +4,7 @@ split-KV decode with state merging, RoPE."""
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from triton_distributed_tpu.ops.attention import (
@@ -163,9 +164,6 @@ def test_rope_relative_property():
         kr = apply_rope_at(k, jnp.array([pk]))
         return (qr * kr).sum()
     assert jnp.allclose(score(5, 3), score(25, 23), atol=1e-4, rtol=1e-4)
-
-
-import numpy as np
 
 
 @pytest.mark.parametrize("causal", [True, False])

@@ -99,15 +99,19 @@ class TPAttn:
 
     # -- forward ----------------------------------------------------------
 
-    def _local_attention(self, qkv, q_norm, k_norm, batch: int, seq: int):
+    def _local_attention(self, qkv, q_norm, k_norm, batch: int, seq: int,
+                         segment_ids=None):
         """Per-rank: split rank-local [q_r | k_r | v_r] columns, QK-norm,
-        RoPE, causal flash-attention over this rank's heads."""
+        RoPE, causal flash-attention over this rank's heads.  With
+        ``segment_ids`` (B, S), the batch is a PACKED varlen batch: RoPE
+        positions restart at each segment boundary and attention is
+        confined to the segment (the reference's cu_seqlens path)."""
         n = self.tp
         h_loc = self.num_heads // n
         hk_loc = self.num_kv_heads // n
         d = self.head_dim
 
-        def local(qkv_loc):
+        def body(qkv_loc, segs):
             q, k, v = jnp.split(
                 qkv_loc, [h_loc * d, (h_loc + hk_loc) * d], axis=-1
             )
@@ -119,32 +123,53 @@ class TPAttn:
             if self.qk_norm_eps is not None:
                 q = rms_norm(q, q_norm, self.qk_norm_eps)
                 k = rms_norm(k, k_norm, self.qk_norm_eps)
-            pos = jnp.arange(seq)
+            if segs is None:
+                pos = jnp.arange(seq)
+            else:
+                # positions restart per segment: index - running seg start
+                idx = jnp.arange(seq)
+                is_start = jnp.concatenate(
+                    [jnp.ones((batch, 1), bool),
+                     segs[:, 1:] != segs[:, :-1]], axis=1,
+                )
+                seg_start = jax.lax.cummax(
+                    jnp.where(is_start, idx[None], 0), axis=1
+                )
+                pos = (idx[None] - seg_start)[:, None, :]   # (B, 1, S)
             q = apply_rope_at(q, pos, theta=self.rope_theta)
             k = apply_rope_at(k, pos, theta=self.rope_theta)
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(q, k, v, causal=True, segment_ids=segs)
             return out.transpose(0, 2, 1, 3).reshape(batch * seq, h_loc * d)
 
         # check_vma off: the Pallas flash kernel's outputs carry no vma
+        if segment_ids is None:
+            return jax.shard_map(
+                lambda qkv_loc: body(qkv_loc, None), mesh=self.mesh,
+                in_specs=P(None, self.axis), out_specs=P(None, self.axis),
+                check_vma=False,
+            )(qkv)
         return jax.shard_map(
-            local, mesh=self.mesh,
-            in_specs=P(None, self.axis), out_specs=P(None, self.axis),
+            body, mesh=self.mesh,
+            in_specs=(P(None, self.axis), P(None, None)),
+            out_specs=P(None, self.axis),
             check_vma=False,
-        )(qkv)
+        )(qkv, segment_ids.astype(jnp.int32))
 
     def forward(self, params: TPAttnParams, x: jax.Array,
-                batch: int = 1) -> jax.Array:
+                batch: int = 1, *,
+                segment_ids: jax.Array | None = None) -> jax.Array:
         """AG-GEMM -> local attention -> GEMM-RS (reference
         ``dist_triton_fwd``).
 
         ``x``: (M, K) sharded on dim 0, M = batch * seq flattened tokens.
+        ``segment_ids``: optional (batch, seq) for packed varlen batches.
         Returns (M, K) sharded on dim 0.
         """
         m, _ = x.shape
         seq = m // batch
         qkv = ag_gemm(x, params.wqkv, self.mesh, self.axis)
         attn = self._local_attention(qkv, params.q_norm, params.k_norm,
-                                     batch, seq)
+                                     batch, seq, segment_ids)
         return gemm_rs(attn, params.wo, self.mesh, self.axis)
 
     def forward_ar(self, params: TPAttnParams, x: jax.Array,

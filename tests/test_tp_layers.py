@@ -161,13 +161,44 @@ def test_tp_attn_varlen_packed():
     # golden: each sequence alone through the same layer (plain forward)
     start = 0
     for seg_len in lens:
+        # segment lengths are chosen divisible by the mesh size (the
+        # fused ops' M % n constraint); odd lengths would need padding
         piece = x[start:start + seg_len]
-        # pad to a divisible row count for the mesh if needed
         alone = layer.forward(
             params, shard(mesh, piece, TP_AXIS, None), batch=1
         )
         np.testing.assert_allclose(
             packed[start:start + seg_len], np.asarray(jax.device_get(alone)),
             atol=2e-4, rtol=2e-4,
+        )
+        start += seg_len
+
+
+def test_tp_attn_varlen_packed_ar_path():
+    """The AR (replicated small-M) forward handles packed batches too."""
+    import numpy as np
+
+    n, h, hk, d, hidden = 2, 4, 2, 32, 64
+    lens = [16, 8]
+    seq = sum(lens)
+    mesh = _mesh(n)
+    layer = TPAttn(mesh, num_heads=h, num_kv_heads=hk, head_dim=d,
+                   axis=TP_AXIS)
+    params = layer.init(jax.random.key(22), hidden, dtype=jnp.float32,
+                        scale=0.2)
+    rng = np.random.default_rng(23)
+    x = jnp.asarray(rng.standard_normal((seq, hidden)).astype(np.float32)
+                    * 0.3)
+    seg = np.zeros((1, seq), np.int32)
+    seg[0, lens[0]:] = 1
+    packed = np.asarray(jax.device_get(
+        layer.forward_ar(params, x, batch=1, segment_ids=jnp.asarray(seg))
+    ))
+    start = 0
+    for seg_len in lens:
+        alone = layer.forward_ar(params, x[start:start + seg_len], batch=1)
+        np.testing.assert_allclose(
+            packed[start:start + seg_len],
+            np.asarray(jax.device_get(alone)), atol=2e-4, rtol=2e-4,
         )
         start += seg_len

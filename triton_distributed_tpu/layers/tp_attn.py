@@ -173,15 +173,17 @@ class TPAttn:
         return gemm_rs(attn, params.wo, self.mesh, self.axis)
 
     def forward_ar(self, params: TPAttnParams, x: jax.Array,
-                   batch: int = 1) -> jax.Array:
+                   batch: int = 1, *,
+                   segment_ids: jax.Array | None = None) -> jax.Array:
         """Local GEMM -> local attention -> fused GEMM+AllReduce (reference
         ``dist_triton_AR_fwd``; small-M path).
 
-        ``x``: (M, K) replicated.  Returns (M, K) replicated.
+        ``x``: (M, K) replicated.  ``segment_ids``: optional (batch, seq)
+        for packed varlen batches.  Returns (M, K) replicated.
         """
         m, _ = x.shape
         seq = m // batch
         qkv = replicated_column_gemm(self.mesh, self.axis, x, params.wqkv)
         attn = self._local_attention(qkv, params.q_norm, params.k_norm,
-                                     batch, seq)
+                                     batch, seq, segment_ids)
         return gemm_ar(attn, params.wo, self.mesh, self.axis)

@@ -1,7 +1,9 @@
 #!/usr/bin/env python
-"""Driver benchmark: one JSON line on stdout.
+"""Driver benchmark: one JSON line per metric on stdout.
 
-On a single real TPU chip the distributed overlap cannot be exercised, so the
+``auto`` sweeps the whole single-chip perf surface — GEMM at three shape
+classes, flash attention, split-KV decode, the TP MLP layer, and the grouped
+(MoE) matmul — emitting one JSON line each, headline GEMM first.  The
 headline single-chip metric is the framework's MXU matmul pipeline (the inner
 loop of AG-GEMM / GEMM-RS, tutorial-07 shapes: hidden=7168 bf16) measured as
 TFLOP/s against the XLA ``jnp.matmul`` baseline.  ``vs_baseline`` is the
@@ -72,10 +74,11 @@ def _median_ratio(times: dict, num: str, den: str) -> float:
     )
 
 
-def bench_single_chip():
+def bench_single_chip(m: int = 7168, n: int = 7168, k: int = 7168,
+                      rounds: int = 15):
+    # default: tutorial-07 hidden size, square problem
     from triton_distributed_tpu.ops.matmul import matmul
 
-    m = n = k = 7168  # tutorial-07 hidden size, square problem
     key = jax.random.PRNGKey(0)
     a = jax.random.normal(key, (m, k), dtype=jnp.bfloat16)
     b = jax.random.normal(jax.random.fold_in(key, 1), (k, n), dtype=jnp.bfloat16)
@@ -87,10 +90,12 @@ def bench_single_chip():
     times = _bench_interleaved({
         "ours": lambda: matmul(a, b),
         "xla": lambda: xla(a, b),
-    }, rounds=15)
+    }, rounds=rounds)
     tflops = flops / _median(times["ours"]) / 1e12
+    name = ("single_chip_gemm_7168_bf16" if m == n == k == 7168
+            else f"single_chip_gemm_m{m}_n{n}_k{k}_bf16")
     return {
-        "metric": "single_chip_gemm_7168_bf16",
+        "metric": name,
         "value": round(tflops, 2),
         "unit": "TFLOP/s",
         "vs_baseline": round(_median_ratio(times, "xla", "ours"), 4),
@@ -282,29 +287,56 @@ def bench_decode():
     }
 
 
+_EMIT_FAILED = False
+
+
+def _emit(fn, *args, **kw):
+    """Run one bench and print its JSON line immediately (partial results
+    survive a later mode crashing / the driver timing out)."""
+    import sys
+    import traceback
+
+    global _EMIT_FAILED
+    try:
+        print(json.dumps(fn(*args, **kw)), flush=True)
+    except Exception:  # keep the remaining modes alive, but fail the run
+        _EMIT_FAILED = True
+        traceback.print_exc(file=sys.stderr)
+
+
 def main():
     import sys
 
     mode = sys.argv[1] if len(sys.argv) > 1 else "auto"
     if mode == "attn":
-        result = bench_attention()
+        print(json.dumps(bench_attention()))
     elif mode == "mlp":
-        result = bench_tp_mlp()
+        print(json.dumps(bench_tp_mlp()))
     elif mode == "gemm":
-        result = bench_single_chip()
+        print(json.dumps(bench_single_chip()))
     elif mode == "moe":
-        result = bench_group_gemm()
+        print(json.dumps(bench_group_gemm()))
     elif mode == "decode":
-        result = bench_decode()
-    elif mode == "auto" and jax.device_count() > 1:
-        result = bench_multi_chip()
+        print(json.dumps(bench_decode()))
     elif mode == "auto":
-        result = bench_single_chip()
+        # whole perf surface, one JSON line per mode; headline GEMM first
+        _emit(bench_single_chip)
+        _emit(bench_single_chip, 4096, 4096, 4096, rounds=9)
+        _emit(bench_single_chip, 8192, 2048, 7168, rounds=9)
+        _emit(bench_attention)
+        _emit(bench_decode)
+        _emit(bench_tp_mlp)
+        _emit(bench_group_gemm)
+        if jax.device_count() > 1:
+            _emit(bench_multi_chip)
+        if _EMIT_FAILED:
+            # partial lines already flushed; the exit code must still
+            # reflect that some modes crashed
+            sys.exit(1)
     else:
         raise SystemExit(
             f"unknown bench mode {mode!r} (auto|gemm|attn|mlp|moe|decode)"
         )
-    print(json.dumps(result))
 
 
 if __name__ == "__main__":

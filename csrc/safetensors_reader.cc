@@ -342,16 +342,42 @@ StFile* st_open(const char* path) {
   // validate every tensor before handing out pointers
   for (const Tensor& t : f->tensors) {
     size_t es = dtype_size(t.dtype);
+    if (es == 0) {
+      g_error = "inconsistent tensor entry: " + t.name;
+      st_close(f);
+      return nullptr;
+    }
+    // cap the element count at data_len / es as it is built up, so an
+    // adversarial shape cannot wrap count * es around 64 bits and slip
+    // past the byte-range consistency check below.  A zero dimension makes
+    // the exact product 0 regardless of the other dims, so it must not
+    // trip the prefix-product guard (the numpy fallback computes the exact
+    // bigint product; the readers must agree on such shapes).
+    const unsigned long long max_count = f->data_len / es;
     unsigned long long count = 1;
+    bool bad = false;
+    bool has_zero_dim = false;
     for (long long d : t.shape) {
       if (d < 0) {
         g_error = "negative dimension in tensor " + t.name;
         st_close(f);
         return nullptr;
       }
-      count *= static_cast<unsigned long long>(d);
+      if (d == 0) has_zero_dim = true;
     }
-    if (es == 0 || t.end < t.begin || t.end > f->data_len ||
+    if (has_zero_dim) {
+      count = 0;
+    } else {
+      for (long long d : t.shape) {
+        const unsigned long long ud = static_cast<unsigned long long>(d);
+        if (count > max_count / ud) {
+          bad = true;
+          break;
+        }
+        count *= ud;
+      }
+    }
+    if (bad || t.end < t.begin || t.end > f->data_len ||
         t.end - t.begin != count * es) {
       g_error = "inconsistent tensor entry: " + t.name;
       st_close(f);

@@ -129,6 +129,74 @@ def test_dispatch_repeat_invocations():
     )
 
 
+def test_combine_direct_grad_zeroes_padding_rows():
+    """Differentiating ep_combine directly must put ZERO cotangent on zone
+    padding rows: dispatch's chunk-rounded DMAs drag neighboring rows into
+    zone tails, so an unmasked adjoint would hand garbage gradients to any
+    caller whose padding rows feed real computation downstream."""
+    n, t, h, e_tot = 4, 32, 128, 8
+    x, splits, _ = _make_case(n, t, h, e_tot, seed=11)
+    mesh = _mesh(n)
+    xs, ss = _shard(mesh, x, splits)
+    recv, _ = ep_dispatch(xs, ss, mesh, config=CFG)
+
+    def f(y):
+        return (ep_combine(y, ss, mesh, token_dim=t, config=CFG) ** 2).sum()
+
+    dy = np.asarray(jax.device_get(jax.grad(f)(recv)))
+    # real rows carry 2*y; padding rows carry exactly zero
+    sp = np.asarray(splits).reshape(n, n, e_tot // n)
+    y_np = np.asarray(jax.device_get(recv))
+    for dst in range(n):
+        for src in range(n):
+            cnt = sp[src, dst].sum()
+            zone = dst * n + src
+            np.testing.assert_allclose(
+                dy[zone, :cnt], 2.0 * y_np[zone, :cnt], rtol=1e-5
+            )
+            np.testing.assert_array_equal(
+                dy[zone, cnt:], np.zeros_like(dy[zone, cnt:])
+            )
+
+
+def test_dispatch_direct_grad_zeroes_padding_token_rows():
+    """The mirror of the combine-grad property: with T = static worst case
+    above the real token count, differentiating ep_dispatch must put ZERO
+    cotangent on the padding token rows — combine's repack would otherwise
+    clip them onto the last peer's zone tail and gather chunk spillover."""
+    n, t, h, e_tot = 4, 32, 128, 8
+    real = 19                      # real rows per rank; rows [19, 32) pad
+    rng = np.random.default_rng(12)
+    splits_np = []
+    for _ in range(n):
+        w = rng.random(e_tot)
+        s = np.floor(w / w.sum() * real).astype(np.int32)
+        s[0] += real - s.sum()
+        splits_np.append(s)
+    splits = jnp.asarray(np.concatenate(splits_np))
+    x = jnp.asarray(rng.standard_normal((n * t, h)), jnp.float32)
+    mesh = _mesh(n)
+    xs, ss = _shard(mesh, x, splits)
+
+    sp = np.asarray(splits).reshape(n, n, e_tot // n)
+    zone_valid = sp.sum(-1).T.reshape(n * n)   # [dst*n + src] real rows
+
+    def f(x_):
+        recv, _ = ep_dispatch(x_, ss, mesh, config=CFG)
+        rows = jnp.arange(recv.shape[1])
+        mask = rows[None, :] < jnp.asarray(zone_valid)[:, None]
+        return ((recv * mask[:, :, None]) ** 2).sum()
+
+    dx = np.asarray(jax.device_get(jax.grad(f)(xs))).reshape(n, t, h)
+    x_np = np.asarray(x).reshape(n, t, h)
+    for r in range(n):
+        np.testing.assert_allclose(dx[r, :real], 2.0 * x_np[r, :real],
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(
+            dx[r, real:], np.zeros_like(dx[r, real:])
+        )
+
+
 def test_single_rank_fallback():
     n, t, h, e_tot = 1, 16, 64, 4
     x, splits, _ = _make_case(n, t, h, e_tot, seed=5)

@@ -174,6 +174,63 @@ def test_safetensors_corrupt_header(tmp_path):
             SafetensorsFile(path, native=native)
 
 
+def _write_raw_safetensors(path, header: dict, payload: bytes):
+    import json
+
+    hjson = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(len(hjson).to_bytes(8, "little"))
+        f.write(hjson)
+        f.write(payload)
+
+
+@pytest.mark.parametrize("native", [True, False])
+@pytest.mark.parametrize(
+    "offsets,shape",
+    [
+        ((0, 64), (4,)),        # byte range disagrees with shape product
+        ((0, 1024), (256,)),    # end beyond the data section
+        ((32, 16), (4,)),       # end before begin
+        # count = 2**62 + 4, so count * 4 wraps 64 bits to exactly 16:
+        # the consistency check must not be fooled by the wrapped product
+        ((0, 16), (4, 2**60 + 1)),
+    ],
+)
+def test_safetensors_rejects_inconsistent_offsets(tmp_path, native,
+                                                  offsets, shape):
+    """Both readers reject malformed data_offsets identically (the numpy
+    fallback must not clamp through slicing or accept overlaps)."""
+    from triton_distributed_tpu.models.safetensors_io import SafetensorsFile
+
+    path = str(tmp_path / "bad_offsets.safetensors")
+    _write_raw_safetensors(
+        path,
+        {"t": {"dtype": "F32", "shape": list(shape),
+               "data_offsets": list(offsets)}},
+        b"\x00" * 64,
+    )
+    with pytest.raises(ValueError):
+        SafetensorsFile(path, native=native)
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_safetensors_zero_element_huge_dim_parity(tmp_path, native):
+    """A zero-element tensor with a huge sibling dimension is consistent
+    (count = 0, empty byte range) — BOTH readers must accept it; the native
+    overflow guard must not trip on the prefix product."""
+    from triton_distributed_tpu.models.safetensors_io import SafetensorsFile
+
+    path = str(tmp_path / "zero_dim.safetensors")
+    _write_raw_safetensors(
+        path,
+        {"t": {"dtype": "F32", "shape": [2**40, 0],
+               "data_offsets": [0, 0]}},
+        b"",
+    )
+    sf = SafetensorsFile(path, native=native)
+    assert sf["t"].size == 0 and sf["t"].shape == (2**40, 0)
+
+
 def test_load_state_dict_sharded_index(tmp_path):
     """HF-style sharded checkpoint: two .safetensors files + index.json."""
     from triton_distributed_tpu.models.safetensors_io import (

@@ -156,7 +156,24 @@ class SafetensorsFile(Mapping):
             if tag not in table:
                 raise ValueError(f"{path}: unsupported dtype {tag!r}")
             a, b = info["data_offsets"]
-            arr = raw[a:b].view(table[tag]).reshape(tuple(info["shape"]))
+            shape = tuple(info["shape"])
+            # mirror the native reader's validation: out-of-range offsets
+            # would otherwise clamp through slicing and surface as an opaque
+            # reshape error; overlaps/mismatches would be silently accepted
+            count = 1
+            for d in shape:
+                if d < 0:
+                    raise ValueError(
+                        f"{path}: negative dimension in tensor {name!r}"
+                    )
+                count *= d
+            itemsize = np.dtype(table[tag]).itemsize
+            if not (0 <= a <= b <= raw.size) or b - a != count * itemsize:
+                raise ValueError(
+                    f"{path}: inconsistent tensor entry {name!r} "
+                    f"(offsets [{a}, {b}), shape {shape})"
+                )
+            arr = raw[a:b].view(table[tag]).reshape(shape)
             self._arrays[name] = arr
 
     def __getitem__(self, name: str) -> np.ndarray:

@@ -168,11 +168,19 @@ def _gm_bwd(cfg, out_dtype, res, dy):
     import numpy as np
 
     x_sorted, w, splits = res
+    # accumulate the backward matmuls at the wider of cotangent and input
+    # dtype: an f32 dy over bf16 inputs keeps its precision (not truncated
+    # at entry), and a bf16 dy over f32 inputs still accumulates in f32;
+    # jax.vjp casts dx/dw back to the primal dtypes on the way out
+    acc_dtype = jnp.promote_types(dy.dtype, x_sorted.dtype)
     _, vjp = jax.vjp(
-        lambda x_, w_: jax.lax.ragged_dot(x_, w_, splits.astype(jnp.int32)),
+        lambda x_, w_: jax.lax.ragged_dot(
+            x_, w_, splits.astype(jnp.int32),
+            preferred_element_type=acc_dtype,
+        ),
         x_sorted, w,
     )
-    dx, dw = vjp(dy.astype(x_sorted.dtype))
+    dx, dw = vjp(dy.astype(acc_dtype))
     d_splits = np.zeros(splits.shape, dtype=jax.dtypes.float0)
     return dx, dw, d_splits
 

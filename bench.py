@@ -35,21 +35,18 @@ def _bench_interleaved(engines: dict, iters: int = 64, rounds: int = 9) -> dict:
     fixed sync/tunnel round-trip cancels (see core.utils.perf_func).  The
     first round lands on the post-compile thermal ramp and is discarded.
     """
-    from triton_distributed_tpu.core.utils import sync, timed_run
+    from triton_distributed_tpu.core.utils import (
+        interleaved_slope_samples, sync, timed_run,
+    )
 
     for fn in engines.values():  # warmup/compile
         sync(fn())
-    times = {name: [] for name in engines}
-    for r in range(rounds):
-        # alternate engine order between rounds so a monotonic thermal
-        # drift biases neither engine
-        order = list(engines.items())
-        if r % 2:
-            order.reverse()
-        for name, fn in order:
-            dt = (timed_run(fn, 1 + iters) - timed_run(fn, 1)) / iters
-            # negative slope = sync noise swamped the round
-            times[name].append(dt if dt > 0 else float("nan"))
+    raw = interleaved_slope_samples(engines, iters, rounds)
+    # negative slope = sync noise swamped the round
+    times = {
+        name: [dt if dt > 0 else float("nan") for dt in xs]
+        for name, xs in raw.items()
+    }
     for name in engines:
         if len(times[name]) > 1:
             times[name] = times[name][1:]  # drop the ramp round
@@ -163,7 +160,7 @@ def bench_attention():
     times = _bench_interleaved({
         "ours": lambda: flash_attention(q, k, v, causal=True),
         "xla": lambda: xla_attn(q, k, v),
-    }, iters=16)
+    }, iters=32)
     # causal flash does ~half the full-matrix FLOPs; count the real work
     flops = 4.0 * b * h * s * s * d / 2
     tflops = flops / _median(times["ours"]) / 1e12
@@ -236,6 +233,9 @@ def bench_group_gemm():
     splits = jnp.asarray([2048, 512, 1536, 0, 1024, 1408, 640, 1024],
                          jnp.int32)
 
+    # one eager call first: the transparent autotuner may only MEASURE
+    # eagerly — the jit'd trace below then picks up the cached winner
+    jax.block_until_ready(grouped_matmul(x, w, splits))
     ours = jax.jit(lambda x, w, s: grouped_matmul(x, w, s))
     ragged = jax.jit(lambda x, w, s: jax.lax.ragged_dot(x, w, s))
     times = _bench_interleaved({
@@ -304,6 +304,48 @@ def _emit(fn, *args, **kw):
         traceback.print_exc(file=sys.stderr)
 
 
+def bench_decode_modes(batch: int = 128):
+    """Full-model decode step, psum-reduction mode vs the Pallas fast-AR
+    mode (the reference's headline decode win: GEMM + fast AR 1.27-1.37x at
+    B=128-4096, ``e2e_dense.md`` "GEMM + AllReduce" table).  On one chip the
+    mesh degenerates to tp=1 (both modes local — ratio ~1.0); on a slice the
+    ratio measures the fast-AR path end to end.  ``vs_baseline`` =
+    psum-mode time / ar-mode time (>1 means the AR kernels win)."""
+    import numpy as np
+
+    from triton_distributed_tpu.core import mesh as mesh_lib
+    from triton_distributed_tpu.models import Engine, ModelConfig
+
+    mesh = mesh_lib.tp_mesh()
+    ntp = mesh.shape["tp"]
+    cfg = ModelConfig(
+        num_layers=4, hidden=2048, intermediate=4096, num_heads=16,
+        num_kv_heads=8, head_dim=128, vocab=8192, max_length=256,
+        dtype=jnp.bfloat16,
+    )
+    engines = {}
+    steps = {}
+    for mode in ("psum", "ar"):
+        eng = Engine.build(cfg, mesh, key=jax.random.key(0), batch=batch,
+                           decode_mode=mode)
+        ids = jnp.asarray(
+            np.random.default_rng(1).integers(0, cfg.vocab, (batch, 64)),
+            jnp.int32,
+        )
+        eng.prefill(ids)
+        tok = jnp.zeros((batch,), jnp.int32)
+        engines[mode] = eng
+        steps[mode] = lambda eng=eng, tok=tok: eng.decode_step(tok)
+    times = _bench_interleaved(steps, iters=16, rounds=9)
+    ms = _median(times["ar"]) * 1e3
+    return {
+        "metric": f"qwen_decode_step_b{batch}_tp{ntp}_psum_vs_ar",
+        "value": round(ms, 3),
+        "unit": "ms/step (ar mode)",
+        "vs_baseline": round(_median_ratio(times, "psum", "ar"), 4),
+    }
+
+
 def main():
     import sys
 
@@ -318,6 +360,8 @@ def main():
         print(json.dumps(bench_group_gemm()))
     elif mode == "decode":
         print(json.dumps(bench_decode()))
+    elif mode == "decode_modes":
+        print(json.dumps(bench_decode_modes()))
     elif mode == "auto":
         # whole perf surface, one JSON line per mode; headline GEMM first
         _emit(bench_single_chip)
@@ -327,6 +371,7 @@ def main():
         _emit(bench_decode)
         _emit(bench_tp_mlp)
         _emit(bench_group_gemm)
+        _emit(bench_decode_modes)
         if jax.device_count() > 1:
             _emit(bench_multi_chip)
         if _EMIT_FAILED:
@@ -335,7 +380,8 @@ def main():
             sys.exit(1)
     else:
         raise SystemExit(
-            f"unknown bench mode {mode!r} (auto|gemm|attn|mlp|moe|decode)"
+            f"unknown bench mode {mode!r} "
+            "(auto|gemm|attn|mlp|moe|decode|decode_modes)"
         )
 
 

@@ -89,6 +89,104 @@ def test_tuned_matmul_correct(tmp_path, monkeypatch):
                        rtol=1e-4)
 
 
+def test_transparent_matmul_uses_cached_winner(tmp_path, monkeypatch):
+    """With config=None, ops consult the persisted winner cache — a prior
+    tuned run teaches later (including jit'd) calls with zero code change;
+    with no cache entry under tracing/interpret, the static default holds
+    (VERDICT next #5)."""
+    import jax
+
+    from triton_distributed_tpu.ops import matmul as mm
+    from triton_distributed_tpu.tune import autotuner as at
+
+    monkeypatch.setattr(at, "_GLOBAL", at.Autotuner(path=str(tmp_path / "w.json")))
+    monkeypatch.setenv("TDT_AUTOTUNE", "0")   # never measure in this test
+
+    built = []
+    real_build = mm._build_matmul
+
+    def spy(m, n, k, bm, bn, bk, dtype, out_dtype):
+        built.append((bm, bn, bk))
+        return real_build(m, n, k, bm, bn, bk, dtype, out_dtype)
+
+    monkeypatch.setattr(mm, "_build_matmul", spy)
+
+    m, n, k = 512, 1024, 512
+    a = jax.random.normal(jax.random.key(0), (m, k), jnp.float32)
+    b = jax.random.normal(jax.random.key(1), (k, n), jnp.float32)
+
+    # no cache entry: default (512, 1792, 512) tiles, bn clipped to 1024
+    mm.matmul(a, b)
+    assert built[-1] == (512, 1024, 512)
+
+    # plant a DIFFERENT winner (no clipping at these dims) and check both
+    # eager and traced calls pick it up from disk
+    cands = at.matmul_tile_candidates(m, n, k)
+    if (512, 1792, 512) not in cands:   # resolve_config prepends the default
+        cands = [(512, 1792, 512), *cands]
+    target = (256, 512, 512)
+    idx = cands.index(target)
+    key = ("matmul", (m, n, k, str(a.dtype), at.platform.device_kind()))
+    at._GLOBAL._load_disk()[at._cache_key(key[0], key[1], cands)] = idx
+    at._GLOBAL._save_disk()
+    # fresh tuner (new process analogue) reads the planted winner from disk
+    monkeypatch.setattr(at, "_GLOBAL", at.Autotuner(path=str(tmp_path / "w.json")))
+
+    mm.matmul(a, b)                                   # eager
+    assert built[-1] == target
+
+    jax.jit(lambda a, b: mm.matmul(a, b))(a, b)       # traced: same winner
+    assert built[-1] == target
+
+
+def test_transparent_ag_gemm_cache_consult(tmp_path, monkeypatch):
+    """config=None on the fused collective consults the same cache keys the
+    explicit tuned_ag_gemm writes, including under jit tracing."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import sys
+
+    import triton_distributed_tpu.ops.ag_gemm  # noqa: F401
+
+    from triton_distributed_tpu.core.mesh import TP_AXIS, make_mesh
+    from triton_distributed_tpu.tune import autotuner as at
+    from triton_distributed_tpu.tune import tuned_ag_gemm
+
+    agg = sys.modules["triton_distributed_tpu.ops.ag_gemm"]
+
+    monkeypatch.setattr(at, "_GLOBAL", at.Autotuner(path=str(tmp_path / "c.json")))
+    mesh = make_mesh({TP_AXIS: 2}, devices=jax.devices()[:2])
+    m, k, n = 64, 96, 80
+    a = jax.device_put(
+        jax.random.normal(jax.random.key(0), (m, k), jnp.float32) * 0.1,
+        NamedSharding(mesh, P(TP_AXIS, None)))
+    b = jax.device_put(
+        jax.random.normal(jax.random.key(1), (k, n), jnp.float32) * 0.1,
+        NamedSharding(mesh, P(None, TP_AXIS)))
+
+    built = []
+    real_build = agg._build_ag_gemm
+
+    def spy(mesh_, axis_, m_, k_, n_, dt, odt, cfg, bidir):
+        built.append(cfg)
+        return real_build(mesh_, axis_, m_, k_, n_, dt, odt, cfg, bidir)
+
+    monkeypatch.setattr(agg, "_build_ag_gemm", spy)
+
+    tuned_ag_gemm(a, b, mesh, TP_AXIS)        # measures, persists winner
+    winner = built[-1]
+
+    built.clear()
+    out = jax.jit(
+        lambda a, b: agg.ag_gemm(a, b, mesh, TP_AXIS)
+    )(a, b)                                    # traced, config=None
+    assert built and built[-1] == winner
+    want = np.asarray(jax.device_get(a)) @ np.asarray(jax.device_get(b))
+    assert np.allclose(np.asarray(jax.device_get(out)), want, atol=1e-3,
+                       rtol=1e-3)
+
+
 def test_tuned_collective_ops_correct(tmp_path, monkeypatch):
     """tuned_ag_gemm / tuned_gemm_rs sweep real collective invocations and
     return correct results with the winning config."""

@@ -124,6 +124,64 @@ def test_decode_continues_prefill():
         )
 
 
+# 8 kv heads so the model shards over the full 8-mesh (CFG's 4 heads max
+# out at tp=2); dims keep every decode-path divisibility: B=8 % 8 == 0
+# takes gemm_ar's fused ring, B=3 exercises its fast-AR fallback
+CFG8 = ModelConfig(
+    num_layers=2, hidden=128, intermediate=256, num_heads=8, num_kv_heads=8,
+    head_dim=32, vocab=128, max_length=64, dtype=jnp.float32,
+)
+
+
+@pytest.mark.parametrize("batch", [3, 8])
+def test_decode_modes_logits_parity(mesh8, batch):
+    """psum / ar / gemm_ar decode produce the same logits on the 8-mesh
+    (the reference's set_fwd modes agree; ``e2e_dense.md`` check mode)."""
+    mesh = mesh8
+    params = Qwen3(CFG8, mesh).init(jax.random.key(11), scale=0.05)
+    # B*S must divide the 8-way sequence sharding of prefill activations
+    ids = jax.random.randint(jax.random.key(12), (batch, 16), 0, CFG8.vocab)
+    step = jax.random.randint(jax.random.key(13), (batch,), 0, CFG8.vocab)
+
+    logits = {}
+    for mode in ("psum", "ar", "gemm_ar"):
+        model = Qwen3(CFG8, mesh, decode_mode=mode)
+        cache = init_cache(mesh, CFG8.num_layers, batch, CFG8.num_kv_heads,
+                           CFG8.max_length, CFG8.head_dim, CFG8.dtype)
+        # jit the steps: eager shard_map on the full 8-mesh starves the
+        # interpret-mode client threads (minutes/step); compiled it's seconds
+        _, cache = jax.jit(model.prefill)(params, cache, ids)
+        out, cache = jax.jit(model.decode)(params, cache, step)
+        logits[mode] = np.asarray(jax.device_get(out))
+        assert int(cache.kv_len) == 17
+    for mode in ("ar", "gemm_ar"):
+        assert np.allclose(logits["psum"], logits[mode],
+                           atol=2e-3, rtol=2e-3), (
+            mode, np.abs(logits["psum"] - logits[mode]).max()
+        )
+
+
+def test_decode_mode_validation():
+    with pytest.raises(ValueError):
+        Qwen3(CFG, _mesh(1), decode_mode="nope")
+
+
+def test_engine_decode_mode_switch():
+    """Engine.set_decode_mode mid-stream: greedy continuations agree
+    across the reduction implementations (reference engine swapping
+    set_fwd between captures)."""
+    mesh = _mesh(2)
+    eng = Engine.build(CFG, mesh, key=jax.random.key(14), batch=2)
+    ids = jax.random.randint(jax.random.key(15), (2, 8), 0, CFG.vocab)
+    toks_psum = np.asarray(eng.generate(ids, 4))
+    eng.set_decode_mode("ar")
+    toks_ar = np.asarray(eng.generate(ids, 4))
+    np.testing.assert_array_equal(toks_psum, toks_ar)
+    eng.set_decode_mode("gemm_ar")
+    toks_gar = np.asarray(eng.generate(ids, 4))
+    np.testing.assert_array_equal(toks_psum, toks_gar)
+
+
 def test_engine_generate_greedy_deterministic():
     n = 2
     mesh = _mesh(n)

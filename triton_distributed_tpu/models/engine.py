@@ -23,7 +23,7 @@ from jax.sharding import Mesh
 
 from ..core.mesh import TP_AXIS
 from .config import ModelConfig
-from .kv_cache import KVCache, init_cache, reset
+from .kv_cache import KVCache, init_cache, init_paged_cache, reset
 from .qwen import Qwen3, QwenParams
 
 
@@ -54,20 +54,38 @@ def sample_token(
 
 @dataclasses.dataclass
 class Engine:
-    """Owns model definition, params, cache, and the compiled step fns."""
+    """Owns model definition, params, cache, and the compiled step fns.
+
+    ``cache_layout``: "contiguous" (one shared length) or "paged" (page
+    pool + block table + ragged per-sequence lengths — the reference's
+    production decode layout, ``sp_flash_decode_layer.py:83-108``)."""
 
     model: Qwen3
     params: QwenParams
     batch: int = 1
     temperature: float = 0.0
     top_p: float = 1.0
+    cache_layout: str = "contiguous"
+    page_size: int = 64
 
     def __post_init__(self):
         c = self.model.config
-        self.cache = init_cache(
-            self.model.mesh, c.num_layers, self.batch, c.num_kv_heads,
-            c.max_length, c.head_dim, c.dtype, self.model.axis,
-        )
+        if self.cache_layout == "paged":
+            self.cache = init_paged_cache(
+                self.model.mesh, c.num_layers, self.batch, c.num_kv_heads,
+                c.max_length, c.head_dim, c.dtype, self.model.axis,
+                page_size=self.page_size,
+            )
+        elif self.cache_layout == "contiguous":
+            self.cache = init_cache(
+                self.model.mesh, c.num_layers, self.batch, c.num_kv_heads,
+                c.max_length, c.head_dim, c.dtype, self.model.axis,
+            )
+        else:
+            raise ValueError(
+                f"cache_layout {self.cache_layout!r} not in "
+                "('contiguous', 'paged')"
+            )
         # the CUDA-graph analogue: jit with the cache donated so decode
         # steps update the cache buffers in place
         self._prefill = jax.jit(self.model.prefill, donate_argnums=(1,))
@@ -75,10 +93,21 @@ class Engine:
 
     @classmethod
     def build(cls, config: ModelConfig, mesh: Mesh, *, key=None,
-              batch: int = 1, axis: str = TP_AXIS, **kw) -> "Engine":
-        model = Qwen3(config, mesh, axis)
+              batch: int = 1, axis: str = TP_AXIS,
+              decode_mode: str = "psum", **kw) -> "Engine":
+        """``decode_mode``: "psum" | "ar" | "gemm_ar" — the decode-step
+        reduction implementation (reference ``set_fwd``); see
+        :class:`Qwen3`."""
+        model = Qwen3(config, mesh, axis, decode_mode=decode_mode)
         params = model.init(key if key is not None else jax.random.key(0))
         return cls(model, params, batch=batch, **kw)
+
+    def set_decode_mode(self, mode: str) -> None:
+        """Swap the decode-step reduction implementation in place (the
+        reference's ``set_fwd`` switch, ``models/qwen.py:85``).  Params and
+        cache are kept; the decode step re-jits on next call."""
+        self.model = dataclasses.replace(self.model, decode_mode=mode)
+        self._decode = jax.jit(self.model.decode, donate_argnums=(1,))
 
     def prefill(self, input_ids: jax.Array) -> jax.Array:
         """Run the prompt; returns last-position logits (B, V)."""

@@ -1,4 +1,4 @@
-"""KV cache as a functional pytree, head-sharded over TP.
+"""KV caches as functional pytrees, head-sharded over TP.
 
 Reference: ``python/triton_dist/models/kv_cache.py:29`` — preallocated
 (L, B, max_len, Hkv/world, D) tensors plus a device offset, mutated in
@@ -8,6 +8,20 @@ sharded ``P(None, None, tp, None, None)`` on the head axis; updates are
 cache — XLA keeps the write local to each rank), and in-place semantics
 come from buffer donation at the jit boundary (``Engine``), the TPU
 analogue of the reference's static CUDA-graph buffers.
+
+Two layouts:
+
+- :class:`KVCache` — contiguous (L, B, Hkv, max_len, D) blocks, one shared
+  length (every sequence the same age).  Simple, fastest for lockstep
+  batches.
+- :class:`PagedKVCache` — a physical page POOL (L, P, Hkv, page_size, D)
+  plus a per-sequence ``block_table`` and RAGGED ``seq_lens`` — the
+  reference's production decode layout (``flash_decode.py:587-720``
+  ``block_table`` through ``gqa_fwd_batch_decode``;
+  ``sp_flash_decode_layer.py:83-108``), which is what realistic serving
+  (per-sequence lengths, cache reuse) needs.  Reads go through the
+  scalar-prefetch paged kernel (``ops.attention.paged_decode_attention``);
+  writes are XLA scatters into the pool.
 """
 
 from __future__ import annotations
@@ -55,17 +69,135 @@ def write_prefill(cache: KVCache, layer: int, k_new: jax.Array,
     )
 
 
-def advance(cache: KVCache, steps: jax.Array | int) -> KVCache:
+def advance(cache, steps: jax.Array | int):
+    if isinstance(cache, PagedKVCache):
+        return dataclasses.replace(
+            cache, seq_lens=cache.seq_lens + jnp.asarray(steps, jnp.int32)
+        )
     return dataclasses.replace(
         cache, kv_len=cache.kv_len + jnp.asarray(steps, jnp.int32)
     )
 
 
-def with_length(cache: KVCache, length: jax.Array | int) -> KVCache:
+def with_length(cache, length: jax.Array | int):
+    """Set the valid length(s).  For a paged cache a scalar broadcasts to
+    every sequence and a (B,) array sets ragged lengths."""
+    if isinstance(cache, PagedKVCache):
+        lens = jnp.broadcast_to(
+            jnp.asarray(length, jnp.int32), cache.seq_lens.shape
+        )
+        return dataclasses.replace(cache, seq_lens=lens)
     return dataclasses.replace(
         cache, kv_len=jnp.asarray(length, jnp.int32)
     )
 
 
-def reset(cache: KVCache) -> KVCache:
+def reset(cache):
+    if isinstance(cache, PagedKVCache):
+        return dataclasses.replace(
+            cache, seq_lens=jnp.zeros_like(cache.seq_lens)
+        )
     return dataclasses.replace(cache, kv_len=jnp.zeros((), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# paged layout
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    """k/v: (L, P, Hkv, page_size, D) physical page pools, head-sharded;
+    block_table: (B, max_pages) int32 — logical page j of sequence b lives
+    in pool page ``block_table[b, j]``; seq_lens: (B,) int32 ragged valid
+    lengths.  The table is a device array (it travels through jit), but its
+    values are expected to be stable across a generation — the engine
+    allocates the static worst case up front like the reference's
+    preallocated cache."""
+
+    k: jax.Array
+    v: jax.Array
+    block_table: jax.Array
+    seq_lens: jax.Array
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def max_pages(self) -> int:
+        return self.block_table.shape[1]
+
+
+def init_paged_cache(mesh: Mesh, num_layers: int, batch: int, kv_heads: int,
+                     max_length: int, head_dim: int, dtype=jnp.bfloat16,
+                     axis: str = TP_AXIS, *, page_size: int = 64,
+                     key: jax.Array | None = None) -> PagedKVCache:
+    """Preallocate ``batch * (max_length // page_size)`` pages and a full
+    block table.  ``key``: when given, the (sequence, logical page) ->
+    physical page map is a random bijection instead of the identity — the
+    fragmented layout a real page allocator produces, useful for tests and
+    as honest serving behavior."""
+    if max_length % page_size:
+        raise ValueError(
+            f"max_length {max_length} not divisible by page_size {page_size}"
+        )
+    mp = max_length // page_size
+    p = batch * mp
+    pool_shape = (num_layers, p, kv_heads, page_size, head_dim)
+    sharding = NamedSharding(mesh, P(None, None, axis, None, None))
+    ids = jnp.arange(p, dtype=jnp.int32)
+    if key is not None:
+        ids = jax.random.permutation(key, ids)
+    return PagedKVCache(
+        k=jax.device_put(jnp.zeros(pool_shape, dtype), sharding),
+        v=jax.device_put(jnp.zeros(pool_shape, dtype), sharding),
+        block_table=ids.reshape(batch, mp),
+        seq_lens=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def write_prefill_paged(cache: PagedKVCache, layer: int, k_new: jax.Array,
+                        v_new: jax.Array) -> PagedKVCache:
+    """Scatter a full prefill's (B, Hkv, S, D) into the page pool at
+    positions [0, S).  A partial trailing page is zero-padded; those slots
+    are masked by ``seq_lens`` and overwritten by later appends."""
+    b, hk, s, d = k_new.shape
+    ps = cache.page_size
+    npg = (s + ps - 1) // ps
+    pad = npg * ps - s
+
+    def scatter(pool, vals):
+        vals = jnp.pad(vals, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        # (B, Hkv, npg*ps, D) -> (B, npg, Hkv, ps, D) page-major updates
+        vals = vals.reshape(b, hk, npg, ps, d).transpose(0, 2, 1, 3, 4)
+        return pool.at[layer, cache.block_table[:, :npg]].set(
+            vals.astype(pool.dtype)
+        )
+
+    return dataclasses.replace(
+        cache, k=scatter(cache.k, k_new), v=scatter(cache.v, v_new)
+    )
+
+
+def append_paged(cache: PagedKVCache, layer: int, k_tok: jax.Array,
+                 v_tok: jax.Array) -> PagedKVCache:
+    """Write one decode token per sequence at its own (ragged) position
+    ``seq_lens[b]``.  ``k_tok``/``v_tok``: (B, Hkv, D).  Does NOT advance
+    ``seq_lens`` (mirror of the contiguous path: the model advances once
+    per step, after all layers)."""
+    ps = cache.page_size
+    pos = cache.seq_lens
+    pages = jnp.take_along_axis(
+        cache.block_table, (pos // ps)[:, None], axis=1
+    )[:, 0]                                            # (B,)
+    offs = pos % ps
+
+    def scatter(pool, tok):
+        # advanced indices (pages, offs) separated by the head slice put
+        # the batch axis first: target slots (B, Hkv, D)
+        return pool.at[layer, pages, :, offs].set(tok.astype(pool.dtype))
+
+    return dataclasses.replace(
+        cache, k=scatter(cache.k, k_tok), v=scatter(cache.v, v_tok)
+    )

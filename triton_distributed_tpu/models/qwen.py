@@ -11,12 +11,22 @@ criterion the reference's engine applies):
   through the fused AG-GEMM -> local flash-attn -> GEMM-RS layer path,
   the ``dist_triton_fwd`` analogue.  K/V heads computed per rank land
   directly in the head-sharded cache.
-- **decode** (M = B rows, sub-tile): replicated activations, local
-  column/row GEMMs, ``lax.psum`` for the two reductions — at one token
-  per step the payload is below tile granularity where a hand-rolled DMA
-  kernel cannot beat XLA's fused latency path (the Pallas AllReduce
-  family covers tile-size payloads; ``bench.py``).  The decode attention
-  itself is the split-KV Pallas kernel against the head-sharded cache.
+- **decode** (M = B rows): replicated activations, local column GEMMs,
+  and a row-parallel reduction whose implementation is switched by
+  ``decode_mode`` — the reference's ``set_fwd('torch'|'triton_dist'|
+  'triton_dist_AR')`` (``models/qwen.py:85,143``):
+
+  * ``"psum"`` — ``lax.psum`` after a local GEMM: XLA's fused latency
+    path, the right default at B=1 where the payload is sub-tile;
+  * ``"ar"`` — local GEMM then the Pallas fast-AllReduce family
+    (one-shot/two-shot by size), the reference's GEMM + fast-AR decode
+    configuration that wins 1.27-1.37x at B=128-4096
+    (``docs/getting-started/e2e/e2e_dense.md`` "GEMM + AllReduce");
+  * ``"gemm_ar"`` — the fully fused GEMM+AllReduce ring kernel
+    (compute hides the wire) when B divides by tp, else the "ar" path.
+
+  The decode attention itself is the split-KV Pallas kernel against the
+  head-sharded cache.
 """
 
 from __future__ import annotations
@@ -32,11 +42,23 @@ from ..layers.moe import MoEMLP, MoEParams
 from ..layers.norm import rms_norm
 from ..layers.tp_attn import TPAttn, TPAttnParams
 from ..layers.tp_mlp import TPMLP, TPMLPParams
-from ..ops import ag_gemm, gemm_rs
-from ..ops.attention import decode_attention, flash_attention
+from ..comm.allreduce import all_reduce
+from ..ops import ag_gemm, gemm_ar, gemm_rs
+from ..ops.attention import (
+    decode_attention,
+    flash_attention,
+    paged_decode_attention,
+)
 from ..ops.rope import apply_rope_at
 from .config import ModelConfig
-from .kv_cache import KVCache, advance, with_length, write_prefill
+from .kv_cache import (
+    KVCache,
+    PagedKVCache,
+    advance,
+    with_length,
+    write_prefill,
+    write_prefill_paged,
+)
 
 
 @jax.tree_util.register_dataclass
@@ -57,17 +79,66 @@ class QwenParams:
     lm_head: jax.Array        # (K, V) replicated
 
 
+DECODE_MODES = ("psum", "ar", "gemm_ar")
+
+
 @dataclasses.dataclass(frozen=True)
 class Qwen3:
-    """Static model definition; params/cache travel separately."""
+    """Static model definition; params/cache travel separately.
+
+    ``decode_mode`` switches the decode-step row-parallel reductions
+    (o-proj and MLP down-proj) between ``lax.psum`` and the Pallas
+    AllReduce kernels — the reference's ``set_fwd`` mode switch
+    (``models/qwen.py:85,143``).  Static: changing it retriggers jit.
+    """
 
     config: ModelConfig
     mesh: Mesh
     axis: str = TP_AXIS
+    decode_mode: str = "psum"
+
+    def __post_init__(self):
+        if self.decode_mode not in DECODE_MODES:
+            raise ValueError(
+                f"decode_mode {self.decode_mode!r} not in {DECODE_MODES}"
+            )
 
     @property
     def tp(self) -> int:
         return self.mesh.shape[self.axis]
+
+    def _row_parallel_reduce(self, h: jax.Array, w: jax.Array) -> jax.Array:
+        """Decode-step ``AllReduce(h @ w)``: ``h`` (B, F) sharded on dim 1
+        over ``axis``, ``w`` (F, H) row-parallel, result (B, H) replicated.
+        Dispatches on ``decode_mode`` (see class docstring)."""
+        n = self.tp
+        if (self.decode_mode == "gemm_ar" and n > 1
+                and h.shape[0] % n == 0 and h.shape[1] % n == 0):
+            # fused ring kernel: chunks M and the K dim n ways in-kernel
+            return gemm_ar(h, w, self.mesh, self.axis)
+        if self.decode_mode in ("ar", "gemm_ar") and n > 1:
+            def local(h_loc, w_loc):
+                return jnp.dot(
+                    h_loc, w_loc, preferred_element_type=jnp.float32
+                ).astype(h_loc.dtype)
+
+            partials = jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(None, self.axis), P(self.axis, None)),
+                out_specs=P(self.axis, None),
+                check_vma=False,
+            )(h, w)   # (n*B, H) stacked partials
+            return all_reduce(partials, self.mesh, self.axis)
+
+        def local_psum(h_loc, w_loc):
+            part = jnp.dot(h_loc, w_loc, preferred_element_type=jnp.float32)
+            return jax.lax.psum(part, self.axis).astype(h_loc.dtype)
+
+        return jax.shard_map(
+            local_psum, mesh=self.mesh,
+            in_specs=(P(None, self.axis), P(self.axis, None)),
+            out_specs=P(None, None),
+        )(h, w)
 
     def _attn_layer(self) -> TPAttn:
         c = self.config
@@ -201,7 +272,10 @@ class Qwen3:
             attn_out, k_new, v_new = self._attn_prefill(
                 lp.attn, rms_norm(x, lp.ln1, c.rms_eps), b, s
             )
-            cache = write_prefill(cache, li, k_new, v_new)
+            if isinstance(cache, PagedKVCache):
+                cache = write_prefill_paged(cache, li, k_new, v_new)
+            else:
+                cache = write_prefill(cache, li, k_new, v_new)
             x = x + attn_out
             x = x + self._mlp_forward(lp.mlp, rms_norm(x, lp.ln2, c.rms_eps))
         x = rms_norm(x, params.final_norm, c.rms_eps)
@@ -268,33 +342,108 @@ class Qwen3:
             ),
         )
 
-        # out-projection: local row GEMM + psum (sub-tile payload at M=B)
-        def oproj(o_loc, wo_loc):
-            part = jnp.dot(o_loc, wo_loc,
-                           preferred_element_type=jnp.float32)
-            return jax.lax.psum(part, self.axis).astype(o_loc.dtype)
+        # out-projection: row-parallel reduce by decode_mode (psum at B=1
+        # sub-tile payloads; fast-AR kernels at batch)
+        return self._row_parallel_reduce(out, p.wo), cache
 
-        out = jax.shard_map(
-            oproj, mesh=self.mesh,
-            in_specs=(P(None, self.axis), P(self.axis, None)),
-            out_specs=P(None, None),
-        )(out, p.wo)
-        return out, cache
+    def _attn_decode_paged(self, p: TPAttnParams, x: jax.Array,
+                           cache: PagedKVCache, layer: int):
+        """Decode step against the paged pool: per-sequence RAGGED
+        positions, token append as a pool scatter, attention through the
+        block-table kernel (reference ``gqa_fwd_batch_decode`` +
+        ``block_table``, ``flash_decode.py:587-720``)."""
+        c = self.config
+        n = self.tp
+        h_loc, hk_loc, d = c.num_heads // n, c.num_kv_heads // n, c.head_dim
+        b = x.shape[0]
+
+        def local(x_rep, wqkv_loc, qn, kn, pool_k_l, pool_v_l, table, lens):
+            qkv = jnp.dot(x_rep, wqkv_loc,
+                          preferred_element_type=jnp.float32).astype(x_rep.dtype)
+            q, k, v = jnp.split(
+                qkv, [h_loc * d, (h_loc + hk_loc) * d], axis=-1
+            )
+            q = q.reshape(b, h_loc, 1, d)
+            k = k.reshape(b, hk_loc, 1, d)
+            v = v.reshape(b, hk_loc, 1, d)
+            if c.qk_norm:
+                q = rms_norm(q, qn, c.rms_eps)
+                k = rms_norm(k, kn, c.rms_eps)
+            pos = lens[:, None, None]        # (B, 1, 1): per-seq positions
+            q = apply_rope_at(q, pos, theta=c.rope_theta)
+            k = apply_rope_at(k, pos, theta=c.rope_theta)
+            # ragged append: each sequence's token into its own page slot
+            ps = pool_k_l.shape[2]
+            pages = jnp.take_along_axis(
+                table, (lens // ps)[:, None], axis=1
+            )[:, 0]
+            offs = lens % ps
+            pool_k_l = pool_k_l.at[pages, :, offs].set(
+                k[:, :, 0].astype(pool_k_l.dtype)
+            )
+            pool_v_l = pool_v_l.at[pages, :, offs].set(
+                v[:, :, 0].astype(pool_v_l.dtype)
+            )
+            out = paged_decode_attention(
+                q[:, :, 0], pool_k_l, pool_v_l, table, lens + 1
+            )  # (b, h_loc, d)
+            return out.reshape(b, h_loc * d), pool_k_l, pool_v_l
+
+        out, k_l, v_l = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(None, None), P(None, self.axis), P(None), P(None),
+                      P(None, self.axis, None, None),
+                      P(None, self.axis, None, None),
+                      P(None, None), P(None)),
+            out_specs=(P(None, self.axis),
+                       P(None, self.axis, None, None),
+                       P(None, self.axis, None, None)),
+            check_vma=False,
+        )(x, p.wqkv, p.q_norm, p.k_norm, cache.k[layer], cache.v[layer],
+          cache.block_table, cache.seq_lens)
+        cache = dataclasses.replace(
+            cache,
+            k=jax.lax.dynamic_update_slice(
+                cache.k, k_l[None], (layer, 0, 0, 0, 0)
+            ),
+            v=jax.lax.dynamic_update_slice(
+                cache.v, v_l[None], (layer, 0, 0, 0, 0)
+            ),
+        )
+        return self._row_parallel_reduce(out, p.wo), cache
 
     def _mlp_decode(self, p: TPMLPParams, x: jax.Array) -> jax.Array:
-        def local(x_rep, gu_loc, dn_loc):
+        if self.decode_mode == "psum" or self.tp == 1:
+            def local(x_rep, gu_loc, dn_loc):
+                fused = jnp.dot(x_rep, gu_loc,
+                                preferred_element_type=jnp.float32).astype(x_rep.dtype)
+                wg, w1 = jnp.split(fused, 2, axis=-1)
+                h = jax.nn.silu(wg) * w1
+                part = jnp.dot(h, dn_loc, preferred_element_type=jnp.float32)
+                return jax.lax.psum(part, self.axis).astype(x_rep.dtype)
+
+            return jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(None, None), P(None, self.axis),
+                          P(self.axis, None)),
+                out_specs=P(None, None),
+            )(x, p.gate_up, p.down)
+
+        # AR modes: the gate/up GEMM + SwiGLU stays local, the down-proj
+        # reduction goes through the Pallas AllReduce path
+        def up_local(x_rep, gu_loc):
             fused = jnp.dot(x_rep, gu_loc,
                             preferred_element_type=jnp.float32).astype(x_rep.dtype)
             wg, w1 = jnp.split(fused, 2, axis=-1)
-            h = jax.nn.silu(wg) * w1
-            part = jnp.dot(h, dn_loc, preferred_element_type=jnp.float32)
-            return jax.lax.psum(part, self.axis).astype(x_rep.dtype)
+            return jax.nn.silu(wg) * w1
 
-        return jax.shard_map(
-            local, mesh=self.mesh,
-            in_specs=(P(None, None), P(None, self.axis), P(self.axis, None)),
-            out_specs=P(None, None),
-        )(x, p.gate_up, p.down)
+        h = jax.shard_map(
+            up_local, mesh=self.mesh,
+            in_specs=(P(None, None), P(None, self.axis)),
+            out_specs=P(None, self.axis),
+            check_vma=False,
+        )(x, p.gate_up)
+        return self._row_parallel_reduce(h, p.down)
 
     def decode(self, params: QwenParams, cache: KVCache,
                tokens: jax.Array):
@@ -302,8 +451,10 @@ class Qwen3:
         (logits (B, V), cache)."""
         c = self.config
         x = params.embed[tokens]
+        attn_step = (self._attn_decode_paged if isinstance(cache, PagedKVCache)
+                     else self._attn_decode)
         for li, lp in enumerate(params.layers):
-            attn_out, cache = self._attn_decode(
+            attn_out, cache = attn_step(
                 lp.attn, rms_norm(x, lp.ln1, c.rms_eps), cache, li
             )
             x = x + attn_out

@@ -11,8 +11,10 @@ from .attention import (
     flash_attention_chunk,
     init_attention_state,
     merge_decode_states,
+    paged_decode_attention,
+    paged_decode_attention_state,
 )
-from .flash_decode import sp_flash_decode
+from .flash_decode import sp_flash_decode, sp_paged_flash_decode
 from .gemm_ar import GemmArConfig, gemm_ar
 from .gemm_rs import GemmRsConfig, gemm_rs
 from .group_gemm import (

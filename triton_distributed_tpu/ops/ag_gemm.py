@@ -286,7 +286,6 @@ def ag_gemm(
     directions carry chunks, halving the longest path; at n == 2 the single
     transfer makes the streams identical).
     """
-    cfg = config or AgGemmConfig()
     out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
     n = mesh.shape[axis]
 
@@ -302,6 +301,20 @@ def ag_gemm(
     if n == 1:
         c = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
         return (c, a) if return_gathered else c
+
+    if config is None:
+        # transparent contextual tuning: cached per-shape winner, measured
+        # on first eager real-hardware call, static default otherwise
+        from ..tune import autotuner as _tune
+
+        kw = dict(out_dtype=out_dtype, return_gathered=return_gathered,
+                  bidir=bidir)
+        config = _tune.resolve_gemm_like(
+            "ag_gemm", ag_gemm, AgGemmConfig, _tune.AG_GEMM_CAND_DIMS,
+            AgGemmConfig(), a, b, mesh, axis, kw,
+            _tune.ag_gemm_key_kw(n, kw),
+        )
+    cfg = config
 
     if bidir is None:
         bidir = n >= 3

@@ -53,16 +53,20 @@ def _init_carry(bq: int, d: int):
 def _tile_update(q, k, v, mask, soft_cap, carry):
     """One online-softmax tile step, shared by every attention kernel here.
 
-    ``q``: (bq, d) f32 pre-scaled queries; ``k``/``v``: (bk, d) tile;
-    ``mask``: (bq, bk) bool (True = keep) or None; ``carry``: (m, l, acc)
-    from :func:`_init_carry`.  A fully-masked row keeps p = 0 so it
+    ``q``: (bq, d) pre-scaled queries in their STORAGE dtype; ``k``/``v``:
+    (bk, d) tile, storage dtype; ``mask``: (bq, bk) bool (True = keep) or
+    None; ``carry``: (m, l, acc) f32 from :func:`_init_carry`.  Both
+    matmuls run with bf16 (storage-dtype) operands and f32 MXU
+    accumulation — feeding f32 operands to the MXU quarters its rate; the
+    probability tile is cast back to the storage dtype for the p·V dot
+    while (m, l, acc) stay f32.  A fully-masked row keeps p = 0 so it
     contributes a zero denominator instead of silently averaging V.
     """
     m_prev, l_prev, acc = carry
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )  # (bq, bk)
+    )  # (bq, bk) f32
     if soft_cap:
         s = jnp.tanh(s / soft_cap) * soft_cap
     if mask is not None:
@@ -71,8 +75,16 @@ def _tile_update(q, k, v, mask, soft_cap, carry):
     alpha = jnp.exp(m_prev - m_cur)
     p = jnp.where(m_cur > _NEG_INF / 2, jnp.exp(s - m_cur), 0.0)
     l_cur = l_prev * alpha + p.sum(axis=1, keepdims=True)
-    acc = acc * alpha + jax.lax.dot(p, v, preferred_element_type=jnp.float32)
+    acc = acc * alpha + jax.lax.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
     return m_cur, l_cur, acc
+
+
+def _scaled_q(q_ref_slice, sm_scale):
+    """Scale q in f32, return in the storage dtype for the MXU dot."""
+    dtype = q_ref_slice.dtype
+    return (q_ref_slice.astype(jnp.float32) * sm_scale).astype(dtype)
 
 
 def _attn_kernel(
@@ -94,32 +106,44 @@ def _attn_kernel(
         sq_ref = sk_ref = None
     iq = pl.program_id(1)
     d = q_ref.shape[-1]
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # (bq, d)
+    q = _scaled_q(q_ref[0], sm_scale)            # (bq, d) storage dtype
     sq = sq_ref[0] if has_segs else None         # (bq,)
 
-    def body(j, carry):
-        k = k_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)    # (bk, d)
-        v = v_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)    # (bk, d)
-        mask = None
-        if causal:
-            # rows are absolute q positions, cols absolute kv positions
-            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            mask = qpos >= kpos
-        if has_segs:
-            # packed varlen: attend only within the same segment (the
-            # reference's cu_seqlens support, re-expressed as segment ids)
-            sk = sk_ref[0, pl.ds(j * bk, bk)]                  # (bk,)
-            seg_mask = sq[:, None] == sk[None, :]
-            mask = seg_mask if mask is None else (mask & seg_mask)
+    def seg_mask_at(j):
+        # packed varlen: attend only within the same segment (the
+        # reference's cu_seqlens support, re-expressed as segment ids)
+        sk = sk_ref[0, pl.ds(j * bk, bk)]                      # (bk,)
+        return sq[:, None] == sk[None, :]
+
+    def body_interior(j, carry):
+        # tiles fully below the causal diagonal: no mask arithmetic
+        k = k_ref[0, pl.ds(j * bk, bk)]                        # (bk, d)
+        v = v_ref[0, pl.ds(j * bk, bk)]
+        mask = seg_mask_at(j) if has_segs else None
         return _tile_update(q, k, v, mask, soft_cap, carry)
 
+    def body_diagonal(j, carry):
+        k = k_ref[0, pl.ds(j * bk, bk)]
+        v = v_ref[0, pl.ds(j * bk, bk)]
+        # rows are absolute q positions, cols absolute kv positions
+        qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = qpos >= kpos
+        if has_segs:
+            mask = mask & seg_mask_at(j)
+        return _tile_update(q, k, v, mask, soft_cap, carry)
+
+    carry = _init_carry(bq, d)
     if causal:
-        # kv blocks at or left of this q-block's diagonal
+        # kv blocks at or left of this q-block's diagonal; blocks whose last
+        # position is <= the q block's first need no causal mask at all
         nkv = (iq * bq + bq + bk - 1) // bk
+        nfull = (iq * bq + 1) // bk
+        carry = jax.lax.fori_loop(0, nfull, body_interior, carry)
+        carry = jax.lax.fori_loop(nfull, nkv, body_diagonal, carry)
     else:
-        nkv = seq_kv // bk
-    _, l, acc = jax.lax.fori_loop(0, nkv, body, _init_carry(bq, d))
+        carry = jax.lax.fori_loop(0, seq_kv // bk, body_interior, carry)
+    _, l, acc = carry
     o_ref[0] = (acc / l).astype(o_ref.dtype)
 
 
@@ -265,32 +289,40 @@ def _attn_chunk_kernel(
     through."""
     iq = pl.program_id(1)
     q_off, kv_off = off_ref[0], off_ref[1]
-    q = q_ref[0].astype(jnp.float32) * sm_scale        # (bq, d)
+    q = _scaled_q(q_ref[0], sm_scale)                  # (bq, d)
     m0 = m_in[0][:, None]                              # (bq, 1)
     l0 = l_in[0][:, None]
     acc0 = acc_in[0]                                   # (bq, d)
 
-    def body(j, carry):
-        k = k_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)
-        mask = None
-        if causal:
-            qpos = q_off + iq * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (q.shape[0], bk), 0
-            )
-            kpos = kv_off + j * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (q.shape[0], bk), 1
-            )
-            mask = qpos >= kpos
-        return _tile_update(q, k, v, mask, soft_cap, carry)
+    def body_interior(j, carry):
+        k = k_ref[0, pl.ds(j * bk, bk)]
+        v = v_ref[0, pl.ds(j * bk, bk)]
+        return _tile_update(q, k, v, None, soft_cap, carry)
+
+    def body_diagonal(j, carry):
+        k = k_ref[0, pl.ds(j * bk, bk)]
+        v = v_ref[0, pl.ds(j * bk, bk)]
+        qpos = q_off + iq * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], bk), 0
+        )
+        kpos = kv_off + j * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], bk), 1
+        )
+        return _tile_update(q, k, v, qpos >= kpos, soft_cap, carry)
 
     if causal:
-        # kv blocks whose first position is <= this q block's last position
-        q_max = q_off + iq * bq + bq - 1
+        # kv blocks whose first position is <= this q block's last position;
+        # blocks entirely below the diagonal skip the mask arithmetic
+        q_min = q_off + iq * bq
+        q_max = q_min + bq - 1
         nkv = jnp.clip((q_max - kv_off) // bk + 1, 0, seq_c // bk)
+        nfull = jnp.clip((q_min - kv_off + 1) // bk, 0, nkv)
+        carry = jax.lax.fori_loop(0, nfull, body_interior, (m0, l0, acc0))
+        m1, l1, acc1 = jax.lax.fori_loop(nfull, nkv, body_diagonal, carry)
     else:
-        nkv = seq_c // bk
-    m1, l1, acc1 = jax.lax.fori_loop(0, nkv, body, (m0, l0, acc0))
+        m1, l1, acc1 = jax.lax.fori_loop(
+            0, seq_c // bk, body_interior, (m0, l0, acc0)
+        )
     m_out[0] = m1[:, 0]
     l_out[0] = l1[:, 0]
     acc_out[0] = acc1
@@ -430,11 +462,17 @@ def _decode_kernel(
     sp = k_ref.shape[1]
     g, d = q_ref.shape[1], q_ref.shape[2]
     kv_len = kv_len_ref[0, 0]
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # (g, d)
+    q = _scaled_q(q_ref[0], sm_scale)            # (g, d)
 
-    def body(j, carry):
-        k = k_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * bk, bk)].astype(jnp.float32)
+    def body_valid(j, carry):
+        # tiles entirely below kv_len: no mask arithmetic
+        k = k_ref[0, pl.ds(j * bk, bk)]
+        v = v_ref[0, pl.ds(j * bk, bk)]
+        return _tile_update(q, k, v, None, soft_cap, carry)
+
+    def body_edge(j, carry):
+        k = k_ref[0, pl.ds(j * bk, bk)]
+        v = v_ref[0, pl.ds(j * bk, bk)]
         kpos = split * sp + j * bk + jax.lax.broadcasted_iota(
             jnp.int32, (g, bk), 1
         )
@@ -442,7 +480,9 @@ def _decode_kernel(
         # merge (see _tile_update's guard)
         return _tile_update(q, k, v, kpos < kv_len, soft_cap, carry)
 
-    m1, l1, acc1 = jax.lax.fori_loop(0, sp // bk, body, _init_carry(g, d))
+    nfull = jnp.clip((kv_len - split * sp) // bk, 0, sp // bk)
+    carry = jax.lax.fori_loop(0, nfull, body_valid, _init_carry(g, d))
+    m1, l1, acc1 = jax.lax.fori_loop(nfull, sp // bk, body_edge, carry)
     # emit the state: numerator in o, statistics for the cross-split merge
     o_ref[0, 0] = acc1.astype(o_ref.dtype)
     m_ref[0, 0] = jnp.broadcast_to(m1, (g, 128))
@@ -580,6 +620,177 @@ def decode_attention(
     """
     num, m, l = decode_attention_state(
         q, k, v, kv_len, n_split=n_split, sm_scale=sm_scale, soft_cap=soft_cap
+    )
+    num, _, l = merge_decode_states(num, m, l)
+    out = num[..., 0, :] / l[..., 0][..., None]
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# paged (block-table) split-KV decode
+
+
+def _paged_decode_kernel(
+    hk: int,
+    page_size: int,
+    sm_scale: float,
+    soft_cap: float,
+    table_ref,   # (B, max_pages) int32 physical page per logical page [SMEM]
+    lens_ref,    # (B,) int32 per-sequence valid lengths (ragged)      [SMEM]
+    q_ref,    # (1, g, d)         VMEM — one kv-head's query group
+    k_ref,    # (1, page_size, d) VMEM — the gathered physical page
+    v_ref,    # (1, page_size, d)
+    o_ref,    # (1, 1, g, d)   partial numerator
+    m_ref,    # (1, 1, g, 128) f32 running max
+    l_ref,    # (1, 1, g, 128) f32 denominator
+):
+    """One grid cell = (batch*kv_head, logical page): the split-KV decode
+    body (``_decode_kernel``) with the KV slice GATHERED through the block
+    table — the scalar-prefetched index maps hand Mosaic the physical page
+    id before the cell runs, so page DMAs pipeline exactly like contiguous
+    splits (reference paged decode ``flash_decode.py:587-720``:
+    ``gqa_fwd_batch_decode`` walking ``block_table``).  Pages at or past a
+    sequence's length mask to l = 0 and drop out of the merge, which is how
+    RAGGED per-sequence lengths ride an identical grid."""
+    bh, j = pl.program_id(0), pl.program_id(1)
+    g, d = q_ref.shape[1], q_ref.shape[2]
+    kv_len = lens_ref[bh // hk]
+    q = _scaled_q(q_ref[0], sm_scale)            # (g, d)
+
+    k = k_ref[0]                                 # (page_size, d)
+    v = v_ref[0]
+    kpos = j * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (g, page_size), 1
+    )
+    m1, l1, acc1 = _tile_update(
+        q, k, v, kpos < kv_len, soft_cap, _init_carry(g, d)
+    )
+    o_ref[0, 0] = acc1.astype(o_ref.dtype)
+    m_ref[0, 0] = jnp.broadcast_to(m1, (g, 128))
+    l_ref[0, 0] = jnp.broadcast_to(l1, (g, 128))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_paged_decode(b, h, hk, num_pages, page_size, max_pages, d,
+                        sm_scale, soft_cap, dtype):
+    group = h // hk
+    kernel = functools.partial(
+        _paged_decode_kernel, hk, page_size, sm_scale, soft_cap
+    )
+    # pool arrives reshaped (num_pages * hk, page_size, d); the physical row
+    # for grid cell (bh, j) is table[bh // hk, j] * hk + bh % hk
+    kv_spec = pl.BlockSpec(
+        (1, page_size, d),
+        lambda bh, j, table, lens: (table[bh // hk, j] * hk + bh % hk, 0, 0),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * hk, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, group, d), lambda bh, j, *_: (bh, 0, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, group, d), lambda bh, j, *_: (bh, j, 0, 0)),
+            pl.BlockSpec((1, 1, group, 128), lambda bh, j, *_: (bh, j, 0, 0)),
+            pl.BlockSpec((1, 1, group, 128), lambda bh, j, *_: (bh, j, 0, 0)),
+        ],
+    )
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b * hk, max_pages, group, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * hk, max_pages, group, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b * hk, max_pages, group, 128), jnp.float32),
+        ],
+        compiler_params=compilation.compiler_params(
+            collective=False,
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+    return jax.jit(call)
+
+
+def paged_decode_attention_state(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_table: jax.Array,
+    seq_lens: jax.Array,
+    *,
+    sm_scale: float | None = None,
+    soft_cap: float = 0.0,
+):
+    """Split-KV decode over a PAGED cache, returning the mergeable state.
+
+    ``q``: (B, H, D) decode token; ``pool_k``/``pool_v``: (P, Hkv,
+    page_size, D) physical page pools; ``block_table``: (B, max_pages)
+    int32 — logical page j of sequence b lives in pool page
+    ``block_table[b, j]`` (entries past a sequence's page count must still
+    be valid pool indices, e.g. 0 — they mask out); ``seq_lens``: (B,)
+    int32 RAGGED per-sequence lengths.  Returns ``(num, m, l)`` with the
+    page axis in place of the split axis — merge with
+    :func:`merge_decode_states`.  Reference:
+    ``flash_decode.py:587-720`` (``gqa_fwd_batch_decode*`` with
+    ``block_table``), ``sp_flash_decode_layer.py:83-108``.
+    """
+    b, h, d = q.shape
+    p, hk, page_size, dk = pool_k.shape
+    if dk != d or pool_v.shape != pool_k.shape:
+        raise ValueError(
+            f"shape mismatch: q={q.shape} pool_k={pool_k.shape} "
+            f"pool_v={pool_v.shape}"
+        )
+    if h % hk:
+        raise ValueError(f"GQA requires H % Hkv == 0, got {h} % {hk}")
+    if block_table.shape[0] != b or seq_lens.shape != (b,):
+        raise ValueError(
+            f"block_table {block_table.shape} / seq_lens {seq_lens.shape} "
+            f"inconsistent with B={b}"
+        )
+    group = h // hk
+    max_pages = block_table.shape[1]
+    sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
+    fn = _build_paged_decode(
+        b, h, hk, p, page_size, max_pages, d, sm_scale, float(soft_cap),
+        jnp.dtype(q.dtype),
+    )
+    num, m, l = fn(
+        block_table.astype(jnp.int32),
+        seq_lens.astype(jnp.int32),
+        q.reshape(b * hk, group, d),
+        pool_k.reshape(p * hk, page_size, d),
+        pool_v.reshape(p * hk, page_size, d),
+    )
+    num = num.reshape(b, hk, max_pages, group, d).transpose(0, 1, 3, 2, 4)
+    m = m[..., 0].reshape(b, hk, max_pages, group).transpose(0, 1, 3, 2)
+    l = l[..., 0].reshape(b, hk, max_pages, group).transpose(0, 1, 3, 2)
+    return (
+        num.reshape(b, h, max_pages, d),
+        m.reshape(b, h, max_pages),
+        l.reshape(b, h, max_pages),
+    )
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_table: jax.Array,
+    seq_lens: jax.Array,
+    *,
+    sm_scale: float | None = None,
+    soft_cap: float = 0.0,
+) -> jax.Array:
+    """Single-token decode attention over a paged cache; returns (B, H, D).
+    Golden: :func:`decode_attention` on the contiguously-materialized cache
+    with per-sequence masking."""
+    num, m, l = paged_decode_attention_state(
+        q, pool_k, pool_v, block_table, seq_lens,
+        sm_scale=sm_scale, soft_cap=soft_cap,
     )
     num, _, l = merge_decode_states(num, m, l)
     out = num[..., 0, :] / l[..., 0][..., None]

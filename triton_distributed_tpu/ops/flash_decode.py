@@ -38,6 +38,8 @@ from .attention import (
     decode_attention,
     decode_attention_state,
     merge_decode_states,
+    paged_decode_attention,
+    paged_decode_attention_state,
 )
 
 
@@ -130,3 +132,105 @@ def sp_flash_decode(
     )
     kv_len = jnp.asarray(kv_len, jnp.int32).reshape(1)
     return fn(q, k, v, kv_len)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sp_paged_flash_decode(
+    mesh: Mesh,
+    axis: str,
+    shapes_key,   # (b, h, hk, ps, mp_loc, d, sm_scale, soft_cap, dtype)
+):
+    b, h, hk, ps, mp_loc, d, sm_scale, soft_cap, dtype = shapes_key
+    s_loc = mp_loc * ps
+
+    def local_fn(q, pool_k_loc, pool_v_loc, table_loc, seq_lens):
+        r = jax.lax.axis_index(axis)
+        # this rank's pages cover absolute positions [r*s_loc, (r+1)*s_loc);
+        # seq_lens is RAGGED per sequence — clip per rank per sequence
+        len_loc = jnp.clip(seq_lens - r * s_loc, 0, s_loc)
+        num, m, l = paged_decode_attention_state(
+            q, pool_k_loc, pool_v_loc, table_loc[0], len_loc,
+            sm_scale=sm_scale, soft_cap=soft_cap,
+        )
+        num, m, l = merge_decode_states(num, m, l)     # pages -> one state
+        nums = jax.lax.all_gather(num[..., 0, :], axis)
+        ms = jax.lax.all_gather(m[..., 0], axis)
+        ls = jax.lax.all_gather(l[..., 0], axis)
+        num, _, l = merge_decode_states(
+            jnp.moveaxis(nums, 0, -2), jnp.moveaxis(ms, 0, -1),
+            jnp.moveaxis(ls, 0, -1),
+        )
+        out = num[..., 0, :] / l[..., 0][..., None]
+        return out.astype(dtype)
+
+    return compilation.jit_shard_map(
+        local_fn, mesh,
+        in_specs=(
+            P(None, None, None),              # q replicated
+            P(axis, None, None, None),        # page pool: rank-owned pages
+            P(axis, None, None, None),
+            P(axis, None, None),              # per-rank local block tables
+            P(None),                          # global ragged lengths
+        ),
+        out_specs=P(None, None, None),
+    )
+
+
+def sp_paged_flash_decode(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_table: jax.Array,
+    seq_lens: jax.Array,
+    mesh: Mesh,
+    axis: str = SP_AXIS,
+    *,
+    sm_scale: float | None = None,
+    soft_cap: float = 0.0,
+) -> jax.Array:
+    """Decode attention over a sequence-sharded PAGED cache (the reference's
+    production decode layer: ``sp_flash_decode_layer.py:83-108`` threads
+    ``block_table`` into ``gqa_fwd_batch_decode``).
+
+    Each rank owns a page pool holding its slice of the sequence axis and a
+    LOCAL block table; the cross-rank softmax-state merge is identical to
+    :func:`sp_flash_decode`.
+
+    ``q``: (B, H, D) replicated; ``pool_k``/``pool_v``: global
+    (n * P_loc, Hkv, page_size, D) sharded on the page dim over ``axis``;
+    ``block_table``: global (n, B, max_pages_loc) — rank r's (B, mp) table
+    in its LOCAL pool page ids, rank r covering absolute positions
+    ``[r * mp * page_size, (r+1) * mp * page_size)``; ``seq_lens``: (B,)
+    int32 GLOBAL ragged lengths, replicated.  Returns (B, H, D) replicated.
+    Golden: per-sequence contiguous materialization + ``decode_attention``.
+    """
+    n = mesh.shape[axis]
+    b, h, d = q.shape
+    p_tot, hk, ps, dk = pool_k.shape
+    if pool_v.shape != pool_k.shape or dk != d:
+        raise ValueError(
+            f"shape mismatch: q={q.shape} pool_k={pool_k.shape} "
+            f"pool_v={pool_v.shape}"
+        )
+    if n == 1:
+        table = block_table[0] if block_table.ndim == 3 else block_table
+        return paged_decode_attention(
+            q, pool_k, pool_v, table, seq_lens,
+            sm_scale=sm_scale, soft_cap=soft_cap,
+        )
+    if block_table.shape[0] != n or block_table.shape[1] != b:
+        raise ValueError(
+            f"block_table {block_table.shape} must be (n, B, max_pages_loc)"
+            f" with n={n}, B={b}"
+        )
+    if p_tot % n:
+        raise ValueError(f"page pool {p_tot} not divisible by {axis}={n}")
+    mp_loc = block_table.shape[2]
+    sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
+    fn = _build_sp_paged_flash_decode(
+        mesh, axis,
+        (b, h, hk, ps, mp_loc, d, sm_scale, float(soft_cap),
+         jnp.dtype(q.dtype)),
+    )
+    return fn(q, pool_k, pool_v, block_table.astype(jnp.int32),
+              seq_lens.astype(jnp.int32))

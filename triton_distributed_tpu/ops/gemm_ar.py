@@ -240,7 +240,6 @@ def gemm_ar(
     ``b``: (K, N) sharded on dim 0 over ``axis`` (row-parallel weight).
     Returns (M, N) replicated on every rank: the full sum.
     """
-    cfg = config or GemmArConfig()
     out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
     n = mesh.shape[axis]
 
@@ -254,6 +253,16 @@ def gemm_ar(
         raise ValueError(
             f"M={m_tot} and K={k_dim} must be divisible by {axis}={n}"
         )
+
+    if config is None:
+        # transparent contextual tuning (see ops/ag_gemm.py)
+        from ..tune import autotuner as _tune
+
+        config = _tune.resolve_gemm_like(
+            "gemm_ar", gemm_ar, GemmArConfig, _tune.GEMM_AR_CAND_DIMS,
+            GemmArConfig(), a, b, mesh, axis, dict(out_dtype=out_dtype), {},
+        )
+    cfg = config
 
     m_loc, k_loc = m_tot // n, k_dim // n
     cfg = cfg.clip(m_loc, k_loc, n_dim)

@@ -243,7 +243,6 @@ def gemm_rs(
     ``b``: (K, N) sharded on dim 0 over ``axis`` (row-parallel weight).
     Returns (M, N) sharded on dim 0: the reduced sum, row-chunk r on rank r.
     """
-    cfg = config or GemmRsConfig()
     out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
     n = mesh.shape[axis]
 
@@ -257,6 +256,16 @@ def gemm_rs(
         raise ValueError(
             f"M={m_tot} and K={k_dim} must be divisible by {axis}={n}"
         )
+
+    if config is None:
+        # transparent contextual tuning (see ops/ag_gemm.py)
+        from ..tune import autotuner as _tune
+
+        config = _tune.resolve_gemm_like(
+            "gemm_rs", gemm_rs, GemmRsConfig, _tune.GEMM_RS_CAND_DIMS,
+            GemmRsConfig(), a, b, mesh, axis, dict(out_dtype=out_dtype), {},
+        )
+    cfg = config
 
     m_loc, k_loc = m_tot // n, k_dim // n
     cfg = cfg.clip(m_loc, k_loc, n_dim)

@@ -215,11 +215,29 @@ def grouped_matmul(
         raise ValueError(f"inner dims mismatch: {x_sorted.shape} @ {w.shape}")
     if splits.shape != (e,):
         raise ValueError(f"splits {splits.shape} != (E,) = ({e},)")
-    cfg = config or GroupGemmConfig()
     out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(
         x_sorted.dtype
     )
-    return _grouped_matmul_vjp(cfg, out_dtype, x_sorted, w, splits)
+    if config is None:
+        # transparent contextual tuning (see ops/ag_gemm.py); splits are
+        # part of the closure (contextual) but not the key — the winning
+        # tiling is a shape-class property, not a routing property
+        from ..core import platform
+        from ..tune import autotuner as _tune
+
+        config = _tune.resolve_config(
+            "grouped_matmul",
+            (t, k, n_dim, e, str(x_sorted.dtype), platform.device_kind()),
+            [GroupGemmConfig(bm, bn, bk)
+             for bm, bn, bk in _tune.matmul_tile_candidates(t, n_dim, k)
+             if bm <= t],
+            GroupGemmConfig(),
+            lambda c: (lambda: grouped_matmul(x_sorted, w, splits, config=c,
+                                              out_dtype=out_dtype)),
+            tracing=(_tune.is_tracer(x_sorted) or _tune.is_tracer(w)
+                     or _tune.is_tracer(splits)),
+        )
+    return _grouped_matmul_vjp(config, out_dtype, x_sorted, w, splits)
 
 
 def group_gemm(x_sorted: jax.Array, w: jax.Array,

@@ -20,6 +20,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..core import compilation
 from ..core.utils import clip_block
+from ..tune.autotuner import MATMUL_DEFAULT_TILES
 from . import blocks
 
 
@@ -54,26 +55,41 @@ def matmul(
     a: jax.Array,
     b: jax.Array,
     *,
-    bm: int = 512,
-    bn: int = 1792,
-    bk: int = 512,
+    bm: int | None = None,
+    bn: int | None = None,
+    bk: int | None = None,
     out_dtype=None,
 ) -> jax.Array:
     """C = A @ B with f32 accumulation, blocked for the MXU.
 
-    Defaults (512, 1792, 512) measured at 1.03x of XLA's own GEMM at
-    7168^3 bf16 (median per-round interleaved ratio over 14 rounds; the
-    wide 14-lane-tile N block keeps the MXU fed while halving the
-    accumulator footprint vs 1024x1024, which measured 0.99x).  For shapes
-    1792 does not divide, ``clip_block`` degrades bn to the largest
-    sublane-aligned divisor (1024/512/...), recovering the round-1
-    behavior.  The round-1 512x512 output tiles are HBM-bound and cost
-    ~13% (VERDICT.md weak #3).
+    With no explicit tiles, the contextual autotuner resolves them per
+    shape class: a cached per-(m, n, k, dtype, device) winner if one
+    exists, a measurement sweep on the first eager real-hardware call,
+    else the static default (512, 1792, 512) — which measured 1.03x of
+    XLA's own GEMM at 7168^3 bf16 (median per-round interleaved ratio over
+    14 rounds; the wide 14-lane-tile N block keeps the MXU fed while
+    halving the accumulator footprint vs 1024x1024, which measured 0.99x).
+    For shapes 1792 does not divide, ``clip_block`` degrades bn to the
+    largest sublane-aligned divisor (1024/512/...).
     """
     (m, k), (k2, n) = a.shape, b.shape
     if k2 != k:
         raise ValueError(f"inner dims mismatch: {a.shape} @ {b.shape}")
     out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
+    if bm is None and bn is None and bk is None:
+        from ..tune import autotuner as _tune
+
+        bm, bn, bk = _tune.resolve_config(
+            "matmul", _tune.matmul_resolve_key(m, n, k, a.dtype),
+            _tune.matmul_tile_candidates(m, n, k),
+            _tune.MATMUL_DEFAULT_TILES,
+            lambda c: (lambda: matmul(a, b, bm=c[0], bn=c[1], bk=c[2],
+                                      out_dtype=out_dtype)),
+            tracing=_tune.is_tracer(a) or _tune.is_tracer(b),
+        )
+    else:
+        dbm, dbn, dbk = MATMUL_DEFAULT_TILES
+        bm, bn, bk = bm or dbm, bn or dbn, bk or dbk
     bm, bn, bk = clip_block(bm, m), clip_block(bn, n), clip_block(bk, k)
     fn = _build_matmul(m, n, k, bm, bn, bk, jnp.dtype(a.dtype), out_dtype)
     return fn(a, b)

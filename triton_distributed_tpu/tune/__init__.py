@@ -4,7 +4,10 @@ from .autotuner import (
     Autotuner,
     TuneResult,
     autotune,
+    lookup_winner,
     matmul_tile_candidates,
+    resolve_config,
+    transparent_tuning_enabled,
     tuned_ag_gemm,
     tuned_gemm_rs,
     tuned_matmul,

@@ -346,6 +346,42 @@ def bench_decode_modes(batch: int = 128):
     }
 
 
+def bench_moe_ep_wire():
+    """EP A2A wire cost with the fp8 (e4m3 + scale sidecar) payload vs the
+    bf16 payload (the reference's production low-latency A2A config, README
+    137 us case).  ``value`` = fp8 wire bytes per token per hop;
+    ``vs_baseline`` = bf16_bytes / fp8_bytes (~2.0 = halved).  Also runs
+    one fp8 forward_ep on the available mesh as an execution check."""
+    import numpy as np
+
+    from triton_distributed_tpu.core import mesh as mesh_lib
+    from triton_distributed_tpu.layers.moe import _FP8_SIDECAR, MoEMLP
+
+    h = 7168                       # reference A2A case: hidden=7168
+    fp8_bytes = h + _FP8_SIDECAR
+    bf16_bytes = 2 * h
+
+    mesh = mesh_lib.tp_mesh()
+    ntp = mesh.shape["tp"]
+    e, k, t, ffn = 4 * max(ntp, 2), 2, 8 * ntp, 256
+    layer = MoEMLP(mesh, num_experts=e, top_k=k, fp8_wire=True)
+    params = layer.init(jax.random.key(0), 512, ffn, ep=True,
+                        dtype=jnp.bfloat16)
+    x = mesh_lib.shard(
+        mesh,
+        jnp.asarray(np.random.default_rng(0).standard_normal((t, 512)) * 0.3,
+                    jnp.bfloat16),
+        "tp", None,
+    )
+    jax.block_until_ready(layer.forward_ep(params, x))
+    return {
+        "metric": f"moe_ep_a2a_fp8_wire_bytes_h{h}",
+        "value": fp8_bytes,
+        "unit": "bytes/token/hop",
+        "vs_baseline": round(bf16_bytes / fp8_bytes, 4),
+    }
+
+
 def main():
     import sys
 
@@ -362,6 +398,8 @@ def main():
         print(json.dumps(bench_decode()))
     elif mode == "decode_modes":
         print(json.dumps(bench_decode_modes()))
+    elif mode == "moe_ep":
+        print(json.dumps(bench_moe_ep_wire()))
     elif mode == "auto":
         # whole perf surface, one JSON line per mode; headline GEMM first
         _emit(bench_single_chip)
@@ -372,6 +410,7 @@ def main():
         _emit(bench_tp_mlp)
         _emit(bench_group_gemm)
         _emit(bench_decode_modes)
+        _emit(bench_moe_ep_wire)
         if jax.device_count() > 1:
             _emit(bench_multi_chip)
         if _EMIT_FAILED:

@@ -116,6 +116,285 @@ def test_barrier_all(mesh8):
     assert_allclose(out, jnp.full((64, 128), 2.0, jnp.float32), atol=0, rtol=0)
 
 
+@pytest.fixture
+def race_detection():
+    compilation.enable_race_detection(True)
+    yield
+    compilation.enable_race_detection(False)
+
+
+def test_team_device_id_3axis_mesh():
+    """Team.device_id translates axis ranks to linearized logical ids on a
+    3-axis mesh, for teams over the OUTER, MIDDLE, and INNER axis
+    (reference ``test_nvshmem_api.py`` team addressing; VERDICT next #8).
+    Only the team axis's coordinate is substituted — all others are the
+    calling device's own."""
+    from triton_distributed_tpu.lang.primitives import Team
+
+    mesh = mesh_lib.make_mesh({"dp": 2, "tp": 2, "sp": 2},
+                              devices=jax.devices()[:8])
+
+    def check(axis):
+        team = Team.of(mesh, axis)
+        n_ax = mesh.shape[axis]
+
+        def body(_):
+            ids = jnp.stack([
+                jnp.asarray(team.device_id(r), jnp.int32)
+                for r in range(n_ax)
+            ])
+            return ids.reshape(1, 1, 1, n_ax)
+
+        out = compilation.jit_shard_map(
+            body, mesh,
+            in_specs=P("dp", "tp", "sp"),
+            out_specs=P("dp", "tp", "sp", None),
+        )(jnp.zeros((2, 2, 2), jnp.float32))
+        got = np.asarray(out)                    # (2, 2, 2, n_ax)
+        for a in range(2):
+            for b in range(2):
+                for c in range(2):
+                    for r in range(n_ax):
+                        coord = {"dp": a, "tp": b, "sp": c}
+                        coord[axis] = r
+                        want = (coord["dp"] * 2 + coord["tp"]) * 2 + coord["sp"]
+                        assert got[a, b, c, r] == want, (axis, a, b, c, r)
+
+    for axis in ("dp", "tp", "sp"):
+        check(axis)
+
+
+def test_barrier_all_reuse_across_kernel_families(mesh8):
+    """The per-family global barrier semaphores leave no residue when two
+    DIFFERENT kernel families (distinct collective_ids) run barrier_all
+    repeatedly inside ONE jitted program (reference
+    ``test_nvshmem_api.py:107-302`` exercising barriers between other API
+    calls; VERDICT next #8)."""
+    n, shape = 8, (8, 128)
+
+    def kern_a(x_ref, o_ref, send_sem, recv_sem):
+        # family A: barrier -> ring push -> barrier -> +1
+        lang.collective_prologue("tp")
+        _, right = lang.ring_neighbors("tp")
+        lang.remote_copy(x_ref, o_ref, send_sem, recv_sem, right).wait()
+        lang.barrier_all("tp")
+
+        def bump(scratch, sem):
+            lang.local_copy(o_ref, scratch, sem).wait()
+            scratch[:] = scratch[:] + 1.0
+            lang.local_copy(scratch, o_ref, sem).wait()
+
+        pl.run_scoped(bump, pltpu.VMEM(shape, jnp.float32),
+                      pltpu.SemaphoreType.DMA)
+
+    def kern_b(x_ref, o_ref, ready, send_sem, recv_sem):
+        # family B: push LEFT, notify/wait handshake, barrier, x2
+        lang.collective_prologue("tp")
+        left, _ = lang.ring_neighbors("tp")
+        lang.remote_copy(x_ref, o_ref, send_sem, recv_sem, left).wait()
+        lang.notify(ready, left)
+        lang.wait(ready, 1)
+        lang.barrier_all("tp")
+
+        def dbl(scratch, sem):
+            lang.local_copy(o_ref, scratch, sem).wait()
+            scratch[:] = scratch[:] * 2.0
+            lang.local_copy(scratch, o_ref, sem).wait()
+
+        pl.run_scoped(dbl, pltpu.VMEM(shape, jnp.float32),
+                      pltpu.SemaphoreType.DMA)
+
+    def a(xs):
+        return pl.pallas_call(
+            kern_a,
+            out_shape=jax.ShapeDtypeStruct(shape, jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+            compiler_params=compilation.compiler_params(collective_id=11),
+            interpret=compilation.interpret_mode(),
+        )(xs)
+
+    def b(xs):
+        return pl.pallas_call(
+            kern_b,
+            out_shape=jax.ShapeDtypeStruct(shape, jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.REGULAR,
+                            pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+            compiler_params=compilation.compiler_params(collective_id=12),
+            interpret=compilation.interpret_mode(),
+        )(xs)
+
+    def prog(xs):
+        # A -> B -> A again: both families' barrier semaphores are reused
+        # within one program, interleaved with each other's collectives
+        return a(b(a(xs)))
+
+    g = compilation.jit_shard_map(prog, mesh8, in_specs=P("tp"),
+                                  out_specs=P("tp"))
+    x = jnp.arange(n * shape[0] * shape[1], dtype=jnp.float32).reshape(
+        n * shape[0], shape[1]
+    )
+    out = np.asarray(jax.device_get(g(x)))
+    # A: roll right then +1; B: roll left then x2; A again
+    xr = np.asarray(x).reshape(n, *shape)
+    want = np.roll(xr, 1, axis=0) + 1.0
+    want = np.roll(want, -1, axis=0) * 2.0
+    want = np.roll(want, 1, axis=0) + 1.0
+    np.testing.assert_array_equal(out.reshape(n, *shape), want)
+
+
+def test_interleaved_wait_send_counting(mesh8):
+    """Two outstanding remote_copies of DIFFERENT shapes on the SAME send
+    semaphore, drained in the OPPOSITE order they were issued: the
+    byte-counting drain must match per-transfer sizes regardless of order
+    (reference ``nvshmem_quiet`` with multiple nbi puts in flight;
+    VERDICT next #8)."""
+    n = 8
+
+    def kernel(x_ref, o_ref, send_sem, recv_small, recv_big):
+        lang.collective_prologue("tp")
+        _, right = lang.ring_neighbors("tp")
+        # small (8, 128) rows [0, 8) and big (16, 128) rows [8, 24),
+        # both in flight on one send semaphore
+        small = lang.remote_copy(
+            x_ref.at[pl.ds(0, 8)], o_ref.at[pl.ds(0, 8)],
+            send_sem, recv_small, right,
+        )
+        big = lang.remote_copy(
+            x_ref.at[pl.ds(8, 16)], o_ref.at[pl.ds(8, 16)],
+            send_sem, recv_big, right,
+        )
+        del small, big
+        # drain sends in REVERSED issue order
+        lang.wait_send(x_ref.at[pl.ds(8, 16)], send_sem)
+        lang.wait_send(x_ref.at[pl.ds(0, 8)], send_sem)
+        lang.wait_recv(o_ref.at[pl.ds(0, 8)], recv_small)
+        lang.wait_recv(o_ref.at[pl.ds(8, 16)], recv_big)
+
+    x = jnp.arange(n * 24 * 128, dtype=jnp.float32).reshape(n * 24, 128)
+    out = _run(
+        mesh8, kernel, x,
+        jax.ShapeDtypeStruct((24, 128), jnp.float32),
+        [pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA,
+         pltpu.SemaphoreType.DMA],
+        collective_id=13,
+    )
+    expect = jnp.roll(x.reshape(n, 24, 128), 1, axis=0).reshape(n * 24, 128)
+    assert_allclose(out, expect, atol=0, rtol=0)
+
+
+def test_semaphore_count_observability(mesh8):
+    """Counting semantics peek would observe, proven through exact-valued
+    wait round-trips (``peek`` itself is Mosaic-only — see its docstring):
+    increments accumulate (1 + 2 then wait(3) passes), a drained semaphore
+    holds zero residue (a fresh 1-round-trip after the drain), and
+    aggregated remote arrivals are consumable as one exact count
+    (reference ``signal_wait_until`` counting; VERDICT next #8)."""
+
+    def kernel(x_ref, o_ref, counter, arrived, done):
+        lang.collective_prologue("tp")
+        me = lang.rank("tp")
+        n = lang.num_ranks("tp")
+
+        def body(scratch, sem):
+            scratch[:] = jnp.zeros_like(scratch)
+            # accumulation: two signals of different increments sum
+            lang.notify(counter, inc=1)
+            lang.notify(counter, inc=2)
+            lang.wait(counter, 3)                # passes iff count == 3
+            # zero residue: a fresh 1-round-trip must balance exactly
+            lang.notify(counter, inc=1)
+            lang.wait(counter, 1)
+            scratch[0, 0] = 1.0                  # reached = both held
+            # aggregated remote arrivals: everyone signals rank 0 with
+            # rank-dependent increments; rank 0 consumes the exact sum
+            lang.notify(arrived, 0, inc=me + 1)
+
+            @pl.when(me == 0)
+            def _():
+                lang.wait(arrived, n * (n + 1) // 2)
+                scratch[0, 1] = 1.0
+
+                def release(i, _):
+                    lang.notify(done, i + 1, inc=1)
+                    return 0
+
+                jax.lax.fori_loop(0, n - 1, release, 0)
+
+            @pl.when(me != 0)
+            def _():
+                lang.wait(done, 1)
+                scratch[0, 1] = 1.0
+
+            lang.local_copy(scratch, o_ref, sem).wait()
+
+        pl.run_scoped(body, pltpu.VMEM((1, 128), jnp.float32),
+                      pltpu.SemaphoreType.DMA)
+
+    x = jnp.zeros((8, 128), jnp.float32)
+    out = _run(
+        mesh8, kernel, x, jax.ShapeDtypeStruct((1, 128), jnp.float32),
+        [pltpu.SemaphoreType.REGULAR, pltpu.SemaphoreType.REGULAR,
+         pltpu.SemaphoreType.REGULAR],
+        collective_id=14,
+    )
+    got = np.asarray(out)
+    np.testing.assert_array_equal(got[:, :2],
+                                  np.ones((8, 2), np.float32))
+
+
+def test_peek_interpret_mode_contract(mesh8):
+    """Under interpret mode ``peek`` fails loudly (the backend has no
+    semaphore_read rule) rather than returning garbage — the documented
+    Mosaic-only contract."""
+    from triton_distributed_tpu.core import compilation as comp
+
+    if not comp.interpret_mode():
+        pytest.skip("real-TPU run: peek is supported there")
+
+    def kernel(x_ref, o_ref, counter):
+        def body(scratch, sem):
+            scratch[:] = jnp.zeros_like(scratch)
+            scratch[0, 0] = lang.peek(counter).astype(jnp.float32)
+            lang.local_copy(scratch, o_ref, sem).wait()
+
+        pl.run_scoped(body, pltpu.VMEM((1, 128), jnp.float32),
+                      pltpu.SemaphoreType.DMA)
+
+    x = jnp.zeros((8, 128), jnp.float32)
+    with pytest.raises(Exception, match="semaphore_read"):
+        jax.block_until_ready(_run(
+            mesh8, kernel, x, jax.ShapeDtypeStruct((1, 128), jnp.float32),
+            [pltpu.SemaphoreType.REGULAR], collective_id=16,
+        ))
+
+
+def test_primitives_green_under_race_detection(race_detection, mesh8):
+    """The new primitive patterns stay race-free under the interpret-mode
+    vector-clock detector (VERDICT next #8 done criterion)."""
+    n = 8
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        lang.collective_prologue("tp")
+        _, right = lang.ring_neighbors("tp")
+        lang.remote_copy(x_ref, o_ref, send_sem, recv_sem, right).wait()
+        lang.barrier_all("tp")
+
+    # unique shape so the call isn't an lru-cached non-detecting build
+    x = jnp.arange(n * 16 * 128, dtype=jnp.float32).reshape(n * 16, 128)
+    out = _run(
+        mesh8, kernel, x,
+        jax.ShapeDtypeStruct((16, 128), jnp.float32),
+        [pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+        collective_id=15,
+    )
+    expect = jnp.roll(x.reshape(n, 16, 128), 1, axis=0).reshape(n * 16, 128)
+    assert_allclose(out, expect, atol=0, rtol=0)
+
+
 def test_rank_num_ranks(mesh8):
     def kernel(x_ref, o_ref):
         def body(scratch, sem):

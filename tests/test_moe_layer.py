@@ -69,6 +69,113 @@ def test_moe_ep_forward(n):
     )
 
 
+def test_moe_ep_fp8_wire_parity():
+    """fp8_wire=True ships e4m3 + scale sidecars on BOTH A2A hops and must
+    agree with the bf16 wire within fp8 quantization tolerance on the
+    8-mesh (VERDICT next #7; reference production A2A configuration)."""
+    n, t, hid, ffn, e, k = 8, 16, 128, 32, 16, 2
+    mesh = make_mesh({TP_AXIS: n}, devices=jax.devices()[:n])
+    x, router, w_up, w_dn = _setup(n, t, hid, ffn, e, seed=77)
+    xs = jax.device_put(x, NamedSharding(mesh, P(TP_AXIS, None)))
+    cfg = AllToAllConfig(chunk=8)
+
+    outs = {}
+    for fp8 in (False, True):
+        layer = MoEMLP(mesh, num_experts=e, top_k=k, fp8_wire=fp8)
+        params = layer.shard_params_ep(router, w_up, w_dn)
+        outs[fp8] = np.asarray(jax.device_get(
+            layer.forward_ep(params, xs, a2a_config=cfg)
+        ))
+    # e4m3 has ~2 decimal digits; both hops quantize, so tolerance is a
+    # few percent of the activations' scale
+    np.testing.assert_allclose(outs[True], outs[False], rtol=0.12,
+                               atol=0.12)
+    # and the fp8 path still matches the dense golden loosely
+    want = _golden(x, router, w_up, w_dn, k)
+    np.testing.assert_allclose(outs[True], want, rtol=0.15, atol=0.15)
+
+
+def test_moe_ep_fp8_wire_gradients_flow():
+    """The quantized wire must NOT freeze training: the u8 transport is
+    custom-vjp'd with a straight-through estimator, so expert-weight
+    gradients under fp8_wire=True stay close to the bf16-wire gradients
+    (a bitcast path would silently return exact zeros)."""
+    n, t, hid, ffn, e, k = 4, 8, 64, 32, 8, 2
+    mesh = make_mesh({TP_AXIS: n}, devices=jax.devices()[:n])
+    x, router, w_up, w_dn = _setup(n, t, hid, ffn, e, seed=88)
+    xs = jax.device_put(x, NamedSharding(mesh, P(TP_AXIS, None)))
+    cfg = AllToAllConfig(chunk=8)
+
+    grads = {}
+    for fp8 in (False, True):
+        layer = MoEMLP(mesh, num_experts=e, top_k=k, fp8_wire=fp8)
+        params = layer.shard_params_ep(router, w_up, w_dn)
+
+        def loss(p, x_):
+            out = layer.forward_ep(p, x_, a2a_config=cfg)
+            return jnp.mean(jnp.square(out.astype(jnp.float32)))
+
+        g = jax.grad(loss)(params, xs)
+        grads[fp8] = {
+            "w_up": np.asarray(jax.device_get(g.w_up), np.float32),
+            "w_dn": np.asarray(jax.device_get(g.w_dn), np.float32),
+            "router": np.asarray(jax.device_get(g.router), np.float32),
+        }
+    for name in ("w_up", "w_dn", "router"):
+        ref = grads[False][name]
+        got = grads[True][name]
+        assert np.abs(got).max() > 0, f"{name} gradient frozen under fp8"
+        # straight-through: grads agree up to the fp8 forward error
+        np.testing.assert_allclose(
+            got, ref, atol=0.15 * np.abs(ref).max() + 1e-6, rtol=0.5,
+        )
+
+
+def test_moe_fp8_wire_bytes_halved():
+    """The packed u8 wire message is ~half the bf16 payload bytes."""
+    from triton_distributed_tpu.layers.moe import _FP8_SIDECAR, _pack_fp8
+
+    h = 7168
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((4, h)),
+                    jnp.bfloat16)
+    packed = _pack_fp8(x)
+    assert packed.dtype == jnp.uint8
+    bf16_bytes = h * 2
+    fp8_bytes = packed.shape[-1]
+    assert fp8_bytes == h + _FP8_SIDECAR
+    assert fp8_bytes / bf16_bytes < 0.51
+
+
+def test_moe_model_fp8_wire_prefill_parity(mesh8):
+    """Qwen3-MoE under EP serving with ``moe_fp8_wire`` produces logits
+    within fp8 tolerance of the bf16-wire engine on the 8-mesh (VERDICT
+    next #7 done criterion)."""
+    import dataclasses
+
+    from triton_distributed_tpu.models import ModelConfig, Qwen3, init_cache
+
+    cfg = ModelConfig(
+        num_layers=1, hidden=128, intermediate=256, num_heads=8,
+        num_kv_heads=8, head_dim=32, vocab=128, max_length=64,
+        dtype=jnp.float32, num_experts=8, top_k=2, moe_intermediate=32,
+        moe_strategy="ep",
+    )
+    mesh = mesh8
+    params = Qwen3(cfg, mesh).init(jax.random.key(41), scale=0.05)
+    ids = jax.random.randint(jax.random.key(42), (2, 16), 0, cfg.vocab)
+
+    logits = {}
+    for fp8 in (False, True):
+        model = Qwen3(dataclasses.replace(cfg, moe_fp8_wire=fp8), mesh)
+        cache = init_cache(mesh, cfg.num_layers, 2, cfg.num_kv_heads,
+                           cfg.max_length, cfg.head_dim, cfg.dtype)
+        out, _ = jax.jit(model.prefill)(params, cache, ids)
+        logits[fp8] = np.asarray(jax.device_get(out))
+    diff = np.abs(logits[True] - logits[False]).max()
+    scale = np.abs(logits[False]).max()
+    assert diff <= 0.08 * scale + 1e-3, (diff, scale)
+
+
 def test_moe_tp_ep_agree():
     """Both parallel strategies compute the same function."""
     n, t, hid, ffn, e, k = 4, 8, 32, 16, 8, 2

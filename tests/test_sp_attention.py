@@ -13,7 +13,10 @@ from triton_distributed_tpu.ops.attention import (
     finalize_attention_state,
     init_attention_state,
 )
-from triton_distributed_tpu.ops.sp_attention import sp_attention
+from triton_distributed_tpu.ops.sp_attention import (
+    hierarchical_sp_attention,
+    sp_attention,
+)
 
 
 def _inputs(b, h, hk, s, d, key=0, dtype=jnp.float32):
@@ -100,3 +103,55 @@ def test_sp_attention_single_rank_fallback():
     out = sp_attention(q, k, v, mesh, causal=True, block_q=128, block_k=128)
     want = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
     assert jnp.allclose(out, want, atol=0, rtol=0)
+
+
+def _mesh2(n_out, n_in):
+    devs = jax.devices()[: n_out * n_in]
+    import numpy as np
+
+    return jax.sharding.Mesh(
+        np.array(devs).reshape(n_out, n_in), ("dcn", "ici")
+    )
+
+
+@pytest.mark.parametrize("n_out,n_in", [(2, 4), (2, 2), (4, 2)])
+@pytest.mark.parametrize("causal", [True, False])
+def test_hierarchical_sp_attention_matches_flash(n_out, n_in, causal):
+    """Inner-ICI ring x outer-DCN superchunk hops == single-device flash
+    (VERDICT next #6; reference ``sp_ag_attention_inter_node.py:115-192``)."""
+    b, h, s, d = 1, 4, 512, 64
+    q, k, v = _inputs(b, h, h, s, d, key=7)
+    mesh = _mesh2(n_out, n_in)
+    spec = NamedSharding(mesh, P(None, None, ("dcn", "ici"), None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out = hierarchical_sp_attention(
+        qs, ks, vs, mesh, "ici", "dcn", causal=causal,
+        block_q=64, block_k=64,
+    )
+    want = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    assert out.shape == q.shape
+    assert jnp.allclose(jax.device_get(out), want, atol=2e-5, rtol=2e-5), (
+        jnp.abs(jax.device_get(out) - want).max()
+    )
+
+
+def test_hierarchical_sp_attention_gqa_and_outer1():
+    b, h, hk, s, d = 1, 8, 2, 256, 64
+    q, k, v = _inputs(b, h, hk, s, d, key=8)
+    mesh = _mesh2(2, 2)
+    spec = NamedSharding(mesh, P(None, None, ("dcn", "ici"), None))
+    spec_kv = spec
+    qs = jax.device_put(q, spec)
+    ks, vs = jax.device_put(k, spec_kv), jax.device_put(v, spec_kv)
+    out = hierarchical_sp_attention(qs, ks, vs, mesh, "ici", "dcn",
+                                    causal=True, block_q=64, block_k=64)
+    want = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    assert jnp.allclose(jax.device_get(out), want, atol=2e-5, rtol=2e-5)
+
+    # n_out == 1 degenerates to the flat ICI ring
+    mesh1 = _mesh2(1, 4)
+    spec1 = NamedSharding(mesh1, P(None, None, ("dcn", "ici"), None))
+    qs, ks, vs = (jax.device_put(x, spec1) for x in (q, k, v))
+    out1 = hierarchical_sp_attention(qs, ks, vs, mesh1, "ici", "dcn",
+                                     causal=True, block_q=64, block_k=64)
+    assert jnp.allclose(jax.device_get(out1), want, atol=2e-5, rtol=2e-5)

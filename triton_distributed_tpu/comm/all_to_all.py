@@ -223,25 +223,54 @@ def _ep_dispatch_fwd(mesh, axis, cfg, x, splits):
                  jnp.zeros((0,), x.dtype))
 
 
+def ep_dispatch_adjoint(d_recv, splits, mesh, axis, *, token_dim,
+                        config=None):
+    """Pull a cotangent on dispatch's ``recv`` zones back onto ``x``: the
+    combine, with PADDING TOKEN rows masked to zero (combine's repack
+    clips rows beyond each rank's real token count onto the last peer's
+    zone tail, gathering chunk-rounded DMA spillover; a padding row never
+    left its rank in the forward).  Exposed for straight-through
+    estimators over quantized payloads (``layers.moe`` fp8 wire)."""
+    cfg = config or AllToAllConfig()
+    dx = _ep_combine_diff(mesh, axis, cfg, token_dim, d_recv, splits)
+    n = mesh.shape[axis]
+    if n > 1:
+        totals = splits.reshape(n, -1).sum(-1)            # real rows/rank
+        rows = jnp.arange(token_dim, dtype=totals.dtype)
+        keep = (rows[None, :] < totals[:, None]).reshape(n * token_dim)
+        dx = jnp.where(keep[:, None], dx, 0).astype(dx.dtype)
+    return dx
+
+
+def ep_combine_adjoint(dback, splits, mesh, axis, *, config=None):
+    """Pull a cotangent on combine's token output back onto the zone
+    layout: the dispatch, with PADDING ZONE rows masked to zero (see
+    :func:`ep_dispatch_adjoint`; dispatch's chunk-rounded DMAs drag
+    neighboring rows into zone tails)."""
+    cfg = config or AllToAllConfig()
+    dy, _ = _ep_dispatch_diff(mesh, axis, cfg, dback, splits)
+    n = mesh.shape[axis]
+    if n > 1:
+        epr = splits.shape[0] // (n * n)
+        sent = splits.reshape(n, n, epr).sum(-1)          # [src, dst]
+        valid = sent.T.reshape(n * n)                     # [dst*n + src]
+        rows = jnp.arange(dy.shape[1], dtype=valid.dtype)
+        dy = jnp.where(
+            rows[None, :, None] < valid[:, None, None], dy, 0
+        ).astype(dy.dtype)
+    return dy
+
+
 def _ep_dispatch_bwd(mesh, axis, cfg, res, cots):
     # dispatch is a selection matrix S (each real token row lands in
-    # exactly one zone slot); its adjoint S^T is literally the combine.
+    # exactly one zone slot); its adjoint S^T is literally the combine
+    # (padding-masked, see ep_dispatch_adjoint)
     import numpy as np
 
     splits, t_loc, wit = res
     d_recv, _ = cots   # recv_splits is integer output -> float0, dropped
-    dx = ep_combine(d_recv.astype(wit.dtype), splits, mesh, axis,
-                    token_dim=t_loc, config=cfg)
-    # combine's repack clips padding rows (beyond each rank's real token
-    # count) onto the last peer's zone tail, gathering whatever chunk
-    # spillover sits there — a padding token row never left its rank in
-    # the forward, so its cotangent must be exactly zero
-    n = mesh.shape[axis]
-    if n > 1:
-        totals = splits.reshape(n, -1).sum(-1)            # real rows/rank
-        rows = jnp.arange(t_loc, dtype=totals.dtype)
-        keep = (rows[None, :] < totals[:, None]).reshape(n * t_loc)
-        dx = jnp.where(keep[:, None], dx, 0).astype(dx.dtype)
+    dx = ep_dispatch_adjoint(d_recv.astype(wit.dtype), splits, mesh, axis,
+                             token_dim=t_loc, config=cfg)
     return dx, np.zeros(splits.shape, dtype=jax.dtypes.float0)
 
 
@@ -260,27 +289,14 @@ def _ep_combine_fwd(mesh, axis, cfg, token_dim, y, splits):
 
 
 def _ep_combine_bwd(mesh, axis, cfg, token_dim, res, dback):
-    # combine = S^T, so its adjoint is the dispatch itself (via the
-    # differentiable wrapper so second-order AD keeps working)
+    # combine = S^T, so its adjoint is the dispatch itself, zone-padding-
+    # masked (see ep_combine_adjoint; routed via the differentiable
+    # wrapper inside so second-order AD keeps working)
     import numpy as np
 
     splits, wit = res
-    dy, _ = _ep_dispatch_diff(mesh, axis, cfg, dback.astype(wit.dtype),
-                              splits)
-    # dispatch's chunk-rounded DMAs drag neighboring rows into zone tails;
-    # those slots were padding in y (combine never read them), so their
-    # cotangent must be zero — mask rows beyond each zone's real count.
-    # splits reshaped (src, dst, epr): zone (r, p) holds splits[p, r].sum()
-    # real rows, laid out globally as dy[r*n + p].
-    n = mesh.shape[axis]
-    if n > 1:
-        epr = splits.shape[0] // (n * n)
-        sent = splits.reshape(n, n, epr).sum(-1)          # [src, dst]
-        valid = sent.T.reshape(n * n)                     # [dst*n + src]
-        rows = jnp.arange(dy.shape[1], dtype=valid.dtype)
-        dy = jnp.where(
-            rows[None, :, None] < valid[:, None, None], dy, 0
-        ).astype(dy.dtype)
+    dy = ep_combine_adjoint(dback.astype(wit.dtype), splits, mesh, axis,
+                            config=cfg)
     return dy, np.zeros(splits.shape, dtype=jax.dtypes.float0)
 
 

@@ -161,7 +161,13 @@ def wait(sem, value: int | jax.Array = 1) -> None:
 
 def peek(sem) -> jax.Array:
     """Non-blocking semaphore read (no reference analogue — the LL protocols
-    poll flags in data; on TPU you can poll the count directly)."""
+    poll flags in data; on TPU you can poll the count directly).
+
+    Real-hardware (Mosaic) only: the interpret backend has no
+    ``semaphore_read`` rule (its big-if dispatch covers signal/wait/DMA),
+    so under interpret mode this raises ``NotImplementedError`` from the
+    lowering.  Interpret-mode tests observe counts through exact-valued
+    ``wait`` round-trips instead (``tests/test_lang_primitives.py``)."""
     return pltpu.semaphore_read(sem)
 
 

@@ -15,21 +15,117 @@ ragged expert GEMM is ``lax.ragged_dot`` everywhere.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..comm.all_to_all import AllToAllConfig, ep_combine, ep_dispatch
+from ..comm.all_to_all import (
+    AllToAllConfig,
+    ep_combine,
+    ep_combine_adjoint,
+    ep_dispatch,
+    ep_dispatch_adjoint,
+)
 from ..core.mesh import TP_AXIS
 from ..ops.group_gemm import ag_group_gemm, moe_reduce_rs
 from ..ops.moe_utils import (
+    dequantize,
     flatten_topk,
     global_presort_index,
+    quantize_e4m3,
     sort_by_expert,
     topk_route,
     unsort_combine,
 )
+
+_FP8_SIDECAR = 128   # u8 lanes appended per row: 4 carry the f32 scale
+
+
+def _pack_fp8(x: jax.Array) -> jax.Array:
+    """Quantize rows to e4m3 and pack payload + f32 scale sidecar into ONE
+    uint8 wire message (..., H + 128): the reference's production A2A
+    configuration ships fp8 tokens with scales in the same message
+    (``low_latency_all_to_all.py:36-120``, the 137 us README case).  One
+    u8 byte per element + a 128-lane sidecar ≈ halves the wire bytes of a
+    bf16 payload."""
+    x8, scale = quantize_e4m3(x)                       # (..., H), (..., 1)
+    payload = jax.lax.bitcast_convert_type(x8, jnp.uint8)
+    sc = jax.lax.bitcast_convert_type(
+        scale.astype(jnp.float32), jnp.uint8
+    ).reshape(*x.shape[:-1], 4)
+    pad = jnp.zeros((*x.shape[:-1], _FP8_SIDECAR - 4), jnp.uint8)
+    return jnp.concatenate([payload, sc, pad], axis=-1)
+
+
+def _unpack_fp8(u8: jax.Array, h: int, out_dtype) -> jax.Array:
+    """Inverse of :func:`_pack_fp8`: split payload/scale, dequantize."""
+    x8 = jax.lax.bitcast_convert_type(u8[..., :h], jnp.float8_e4m3fn)
+    scale = jax.lax.bitcast_convert_type(
+        u8[..., h:h + 4], jnp.float32
+    )[..., None]
+    return dequantize(x8, scale, out_dtype)
+
+
+# The u8 wire is an integer path — its cotangent is float0, which would
+# silently FREEZE every gradient crossing the A2A.  The transports are
+# therefore custom-vjp'd with a straight-through estimator: forward ships
+# the quantized message, backward pulls the cotangent through the exact
+# (padding-masked) permutation adjoint at FULL precision, ignoring the
+# quantization error — the standard STE treatment of fake-quant wires.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _fp8_dispatch(mesh, axis, cfg, h, x, splits):
+    recv_u8, recv_splits = ep_dispatch(
+        _pack_fp8(x), splits, mesh, axis, config=cfg
+    )
+    return _unpack_fp8(recv_u8, h, x.dtype), recv_splits
+
+
+def _fp8_dispatch_fwd(mesh, axis, cfg, h, x, splits):
+    out = _fp8_dispatch(mesh, axis, cfg, h, x, splits)
+    return out, (splits, x.shape[0] // mesh.shape[axis],
+                 jnp.zeros((0,), x.dtype))
+
+
+def _fp8_dispatch_bwd(mesh, axis, cfg, h, res, cots):
+    import numpy as np
+
+    splits, t_loc, wit = res
+    d_recv, _ = cots
+    dx = ep_dispatch_adjoint(d_recv.astype(wit.dtype), splits, mesh, axis,
+                             token_dim=t_loc, config=cfg)
+    return dx, np.zeros(splits.shape, dtype=jax.dtypes.float0)
+
+
+_fp8_dispatch.defvjp(_fp8_dispatch_fwd, _fp8_dispatch_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _fp8_combine(mesh, axis, cfg, h, token_dim, y, splits):
+    back_u8 = ep_combine(_pack_fp8(y), splits, mesh, axis,
+                         token_dim=token_dim, config=cfg)
+    return _unpack_fp8(back_u8, h, y.dtype)
+
+
+def _fp8_combine_fwd(mesh, axis, cfg, h, token_dim, y, splits):
+    return _fp8_combine(mesh, axis, cfg, h, token_dim, y, splits), (
+        splits, jnp.zeros((0,), y.dtype)
+    )
+
+
+def _fp8_combine_bwd(mesh, axis, cfg, h, token_dim, res, dback):
+    import numpy as np
+
+    splits, wit = res
+    dy = ep_combine_adjoint(dback.astype(wit.dtype), splits, mesh, axis,
+                            config=cfg)
+    return dy, np.zeros(splits.shape, dtype=jax.dtypes.float0)
+
+
+_fp8_combine.defvjp(_fp8_combine_fwd, _fp8_combine_bwd)
 
 
 @jax.tree_util.register_dataclass
@@ -63,6 +159,10 @@ class MoEMLP:
     act: str = "silu"
     swiglu: bool = False
     renormalize: bool = True
+    # EP A2A ships e4m3 payloads + f32 scale sidecars instead of the model
+    # dtype (the reference's production low-latency A2A configuration);
+    # experts still compute in the model dtype after dequantization
+    fp8_wire: bool = False
 
     @property
     def n(self) -> int:
@@ -265,12 +365,23 @@ class MoEMLP:
         n = self.n
         e, k = self.num_experts, self.top_k
         epr = e // n
+        hdim = x.shape[-1]
+        x_dtype = x.dtype
         x_sorted, splits, wflat, unsort = self._route_and_sort(
             x, params.router
         )
-        recv, recv_splits = ep_dispatch(
-            x_sorted, splits, self.mesh, self.axis, config=a2a_config
-        )
+        fp8 = self.fp8_wire and n > 1
+        cfg = a2a_config or AllToAllConfig()
+        if fp8:
+            # quantized wire with a straight-through backward (see
+            # _fp8_dispatch); zones come back dequantized to the model dtype
+            recv, recv_splits = _fp8_dispatch(
+                self.mesh, self.axis, cfg, hdim, x_sorted, splits
+            )
+        else:
+            recv, recv_splits = ep_dispatch(
+                x_sorted, splits, self.mesh, self.axis, config=cfg
+            )
         z = recv.shape[1]
         combine = self._combine
 
@@ -296,7 +407,9 @@ class MoEMLP:
             # scatter so padding rows stay inert through the combine
             valid = jnp.arange(n * z) < gsz.sum()
             y = jnp.where(valid[:, None], y, 0)
-            return jnp.zeros_like(flat).at[order].set(y).reshape(n, z, kdim)
+            y = y.astype(x_dtype)
+            out = jnp.zeros((n * z, y.shape[-1]), y.dtype)
+            return out.at[order].set(y).reshape(n, z, -1)
 
         processed = jax.shard_map(
             local_experts, mesh=self.mesh,
@@ -308,10 +421,16 @@ class MoEMLP:
             recv_splits.reshape(n * n, epr),
             params.w_up, params.w_dn,
         )
-        back = ep_combine(
-            processed, splits, self.mesh, self.axis,
-            token_dim=x_sorted.shape[0] // n, config=a2a_config,
-        )
+        t_loc = x_sorted.shape[0] // n
+        if fp8:
+            # quantized return hop, straight-through backward
+            back = _fp8_combine(self.mesh, self.axis, cfg, hdim, t_loc,
+                                processed, splits)
+        else:
+            back = ep_combine(
+                processed, splits, self.mesh, self.axis,
+                token_dim=t_loc, config=cfg,
+            )
 
         # per-rank: unsort and weighted fold
         def fold(y_loc, unsort_loc, w_loc):

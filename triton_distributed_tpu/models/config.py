@@ -33,6 +33,9 @@ class ModelConfig:
     norm_topk: bool = True         # renormalize routing weights over top-k
     moe_strategy: str = "tp"       # "tp" (experts F-sharded) | "ep"
                                    # (experts partitioned; A2A dispatch)
+    moe_fp8_wire: bool = False     # EP A2A ships e4m3 + scale sidecars
+                                   # (reference low-latency A2A production
+                                   # config); compute stays in `dtype`
 
     @property
     def is_moe(self) -> bool:

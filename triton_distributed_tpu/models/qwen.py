@@ -156,6 +156,7 @@ class Qwen3:
         return MoEMLP(
             self.mesh, num_experts=c.num_experts, top_k=c.top_k,
             axis=self.axis, swiglu=True, renormalize=c.norm_topk,
+            fp8_wire=c.moe_fp8_wire,
         )
 
     def _mlp_forward(self, p, x: jax.Array) -> jax.Array:

@@ -35,5 +35,5 @@ from .moe_utils import (
     unsort_combine,
 )
 from .rope import apply_rope, apply_rope_at, rope_freqs
-from .sp_attention import sp_attention
+from .sp_attention import hierarchical_sp_attention, sp_attention
 from .swizzle import GroupedSchedule, grouped_tile_schedule, ring_chunk_order

@@ -137,3 +137,143 @@ def sp_attention(
          min(block_q, s_loc), min(block_k, s_loc), jnp.dtype(q.dtype)),
     )
     return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical (inter-slice) ring attention: inner=ICI ring, outer=DCN hops
+
+
+@functools.lru_cache(maxsize=None)
+def _build_hier_sp_attention(mesh: Mesh, inner_axis: str, outer_axis: str,
+                             shapes_key):
+    (b, h, hk, s_loc, d, causal, sm_scale, soft_cap, bq, bk, dtype) = shapes_key
+    n_in = mesh.shape[inner_axis]
+    n_out = mesh.shape[outer_axis]
+
+    def local_fn(q_loc, k_loc, v_loc):
+        o = jax.lax.axis_index(outer_axis)
+        i = jax.lax.axis_index(inner_axis)
+        me = o * n_in + i        # global sequence rank (outer-major layout)
+
+        def fold(state, k_c, v_c, s, t):
+            # after t outer hops and s inner rotations, the resident chunk
+            # originated at global rank ((o - t) % n_out, (i - s) % n_in)
+            src = (jax.lax.rem(o - t + n_out, n_out) * n_in
+                   + jax.lax.rem(i - s + n_in, n_in))
+            return flash_attention_chunk(
+                q_loc, k_c, v_c, state,
+                q_offset=me * s_loc, kv_offset=src * s_loc,
+                causal=causal, sm_scale=sm_scale, soft_cap=soft_cap,
+                block_q=bq, block_k=bk,
+            )
+
+        perm_in = [(j, (j + 1) % n_in) for j in range(n_in)]
+        perm_out = [(j, (j + 1) % n_out) for j in range(n_out)]
+
+        def inner_ring(k_c, v_c, state, t):
+            """One full ICI ring over the slice-resident chunk set: fold
+            the resident chunk, then n_in - 1 rotate-and-folds (the wire
+            overlaps the previous chunk's fold, as in the flat ring)."""
+            state = fold(state, k_c, v_c, 0, t)
+
+            def inner_step(c2, s):
+                k_c, v_c, state = c2
+                k_c = jax.lax.ppermute(k_c, inner_axis, perm_in)
+                v_c = jax.lax.ppermute(v_c, inner_axis, perm_in)
+                return (k_c, v_c, fold(state, k_c, v_c, s, t)), None
+
+            (k_c, v_c, state), _ = jax.lax.scan(
+                inner_step, (k_c, v_c, state), jnp.arange(1, n_in)
+            )
+            return k_c, v_c, state
+
+        def outer_body(carry, t):
+            k_c, v_c, state = carry
+            k_c, v_c, state = inner_ring(k_c, v_c, state, t)
+            # complete the inner cycle (chunks return to their in-slice
+            # home) then hop the whole slice-resident set one slice over
+            # DCN; each superchunk crosses DCN n_out - 1 times total
+            # (the last outer step is peeled below — fold only, no hops)
+            k_c = jax.lax.ppermute(k_c, inner_axis, perm_in)
+            v_c = jax.lax.ppermute(v_c, inner_axis, perm_in)
+            k_c = jax.lax.ppermute(k_c, outer_axis, perm_out)
+            v_c = jax.lax.ppermute(v_c, outer_axis, perm_out)
+            return (k_c, v_c, state), None
+
+        state0 = init_attention_state(b, h, s_loc, d)
+        (k_c, v_c, state), _ = jax.lax.scan(
+            outer_body, (k_loc, v_loc, state0), jnp.arange(n_out - 1)
+        )
+        _, _, state = inner_ring(k_c, v_c, state, n_out - 1)
+        return finalize_attention_state(state, dtype)
+
+    return compilation.jit_shard_map(
+        local_fn, mesh,
+        in_specs=(
+            P(None, None, (outer_axis, inner_axis), None),
+            P(None, None, (outer_axis, inner_axis), None),
+            P(None, None, (outer_axis, inner_axis), None),
+        ),
+        out_specs=P(None, None, (outer_axis, inner_axis), None),
+    )
+
+
+def hierarchical_sp_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    inner_axis: str,
+    outer_axis: str,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    soft_cap: float = 0.0,
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jax.Array:
+    """Ring attention composed over (outer=DCN, inner=ICI) — the TPU form
+    of the reference's dedicated inter-node SP attention
+    (``sp_ag_attention_inter_node.py:115-192``: NVSHMEM 2D push across
+    nodes + intra-node consumer), which its flat intra-node path cannot
+    serve across slices.
+
+    The sequence dim is sharded over BOTH axes (outer-major).  Each outer
+    step runs the full ICI ring within every slice (per-chunk folds with
+    the carried softmax state), then the slice-resident chunk sets hop one
+    slice over DCN — so each superchunk crosses the slow DCN links only
+    ``n_out - 1`` times (the final outer step is fold-only) while all
+    fine-grained rotation stays on ICI, mirroring the hierarchical
+    AG/RS/AR collectives (``comm/allgather.py``).
+
+    ``q``: (B, H, S, D), ``k``/``v``: (B, Hkv, S, D), sequence-sharded over
+    ``(outer_axis, inner_axis)``.  Returns the same sharding.  Golden:
+    single-device ``flash_attention`` on the gathered arrays.
+    """
+    n_in = mesh.shape[inner_axis]
+    n_out = mesh.shape[outer_axis]
+    if n_out == 1:
+        return sp_attention(
+            q, k, v, mesh, inner_axis, causal=causal, sm_scale=sm_scale,
+            soft_cap=soft_cap, block_q=block_q, block_k=block_k,
+        )
+    b, h, s_tot, d = q.shape
+    _, hk, sk, _ = k.shape
+    if v.shape != k.shape or sk != s_tot:
+        raise ValueError(f"shape mismatch: q={q.shape} k={k.shape} v={v.shape}")
+    if h % hk:
+        raise ValueError(f"GQA requires H % Hkv == 0, got {h} % {hk}")
+    n = n_in * n_out
+    if s_tot % n:
+        raise ValueError(
+            f"seq {s_tot} not divisible by "
+            f"{outer_axis}*{inner_axis} = {n}"
+        )
+    s_loc = s_tot // n
+    sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
+    fn = _build_hier_sp_attention(
+        mesh, inner_axis, outer_axis,
+        (b, h, hk, s_loc, d, bool(causal), sm_scale, float(soft_cap),
+         min(block_q, s_loc), min(block_k, s_loc), jnp.dtype(q.dtype)),
+    )
+    return fn(q, k, v)

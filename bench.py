@@ -350,30 +350,27 @@ def bench_moe_ep_wire():
     """EP A2A wire cost with the fp8 (e4m3 + scale sidecar) payload vs the
     bf16 payload (the reference's production low-latency A2A config, README
     137 us case).  ``value`` = fp8 wire bytes per token per hop;
-    ``vs_baseline`` = bf16_bytes / fp8_bytes (~2.0 = halved).  Also runs
-    one fp8 forward_ep on the available mesh as an execution check."""
+    ``vs_baseline`` = bf16_bytes / fp8_bytes (~2.0 = halved).  Execution
+    check: the pack/unpack wire codec round-trips on device at the bench
+    hidden size (forward_ep's wire path itself needs n > 1 ranks — it is
+    covered on the 8-mesh by tests/test_moe_layer.py)."""
     import numpy as np
 
-    from triton_distributed_tpu.core import mesh as mesh_lib
-    from triton_distributed_tpu.layers.moe import _FP8_SIDECAR, MoEMLP
+    from triton_distributed_tpu.layers.moe import (
+        _FP8_SIDECAR, _pack_fp8, _unpack_fp8,
+    )
 
     h = 7168                       # reference A2A case: hidden=7168
     fp8_bytes = h + _FP8_SIDECAR
     bf16_bytes = 2 * h
 
-    mesh = mesh_lib.tp_mesh()
-    ntp = mesh.shape["tp"]
-    e, k, t, ffn = 4 * max(ntp, 2), 2, 8 * ntp, 256
-    layer = MoEMLP(mesh, num_experts=e, top_k=k, fp8_wire=True)
-    params = layer.init(jax.random.key(0), 512, ffn, ep=True,
-                        dtype=jnp.bfloat16)
-    x = mesh_lib.shard(
-        mesh,
-        jnp.asarray(np.random.default_rng(0).standard_normal((t, 512)) * 0.3,
-                    jnp.bfloat16),
-        "tp", None,
-    )
-    jax.block_until_ready(layer.forward_ep(params, x))
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((64, h)) * 0.3,
+                    jnp.bfloat16)
+    packed = _pack_fp8(x)
+    assert packed.shape == (64, fp8_bytes) and packed.dtype == jnp.uint8
+    back = _unpack_fp8(packed, h, jnp.bfloat16)
+    err = jnp.abs(back.astype(jnp.float32) - x.astype(jnp.float32)).max()
+    assert float(err) < 0.1, f"fp8 wire codec round-trip error {err}"
     return {
         "metric": f"moe_ep_a2a_fp8_wire_bytes_h{h}",
         "value": fp8_bytes,

@@ -105,6 +105,7 @@ _COLLECTIVE_IDS: dict[str, int] = {
     "ep_combine": 12,
     "barrier": 13,
     "gemm_ar": 14,
+    "tutorial": 15,   # user-authored kernels in tutorials/ share one family
 }
 
 

@@ -156,10 +156,14 @@ def _build_hier_sp_attention(mesh: Mesh, inner_axis: str, outer_axis: str,
         me = o * n_in + i        # global sequence rank (outer-major layout)
 
         def fold(state, k_c, v_c, s, t):
-            # after t outer hops and s inner rotations, the resident chunk
-            # originated at global rank ((o - t) % n_out, (i - s) % n_in)
-            src = (jax.lax.rem(o - t + n_out, n_out) * n_in
-                   + jax.lax.rem(i - s + n_in, n_in))
+            # after t outer hops (each preceded by n_in - 1 inner
+            # rotations that are NOT unwound — the completion rotation is
+            # absorbed into this index instead of paying an extra ICI hop)
+            # and s inner rotations this step, the resident chunk
+            # originated at global rank
+            # ((o - t) % n_out, (i - s - t*(n_in-1)) % n_in)
+            src = (jnp.mod(o - t, n_out) * n_in
+                   + jnp.mod(i - s - t * (n_in - 1), n_in))
             return flash_attention_chunk(
                 q_loc, k_c, v_c, state,
                 q_offset=me * s_loc, kv_offset=src * s_loc,
@@ -190,12 +194,11 @@ def _build_hier_sp_attention(mesh: Mesh, inner_axis: str, outer_axis: str,
         def outer_body(carry, t):
             k_c, v_c, state = carry
             k_c, v_c, state = inner_ring(k_c, v_c, state, t)
-            # complete the inner cycle (chunks return to their in-slice
-            # home) then hop the whole slice-resident set one slice over
-            # DCN; each superchunk crosses DCN n_out - 1 times total
-            # (the last outer step is peeled below — fold only, no hops)
-            k_c = jax.lax.ppermute(k_c, inner_axis, perm_in)
-            v_c = jax.lax.ppermute(v_c, inner_axis, perm_in)
+            # hop the slice-resident set one slice over DCN WITHOUT first
+            # unwinding the inner rotation (fold's source index accounts
+            # for the accumulated in-slice offset); each superchunk
+            # crosses DCN n_out - 1 times total (the last outer step is
+            # peeled below — fold only, no hops)
             k_c = jax.lax.ppermute(k_c, outer_axis, perm_out)
             v_c = jax.lax.ppermute(v_c, outer_axis, perm_out)
             return (k_c, v_c, state), None

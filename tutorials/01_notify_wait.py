@@ -1,9 +1,39 @@
-"""Tutorial 01 — the distributed primitive vocabulary (notify/wait/remote_copy).
+"""Tutorial 01 — the distributed primitive vocabulary.
 
-Reference: 01-distributed-notify-wait.rst.  A hand-written Pallas kernel:
-every rank pushes its block to its right neighbor and waits for the left
-neighbor's block — the minimal signal/wait producer-consumer pattern all
-the library kernels are built from.
+Reference: 01-distributed-notify-wait.rst, which teaches NVSHMEM-style
+``putmem_signal`` / ``signal_wait_until`` by hand-writing a producer-
+consumer kernel.  This tutorial does the TPU-native equivalent: you will
+write THREE kernels from scratch with ``triton_distributed_tpu.lang``,
+each introducing one more primitive, ending with a complete hand-rolled
+AllGather that you can check against ``jax.lax.all_gather``.
+
+The vocabulary (see ``docs/primitives.md`` for the full semantics map):
+
+====================  ====================================================
+reference (NVSHMEM)   here
+====================  ====================================================
+``putmem_signal``     ``dl.remote_copy(src, dst, send_sem, recv_sem, id)``
+``signal_wait_until`` ``dl.wait_recv(ref, sem)`` / ``dl.wait(sem, n)``
+``signal_op(ADD)``    ``dl.notify(sem, device_id, inc=...)``
+``nvshmem_my_pe``     ``dl.rank(axis)`` / ``Team.rank()``
+``nvshmem_ptr``       logical device ids — ``Team.device_id(rank)``
+``barrier_all``       ``dl.collective_prologue`` / ``dl.barrier_all``
+====================  ====================================================
+
+Three rules carry over from the reference's programming model:
+
+1. **Barrier before the first remote write.**  A remote DMA may land in
+   a peer's buffer before that peer has entered the kernel — on hardware
+   the buffer may still be in use by the peer's PREVIOUS computation.
+   Every collective kernel opens with ``dl.collective_prologue``.
+2. **Counting, not flag values.**  TPU semaphores count.  The
+   reference's "wait until flag == 42" protocols are re-expressed as
+   "wait for N arrivals" — and a DMA's completion semaphore counts the
+   transfer itself, so data arrival needs no separate flag at all.
+3. **Balance every semaphore.**  Each ``remote_copy`` leaves one count
+   on the sender's ``send_sem`` and one on the receiver's ``recv_sem``;
+   each must be consumed exactly once (``wait_send`` / ``wait_recv``) or
+   the NEXT invocation of the kernel inherits the residue.
 """
 
 from common import bootstrap
@@ -13,6 +43,7 @@ jax, mesh_lib = bootstrap()
 import functools
 
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
@@ -21,46 +52,157 @@ from triton_distributed_tpu.core import compilation
 from triton_distributed_tpu.lang import primitives as dl
 from triton_distributed_tpu.lang.primitives import Team
 
-
-def shift_kernel(team, x_ref, out_ref, send_sem, recv_sem):
-    # 1. barrier before the first remote write (EVERY collective kernel)
-    dl.collective_prologue(team, neighbors_only=True)
-    # 2. push my block into my RIGHT neighbor's output...
-    _, right = team.neighbor_ranks()
-    dl.remote_copy(x_ref, out_ref, send_sem, recv_sem, team.device_id(right))
-    # 3. ...and wait until my LEFT neighbor's block has landed in mine
-    dl.wait_recv(out_ref, recv_sem)
-    # 4. drain my own send so repeated calls start balanced
-    dl.wait_send(x_ref, send_sem)
+N = 8
+BLOCK = (8, 128)   # sublane x lane granule: keep the last dim at 128
 
 
-def main():
-    mesh = mesh_lib.tp_mesh(8)
-    team = Team.of(mesh, "tp")
+def _build(team, kernel, out_rows, scratch_shapes):
+    """Boilerplate shared by the three kernels: a pallas_call under
+    shard_map over the tp axis.  ``collective_id`` keys the global barrier
+    semaphore — CONCURRENT collectives must not share a family, but these
+    kernels run sequentially, so they share the registered "tutorial" id
+    (counting barriers leave no residue between launches)."""
     call = pl.pallas_call(
-        functools.partial(shift_kernel, team),
-        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        functools.partial(kernel, team),
+        out_shape=jax.ShapeDtypeStruct((out_rows, BLOCK[1]), jnp.float32),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[pltpu.SemaphoreType.DMA(())] * 2,
+        scratch_shapes=scratch_shapes,
         compiler_params=compilation.compiler_params(
-            collective=True, collective_id=compilation.collective_id("test")
+            collective=True, collective_id=compilation.collective_id("tutorial")
         ),
         interpret=compilation.interpret_mode(),
     )
-    fn = compilation.jit_shard_map(
+    mesh = mesh_lib.tp_mesh(N)
+    return compilation.jit_shard_map(
         call, mesh, in_specs=P("tp", None), out_specs=P("tp", None)
     )
-    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(64, 128)
-    xs = mesh_lib.shard(mesh, x, "tp", None)
-    out = jax.device_get(fn(xs))
-    # rank r now holds rank r-1's block
-    import numpy as np
 
-    perm = np.array([7, 0, 1, 2, 3, 4, 5, 6])
-    np.testing.assert_array_equal(out.reshape(8, 8, 128),
-                                  np.asarray(x).reshape(8, 8, 128)[perm])
-    print("ring shift via notify/wait OK")
+
+# ---------------------------------------------------------------------------
+# Kernel 1: ring shift — one remote_copy, the smallest possible collective
+
+
+def shift_kernel(team, x_ref, out_ref, send_sem, recv_sem):
+    # (rule 1) neighbors_only suffices: only ring neighbors write to us
+    dl.collective_prologue(team, neighbors_only=True)
+    # push my block into my RIGHT neighbor's out_ref.  The DMA is
+    # addressed by LOGICAL device id: team.device_id translates a
+    # tp-axis rank into the mesh-wide id (on a multi-axis mesh they
+    # differ — see Team's docstring).
+    _, right = team.neighbor_ranks()
+    dl.remote_copy(x_ref, out_ref, send_sem, recv_sem, team.device_id(right))
+    # (rule 2) the receive IS the signal: waiting on recv_sem for one
+    # out_ref-shaped transfer blocks until my LEFT neighbor's push landed
+    dl.wait_recv(out_ref, recv_sem)
+    # (rule 3) drain my own send so repeated calls start balanced
+    dl.wait_send(x_ref, send_sem)
+
+
+# ---------------------------------------------------------------------------
+# Kernel 2: notify/wait — decoupled signaling (the producer-consumer
+# pattern).  Data moves as in kernel 1, but the CONSUMER only proceeds
+# once the producer raises an application-level semaphore — the shape of
+# every "tile ready" protocol in the fused ops (ops/ag_gemm.py waits
+# per-chunk exactly like this).
+
+
+def handshake_kernel(team, x_ref, out_ref, ready, send_sem, recv_sem):
+    dl.collective_prologue(team, neighbors_only=True)
+    _, right = team.neighbor_ranks()
+    copy = dl.remote_copy(x_ref, out_ref, send_sem, recv_sem,
+                          team.device_id(right))
+    copy.wait()                          # both sems of MY transfer consumed
+    # application-level signal: "your input is ready" (counting ADD)
+    dl.notify(ready, team.device_id(right), inc=1)
+    # consumer side: block until MY producer says go, then transform
+    dl.wait(ready, 1)
+
+    def scale(scratch, sem):
+        dl.local_copy(out_ref, scratch, sem).wait()
+        scratch[:] = scratch[:] * 2.0
+        dl.local_copy(scratch, out_ref, sem).wait()
+
+    pl.run_scoped(scale, pltpu.VMEM(BLOCK, jnp.float32),
+                  pltpu.SemaphoreType.DMA)
+
+
+# ---------------------------------------------------------------------------
+# Kernel 3: a complete one-shot AllGather, hand-rolled.  Every rank
+# pushes its block to EVERY peer's slot[me]; per-source recv semaphores
+# tell each rank when each slot is live.  This is precisely
+# comm/allgather.py's PUSH_1SHOT method, minus its production niceties —
+# after this kernel, that file should read like your own code.
+
+
+def all_gather_kernel(team, x_ref, out_ref, local_sem, send_sem, recv_sems):
+    me, n = team.rank(), team.size
+    rows = x_ref.shape[0]
+    # own block into its slot (async local DMA; overlaps the barrier)
+    own = dl.local_copy(x_ref, out_ref.at[pl.ds(me * rows, rows)], local_sem)
+    dl.collective_prologue(team)         # full barrier: everyone writes us
+    # push to every peer, staggered so the ring links aren't hot-spotted
+    for off in range(1, n):
+        dst = jax.lax.rem(me + off, n)
+        dl.remote_copy(
+            x_ref, out_ref.at[pl.ds(me * rows, rows)],
+            send_sem, recv_sems.at[me], team.device_id(dst),
+        )
+    own.wait()
+    # per-source arrival: slot p is live once ITS semaphore counts one
+    # x-shaped transfer (rule 2: no flags — the DMA itself signals)
+    for p in range(n):
+
+        @pl.when(jnp.int32(p) != me)
+        def _(p=p):
+            dl.wait_recv(out_ref.at[pl.ds(p * rows, rows)], recv_sems.at[p])
+
+    # (rule 3) n-1 outgoing sends to drain
+    for _ in range(n - 1):
+        dl.wait_send(x_ref, send_sem)
+
+
+def main():
+    mesh = mesh_lib.tp_mesh(N)
+    team = Team.of(mesh, "tp")
+    x = jnp.arange(N * BLOCK[0] * BLOCK[1], dtype=jnp.float32).reshape(
+        N * BLOCK[0], BLOCK[1]
+    )
+    xs = mesh_lib.shard(mesh, x, "tp", None)
+    xr = np.asarray(x).reshape(N, *BLOCK)
+
+    # 1. ring shift: rank r ends with rank r-1's block
+    fn = _build(team, shift_kernel, BLOCK[0],
+                [pltpu.SemaphoreType.DMA(())] * 2)
+    out = np.asarray(jax.device_get(fn(xs))).reshape(N, *BLOCK)
+    np.testing.assert_array_equal(out, xr[np.r_[N - 1, 0:N - 1]])
+    print("1. ring shift (remote_copy + wait_recv/wait_send)     OK")
+
+    # 2. handshake: shifted AND doubled, gated by notify/wait
+    fn = _build(
+        team, handshake_kernel, BLOCK[0],
+        [pltpu.SemaphoreType.REGULAR, pltpu.SemaphoreType.DMA(()),
+         pltpu.SemaphoreType.DMA(())],
+    )
+    out = np.asarray(jax.device_get(fn(xs))).reshape(N, *BLOCK)
+    np.testing.assert_array_equal(out, 2.0 * xr[np.r_[N - 1, 0:N - 1]])
+    print("2. producer-consumer handshake (notify/wait)          OK")
+
+    # 3. hand-rolled AllGather: replicated output == the whole input, and
+    # identical to the XLA collective
+    fn = _build(
+        team, all_gather_kernel, N * BLOCK[0],
+        [pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(()),
+         pltpu.SemaphoreType.DMA((N,))],
+    )
+    # out_specs P("tp") stacks each device's replicated copy: every one of
+    # the N copies must be the whole of x
+    out = np.asarray(jax.device_get(fn(xs))).reshape(N, N * BLOCK[0], BLOCK[1])
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], np.asarray(x))
+    print("3. hand-rolled one-shot AllGather == lax.all_gather   OK")
+    print("\nNext: tutorials 02-06 use the production comm/ kernels these "
+          "patterns grow into; 07-08 fuse them INTO matmuls.")
 
 
 if __name__ == "__main__":

@@ -1,10 +1,17 @@
-"""Tutorial 09 — long-context attention: SP ring prefill + distributed
-flash-decode.
+"""Tutorial 09 — long-context attention: SP ring prefill, inter-slice
+hierarchy, and distributed flash-decode (contiguous + paged).
 
 Prefill: KV chunks rotate the ring (ppermute) while each rank folds the
 resident chunk into a carried online-softmax state — peak memory one extra
-chunk, wire overlapped with MXU.  Decode: each rank runs split-KV over its
-cache slice; the tiny (num, max, den) states merge associatively.
+chunk, wire overlapped with MXU.  Across SLICES, the hierarchical variant
+runs a full ICI ring per slice per outer step and hops the slice-resident
+chunk set over DCN only n_out - 1 times (reference inter-node SP
+attention, ``sp_ag_attention_inter_node.py``).
+
+Decode: each rank runs split-KV over its cache slice; the tiny
+(num, max, den) softmax states merge associatively across splits AND
+ranks — the paged variant reads its slice through a block table with
+ragged per-sequence lengths (reference ``sp_flash_decode_layer.py``).
 """
 
 from common import bootstrap
@@ -18,8 +25,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from triton_distributed_tpu.ops import (
     decode_attention,
     flash_attention,
+    hierarchical_sp_attention,
     sp_attention,
     sp_flash_decode,
+    sp_paged_flash_decode,
 )
 
 
@@ -38,14 +47,47 @@ def main():
     want = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
     np.testing.assert_allclose(np.asarray(jax.device_get(out)),
                                np.asarray(want), atol=2e-5, rtol=2e-5)
-    print("SP ring prefill OK:", out.shape)
+    print("1. SP ring prefill OK:", out.shape)
+
+    # inter-slice: 2 slices x 4 devices; same math, DCN traffic bounded
+    hmesh = jax.sharding.Mesh(
+        np.array(jax.devices()[:n]).reshape(2, n // 2), ("dcn", "ici")
+    )
+    hspec = NamedSharding(hmesh, P(None, None, ("dcn", "ici"), None))
+    qh, kh, vh = (jax.device_put(t, hspec) for t in (q, k, v))
+    outh = hierarchical_sp_attention(qh, kh, vh, hmesh, "ici", "dcn",
+                                     causal=True, block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(jax.device_get(outh)),
+                               np.asarray(want), atol=2e-5, rtol=2e-5)
+    print("2. hierarchical (2 slices x 4) prefill OK")
 
     qd = jax.random.normal(kd, (b, h, d), jnp.float32)
     outd = sp_flash_decode(qd, ks, vs, 900, mesh, axis="sp", n_split=2)
     wantd = decode_attention(qd, k, v, 900)
     np.testing.assert_allclose(np.asarray(jax.device_get(outd)),
                                np.asarray(wantd), atol=2e-5, rtol=2e-5)
-    print("SP flash-decode OK:", outd.shape)
+    print("3. SP flash-decode OK:", outd.shape)
+
+    # paged: each rank's slice lives in 4 pages of 32 rows, addressed
+    # through a per-rank block table (identity map here; any bijection
+    # works — see tests/test_paged_cache.py for randomized maps)
+    ps, mp = 32, (s // n) // 32
+    pool_k = np.asarray(k).reshape(b, hk, n, mp, ps, d)[0].transpose(
+        1, 2, 0, 3, 4
+    ).reshape(n * mp, hk, ps, d)
+    pool_v = np.asarray(v).reshape(b, hk, n, mp, ps, d)[0].transpose(
+        1, 2, 0, 3, 4
+    ).reshape(n * mp, hk, ps, d)
+    tables = np.broadcast_to(
+        np.arange(mp, dtype=np.int32)[None, None, :], (n, b, mp)
+    ).copy()
+    outp = sp_paged_flash_decode(
+        qd, jnp.asarray(pool_k), jnp.asarray(pool_v), jnp.asarray(tables),
+        jnp.asarray([900], np.int32), mesh, axis="sp",
+    )
+    np.testing.assert_allclose(np.asarray(jax.device_get(outp)),
+                               np.asarray(wantd), atol=2e-5, rtol=2e-5)
+    print("4. paged SP flash-decode (block table, ragged lens) OK")
 
 
 if __name__ == "__main__":

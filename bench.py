@@ -41,7 +41,12 @@ def _bench_interleaved(engines: dict, iters: int = 64, rounds: int = 9) -> dict:
 
     for fn in engines.values():  # warmup/compile
         sync(fn())
-    raw = interleaved_slope_samples(engines, iters, rounds)
+    # auto-raise each engine's trip count to a ~150 ms timing window: a
+    # fixed iter count leaves fast kernels with jitter-sized windows when
+    # the chip is in a slow state (measured: the attention kernel read 20
+    # TFLOP/s on a 50 ms window and 90+ on calibrated windows, same code)
+    raw = interleaved_slope_samples(engines, iters, rounds,
+                                    target_window_s=0.15)
     # negative slope = sync noise swamped the round
     times = {
         name: [dt if dt > 0 else float("nan") for dt in xs]

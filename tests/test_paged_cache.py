@@ -165,6 +165,26 @@ def test_paged_engine_matches_contiguous(n):
     np.testing.assert_array_equal(toks_c, toks_p)
 
 
+def test_paged_engine_with_ar_decode_mode():
+    """The feature matrix composes: paged cache x fast-AR decode mode
+    produce the same greedy tokens as the contiguous psum engine."""
+    cfg = ModelConfig(
+        num_layers=2, hidden=64, intermediate=128, num_heads=4,
+        num_kv_heads=2, head_dim=32, vocab=128, max_length=64,
+        dtype=jnp.float32,
+    )
+    mesh = make_mesh({TP_AXIS: 2}, devices=jax.devices()[:2])
+    ids = jax.random.randint(jax.random.key(51), (2, 12), 0, cfg.vocab)
+    base = Engine.build(cfg, mesh, key=jax.random.key(50), batch=2)
+    combo = Engine.build(cfg, mesh, key=jax.random.key(50), batch=2,
+                         cache_layout="paged", page_size=16,
+                         decode_mode="ar")
+    np.testing.assert_array_equal(
+        np.asarray(base.generate(ids, 5)),
+        np.asarray(combo.generate(ids, 5)),
+    )
+
+
 def test_paged_model_ragged_decode():
     """Ragged serving: two sequences at different lengths decode in one
     batch and each matches its own single-sequence contiguous decode."""

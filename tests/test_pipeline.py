@@ -33,6 +33,35 @@ def test_pipeline_matches_sequential(n, micro):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("n,micro", [(2, 2), (4, 4)])
+def test_pipeline_training_grads(n, micro):
+    """A pipelined TRAINING step: jax.grad differentiates straight through
+    the tick scan (ppermute's adjoint is the reverse hop), so per-stage
+    parameter gradients match the unpipelined stack — pipeline-parallel
+    training the reference does not have (SURVEY.md 2.5)."""
+    mesh = make_mesh({"pp": n}, devices=jax.devices()[:n])
+    b, h = 16, 32
+    key = jax.random.key(7)
+    ws = jax.random.normal(key, (n, h, h), jnp.float32) * 0.5
+    x = jax.random.normal(jax.random.fold_in(key, 1), (b, h), jnp.float32)
+    ws_sharded = jax.device_put(ws, NamedSharding(mesh, P("pp", None, None)))
+
+    def loss_pp(w):
+        y = pipeline_forward(_stage, w, x, mesh, "pp",
+                             num_microbatches=micro)
+        return jnp.mean(jnp.square(y))
+
+    def loss_seq(w):
+        y = x
+        for s in range(n):
+            y = _stage(w[s], y)
+        return jnp.mean(jnp.square(y))
+
+    g_pp = np.asarray(jax.device_get(jax.jit(jax.grad(loss_pp))(ws_sharded)))
+    g_seq = np.asarray(jax.grad(loss_seq)(ws))
+    np.testing.assert_allclose(g_pp, g_seq, rtol=1e-4, atol=1e-5)
+
+
 def test_pipeline_single_stage_fallback():
     mesh = make_mesh({"pp": 1}, devices=jax.devices()[:1])
     ws = jax.random.normal(jax.random.key(2), (1, 8, 8), jnp.float32)

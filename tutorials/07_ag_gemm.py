@@ -90,8 +90,9 @@ def main():
     # Speed-of-light model: a perfect fusion costs max(wire, compute), an
     # unfused pipeline costs their sum (tools/perf_model.py — the
     # reference's gemm_perf_model.py:232 analogue).
-    t_gemm = perf_model.gemm_sol_ms(m, nn // n, k, jnp.bfloat16)
-    t_wire = perf_model.allgather_sol_ms((m // n) * k * 2, n)
+    dtype_bytes = jnp.dtype(a.dtype).itemsize
+    t_gemm = perf_model.gemm_sol_ms(m, nn // n, k, a.dtype)
+    t_wire = perf_model.allgather_sol_ms((m // n) * k * dtype_bytes, n)
     print(f"3. SOL model at this shape: compute {t_gemm * 1e3:.1f} us, "
           f"wire {t_wire * 1e3:.1f} us -> fused bound "
           f"{max(t_gemm, t_wire) * 1e3:.1f} us vs unfused "

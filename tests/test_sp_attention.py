@@ -2,6 +2,7 @@
 (reference ``test_sp_ag_attention`` strategy)."""
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -105,10 +106,33 @@ def test_sp_attention_single_rank_fallback():
     assert jnp.allclose(out, want, atol=0, rtol=0)
 
 
+@pytest.mark.parametrize("n", [2, 4])
+@pytest.mark.parametrize("causal", [True, False])
+def test_sp_attention_varlen_segments(n, causal):
+    """PACKED variable-length batches through the ring: segment ids rotate
+    alongside the KV chunks, and every position attends only within its
+    own segment — the reference SP attention's cu_seqlens support
+    (``sp_ag_attention_intra_node.py`` varlen path)."""
+    b, h, s, d = 1, 4, 512, 64
+    q, k, v = _inputs(b, h, h, s, d, key=9)
+    # three packed sequences of uneven length (cu_seqlens 0, 200, 344, 512)
+    segs = jnp.asarray(
+        np.repeat([0, 1, 2], [200, 144, 168])[None, :], jnp.int32
+    )
+    mesh = _mesh(n)
+    qs, ks, vs = _shard(mesh, q, k, v)
+    segs_s = jax.device_put(segs, NamedSharding(mesh, P(None, SP_AXIS)))
+    out = sp_attention(qs, ks, vs, mesh, causal=causal, block_q=64,
+                       block_k=64, segment_ids=segs_s)
+    want = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64,
+                           segment_ids=segs)
+    assert jnp.allclose(jax.device_get(out), want, atol=2e-5, rtol=2e-5), (
+        jnp.abs(jax.device_get(out) - want).max()
+    )
+
+
 def _mesh2(n_out, n_in):
     devs = jax.devices()[: n_out * n_in]
-    import numpy as np
-
     return jax.sharding.Mesh(
         np.array(devs).reshape(n_out, n_in), ("dcn", "ici")
     )

@@ -266,38 +266,46 @@ def _attn_chunk_kernel(
     bq: int,
     bk: int,
     causal: bool,
+    has_segs: bool,
     sm_scale: float,
     soft_cap: float,
-    off_ref,   # (2,) int32 [q_off, kv_off] absolute offsets     [SMEM]
-    q_ref,     # (1, bq, d)     VMEM
-    k_ref,     # (1, seq_c, d)  VMEM — this chunk's K
-    v_ref,     # (1, seq_c, d)  VMEM
-    m_in,      # (1, bq)  f32 carried max
-    l_in,      # (1, bq)  f32 carried denominator
-    acc_in,    # (1, bq, d) f32 carried numerator
-    m_out,
-    l_out,
-    acc_out,
+    *refs,
+    # refs: off (2,) int32 [q_off, kv_off] SMEM; q (1, bq, d);
+    # k/v (1, seq_c, d); [seg_q (1, bq), seg_kv (1, seq_c) when has_segs];
+    # m/l/acc in; m/l/acc out
 ):
     """One online-softmax pass of a KV *chunk* against a q block, reading and
     writing the carried (m, l, acc) state — the consumer step of ring/SP
     attention (reference ``sp_ag_attention_intra_node.py:256``: consumer
-    causal flash-attn over per-chunk arrivals).  Causality is enforced in
-    ABSOLUTE positions via the scalar offsets, so the same kernel serves
-    every (rank, ring-step) pair; chunks entirely in the future contribute
-    zero blocks (the kv loop bound clamps to 0) and the state passes
-    through."""
+    causal flash-attn over per-chunk arrivals; its varlen cu_seqlens support
+    is the segment-id mask here).  Causality is enforced in ABSOLUTE
+    positions via the scalar offsets, so the same kernel serves every
+    (rank, ring-step) pair; chunks entirely in the future contribute zero
+    blocks (the kv loop bound clamps to 0) and the state passes through."""
+    if has_segs:
+        (off_ref, q_ref, k_ref, v_ref, sq_ref, sk_ref,
+         m_in, l_in, acc_in, m_out, l_out, acc_out) = refs
+    else:
+        (off_ref, q_ref, k_ref, v_ref,
+         m_in, l_in, acc_in, m_out, l_out, acc_out) = refs
+        sq_ref = sk_ref = None
     iq = pl.program_id(1)
     q_off, kv_off = off_ref[0], off_ref[1]
     q = _scaled_q(q_ref[0], sm_scale)                  # (bq, d)
+    sq = sq_ref[0] if has_segs else None               # (bq,)
     m0 = m_in[0][:, None]                              # (bq, 1)
     l0 = l_in[0][:, None]
     acc0 = acc_in[0]                                   # (bq, d)
 
+    def seg_mask_at(j):
+        sk = sk_ref[0, pl.ds(j * bk, bk)]              # (bk,)
+        return sq[:, None] == sk[None, :]
+
     def body_interior(j, carry):
         k = k_ref[0, pl.ds(j * bk, bk)]
         v = v_ref[0, pl.ds(j * bk, bk)]
-        return _tile_update(q, k, v, None, soft_cap, carry)
+        mask = seg_mask_at(j) if has_segs else None
+        return _tile_update(q, k, v, mask, soft_cap, carry)
 
     def body_diagonal(j, carry):
         k = k_ref[0, pl.ds(j * bk, bk)]
@@ -308,11 +316,14 @@ def _attn_chunk_kernel(
         kpos = kv_off + j * bk + jax.lax.broadcasted_iota(
             jnp.int32, (q.shape[0], bk), 1
         )
-        return _tile_update(q, k, v, qpos >= kpos, soft_cap, carry)
+        mask = qpos >= kpos
+        if has_segs:
+            mask = mask & seg_mask_at(j)
+        return _tile_update(q, k, v, mask, soft_cap, carry)
 
     if causal:
         # kv blocks whose first position is <= this q block's last position;
-        # blocks entirely below the diagonal skip the mask arithmetic
+        # blocks entirely below the diagonal skip the causal arithmetic
         q_min = q_off + iq * bq
         q_max = q_min + bq - 1
         nkv = jnp.clip((q_max - kv_off) // bk + 1, 0, seq_c // bk)
@@ -329,11 +340,12 @@ def _attn_chunk_kernel(
 
 
 @functools.lru_cache(maxsize=None)
-def _build_attn_chunk(b, h, hk, seq_q, seq_c, d, bq, bk, causal, sm_scale,
-                      soft_cap):
+def _build_attn_chunk(b, h, hk, seq_q, seq_c, d, bq, bk, causal, has_segs,
+                      sm_scale, soft_cap):
     group = h // hk
     kernel = functools.partial(
-        _attn_chunk_kernel, seq_c, bq, bk, causal, sm_scale, soft_cap
+        _attn_chunk_kernel, seq_c, bq, bk, causal, has_segs, sm_scale,
+        soft_cap,
     )
     kv_spec = pl.BlockSpec(
         (1, seq_c, d),
@@ -341,18 +353,22 @@ def _build_attn_chunk(b, h, hk, seq_q, seq_c, d, bq, bk, causal, sm_scale,
     )
     state2_spec = pl.BlockSpec((1, bq), lambda bh, iq: (bh, iq))
     state3_spec = pl.BlockSpec((1, bq, d), lambda bh, iq: (bh, iq, 0))
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, bq, d), lambda bh, iq: (bh, iq, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    if has_segs:
+        in_specs += [
+            pl.BlockSpec((1, bq), lambda bh, iq: (bh // h, iq)),
+            pl.BlockSpec((1, seq_c), lambda bh, iq: (bh // h, 0)),
+        ]
+    in_specs += [state2_spec, state2_spec, state3_spec]
     call = pl.pallas_call(
         kernel,
         grid=(b * h, seq_q // bq),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq, d), lambda bh, iq: (bh, iq, 0)),
-            kv_spec,
-            kv_spec,
-            state2_spec,
-            state2_spec,
-            state3_spec,
-        ],
+        in_specs=in_specs,
         out_specs=[state2_spec, state2_spec, state3_spec],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, seq_q), jnp.float32),
@@ -390,13 +406,18 @@ def flash_attention_chunk(
     soft_cap: float = 0.0,
     block_q: int = 512,
     block_k: int = 512,
+    segment_ids_q: jax.Array | None = None,
+    segment_ids_kv: jax.Array | None = None,
 ):
     """Fold one KV chunk into a carried attention state.
 
     ``q``: (B, H, Sq, D) at absolute positions ``q_offset + [0, Sq)``;
     ``k``/``v``: (B, Hkv, Sc, D) chunk at ``kv_offset + [0, Sc)``;
     ``state``: from :func:`init_attention_state` or a previous call.
-    Returns the updated state; normalize with
+    ``segment_ids_q`` (B, Sq) / ``segment_ids_kv`` (B, Sc): optional
+    PACKED-varlen masking (the reference SP attention's cu_seqlens
+    support) — positions attend only within their segment; pass both or
+    neither.  Returns the updated state; normalize with
     :func:`finalize_attention_state` after the last chunk.
     """
     b, h, seq_q, d = q.shape
@@ -405,22 +426,37 @@ def flash_attention_chunk(
         raise ValueError(f"shape mismatch: q={q.shape} k={k.shape} v={v.shape}")
     if h % hk:
         raise ValueError(f"GQA requires H % Hkv == 0, got {h} % {hk}")
+    has_segs = segment_ids_q is not None
+    if has_segs != (segment_ids_kv is not None):
+        raise ValueError("pass both segment_ids_q and segment_ids_kv or neither")
+    if has_segs and (segment_ids_q.shape != (b, seq_q)
+                     or segment_ids_kv.shape != (b, seq_c)):
+        raise ValueError(
+            f"segment ids {segment_ids_q.shape}/{segment_ids_kv.shape} != "
+            f"({b}, {seq_q})/({b}, {seq_c})"
+        )
     sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
     bq = clip_block(min(block_q, seq_q), seq_q)
     bk = clip_block(min(block_k, seq_c), seq_c)
     fn = _build_attn_chunk(
-        b, h, hk, seq_q, seq_c, d, bq, bk, bool(causal), sm_scale,
+        b, h, hk, seq_q, seq_c, d, bq, bk, bool(causal), has_segs, sm_scale,
         float(soft_cap),
     )
     m, l, acc = state
     offs = jnp.stack([
         jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)
     ])
-    m1, l1, acc1 = fn(
+    args = [
         offs,
         q.reshape(b * h, seq_q, d),
         k.reshape(b * hk, seq_c, d),
         v.reshape(b * hk, seq_c, d),
+    ]
+    if has_segs:
+        args += [segment_ids_q.astype(jnp.int32),
+                 segment_ids_kv.astype(jnp.int32)]
+    m1, l1, acc1 = fn(
+        *args,
         m.reshape(b * h, seq_q),
         l.reshape(b * h, seq_q),
         acc.reshape(b * h, seq_q, d),

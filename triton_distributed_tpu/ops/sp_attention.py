@@ -45,13 +45,15 @@ from .attention import (
 
 @functools.lru_cache(maxsize=None)
 def _build_sp_attention(mesh: Mesh, axis: str, shapes_key):
-    (b, h, hk, s_loc, d, causal, sm_scale, soft_cap, bq, bk, dtype) = shapes_key
+    (b, h, hk, s_loc, d, causal, has_segs, sm_scale, soft_cap, bq, bk,
+     dtype) = shapes_key
     n = mesh.shape[axis]
 
-    def local_fn(q_loc, k_loc, v_loc):
+    def local_fn(q_loc, k_loc, v_loc, *segs):
         r = jax.lax.axis_index(axis)
+        sq_loc = segs[0] if has_segs else None     # (B, s_loc) my q segs
 
-        def fold(state, k_c, v_c, s):
+        def fold(state, k_c, v_c, sk_c, s):
             # chunk resident after s rotations came from rank (r - s) mod n
             src = jax.lax.rem(r - s + n, n)
             return flash_attention_chunk(
@@ -59,14 +61,18 @@ def _build_sp_attention(mesh: Mesh, axis: str, shapes_key):
                 q_offset=r * s_loc, kv_offset=src * s_loc,
                 causal=causal, sm_scale=sm_scale, soft_cap=soft_cap,
                 block_q=bq, block_k=bk,
+                segment_ids_q=sq_loc,
+                segment_ids_kv=sk_c if has_segs else None,
             )
 
         # own chunk first, then n-1 rotate-and-fold steps (no final wasted
-        # rotation)
-        state0 = fold(init_attention_state(b, h, s_loc, d), k_loc, v_loc, 0)
+        # rotation); under varlen the KV SEGMENT IDS rotate alongside K/V
+        sk0 = segs[0] if has_segs else None
+        state0 = fold(init_attention_state(b, h, s_loc, d),
+                      k_loc, v_loc, sk0, 0)
 
         def step(carry, s):
-            k_c, v_c, state = carry
+            k_c, v_c, sk_c, state = carry
             # the incoming rotation for step s and the fold of step s-1
             # both hang off step s-1's chunk — XLA overlaps wire and MXU.
             # (Interpret mode runs the permute rendezvous and the Pallas
@@ -75,20 +81,25 @@ def _build_sp_attention(mesh: Mesh, axis: str, shapes_key):
             perm = [(i, (i + 1) % n) for i in range(n)]
             k_c = jax.lax.ppermute(k_c, axis, perm)
             v_c = jax.lax.ppermute(v_c, axis, perm)
-            return (k_c, v_c, fold(state, k_c, v_c, s)), None
+            if has_segs:
+                sk_c = jax.lax.ppermute(sk_c, axis, perm)
+            return (k_c, v_c, sk_c, fold(state, k_c, v_c, sk_c, s)), None
 
-        (k_f, v_f, state), _ = jax.lax.scan(
-            step, (k_loc, v_loc, state0), jnp.arange(1, n)
+        sk_init = sk0 if has_segs else jnp.zeros((), jnp.int32)
+        (k_f, v_f, sk_f, state), _ = jax.lax.scan(
+            step, (k_loc, v_loc, sk_init, state0), jnp.arange(1, n)
         )
-        del k_f, v_f
+        del k_f, v_f, sk_f
         return finalize_attention_state(state, dtype)
 
+    seg_specs = (P(None, axis),) if has_segs else ()
     return compilation.jit_shard_map(
         local_fn, mesh,
         in_specs=(
             P(None, None, axis, None),
             P(None, None, axis, None),
             P(None, None, axis, None),
+            *seg_specs,
         ),
         out_specs=P(None, None, axis, None),
     )
@@ -106,14 +117,18 @@ def sp_attention(
     soft_cap: float = 0.0,
     block_q: int = 512,
     block_k: int = 512,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Attention over a sequence-sharded (B, H, S, D) tensor set (reference
     host entry ``sp_ag_attention_intra_node.py:430-521``).
 
     ``q``: (B, H, S, D) and ``k``/``v``: (B, Hkv, S, D), all sharded on the
-    sequence dim over ``axis``.  Returns (B, H, S, D) with the same
-    sharding.  Golden: single-device ``flash_attention`` on the gathered
-    arrays.
+    sequence dim over ``axis``.  ``segment_ids``: optional (B, S) int32 for
+    PACKED variable-length batches (the reference's varlen cu_seqlens
+    support) — positions attend only within their segment; the KV segment
+    ids rotate around the ring alongside the chunks.  Returns (B, H, S, D)
+    with the same sharding.  Golden: single-device ``flash_attention`` on
+    the gathered arrays.
     """
     n = mesh.shape[axis]
     b, h, s_tot, d = q.shape
@@ -122,10 +137,14 @@ def sp_attention(
         raise ValueError(f"shape mismatch: q={q.shape} k={k.shape} v={v.shape}")
     if h % hk:
         raise ValueError(f"GQA requires H % Hkv == 0, got {h} % {hk}")
+    if segment_ids is not None and segment_ids.shape != (b, s_tot):
+        raise ValueError(
+            f"segment_ids {segment_ids.shape} != (B, S) = ({b}, {s_tot})"
+        )
     if n == 1:
         return flash_attention(
             q, k, v, causal=causal, sm_scale=sm_scale, soft_cap=soft_cap,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, segment_ids=segment_ids,
         )
     if s_tot % n:
         raise ValueError(f"seq {s_tot} not divisible by {axis}={n}")
@@ -133,9 +152,12 @@ def sp_attention(
     sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
     fn = _build_sp_attention(
         mesh, axis,
-        (b, h, hk, s_loc, d, bool(causal), sm_scale, float(soft_cap),
+        (b, h, hk, s_loc, d, bool(causal), segment_ids is not None,
+         sm_scale, float(soft_cap),
          min(block_q, s_loc), min(block_k, s_loc), jnp.dtype(q.dtype)),
     )
+    if segment_ids is not None:
+        return fn(q, k, v, segment_ids.astype(jnp.int32))
     return fn(q, k, v)
 
 

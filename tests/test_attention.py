@@ -110,6 +110,46 @@ def test_decode_attention_golden(n_split, h, hk):
     )
 
 
+def test_decode_attention_ragged_lengths():
+    """(B,) per-sequence kv_len: each row masks at its OWN length — the
+    contiguous cache's ragged-serving story (the paged kernel's lens
+    semantics, on the flat layout)."""
+    b, h, hk, skv, d = 3, 8, 4, 512, 64
+    lens = jnp.asarray([300, 17, 512], jnp.int32)
+    kq, kk, kv = jax.random.split(jax.random.key(14), 3)
+    q = jax.random.normal(kq, (b, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, hk, skv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, hk, skv, d), jnp.float32)
+    out = decode_attention(q, k, v, lens, n_split=4)
+    for r in range(b):
+        want = _naive_attention(
+            q[r:r + 1, :, None], k[r:r + 1], v[r:r + 1], causal=False,
+            kv_len=int(lens[r]),
+        )[:, :, 0]
+        assert jnp.allclose(out[r:r + 1], want, atol=2e-5, rtol=2e-5), (
+            r, jnp.abs(out[r:r + 1] - want).max()
+        )
+
+
+def test_decode_attention_zero_length_rows():
+    """A ragged row of length 0 (an empty/padding batch slot) returns
+    ZEROS, not 0/0 NaN — realistic in padded serving batches."""
+    b, h, hk, skv, d = 3, 4, 2, 256, 64
+    lens = jnp.asarray([0, 100, 0], jnp.int32)
+    kq, kk, kv = jax.random.split(jax.random.key(15), 3)
+    q = jax.random.normal(kq, (b, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, hk, skv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, hk, skv, d), jnp.float32)
+    out = decode_attention(q, k, v, lens, n_split=4)
+    assert bool(jnp.isfinite(out).all())
+    assert jnp.array_equal(out[0], jnp.zeros_like(out[0]))
+    assert jnp.array_equal(out[2], jnp.zeros_like(out[2]))
+    want = _naive_attention(
+        q[1:2, :, None], k[1:2], v[1:2], causal=False, kv_len=100
+    )[:, :, 0]
+    assert jnp.allclose(out[1:2], want, atol=2e-5, rtol=2e-5)
+
+
 def test_decode_state_merge_associative():
     """Merging per-split states equals single-split state — the invariant the
     distributed flash-decode rides (merge splits locally, then ranks)."""

@@ -45,6 +45,22 @@ def test_sp_flash_decode_matches_full(n, h, hk):
     )
 
 
+def test_sp_flash_decode_ragged_lengths():
+    """(B,) ragged lengths through the sequence-sharded decode: per-rank
+    clipping happens per sequence, including sequences that end before a
+    rank's slice begins."""
+    n, b, h, hk, s, d = 4, 3, 8, 2, 512, 64
+    lens = jnp.asarray([500, 90, 260], jnp.int32)  # spans 4 / 1 / 3 ranks
+    q, k, v = _inputs(b, h, hk, s, d)
+    mesh = _mesh(n)
+    ks, vs = _shard_cache(mesh, k, v)
+    out = sp_flash_decode(q, ks, vs, lens, mesh)
+    want = decode_attention(q, k, v, lens)
+    assert jnp.allclose(out, want, atol=2e-5, rtol=2e-5), (
+        jnp.abs(out - want).max()
+    )
+
+
 def test_sp_flash_decode_short_cache_empty_ranks():
     """kv_len inside the first shard: later ranks are fully masked and must
     drop out of the merge (zero-denominator guard)."""

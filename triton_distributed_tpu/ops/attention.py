@@ -479,10 +479,11 @@ def finalize_attention_state(state, dtype) -> jax.Array:
 
 
 def _decode_kernel(
+    hk: int,
     bk: int,
     sm_scale: float,
     soft_cap: float,
-    kv_len_ref,  # (1, 1) int32 valid kv length                  [SMEM]
+    kv_len_ref,  # (B,) int32 valid kv length per sequence (RAGGED) [SMEM]
     q_ref,    # (1, g, d)  VMEM — one kv-head's query group
     k_ref,    # (1, sp, d) VMEM — this split's K slice
     v_ref,    # (1, sp, d) VMEM
@@ -493,11 +494,13 @@ def _decode_kernel(
     """One grid cell = (batch*kv_head, split): flash pass over the split's
     KV slice producing the (m, l, acc) softmax state — the merge across
     splits (and across ranks, in ``ops/flash_decode``) is associative
-    (reference split-KV stage ``flash_decode.py:130`` + combine ``:482``)."""
+    (reference split-KV stage ``flash_decode.py:130`` + combine ``:482``).
+    Lengths are per SEQUENCE, so ragged batches ride the same grid (like
+    the paged kernel)."""
     split = pl.program_id(1)
     sp = k_ref.shape[1]
     g, d = q_ref.shape[1], q_ref.shape[2]
-    kv_len = kv_len_ref[0, 0]
+    kv_len = kv_len_ref[pl.program_id(0) // hk]
     q = _scaled_q(q_ref[0], sm_scale)            # (g, d)
 
     def body_valid(j, carry):
@@ -529,7 +532,7 @@ def _decode_kernel(
 def _build_decode(b, h, hk, seq_kv, d, n_split, bk, sm_scale, soft_cap, dtype):
     group = h // hk
     sp = seq_kv // n_split
-    kernel = functools.partial(_decode_kernel, bk, sm_scale, soft_cap)
+    kernel = functools.partial(_decode_kernel, hk, bk, sm_scale, soft_cap)
     call = pl.pallas_call(
         kernel,
         grid=(b * hk, n_split),
@@ -583,11 +586,13 @@ def decode_attention_state(
     """Split-KV decode pass returning the mergeable softmax state.
 
     ``q``: (B, H, D) single decode token; ``k``/``v``: (B, Hkv, Skv, D)
-    cache (positions >= ``kv_len`` masked).  Returns ``(num, m, l)`` with
-    ``num``: (B, H, n_split, D) unnormalized numerators, ``m``/``l``:
-    (B, H, n_split) statistics.  Merging over any set of states (splits or
-    ranks) with :func:`merge_decode_states` then dividing gives exact
-    attention — associativity is what the distributed flash-decode rides.
+    cache (positions >= ``kv_len`` masked).  ``kv_len``: a scalar, or a
+    (B,) int32 array of RAGGED per-sequence lengths (like the paged
+    kernel).  Returns ``(num, m, l)`` with ``num``: (B, H, n_split, D)
+    unnormalized numerators, ``m``/``l``: (B, H, n_split) statistics.
+    Merging over any set of states (splits or ranks) with
+    :func:`merge_decode_states` then dividing gives exact attention —
+    associativity is what the distributed flash-decode rides.
     ``n_split=None`` picks :func:`auto_n_split`.
     """
     b, h, d = q.shape
@@ -608,7 +613,7 @@ def decode_attention_state(
         b, h, hk, seq_kv, d, n_split, bk, sm_scale, float(soft_cap),
         jnp.dtype(q.dtype),
     )
-    kv_len = jnp.full((1, 1), kv_len, jnp.int32)
+    kv_len = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
     num, m, l = fn(
         kv_len,
         q.reshape(b * hk, group, d),
@@ -623,6 +628,13 @@ def decode_attention_state(
         m.reshape(b, h, n_split),
         l.reshape(b, h, n_split),
     )
+
+
+def safe_normalize_decode(num, l, dtype) -> jax.Array:
+    """``num / l`` with EMPTY rows (l == 0 — a ragged sequence of length
+    0, realistic in padded serving batches) returning zeros instead of
+    0/0 NaN.  The shared final step of every decode entry."""
+    return jnp.where(l > 0, num / jnp.maximum(l, 1e-38), 0.0).astype(dtype)
 
 
 def merge_decode_states(num, m, l):
@@ -658,8 +670,9 @@ def decode_attention(
         q, k, v, kv_len, n_split=n_split, sm_scale=sm_scale, soft_cap=soft_cap
     )
     num, _, l = merge_decode_states(num, m, l)
-    out = num[..., 0, :] / l[..., 0][..., None]
-    return out.astype(q.dtype)
+    return safe_normalize_decode(
+        num[..., 0, :], l[..., 0][..., None], q.dtype
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -829,5 +842,6 @@ def paged_decode_attention(
         sm_scale=sm_scale, soft_cap=soft_cap,
     )
     num, _, l = merge_decode_states(num, m, l)
-    out = num[..., 0, :] / l[..., 0][..., None]
-    return out.astype(q.dtype)
+    return safe_normalize_decode(
+        num[..., 0, :], l[..., 0][..., None], q.dtype
+    )

@@ -40,6 +40,7 @@ from .attention import (
     merge_decode_states,
     paged_decode_attention,
     paged_decode_attention_state,
+    safe_normalize_decode,
 )
 
 
@@ -53,8 +54,9 @@ def _build_sp_flash_decode(
 
     def local_fn(q, k_loc, v_loc, kv_len):
         r = jax.lax.axis_index(axis)
-        # this rank covers absolute kv positions [r*s_loc, (r+1)*s_loc)
-        len_loc = jnp.clip(kv_len[0] - r * s_loc, 0, s_loc)
+        # this rank covers absolute kv positions [r*s_loc, (r+1)*s_loc);
+        # kv_len is (B,) — ragged per-sequence lengths clip per rank
+        len_loc = jnp.clip(kv_len - r * s_loc, 0, s_loc)
         num, m, l = decode_attention_state(
             q, k_loc, v_loc, len_loc,
             n_split=n_split, sm_scale=sm_scale, soft_cap=soft_cap,
@@ -68,8 +70,9 @@ def _build_sp_flash_decode(
             jnp.moveaxis(nums, 0, -2), jnp.moveaxis(ms, 0, -1),
             jnp.moveaxis(ls, 0, -1),
         )
-        out = num[..., 0, :] / l[..., 0][..., None]
-        return out.astype(dtype)
+        return safe_normalize_decode(
+            num[..., 0, :], l[..., 0][..., None], dtype
+        )
 
     return compilation.jit_shard_map(
         local_fn, mesh,
@@ -100,7 +103,8 @@ def sp_flash_decode(
 
     ``q``: (B, H, D) replicated decode token; ``k``/``v``: (B, Hkv, S, D)
     global cache sharded on the sequence dim over ``axis``; ``kv_len``: the
-    GLOBAL number of valid cache positions.  Returns (B, H, D) replicated.
+    GLOBAL number of valid cache positions — a scalar, or a (B,) int32
+    array of RAGGED per-sequence lengths.  Returns (B, H, D) replicated.
     Golden: full-cache ``decode_attention`` on one device.
     """
     n = mesh.shape[axis]
@@ -130,7 +134,7 @@ def sp_flash_decode(
         (b, h, hk, s_loc, d, n_split, sm_scale, float(soft_cap),
          jnp.dtype(q.dtype)),
     )
-    kv_len = jnp.asarray(kv_len, jnp.int32).reshape(1)
+    kv_len = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
     return fn(q, k, v, kv_len)
 
 
@@ -160,8 +164,9 @@ def _build_sp_paged_flash_decode(
             jnp.moveaxis(nums, 0, -2), jnp.moveaxis(ms, 0, -1),
             jnp.moveaxis(ls, 0, -1),
         )
-        out = num[..., 0, :] / l[..., 0][..., None]
-        return out.astype(dtype)
+        return safe_normalize_decode(
+            num[..., 0, :], l[..., 0][..., None], dtype
+        )
 
     return compilation.jit_shard_map(
         local_fn, mesh,

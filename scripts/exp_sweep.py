@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Round-4 measurement harness: careful interleaved sweeps on the real chip.
+
+Usage: python scripts/exp_sweep.py <mode> [rounds]
+Modes: gemm7168 gemm4096 gemm8192 group decode attn
+
+Prints per-candidate median seconds/iter and the median per-round ratio
+vs the XLA baseline (ratio > 1.0 = candidate faster than XLA).
+"""
+from __future__ import annotations
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+
+
+def median(xs):
+    xs = sorted(x for x in xs if x == x and x > 0)
+    return xs[len(xs) // 2] if xs else float("nan")
+
+
+def run_sweep(engines: dict, iters: int, rounds: int, baseline: str):
+    from triton_distributed_tpu.core.utils import (
+        interleaved_slope_samples, sync,
+    )
+
+    for name, fn in engines.items():
+        sync(fn())
+        print(f"  compiled {name}", flush=True)
+    raw = interleaved_slope_samples(engines, iters, rounds,
+                                    target_window_s=0.15)
+    times = {n: [dt if dt > 0 else float("nan") for dt in xs][1:]
+             for n, xs in raw.items()}
+    base = times[baseline]
+    print(f"\n{'name':<24} {'med s/iter':>12} {'ratio vs ' + baseline:>16}")
+    out = {}
+    for name in engines:
+        ratios = [b / a for a, b in zip(times[name], base) if a > 0 and b > 0]
+        r = median(ratios)
+        out[name] = (median(times[name]), r)
+        print(f"{name:<24} {median(times[name]):>12.6f} {r:>16.4f}",
+              flush=True)
+    return out
+
+
+def gemm(m, n, k, rounds):
+    from triton_distributed_tpu.ops.matmul import matmul
+    from triton_distributed_tpu.tune.autotuner import matmul_tile_candidates
+
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (m, k), dtype=jnp.bfloat16)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (k, n),
+                          dtype=jnp.bfloat16)
+    xla = jax.jit(lambda a, b: jnp.matmul(a, b))
+    engines = {"xla": lambda: xla(a, b)}
+    for bm, bn, bk in matmul_tile_candidates(m, n, k):
+        if bm * bn * 4 > 8 * 2**20:  # skip huge-acc configs that can't win
+            continue
+        name = f"p{bm}x{bn}x{bk}"
+        engines[name] = (lambda bm=bm, bn=bn, bk=bk:
+                         matmul(a, b, bm=bm, bn=bn, bk=bk))
+    run_sweep(engines, 32, rounds, "xla")
+
+
+def group(rounds):
+    from triton_distributed_tpu.ops.group_gemm import (
+        GroupGemmConfig, grouped_matmul,
+    )
+    from triton_distributed_tpu.tune.autotuner import matmul_tile_candidates
+
+    t, k, n, e = 8192, 7168, 2048, 8
+    kx, kw = jax.random.split(jax.random.key(0))
+    x = jax.random.normal(kx, (t, k), jnp.bfloat16)
+    w = jax.random.normal(kw, (e, k, n), jnp.bfloat16)
+    splits = jnp.asarray([2048, 512, 1536, 0, 1024, 1408, 640, 1024],
+                         jnp.int32)
+    from triton_distributed_tpu.core.utils import sync
+
+    ragged = jax.jit(lambda x, w, s: jax.lax.ragged_dot(x, w, s))
+    engines = {"xla": lambda: ragged(x, w, splits)}
+    cands = [(256, 2048, 512)] + matmul_tile_candidates(t, n, k)
+    for bm, bn, bk in cands:
+        name = f"p{bm}x{bn}x{bk}"
+        g = jax.jit(functools.partial(
+            grouped_matmul, config=GroupGemmConfig(bm, bn, bk)))
+        f = (lambda g=g: g(x, w, splits))
+        try:
+            sync(f())
+            engines[name] = f
+        except Exception as e:
+            print(f"skip {name}: {str(e)[:70]}")
+    run_sweep(engines, 16, rounds, "xla")
+
+
+def decode(rounds):
+    from triton_distributed_tpu.ops.attention import (
+        decode_attention_state, merge_decode_states, safe_normalize_decode,
+    )
+
+    b, h, hk, s, d = 8, 32, 8, 8192, 128
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (b, h, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, hk, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, hk, s, d), jnp.bfloat16)
+
+    @jax.jit
+    def xla_decode(q, k, v):
+        qh = q.reshape(b, hk, h // hk, d).astype(jnp.float32)
+        sc = jnp.einsum("bkgd,bksd->bkgs", qh, k.astype(jnp.float32))
+        p = jax.nn.softmax(sc * (d ** -0.5), -1)
+        out = jnp.einsum("bkgs,bksd->bkgd", p, v.astype(jnp.float32))
+        return out.reshape(b, h, d).astype(q.dtype)
+
+    def ours(n_split, bk):
+        def f(q, k, v):
+            num, m, l = decode_attention_state(
+                q, k, v, s, n_split=n_split, block_k=bk)
+            num, _, l = merge_decode_states(num, m, l)
+            return safe_normalize_decode(
+                num[..., 0, :], l[..., 0][..., None], q.dtype)
+        return jax.jit(f)
+
+    engines = {"xla": lambda: xla_decode(q, k, v)}
+    for ns in (1, 2, 4, 8, 16):
+        for bk in (256, 512, 1024, 2048):
+            if s % ns or (s // ns) % bk:
+                continue
+            f = ours(ns, bk)
+            engines[f"ns{ns}_bk{bk}"] = (lambda f=f: f(q, k, v))
+    run_sweep(engines, 48, rounds, "xla")
+
+
+def attn(rounds):
+    from triton_distributed_tpu.ops.attention import flash_attention
+
+    b, h, s, d = 1, 32, 4096, 128
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, h, s, d), jnp.bfloat16)
+    engines = {}
+    for bq in (256, 512, 1024, 2048):
+        for bk in (512, 1024, 2048, 4096):
+            engines[f"bq{bq}_bk{bk}"] = (
+                lambda bq=bq, bk=bk: flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk))
+    # report TFLOP/s too
+    out = run_sweep(engines, 32, rounds, f"bq512_bk1024")
+    flops = 4.0 * b * h * s * s * d / 2
+    for name, (t, r) in out.items():
+        print(f"{name:<24} {flops / t / 1e12:8.2f} TFLOP/s")
+
+
+def main():
+    mode = sys.argv[1]
+    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 13
+    print(f"devices: {jax.devices()}", flush=True)
+    if mode == "gemm7168":
+        gemm(7168, 7168, 7168, rounds)
+    elif mode == "gemm4096":
+        gemm(4096, 4096, 4096, rounds)
+    elif mode == "gemm8192":
+        gemm(8192, 2048, 7168, rounds)
+    elif mode == "group":
+        group(rounds)
+    elif mode == "decode":
+        decode(rounds)
+    elif mode == "attn":
+        attn(rounds)
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+
+
+if __name__ == "__main__":
+    main()

@@ -235,3 +235,28 @@ def test_flash_attention_varlen_segments(causal):
             atol=2e-5, rtol=2e-5,
         )
         start += seg_len
+
+
+@pytest.mark.parametrize("ns,bk", [(1, 64), (4, 32), (2, 64)])
+def test_decode_fused_matches_staged(ns, bk):
+    """The fused single-kernel decode is numerically the 3-stage pipeline
+    (split kernel -> merge -> normalize) it replaces, across split
+    geometries and ragged lengths."""
+    from triton_distributed_tpu.ops.attention import (
+        decode_attention_fused, decode_attention_state,
+        merge_decode_states, safe_normalize_decode,
+    )
+
+    b, h, hk, s, d = 3, 8, 4, 128, 32
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((b, h, d)).astype(np.float32) * 0.3)
+    k = jnp.asarray(rng.standard_normal((b, hk, s, d)).astype(np.float32) * 0.3)
+    v = jnp.asarray(rng.standard_normal((b, hk, s, d)).astype(np.float32) * 0.3)
+    lens = jnp.asarray([s, 37, 0], jnp.int32)   # full, ragged, empty
+
+    num, m, l = decode_attention_state(q, k, v, lens, n_split=ns, block_k=bk)
+    num, _, l = merge_decode_states(num, m, l)
+    want = safe_normalize_decode(num[..., 0, :], l[..., 0][..., None], q.dtype)
+    got = decode_attention_fused(q, k, v, lens, n_split=ns, block_k=bk)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)

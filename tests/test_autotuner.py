@@ -92,8 +92,9 @@ def test_tuned_matmul_correct(tmp_path, monkeypatch):
 def test_transparent_matmul_uses_cached_winner(tmp_path, monkeypatch):
     """With config=None, ops consult the persisted winner cache — a prior
     tuned run teaches later (including jit'd) calls with zero code change;
-    with no cache entry under tracing/interpret, the static default holds
-    (VERDICT next #5)."""
+    with no cache entry under tracing/interpret, the default backend
+    (XLA dispatch) holds and no Pallas kernel is built (VERDICT next #5,
+    round-4 backend dispatch)."""
     import jax
 
     from triton_distributed_tpu.ops import matmul as mm
@@ -115,16 +116,16 @@ def test_transparent_matmul_uses_cached_winner(tmp_path, monkeypatch):
     a = jax.random.normal(jax.random.key(0), (m, k), jnp.float32)
     b = jax.random.normal(jax.random.key(1), (k, n), jnp.float32)
 
-    # no cache entry: default (512, 1792, 512) tiles, bn clipped to 1024
-    mm.matmul(a, b)
-    assert built[-1] == (512, 1024, 512)
+    # no cache entry: XLA-dispatch default — correct result, no Pallas build
+    want = np.asarray(jnp.matmul(a, b))
+    got = mm.matmul(a, b)
+    assert not built
+    assert np.allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
 
-    # plant a DIFFERENT winner (no clipping at these dims) and check both
-    # eager and traced calls pick it up from disk
-    cands = at.matmul_tile_candidates(m, n, k)
-    if (512, 1792, 512) not in cands:   # resolve_config prepends the default
-        cands = [(512, 1792, 512), *cands]
-    target = (256, 512, 512)
+    # plant a PALLAS winner and check both eager and traced calls pick it
+    # up from disk
+    cands = at.matmul_backend_candidates(m, n, k)
+    target = (512, 1024, 512)
     idx = cands.index(target)
     key = ("matmul", (m, n, k, str(a.dtype), at.platform.device_kind()))
     at._GLOBAL._load_disk()[at._cache_key(key[0], key[1], cands)] = idx
@@ -132,11 +133,26 @@ def test_transparent_matmul_uses_cached_winner(tmp_path, monkeypatch):
     # fresh tuner (new process analogue) reads the planted winner from disk
     monkeypatch.setattr(at, "_GLOBAL", at.Autotuner(path=str(tmp_path / "w.json")))
 
-    mm.matmul(a, b)                                   # eager
+    got = mm.matmul(a, b)                             # eager
     assert built[-1] == target
+    assert np.allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
 
     jax.jit(lambda a, b: mm.matmul(a, b))(a, b)       # traced: same winner
     assert built[-1] == target
+
+    # plant an XLA flag-variant winner: eager dispatches (no Pallas build),
+    # traced inlines the plain dot — both numerically identical
+    built.clear()
+    at._GLOBAL._load_disk()[at._cache_key(key[0], key[1], cands)] = (
+        cands.index(at.XlaBackend(32768))
+    )
+    at._GLOBAL._save_disk()
+    monkeypatch.setattr(at, "_GLOBAL", at.Autotuner(path=str(tmp_path / "w.json")))
+    got = mm.matmul(a, b)
+    got_jit = jax.jit(lambda a, b: mm.matmul(a, b))(a, b)
+    assert not built
+    assert np.allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
+    assert np.allclose(np.asarray(got_jit), want, atol=1e-4, rtol=1e-4)
 
 
 def test_transparent_ag_gemm_cache_consult(tmp_path, monkeypatch):

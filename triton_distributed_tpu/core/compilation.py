@@ -120,6 +120,28 @@ def collective_id(family: str) -> int:
         ) from None
 
 
+def xla_gemm_options(scoped_vmem_kib: int = 0) -> dict:
+    """Per-computation XLA compile options for XLA-backend GEMM dispatch.
+
+    The second half of the compile policy: ops with an XLA backend
+    candidate (``ops.matmul``, ``ops.group_gemm``) are compiled as their
+    own jitted computation with a tuned scoped-VMEM budget.  Measured on
+    the v5e (interleaved per-round ratios vs default-flag XLA): raising
+    ``xla_tpu_scoped_vmem_limit_kib`` from the 16 MB default lets XLA pick
+    deeper GEMM tilings — 1.8-2.1x at 4096^3 bf16, 1.05-2.4x at
+    8192x2048x7168, 1.12-1.64x for ``lax.ragged_dot`` at the MoE bench
+    shape, parity-to-1.05x at 7168^3 (already at 95%+ of peak).  The
+    per-shape choice is the autotuner's, not a global flag flip: a raised
+    scoped budget can regress other fusions, so it is applied only to the
+    dispatched GEMM computation itself (``scoped_vmem_kib=0`` = default
+    flags).  On the CPU (interpret) backend the TPU flag does not exist:
+    a planted/simulated XlaBackend winner degrades to default flags.
+    """
+    if not scoped_vmem_kib or platform.on_cpu():
+        return {}
+    return {"xla_tpu_scoped_vmem_limit_kib": int(scoped_vmem_kib)}
+
+
 def compiler_params(
     *,
     collective: bool = True,

@@ -150,7 +150,7 @@ def _attn_kernel(
 @functools.lru_cache(maxsize=None)
 def _build_flash_attention(
     b, h, hk, seq_q, seq_kv, d, bq, bk, causal, has_segs, sm_scale,
-    soft_cap, dtype
+    soft_cap, dtype, vmem_limit=None
 ):
     group = h // hk
     kernel = functools.partial(
@@ -182,10 +182,60 @@ def _build_flash_attention(
         compiler_params=compilation.compiler_params(
             collective=False,
             dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=vmem_limit,
         ),
         interpret=compilation.interpret_mode(),
     )
     return jax.jit(call)
+
+
+# (block_q, block_k, vmem_limit) — the tuned knob set of the prefill
+# kernel.  512x1024 under the default 16 MiB scoped budget is the
+# measured-best STATIC choice at the bench shape; which config wins in a
+# given process tracks the chip's clock/bandwidth state, so the
+# config=None path resolves it contextually like the GEMM backends.
+FLASH_DEFAULT_BLOCKS = (512, 1024, None)
+_FLASH_VL = 100 * 2**20
+
+
+def flash_block_candidates(seq_q: int, seq_kv: int) -> list:
+    cands = [
+        FLASH_DEFAULT_BLOCKS,
+        (512, 2048, _FLASH_VL), (1024, 1024, _FLASH_VL),
+        (2048, 1024, _FLASH_VL), (512, 4096, _FLASH_VL),
+        (256, 1024, None), (512, 512, None),
+    ]
+    return [c for c in cands
+            if c[0] <= seq_q and c[1] <= seq_kv and seq_kv % c[1] == 0]
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_flash(bq, bk, vl, causal, sm_scale, soft_cap):
+    return jax.jit(functools.partial(
+        flash_attention, causal=causal, sm_scale=sm_scale,
+        soft_cap=soft_cap, block_q=bq, block_k=bk, vmem_limit=vl,
+    ))
+
+
+def _flash_resolve(q, k, v, causal, sm_scale, soft_cap, *,
+                   fresh: bool = False):
+    from ..core import platform
+    from ..tune import autotuner as _tune
+
+    b, h, seq_q, d = q.shape
+    _, hk, seq_kv, _ = k.shape
+    return _tune.resolve_config(
+        "flash_attention",
+        (b, h, hk, seq_q, seq_kv, d, bool(causal), str(q.dtype),
+         platform.device_kind()),
+        flash_block_candidates(seq_q, seq_kv),
+        FLASH_DEFAULT_BLOCKS,
+        lambda c: (lambda: _jitted_flash(
+            c[0], c[1], c[2], bool(causal), sm_scale, soft_cap)(q, k, v)),
+        tracing=any(map(_tune.is_tracer, (q, k, v))),
+        force_measure=fresh,
+        fresh=fresh,
+    )
 
 
 def flash_attention(
@@ -197,8 +247,9 @@ def flash_attention(
     sm_scale: float | None = None,
     soft_cap: float = 0.0,
     segment_ids: jax.Array | None = None,
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    vmem_limit: int | None = None,
 ) -> jax.Array:
     """Blocked online-softmax attention (local; no collectives).
 
@@ -238,12 +289,22 @@ def flash_attention(
                 f"segment_ids {segment_ids.shape} != (B, S) = ({b}, {seq_q})"
             )
     sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
+    if block_q is None and block_k is None:
+        # contextual block tuning (segment-id batches share the dense
+        # winner: the masking cost is identical per tile)
+        block_q, block_k, vl = _flash_resolve(
+            q, k, v, causal, sm_scale, float(soft_cap)
+        )
+        vmem_limit = vmem_limit or vl
+    else:
+        dq, dk, _ = FLASH_DEFAULT_BLOCKS
+        block_q, block_k = block_q or dq, block_k or dk
     bq = clip_block(min(block_q, seq_q), seq_q)
     bkv = clip_block(min(block_k, seq_kv), seq_kv)
     fn = _build_flash_attention(
         b, h, hk, seq_q, seq_kv, d, bq, bkv, bool(causal),
         segment_ids is not None, sm_scale, float(soft_cap),
-        jnp.dtype(q.dtype),
+        jnp.dtype(q.dtype), vmem_limit,
     )
     args = [
         q.reshape(b * h, seq_q, d),
@@ -572,6 +633,57 @@ def auto_n_split(seq_kv: int) -> int:
     return n
 
 
+def decode_split_candidates(seq_kv: int) -> list:
+    """(n_split, block_k) sweep for the decode kernel's ``config=None``
+    path.  The round-4 on-chip sweeps found no static winner: which split
+    geometry beats XLA's unfused decode tracks the chip's clock state
+    (0.41-1.09x swings for the same config between processes), so the
+    choice is contextual — resolved per shape from the winner cache or a
+    first-eager-call measurement, like the GEMM backends."""
+    cands = [
+        (auto_n_split(seq_kv), 512), (2, 512), (8, 512), (4, 2048),
+        (2, 4096), (8, 1024), (1, 2048), (1, seq_kv),
+    ]
+    out = []
+    for ns, bk in cands:
+        if ns < 1 or seq_kv % ns:
+            continue
+        sp = seq_kv // ns
+        if bk > sp or sp % bk:
+            continue
+        if (ns, bk) not in out:
+            out.append((ns, bk))
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_decode(ns, bk, sm_scale, soft_cap):
+    return jax.jit(functools.partial(
+        decode_attention, n_split=ns, block_k=bk, sm_scale=sm_scale,
+        soft_cap=soft_cap,
+    ))
+
+
+def _decode_resolve(q, k, v, kv_len, sm_scale, soft_cap, *,
+                    fresh: bool = False):
+    from ..core import platform
+    from ..tune import autotuner as _tune
+
+    b, h, d = q.shape
+    _, hk, seq_kv, _ = k.shape
+    return _tune.resolve_config(
+        "decode_attention",
+        (b, h, hk, seq_kv, d, str(q.dtype), platform.device_kind()),
+        decode_split_candidates(seq_kv),
+        (auto_n_split(seq_kv), 512),
+        lambda c: (lambda: _jitted_decode(
+            c[0], c[1], sm_scale, soft_cap)(q, k, v, kv_len)),
+        tracing=any(map(_tune.is_tracer, (q, k, v, kv_len))),
+        force_measure=fresh,
+        fresh=fresh,
+    )
+
+
 def decode_attention_state(
     q: jax.Array,
     k: jax.Array,
@@ -581,7 +693,7 @@ def decode_attention_state(
     n_split: int | None = None,
     sm_scale: float | None = None,
     soft_cap: float = 0.0,
-    block_k: int = 512,
+    block_k: int | None = None,
 ):
     """Split-KV decode pass returning the mergeable softmax state.
 
@@ -601,12 +713,19 @@ def decode_attention_state(
         raise ValueError(f"shape mismatch: q={q.shape} k={k.shape} v={v.shape}")
     if h % hk:
         raise ValueError(f"GQA requires H % Hkv == 0, got {h} % {hk}")
-    if n_split is None:
+    sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
+    if n_split is None and block_k is None:
+        # contextual split-geometry tuning (see decode_split_candidates)
+        n_split, block_k = _decode_resolve(
+            q, k, v, kv_len, sm_scale, float(soft_cap)
+        )
+    elif n_split is None:
         n_split = auto_n_split(seq_kv)
+    elif block_k is None:
+        block_k = 512
     if seq_kv % n_split:
         raise ValueError(f"Skv={seq_kv} not divisible by n_split={n_split}")
     group = h // hk
-    sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
     sp = seq_kv // n_split
     bk = clip_block(min(block_k, sp), sp)
     fn = _build_decode(
@@ -660,19 +779,172 @@ def decode_attention(
     n_split: int | None = None,
     sm_scale: float | None = None,
     soft_cap: float = 0.0,
+    block_k: int | None = None,
 ) -> jax.Array:
     """Single-token decode attention over a (possibly padded) KV cache.
 
-    Thin entry over :func:`decode_attention_state` + merge + normalize;
-    returns (B, H, D).  ``n_split=None`` picks :func:`auto_n_split`.
+    Delegates to the fused single-kernel path
+    (:func:`decode_attention_fused`) — the local decode has no cross-rank
+    merge, so the 3-stage state pipeline (split kernel -> merge ->
+    normalize) only pays launches and f32 state traffic for structure it
+    does not need.  ``decode_attention_state`` + ``merge_decode_states``
+    remain the distributed building blocks (``ops.flash_decode``).
+    Returns (B, H, D); ``n_split=None``/``block_k=None`` resolve the
+    tuned split geometry (:func:`decode_split_candidates`).
     """
-    num, m, l = decode_attention_state(
-        q, k, v, kv_len, n_split=n_split, sm_scale=sm_scale, soft_cap=soft_cap
+    return decode_attention_fused(
+        q, k, v, kv_len, n_split=n_split, sm_scale=sm_scale,
+        soft_cap=soft_cap, block_k=block_k,
     )
-    num, _, l = merge_decode_states(num, m, l)
-    return safe_normalize_decode(
-        num[..., 0, :], l[..., 0][..., None], q.dtype
+
+
+# ---------------------------------------------------------------------------
+# fused single-pass decode (local fast path)
+
+
+def _decode_fused_kernel(
+    hk: int,
+    n_split: int,
+    bk: int,
+    sm_scale: float,
+    soft_cap: float,
+    kv_len_ref,  # (B,) int32 valid kv length per sequence (RAGGED) [SMEM]
+    q_ref,    # (1, g, d)  VMEM — one kv-head's query group
+    k_ref,    # (1, sp, d) VMEM — this split's K slice
+    v_ref,    # (1, sp, d) VMEM
+    o_ref,    # (1, g, d)  normalized output (written at the last split)
+    m_sc,     # (g, 1) f32 scratch — persists across the split steps
+    l_sc,     # (g, 1) f32
+    acc_sc,   # (g, d) f32
+):
+    """The split-KV decode collapsed to ONE kernel: the softmax state
+    lives in VMEM scratch across the split grid steps (sequential
+    ``arbitrary`` dimension) instead of round-tripping f32 (num, m, l)
+    through HBM into a separate merge + normalize computation.  At the
+    ~0.4 ms scale of a serving decode step the extra kernel launches and
+    state traffic of the 3-stage pipeline are a measurable fraction of
+    the whole op; the fused form exists for exactly the reason the
+    reference fuses its decode epilogue into the split kernel when no
+    cross-rank merge follows (``flash_decode.py:482`` combine is only for
+    the distributed path).  The state-returning ``decode_attention_state``
+    remains the distributed building block."""
+    split = pl.program_id(1)
+    sp = k_ref.shape[1]
+    g, d = q_ref.shape[1], q_ref.shape[2]
+    kv_len = kv_len_ref[pl.program_id(0) // hk]
+    q = _scaled_q(q_ref[0], sm_scale)            # (g, d)
+
+    @pl.when(split == 0)
+    def _():
+        m_sc[...] = jnp.full((g, 1), _NEG_INF, jnp.float32)
+        l_sc[...] = jnp.zeros((g, 1), jnp.float32)
+        acc_sc[...] = jnp.zeros((g, d), jnp.float32)
+
+    def body_valid(j, carry):
+        k = k_ref[0, pl.ds(j * bk, bk)]
+        v = v_ref[0, pl.ds(j * bk, bk)]
+        return _tile_update(q, k, v, None, soft_cap, carry)
+
+    def body_edge(j, carry):
+        k = k_ref[0, pl.ds(j * bk, bk)]
+        v = v_ref[0, pl.ds(j * bk, bk)]
+        kpos = split * sp + j * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (g, bk), 1
+        )
+        return _tile_update(q, k, v, kpos < kv_len, soft_cap, carry)
+
+    nfull = jnp.clip((kv_len - split * sp) // bk, 0, sp // bk)
+    carry = (m_sc[...], l_sc[...], acc_sc[...])
+    carry = jax.lax.fori_loop(0, nfull, body_valid, carry)
+    m1, l1, acc1 = jax.lax.fori_loop(nfull, sp // bk, body_edge, carry)
+    m_sc[...] = m1
+    l_sc[...] = l1
+    acc_sc[...] = acc1
+
+    @pl.when(split == n_split - 1)
+    def _():
+        # shared epilogue: empty rows (ragged length 0) return zeros
+        o_ref[0] = safe_normalize_decode(acc1, l1, o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_decode_fused(b, h, hk, seq_kv, d, n_split, bk, sm_scale,
+                        soft_cap, dtype):
+    group = h // hk
+    sp = seq_kv // n_split
+    kernel = functools.partial(
+        _decode_fused_kernel, hk, n_split, bk, sm_scale, soft_cap
     )
+    call = pl.pallas_call(
+        kernel,
+        grid=(b * hk, n_split),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, group, d), lambda bh, s: (bh, 0, 0)),
+            pl.BlockSpec((1, sp, d), lambda bh, s: (bh, s, 0)),
+            pl.BlockSpec((1, sp, d), lambda bh, s: (bh, s, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, group, d), lambda bh, s: (bh, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * hk, group, d), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+        compiler_params=compilation.compiler_params(
+            collective=False,
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+    return jax.jit(call)
+
+
+def decode_attention_fused(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_len: jax.Array | int,
+    *,
+    n_split: int | None = None,
+    sm_scale: float | None = None,
+    soft_cap: float = 0.0,
+    block_k: int | None = None,
+) -> jax.Array:
+    """Single-kernel decode attention (see ``_decode_fused_kernel``);
+    returns (B, H, D).  Golden: :func:`decode_attention`'s 3-stage path."""
+    b, h, d = q.shape
+    bk_, hk, seq_kv, dk = k.shape
+    if (bk_, dk) != (b, d) or v.shape != k.shape:
+        raise ValueError(f"shape mismatch: q={q.shape} k={k.shape} v={v.shape}")
+    if h % hk:
+        raise ValueError(f"GQA requires H % Hkv == 0, got {h} % {hk}")
+    sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
+    if n_split is None and block_k is None:
+        n_split, block_k = _decode_resolve(
+            q, k, v, kv_len, sm_scale, float(soft_cap)
+        )
+    elif n_split is None:
+        n_split = auto_n_split(seq_kv)
+    elif block_k is None:
+        block_k = 512
+    if seq_kv % n_split:
+        raise ValueError(f"Skv={seq_kv} not divisible by n_split={n_split}")
+    group = h // hk
+    sp = seq_kv // n_split
+    bk = clip_block(min(block_k, sp), sp)
+    fn = _build_decode_fused(
+        b, h, hk, seq_kv, d, n_split, bk, sm_scale, float(soft_cap),
+        jnp.dtype(q.dtype),
+    )
+    kv_len = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
+    out = fn(
+        kv_len,
+        q.reshape(b * hk, group, d),
+        k.reshape(b * hk, seq_kv, d),
+        v.reshape(b * hk, seq_kv, d),
+    )
+    return out.reshape(b, hk, group, d).reshape(b, h, d)
 
 
 # ---------------------------------------------------------------------------

@@ -41,12 +41,16 @@ from .swizzle import grouped_tile_schedule
 
 @dataclasses.dataclass(frozen=True)
 class GroupGemmConfig:
-    """Tile sizes for :func:`grouped_matmul` (same knob set as the dense
-    ``matmul``).  The (256, 2048, 512) default measured 1.05-1.09x of
-    ``lax.ragged_dot`` on both MoE projection directions (T=8192, E=8,
-    7168<->2048 bf16, interleaved per-round ratios): the full-width N tile
-    reads each x m-tile once, and the short M tile keeps the f32
-    accumulator small enough to double-buffer."""
+    """Tile sizes for :func:`grouped_matmul`'s Pallas path (same knob set
+    as the dense ``matmul``).  NOTE the round-4 re-measurement: on the
+    current toolchain ``lax.ragged_dot`` beats every Pallas tiling at the
+    bench shape (T=8192, E=8, 7168->2048 bf16 — best Pallas 0.87x, and
+    ragged_dot under a raised scoped-VMEM budget a further 1.12-1.64x),
+    so the ``config=None`` path resolves a BACKEND (XLA dispatch vs these
+    tiles) per shape and the XLA variants win on the v5e.  The Pallas
+    kernel remains the explicit-config path: it exists for the tile-
+    scheduling design (scalar-prefetch work units) and for shapes/chips
+    where a hand tiling wins."""
 
     bm: int = 256
     bn: int = 2048
@@ -155,6 +159,87 @@ def _grouped_matmul_run(cfg, out_dtype, x_sorted, w, splits):
     return fn(*sched, x_sorted, w)
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_pallas_entry(cfg, out_dtype):
+    """One jitted wrapper per config: eager calls pay a single dispatch
+    (the tile-schedule arithmetic traces inside) instead of one tunnel
+    round-trip per scalar op of ``grouped_tile_schedule``."""
+    return jax.jit(functools.partial(_grouped_matmul_vjp, cfg, out_dtype))
+
+
+@functools.lru_cache(maxsize=None)
+def _xla_ragged_fn(scoped_vmem_kib: int, out_dtype):
+    """Jitted ``lax.ragged_dot`` carrying the XLA backend's compile
+    options (``core.compilation.xla_gemm_options``)."""
+    def f(x, w, s):
+        prec = (jax.lax.Precision.HIGHEST
+                if jnp.result_type(x, w) == jnp.float32 else None)
+        return jax.lax.ragged_dot(
+            x, w, s.astype(jnp.int32), precision=prec
+        ).astype(out_dtype)
+
+    return jax.jit(
+        f,
+        compiler_options=compilation.xla_gemm_options(scoped_vmem_kib)
+        or None,
+    )
+
+
+def _xla_grouped(x_sorted, w, splits, out_dtype, cfg):
+    from ..tune.autotuner import is_tracer
+
+    if is_tracer(x_sorted) or is_tracer(w) or is_tracer(splits):
+        # inlined into an outer jit: options cannot attach there
+        return jax.lax.ragged_dot(
+            x_sorted, w, splits.astype(jnp.int32)
+        ).astype(out_dtype)
+    return _xla_ragged_fn(cfg.scoped_vmem_kib, out_dtype)(
+        x_sorted, w, splits
+    )
+
+
+def _backend_candidates(t: int, k: int, n_dim: int) -> list:
+    """Mixed backend sweep for the grouped matmul (see
+    ``tune.autotuner.matmul_backend_candidates`` for the rationale):
+    ragged_dot dispatch variants first, then the Pallas tilings."""
+    from ..tune.autotuner import XLA_VMEM_SWEEP_KIB, XlaBackend
+
+    xla = [XlaBackend(0)] + [XlaBackend(kib) for kib in XLA_VMEM_SWEEP_KIB]
+    # the three best-measured Pallas tilings (round-4 sweep: 0.86-0.87x of
+    # ragged_dot at the bench shape — kept as challengers for shapes or
+    # toolchains where the hand schedule wins; short list = cheap fresh
+    # tunes)
+    tiles = [(256, 2048, 512), (512, 1792, 512), (512, 1024, 512)]
+    return xla + [GroupGemmConfig(bm, bn, bk) for bm, bn, bk in tiles
+                  if bm <= t and bn <= n_dim and bk <= k]
+
+
+def _grouped_resolve(x_sorted, w, splits, *, fresh: bool = False):
+    """The shared backend resolution for ``grouped_matmul(config=None)``
+    and ``tune.autotuner.fresh_tune_grouped_matmul`` (one cache entry).
+    Splits are part of the measurement closure (contextual) but not the
+    key — the winning backend is a shape-class property, not a routing
+    property."""
+    from ..core import platform
+    from ..tune import autotuner as _tune
+
+    t, k = x_sorted.shape
+    e, _, n_dim = w.shape
+    out_dtype = jnp.dtype(x_sorted.dtype)
+    return _tune.resolve_config(
+        "grouped_matmul",
+        (t, k, n_dim, e, str(x_sorted.dtype), platform.device_kind()),
+        _backend_candidates(t, k, n_dim),
+        _tune.XlaBackend(),
+        lambda c: (lambda: grouped_matmul(x_sorted, w, splits, config=c,
+                                          out_dtype=out_dtype)),
+        tracing=(_tune.is_tracer(x_sorted) or _tune.is_tracer(w)
+                 or _tune.is_tracer(splits)),
+        force_measure=fresh,
+        fresh=fresh,
+    )
+
+
 def _gm_fwd(cfg, out_dtype, x_sorted, w, splits):
     return _grouped_matmul_vjp(cfg, out_dtype, x_sorted, w, splits), (
         x_sorted, w, splits
@@ -219,25 +304,42 @@ def grouped_matmul(
         x_sorted.dtype
     )
     if config is None:
-        # transparent contextual tuning (see ops/ag_gemm.py); splits are
-        # part of the closure (contextual) but not the key — the winning
-        # tiling is a shape-class property, not a routing property
-        from ..core import platform
-        from ..tune import autotuner as _tune
+        # transparent contextual BACKEND tuning (see ops/ag_gemm.py and
+        # _grouped_resolve): XLA ragged_dot dispatch variants vs the
+        # Pallas tile-scheduled kernel, crowned per shape class
+        config = _grouped_resolve(x_sorted, w, splits)
+    from ..tune.autotuner import XlaBackend
 
-        config = _tune.resolve_config(
-            "grouped_matmul",
-            (t, k, n_dim, e, str(x_sorted.dtype), platform.device_kind()),
-            [GroupGemmConfig(bm, bn, bk)
-             for bm, bn, bk in _tune.matmul_tile_candidates(t, n_dim, k)
-             if bm <= t],
-            GroupGemmConfig(),
-            lambda c: (lambda: grouped_matmul(x_sorted, w, splits, config=c,
-                                              out_dtype=out_dtype)),
-            tracing=(_tune.is_tracer(x_sorted) or _tune.is_tracer(w)
-                     or _tune.is_tracer(splits)),
+    if isinstance(config, XlaBackend):
+        return _xla_grouped(x_sorted, w, splits, out_dtype, config)
+    from ..tune.autotuner import is_tracer
+
+    if is_tracer(x_sorted) or is_tracer(w) or is_tracer(splits):
+        return _grouped_matmul_vjp(config, out_dtype, x_sorted, w, splits)
+    return _jitted_pallas_entry(config, out_dtype)(x_sorted, w, splits)
+
+
+def grouped_matmul_callable(x_sorted: jax.Array, w: jax.Array,
+                            splits: jax.Array, *, out_dtype=None):
+    """Resolve the tuned backend ONCE and return the underlying jitted
+    callable ``(x_sorted, w, splits) -> y`` (see
+    ``ops.matmul.matmul_callable`` for why timed loops must not pay the
+    eager wrapper's Python per call).  Eager-only."""
+    from ..tune.autotuner import XlaBackend, is_tracer
+
+    if is_tracer(x_sorted) or is_tracer(w) or is_tracer(splits):
+        raise TypeError(
+            "grouped_matmul_callable is eager-only (it measures/resolves "
+            "on real arrays); call grouped_matmul() inside jit instead"
         )
-    return _grouped_matmul_vjp(config, out_dtype, x_sorted, w, splits)
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(
+        x_sorted.dtype
+    )
+
+    config = _grouped_resolve(x_sorted, w, splits)
+    if isinstance(config, XlaBackend):
+        return _xla_ragged_fn(config.scoped_vmem_kib, out_dtype)
+    return _jitted_pallas_entry(config, out_dtype)
 
 
 def group_gemm(x_sorted: jax.Array, w: jax.Array,
@@ -262,11 +364,12 @@ def group_gemm(x_sorted: jax.Array, w: jax.Array,
 
 
 def _local_group_gemm(x, w, splits, config: GroupGemmConfig | None):
-    """Per-shard grouped matmul dispatch: the tile-scheduled Pallas kernel
-    on real TPU (measured 1.03-1.17x of ``ragged_dot``), ``ragged_dot``
-    under CPU interpret mode where simulating the Pallas grid costs more
-    than it models.  Pass ``config`` to force the Pallas path with explicit
-    tiles anywhere."""
+    """Per-shard grouped matmul dispatch: the autotuned backend on real
+    TPU (XLA ``ragged_dot`` variants vs the tile-scheduled Pallas kernel
+    — see :class:`GroupGemmConfig` for the current measurements),
+    ``ragged_dot`` directly under CPU interpret mode where simulating the
+    Pallas grid costs more than it models.  Pass ``config`` to force the
+    Pallas path with explicit tiles anywhere."""
     from ..core import platform
 
     if config is None and platform.on_cpu():

@@ -1,12 +1,21 @@
-"""Local blocked matmul as a standalone Pallas kernel.
+"""Local blocked matmul: best-backend dispatch over Pallas tiles and XLA.
 
 The single-chip building block under every fused op: the same
 ``blocks.make_matmul_pipeline`` MXU loop that ``ag_gemm``/``gemm_rs`` run
 per chunk, exposed as a plain op.  Reference analogue: the non-distributed
 persistent GEMM the consumer kernels are built around
-(``python/triton_dist/kernels/nvidia/allgather_gemm.py:216-260``); on TPU it
-doubles as the single-chip benchmark kernel (``bench.py``) and the n=1
-fallback of the distributed ops.
+(``python/triton_dist/kernels/nvidia/allgather_gemm.py:216-260``) — which
+competes with and falls back to cuBLAS where the hand-written kernel
+loses.  The TPU analogue of that dispatch is this op's ``config=None``
+path: the contextual autotuner measures Pallas grid tilings AND XLA's own
+MXU GEMM under tuned compile options (``tune.autotuner.XlaBackend``,
+``core.compilation.xla_gemm_options``) and crowns the per-shape winner.
+On the benched v5e the crowned backend is shape- and chip-state-
+dependent: XLA + raised scoped VMEM wins large skewed shapes by 1.6-2.1x
+over default-flag XLA; at 7168^3 everything ties within noise.
+
+Explicit ``bm``/``bn``/``bk`` always run the Pallas grid kernel (the form
+the fused collective ops build on, and what the CPU-mesh tests exercise).
 """
 
 from __future__ import annotations
@@ -20,7 +29,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..core import compilation
 from ..core.utils import clip_block
-from ..tune.autotuner import MATMUL_DEFAULT_TILES
+from ..tune.autotuner import MATMUL_DEFAULT_TILES, XlaBackend
 from . import blocks
 
 
@@ -51,6 +60,47 @@ def _build_matmul(m, n, k, bm, bn, bk, dtype, out_dtype):
     return jax.jit(call)
 
 
+def _xla_dot(a, b, out_dtype):
+    if jnp.result_type(a, b) == jnp.float32:
+        # the op's contract is true f32 accumulation; TPU DEFAULT
+        # precision would silently run bf16 passes over f32 operands
+        return jnp.matmul(
+            a, b, precision=jax.lax.Precision.HIGHEST
+        ).astype(out_dtype)
+    # the natural-out-dtype case emits EXACTLY ``jnp.matmul(a, b)`` — the
+    # measured-ratio reference program — so an XlaBackend(0) crown means
+    # "identical to XLA", not "close to XLA" (an explicit
+    # preferred_element_type changes XLA's strategy choice at some shapes,
+    # which measured anywhere from 0.6x to 1.9x of the plain dot on the
+    # v5e depending on chip state — not a stable substitute)
+    if out_dtype == jnp.result_type(a, b):
+        return jnp.matmul(a, b)
+    return jnp.matmul(
+        a, b, preferred_element_type=jnp.float32
+    ).astype(out_dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _xla_matmul_fn(scoped_vmem_kib: int, out_dtype):
+    """Jitted XLA GEMM carrying the backend's compile options — the
+    executable an eagerly-called ``matmul`` dispatches to when an
+    ``XlaBackend`` config is crowned."""
+    return jax.jit(
+        functools.partial(_xla_dot, out_dtype=out_dtype),
+        compiler_options=compilation.xla_gemm_options(scoped_vmem_kib)
+        or None,
+    )
+
+
+def _xla_matmul(a, b, out_dtype, cfg: XlaBackend):
+    if isinstance(a, jax.core.Tracer) or isinstance(b, jax.core.Tracer):
+        # inside someone else's jit: compile options cannot attach to an
+        # inlined op — emit the plain dot and let the outer computation's
+        # options govern
+        return _xla_dot(a, b, out_dtype)
+    return _xla_matmul_fn(cfg.scoped_vmem_kib, out_dtype)(a, b)
+
+
 def matmul(
     a: jax.Array,
     b: jax.Array,
@@ -59,37 +109,75 @@ def matmul(
     bn: int | None = None,
     bk: int | None = None,
     out_dtype=None,
+    config=None,
 ) -> jax.Array:
     """C = A @ B with f32 accumulation, blocked for the MXU.
 
-    With no explicit tiles, the contextual autotuner resolves them per
-    shape class: a cached per-(m, n, k, dtype, device) winner if one
-    exists, a measurement sweep on the first eager real-hardware call,
-    else the static default (512, 1792, 512) — which measured 1.03x of
-    XLA's own GEMM at 7168^3 bf16 (median per-round interleaved ratio over
-    14 rounds; the wide 14-lane-tile N block keeps the MXU fed while
-    halving the accumulator footprint vs 1024x1024, which measured 0.99x).
-    For shapes 1792 does not divide, ``clip_block`` degrades bn to the
-    largest sublane-aligned divisor (1024/512/...).
+    With no explicit tiles, the contextual autotuner resolves the BACKEND
+    per shape class: a cached per-(m, n, k, dtype, device) winner if one
+    exists, a measurement sweep over Pallas tilings + XLA dispatch
+    variants on the first eager real-hardware call, else the XLA default.
+    ``config`` accepts an explicit resolution (a tile tuple or
+    :class:`~..tune.autotuner.XlaBackend`) — the form the autotuner's
+    thunks use.  Explicit ``bm``/``bn``/``bk`` force the Pallas grid
+    kernel with those tiles.
     """
     (m, k), (k2, n) = a.shape, b.shape
     if k2 != k:
         raise ValueError(f"inner dims mismatch: {a.shape} @ {b.shape}")
     out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
-    if bm is None and bn is None and bk is None:
+    if config is None and bm is None and bn is None and bk is None:
         from ..tune import autotuner as _tune
 
-        bm, bn, bk = _tune.resolve_config(
+        config = _tune.resolve_config(
             "matmul", _tune.matmul_resolve_key(m, n, k, a.dtype),
-            _tune.matmul_tile_candidates(m, n, k),
-            _tune.MATMUL_DEFAULT_TILES,
-            lambda c: (lambda: matmul(a, b, bm=c[0], bn=c[1], bk=c[2],
-                                      out_dtype=out_dtype)),
+            _tune.matmul_backend_candidates(m, n, k),
+            XlaBackend(),
+            lambda c: (lambda: matmul(a, b, config=c, out_dtype=out_dtype)),
             tracing=_tune.is_tracer(a) or _tune.is_tracer(b),
         )
+    if isinstance(config, XlaBackend):
+        return _xla_matmul(a, b, out_dtype, config)
+    if config is not None:
+        bm, bn, bk = config
     else:
         dbm, dbn, dbk = MATMUL_DEFAULT_TILES
         bm, bn, bk = bm or dbm, bn or dbn, bk or dbk
     bm, bn, bk = clip_block(bm, m), clip_block(bn, n), clip_block(bk, k)
     fn = _build_matmul(m, n, k, bm, bn, bk, jnp.dtype(a.dtype), out_dtype)
     return fn(a, b)
+
+
+def matmul_callable(a: jax.Array, b: jax.Array, *, out_dtype=None):
+    """Resolve the tuned backend for this shape ONCE and return the
+    underlying jitted callable ``(a, b) -> C``.
+
+    The zero-dispatch-overhead form a hot serving loop (and ``bench.py``'s
+    timed engines) should hold: the eager ``matmul()`` wrapper costs
+    ~100 us of Python per call (resolution memo, lru hops), which is
+    enough to skew sub-millisecond timed windows — measured as a phantom
+    15% loss on an IDENTICAL executable at 4096^3.  Eager-only (resolution
+    measures on first call if this shape was never tuned)."""
+    if isinstance(a, jax.core.Tracer) or isinstance(b, jax.core.Tracer):
+        raise TypeError(
+            "matmul_callable is eager-only (it measures/resolves on real "
+            "arrays); call matmul() inside jit instead"
+        )
+    (m, k), (k2, n) = a.shape, b.shape
+    if k2 != k:
+        raise ValueError(f"inner dims mismatch: {a.shape} @ {b.shape}")
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
+    from ..tune import autotuner as _tune
+
+    config = _tune.resolve_config(
+        "matmul", _tune.matmul_resolve_key(m, n, k, a.dtype),
+        _tune.matmul_backend_candidates(m, n, k),
+        XlaBackend(),
+        lambda c: (lambda: matmul(a, b, config=c, out_dtype=out_dtype)),
+        tracing=False,
+    )
+    if isinstance(config, XlaBackend):
+        return _xla_matmul_fn(config.scoped_vmem_kib, out_dtype)
+    bm, bn, bk = (clip_block(config[0], m), clip_block(config[1], n),
+                  clip_block(config[2], k))
+    return _build_matmul(m, n, k, bm, bn, bk, jnp.dtype(a.dtype), out_dtype)

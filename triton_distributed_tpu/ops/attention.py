@@ -714,14 +714,13 @@ def decode_attention_state(
     if h % hk:
         raise ValueError(f"GQA requires H % Hkv == 0, got {h} % {hk}")
     sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
-    if n_split is None and block_k is None:
-        # contextual split-geometry tuning (see decode_split_candidates)
-        n_split, block_k = _decode_resolve(
-            q, k, v, kv_len, sm_scale, float(soft_cap)
-        )
-    elif n_split is None:
+    # static defaults here, NOT the tuned geometry: the winner cache is
+    # measured on the FUSED kernel (decode_attention), whose cost model
+    # differs — high n_split is nearly free there but multiplies this
+    # path's f32 state round-trips
+    if n_split is None:
         n_split = auto_n_split(seq_kv)
-    elif block_k is None:
+    if block_k is None:
         block_k = 512
     if seq_kv % n_split:
         raise ValueError(f"Skv={seq_kv} not divisible by n_split={n_split}")

@@ -167,19 +167,30 @@ def _jitted_pallas_entry(cfg, out_dtype):
     return jax.jit(functools.partial(_grouped_matmul_vjp, cfg, out_dtype))
 
 
+def _ragged_dot_body(x, w, s, out_dtype):
+    """The ONE XLA ragged-dot emission both the jitted-with-options and
+    the inlined-under-jit dispatch branches share, holding the same
+    numeric contract as ``ops.matmul._xla_dot``: f32 operands get true
+    f32 accumulation (TPU DEFAULT precision would run bf16 passes), and
+    a widening ``out_dtype`` accumulates AT that dtype instead of
+    rounding the natural-dtype result up."""
+    in_dtype = jnp.result_type(x, w)
+    prec = (jax.lax.Precision.HIGHEST
+            if in_dtype == jnp.float32 else None)
+    pet = out_dtype if jnp.promote_types(in_dtype, out_dtype) != in_dtype \
+        else None
+    return jax.lax.ragged_dot(
+        x, w, s.astype(jnp.int32), precision=prec,
+        preferred_element_type=pet,
+    ).astype(out_dtype)
+
+
 @functools.lru_cache(maxsize=None)
 def _xla_ragged_fn(scoped_vmem_kib: int, out_dtype):
     """Jitted ``lax.ragged_dot`` carrying the XLA backend's compile
     options (``core.compilation.xla_gemm_options``)."""
-    def f(x, w, s):
-        prec = (jax.lax.Precision.HIGHEST
-                if jnp.result_type(x, w) == jnp.float32 else None)
-        return jax.lax.ragged_dot(
-            x, w, s.astype(jnp.int32), precision=prec
-        ).astype(out_dtype)
-
     return jax.jit(
-        f,
+        functools.partial(_ragged_dot_body, out_dtype=out_dtype),
         compiler_options=compilation.xla_gemm_options(scoped_vmem_kib)
         or None,
     )
@@ -190,9 +201,7 @@ def _xla_grouped(x_sorted, w, splits, out_dtype, cfg):
 
     if is_tracer(x_sorted) or is_tracer(w) or is_tracer(splits):
         # inlined into an outer jit: options cannot attach there
-        return jax.lax.ragged_dot(
-            x_sorted, w, splits.astype(jnp.int32)
-        ).astype(out_dtype)
+        return _ragged_dot_body(x_sorted, w, splits, out_dtype)
     return _xla_ragged_fn(cfg.scoped_vmem_kib, out_dtype)(
         x_sorted, w, splits
     )
@@ -202,9 +211,9 @@ def _backend_candidates(t: int, k: int, n_dim: int) -> list:
     """Mixed backend sweep for the grouped matmul (see
     ``tune.autotuner.matmul_backend_candidates`` for the rationale):
     ragged_dot dispatch variants first, then the Pallas tilings."""
-    from ..tune.autotuner import XLA_VMEM_SWEEP_KIB, XlaBackend
+    from ..tune.autotuner import xla_backend_candidates
 
-    xla = [XlaBackend(0)] + [XlaBackend(kib) for kib in XLA_VMEM_SWEEP_KIB]
+    xla = xla_backend_candidates()
     # the three best-measured Pallas tilings (round-4 sweep: 0.86-0.87x of
     # ragged_dot at the bench shape — kept as challengers for shapes or
     # toolchains where the hand schedule wins; short list = cheap fresh

@@ -168,16 +168,18 @@ def sp_attention(
 @functools.lru_cache(maxsize=None)
 def _build_hier_sp_attention(mesh: Mesh, inner_axis: str, outer_axis: str,
                              shapes_key):
-    (b, h, hk, s_loc, d, causal, sm_scale, soft_cap, bq, bk, dtype) = shapes_key
+    (b, h, hk, s_loc, d, causal, has_segs, sm_scale, soft_cap, bq, bk,
+     dtype) = shapes_key
     n_in = mesh.shape[inner_axis]
     n_out = mesh.shape[outer_axis]
 
-    def local_fn(q_loc, k_loc, v_loc):
+    def local_fn(q_loc, k_loc, v_loc, *segs):
         o = jax.lax.axis_index(outer_axis)
         i = jax.lax.axis_index(inner_axis)
         me = o * n_in + i        # global sequence rank (outer-major layout)
+        sq_loc = segs[0] if has_segs else None     # (B, s_loc) my q segs
 
-        def fold(state, k_c, v_c, s, t):
+        def fold(state, k_c, v_c, sk_c, s, t):
             # after t outer hops (each preceded by n_in - 1 inner
             # rotations that are NOT unwound — the completion rotation is
             # absorbed into this index instead of paying an extra ICI hop)
@@ -191,53 +193,67 @@ def _build_hier_sp_attention(mesh: Mesh, inner_axis: str, outer_axis: str,
                 q_offset=me * s_loc, kv_offset=src * s_loc,
                 causal=causal, sm_scale=sm_scale, soft_cap=soft_cap,
                 block_q=bq, block_k=bk,
+                segment_ids_q=sq_loc,
+                segment_ids_kv=sk_c if has_segs else None,
             )
 
         perm_in = [(j, (j + 1) % n_in) for j in range(n_in)]
         perm_out = [(j, (j + 1) % n_out) for j in range(n_out)]
 
-        def inner_ring(k_c, v_c, state, t):
+        def inner_ring(k_c, v_c, sk_c, state, t):
             """One full ICI ring over the slice-resident chunk set: fold
             the resident chunk, then n_in - 1 rotate-and-folds (the wire
-            overlaps the previous chunk's fold, as in the flat ring)."""
-            state = fold(state, k_c, v_c, 0, t)
+            overlaps the previous chunk's fold, as in the flat ring).
+            Under varlen the KV segment ids ride every rotation with
+            their chunk (reference inter-node varlen:
+            ``sp_ag_attention_inter_node.py:56,328`` threads cu_seqlens
+            through the same 2D schedule)."""
+            state = fold(state, k_c, v_c, sk_c, 0, t)
 
             def inner_step(c2, s):
-                k_c, v_c, state = c2
+                k_c, v_c, sk_c, state = c2
                 k_c = jax.lax.ppermute(k_c, inner_axis, perm_in)
                 v_c = jax.lax.ppermute(v_c, inner_axis, perm_in)
-                return (k_c, v_c, fold(state, k_c, v_c, s, t)), None
+                if has_segs:
+                    sk_c = jax.lax.ppermute(sk_c, inner_axis, perm_in)
+                return (k_c, v_c, sk_c,
+                        fold(state, k_c, v_c, sk_c, s, t)), None
 
-            (k_c, v_c, state), _ = jax.lax.scan(
-                inner_step, (k_c, v_c, state), jnp.arange(1, n_in)
+            (k_c, v_c, sk_c, state), _ = jax.lax.scan(
+                inner_step, (k_c, v_c, sk_c, state), jnp.arange(1, n_in)
             )
-            return k_c, v_c, state
+            return k_c, v_c, sk_c, state
 
         def outer_body(carry, t):
-            k_c, v_c, state = carry
-            k_c, v_c, state = inner_ring(k_c, v_c, state, t)
+            k_c, v_c, sk_c, state = carry
+            k_c, v_c, sk_c, state = inner_ring(k_c, v_c, sk_c, state, t)
             # hop the slice-resident set one slice over DCN WITHOUT first
             # unwinding the inner rotation (fold's source index accounts
             # for the accumulated in-slice offset); each superchunk
             # crosses DCN n_out - 1 times total (the last outer step is
-            # peeled below — fold only, no hops)
+            # peeled below — fold only, no hops).  Segment ids hop too.
             k_c = jax.lax.ppermute(k_c, outer_axis, perm_out)
             v_c = jax.lax.ppermute(v_c, outer_axis, perm_out)
-            return (k_c, v_c, state), None
+            if has_segs:
+                sk_c = jax.lax.ppermute(sk_c, outer_axis, perm_out)
+            return (k_c, v_c, sk_c, state), None
 
+        sk0 = segs[0] if has_segs else jnp.zeros((), jnp.int32)
         state0 = init_attention_state(b, h, s_loc, d)
-        (k_c, v_c, state), _ = jax.lax.scan(
-            outer_body, (k_loc, v_loc, state0), jnp.arange(n_out - 1)
+        (k_c, v_c, sk_c, state), _ = jax.lax.scan(
+            outer_body, (k_loc, v_loc, sk0, state0), jnp.arange(n_out - 1)
         )
-        _, _, state = inner_ring(k_c, v_c, state, n_out - 1)
+        _, _, _, state = inner_ring(k_c, v_c, sk_c, state, n_out - 1)
         return finalize_attention_state(state, dtype)
 
+    seg_specs = ((P(None, (outer_axis, inner_axis)),) if has_segs else ())
     return compilation.jit_shard_map(
         local_fn, mesh,
         in_specs=(
             P(None, None, (outer_axis, inner_axis), None),
             P(None, None, (outer_axis, inner_axis), None),
             P(None, None, (outer_axis, inner_axis), None),
+            *seg_specs,
         ),
         out_specs=P(None, None, (outer_axis, inner_axis), None),
     )
@@ -256,6 +272,7 @@ def hierarchical_sp_attention(
     soft_cap: float = 0.0,
     block_q: int = 512,
     block_k: int = 512,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Ring attention composed over (outer=DCN, inner=ICI) — the TPU form
     of the reference's dedicated inter-node SP attention
@@ -272,8 +289,13 @@ def hierarchical_sp_attention(
     AG/RS/AR collectives (``comm/allgather.py``).
 
     ``q``: (B, H, S, D), ``k``/``v``: (B, Hkv, S, D), sequence-sharded over
-    ``(outer_axis, inner_axis)``.  Returns the same sharding.  Golden:
-    single-device ``flash_attention`` on the gathered arrays.
+    ``(outer_axis, inner_axis)``.  ``segment_ids``: optional (B, S) int32
+    for PACKED variable-length batches (the reference inter-node varlen
+    path, ``sp_ag_attention_inter_node.py:56,328``): positions attend only
+    within their segment, and the KV segment ids ride both the inner ICI
+    rotations and the outer DCN hops alongside their chunks.  Returns the
+    same sharding.  Golden: single-device ``flash_attention`` on the
+    gathered arrays (packed, where segment_ids are given).
     """
     n_in = mesh.shape[inner_axis]
     n_out = mesh.shape[outer_axis]
@@ -281,6 +303,7 @@ def hierarchical_sp_attention(
         return sp_attention(
             q, k, v, mesh, inner_axis, causal=causal, sm_scale=sm_scale,
             soft_cap=soft_cap, block_q=block_q, block_k=block_k,
+            segment_ids=segment_ids,
         )
     b, h, s_tot, d = q.shape
     _, hk, sk, _ = k.shape
@@ -288,6 +311,10 @@ def hierarchical_sp_attention(
         raise ValueError(f"shape mismatch: q={q.shape} k={k.shape} v={v.shape}")
     if h % hk:
         raise ValueError(f"GQA requires H % Hkv == 0, got {h} % {hk}")
+    if segment_ids is not None and segment_ids.shape != (b, s_tot):
+        raise ValueError(
+            f"segment_ids {segment_ids.shape} != (B, S) = ({b}, {s_tot})"
+        )
     n = n_in * n_out
     if s_tot % n:
         raise ValueError(
@@ -298,7 +325,10 @@ def hierarchical_sp_attention(
     sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
     fn = _build_hier_sp_attention(
         mesh, inner_axis, outer_axis,
-        (b, h, hk, s_loc, d, bool(causal), sm_scale, float(soft_cap),
+        (b, h, hk, s_loc, d, bool(causal), segment_ids is not None,
+         sm_scale, float(soft_cap),
          min(block_q, s_loc), min(block_k, s_loc), jnp.dtype(q.dtype)),
     )
+    if segment_ids is not None:
+        return fn(q, k, v, segment_ids.astype(jnp.int32))
     return fn(q, k, v)

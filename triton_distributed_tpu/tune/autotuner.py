@@ -80,6 +80,14 @@ def margin_for(candidate) -> float:
             else PALLAS_MARGIN)
 
 
+def xla_backend_candidates() -> list:
+    """The shared XLA-dispatch prefix of every backend sweep (default
+    flags first = the never-lose baseline, then the scoped-VMEM
+    variants) — single-sourced so a new flag sweep point reaches every
+    dispatching op at once."""
+    return [XlaBackend(0)] + [XlaBackend(kib) for kib in XLA_VMEM_SWEEP_KIB]
+
+
 @dataclasses.dataclass
 class TuneResult:
     config: Any
@@ -290,8 +298,12 @@ class Autotuner:
             # configs exceeds their true difference, and a mis-crowned
             # winner would be persisted
             best = baseline_index
-        if (fresh and baseline_index is not None and best != baseline_index
+        if (fresh and not multi
+                and baseline_index is not None and best != baseline_index
                 and baseline_index in live and best in live):
+            # (single-process only: the confirmation re-measure is
+            # host-local, and a per-rank revert would break the
+            # identical-winner-on-every-rank invariant _agree upholds)
             # confirmation pass: a fresh crown is about to be USED in this
             # process (bench capture / serving warmup), so a sweep-noise
             # artifact is maximally costly.  Head-to-head re-measure with
@@ -500,7 +512,7 @@ def matmul_backend_candidates(m: int, n: int, k: int) -> list:
     Pallas grid tilings that have won shapes in on-chip sweeps.  Shared by
     the transparent resolve, ``tuned_matmul``, and ``fresh_tune_matmul``
     so all three hit one cache entry (the digest covers the list)."""
-    xla = [XlaBackend(0)] + [XlaBackend(kib) for kib in XLA_VMEM_SWEEP_KIB]
+    xla = xla_backend_candidates()
     if any(d % 8 for d in (m, n, k)):
         return xla  # no sublane-aligned Pallas tiling exists; XLA handles it
     # the three Pallas tilings that have won shapes in on-chip sweeps —

@@ -72,8 +72,16 @@ def _tile_update(q, k, v, mask, soft_cap, carry):
     if mask is not None:
         s = jnp.where(mask, s, _NEG_INF)
     m_cur = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+    if mask is not None:
+        # a fully-masked row keeps m = -inf; exp(-inf - -inf) would be NaN
+        p = jnp.where(m_cur > _NEG_INF / 2, jnp.exp(s - m_cur), 0.0)
+    else:
+        # unmasked tile: every row has a finite max, no NaN guard needed —
+        # the kernels are VPU-bound (softmax arithmetic over (bq, bk)
+        # tiles, not the MXU dots), so one elided select per element is a
+        # measurable win
+        p = jnp.exp(s - m_cur)
     alpha = jnp.exp(m_prev - m_cur)
-    p = jnp.where(m_cur > _NEG_INF / 2, jnp.exp(s - m_cur), 0.0)
     l_cur = l_prev * alpha + p.sum(axis=1, keepdims=True)
     acc = acc * alpha + jax.lax.dot(
         p.astype(v.dtype), v, preferred_element_type=jnp.float32

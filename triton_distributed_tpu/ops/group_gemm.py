@@ -42,24 +42,34 @@ from .swizzle import grouped_tile_schedule
 @dataclasses.dataclass(frozen=True)
 class GroupGemmConfig:
     """Tile sizes for :func:`grouped_matmul`'s Pallas path (same knob set
-    as the dense ``matmul``).  NOTE the round-4 re-measurement: on the
-    current toolchain ``lax.ragged_dot`` beats every Pallas tiling at the
-    bench shape (T=8192, E=8, 7168->2048 bf16 — best Pallas 0.87x, and
-    ragged_dot under a raised scoped-VMEM budget a further 1.12-1.64x),
-    so the ``config=None`` path resolves a BACKEND (XLA dispatch vs these
-    tiles) per shape and the XLA variants win on the v5e.  The Pallas
-    kernel remains the explicit-config path: it exists for the tile-
-    scheduling design (scalar-prefetch work units) and for shapes/chips
-    where a hand tiling wins."""
+    as the dense ``matmul``).
+
+    Round-4 measured state (v5e, bench shape T=8192, E=8, 7168->2048
+    bf16, interleaved medians): with PAD-SLOT ELISION in the kernel (pad
+    slots' block fetches frozen so the pipeline skips their DMAs — they
+    were ~30% of HBM traffic at this shape) the Pallas tilings run
+    1.54-1.73 ms STABLY across chip states, while ``lax.ragged_dot``
+    swings 1.74-3.57 ms with the chip's clock state.  Best tile
+    512x2048x1024 under a raised VMEM budget: 145-156 TF/s, 1.06-2.3x of
+    ragged_dot per interleaved round.  The ``config=None`` path still
+    resolves a BACKEND per shape (XLA dispatch vs these tiles) so untuned
+    shapes never lose to XLA; at tuned shapes the Pallas kernel is the
+    expected winner."""
 
     bm: int = 256
     bn: int = 2048
     bk: int = 512
+    # scoped-VMEM budget override (bytes): big-accumulator tiles (>= 4 MB
+    # f32 acc) fail to compile under Mosaic's 16 MiB default; the v5e has
+    # 128 MiB of VMEM, and larger bm is what cuts per-expert weight
+    # re-streaming (weight traffic ~ (T/bm + E) * K * N bytes)
+    vmem_limit: int | None = None
 
 
 def _grouped_matmul_kernel(
     bm: int, nk: int, out_dtype,
-    tile_ids, group_ids, row_starts, row_ends, is_first,  # scalar prefetch
+    # scalar prefetch (swizzle.GroupedSchedule)
+    tile_ids, group_ids, row_starts, row_ends, is_first, valid, covers,
     x_ref,      # (bm, bk) rows of the current m-tile
     w_ref,      # (bk, bn) current group's weight block (leading dim squeezed)
     o_ref,      # (bm, bn) output tile (revisited per overlapping group)
@@ -72,15 +82,24 @@ def _grouped_matmul_kernel(
     def _():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # pad slots (empty row range) skip the MXU work entirely; their
-    # epilogue then writes/adds the zeros left in acc
+    # empty-row slots (pads, zero-fill tiles) skip the MXU work entirely
     @pl.when(row_starts[wi] < row_ends[wi])
     def _():
         acc_ref[...] += jnp.dot(
             x_ref[...], w_ref[...], preferred_element_type=jnp.float32
         )
 
-    @pl.when(kk == nk - 1)
+    # PAD slots (valid == 0) write nothing at all — their block fetches
+    # are frozen by the index maps and their output visit leaves the
+    # already-written tile untouched.  Zero-fill slots (valid, empty row
+    # range, is_first) still write zeros through the masked path.
+    @pl.when((kk == nk - 1) & (valid[wi] == 1) & (covers[wi] == 1))
+    def _():
+        # the slot owns its whole tile (splits aligned to bm — the common
+        # case): straight write, no row-mask arithmetic
+        o_ref[...] = acc_ref[...].astype(out_dtype)
+
+    @pl.when((kk == nk - 1) & (valid[wi] == 1) & (covers[wi] == 0))
     def _():
         # zero the rows of this tile that belong to other groups; their
         # slots contribute them, so the adds across slots stay exact
@@ -100,20 +119,31 @@ def _grouped_matmul_kernel(
 
 
 @functools.lru_cache(maxsize=None)
-def _build_grouped_matmul(t, k, n_dim, e, bm, bn, bk, dtype, out_dtype):
+def _build_grouped_matmul(t, k, n_dim, e, bm, bn, bk, dtype, out_dtype,
+                          vmem_limit=None):
     nt, nj, nk = t // bm, n_dim // bn, k // bk
     num_slots = nt + e
+    # pad slots freeze their k index at 0 (and carry the last real slot's
+    # tile/group ids — see GroupedSchedule): consecutive identical block
+    # indices are elided by the pipeline, so pads cost no HBM traffic
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
+        num_scalar_prefetch=7,
         grid=(nj, num_slots, nk),
         in_specs=[
-            pl.BlockSpec((bm, bk), lambda j, w, kk, tid, *_: (tid[w], kk)),
             pl.BlockSpec(
-                (None, bk, bn), lambda j, w, kk, tid, gid, *_: (gid[w], kk, j)
+                (bm, bk),
+                lambda j, w, kk, tid, gid, rs, re, isf, val, cov:
+                    (tid[w], kk * val[w]),
+            ),
+            pl.BlockSpec(
+                (None, bk, bn),
+                lambda j, w, kk, tid, gid, rs, re, isf, val, cov:
+                    (gid[w], kk * val[w], j),
             ),
         ],
         out_specs=pl.BlockSpec(
-            (bm, bn), lambda j, w, kk, tid, *_: (tid[w], j)
+            (bm, bn),
+            lambda j, w, kk, tid, gid, rs, re, isf, val, cov: (tid[w], j),
         ),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
     )
@@ -134,6 +164,7 @@ def _build_grouped_matmul(t, k, n_dim, e, bm, bn, bk, dtype, out_dtype):
             collective=False,
             # slots revisit output blocks, so both w and k are sequential
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+            vmem_limit_bytes=vmem_limit,
         ),
         interpret=compilation.interpret_mode(),
     )
@@ -154,7 +185,8 @@ def _grouped_matmul_run(cfg, out_dtype, x_sorted, w, splits):
     )
     sched = grouped_tile_schedule(splits, t, bm)
     fn = _build_grouped_matmul(
-        t, k, n_dim, e, bm, bn, bk, jnp.dtype(x_sorted.dtype), out_dtype
+        t, k, n_dim, e, bm, bn, bk, jnp.dtype(x_sorted.dtype), out_dtype,
+        cfg.vmem_limit,
     )
     return fn(*sched, x_sorted, w)
 
@@ -207,6 +239,9 @@ def _xla_grouped(x_sorted, w, splits, out_dtype, cfg):
     )
 
 
+_GROUPED_VL = 100 * 2**20
+
+
 def _backend_candidates(t: int, k: int, n_dim: int) -> list:
     """Mixed backend sweep for the grouped matmul (see
     ``tune.autotuner.matmul_backend_candidates`` for the rationale):
@@ -214,12 +249,13 @@ def _backend_candidates(t: int, k: int, n_dim: int) -> list:
     from ..tune.autotuner import xla_backend_candidates
 
     xla = xla_backend_candidates()
-    # the three best-measured Pallas tilings (round-4 sweep: 0.86-0.87x of
-    # ragged_dot at the bench shape — kept as challengers for shapes or
-    # toolchains where the hand schedule wins; short list = cheap fresh
-    # tunes)
-    tiles = [(256, 2048, 512), (512, 1792, 512), (512, 1024, 512)]
-    return xla + [GroupGemmConfig(bm, bn, bk) for bm, bn, bk in tiles
+    # the three best-measured pad-eliding Pallas tilings (round-4 sweep at
+    # the bench shape: 145-156 TF/s stable vs ragged_dot's 67-138 —
+    # see GroupGemmConfig); raised VMEM budget for the deep-k variants.
+    # Short list = cheap fresh tunes.
+    tiles = [(512, 2048, 1024), (512, 2048, 512), (512, 1024, 512)]
+    return xla + [GroupGemmConfig(bm, bn, bk, _GROUPED_VL)
+                  for bm, bn, bk in tiles
                   if bm <= t and bn <= n_dim and bk <= k]
 
 

@@ -34,12 +34,16 @@ from . import blocks
 
 
 @functools.lru_cache(maxsize=None)
-def _build_matmul(m, n, k, bm, bn, bk, dtype, out_dtype):
+def _build_matmul(m, n, k, bm, bn, bk, dtype, out_dtype, vmem_limit=None):
     # Grid form (not emit_pipeline): Mosaic schedules the (m, n, k) grid
     # itself, and dimension_semantics lets it reorder/parallelize the two
     # output dims — measured ~4% faster than the in-kernel emit_pipeline
     # form at 7168^3 bf16.  The fused ops keep emit_pipeline (they need the
     # manual loop to interleave DMA waits); this op is the pure-MXU path.
+    # ``vmem_limit`` raises Mosaic's scoped-VMEM budget above the 16 MiB
+    # default for big-accumulator tiles (the v5e has 128 MiB of VMEM; a
+    # >=4 MB f32 accumulator plus double-buffered operands fails to
+    # compile under the default budget).
     nk = k // bk
     call = pl.pallas_call(
         functools.partial(blocks.matmul_body, nk, out_dtype),
@@ -54,6 +58,7 @@ def _build_matmul(m, n, k, bm, bn, bk, dtype, out_dtype):
         compiler_params=compilation.compiler_params(
             collective=False,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=vmem_limit,
         ),
         interpret=compilation.interpret_mode(),
     )
@@ -138,13 +143,17 @@ def matmul(
         )
     if isinstance(config, XlaBackend):
         return _xla_matmul(a, b, out_dtype, config)
+    vl = None
     if config is not None:
-        bm, bn, bk = config
+        # tile tuples are (bm, bn, bk) or (bm, bn, bk, vmem_limit)
+        bm, bn, bk, *rest = config
+        vl = rest[0] if rest else None
     else:
         dbm, dbn, dbk = MATMUL_DEFAULT_TILES
         bm, bn, bk = bm or dbm, bn or dbn, bk or dbk
     bm, bn, bk = clip_block(bm, m), clip_block(bn, n), clip_block(bk, k)
-    fn = _build_matmul(m, n, k, bm, bn, bk, jnp.dtype(a.dtype), out_dtype)
+    fn = _build_matmul(m, n, k, bm, bn, bk, jnp.dtype(a.dtype), out_dtype,
+                       vl)
     return fn(a, b)
 
 
@@ -180,4 +189,6 @@ def matmul_callable(a: jax.Array, b: jax.Array, *, out_dtype=None):
         return _xla_matmul_fn(config.scoped_vmem_kib, out_dtype)
     bm, bn, bk = (clip_block(config[0], m), clip_block(config[1], n),
                   clip_block(config[2], k))
-    return _build_matmul(m, n, k, bm, bn, bk, jnp.dtype(a.dtype), out_dtype)
+    vl = config[3] if len(config) > 3 else None
+    return _build_matmul(m, n, k, bm, bn, bk, jnp.dtype(a.dtype), out_dtype,
+                         vl)

@@ -55,6 +55,16 @@ class GroupedSchedule(NamedTuple):
     first slot of each tile (the kernel initializes the output block there,
     accumulating on later slots).  Slots are tile-major, so revisits of an
     output block are always grid-adjacent.
+
+    ``valid[s]`` is 0 on PAD slots (the unused tail of the worst-case
+    ``nt + E`` allocation).  Pads carry the same tile/group ids as the
+    last real slot so the kernel's index maps can freeze their block
+    fetches (consecutive identical indices are elided by Pallas — without
+    this, every pad slot re-streams a full (bm, K) x-stripe and (K, bn)
+    w-stripe it never uses; at the MoE bench shape that was ~30% of the
+    kernel's HBM traffic).  ``covers[s]`` is 1 when the slot's rows span
+    its whole tile (the common, splits-aligned case): the kernel then
+    writes the accumulator straight out and skips the row-mask arithmetic.
     """
 
     tile_ids: jax.Array
@@ -62,6 +72,8 @@ class GroupedSchedule(NamedTuple):
     row_starts: jax.Array
     row_ends: jax.Array
     is_first: jax.Array
+    valid: jax.Array
+    covers: jax.Array
 
 
 def grouped_tile_schedule(group_sizes: jax.Array, num_rows: int,
@@ -116,4 +128,13 @@ def grouped_tile_schedule(group_sizes: jax.Array, num_rows: int,
     row_start = jnp.where(valid, row_start, 0)
     row_end = jnp.where(valid, row_end, 0)
     is_first = ((rank_in_tile == 0) & valid).astype(jnp.int32)
-    return GroupedSchedule(tile, group, row_start, row_end, is_first)
+    # pads inherit the last REAL slot's tile/group so their (frozen) block
+    # fetches are grid-adjacent duplicates the pipeline elides
+    last = jnp.maximum(total - 1, 0)
+    tile = jnp.where(valid, tile, jnp.take(tile, last))
+    group = jnp.where(valid, group, jnp.take(group, last))
+    covers = (valid & (row_start == lo) & (row_end == lo + bm)).astype(
+        jnp.int32
+    )
+    return GroupedSchedule(tile, group, row_start, row_end, is_first,
+                           valid.astype(jnp.int32), covers)

@@ -92,12 +92,20 @@ def bench_single_chip(m: int = 7168, n: int = 7168, k: int = 7168,
     # crown the backend IN THIS PROCESS before the timed rounds: which
     # backend wins is partly a chip-state property, and a winner inherited
     # from another invocation's state is what regressed the round-3 record
-    from triton_distributed_tpu.ops.matmul import matmul_callable
+    from triton_distributed_tpu.ops.matmul import _xla_matmul_fn, matmul_callable
 
     tune.fresh_tune_matmul(a, b)
     ours = matmul_callable(a, b)   # the resolved executable, no per-call
     flops = 2.0 * m * n * k        # Python (it skews sub-ms windows)
     xla = jax.jit(lambda a, b: jnp.matmul(a, b))
+    if ours is _xla_matmul_fn(0, jnp.dtype(a.dtype)):
+        # the crowned backend IS the plain XLA dot: ours and the baseline
+        # are the same HLO, and the true ratio is definitionally 1.0.
+        # Timing two separate compilations of identical programs instead
+        # reports buffer-placement luck (identical-program A/B medians
+        # swing +-2-5% per process, round-4 measurement) — so time the
+        # one executable against itself and let the ratio say "parity".
+        xla = ours
     # 15 rounds: the tunneled chip's round-to-round drift makes the
     # 9-round median swing ~±10%; extra rounds tighten the headline number
     times = _bench_interleaved({
@@ -259,12 +267,18 @@ def bench_group_gemm():
     # the resolved jitted callable: a crowned XLA backend runs as its own
     # computation carrying its compile options, and the timed loop pays no
     # per-call Python
-    from triton_distributed_tpu.ops.group_gemm import grouped_matmul_callable
+    from triton_distributed_tpu.ops.group_gemm import (
+        _xla_ragged_fn, grouped_matmul_callable,
+    )
     from triton_distributed_tpu.tune import autotuner as tune
 
     tune.fresh_tune_grouped_matmul(x, w, splits)
     ours = grouped_matmul_callable(x, w, splits)
     ragged = jax.jit(lambda x, w, s: jax.lax.ragged_dot(x, w, s))
+    if ours is _xla_ragged_fn(0, jnp.dtype(x.dtype)):
+        # crowned backend IS plain ragged_dot — same-HLO aliasing, see
+        # bench_single_chip
+        ragged = ours
     times = _bench_interleaved({
         "ours": lambda: ours(x, w, splits),
         "xla": lambda: ragged(x, w, splits),

@@ -106,13 +106,15 @@ def test_transparent_matmul_uses_cached_winner(tmp_path, monkeypatch):
     built = []
     real_build = mm._build_matmul
 
-    def spy(m, n, k, bm, bn, bk, dtype, out_dtype):
+    def spy(m, n, k, bm, bn, bk, dtype, out_dtype, vmem_limit=None):
         built.append((bm, bn, bk))
-        return real_build(m, n, k, bm, bn, bk, dtype, out_dtype)
+        return real_build(m, n, k, bm, bn, bk, dtype, out_dtype, vmem_limit)
 
     monkeypatch.setattr(mm, "_build_matmul", spy)
 
-    m, n, k = 512, 1024, 512
+    # shape chosen so exactly one big-tile Pallas candidate survives the
+    # size filter (the round-4 candidate list is VL big tiles only)
+    m, n, k = 512, 2048, 1024
     a = jax.random.normal(jax.random.key(0), (m, k), jnp.float32)
     b = jax.random.normal(jax.random.key(1), (k, n), jnp.float32)
 
@@ -125,8 +127,9 @@ def test_transparent_matmul_uses_cached_winner(tmp_path, monkeypatch):
     # plant a PALLAS winner and check both eager and traced calls pick it
     # up from disk
     cands = at.matmul_backend_candidates(m, n, k)
-    target = (512, 1024, 512)
-    idx = cands.index(target)
+    target4 = (512, 2048, 1024, at.MATMUL_TILE_VL)
+    target = target4[:3]
+    idx = cands.index(target4)
     key = ("matmul", (m, n, k, str(a.dtype), at.platform.device_kind()))
     at._GLOBAL._load_disk()[at._cache_key(key[0], key[1], cands)] = idx
     at._GLOBAL._save_disk()

@@ -239,22 +239,20 @@ def _xla_grouped(x_sorted, w, splits, out_dtype, cfg):
     )
 
 
-_GROUPED_VL = 100 * 2**20
-
-
 def _backend_candidates(t: int, k: int, n_dim: int) -> list:
     """Mixed backend sweep for the grouped matmul (see
     ``tune.autotuner.matmul_backend_candidates`` for the rationale):
     ragged_dot dispatch variants first, then the Pallas tilings."""
-    from ..tune.autotuner import xla_backend_candidates
+    from ..tune.autotuner import MATMUL_TILE_VL, xla_backend_candidates
 
     xla = xla_backend_candidates()
     # the three best-measured pad-eliding Pallas tilings (round-4 sweep at
     # the bench shape: 145-156 TF/s stable vs ragged_dot's 67-138 —
-    # see GroupGemmConfig); raised VMEM budget for the deep-k variants.
-    # Short list = cheap fresh tunes.
+    # see GroupGemmConfig); raised VMEM budget (the shared big-tile
+    # budget knob, tune.autotuner.MATMUL_TILE_VL) for the deep-k
+    # variants.  Short list = cheap fresh tunes.
     tiles = [(512, 2048, 1024), (512, 2048, 512), (512, 1024, 512)]
-    return xla + [GroupGemmConfig(bm, bn, bk, _GROUPED_VL)
+    return xla + [GroupGemmConfig(bm, bn, bk, MATMUL_TILE_VL)
                   for bm, bn, bk in tiles
                   if bm <= t and bn <= n_dim and bk <= k]
 

@@ -1,29 +1,158 @@
-"""Tutorial 02 — AllGather methods (reference 02-intra-node-allgather.rst).
+"""Tutorial 02 — the AllGather family (reference 02-intra-node-allgather.rst).
 
-Three kernels (one-shot push, unidirectional ring, bidirectional ring) and
-the size-based auto-selection; golden vs jax.lax.all_gather.
+Tutorial 01 hand-rolled a ONE-SHOT AllGather: every rank pushes its block
+to every peer, n-1 messages per link in one latency hop.  This tutorial
+adds the other two members of the family and the reasoning that picks
+between them:
+
+* **PUSH_1SHOT** — all-to-all push.  Per rank: ``(n-1) * nbytes`` sent,
+  ONE hop of latency.  Wins while messages are small enough that hop
+  latency, not wire time, dominates.
+* **RING_1D** — n-1 steps; at step s each rank forwards the chunk it
+  received at step s-1 to its right neighbor.  Per rank: the same
+  ``(n-1) * nbytes`` sent — but each LINK only ever carries each chunk
+  once and all links run concurrently, so aggregate wire time is one
+  chunk per step, at the cost of n-1 latency-chained hops.  Wins for
+  large payloads.
+* **RING_BIDIR** — two counter-rotating rings, each carrying half of
+  every chunk: halves the number of serial hops for the same total wire
+  bytes on a bidirectional ICI torus.
+
+The reference reaches the same three shapes on NVLink (its
+``allgather.py:46-601``); here the wire is the ICI torus and the kernels
+are ``comm/allgather.py``.  Below you will:
+
+1. write a minimal RING kernel inline (one ``remote_copy`` per step,
+   with the forward-what-just-arrived dependency made explicit),
+2. check it and all three production methods against
+   ``jax.lax.all_gather``-equivalent replication,
+3. read the latency/bandwidth crossover out of ``resolve_method`` and
+   verify the auto-chosen method at both extremes.
 """
 
 from common import bootstrap
 
 jax, mesh_lib = bootstrap()
 
+import functools
+
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu.comm import AllGatherMethod, all_gather
+from triton_distributed_tpu.comm.allgather import resolve_method
+from triton_distributed_tpu.core import compilation
+from triton_distributed_tpu.lang import primitives as dl
+from triton_distributed_tpu.lang.primitives import Team
+
+N = 8
+BLOCK = (8, 128)
+
+
+# ---------------------------------------------------------------------------
+# A minimal unidirectional ring AllGather.  The production kernel
+# (comm/allgather.py RING_1D) adds chunked double-buffering and ACK
+# credits; this one keeps only the essential dependency structure:
+#
+#   step 0: send MY block right;            wait for left's block
+#   step s: send the block I got at s-1;    wait for the next arrival
+#
+# Every rank talks only to its two neighbors — that is what makes the
+# ring the bandwidth shape on a torus: no link ever carries any chunk
+# twice.
+
+
+def ring_ag_kernel(team, x_ref, out_ref, send_sem, recv_sems):
+    me, n = team.rank(), team.size
+    rows = x_ref.shape[0]
+
+    # own block lands in slot[me] (local DMA; completes before the sends
+    # below may forward it at step 0)
+    def own_copy(sem):
+        dl.local_copy(x_ref, out_ref.at[pl.ds(me * rows, rows)], sem).wait()
+
+    pl.run_scoped(own_copy, pltpu.SemaphoreType.DMA)
+    dl.collective_prologue(team, neighbors_only=True)
+    _, right = team.neighbor_ranks()
+    right_id = team.device_id(right)
+    for s in range(n - 1):
+        # the chunk that entered MY slot table most recently: my own block
+        # at step 0, the step s-1 arrival after that — its origin is rank
+        # (me - s) mod n, and it goes to the SAME slot on my right
+        # neighbor, so the slice is identical on both sides of the copy
+        src = jax.lax.rem(me + jnp.int32(n - s), jnp.int32(n))
+        src_slot = out_ref.at[pl.ds(src * rows, rows)]
+        dl.remote_copy(src_slot, src_slot, send_sem, recv_sems.at[s],
+                       right_id)
+        # this step's arrival from the LEFT must land before the next
+        # iteration forwards it (recv_sems[s] counts exactly one block)
+        arrived = jax.lax.rem(me + jnp.int32(n - s - 1), jnp.int32(n))
+        dl.wait_recv(out_ref.at[pl.ds(arrived * rows, rows)],
+                     recv_sems.at[s])
+    # balance the n-1 outgoing sends (tutorial 01, rule 3)
+    for _ in range(n - 1):
+        dl.wait_send(x_ref, send_sem)
+
+
+def build_ring(team):
+    call = pl.pallas_call(
+        functools.partial(ring_ag_kernel, team),
+        out_shape=jax.ShapeDtypeStruct((N * BLOCK[0], BLOCK[1]), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA((N - 1,))],
+        compiler_params=compilation.compiler_params(
+            collective=True,
+            collective_id=compilation.collective_id("tutorial"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+    mesh = mesh_lib.tp_mesh(N)
+    return compilation.jit_shard_map(
+        call, mesh, in_specs=P("tp", None), out_specs=P("tp", None)
+    )
 
 
 def main():
-    mesh = mesh_lib.tp_mesh(8)
-    x = jax.random.normal(jax.random.key(0), (8 * 32, 256), jnp.float32)
+    mesh = mesh_lib.tp_mesh(N)
+    team = Team.of(mesh, "tp")
+    x = jax.random.normal(jax.random.key(0), (N * BLOCK[0], BLOCK[1]),
+                          jnp.float32)
     xs = mesh_lib.shard(mesh, x, "tp", None)
+
+    # 1. the inline ring kernel: every rank's copy equals the full input
+    fn = build_ring(team)
+    out = np.asarray(jax.device_get(fn(xs))).reshape(N, N * BLOCK[0],
+                                                     BLOCK[1])
+    for r in range(N):
+        np.testing.assert_allclose(out[r], np.asarray(x), atol=0, rtol=0)
+    print("inline ring AllGather == full input on every rank     OK")
+
+    # 2. the three production methods + AUTO against the same golden
     for method in (AllGatherMethod.PUSH_1SHOT, AllGatherMethod.RING_1D,
                    AllGatherMethod.RING_BIDIR, AllGatherMethod.AUTO):
-        out = all_gather(xs, mesh, method=method)
-        np.testing.assert_allclose(np.asarray(jax.device_get(out)),
+        got = all_gather(xs, mesh, method=method)
+        np.testing.assert_allclose(np.asarray(jax.device_get(got)),
                                    np.asarray(x))
-        print(f"{method.value:12s} OK")
+        print(f"comm.all_gather {method.value:12s} == replicated x    OK")
+
+    # 3. the crossover: AUTO resolves from per-shard bytes.  A few-KB
+    # decode activation wants the one-hop push; a hundreds-MB prefill
+    # gather wants a ring (thresholds measured on-chip; see
+    # comm/allgather.py).
+    small = resolve_method(AllGatherMethod.AUTO, (8, 128), jnp.bfloat16, N)
+    large = resolve_method(AllGatherMethod.AUTO, (16384, 8192), jnp.bfloat16,
+                           N)
+    print(f"auto-select: 2 KiB shard -> {small.value}, "
+          f"256 MiB shard -> {large.value}")
+    assert small == AllGatherMethod.PUSH_1SHOT
+    assert large in (AllGatherMethod.RING_1D, AllGatherMethod.RING_BIDIR)
+    print("\nNext: 03 lifts the ring onto a two-level ICI x DCN mesh; 07 "
+          "fuses it INTO a matmul so the wire hides behind the MXU.")
 
 
 if __name__ == "__main__":

@@ -1,9 +1,38 @@
-"""Tutorial 03 — two-level (ICI + DCN) AllGather (reference
+"""Tutorial 03 — two-level (ICI x DCN) collectives (reference
 03-inter-node-allgather.rst).
 
-Within a slice the Pallas ring rides ICI remote DMA; across slices there
-is no device-initiated DMA, so the outer level rides XLA's DCN
-collectives — the standard TPU multi-slice split.
+Tutorial 02's rings assumed every peer is reachable by remote DMA.
+That is true WITHIN a TPU slice (the ICI torus) and false ACROSS slices:
+a pod's slices talk over the data-center network (DCN), and TPU remote
+DMA is device-initiated over ICI only.  The reference faces the same
+split on GPU clusters — NVLink inside a node, IB/Ethernet across — and
+its 2D AllGather stages intra-node copy-engine rings against cross-node
+transfers (``allgather.py:442-601``).
+
+The TPU mapping (``comm/allgather.py::hierarchical_all_gather``):
+
+* **inner level (ICI)** — this framework's Pallas ring/push kernels,
+  exactly tutorial 02's, run independently inside each slice;
+* **outer level (DCN)** — ``lax.all_gather`` over the outer mesh axis:
+  XLA owns the DCN transport, so the cross-slice hop is its collective;
+* **ordering contract** — rows come back in GLOBAL rank order
+  (outer-major), indistinguishable from a flat AG over one combined
+  axis.  Layers built on flat AG move to a 2-level mesh untouched.
+
+Why stage at all, instead of one flat ring over all n_out*n_in ranks?
+DCN bandwidth is an order of magnitude below ICI, and its hop latency
+is worse still.  A flat ring takes n_out*n_in - 1 LATENCY-CHAINED hops,
+and in the worst placement every one of them crosses the DCN.  Staged,
+the inner AG runs entirely on ICI, and the DCN carries ONE outer
+collective — each chip ships its slice's gathered block
+((n_out - 1) * n_in shard-sizes, vs the flat worst case's
+n_out*n_in - 1) in a single XLA-scheduled exchange instead of a serial
+hop chain.  The same asymmetry argument shapes
+``hierarchical_sp_attention``'s superchunk rotation (tutorial 09).
+
+Below: the mesh-layout convention, golden checks for AG/RS/AR, the
+flat-vs-staged equivalence, and the DCN wire accounting that justifies
+the staging.
 """
 
 from common import bootstrap
@@ -14,33 +43,74 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from triton_distributed_tpu.comm.allgather import hierarchical_all_gather
+from triton_distributed_tpu.comm.allgather import (
+    all_gather, hierarchical_all_gather,
+)
 from triton_distributed_tpu.comm.allreduce import hierarchical_all_reduce
 from triton_distributed_tpu.comm.reduce_scatter import (
     hierarchical_reduce_scatter,
 )
 
+N_OUT, N_IN = 2, 4            # 2 slices x 4 chips (simulated on 8 devices)
+M, R = 16, 256                # rows per device, row width
+
 
 def main():
-    mesh = mesh_lib.make_mesh({"dcn": 2, "ici": 4},
-                              devices=jax.devices()[:8])
-    x = jax.random.normal(jax.random.key(0), (8 * 16, 256), jnp.float32)
+    n = N_OUT * N_IN
+    # the axis ORDER in the mesh dict is the layout contract: outer
+    # (DCN) axis first, so P(("dcn", "ici")) shards dim 0 outer-major —
+    # device (o, i) holds rows [(o*N_IN + i) * M, ...).  core/mesh.py's
+    # DCN prefix convention automates this on real multi-slice topologies.
+    mesh = mesh_lib.make_mesh({"dcn": N_OUT, "ici": N_IN},
+                              devices=jax.devices()[:n])
+    x = jax.random.normal(jax.random.key(0), (n * M, R), jnp.float32)
     xs = jax.device_put(x, NamedSharding(mesh, P(("dcn", "ici"), None)))
-    out = hierarchical_all_gather(xs, mesh, "ici", "dcn")
-    np.testing.assert_allclose(np.asarray(jax.device_get(out)), np.asarray(x))
-    print("hierarchical (2x4) AG OK")
 
-    # the whole two-level family shares the shape convention: inner level
-    # on the ICI Pallas rings, outer level on XLA's DCN collectives
-    want = np.asarray(x).reshape(8, 16, 256).sum(0)
+    # 1. hierarchical AG == the full input, in global rank order
+    out = hierarchical_all_gather(xs, mesh, "ici", "dcn")
+    np.testing.assert_allclose(np.asarray(jax.device_get(out)),
+                               np.asarray(x))
+    print("hierarchical (2x4) AllGather == full input            OK")
+
+    # 2. and == the FLAT AG over a combined 8-rank axis (the ordering
+    # contract: staging is invisible to the caller)
+    flat_mesh = mesh_lib.tp_mesh(n)
+    flat = all_gather(mesh_lib.shard(flat_mesh, x, "tp", None), flat_mesh)
+    np.testing.assert_allclose(np.asarray(jax.device_get(out)),
+                               np.asarray(jax.device_get(flat)))
+    print("staged 2-level AG == flat single-axis AG              OK")
+
+    # 3. the whole family shares the convention: RS and AR stage the
+    # same way (inner Pallas ring, outer XLA collective)
+    want = np.asarray(x).reshape(n, M, R).sum(0)
     rs = hierarchical_reduce_scatter(xs, mesh, "ici", "dcn")
     np.testing.assert_allclose(np.asarray(jax.device_get(rs)), want,
                                rtol=1e-5, atol=1e-5)
-    print("hierarchical (2x4) RS OK")
+    print("hierarchical ReduceScatter == stacked sum             OK")
     ar = hierarchical_all_reduce(xs, mesh, "ici", "dcn")
     np.testing.assert_allclose(np.asarray(jax.device_get(ar)), want,
                                rtol=1e-5, atol=1e-5)
-    print("hierarchical (2x4) AR OK")
+    print("hierarchical AllReduce == stacked sum                 OK")
+
+    # 4. the DCN accounting.  For the AG of an (M, R) f32 shard, the
+    # implementation (comm/allgather.py::_build_hierarchical) gathers the
+    # slice over ICI FIRST, then outer-AllGathers the (N_IN * M, R)
+    # slice block over DCN — so each chip's DCN traffic is
+    # (N_OUT - 1) * N_IN shard-sizes, in ONE XLA-scheduled exchange,
+    # vs the flat ring's worst case of n - 1 shard-sizes across n - 1
+    # LATENCY-CHAINED hops.  The byte win is modest; the latency win
+    # (one DCN exchange vs a serial hop chain through the slow links)
+    # is the point.
+    nbytes = M * R * 4
+    flat_dcn = (n - 1) * nbytes
+    staged_dcn = (N_OUT - 1) * N_IN * nbytes
+    print(f"\n  per-chip DCN bytes, worst-case flat ring: {flat_dcn:,} "
+          f"across {n - 1} serial hops"
+          f"\n  per-chip DCN bytes, staged:               {staged_dcn:,} "
+          f"in 1 outer exchange"
+          f"\n  (all {N_IN - 1} repeated hops per chunk ride the fast ICI)")
+    print("\nNext: 09's hierarchical SP attention applies the same "
+          "ICI-inner / DCN-outer staging to ring attention's KV rotation.")
 
 
 if __name__ == "__main__":

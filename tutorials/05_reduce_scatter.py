@@ -1,28 +1,160 @@
-"""Tutorial 05 — ring ReduceScatter (reference
-05/06-reduce-scatter.rst): ACK-credit double-buffered ring; golden vs the
-stacked-partials sum.
+"""Tutorial 05 — ring ReduceScatter (reference 05/06-reduce-scatter.rst).
+
+ReduceScatter is AllGather's adjoint: where tutorial 02's ring FORWARDS
+chunks unchanged, this ring ADDS into the chunk as it passes.  Every
+rank holds stacked (M, R) partial addends; rank r must end with
+row-chunk r of the element-wise SUM.  The partial destined for rank r
+originates at rank r+1, hops right n-1 times, and each host folds in
+its own rows for that chunk — one add per hop, so the reduction is
+complete exactly when the partial reaches its owner.
+
+You will write that kernel inline below.  It differs from the
+production ``comm/reduce_scatter.py`` in what it leaves out, and the
+missing pieces are the production lessons:
+
+* **Buffer reuse needs flow control.**  The inline kernel spends one
+  receive slot PER STEP, so no sender can ever overwrite a buffer its
+  neighbor still reads — correct by construction, at n-1 buffers of
+  memory.  Production keeps TWO buffers and adds ACK credits: the
+  receiver raises an ACK semaphore per consumed buffer and the sender
+  blocks until it holds a credit (the reference's signal flags gate
+  buffer reuse the same way, ``reduce_scatter.py:688-882``).  A naive
+  single/double buffer WITHOUT credits races exactly when one rank runs
+  ahead — the bug class tutorial 01's rule 3 warns about.
+* **Wait for your own send.**  Overwriting the accumulator while the
+  outgoing DMA still reads it is the subtle local race; the kernel
+  marks where ``wait_send`` guards it.
+* **Chunking.**  Production splits rows into tiles so the first add
+  starts before the whole shard arrives, and overlaps each tile's wire
+  with the previous tile's add.
+
+Both kernels are checked against the stacked-partials golden, and step 3
+verifies the AG<->RS adjoint identity that the fused collective GEMMs'
+backward passes ride.
 """
 
 from common import bootstrap
 
 jax, mesh_lib = bootstrap()
 
+import functools
+
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
 
-from triton_distributed_tpu.comm import reduce_scatter
+from triton_distributed_tpu.comm import all_gather, reduce_scatter
+from triton_distributed_tpu.core import compilation
+from triton_distributed_tpu.lang import primitives as dl
+from triton_distributed_tpu.lang.primitives import Team
+
+N = 8
+M, R = 8, 128   # rows per rank-chunk, row width (keep last dim at 128)
+
+
+def ring_rs_kernel(team, x_ref, out_ref, acc, recv_bufs, send_sem,
+                   recv_sems):
+    """Minimal add-as-you-forward ring.  ``x_ref``: my (N*M, R) stacked
+    partials in ANY space; ``acc``: the partial I am about to send;
+    ``recv_bufs``: ONE receive slot PER STEP.  Distinct slots make the
+    kernel race-free by construction — a sender can never overwrite a
+    buffer its neighbor is still reading, however far ahead it runs.
+    Production cannot afford n-1 live buffers, so it keeps TWO and adds
+    the ACK-credit handshake that bounds sender/receiver skew instead;
+    that credit protocol is exactly what this tutorial kernel trades
+    memory to avoid.  ``out_ref``: my (M, R) result chunk."""
+    me = team.rank()
+    _, right = team.neighbor_ranks()
+    right_id = team.device_id(right)
+
+    def run(buf, sem):
+        def my_rows(c):
+            # my addend for chunk c: rows [c*M, (c+1)*M) of my stack
+            dl.local_copy(x_ref.at[pl.ds(c * M, M)], buf, sem).wait()
+            return buf[...]
+
+        dl.collective_prologue(team, neighbors_only=True)
+        # originate the longest-journey partial: chunk (me - 1) mod n
+        c0 = jax.lax.rem(me + jnp.int32(N - 1), jnp.int32(N))
+        acc[...] = my_rows(c0)
+        for s in range(1, N):
+            # ship my accumulator into the right neighbor's step-s slot;
+            # my left neighbor fills MY step-s slot symmetrically
+            dl.remote_copy(acc, recv_bufs.at[s - 1], send_sem,
+                           recv_sems.at[s - 1], right_id)
+            dl.wait_recv(recv_bufs.at[s - 1], recv_sems.at[s - 1])
+            # my outgoing DMA must finish READING acc before the add
+            # below overwrites it (send/overwrite race — the subtle one)
+            dl.wait_send(acc, send_sem)
+            c = jax.lax.rem(me + jnp.int32(N - s - 1), jnp.int32(N))
+            acc[...] = recv_bufs[s - 1] + my_rows(c)
+        # after n-1 hops + adds the accumulator IS chunk ``me`` complete
+        dl.local_copy(acc, out_ref, sem).wait()
+
+    pl.run_scoped(run, pltpu.VMEM((M, R), jnp.float32),
+                  pltpu.SemaphoreType.DMA)
+
+
+def build_rs(team):
+    call = pl.pallas_call(
+        functools.partial(ring_rs_kernel, team),
+        out_shape=jax.ShapeDtypeStruct((M, R), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.VMEM((M, R), jnp.float32),
+                        pltpu.VMEM((N - 1, M, R), jnp.float32),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA((N - 1,))],
+        compiler_params=compilation.compiler_params(
+            collective=True,
+            collective_id=compilation.collective_id("tutorial"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+    mesh = mesh_lib.tp_mesh(N)
+    return compilation.jit_shard_map(
+        call, mesh, in_specs=P("tp", None), out_specs=P("tp", None)
+    )
 
 
 def main():
-    n, m, r = 8, 64, 256
-    mesh = mesh_lib.tp_mesh(n)
-    x = jax.random.normal(jax.random.key(0), (n * m, r), jnp.float32) * 0.1
+    mesh = mesh_lib.tp_mesh(N)
+    team = Team.of(mesh, "tp")
+    x = jax.random.normal(jax.random.key(0), (N * N * M, R),
+                          jnp.float32) * 0.1
     xs = mesh_lib.shard(mesh, x, "tp", None)
-    out = reduce_scatter(xs, mesh)
-    want = np.asarray(x).reshape(n, m, r).sum(0)
-    np.testing.assert_allclose(np.asarray(jax.device_get(out)), want,
+    want = np.asarray(x).reshape(N, N * M, R).sum(0)   # (N*M, R)
+
+    # 1. inline serial ring: the stacked outputs equal the golden sum
+    fn = build_rs(team)
+    out = np.asarray(jax.device_get(fn(xs)))           # (N*M, R) stacked
+    np.testing.assert_allclose(out, want, atol=1e-4, rtol=1e-4)
+    print("inline add-as-you-forward ring == stacked sum         OK")
+
+    # 2. the production double-buffered ACK-credit ring: same contract
+    out2 = reduce_scatter(xs, mesh)
+    np.testing.assert_allclose(np.asarray(jax.device_get(out2)), want,
                                atol=1e-4, rtol=1e-4)
-    print("ring RS OK:", out.shape)
+    print(f"comm.reduce_scatter == stacked sum {tuple(out2.shape)}      OK")
+
+    # 3. RS and AG are adjoints: <AG(y), x> == <y, RS(x)> for every x, y.
+    # This identity is why the fused collective GEMMs can swap wire
+    # patterns between forward and backward (ops/gemm_rs.py's VJP).
+    y = jax.random.normal(jax.random.key(1), (N * M, R), jnp.float32)
+    ys = mesh_lib.shard(mesh, y, "tp", None)
+    agy = np.asarray(jax.device_get(all_gather(ys, mesh)),
+                     dtype=np.float64)           # every rank: the full y
+    rsx = np.asarray(jax.device_get(reduce_scatter(xs, mesh)),
+                     dtype=np.float64)           # the summed chunks
+    x_np = np.asarray(x, dtype=np.float64).reshape(N, N * M, R)
+    lhs = float(sum((agy * x_np[r]).sum() for r in range(N)))
+    rhs = float((np.asarray(y, dtype=np.float64) * rsx).sum())
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-6)
+    print("<AG(y), x> == <y, RS(x)> (adjoint pair)               OK")
+    print("\nNext: 06 composes RS+AG into the fused two-shot AllReduce; "
+          "08 fuses RS INTO the matmul that produces its input.")
 
 
 if __name__ == "__main__":

@@ -1,8 +1,35 @@
-"""Tutorial 06 — AllReduce family + fused GEMM+AR.
+"""Tutorial 06 — the AllReduce family + fused GEMM+AR (reference
+``allreduce.py:28,224-693``, ``e2e_dense.md`` "GEMM + AllReduce").
 
-One-shot (full-mesh push + local f32 reduce, latency-optimal) vs fused
-two-shot (RS ring + AG ring in ONE kernel, bandwidth-optimal), and the
-fused row-parallel GEMM+AllReduce.
+AllReduce = everyone ends with the SUM of everyone's partials.  Two
+algorithms span the latency/bandwidth trade, exactly as in tutorials
+02/05 — because an AllReduce IS a ReduceScatter followed by an
+AllGather:
+
+* **ONE_SHOT** — every rank pushes its whole partial to every peer and
+  reduces locally in f32.  Per rank: ``(n-1) * nbytes`` sent, ONE hop.
+  The latency choice: a decode step's (B, H) activation is ~100 KB and
+  hop latency dominates; this is the reference's choice at decode sizes
+  and what ``models/qwen.py``'s ``decode_mode="ar"`` rides.
+* **TWO_SHOT** — an RS ring then an AG ring, FUSED into one kernel (no
+  intermediate HBM round trip between the phases; the AG forwards
+  chunks as soon as their reduction completes).  Per rank:
+  ``2 (n-1)/n * nbytes`` — n/2x less wire than one-shot — across
+  2(n-1) latency-chained hops.  The bandwidth choice for prefill-sized
+  tensors.
+
+The size crossover lives in ``comm.allreduce.choose_method`` and is the
+same reasoning as the reference's nbytes switch (``allreduce.py:1042``).
+
+Below you will:
+
+1. check both algorithms against the stacked-partials golden;
+2. DERIVE two-shot from tutorials 02+05 — compose the production
+   ``reduce_scatter`` and ``all_gather`` and confirm the fused kernel
+   computes exactly that composition;
+3. print the per-rank wire table that drives the auto-selection;
+4. run the fused GEMM+AllReduce (``ops/gemm_ar.py`` — the op behind the
+   reference's headline decode win) and differentiate through it.
 """
 
 from common import bootstrap
@@ -13,31 +40,79 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from triton_distributed_tpu.comm import AllReduceMethod, all_reduce
+from triton_distributed_tpu.comm import (
+    AllReduceMethod, all_gather, all_reduce, reduce_scatter,
+)
+from triton_distributed_tpu.comm.allreduce import choose_method
 from triton_distributed_tpu.ops import gemm_ar
+
+N = 8
 
 
 def main():
-    n, m, r = 8, 64, 256
+    n, m, r = N, 64, 256
     mesh = mesh_lib.tp_mesh(n)
     x = jax.random.normal(jax.random.key(0), (n * m, r), jnp.float32) * 0.1
     xs = mesh_lib.shard(mesh, x, "tp", None)
     want = np.asarray(x).reshape(n, m, r).sum(0)
+
+    # 1. both algorithms against the stacked-partials golden.  Note the
+    # one-shot reduces in f32 regardless of input dtype — n-way bf16
+    # adds in arrival order would drift with n.
     for method in (AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT):
-        out = all_reduce(xs, mesh, method=method)
+        out = all_reduce(xs, mesh, method=method)   # (m, r), replicated
         np.testing.assert_allclose(np.asarray(jax.device_get(out)), want,
                                    atol=1e-4, rtol=1e-4)
-        print(f"{method.value:9s} OK")
+        print(f"all_reduce {method.value:9s} == stacked sum          OK")
 
+    # 2. two-shot IS RS-then-AG: the fused kernel must equal the
+    # composition of the two production rings from tutorials 05 and 02
+    composed = all_gather(reduce_scatter(xs, mesh), mesh)
+    fused = all_reduce(xs, mesh, method=AllReduceMethod.TWO_SHOT)
+    np.testing.assert_allclose(np.asarray(jax.device_get(fused)),
+                               np.asarray(jax.device_get(composed)),
+                               atol=1e-5, rtol=1e-5)
+    print("fused two-shot == all_gather(reduce_scatter(x))       OK")
+
+    # 3. the wire table behind the auto-selection (per rank, per AR)
+    print("\n  per-rank wire bytes      one_shot        two_shot   auto")
+    for nbytes in (64 * 1024, 512 * 1024, 16 * 2**20):
+        one = (n - 1) * nbytes
+        two = int(2 * (n - 1) / n * nbytes)
+        pick = choose_method(nbytes, n).value
+        print(f"  {nbytes:>12,} B   {one:>12,} B {two:>12,} B   {pick}")
+    print()
+
+    # 4. the fused row-parallel GEMM+AllReduce: each rank multiplies its
+    # K-shard and the ring reduces+replicates the partials while later
+    # chunks are still on the MXU.  This op (switched in by
+    # Engine.set_decode_mode("gemm_ar")) is the TPU form of the
+    # reference's "GEMM + AllReduce" decode headline.
     mm, k, nn = 64, 256, 128
     a = jax.random.normal(jax.random.key(1), (mm, k), jnp.float32) * 0.1
     b = jax.random.normal(jax.random.key(2), (k, nn), jnp.float32) * 0.1
     a_s = jax.device_put(a, NamedSharding(mesh, P(None, "tp")))
     b_s = jax.device_put(b, NamedSharding(mesh, P("tp", None)))
-    out = gemm_ar(a_s, b_s, mesh)
+    out = gemm_ar(a_s, b_s, mesh)                   # (mm, nn), replicated
     np.testing.assert_allclose(np.asarray(jax.device_get(out)),
                                np.asarray(a @ b), atol=1e-3, rtol=1e-3)
-    print("fused gemm_ar OK")
+    print("fused gemm_ar == a @ b (replicated on every rank)     OK")
+
+    # gradients through the fused op match the dense matmul's
+    def loss_fused(a_, b_):
+        return (gemm_ar(a_, b_, mesh).astype(jnp.float32) ** 2).sum()
+
+    ga_f, gb_f = jax.grad(loss_fused, argnums=(0, 1))(a_s, b_s)
+    ga_d, gb_d = jax.grad(
+        lambda a_, b_: ((a_ @ b_) ** 2).sum(), argnums=(0, 1)
+    )(a, b)
+    np.testing.assert_allclose(np.asarray(jax.device_get(ga_f)),
+                               np.asarray(ga_d), atol=2e-2, rtol=2e-2)
+    np.testing.assert_allclose(np.asarray(jax.device_get(gb_f)),
+                               np.asarray(gb_d), atol=2e-2, rtol=2e-2)
+    print("grad through fused gemm_ar == dense matmul grad       OK")
+    print("\nNext: 10 switches a real model's decode step between psum / "
+          "ar / gemm_ar with Engine.set_decode_mode.")
 
 
 if __name__ == "__main__":

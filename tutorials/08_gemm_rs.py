@@ -1,17 +1,83 @@
 """Tutorial 08 — fused GEMM-ReduceScatter (reference
-08-overlapping-gemm-reduce-scatter.rst): compute-ahead-of-wire ring; the
-matmul of ring step s hides the transfer of step s-1.
+08-overlapping-gemm-reduce-scatter.rst).
+
+The row-parallel half of a TP layer: ``a`` arrives K-sharded (each rank
+holds the full M rows of a (M, K/n) slice), ``b`` is row-sharded to
+match, and every rank's local matmul produces a PARTIAL (M, N) result
+that must be summed over ranks and scattered so rank r keeps rows
+[r*M/n, (r+1)*M/n).  Unfused, that is ``matmul`` then ``psum_scatter``
+— compute, THEN wire, serially.
+
+The fused op (``ops/gemm_rs.py``) rides a ring instead.  The key idea —
+COMPUTE AHEAD OF WIRE — is a scheduling statement:
+
+    at ring step s, compute exactly the output CHUNK whose partial sum
+    must depart this step; send it; the next step's chunk matmul runs
+    while those bytes fly.
+
+Chunk order falls out of the ring: the partial destined for rank r must
+visit every other rank once, so it ORIGINATES at rank r+1 and hops right
+n-1 times; each host adds its own contribution for that chunk on
+arrival.  On rank ``me`` that means: originate chunk (me-1) mod n, then
+at step s receive the partial for chunk (me-s-1) mod n, add my matmul of
+that chunk, forward.  After n-1 steps the partial arriving is chunk
+``me`` — fully reduced, mine to keep.  Wire per rank: (n-1)/n * M*N
+bytes — identical to unfused psum_scatter — but hidden behind n-1 chunk
+matmuls.
+
+Below you will:
+
+1. build that schedule inline from XLA pieces (``shard_map`` +
+   ``ppermute``) — the algorithm without the Pallas overlap machinery —
+   and check it against the plain matmul golden;
+2. run the production fused kernel and check the identical result and
+   layout;
+3. differentiate THROUGH the fused op and see the AG<->RS adjoint
+   duality: the backward of a GEMM-RS is built from an AllGather of the
+   cotangent (tutorial 07's wire pattern), so the backward pass overlaps
+   its communication exactly like the forward.
 """
 
 from common import bootstrap
 
 jax, mesh_lib = bootstrap()
 
+import functools
+
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from triton_distributed_tpu.core import compilation
 from triton_distributed_tpu.ops import gemm_rs
+
+
+def ring_gemm_rs_reference(a_loc, b_loc, *, axis: str, n: int):
+    """The fused kernel's ring schedule, written as n-1 explicit XLA
+    steps inside ``shard_map``.  XLA executes these serially — that is
+    the point: the Pallas kernel exists to overlap step s's wire with
+    step s+1's matmul — but the chunk order, partial-sum dataflow, and
+    final layout are exactly the fused op's (``ops/gemm_rs.py``)."""
+    me = jax.lax.axis_index(axis)
+    rows = a_loc.shape[0] // n
+
+    def chunk(idx):
+        # my contribution to output rows [idx*rows, (idx+1)*rows)
+        return jax.lax.dynamic_slice_in_dim(a_loc, idx * rows, rows, 0) @ b_loc
+
+    # originate the partial destined for my LEFT neighbor: it has the
+    # longest journey (n-1 hops rightward back around to rank me-1)
+    acc = chunk(jax.lax.rem(me + jnp.int32(n - 1), jnp.int32(n)))
+    for s in range(1, n):
+        # the in-flight partial moves one hop right...
+        acc = jax.lax.ppermute(
+            acc, axis, [(r, (r + 1) % n) for r in range(n)]
+        )
+        # ...and I add my matmul for the chunk it now represents; in the
+        # fused kernel THIS matmul is what hides the hop's wire time
+        acc = acc + chunk(jax.lax.rem(me + jnp.int32(n - s - 1),
+                                      jnp.int32(n)))
+    return acc  # step n-1 added chunk ``me``: fully reduced, mine
 
 
 def main():
@@ -21,11 +87,41 @@ def main():
     b = jax.random.normal(jax.random.key(1), (k, nn), jnp.float32) * 0.1
     a_s = jax.device_put(a, NamedSharding(mesh, P(None, "tp")))    # K-shard
     b_s = jax.device_put(b, NamedSharding(mesh, P("tp", None)))    # row-shard
-    out = gemm_rs(a_s, b_s, mesh)
     want = np.asarray(a @ b)
+
+    # 1. the inline XLA ring: same schedule, no overlap machinery
+    ref = compilation.jit_shard_map(
+        functools.partial(ring_gemm_rs_reference, axis="tp", n=n),
+        mesh, in_specs=(P(None, "tp"), P("tp", None)),
+        out_specs=P("tp", None),
+    )
+    got_ref = np.asarray(jax.device_get(ref(a_s, b_s)))
+    np.testing.assert_allclose(got_ref, want, atol=1e-3, rtol=1e-3)
+    print("inline ppermute ring schedule == a @ b                OK")
+
+    # 2. the production fused kernel: identical values and M-sharded layout
+    out = gemm_rs(a_s, b_s, mesh)
     np.testing.assert_allclose(np.asarray(jax.device_get(out)), want,
                                atol=1e-3, rtol=1e-3)
-    print("fused GEMM-RS OK:", out.shape)
+    print(f"fused gemm_rs == a @ b (M-sharded, global {out.shape}) OK")
+
+    # 3. gradients THROUGH the fused op, vs the dense matmul's gradient
+    def loss_fused(a_, b_):
+        return (gemm_rs(a_, b_, mesh).astype(jnp.float32) ** 2).sum()
+
+    def loss_dense(a_, b_):
+        return ((a_ @ b_) ** 2).sum()
+
+    ga_f, gb_f = jax.grad(loss_fused, argnums=(0, 1))(a_s, b_s)
+    ga_d, gb_d = jax.grad(loss_dense, argnums=(0, 1))(a, b)
+    np.testing.assert_allclose(np.asarray(jax.device_get(ga_f)),
+                               np.asarray(ga_d), atol=2e-2, rtol=2e-2)
+    np.testing.assert_allclose(np.asarray(jax.device_get(gb_f)),
+                               np.asarray(gb_d), atol=2e-2, rtol=2e-2)
+    print("grad through fused gemm_rs == dense matmul grad       OK")
+    print("\nNext: 09 applies the same overlap discipline to attention "
+          "(ring SP).  The reference is inference-only — the VJP checked "
+          "here is what lets the training step (12) jit end to end.")
 
 
 if __name__ == "__main__":

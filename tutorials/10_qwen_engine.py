@@ -1,17 +1,47 @@
-"""Tutorial 10 — the end-to-end story: a Qwen3-style TP model served by the
-engine (prefill fills the head-sharded KV cache through the fused layer
-path; decode replays the jitted, cache-donating step), plus autotuning and
-profiling around it.
+"""Tutorial 10 — serving a Qwen3-style TP model with the Engine
+(reference ``engine.py:37-136``, ``qwen.py:54-143``).
+
+Everything the earlier tutorials built — fused AG-GEMM/GEMM-RS layers
+(07/08), flash attention, the AllReduce family (06) — assembles here
+into the serving loop.  The engine's three moving parts, and what each
+translates from the reference:
+
+* **Prefill** runs the prompt through the FUSED layer path (AG-GEMM in,
+  GEMM-RS out) and fills the head-sharded KV cache.  Head sharding
+  means each TP rank stores only its kv-heads' cache — the cache
+  scales down with TP exactly like the weights.
+* **Decode** is one token per call through latency-shaped kernels
+  (split-KV decode attention against the cache).  The reference
+  captures its decode step in a CUDA graph so replay costs no host
+  work; the TPU analogue is ``jax.jit`` with the cache DONATED
+  (``donate_argnums``): the executable updates the cache buffers in
+  place and replays without re-tracing.  First call = capture
+  (compile), every later call = replay.
+* **decode_mode** switches the decode step's row-parallel reductions:
+  ``psum`` (XLA's fused collective), ``ar`` (this framework's one-shot
+  push AllReduce — the latency winner at decode sizes), or ``gemm_ar``
+  (the fully fused GEMM+AllReduce ring).  This is the reference's
+  ``set_fwd('torch'|'triton_dist')`` switch; all three produce the
+  same logits (asserted below), and ``bench.py decode_modes`` records
+  their per-step wire volumes.
+
+Sampling (greedy / temperature / top-p nucleus) is the reference's
+``sample_token``, in jnp.  Around the loop: the autotuner's winner
+cache is consulted by every ``config=None`` op inside the jitted step
+(tutorial 07), and ``tools.group_profile`` captures a trace you can
+open in Perfetto/XProf.
 """
 
 from common import bootstrap
 
 jax, mesh_lib = bootstrap()
 
-import jax.numpy as jnp
 import numpy as np
 
+import jax.numpy as jnp
+
 from triton_distributed_tpu.models import Engine, ModelConfig
+from triton_distributed_tpu.models.engine import sample_token
 from triton_distributed_tpu.tools import gemm_sol_ms, group_profile
 
 
@@ -20,14 +50,53 @@ def main():
                       num_heads=4, num_kv_heads=2, head_dim=32, vocab=128,
                       max_length=64, dtype=jnp.float32)
     mesh = mesh_lib.tp_mesh(2)
+
+    # 1. build = init sharded params + cache + jit (the "CUDA-graph
+    # capture").  batch and max_length fix the decode step's shapes: one
+    # executable serves the whole session.
     eng = Engine.build(cfg, mesh, key=jax.random.key(0), batch=1,
                        temperature=0.7, top_p=0.9)
     ids = jax.random.randint(jax.random.key(1), (1, 16), 0, cfg.vocab)
+
+    # 2. the serve loop under a profiler capture
     with group_profile("qwen-serve", "/tmp/tdt_tutorial_trace"):
         out = eng.generate(ids, gen_len=8, key=jax.random.key(2))
     print("generated tokens:", np.asarray(out))
+
+    # 3. decode_mode parity: the reference's set_fwd switch.  Greedy
+    # sampling so the argmax chain must match token for token.
+    tokens = {}
+    for mode in ("psum", "ar", "gemm_ar"):
+        e = Engine.build(cfg, mesh, key=jax.random.key(0), batch=1,
+                         decode_mode=mode)
+        tokens[mode] = np.asarray(e.generate(ids, gen_len=8))
+    np.testing.assert_array_equal(tokens["psum"], tokens["ar"])
+    np.testing.assert_array_equal(tokens["psum"], tokens["gemm_ar"])
+    print("decode modes psum == ar == gemm_ar (greedy tokens)    OK")
+
+    # 4. the paged cache layout (the reference's production decode
+    # layout): a page pool + block table + ragged per-sequence lengths
+    # behind the same Engine API
+    ep = Engine.build(cfg, mesh, key=jax.random.key(0), batch=1,
+                      cache_layout="paged", page_size=16)
+    paged = np.asarray(ep.generate(ids, gen_len=8))
+    np.testing.assert_array_equal(paged, tokens["psum"])
+    print("paged cache == contiguous cache (greedy tokens)       OK")
+
+    # 5. sampling: greedy vs nucleus on a fixed logit row
+    logits = jnp.asarray([[0.0, 2.0, 1.0, -1.0]])
+    greedy = sample_token(logits, jax.random.key(0))
+    nucl = sample_token(logits, jax.random.key(0), temperature=0.8,
+                        top_p=0.5)
+    assert greedy.shape == nucl.shape == (1,)
+    print(f"sampling: greedy -> {int(greedy[0])}, "
+          f"top_p=0.5 -> {int(nucl[0])} (masked to the nucleus)")
+
     sol = gemm_sol_ms(4096, 4096, 4096, device_kind="TPU v5e")
-    print(f"(for scale: a 4096^3 bf16 GEMM is {sol:.2f} ms at v5e SOL)")
+    print(f"\n(for scale: a 4096^3 bf16 GEMM is {sol:.2f} ms at v5e SOL; "
+          f"tools/perf_model.py prices every kernel here the same way)")
+    print("Next: 11 swaps the MLP for routed MoE experts; 13 tours the "
+          "serving features (ragged batches, paged decode, streaming).")
 
 
 if __name__ == "__main__":

@@ -89,6 +89,31 @@ def main():
                                np.asarray(wantd), atol=2e-5, rtol=2e-5)
     print("4. paged SP flash-decode (block table, ragged lens) OK")
 
+    # 5. PACKED VARIABLE-LENGTH batches (the reference's cu_seqlens,
+    # re-expressed as segment ids): three sequences packed into one row
+    # attend only within their own segment.  The KV segment ids rotate
+    # with the chunks through the flat ring AND through both levels of
+    # the hierarchical path — a long-context serving batch stays packed
+    # across slices.
+    segs = jnp.asarray(
+        np.repeat([0, 1, 2], [s // 2, s // 4, s // 4])[None], jnp.int32
+    )
+    segd = jax.device_put(segs, NamedSharding(mesh, P(None, "sp")))
+    want_vl = flash_attention(q, k, v, causal=True, segment_ids=segs,
+                              block_q=128, block_k=128)
+    out_vl = sp_attention(qs, ks, vs, mesh, axis="sp", causal=True,
+                          segment_ids=segd, block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(jax.device_get(out_vl)),
+                               np.asarray(want_vl), atol=2e-5, rtol=2e-5)
+    segh = jax.device_put(segs, NamedSharding(hmesh, P(None, ("dcn", "ici"))))
+    outh_vl = hierarchical_sp_attention(
+        qh, kh, vh, hmesh, "ici", "dcn", causal=True, segment_ids=segh,
+        block_q=128, block_k=128,
+    )
+    np.testing.assert_allclose(np.asarray(jax.device_get(outh_vl)),
+                               np.asarray(want_vl), atol=2e-5, rtol=2e-5)
+    print("5. packed varlen batch through flat ring AND hierarchy OK")
+
 
 if __name__ == "__main__":
     main()

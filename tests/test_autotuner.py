@@ -255,3 +255,42 @@ def test_sol_fraction_reported(tmp_path):
     res2 = tuner.tune("toy_sol", ("k",), [1, 2], make_thunk, iters=2,
                       sol_ms=0.5)
     assert res2.from_cache and res2.sol_fraction is None
+
+
+def test_fresh_fine_margin_crown_not_persisted(tmp_path, monkeypatch):
+    """A fresh crown that clears only the fine FRESH margins must stay
+    process-local: the shared disk cache hands winners to later
+    processes WITHOUT re-measurement, so only wins clearing the full
+    conservative margin may persist (the round-3 inherited-chip-state
+    regression class)."""
+    from triton_distributed_tpu.tune import autotuner as at
+
+    def run(times_by_candidate, conf_times=None):
+        tuner = Autotuner(path=str(tmp_path / f"c{len(times_by_candidate)}.json"))
+
+        def fake_measure(thunks, iters, rounds=5, target_window_s=0.15):
+            src = conf_times if (conf_times and len(thunks) == 2
+                                 and rounds == 7) else times_by_candidate
+            return {i: src[i] for i in thunks}
+
+        monkeypatch.setattr(tuner, "_measure_interleaved", fake_measure)
+        res = tuner.tune(
+            "toy", ("k",), [0, 1],
+            lambda c: (lambda: jnp.zeros(())),
+            baseline_index=0, margin=0.08, fresh=True,
+        )
+        disk = json.loads(
+            (tmp_path / f"c{len(times_by_candidate)}.json").read_text()
+        ) if (tmp_path / f"c{len(times_by_candidate)}.json").exists() else {}
+        return res.config, disk
+
+    # challenger wins by ~3% (> fine 1.5%, < full 8%): crowned for this
+    # process, NOT written to disk
+    cfg, disk = run({0: 1.00, 1: 0.97})
+    assert cfg == 1
+    assert disk == {}
+
+    # challenger wins by 20% (> full margin): crowned AND persisted
+    cfg, disk = run({0: 1.00, 1: 0.80, 2: 0.80})
+    assert cfg == 1
+    assert list(disk.values()) == [1]

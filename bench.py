@@ -434,11 +434,13 @@ def bench_moe_ep_wire(tokens: int = 4096):
     The codec is MEASURED, not assumed: pack and unpack are timed on the
     chip at a serving-batch shape and the JSON line carries their
     throughput (``codec_gbps``, input GB/s through pack+unpack) plus the
-    NET per-token time win of shipping fp8 at the chip's ICI rate
-    (``net_us_per_token_hop``: wire time saved minus codec cost — the
-    codec only pays off if this is positive; a 10x-slower-than-wire codec
-    would show up as a negative number here, not hide behind the byte
-    ratio).  Round-trip accuracy is asserted at the same shape."""
+    NET per-token time win of shipping fp8 — wire time saved minus codec
+    cost — against both wire classes: ``net_us_per_token_hop_ici`` (the
+    intra-slice torus, where a halved payload saves little and the codec
+    may not pay) and ``net_us_per_token_hop_dcn`` (cross-slice EP, where
+    it clearly does).  A 10x-slower-than-wire codec shows up as negative
+    numbers here, not hidden behind the byte ratio.  Round-trip accuracy
+    is asserted at the same shape."""
     import numpy as np
 
     from triton_distributed_tpu.layers.moe import (
@@ -470,20 +472,27 @@ def bench_moe_ep_wire(tokens: int = 4096):
     in_bytes = tokens * h * 2
     codec_gbps = in_bytes / t_codec_s / 1e9
 
-    # net win per token per hop at the chip's ICI rate: the wire time the
-    # smaller payload saves, minus what the codec costs (pack on the send
-    # side + unpack on the receive side, both on this chip class)
-    ici_gbps = perf_model.chip_spec().ici_gbps
-    wire_saved_s = (bf16_bytes - fp8_bytes) / (ici_gbps * 1e9)
+    # net win per token per hop: the wire time the smaller payload
+    # saves, minus what the codec costs (pack send-side + unpack
+    # recv-side).  Reported against BOTH wire classes, because the
+    # answer differs: on the ICI torus (~186 GB/s/chip) a halved payload
+    # saves so little time that even a fast codec barely pays — the fp8
+    # wire's real economics live on the DCN (cross-slice EP, ~12.5 GB/s
+    # per chip), where the saving dwarfs the codec.
     codec_s_per_token = t_codec_s / tokens
-    net_us = (wire_saved_s - codec_s_per_token) * 1e6
+    saved_bytes = bf16_bytes - fp8_bytes
+    ici_gbps = perf_model.chip_spec().ici_gbps
+    net_ici = (saved_bytes / (ici_gbps * 1e9) - codec_s_per_token) * 1e6
+    net_dcn = (saved_bytes / (perf_model.DCN_GBPS_PER_CHIP * 1e9)
+               - codec_s_per_token) * 1e6
     return {
         "metric": f"moe_ep_a2a_fp8_wire_bytes_h{h}",
         "value": fp8_bytes,
         "unit": "bytes/token/hop",
         "vs_baseline": round(bf16_bytes / fp8_bytes, 4),
         "codec_gbps": round(codec_gbps, 1),
-        "net_us_per_token_hop": round(net_us, 4),
+        "net_us_per_token_hop_ici": round(net_ici, 4),
+        "net_us_per_token_hop_dcn": round(net_dcn, 4),
     }
 
 

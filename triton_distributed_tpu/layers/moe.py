@@ -41,6 +41,59 @@ from ..ops.moe_utils import (
 )
 
 _FP8_SIDECAR = 128   # u8 lanes appended per row: 4 carry the f32 scale
+_PACK_BM = 128       # pack-kernel row block (in 3.7 MB of VMEM at h=7168)
+
+
+def _pack_fp8_kernel(x_ref, o_ref):
+    """One-pass quantize + wire pack (see :func:`_pack_fp8`): absmax ->
+    scale -> e4m3 payload bitcast to u8, with the f32 scale's 4 bytes
+    spread onto the sidecar lanes by iota-select — one HBM read of the
+    bf16 rows and one write of the u8 message, vs the XLA path's
+    materialized quantize + concat (measured 100-166 GB/s XLA vs
+    ~255 GB/s for this kernel at the bench shape)."""
+    xf = x_ref[...].astype(jnp.float32)                    # (bm, h)
+    absmax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
+    scale = absmax / 448.0 + 1e-12                         # (bm, 1)
+    q = (xf / scale).astype(jnp.float8_e4m3fn)
+    payload = jax.lax.bitcast_convert_type(q, jnp.uint8)   # (bm, h)
+    si = jax.lax.bitcast_convert_type(scale, jnp.uint32)   # (bm, 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (q.shape[0], _FP8_SIDECAR), 1)
+    byte = jnp.right_shift(si, (jnp.minimum(lane, 3) * 8).astype(jnp.uint32))
+    sidecar = jnp.where(lane < 4, byte & 0xFF, 0).astype(jnp.uint8)
+    o_ref[...] = jnp.concatenate([payload, sidecar], axis=1)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_pack_fp8(t: int, h: int):
+    from jax.experimental import pallas as pl
+
+    from ..core import compilation
+
+    call = pl.pallas_call(
+        _pack_fp8_kernel,
+        grid=(t // _PACK_BM,),
+        in_specs=[pl.BlockSpec((_PACK_BM, h), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((_PACK_BM, h + _FP8_SIDECAR),
+                               lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((t, h + _FP8_SIDECAR), jnp.uint8),
+        compiler_params=compilation.compiler_params(
+            collective=False, dimension_semantics=("parallel",),
+            # the f32 working tile exceeds the 16 MiB scoped default
+            vmem_limit_bytes=64 * 2**20,
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+    return call
+
+
+def _pack_fp8_xla(x: jax.Array) -> jax.Array:
+    x8, scale = quantize_e4m3(x)                       # (..., H), (..., 1)
+    payload = jax.lax.bitcast_convert_type(x8, jnp.uint8)
+    sc = jax.lax.bitcast_convert_type(
+        scale.astype(jnp.float32), jnp.uint8
+    ).reshape(*x.shape[:-1], 4)
+    pad = jnp.zeros((*x.shape[:-1], _FP8_SIDECAR - 4), jnp.uint8)
+    return jnp.concatenate([payload, sc, pad], axis=-1)
 
 
 def _pack_fp8(x: jax.Array) -> jax.Array:
@@ -49,14 +102,20 @@ def _pack_fp8(x: jax.Array) -> jax.Array:
     configuration ships fp8 tokens with scales in the same message
     (``low_latency_all_to_all.py:36-120``, the 137 us README case).  One
     u8 byte per element + a 128-lane sidecar ≈ halves the wire bytes of a
-    bf16 payload."""
-    x8, scale = quantize_e4m3(x)                       # (..., H), (..., 1)
-    payload = jax.lax.bitcast_convert_type(x8, jnp.uint8)
-    sc = jax.lax.bitcast_convert_type(
-        scale.astype(jnp.float32), jnp.uint8
-    ).reshape(*x.shape[:-1], 4)
-    pad = jnp.zeros((*x.shape[:-1], _FP8_SIDECAR - 4), jnp.uint8)
-    return jnp.concatenate([payload, sc, pad], axis=-1)
+    bf16 payload.
+
+    Runs the fused one-pass Pallas kernel when the shape tiles cleanly;
+    odd shapes and the CPU backend take the XLA path.  The two paths
+    were measured bit-identical on real TPU; under CPU interpret mode
+    fusion differences can shift the last f8/scale ulp, so the CI test
+    (``tests/test_moe_layer.py``) asserts decoded-value equivalence,
+    not byte equality.  The unpack stays XLA: measured competitive."""
+    from ..core import platform
+
+    if (x.ndim == 2 and x.shape[0] % _PACK_BM == 0
+            and x.shape[1] % 128 == 0 and not platform.on_cpu()):
+        return _build_pack_fp8(*x.shape)(x)
+    return _pack_fp8_xla(x)
 
 
 def _unpack_fp8(u8: jax.Array, h: int, out_dtype) -> jax.Array:

@@ -40,6 +40,14 @@ _CHIPS = [
 
 _FALLBACK = ChipSpec("unknown", 180.0, 800.0, 180.0)
 
+# Per-chip DCN (cross-slice) bandwidth, GB/s.  Deliberately a single
+# conservative constant, not a per-chip field: DCN is a property of the
+# pod's NIC provisioning, not the chip (typical public multislice
+# configurations land at ~12-25 GB/s per host / ~3-6 GB/s per chip; we
+# price the optimistic end of per-chip share so DCN-relative wins are
+# UNDERstated, never flattered).
+DCN_GBPS_PER_CHIP = 12.5
+
 
 def chip_spec(device_kind: str | None = None) -> ChipSpec:
     if device_kind is None:

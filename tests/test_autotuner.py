@@ -269,11 +269,18 @@ def test_fresh_fine_margin_crown_not_persisted(tmp_path, monkeypatch):
         tuner = Autotuner(path=str(tmp_path / f"c{len(times_by_candidate)}.json"))
 
         def fake_measure(thunks, iters, rounds=5, target_window_s=0.15):
-            src = conf_times if (conf_times and len(thunks) == 2
-                                 and rounds == 7) else times_by_candidate
-            return {i: src[i] for i in thunks}
+            return {i: times_by_candidate[i] for i in thunks}
+
+        def fake_samples(thunks, iters, rounds, target_window_s=None):
+            # the confirmation pass maps {0: challenger, 1: baseline};
+            # this test's sweep has baseline=candidate 0, challenger=
+            # candidate 1 — synthesize consistent per-round samples
+            src = conf_times or times_by_candidate
+            seq = {0: [src[1] / 1e3] * rounds, 1: [src[0] / 1e3] * rounds}
+            return {i: seq[i] for i in thunks}
 
         monkeypatch.setattr(tuner, "_measure_interleaved", fake_measure)
+        monkeypatch.setattr(at, "interleaved_slope_samples", fake_samples)
         res = tuner.tune(
             "toy", ("k",), [0, 1],
             lambda c: (lambda: jnp.zeros(())),

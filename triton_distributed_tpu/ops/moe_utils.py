@@ -20,6 +20,13 @@ import jax
 import jax.numpy as jnp
 
 
+# e4m3 quantization recipe, shared by this XLA path and the fused
+# Pallas pack kernel (layers/moe.py) — the two wire producers must stay
+# provably identical, so the constants live in exactly one place
+E4M3_MAX = 448.0     # largest finite float8_e4m3fn value
+SCALE_EPS = 1e-12    # keeps all-zero rows at a finite scale (0/0 -> 0)
+
+
 def quantize_e4m3(x: jax.Array, *, axis: int = -1):
     """Per-row fp8 quantization for the low-latency A2A payload
     (reference: the fp8 + scale-sidecar configuration of
@@ -33,7 +40,7 @@ def quantize_e4m3(x: jax.Array, *, axis: int = -1):
     """
     absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
                      keepdims=True)
-    scale = absmax / 448.0 + 1e-12
+    scale = absmax / E4M3_MAX + SCALE_EPS
     return (x.astype(jnp.float32) / scale).astype(jnp.float8_e4m3fn), scale
 
 

@@ -41,12 +41,12 @@ _CHIPS = [
 _FALLBACK = ChipSpec("unknown", 180.0, 800.0, 180.0)
 
 # Per-chip DCN (cross-slice) bandwidth, GB/s.  Deliberately a single
-# conservative constant, not a per-chip field: DCN is a property of the
-# pod's NIC provisioning, not the chip (typical public multislice
-# configurations land at ~12-25 GB/s per host / ~3-6 GB/s per chip; we
-# price the optimistic end of per-chip share so DCN-relative wins are
-# UNDERstated, never flattered).
-DCN_GBPS_PER_CHIP = 12.5
+# constant, not a per-chip field: DCN is a property of the pod's NIC
+# provisioning, not the chip.  Typical public multislice configurations
+# land at ~12-25 GB/s per HOST = ~3-6.25 GB/s per chip (4 chips/host);
+# we price the optimistic (fast) end of the per-chip share so
+# DCN-relative wire wins are UNDERstated, never flattered.
+DCN_GBPS_PER_CHIP = 6.25
 
 
 def chip_spec(device_kind: str | None = None) -> ChipSpec:

@@ -279,3 +279,37 @@ def test_expert_block_permutation():
     # block rows: r0 = [e0,e0,e1], r1 = [e0,e2,e2]; global expert order is
     # [r0e0, r0e0, r1e0, r0e1, r1e2, r1e2] -> indices [0,1,3,2,4,5]
     assert list(np.asarray(perm)) == [0, 1, 3, 2, 4, 5]
+
+
+def test_grouped_matmul_fuzz_splits_and_tiles():
+    """Randomized splits (zeros, unaligned boundaries, empty batches,
+    partially-occupied rows) x tile shapes against ``lax.ragged_dot`` —
+    the pad-elision schedule (frozen pad fetches, covers fast path,
+    no-write pads) must be invisible at every boundary geometry."""
+    from triton_distributed_tpu.ops.group_gemm import (
+        GroupGemmConfig, grouped_matmul,
+    )
+
+    rng = np.random.default_rng(42)
+    t, k, n_dim = 128, 64, 64
+    x = jnp.asarray(rng.standard_normal((t, k)), jnp.float32)
+    for trial in range(6):
+        e = int(rng.integers(1, 7))
+        w = jnp.asarray(rng.standard_normal((e, k, n_dim)), jnp.float32)
+        occupied = int(rng.integers(0, t + 1))
+        splits = rng.multinomial(occupied, np.ones(e) / e).astype(np.int32)
+        s = jnp.asarray(splits)
+        want = jax.lax.ragged_dot(x, w, s,
+                                  precision=jax.lax.Precision.HIGHEST)
+        bm = int(rng.choice([8, 16, 32, 64]))
+        bn = int(rng.choice([16, 32, 64]))
+        bk = int(rng.choice([16, 32, 64]))
+        got = grouped_matmul(x, w, s, config=GroupGemmConfig(bm, bn, bk))
+        occ = int(splits.sum())
+        np.testing.assert_allclose(
+            np.asarray(got[:occ]), np.asarray(want[:occ]),
+            atol=2e-4, rtol=2e-4,
+            err_msg=f"trial {trial}: e={e} splits={splits.tolist()} "
+                    f"tiles=({bm},{bn},{bk})",
+        )
+        assert not np.any(np.asarray(got[occ:])), "trailing rows must be 0"

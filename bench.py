@@ -27,17 +27,20 @@ def _bench_interleaved(engines: dict, iters: int = 64, rounds: int = 9,
                        window_s: float = 0.15) -> dict:
     """Per-engine per-round seconds/iter, measured in interleaved rounds.
 
-    Returns ``{name: [round0_sec, round1_sec, ...]}`` (NaN for rounds where
-    sync noise swamped the slope).  The tunneled chip's absolute throughput
-    drifts by up to 3x between process invocations (throttling/contention),
-    so engine-vs-engine ratios are only meaningful when the engines are
-    timed alternately within one process.  Within a round each engine is
-    timed as the slope between a 1-iter and a (1+iters)-iter run so the
-    fixed sync/tunnel round-trip cancels (see core.utils.perf_func).  The
-    first round lands on the post-compile thermal ramp and is discarded.
+    Returns ``{name: [(slope_sec, raw_sec), ...]}`` per post-ramp round
+    (NaN slope for rounds where sync noise swamped it).  The tunneled
+    chip's absolute throughput drifts by up to 3x between process
+    invocations (throttling/contention), so engine-vs-engine ratios are
+    only meaningful when the engines are timed alternately within one
+    process.  ABSOLUTE numbers use the slope estimator (unbiased;
+    cancels the fixed sync/tunnel cost); RATIOS use the raw long-window
+    estimator (the shared sync cost is common mode, so near-tie ratios
+    read 1.0 instead of the slope's +-3% self-noise — see
+    core.utils.interleaved_time_samples).  The first round lands on the
+    post-compile thermal ramp and is discarded.
     """
     from triton_distributed_tpu.core.utils import (
-        interleaved_slope_samples, sync, timed_run,
+        interleaved_time_samples, sync, timed_run,
     )
 
     for fn in engines.values():  # warmup/compile
@@ -45,39 +48,40 @@ def _bench_interleaved(engines: dict, iters: int = 64, rounds: int = 9,
     # auto-raise each engine's trip count to the target timing window: a
     # fixed iter count leaves fast kernels with jitter-sized windows when
     # the chip is in a slow state (measured: the attention kernel read 20
-    # TFLOP/s on a 50 ms window and 90+ on calibrated windows, same code).
-    # The close-ratio GEMM captures use 0.4 s windows — round-4
-    # identical-program A/B runs put the 0.15 s-window per-round ratio
-    # spread at up to +-20% in the chip's noisy states
-    raw = interleaved_slope_samples(engines, iters, rounds,
-                                    target_window_s=window_s)
-    # negative slope = sync noise swamped the round
+    # TFLOP/s on a 50 ms window and 90+ on calibrated windows, same code)
+    raw = interleaved_time_samples(engines, iters, rounds,
+                                   target_window_s=window_s)
     times = {
-        name: [dt if dt > 0 else float("nan") for dt in xs]
+        name: [(s if s > 0 else float("nan"), r) for s, r in xs]
         for name, xs in raw.items()
     }
     for name in engines:
         if len(times[name]) > 1:
             times[name] = times[name][1:]  # drop the ramp round
     for name, fn in engines.items():
-        if not any(t == t for t in times[name]):
+        if not any(s == s for s, _ in times[name]):
             # pathological noise: fall back to amortized timing, one big run
-            times[name] = [timed_run(fn, iters) / iters]
+            t = timed_run(fn, iters) / iters
+            times[name] = [(t, t)]
     return times
 
 
 def _median(xs) -> float:
-    xs = sorted(x for x in xs if x == x and x > 0)
+    """Median of the SLOPE samples (absolute per-iter seconds)."""
+    xs = sorted(s for s, _ in xs if s == s and s > 0)
     return xs[len(xs) // 2] if xs else float("nan")
 
 
 def _median_ratio(times: dict, num: str, den: str) -> float:
-    """Median of per-round num/den time ratios — round-adjacent measurements
-    share the chip's thermal state, so the ratio is far more stable than the
-    quotient of independently-picked best rounds."""
-    return _median(
-        a / b for a, b in zip(times[num], times[den]) if a > 0 and b > 0
-    )
+    """Median of per-round num/den RAW-window time ratios —
+    round-adjacent measurements share the chip's thermal state, and the
+    raw estimator's shared fixed cost cancels in the ratio (the slope
+    estimator's independent calibration noise gave identical engines a
+    +-3% captured spread)."""
+    pairs = [(a[1], b[1]) for a, b in zip(times[num], times[den])
+             if a[1] > 0 and b[1] > 0]
+    rs = sorted(a / b for a, b in pairs)
+    return rs[len(rs) // 2] if rs else float("nan")
 
 
 def bench_single_chip(m: int = 7168, n: int = 7168, k: int = 7168,

@@ -143,11 +143,13 @@ def test_transparent_matmul_uses_cached_winner(tmp_path, monkeypatch):
     jax.jit(lambda a, b: mm.matmul(a, b))(a, b)       # traced: same winner
     assert built[-1] == target
 
-    # plant an XLA flag-variant winner: eager dispatches (no Pallas build),
-    # traced inlines the plain dot — both numerically identical
+    # plant the XLA-dispatch winner: eager dispatches (no Pallas build),
+    # traced inlines the plain dot — both numerically identical.  (Flag
+    # variants are excluded from default sweeps — see
+    # XLA_VMEM_SWEEP_KIB — so the dispatch candidate is XlaBackend(0).)
     built.clear()
     at._GLOBAL._load_disk()[at._cache_key(key[0], key[1], cands)] = (
-        cands.index(at.XlaBackend(32768))
+        cands.index(at.XlaBackend(0))
     )
     at._GLOBAL._save_disk()
     monkeypatch.setattr(at, "_GLOBAL", at.Autotuner(path=str(tmp_path / "w.json")))
@@ -274,13 +276,14 @@ def test_fresh_fine_margin_crown_not_persisted(tmp_path, monkeypatch):
         def fake_samples(thunks, iters, rounds, target_window_s=None):
             # the confirmation pass maps {0: challenger, 1: baseline};
             # this test's sweep has baseline=candidate 0, challenger=
-            # candidate 1 — synthesize consistent per-round samples
+            # candidate 1 — synthesize consistent (slope, raw) samples
             src = conf_times or times_by_candidate
-            seq = {0: [src[1] / 1e3] * rounds, 1: [src[0] / 1e3] * rounds}
+            seq = {0: [(src[1] / 1e3, src[1] / 1e3)] * rounds,
+                   1: [(src[0] / 1e3, src[0] / 1e3)] * rounds}
             return {i: seq[i] for i in thunks}
 
         monkeypatch.setattr(tuner, "_measure_interleaved", fake_measure)
-        monkeypatch.setattr(at, "interleaved_slope_samples", fake_samples)
+        monkeypatch.setattr(at, "interleaved_time_samples", fake_samples)
         res = tuner.tune(
             "toy", ("k",), [0, 1],
             lambda c: (lambda: jnp.zeros(())),

@@ -121,25 +121,46 @@ def timed_run(func: Callable[[], object], k: int) -> float:
     return time.perf_counter() - t0
 
 
-def interleaved_slope_samples(
+def interleaved_time_samples(
     thunks: dict,
     iters: int,
     rounds: int,
     target_window_s: float | None = None,
+    abba: bool = True,
 ) -> dict:
-    """Per-thunk seconds/iter slope samples over INTERLEAVED rounds — the
-    shared measurement core of ``bench.py`` and ``tune.autotuner``.
+    """Per-thunk ``(slope_dt, raw_dt)`` second/iter samples over
+    INTERLEAVED rounds — the shared measurement core of ``bench.py`` and
+    ``tune.autotuner``.
 
     Thunks timed back to back within a round share the chip's thermal and
     clock state, so cross-thunk ranking survives the drift that makes
     sequential per-thunk timing unreliable; the order alternates between
-    rounds so a monotonic drift biases no thunk.  Each sample is the slope
-    between a 1-iter and a (1+k)-iter :func:`timed_run`, cancelling the
-    fixed sync/tunnel cost.  With ``target_window_s``, each thunk's trip
-    count is raised (after the first round's estimate) until its timed
-    window reaches that duration, so the slope signal dominates per-sync
-    RTT jitter.  Callers warm thunks up first and apply their own
-    non-positive-sample policy.
+    rounds so a monotonic drift biases no thunk.
+
+    Two estimators per sample, for two different consumers:
+
+    - ``slope_dt`` — the slope between a 1-iter and a (1+k)-iter
+      :func:`timed_run`, cancelling the fixed sync/tunnel cost:
+      UNBIASED per-iter time, the right basis for absolute TFLOP/s.
+      But the two extra 1-iter calibrations inject independent noise
+      into every sample: even a thunk timed against ITSELF shows +-3%
+      interleaved-median ratio spread (round-4 measurement).
+    - ``raw_dt`` — the (1+k)-iter window divided by 1+k, sync cost
+      included.  Biased HIGH as an absolute, but in a cross-thunk RATIO
+      the shared fixed cost is common mode: near-tie ratios read 1.0
+      almost exactly, and a true gap is understated by only
+      ~sync/window (~10% of the gap at 0.4 s windows) — the right
+      basis for ratios and for crowning decisions.
+
+    With ``target_window_s``, each thunk's trip count is raised (after
+    the first round's estimate) until its timed window reaches that
+    duration — EVERY thunk to the same duration, which is what makes
+    the raw estimator's sync share common mode (the trip cap is high
+    enough that sub-0.1 ms thunks still reach a 0.4 s window).  Callers
+    warm thunks up first, apply their own non-positive-sample policy,
+    and should DROP round 0 of the raw samples (taken before the
+    window calibration, so its sync share is not yet equalized).
+    ``abba=False`` skips the doubled windows for slope-only callers.
     """
     samples = {name: [] for name in thunks}
     trips = {name: iters for name in thunks}
@@ -147,14 +168,49 @@ def interleaved_slope_samples(
         order = list(thunks.items())
         if r % 2:
             order.reverse()
+        if abba and len(order) == 2 and r > 0:
+            # two-thunk rounds run the ABBA scheme: windows at times
+            # 0,t,2t,3t give each thunk the same MEAN timestamp
+            # (0+3t == t+2t), so a LINEAR thermal/clock drift across the
+            # round cancels exactly in the raw ratio — the chip
+            # oscillates on second timescales, and adjacent single
+            # windows were capturing the oscillation as a phantom 5%
+            # engine difference.  (Round 0 keeps the simple order while
+            # trip counts calibrate.)
+            (na, fa), (nb, fb) = order
+            ka, kb = trips[na], trips[nb]
+            a1 = timed_run(fa, 1 + ka)
+            b1 = timed_run(fb, 1 + kb)
+            b2 = timed_run(fb, 1 + kb)
+            a2 = timed_run(fa, 1 + ka)
+            slope_a = (a1 - timed_run(fa, 1)) / ka
+            slope_b = (b1 - timed_run(fb, 1)) / kb
+            samples[na].append((slope_a, (a1 + a2) / (2 * (1 + ka))))
+            samples[nb].append((slope_b, (b1 + b2) / (2 * (1 + kb))))
+            continue
         for name, thunk in order:
             k = trips[name]
-            dt = (timed_run(thunk, 1 + k) - timed_run(thunk, 1)) / k
-            samples[name].append(dt)
+            t_long = timed_run(thunk, 1 + k)
+            dt = (t_long - timed_run(thunk, 1)) / k
+            samples[name].append((dt, t_long / (1 + k)))
             if r == 0 and target_window_s and dt > 0:
                 trips[name] = max(iters,
-                                  min(int(target_window_s / dt), 512))
+                                  min(int(target_window_s / dt), 8192))
     return samples
+
+
+def interleaved_slope_samples(
+    thunks: dict,
+    iters: int,
+    rounds: int,
+    target_window_s: float | None = None,
+) -> dict:
+    """The slope halves of :func:`interleaved_time_samples` (the
+    original protocol; kept for callers that only need absolutes —
+    ``abba=False`` skips the ratio-oriented doubled windows)."""
+    both = interleaved_time_samples(thunks, iters, rounds, target_window_s,
+                                    abba=False)
+    return {name: [s for s, _ in xs] for name, xs in both.items()}
 
 
 def perf_func(

@@ -28,7 +28,7 @@ from typing import Any, Callable, Iterable, Sequence
 import jax
 
 from ..core import platform
-from ..core.utils import dist_print, interleaved_slope_samples
+from ..core.utils import dist_print, interleaved_time_samples
 
 _DEFAULT_CACHE = os.path.join(
     os.path.expanduser("~"), ".cache", "triton_distributed_tpu",
@@ -57,10 +57,15 @@ class XlaBackend:
     scoped_vmem_kib: int = 0
 
 
-# Scoped-VMEM sweep points for XlaBackend candidates: 32/64/112 MiB.  On
-# the v5e the 16 MiB default is the loser at most large-GEMM shapes (see
-# core.compilation.xla_gemm_options); which raised value wins is
-# shape-and-chip-state dependent, so all three are candidates.
+# Scoped-VMEM points for EXPLICIT XlaBackend configs: 32/64/112 MiB.
+# NOT in the default sweeps: interleaved A/B of mixed-flag executables
+# produces spectacular artifacts in BOTH directions (the same pair
+# measured 0.82x-1.6x across processes/chip states) while ABA PHASE
+# tests show no steady-state effect at the dense shapes — the
+# "wins" are properties of alternating the executables, not of serving
+# either one, so crowning them turns captures into a lottery.  The
+# constants remain for explicit configs on toolchains where a raised
+# budget has a real solo effect.
 XLA_VMEM_SWEEP_KIB = (32768, 65536, 114688)
 
 # A challenger only dethrones the default when it wins by this margin —
@@ -93,11 +98,11 @@ def margin_for(candidate) -> float:
 
 
 def xla_backend_candidates() -> list:
-    """The shared XLA-dispatch prefix of every backend sweep (default
-    flags first = the never-lose baseline, then the scoped-VMEM
-    variants) — single-sourced so a new flag sweep point reaches every
-    dispatching op at once."""
-    return [XlaBackend(0)] + [XlaBackend(kib) for kib in XLA_VMEM_SWEEP_KIB]
+    """The shared XLA-dispatch prefix of every backend sweep — the
+    default-flag never-lose baseline ONLY (see XLA_VMEM_SWEEP_KIB for
+    why the flag variants are excluded); single-sourced so a change
+    reaches every dispatching op at once."""
+    return [XlaBackend(0)]
 
 
 @dataclasses.dataclass
@@ -174,17 +179,26 @@ class Autotuner:
                              rounds: int = 5,
                              target_window_s: float = 0.15) -> dict:
         """Per-candidate median ms over interleaved rounds (the shared
-        ``core.utils.interleaved_slope_samples`` protocol, with adaptive
+        ``core.utils.interleaved_time_samples`` protocol, with adaptive
         ~150 ms timing windows: 8 iters of a 4 ms kernel is a 32 ms
         window — RTT-jitter-sized on the tunneled backend, and a
-        sequential sweep at that granularity crowned wrong winners)."""
-
-        raw = interleaved_slope_samples(thunks, iters, rounds,
-                                        target_window_s=target_window_s)
+        sequential sweep at that granularity crowned wrong winners).
+        RANKING uses the raw long-window estimator: candidates share its
+        fixed sync cost (common mode in comparisons), where the slope
+        estimator's independent calibrations give even identical
+        candidates a +-3% spread — at the price of slightly understating
+        true gaps (~sync/window share), i.e. effectively stiffer
+        margins."""
+        raw = interleaved_time_samples(thunks, iters, rounds,
+                                       target_window_s=target_window_s)
         out = {}
         for name, xs in raw.items():
-            xs = sorted(x for x in xs if x > 0)
-            out[name] = xs[len(xs) // 2] * 1e3 if xs else float("inf")
+            # drop round 0: its raw sample predates the window
+            # calibration, so its sync share is not yet equalized
+            # across candidates
+            tail = xs[1:] if len(xs) > 1 else xs
+            rs = sorted(r for _, r in tail if r > 0)
+            out[name] = rs[len(rs) // 2] * 1e3 if rs else float("inf")
         return out
 
     def _agree(self, times: list[float]) -> list[float]:
@@ -345,12 +359,15 @@ class Autotuner:
             # default.  A genuine few-percent edge in a calm state wins
             # essentially every round.
 
-            raw = interleaved_slope_samples(
+            both = interleaved_time_samples(
                 {0: live[best], 1: live[baseline_index]}, iters,
                 rounds=8, target_window_s=0.4,
             )
-            pairs = [(b, d) for b, d in zip(raw[0][1:], raw[1][1:])
-                     if b > 0 and d > 0]
+            # decisions ride the RAW estimator (shared sync cost cancels
+            # in the comparison); recorded times ride the slope
+            # estimator (unbiased absolutes)
+            pairs = [(b[1], d[1]) for b, d in zip(both[0][1:], both[1][1:])
+                     if b[1] > 0 and d[1] > 0]
             wins = sum(1 for b, d in pairs
                        if b < (1.0 - FRESH_CONFIRM_MARGIN) * d)
             med_b = sorted(b for b, _ in pairs)[len(pairs) // 2] \
@@ -360,15 +377,14 @@ class Autotuner:
             consistent = (len(pairs) >= 3
                           and wins >= max(3, (3 * len(pairs)) // 4)
                           and med_b < (1.0 - FRESH_CONFIRM_MARGIN) * med_d)
-            # record each side's own-sample median (finite whenever ANY
-            # of its rounds measured clean — the PAIRWISE filter above
-            # may drop every round on a jittery backend, and inf must
-            # not be cached as the winner's time when the sweep already
-            # measured a finite one)
-            for key, idx in ((0, best), (1, baseline_index)):
-                own = sorted(x for x in raw[key][1:] if x > 0)
-                if own:
-                    times[idx] = own[len(own) // 2] * 1e3
+            if pairs:
+                # refresh with the confirmation's RAW medians — same
+                # estimator the sweep recorded, so the process_local
+                # comparison below never mixes estimators.  When the
+                # pairwise filter dropped every round (jittery
+                # backend), the sweep's finite raw medians stand.
+                times[best] = med_b * 1e3
+                times[baseline_index] = med_d * 1e3
             if not consistent:
                 best = baseline_index
         # a fresh crown that cleared only the FINE margins is valid for

@@ -1,25 +1,35 @@
 #!/usr/bin/env python
 """Fail when documented perf claims drift from the newest driver record.
 
-The round-3 review found `docs/perf.md` and op docstrings quoting ratios
-(grouped matmul "1.05-1.09x", decode "1.27x") that the driver's
-`BENCH_r03.json` capture contradicted (0.84x / 0.97x).  This script
-closes that loop permanently: the headline claims live HERE as a
-machine-readable registry (docs/perf.md's table quotes the same ranges
-and points at this file), and every run checks the newest `BENCH_r*.json`
-at the repo root against them.
+Round-3 found docs quoting ratios the driver record contradicted; round-4
+closed that loop with a machine-readable registry of RATIO ranges — and
+its first driver capture promptly exposed the flaw in gating on ratios:
+the XLA baselines swing 2-3x with chip state (docs/perf.md), so a
+single capture's ratio is a draw from a wide spread, and widening the
+claimed ranges to cover the spread made them unfalsifiable (a lower
+bound below 1.0 "claims" we might lose).  Worse, mixing the slope
+absolute with the raw-window ratio implied a 1,062 GB/s decode baseline
+on an 819 GB/s HBM part and the gate accepted it.
 
-A claim is a range ``[lo, hi]`` of `vs_baseline` values the docs assert.
-The captured value must land inside ``[lo * (1 - BAND), hi * (1 + BAND)]``
-where BAND is the documented noise band of the interleaved-median
-protocol: identical-program A/A runs on the tunneled chip put the
-captured ratio spread at up to ~8% (bench.py's methodology note), so a
-capture within that band of the claimed range is consistent, and
-anything outside it means the docs or the code regressed — the run
-fails and says which.
+Round-5 restructure (VERDICT r4 next #1):
+
+- **PRIMARY claims are absolute throughput floors** on OUR kernel's
+  recorded ``value`` — the quantity that is stable across chip states.
+  A capture below the floor fails the gate: that is a regression (or a
+  measurement protocol break), never "XLA had a good day".
+- **Physical ceilings** reject impossible measurements: ``value`` and
+  ``baseline_value`` (both slope absolutes, same estimator) must sit
+  below the chip's peak for their bound resource.  A 1,062 GB/s decode
+  baseline now fails the capture instead of passing the gate.
+- **Ratio spreads are secondary and informational**: ``vs_baseline`` is
+  checked against the documented observed spread and drift prints a
+  WARNING (visible in CI logs) without failing the run — a ratio
+  against an unstable baseline is evidence, not a claim.  Deterministic
+  ratios (byte accounting) remain hard failures: they have no noise.
 
 Usage: python scripts/check_perf_claims.py [repo_root]
-Exit 0 = every recorded metric with a claim is consistent.
+Exit 0 = every recorded metric with a claim satisfies its primary
+claims.  Ratio-spread drift warns on stdout but does not fail.
 """
 
 from __future__ import annotations
@@ -30,40 +40,87 @@ import os
 import re
 import sys
 
-# Documented noise band of the capture protocol (A/A identical-program
-# interleaved medians spread up to ~8% between invocations).
-BAND = 0.08
+# v5e physical context for the ceilings: ~197 TFLOP/s bf16 MXU peak and
+# ~819 GB/s HBM.  Ceilings admit the slope estimator's documented noise
+# on a legitimate near-peak measurement (decode slope absolutes have
+# read up to ~890 GB/s on the 819 GB/s part — ~9% high) while still
+# rejecting the 1.3x-of-peak class of artifact.
+_MXU_CEIL_TFLOPS = 210.0
+_HBM_CEIL_GBPS = 925.0
 
-# metric-name prefix -> (claimed lo, claimed hi, since_round[, band]) of
-# vs_baseline.  These ARE the ranges docs/perf.md quotes; edit both
-# together.  ``since_round`` scopes a claim to records captured at or
-# after the round whose code makes it true (BENCH_r03 predates the
-# round-4 backend-dispatch + pad-elision work, so the round-4 claims
-# must not retroactively fail against it).  ``band`` overrides BAND for
-# deterministic claims (a byte ratio has no measurement noise — any
-# drift is a payload-format regression and must fail exactly).
-# The ranges are the FULL spread of repeated same-code captures across
-# the tunneled chip's clock states (docs/perf.md's chip-state note):
-# our Pallas kernels hold stable absolute throughput while XLA's
-# baselines swing 2-3x with chip state, so the RATIO of a single run is
-# a draw from these ranges — the wide 4096^3 upper bound is XLA's
-# documented 53-190 TF/s instability at that shape, and the sub-1.0
-# lower tails are states where XLA's paths run unusually fast.
+# metric-name prefix -> claim dict.  Keys:
+#   floor            PRIMARY: recorded ``value`` must be >= this (hard)
+#   value_ceiling    ``value`` above this is a suspect capture (hard)
+#   value_max        upper bound for lower-is-better values (hard)
+#   baseline_ceiling ``baseline_value`` above this is impossible (hard)
+#   ratio_spread     (lo, hi) documented observed vs_baseline spread
+#                    (SECONDARY: drift prints a warning, exit stays 0)
+#   exact_ratio      (lo, hi, band) deterministic vs_baseline (hard)
+#   since            first round the claim binds to
+#
+# Floors are set just BELOW the multi-round observed MINIMA of our
+# kernels' absolutes across chip states (the docs/perf.md observed
+# column; BENCH_r01-r04 + round-5 session sweeps): they assert "our
+# kernel never does worse than this on a healthy chip" — a lower bound
+# that can actually fail — while a capture in a throttled-but-normal
+# chip state documented before round 5 must not trip them.
 CLAIMS = {
-    "single_chip_gemm_7168_bf16": (0.95, 1.15, 4),
-    "single_chip_gemm_m4096_n4096_k4096_bf16": (0.95, 4.0, 4),
-    "single_chip_gemm_m8192_n2048_k7168_bf16": (0.90, 1.6, 4),
-    # ours and the unfused baseline degrade DIFFERENTLY with chip state
-    # (the S x S-materializing baseline is HBM-bound): measured spread
-    # across states this round was 5.5-12.3x
-    "flash_attn_b1_h32_s4096_d128": (5.0, 13.0, 3),
-    "decode_attn_b8_h32_hk8_s8192_d128": (0.70, 1.35, 3),
-    "group_gemm_t8192_k7168_n2048_e8": (0.90, 1.30, 4),
-    "tp_mlp_m4096_k7168_i7168_tp1": (0.95, 1.30, 3),
-    "qwen_decode_step_b128_tp1_psum_vs_ar": (0.95, 1.35, 3),
-    "moe_ep_a2a_fp8_wire_bytes_h7168": (1.96, 1.97, 3, 0.0),  # exact ratio
+    "single_chip_gemm_7168_bf16": {
+        "floor": 140.0, "value_ceiling": _MXU_CEIL_TFLOPS,
+        "baseline_ceiling": _MXU_CEIL_TFLOPS,
+        "ratio_spread": (0.95, 1.15), "since": 4,
+    },
+    "single_chip_gemm_m4096_n4096_k4096_bf16": {
+        "floor": 140.0, "value_ceiling": _MXU_CEIL_TFLOPS,
+        "baseline_ceiling": _MXU_CEIL_TFLOPS,
+        "ratio_spread": (0.95, 4.0), "since": 4,
+    },
+    "single_chip_gemm_m8192_n2048_k7168_bf16": {
+        "floor": 115.0, "value_ceiling": _MXU_CEIL_TFLOPS,
+        "baseline_ceiling": _MXU_CEIL_TFLOPS,
+        "ratio_spread": (0.90, 1.60), "since": 4,
+    },
+    # the prefill flash kernel is VPU(softmax)-bound at ~95 TF/s in fast
+    # states, ~65 in degraded ones (docs/perf.md roofline); the unfused
+    # baseline does 2x the counted useful flops, so its useful-work
+    # ceiling is ~half the MXU peak
+    "flash_attn_b1_h32_s4096_d128": {
+        "floor": 42.0, "value_ceiling": 115.0, "baseline_ceiling": 110.0,
+        "ratio_spread": (3.0, 13.0), "since": 4,
+    },
+    # both engines are KV-bandwidth bound: absolutes are GB/s of cache
+    # read and CANNOT exceed HBM.  Floor per VERDICT r4 #2: the fused
+    # kernel's steady-state band is 740-890 GB/s with the (1, 2048)
+    # streaming geometry (round-5 sweeps)
+    "decode_attn_b8_h32_hk8_s8192_d128": {
+        "floor": 700.0, "value_ceiling": _HBM_CEIL_GBPS,
+        "baseline_ceiling": _HBM_CEIL_GBPS,
+        "ratio_spread": (0.85, 1.40), "since": 5,
+    },
+    "group_gemm_t8192_k7168_n2048_e8": {
+        "floor": 135.0, "value_ceiling": _MXU_CEIL_TFLOPS,
+        "baseline_ceiling": _MXU_CEIL_TFLOPS,
+        "ratio_spread": (0.90, 1.30), "since": 4,
+    },
+    "tp_mlp_m4096_k7168_i7168_tp1": {
+        "floor": 145.0, "value_ceiling": _MXU_CEIL_TFLOPS,
+        "baseline_ceiling": _MXU_CEIL_TFLOPS,
+        "ratio_spread": (0.95, 1.30), "since": 4,
+    },
+    # tp=1 record: ms/step is chip-state dependent (lower is better) —
+    # value_max is a gross-regression tripwire, the ratio is
+    # definitional parity (accounting-only metric, VERDICT r4 weak #5;
+    # the distributed property in this line is the wire-bytes fields)
+    "qwen_decode_step_b128_tp1_psum_vs_ar": {
+        "value_max": 20.0, "ratio_spread": (0.90, 1.35), "since": 4,
+    },
+    # byte accounting is deterministic: any drift is a payload-format
+    # regression and must fail exactly
+    "moe_ep_a2a_fp8_wire_bytes_h7168": {
+        "floor": 7296, "value_max": 7296,
+        "exact_ratio": (1.96, 1.97, 0.0), "since": 3,
+    },
 }
-
 
 def parse_record(path: str) -> list[dict]:
     """Metric lines from a BENCH_r*.json: either the driver envelope
@@ -100,6 +157,59 @@ def newest_record(root: str) -> str | None:
     return max(paths, key=round_no) if paths else None
 
 
+def _check_metric(rec: dict, claim: dict) -> tuple[list[str], list[str]]:
+    """(hard failures, warnings) for one recorded metric line."""
+    fails, warns = [], []
+    name = rec["metric"]
+    value = rec.get("value")
+    vb = rec.get("vs_baseline")
+    bv = rec.get("baseline_value")
+    unit = rec.get("unit", "")
+
+    floor = claim.get("floor")
+    if floor is not None and value is not None and value < floor:
+        fails.append(
+            f"{name}: value={value} {unit} below the claimed floor "
+            f"{floor} — kernel or measurement-protocol regression"
+        )
+    ceil = claim.get("value_ceiling")
+    if ceil is not None and value is not None and value > ceil:
+        fails.append(
+            f"{name}: value={value} {unit} exceeds the physical ceiling "
+            f"{ceil} — suspect capture (estimator or accounting bug)"
+        )
+    vmax = claim.get("value_max")
+    if vmax is not None and value is not None and value > vmax:
+        fails.append(
+            f"{name}: value={value} {unit} above the allowed maximum {vmax}"
+        )
+    bceil = claim.get("baseline_ceiling")
+    if bceil is not None and bv is not None and bv > bceil:
+        fails.append(
+            f"{name}: baseline_value={bv} {unit} exceeds the physical "
+            f"ceiling {bceil} — the baseline measurement is impossible; "
+            f"the capture (not the claim) is wrong"
+        )
+    exact = claim.get("exact_ratio")
+    if exact is not None and vb is not None:
+        lo, hi, band = exact
+        if not (lo * (1 - band) <= vb <= hi * (1 + band)):
+            fails.append(
+                f"{name}: deterministic vs_baseline={vb} outside "
+                f"[{lo}, {hi}] — payload/accounting regression"
+            )
+    spread = claim.get("ratio_spread")
+    if spread is not None and vb is not None:
+        lo, hi = spread
+        if not (lo <= vb <= hi):
+            warns.append(
+                f"{name}: vs_baseline={vb} outside the documented observed "
+                f"spread [{lo}, {hi}] (informational — the baseline swings "
+                f"with chip state; the binding claim is the absolute floor)"
+            )
+    return fails, warns
+
+
 def check(root: str) -> int:
     path = newest_record(root)
     if path is None:
@@ -111,34 +221,30 @@ def check(root: str) -> int:
     if not metrics:
         print(f"{path}: no metric lines parsed — record format drifted?")
         return 1
-    failures = []
+    failures, warnings = [], []
     checked = 0
     for rec in metrics:
-        name, vb = rec["metric"], rec.get("vs_baseline")
         claim = next(
-            (c for prefix, c in CLAIMS.items() if name.startswith(prefix)),
+            (c for prefix, c in CLAIMS.items()
+             if rec["metric"].startswith(prefix)),
             None,
         )
-        if claim is None or vb is None:
-            continue
-        lo, hi, since, *rest = claim
-        band = rest[0] if rest else BAND
-        if record_round < since:
+        if claim is None or record_round < claim.get("since", 0):
             continue
         checked += 1
-        if not (lo * (1 - band) <= vb <= hi * (1 + band)):
-            failures.append(
-                f"  {name}: captured vs_baseline={vb} outside claimed "
-                f"[{lo}, {hi}] (±{band:.0%} noise band) — update "
-                f"docs/perf.md + scripts/check_perf_claims.py or fix the "
-                f"regression"
-            )
+        f, w = _check_metric(rec, claim)
+        failures.extend(f)
+        warnings.extend(w)
     tag = os.path.basename(path)
+    for w in warnings:
+        print(f"{tag}: WARNING {w}")
     if failures:
-        print(f"{tag}: {len(failures)} claim(s) drifted from the record:")
-        print("\n".join(failures))
+        print(f"{tag}: {len(failures)} primary claim(s) violated:")
+        for f in failures:
+            print(f"  {f}")
         return 1
-    print(f"{tag}: {checked} claimed metrics consistent with the record")
+    print(f"{tag}: {checked} claimed metrics satisfy their primary claims"
+          f" ({len(warnings)} spread warnings)")
     return 0
 
 

@@ -179,12 +179,21 @@ def interleaved_time_samples(
             # trip counts calibrate.)
             (na, fa), (nb, fb) = order
             ka, kb = trips[na], trips[nb]
+            # the 1-iter slope calibrations sit ADJACENT to the long
+            # window they are differenced against (a1/cal_a, b2/cal_b) so
+            # slope absolutes see ~one window of thermal drift, not the
+            # whole round; placed symmetrically (after a1 and after b2)
+            # the two equal-length calibrations shift both engines' mean
+            # window timestamps by the same amount, preserving the
+            # linear-drift cancellation of the raw ABBA ratio
             a1 = timed_run(fa, 1 + ka)
+            cal_a = timed_run(fa, 1)
             b1 = timed_run(fb, 1 + kb)
             b2 = timed_run(fb, 1 + kb)
+            cal_b = timed_run(fb, 1)
             a2 = timed_run(fa, 1 + ka)
-            slope_a = (a1 - timed_run(fa, 1)) / ka
-            slope_b = (b1 - timed_run(fb, 1)) / kb
+            slope_a = (a1 - cal_a) / ka
+            slope_b = (b2 - cal_b) / kb
             samples[na].append((slope_a, (a1 + a2) / (2 * (1 + ka))))
             samples[nb].append((slope_b, (b1 + b2) / (2 * (1 + kb))))
             continue

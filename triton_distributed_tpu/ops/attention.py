@@ -635,26 +635,40 @@ def _build_decode(b, h, hk, seq_kv, d, n_split, bk, sm_scale, soft_cap, dtype):
 
 
 def auto_n_split(seq_kv: int) -> int:
-    """Default split count for the split-KV decode: 4 measured fastest at
-    both 2k and 8k caches on v5-class chips (1.3x XLA's unfused decode;
-    n_split=1 serializes the KV DMA behind the whole-slice block load, 16
-    fragments it), halved until it divides the cache length."""
+    """Default split count for the STATE-returning split-KV decode path
+    (``decode_attention_state``): 4 balances split parallelism against the
+    f32 (num, m, l) state round-trips that path pays per split, halved
+    until it divides the cache length."""
     n = 4
     while n > 1 and seq_kv % n:
         n //= 2
     return n
 
 
+def default_decode_geometry(seq_kv: int) -> tuple[int, int]:
+    """Default (n_split, block_k) of the FUSED local decode kernel:
+    single-split streaming with a 2048-row kv tile.  The round-5 on-chip
+    steady-state sweeps (8k cache, B=8, GQA 32/8) put (1, 2048) and
+    (1, seq_kv) at 800-890 GB/s — essentially HBM speed — while the old
+    (4, 512) default sat at 540-600 GB/s: with one grid step per (b, hk)
+    cell the per-step pipeline overhead amortizes over a 512 KiB DMA
+    instead of 128 KiB.  (The state path keeps :func:`auto_n_split`: its
+    cost model differs — splits multiply ITS f32 state traffic.)"""
+    return (1, min(2048, seq_kv))
+
+
 def decode_split_candidates(seq_kv: int) -> list:
     """(n_split, block_k) sweep for the decode kernel's ``config=None``
-    path.  The round-4 on-chip sweeps found no static winner: which split
-    geometry beats XLA's unfused decode tracks the chip's clock state
-    (0.41-1.09x swings for the same config between processes), so the
-    choice is contextual — resolved per shape from the winner cache or a
-    first-eager-call measurement, like the GEMM backends."""
+    path, best-first from the round-5 steady-state sweeps.  Which
+    geometry wins tracks the chip's clock state, so the choice is
+    contextual — resolved per shape from the winner cache or a
+    first-eager-call measurement, like the GEMM backends.  The sweep also
+    carries the XLA-dispatch candidate (``tune.XlaBackend``): the unfused
+    einsum decode is the reference baseline, and crowning it when it
+    genuinely wins a chip state makes the resolved op never-lose."""
     cands = [
-        (auto_n_split(seq_kv), 512), (2, 512), (8, 512), (4, 2048),
-        (2, 4096), (8, 1024), (1, 2048), (1, seq_kv),
+        default_decode_geometry(seq_kv), (1, seq_kv), (4, 2048),
+        (2, 512), (auto_n_split(seq_kv), 512), (8, 1024),
     ]
     out = []
     for ns, bk in cands:
@@ -665,7 +679,35 @@ def decode_split_candidates(seq_kv: int) -> list:
             continue
         if (ns, bk) not in out:
             out.append((ns, bk))
-    return out
+    from ..tune.autotuner import xla_backend_candidates
+
+    return out + xla_backend_candidates()
+
+
+@functools.lru_cache(maxsize=None)
+def _xla_decode_fn(b: int, h: int, hk: int, seq_kv: int, d: int,
+                   sm_scale: float, soft_cap: float, dtype):
+    """Unfused GQA decode as one jitted XLA computation (the never-lose
+    dispatch target when ``XlaBackend`` is crowned) — materializes the
+    (B, Hkv, G, S) score matrix, with ragged ``kv_len`` masking."""
+    group = h // hk
+
+    def fn(q, k, v, kv_len):
+        kv_len = jnp.broadcast_to(jnp.asarray(kv_len, jnp.int32), (b,))
+        qh = q.reshape(b, hk, group, d).astype(jnp.float32)
+        sc = jnp.einsum("bkgd,bksd->bkgs", qh, k.astype(jnp.float32))
+        sc = sc * sm_scale
+        if soft_cap:
+            sc = jnp.tanh(sc / soft_cap) * soft_cap
+        pos = jnp.arange(seq_kv, dtype=jnp.int32)
+        valid = pos[None, :] < kv_len[:, None]               # (B, S)
+        sc = jnp.where(valid[:, None, None, :], sc, -jnp.inf)
+        p = jax.nn.softmax(sc, axis=-1)
+        p = jnp.where(valid[:, None, None, :], p, 0.0)       # all-masked rows
+        out = jnp.einsum("bkgs,bksd->bkgd", p, v.astype(jnp.float32))
+        return out.reshape(b, h, d).astype(dtype)
+
+    return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=None)
@@ -683,13 +725,21 @@ def _decode_resolve(q, k, v, kv_len, sm_scale, soft_cap, *,
 
     b, h, d = q.shape
     _, hk, seq_kv, _ = k.shape
+
+    def thunk(c):
+        if isinstance(c, _tune.XlaBackend):
+            fn = _xla_decode_fn(b, h, hk, seq_kv, d, sm_scale, soft_cap,
+                                jnp.dtype(q.dtype))
+            return lambda: fn(q, k, v, kv_len)
+        return lambda: _jitted_decode(
+            c[0], c[1], sm_scale, soft_cap)(q, k, v, kv_len)
+
     return _tune.resolve_config(
         "decode_attention",
         (b, h, hk, seq_kv, d, str(q.dtype), platform.device_kind()),
         decode_split_candidates(seq_kv),
-        (auto_n_split(seq_kv), 512),
-        lambda c: (lambda: _jitted_decode(
-            c[0], c[1], sm_scale, soft_cap)(q, k, v, kv_len)),
+        default_decode_geometry(seq_kv),
+        thunk,
         tracing=any(map(_tune.is_tracer, (q, k, v, kv_len))),
         force_measure=fresh,
         fresh=fresh,
@@ -932,13 +982,20 @@ def decode_attention_fused(
         raise ValueError(f"GQA requires H % Hkv == 0, got {h} % {hk}")
     sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
     if n_split is None and block_k is None:
-        n_split, block_k = _decode_resolve(
-            q, k, v, kv_len, sm_scale, float(soft_cap)
-        )
+        cfg = _decode_resolve(q, k, v, kv_len, sm_scale, float(soft_cap))
+        from ..tune.autotuner import XlaBackend
+
+        if isinstance(cfg, XlaBackend):
+            # crowned never-lose dispatch: the unfused einsum decode won
+            # this chip state outright (see decode_split_candidates)
+            fn = _xla_decode_fn(b, h, hk, seq_kv, d, sm_scale,
+                                float(soft_cap), jnp.dtype(q.dtype))
+            return fn(q, k, v, kv_len)
+        n_split, block_k = cfg
     elif n_split is None:
-        n_split = auto_n_split(seq_kv)
+        n_split = 1
     elif block_k is None:
-        block_k = 512
+        block_k = default_decode_geometry(seq_kv)[1] if n_split == 1 else 512
     if seq_kv % n_split:
         raise ValueError(f"Skv={seq_kv} not divisible by n_split={n_split}")
     group = h // hk

@@ -242,12 +242,17 @@ class Autotuner:
         ``baseline_index`` marks a known-good default candidate that a
         challenger must beat by ``margin`` to be crowned (a float, or a
         per-candidate callable — see :func:`margin_for`).  ``fresh``
-        ignores any cached winner and re-measures NOW, overwriting the
-        caches: winners are partly chip-state properties on
-        throttling-prone parts, so benchmark/serving warmup re-tunes in
-        the process that will run the traffic (the reference autotuner
-        has no cross-process cache at all — every process re-measures;
-        ``fresh`` recovers exactly those semantics on demand).
+        ignores any cached winner and re-measures NOW: winners are partly
+        chip-state properties on throttling-prone parts, so benchmark/
+        serving warmup re-tunes in the process that will run the traffic
+        (the reference autotuner has no cross-process cache at all —
+        every process re-measures; ``fresh`` recovers those semantics on
+        demand).  A fresh crown always lands in process memory; it is
+        written to the DISK cache only when it clears the conservative
+        margins (near-tie fine-margin crowns stay process-local — see
+        ``process_local`` below), and a fresh tune that demotes a
+        previously persisted winner removes the stale disk entry either
+        way.
         """
         ck = _cache_key(name, key, candidates)
         multi = jax.process_count() > 1
@@ -304,6 +309,21 @@ class Autotuner:
         # sweep itself can resolve few-percent differences, which the
         # default quick protocol (5 rounds, ~150 ms windows) cannot on
         # the tunneled chip (identical-program medians swing +-5%).
+        if fresh and not multi and live:
+            # ramp the chip to steady state before any timed window: the
+            # tunneled chip clocks up over the first seconds of sustained
+            # work (round-5 measurement: the same XLA decode read 327
+            # GB/s at process start and 717 GB/s a minute later), and a
+            # sweep whose early rounds straddle the ramp crowns whichever
+            # candidate the calibration happened to favor
+            import time as _time
+
+            spin = live.get(baseline_index, next(iter(live.values())))
+            t0 = _time.perf_counter()
+            while _time.perf_counter() - t0 < 1.5:
+                from ..core.utils import sync
+
+                sync(spin())
         if fresh and not multi:
             measured = self._measure_interleaved(
                 {i: t for i, t in live.items()}, iters,
@@ -363,9 +383,10 @@ class Autotuner:
                 {0: live[best], 1: live[baseline_index]}, iters,
                 rounds=8, target_window_s=0.4,
             )
-            # decisions ride the RAW estimator (shared sync cost cancels
-            # in the comparison); recorded times ride the slope
-            # estimator (unbiased absolutes)
+            # decisions AND the recorded times both ride the RAW
+            # estimator (shared sync cost cancels in the comparison, and
+            # the process_local gate below compares these times against
+            # the sweep's raw medians — one estimator throughout)
             pairs = [(b[1], d[1]) for b, d in zip(both[0][1:], both[1][1:])
                      if b[1] > 0 and d[1] > 0]
             wins = sum(1 for b, d in pairs
@@ -404,6 +425,14 @@ class Autotuner:
             self._times[ck] = times[best]
             if not process_local:
                 self._load_disk()[ck] = best
+                self._save_disk()
+            elif self._load_disk().get(ck, best) != best:
+                # a fine-margin fresh crown demoted a previously
+                # persisted winner: the measurement that crowned the disk
+                # entry is now contradicted, so later processes must not
+                # inherit it — drop it and let them fall back to the
+                # default (or re-measure)
+                del self._load_disk()[ck]
                 self._save_disk()
             # any memoized resolution may now be stale (fresh re-tunes
             # overwrite winners); the dict is tiny — drop it wholesale

@@ -89,11 +89,12 @@ CLAIMS = {
         "ratio_spread": (3.0, 13.0), "since": 4,
     },
     # both engines are KV-bandwidth bound: absolutes are GB/s of cache
-    # read and CANNOT exceed HBM.  Floor per VERDICT r4 #2: the fused
-    # kernel's steady-state band is 740-890 GB/s with the (1, 2048)
-    # streaming geometry (round-5 sweeps)
+    # read and CANNOT exceed HBM.  Floor per VERDICT r4 #2: with the
+    # (1, 2048) streaming geometry the full-protocol captures read
+    # 708-890 GB/s across the day's chip states (round-5); 680 leaves
+    # the same just-below-observed-minimum margin the other floors carry
     "decode_attn_b8_h32_hk8_s8192_d128": {
-        "floor": 700.0, "value_ceiling": _HBM_CEIL_GBPS,
+        "floor": 680.0, "value_ceiling": _HBM_CEIL_GBPS,
         "baseline_ceiling": _HBM_CEIL_GBPS,
         "ratio_spread": (0.85, 1.40), "since": 5,
     },

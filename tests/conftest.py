@@ -18,6 +18,63 @@ force_cpu(8 + SPARE_VIRTUAL_DEVICES)
 
 import pytest  # noqa: E402
 
+# The `-m fast` smoke tier (VERDICT r4 next #9): ONE cheap test per op
+# family, kept under ~3 minutes total on the 1-CPU container so a
+# wall-clock-limited runner still produces a real signal instead of a
+# timeout masking failures.  Curated by nodeid (not per-file markers) so
+# the whole tier is auditable in one place; pytest_collection_modifyitems
+# below raises UsageError on full-suite runs if a listed id stops
+# collecting (rename/delete rot cannot silently shrink the tier).
+FAST_NODES = frozenset((
+    "tests/test_matmul.py::test_matmul_golden[float32-256-256-256]",
+    "tests/test_attention.py::test_flash_attention_golden[4-4-True]",
+    "tests/test_attention.py::test_decode_attention_golden[4-4-4]",
+    "tests/test_lang_primitives.py::test_ring_push",
+    "tests/test_lang_primitives.py::test_notify_wait_producer_consumer",
+    "tests/test_allgather.py::test_all_gather_matches_golden"
+    "[shape0-float32-AllGatherMethod.RING_1D]",
+    "tests/test_reduce_scatter.py::test_reduce_scatter_matches_golden"
+    "[64-128-float32]",
+    "tests/test_allreduce.py::test_all_reduce_matches_golden"
+    "[64-128-float32-AllReduceMethod.ONE_SHOT]",
+    "tests/test_allreduce.py::test_gemm_ar_matches_golden[64-128-128]",
+    "tests/test_ag_gemm.py::test_ag_gemm_matches_golden[64-128-256-float32]",
+    "tests/test_gemm_rs.py::test_gemm_rs_matches_golden[64-256-128-float32]",
+    "tests/test_all_to_all.py::test_dispatch_combine_round_trip[2]",
+    "tests/test_group_gemm.py::test_grouped_matmul_golden[splits0]",
+    "tests/test_flash_decode.py::test_sp_flash_decode_matches_full[4-4-2]",
+    "tests/test_sp_attention.py::test_sp_attention_matches_flash[True-2]",
+    "tests/test_tp_layers.py::test_tp_mlp_forward[2]",
+    "tests/test_moe_layer.py::test_moe_ep_forward[2]",
+    "tests/test_pipeline.py::test_pipeline_matches_sequential[2-2]",
+    "tests/test_paged_cache.py::test_paged_decode_matches_contiguous[False]",
+    "tests/test_qwen_engine.py::test_engine_generate_greedy_deterministic",
+    "tests/test_race_detection.py::test_all_gather_race_free",
+    "tests/test_overlap_structure.py::test_gemm_rs_compute_issued_before_wire_wait",
+    "tests/test_tools.py::test_aot_round_trip",
+    "tests/test_loader_checkpoint.py::test_safetensors_round_trip[True]",
+    "tests/test_perf_claims.py::test_repo_records_consistent",
+    "tests/test_autotuner.py::test_picks_fastest_candidate",
+))
+
+
+def pytest_collection_modifyitems(config, items):
+    collected = set()
+    for item in items:
+        collected.add(item.nodeid)
+        if item.nodeid in FAST_NODES:
+            item.add_marker(pytest.mark.fast)
+    # full-suite collections must resolve every fast node: a renamed or
+    # deleted test silently shrinking the smoke tier is exactly the class
+    # of rot a curated list risks (partial runs skip the check)
+    if len({i.fspath for i in items}) >= 20:
+        missing = FAST_NODES - collected
+        if missing:
+            raise pytest.UsageError(
+                f"tests/conftest.py FAST_NODES lists tests that no longer "
+                f"collect: {sorted(missing)}"
+            )
+
 
 @pytest.fixture(scope="session")
 def mesh8():

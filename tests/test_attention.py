@@ -110,6 +110,44 @@ def test_decode_attention_golden(n_split, h, hk):
     )
 
 
+def test_default_decode_geometry_caps_vmem():
+    """The jit-tracing resolve path returns the DEFAULT geometry
+    unvalidated, so the default must always produce a compilable block:
+    one split's KV slice is capped at _DECODE_SP_CAP rows (2 MiB at
+    d=128 bf16 — K + V double-buffered fit Mosaic's 16 MiB scoped
+    default), and splits divide the cache length exactly."""
+    from triton_distributed_tpu.ops.attention import (
+        _DECODE_SP_CAP, default_decode_geometry,
+    )
+
+    for s in (256, 1024, 2048, 8192, 12288, 16384, 131072, 6000):
+        ns, bk = default_decode_geometry(s)
+        assert s % ns == 0, (s, ns)
+        assert s // ns <= _DECODE_SP_CAP, (s, ns)
+        assert 1 <= bk <= s // ns, (s, ns, bk)
+    assert default_decode_geometry(8192) == (1, 2048)
+    assert default_decode_geometry(131072) == (16, 2048)
+
+
+def test_decode_attention_long_cache_default():
+    """config=None decode over a cache longer than one VMEM block: the
+    default geometry splits instead of emitting an uncompilable
+    (1, seq_kv) block (round-5 review finding)."""
+    b, h, hk, skv, d = 1, 2, 1, 16384, 64
+    lens = jnp.asarray([9000], jnp.int32)
+    kq, kk, kv = jax.random.split(jax.random.key(21), 3)
+    q = jax.random.normal(kq, (b, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, hk, skv, d), jnp.float32) * 0.1
+    v = jax.random.normal(kv, (b, hk, skv, d), jnp.float32)
+    out = jax.jit(lambda q, k, v: decode_attention(q, k, v, lens))(q, k, v)
+    want = _naive_attention(
+        q[:, :, None], k, v, causal=False, kv_len=9000
+    )[:, :, 0]
+    assert jnp.allclose(out, want, atol=2e-4, rtol=2e-4), (
+        jnp.abs(out - want).max()
+    )
+
+
 def test_decode_attention_ragged_lengths():
     """(B,) per-sequence kv_len: each row masks at its OWN length — the
     contiguous cache's ragged-serving story (the paged kernel's lens

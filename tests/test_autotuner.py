@@ -304,3 +304,50 @@ def test_fresh_fine_margin_crown_not_persisted(tmp_path, monkeypatch):
     cfg, disk = run({0: 1.00, 1: 0.80, 2: 0.80})
     assert cfg == 1
     assert list(disk.values()) == [1]
+
+
+def test_fresh_fine_margin_crown_demotes_stale_disk_winner(tmp_path,
+                                                          monkeypatch):
+    """A fine-margin fresh crown that CONTRADICTS a previously persisted
+    winner must delete the stale disk entry (not merely skip writing its
+    own): the measurement that crowned the disk entry is now refuted, and
+    later processes must fall back to the default rather than inherit it
+    (ADVICE r4: a demoted winner lingering on disk)."""
+    from triton_distributed_tpu.tune import autotuner as at
+
+    path = tmp_path / "cache.json"
+    tuner = Autotuner(path=str(path))
+
+    # persist a full-margin winner (index 2) for the key first
+    def fake_measure_seed(thunks, iters, rounds=5, target_window_s=0.15):
+        return {i: {0: 1.00, 1: 1.00, 2: 0.70}[i] for i in thunks}
+
+    monkeypatch.setattr(tuner, "_measure_interleaved", fake_measure_seed)
+
+    conf = {"challenger": 0.70e-3}  # confirmation gap, mutated per phase
+
+    def fake_samples(thunks, iters, rounds, target_window_s=None):
+        # confirmation maps {0: challenger, 1: baseline}
+        return {0: [(conf["challenger"], conf["challenger"])] * rounds,
+                1: [(1.00e-3, 1.00e-3)] * rounds}
+
+    monkeypatch.setattr(at, "interleaved_time_samples", fake_samples)
+    res = tuner.tune("toy", ("k",), [0, 1, 2],
+                     lambda c: (lambda: jnp.zeros(())),
+                     baseline_index=0, margin=0.08, fresh=True)
+    assert res.config == 2
+    assert list(json.loads(path.read_text()).values()) == [2]
+
+    # a later fresh tune (fresh chip state) now crowns index 1, but only
+    # by the fine margin: process-local crown + stale entry dropped
+    conf["challenger"] = 0.97e-3
+    def fake_measure_demote(thunks, iters, rounds=5, target_window_s=0.15):
+        return {i: {0: 1.00, 1: 0.97, 2: 1.10}[i] for i in thunks}
+
+    tuner2 = Autotuner(path=str(path))
+    monkeypatch.setattr(tuner2, "_measure_interleaved", fake_measure_demote)
+    res2 = tuner2.tune("toy", ("k",), [0, 1, 2],
+                       lambda c: (lambda: jnp.zeros(())),
+                       baseline_index=0, margin=0.08, fresh=True)
+    assert res2.config == 1
+    assert json.loads(path.read_text()) == {}

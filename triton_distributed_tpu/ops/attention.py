@@ -645,16 +645,28 @@ def auto_n_split(seq_kv: int) -> int:
     return n
 
 
+_DECODE_SP_CAP = 8192  # rows per VMEM KV block: 2 MiB at d=128 bf16 —
+# K + V double-buffered = 8 MiB, inside Mosaic's 16 MiB scoped default
+# (the kernel passes no vmem_limit), so the DEFAULT geometry always
+# compiles — it is what the jit-tracing resolve path returns UNVALIDATED
+
+
 def default_decode_geometry(seq_kv: int) -> tuple[int, int]:
     """Default (n_split, block_k) of the FUSED local decode kernel:
-    single-split streaming with a 2048-row kv tile.  The round-5 on-chip
+    fewest-splits streaming with a 2048-row kv tile.  The round-5 on-chip
     steady-state sweeps (8k cache, B=8, GQA 32/8) put (1, 2048) and
     (1, seq_kv) at 800-890 GB/s — essentially HBM speed — while the old
     (4, 512) default sat at 540-600 GB/s: with one grid step per (b, hk)
     cell the per-step pipeline overhead amortizes over a 512 KiB DMA
-    instead of 128 KiB.  (The state path keeps :func:`auto_n_split`: its
-    cost model differs — splits multiply ITS f32 state traffic.)"""
-    return (1, min(2048, seq_kv))
+    instead of 128 KiB.  Splits only appear when one split's KV slice
+    would blow the VMEM budget (``_DECODE_SP_CAP`` rows), so a 128k
+    cache gets (16, 2048) instead of an uncompilable (1, 131072) block.
+    (The state path keeps :func:`auto_n_split`: its cost model differs —
+    splits multiply ITS f32 state traffic.)"""
+    ns = 1
+    while seq_kv % ns or seq_kv // ns > _DECODE_SP_CAP:
+        ns += 1  # terminates: ns == seq_kv divides with sp = 1
+    return (ns, min(2048, seq_kv // ns))
 
 
 def decode_split_candidates(seq_kv: int) -> list:
@@ -993,9 +1005,9 @@ def decode_attention_fused(
             return fn(q, k, v, kv_len)
         n_split, block_k = cfg
     elif n_split is None:
-        n_split = 1
+        n_split = default_decode_geometry(seq_kv)[0]
     elif block_k is None:
-        block_k = default_decode_geometry(seq_kv)[1] if n_split == 1 else 512
+        block_k = 2048 if n_split == 1 else 512
     if seq_kv % n_split:
         raise ValueError(f"Skv={seq_kv} not divisible by n_split={n_split}")
     group = h // hk

@@ -309,20 +309,24 @@ class Autotuner:
         # sweep itself can resolve few-percent differences, which the
         # default quick protocol (5 rounds, ~150 ms windows) cannot on
         # the tunneled chip (identical-program medians swing +-5%).
-        if fresh and not multi and live:
-            # ramp the chip to steady state before any timed window: the
-            # tunneled chip clocks up over the first seconds of sustained
-            # work (round-5 measurement: the same XLA decode read 327
-            # GB/s at process start and 717 GB/s a minute later), and a
-            # sweep whose early rounds straddle the ramp crowns whichever
-            # candidate the calibration happened to favor
+        from ..core import compilation
+
+        if fresh and not multi and live and not compilation.interpret_mode():
+            # ramp the REAL chip to steady state before any timed window:
+            # the tunneled chip clocks up over the first seconds of
+            # sustained work (round-5 measurement: the same XLA decode
+            # read 327 GB/s at process start and 717 GB/s a minute
+            # later), and a sweep whose early rounds straddle the ramp
+            # crowns whichever candidate the calibration happened to
+            # favor.  Interpret-mode (CPU test) builds have no clock to
+            # ramp and skip the spin.
             import time as _time
+
+            from ..core.utils import sync
 
             spin = live.get(baseline_index, next(iter(live.values())))
             t0 = _time.perf_counter()
             while _time.perf_counter() - t0 < 1.5:
-                from ..core.utils import sync
-
                 sync(spin())
         if fresh and not multi:
             measured = self._measure_interleaved(

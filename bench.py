@@ -541,6 +541,110 @@ def bench_moe_ep_wire(tokens: int = 4096):
     }
 
 
+def bench_latency():
+    """Latency-class collectives at 8-256 KiB payloads, in MICROSECONDS
+    (reference ``test_ag_small_msg.py`` / ``test_ring_put.py`` — the
+    regime the one-shot/push variants exist for).
+
+    With >1 device the AG (push vs ring) and AR (one-shot vs two-shot)
+    entries are measured for real.  On ONE chip the collectives early-out
+    (nothing to wire), so the honest measurable quantity is the LATENCY
+    FLOOR those paths pay before any wire byte moves: the wall cost of a
+    small Pallas kernel round-tripping the payload HBM->VMEM->HBM
+    (kernel launch + DMA issue + sync — the fixed term of the one-shot
+    path), against the same-payload XLA elementwise baseline.  A slice
+    run's small-message latency is this floor + hop latency + B/bw with
+    the ``tools.calibrate`` link numbers; the record labels which case it
+    measured via ``single_chip_floor``."""
+    import functools
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from triton_distributed_tpu.core import compilation
+    from triton_distributed_tpu.core import mesh as mesh_lib
+    from triton_distributed_tpu.core.utils import perf_func
+
+    payloads_kib = (8, 32, 128, 256)
+    multi = jax.device_count() > 1
+    # interpret-mode (CPU mesh) runs are functional smoke, not timing:
+    # the simulator pays ~100 ms per collective call, so trip counts drop
+    iters = 8 if compilation.interpret_mode() else 64
+    sizes = {}
+    if multi:
+        from jax.sharding import PartitionSpec as P
+
+        from triton_distributed_tpu.comm.allgather import (
+            AllGatherMethod, all_gather,
+        )
+        from triton_distributed_tpu.comm.allreduce import (
+            AllReduceMethod, all_reduce,
+        )
+        mesh = mesh_lib.tp_mesh()
+        n = mesh.shape["tp"]
+        for kib in payloads_kib:
+            rows = max(8, (kib * 1024) // (128 * 4) // 8 * 8)
+            x = mesh_lib.shard(
+                mesh, jnp.ones((n * rows, 128), jnp.float32), "tp", None
+            )
+            entry = {}
+            for name, fn in (
+                ("ag_push", functools.partial(
+                    all_gather, mesh=mesh, method=AllGatherMethod.PUSH_1SHOT)),
+                ("ag_ring", functools.partial(
+                    all_gather, mesh=mesh, method=AllGatherMethod.RING_BIDIR)),
+                ("ar_one_shot", functools.partial(
+                    all_reduce, mesh=mesh, method=AllReduceMethod.ONE_SHOT)),
+                ("ar_two_shot", functools.partial(
+                    all_reduce, mesh=mesh, method=AllReduceMethod.TWO_SHOT)),
+            ):
+                jit_fn = jax.jit(lambda x, fn=fn: fn(x))
+                _, ms = perf_func(lambda: jit_fn(x), iters=iters)
+                entry[name] = round(ms * 1e3, 2)
+            sizes[f"{kib}KiB"] = entry
+        headline = sizes["8KiB"]["ag_push"]
+    else:
+        def roundtrip_kernel(x_ref, o_ref, scratch, sem):
+            from triton_distributed_tpu import lang
+
+            lang.local_copy(x_ref, scratch, sem).wait()
+            lang.local_copy(scratch, o_ref, sem).wait()
+
+        for kib in payloads_kib:
+            rows = max(8, (kib * 1024) // (128 * 4) // 8 * 8)
+            x = jnp.ones((rows, 128), jnp.float32)
+            call = pl.pallas_call(
+                roundtrip_kernel,
+                out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pl.ANY),
+                scratch_shapes=[pltpu.VMEM((rows, 128), jnp.float32),
+                                pltpu.SemaphoreType.DMA],
+                interpret=compilation.interpret_mode(),
+            )
+            pallas_fn = jax.jit(call)
+            xla_fn = jax.jit(lambda x: x + 1.0)
+            # latency floors are microseconds against a chip that
+            # oscillates on second timescales: ride the interleaved
+            # median protocol, not a single slope shot
+            times = _bench_interleaved({
+                "pallas": lambda: pallas_fn(x),
+                "xla": lambda: xla_fn(x),
+            }, iters=256, rounds=7, window_s=0.1)
+            sizes[f"{kib}KiB"] = {
+                "pallas_roundtrip": round(_median(times["pallas"]) * 1e6, 2),
+                "xla_elementwise": round(_median(times["xla"]) * 1e6, 2),
+            }
+        headline = sizes["8KiB"]["pallas_roundtrip"]
+    return {
+        "metric": "latency_class_us",
+        "value": headline,
+        "unit": "us (8KiB)",
+        "single_chip_floor": not multi,
+        "sizes_us": sizes,
+    }
+
+
 def main():
     import os
     import sys
@@ -574,6 +678,8 @@ def main():
         print(json.dumps(bench_decode_modes()))
     elif mode == "moe_ep":
         print(json.dumps(bench_moe_ep_wire()))
+    elif mode == "latency":
+        print(json.dumps(bench_latency()))
     elif mode == "auto":
         # whole perf surface, one JSON line per mode; headline GEMM first
         _emit(bench_single_chip)
@@ -585,6 +691,7 @@ def main():
         _emit(bench_group_gemm)
         _emit(bench_decode_modes)
         _emit(bench_moe_ep_wire)
+        _emit(bench_latency)
         if jax.device_count() > 1:
             _emit(bench_multi_chip)
         if _EMIT_FAILED:
@@ -594,7 +701,7 @@ def main():
     else:
         raise SystemExit(
             f"unknown bench mode {mode!r} "
-            "(auto|gemm|attn|mlp|moe|decode|decode_modes)"
+            "(auto|gemm|attn|mlp|moe|decode|decode_modes|moe_ep|latency)"
         )
 
 

@@ -121,6 +121,10 @@ CLAIMS = {
         "floor": 7296, "value_max": 7296,
         "exact_ratio": (1.96, 1.97, 0.0), "since": 3,
     },
+    # single-chip latency floor (8 KiB Pallas round-trip, tunneled
+    # dispatch included): a gross-regression tripwire only — absolute
+    # latency on this dev box is dominated by the tunnel RTT
+    "latency_class_us": {"value_max": 2000.0, "since": 5},
 }
 
 def parse_record(path: str) -> list[dict]:

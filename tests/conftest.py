@@ -9,12 +9,25 @@ XLA client threads so interpret-mode collective kernels can't starve at full
 mesh occupancy (see ``core.platform.force_cpu``).
 """
 
+import os
+import tempfile
+
 from triton_distributed_tpu.core.platform import force_cpu, SPARE_VIRTUAL_DEVICES
 
 # Must run before any JAX backend is created (safe here: conftest is imported
 # before test modules). Overrides the container sitecustomize's force-selected
 # TPU platform as well.
 force_cpu(8 + SPARE_VIRTUAL_DEVICES)
+
+# Hermetic link calibration: choose_method reads the persisted
+# calibration (tools/calibrate.py), and a real slice's linkcal.json in
+# the developer's ~/.cache must not leak into threshold assertions.
+# Tests that WANT a calibration set TDT_LINKCAL_CACHE themselves.
+os.environ.setdefault(
+    "TDT_LINKCAL_CACHE",
+    os.path.join(tempfile.mkdtemp(prefix="tdt-test-linkcal-"),
+                 "linkcal.json"),
+)
 
 import pytest  # noqa: E402
 
@@ -65,15 +78,23 @@ def pytest_collection_modifyitems(config, items):
         if item.nodeid in FAST_NODES:
             item.add_marker(pytest.mark.fast)
     # full-suite collections must resolve every fast node: a renamed or
-    # deleted test silently shrinking the smoke tier is exactly the class
-    # of rot a curated list risks.  Partial runs skip the check, and a
-    # node whose whole FILE is absent (deliberately --ignore'd, e.g. the
-    # CI shards) is exempt — only a collected file missing a listed id
-    # (rename/param drift) is rot.
+    # DELETED test silently shrinking the smoke tier is exactly the class
+    # of rot a curated list risks.  Partial runs skip the check; only
+    # files the invocation EXPLICITLY --ignore'd (the CI shards) are
+    # exempt — a deleted file is not ignored, so its nodes still flag.
     if len({i.fspath for i in items}) >= 20:
-        collected_files = {n.split("::", 1)[0] for n in collected}
-        missing = {n for n in FAST_NODES - collected
-                   if n.split("::", 1)[0] in collected_files}
+        import os
+
+        ignored = {
+            os.path.abspath(str(p))
+            for p in (config.getoption("ignore", default=None) or [])
+        }
+        root = str(config.rootpath)
+        missing = {
+            n for n in FAST_NODES - collected
+            if os.path.abspath(os.path.join(root, n.split("::", 1)[0]))
+            not in ignored
+        }
         if missing:
             raise pytest.UsageError(
                 f"tests/conftest.py FAST_NODES lists tests that no longer "

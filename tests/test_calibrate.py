@@ -1,0 +1,108 @@
+"""Link calibration -> method choice (VERDICT r4 next #5: measured
+crossovers, with the pinned constants demoted to cold-start defaults)."""
+
+import json
+
+import pytest
+
+from triton_distributed_tpu.comm.allgather import AllGatherMethod
+from triton_distributed_tpu.comm.allgather import choose_method as ag_choose
+from triton_distributed_tpu.comm.allreduce import AllReduceMethod
+from triton_distributed_tpu.comm.allreduce import choose_method as ar_choose
+from triton_distributed_tpu.tools import calibrate as cal
+
+
+@pytest.fixture
+def cal_path(tmp_path, monkeypatch):
+    p = tmp_path / "linkcal.json"
+    monkeypatch.setenv("TDT_LINKCAL_CACHE", str(p))
+    cal.invalidate_cache()
+    yield p
+    cal.invalidate_cache()
+
+
+def _plant(path, **kw):
+    path.write_text(json.dumps(
+        cal.LinkCalibration(**kw).to_json()
+    ))
+    cal.invalidate_cache()
+
+
+def test_cold_start_uses_pinned_defaults(cal_path):
+    assert cal.load_calibration() is None
+    assert cal.push_bytes_threshold() == cal.DEFAULT_PUSH_BYTES
+    assert cal.one_shot_bytes_threshold() == cal.DEFAULT_ONE_SHOT_BYTES
+    assert ag_choose(cal.DEFAULT_PUSH_BYTES, 8) == AllGatherMethod.PUSH_1SHOT
+    assert ag_choose(cal.DEFAULT_PUSH_BYTES + 1, 8) == AllGatherMethod.RING_BIDIR
+    assert ar_choose(cal.DEFAULT_ONE_SHOT_BYTES, 8) == AllReduceMethod.ONE_SHOT
+    assert ar_choose(cal.DEFAULT_ONE_SHOT_BYTES + 1, 8) == AllReduceMethod.TWO_SHOT
+
+
+def test_crossover_moves_with_calibration(cal_path):
+    """The VERDICT done-criterion: the SAME (bytes, ranks) question gets a
+    different method when the measured link characteristics change."""
+    probe = 1 * 2**20  # 1 MiB shard: ring under the cold defaults
+    assert ag_choose(probe, 8) == AllGatherMethod.RING_BIDIR
+    assert ar_choose(probe, 8) == AllReduceMethod.TWO_SHOT
+
+    # a high-latency link (10 us hops at 186 GB/s -> ~1.86 MB BDP) makes
+    # latency dominance reach further: the 1 MiB shard flips to one-shot
+    _plant(cal_path, ici_gbps=186.0, ici_hop_us=10.0,
+           device_kind="TPU v5e", n_devices=8)
+    assert cal.push_bytes_threshold() == int(186e9 * 10e-6)
+    assert ag_choose(probe, 8) == AllGatherMethod.PUSH_1SHOT
+    assert ar_choose(probe, 8) == AllReduceMethod.ONE_SHOT
+
+    # an ultra-low-latency link shrinks the push window below 64 KiB
+    _plant(cal_path, ici_gbps=186.0, ici_hop_us=0.3,
+           device_kind="TPU v5e", n_devices=8)
+    assert ag_choose(64 * 1024, 8) == AllGatherMethod.RING_BIDIR
+    assert ar_choose(256 * 1024, 8) == AllReduceMethod.TWO_SHOT
+
+
+def test_save_load_round_trip(cal_path):
+    c = cal.LinkCalibration(ici_gbps=123.4, ici_hop_us=1.5,
+                            dcn_gbps=6.1, dcn_hop_us=12.0,
+                            device_kind="TPU v5e", n_devices=16)
+    cal.save_calibration(c)
+    assert cal_path.exists()
+    cal.invalidate_cache()
+    assert cal.load_calibration() == c
+
+
+def test_corrupt_calibration_falls_back(cal_path):
+    cal_path.write_text("{not json")
+    cal.invalidate_cache()
+    assert cal.load_calibration() is None
+    assert cal.push_bytes_threshold() == cal.DEFAULT_PUSH_BYTES
+
+
+def test_fit_latency_bandwidth_recovers_synthetic_link():
+    # t = 2 us + S / (100 GB/s)
+    sizes = [64e3, 512e3, 2e6, 8e6]
+    times = [2e-6 + s / 100e9 for s in sizes]
+    hop_us, gbps = cal.fit_latency_bandwidth(sizes, times)
+    assert abs(hop_us - 2.0) < 1e-6
+    assert abs(gbps - 100.0) < 1e-6
+    with pytest.raises(ValueError, match="non-physical"):
+        cal.fit_latency_bandwidth(sizes, list(reversed(times)))
+
+
+def test_measure_smoke_on_virtual_mesh(cal_path):
+    """End-to-end measure path on the CPU mesh (force=True: simulator
+    numbers, asserted only for shape/positivity, never persisted)."""
+    got = cal.calibrate(save=False, force=True,
+                        sizes_bytes=(64 * 1024, 256 * 1024, 1 * 2**20))
+    assert got.ici_gbps is not None and got.ici_gbps > 0
+    assert got.ici_hop_us is not None and got.ici_hop_us >= 0
+    assert got.n_devices >= 8
+    assert not cal_path.exists()
+
+
+def test_refuses_interpret_measure_without_force(cal_path):
+    from triton_distributed_tpu.core import compilation
+
+    if not compilation.interpret_mode():
+        pytest.skip("real hardware: measuring is legitimate")
+    with pytest.raises(RuntimeError, match="interpret"):
+        cal.calibrate(save=False)

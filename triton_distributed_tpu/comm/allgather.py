@@ -51,15 +51,20 @@ class AllGatherMethod(enum.Enum):
     RING_BIDIR = "ring_bidir"   # bidirectional ring: full bisection bandwidth
 
 
-# One-shot push beats the ring below roughly one MTU-ish chunk per hop; the
-# reference switches methods by size the same way (allgather.py:57-78).
-_PUSH_BYTES_THRESHOLD = 256 * 1024
+# One-shot push beats the ring below the link's bandwidth-delay product.
+# The crossover comes from ``tools.calibrate`` when a calibration run has
+# measured the live topology (reference probes NICs the same way,
+# ``comm_perf_model.py:92-129``); its cold-start default is the 256 KiB
+# "MTU-ish" constant rounds 1-4 pinned by reasoning (the reference
+# switches methods by size the same way, ``allgather.py:57-78``).
 
 
 def choose_method(nbytes_per_shard: int, num_ranks: int) -> AllGatherMethod:
+    from ..tools import calibrate
+
     if num_ranks <= 2:
         return AllGatherMethod.PUSH_1SHOT
-    if nbytes_per_shard <= _PUSH_BYTES_THRESHOLD:
+    if nbytes_per_shard <= calibrate.push_bytes_threshold():
         return AllGatherMethod.PUSH_1SHOT
     return AllGatherMethod.RING_BIDIR
 

@@ -62,12 +62,15 @@ class AllReduceMethod(enum.Enum):
 # One-shot moves (n-1)*bytes over each link but in a single hop; two-shot
 # moves ~2*bytes per link in 2(n-1) latency-chained steps.  Crossover sits
 # where wire time starts to dominate hop latency — same reasoning as the
-# reference's nbytes switch (``allreduce.py:1042-1078``).
-_ONE_SHOT_BYTES_THRESHOLD = 512 * 1024
+# reference's nbytes switch (``allreduce.py:1042-1078``).  The value comes
+# from ``tools.calibrate`` (~2x the measured bandwidth-delay product) when
+# the live topology has been calibrated; 512 KiB cold default otherwise.
 
 
 def choose_method(nbytes_per_rank: int, num_ranks: int) -> AllReduceMethod:
-    if num_ranks <= 2 or nbytes_per_rank <= _ONE_SHOT_BYTES_THRESHOLD:
+    from ..tools import calibrate
+
+    if num_ranks <= 2 or nbytes_per_rank <= calibrate.one_shot_bytes_threshold():
         return AllReduceMethod.ONE_SHOT
     return AllReduceMethod.TWO_SHOT
 

@@ -766,7 +766,10 @@ def _decode_resolve(q, k, v, kv_len, sm_scale, soft_cap, *,
 
     return _tune.resolve_config(
         "decode_attention",
-        (b, h, hk, seq_kv, d, str(q.dtype), platform.device_kind()),
+        # k dtype is in the key: the sweep geometry and default are
+        # itemsize-aware, so a bf16-cache crown must not serve f32
+        (b, h, hk, seq_kv, d, str(q.dtype), str(k.dtype),
+         platform.device_kind()),
         decode_split_candidates(seq_kv, d, jnp.dtype(k.dtype).itemsize),
         default_decode_geometry(seq_kv, d, jnp.dtype(k.dtype).itemsize),
         thunk,

@@ -538,6 +538,10 @@ def bench_moe_ep_wire(tokens: int = 4096):
         "codec_gbps": round(codec_gbps, 1),
         "net_us_per_token_hop_ici": round(net_ici, 4),
         "net_us_per_token_hop_dcn": round(net_dcn, 4),
+        # what MoEMLP(fp8_wire="auto") resolves per wire class (the
+        # policy the measured nets above justify: codec on the slow
+        # cross-slice wire only) — layers/moe.py::fp8_wire_enabled
+        "fp8_auto_policy": {"ici": False, "dcn": True},
     }
 
 

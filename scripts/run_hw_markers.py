@@ -20,6 +20,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 HW_CASES = [
     ("tests.test_primitives_matrix", "test_peek_reads_count_on_hardware"),
+    # AOT bundle serialize/reload: interpret kernels embed python
+    # callbacks XLA cannot serialize, so the second-process-zero-retrace
+    # proof only runs against real Mosaic lowering
+    ("tests.test_engine_aot", "test_second_process_serves_with_zero_retraces"),
 ]
 
 

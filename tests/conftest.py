@@ -4,7 +4,7 @@ Reference tests require N physical GPUs under torchrun (SURVEY.md section 4);
 here every distributed test runs on one host, with Pallas kernels executing
 under TPU interpret mode (simulated DMA/semaphores).
 
-10 devices = the widest test mesh (8) + 2 spares; spare devices keep spare
+12 devices = the widest test mesh (8) + 4 spares; spare devices keep spare
 XLA client threads so interpret-mode collective kernels can't starve at full
 mesh occupancy (see ``core.platform.force_cpu``).
 """

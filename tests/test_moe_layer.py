@@ -95,6 +95,43 @@ def test_moe_ep_fp8_wire_parity():
     np.testing.assert_allclose(outs[True], want, rtol=0.15, atol=0.15)
 
 
+def test_moe_fp8_wire_auto_policy():
+    """fp8_wire="auto" enables the codec by WIRE CLASS (VERDICT r4 next
+    #8): off on ICI axes (the measured net win there is negative), on
+    for DCN axes (named by convention or actually spanning processes).
+    On an ICI mesh the auto forward must be BIT-identical to
+    fp8_wire=False — the codec never ran."""
+    from triton_distributed_tpu.core import mesh as mesh_lib
+
+    n, t, hid, ffn, e, k = 4, 16, 128, 32, 8, 2
+    mesh = make_mesh({TP_AXIS: n}, devices=jax.devices()[:n])
+    # policy resolution per wire class
+    assert MoEMLP(mesh, num_experts=e, fp8_wire="auto",
+                  ).fp8_wire_enabled() is False          # single-host ICI
+    dcn_mesh = make_mesh({"dcn_ep": 2, TP_AXIS: 2},
+                         devices=jax.devices()[:4])
+    assert mesh_lib.wire_class(dcn_mesh, "dcn_ep") == "dcn"
+    assert MoEMLP(dcn_mesh, num_experts=e, axis="dcn_ep",
+                  fp8_wire="auto").fp8_wire_enabled() is True
+    assert MoEMLP(mesh, num_experts=e, fp8_wire=True).fp8_wire_enabled()
+    with pytest.raises(ValueError, match="fp8_wire"):
+        MoEMLP(mesh, num_experts=e, fp8_wire="always")
+
+    # bit-identical to the bf16 wire on ICI (codec skipped, not merely
+    # accurate)
+    x, router, w_up, w_dn = _setup(n, t, hid, ffn, e, seed=91)
+    xs = jax.device_put(x, NamedSharding(mesh, P(TP_AXIS, None)))
+    cfg = AllToAllConfig(chunk=8)
+    outs = {}
+    for wire in (False, "auto"):
+        layer = MoEMLP(mesh, num_experts=e, top_k=k, fp8_wire=wire)
+        params = layer.shard_params_ep(router, w_up, w_dn)
+        outs[wire] = np.asarray(jax.device_get(
+            layer.forward_ep(params, xs, a2a_config=cfg)
+        ))
+    np.testing.assert_array_equal(outs["auto"], outs[False])
+
+
 def test_moe_ep_fp8_wire_gradients_flow():
     """The quantized wire must NOT freeze training: the u8 transport is
     custom-vjp'd with a straight-through estimator, so expert-weight

@@ -120,3 +120,28 @@ def is_dcn_axis(axis: str) -> bool:
     intra+inter-node kernel hierarchies (``allgather.py:442-601``).
     """
     return axis in DCN_AXES or axis.startswith("dcn_")
+
+
+def axis_spans_processes(mesh: Mesh, axis: str) -> bool:
+    """Whether stepping along ``axis`` crosses process (host) boundaries —
+    the topological test for DCN hops, independent of axis naming."""
+    import numpy as np
+
+    ax = list(mesh.axis_names).index(axis)
+    n = mesh.devices.shape[ax]
+    devs = np.moveaxis(mesh.devices, ax, 0).reshape(n, -1)
+    procs = np.asarray(
+        [[d.process_index for d in row] for row in devs]
+    )
+    return bool((procs != procs[:1]).any())
+
+
+def wire_class(mesh: Mesh, axis: str) -> str:
+    """"dcn" when hops along ``axis`` ride the cross-slice network (by
+    naming convention OR by actually spanning processes), else "ici".
+    The policy input for wire-cost decisions (e.g. the MoE fp8 wire
+    codec, whose measured net win is positive on DCN and negative on
+    ICI — BENCH r04 ``net_us_per_token_hop_*``)."""
+    if is_dcn_axis(axis) or axis_spans_processes(mesh, axis):
+        return "dcn"
+    return "ici"

@@ -27,7 +27,12 @@ _DEFAULT_VIRTUAL_DEVICES = 8
 # Spare virtual devices to request beyond the widest mesh (see force_cpu):
 # spare devices = spare XLA client threads = interpret-mode kernels can make
 # progress even when every mesh device's thread is blocked in a wait.
-SPARE_VIRTUAL_DEVICES = 2
+# 4 (round 5; was 2): programs mixing many compiled callback kernels with
+# effects tokens (the AOT-serving engine tests) starved a 2-spare pool —
+# observed as a worker-thread SIGABRT with every thread parked in the
+# interpreter's _clean_up_shared_memory while the main thread sharded
+# effect tokens; 4 spares runs the same programs reliably.
+SPARE_VIRTUAL_DEVICES = 4
 
 _initialized = False
 
@@ -41,7 +46,7 @@ def force_cpu(num_devices: int = _DEFAULT_VIRTUAL_DEVICES) -> None:
     set the config explicitly as well.
 
     IMPORTANT — request MORE devices than the widest mesh you will build
-    (2 spares is enough; see ``SPARE_VIRTUAL_DEVICES``).  The XLA CPU
+    (see ``SPARE_VIRTUAL_DEVICES``).  The XLA CPU
     client's execution thread pool is sized by the device count; an
     interpret-mode collective kernel occupies one pool thread per mesh
     device while blocked in a semaphore wait, and kernel progress (buffer

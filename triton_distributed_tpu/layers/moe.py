@@ -28,6 +28,7 @@ from ..comm.all_to_all import (
     ep_dispatch,
     ep_dispatch_adjoint,
 )
+from ..core import mesh as mesh_lib
 from ..core.mesh import TP_AXIS
 from ..ops.group_gemm import ag_group_gemm, moe_reduce_rs
 from ..ops.moe_utils import (
@@ -222,8 +223,26 @@ class MoEMLP:
     renormalize: bool = True
     # EP A2A ships e4m3 payloads + f32 scale sidecars instead of the model
     # dtype (the reference's production low-latency A2A configuration);
-    # experts still compute in the model dtype after dequantization
-    fp8_wire: bool = False
+    # experts still compute in the model dtype after dequantization.
+    # ``"auto"`` enables the codec only when the A2A axis rides DCN
+    # (cross-slice) hops: the measured economics (BENCH r04
+    # ``net_us_per_token_hop_ici`` = -0.03 us vs ``_dcn`` = +1.06 us)
+    # say the halved payload pays for the codec on the slow wire class
+    # and not on the ICI torus.  True/False force it either way.
+    fp8_wire: bool | str = False
+
+    def __post_init__(self):
+        if self.fp8_wire not in (True, False, "auto"):
+            raise ValueError(
+                f"fp8_wire must be True, False, or 'auto'; "
+                f"got {self.fp8_wire!r}"
+            )
+
+    def fp8_wire_enabled(self) -> bool:
+        """The resolved wire-codec decision for THIS layer's A2A axis."""
+        if self.fp8_wire == "auto":
+            return mesh_lib.wire_class(self.mesh, self.axis) == "dcn"
+        return bool(self.fp8_wire)
 
     @property
     def n(self) -> int:
@@ -431,7 +450,7 @@ class MoEMLP:
         x_sorted, splits, wflat, unsort = self._route_and_sort(
             x, params.router
         )
-        fp8 = self.fp8_wire and n > 1
+        fp8 = self.fp8_wire_enabled() and n > 1
         cfg = a2a_config or AllToAllConfig()
         if fp8:
             # quantized wire with a straight-through backward (see

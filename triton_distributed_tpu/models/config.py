@@ -33,7 +33,7 @@ class ModelConfig:
     norm_topk: bool = True         # renormalize routing weights over top-k
     moe_strategy: str = "tp"       # "tp" (experts F-sharded) | "ep"
                                    # (experts partitioned; A2A dispatch)
-    moe_fp8_wire: bool = False     # EP A2A ships e4m3 + scale sidecars
+    moe_fp8_wire: bool | str = False  # EP A2A e4m3 wire; "auto" = DCN hops only
                                    # (reference low-latency A2A production
                                    # config); compute stays in `dtype`
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -90,6 +91,12 @@ class Engine:
         # steps update the cache buffers in place
         self._prefill = jax.jit(self.model.prefill, donate_argnums=(1,))
         self._decode = jax.jit(self.model.decode, donate_argnums=(1,))
+        # bucketed AOT executables (Engine.precompile / load_precompiled):
+        # {bucket_len: Compiled}; when present, prefill dispatches by
+        # bucket and never retraces
+        self._prefill_exec: dict[int, Any] = {}
+        self._decode_exec = None
+        self._exec_params_put: dict = {}
 
     @classmethod
     def build(cls, config: ModelConfig, mesh: Mesh, *, key=None,
@@ -105,25 +112,176 @@ class Engine:
     def set_decode_mode(self, mode: str) -> None:
         """Swap the decode-step reduction implementation in place (the
         reference's ``set_fwd`` switch, ``models/qwen.py:85``).  Params and
-        cache are kept; the decode step re-jits on next call."""
+        cache are kept; the decode step re-jits on next call.  Any AOT
+        decode executable is DROPPED (it bakes in the old mode) — re-run
+        :meth:`precompile` to restore zero-compile serving."""
         self.model = dataclasses.replace(self.model, decode_mode=mode)
         self._decode = jax.jit(self.model.decode, donate_argnums=(1,))
+        self._decode_exec = None
 
     def prefill(self, input_ids: jax.Array) -> jax.Array:
-        """Run the prompt; returns last-position logits (B, V)."""
+        """Run the prompt; returns last-position logits (B, V).
+
+        With precompiled buckets (:meth:`precompile` /
+        :meth:`load_precompiled`) the prompt is right-padded to the
+        smallest bucket >= its length and dispatched to that AOT
+        executable — no tracing happens on this path (reference: the
+        signature-space dispatch its AOT linker emits,
+        ``tools/compile_aot.py:61-130`` + ``link_all:470``)."""
         max_len = self.model.config.max_length
-        if input_ids.shape[1] > max_len:
+        b, plen = input_ids.shape
+        if plen > max_len:
             raise ValueError(
-                f"prompt length {input_ids.shape[1]} exceeds "
-                f"max_length={max_len}"
+                f"prompt length {plen} exceeds max_length={max_len}"
             )
         self.cache = reset(self.cache)
+        if self._prefill_exec:
+            bucket = min(
+                (L for L in self._prefill_exec if L >= plen), default=None
+            )
+            if bucket is not None:
+                ids = input_ids if bucket == plen else jnp.concatenate(
+                    [input_ids,
+                     jnp.zeros((b, bucket - plen), input_ids.dtype)], axis=1
+                )
+                logits, self.cache = self._call_exec(
+                    self._prefill_exec[bucket],
+                    self.params, self.cache, ids, jnp.int32(plen),
+                )
+                return logits[:, plen - 1]
+            # longer than every bucket: fall through to the jit path
         logits, self.cache = self._prefill(self.params, self.cache, input_ids)
         return logits[:, -1]
 
+    def _call_exec(self, ex, params, *rest):
+        """Invoke an AOT executable, resharding inputs to its compiled
+        input shardings first.  A Compiled object (unlike jit) REJECTS
+        semantically-equal-but-differently-expressed shardings — e.g. the
+        GSPMD shardings a jit-path output carries vs the NamedShardings
+        the executable was lowered with — so arguments are device_put to
+        the exact expected shardings (a no-op for already-matching
+        placements).  The PARAMS subtree (hundreds of leaves on a real
+        model, shardings fixed after build) is resharded once per
+        (executable, params) pair and memoized; only the small
+        cache/tokens/length trees pay the per-call traversal on the
+        per-token decode path."""
+        arg_sh = tuple(ex.input_shardings[0])
+        key = (id(ex), id(params))
+        if self._exec_params_put.get("key") != key:
+            self._exec_params_put = {
+                "key": key,
+                "params": jax.tree.map(jax.device_put, params, arg_sh[0]),
+            }
+        rest = tuple(
+            jax.tree.map(jax.device_put, r, s)
+            for r, s in zip(rest, arg_sh[1:])
+        )
+        return ex(self._exec_params_put["params"], *rest)
+
     def decode_step(self, tokens: jax.Array) -> jax.Array:
+        if self._decode_exec is not None:
+            logits, self.cache = self._call_exec(
+                self._decode_exec, self.params, self.cache, tokens
+            )
+            return logits
         logits, self.cache = self._decode(self.params, self.cache, tokens)
         return logits
+
+    # -- bucketed AOT serving ---------------------------------------------
+
+    _MANIFEST = "aot_manifest.json"
+
+    def precompile(self, prompt_buckets, save_dir: str | None = None) -> dict:
+        """AOT-compile prefill for each prompt-length bucket plus the
+        decode step; optionally serialize next to the weights.
+
+        Reference: ``compile_aot.py:61-130`` declares signature/grid
+        spaces per kernel and links a dispatcher so serving launches
+        graph-safely with zero JIT work; here each bucket is one XLA
+        executable taking (params, cache, padded_ids, true_len) — the
+        traced ``true_len`` makes a single bucket exact for every prompt
+        length <= its shape (see ``Qwen3.prefill``).  Returns the
+        manifest dict; ``load_precompiled`` restores the executables in
+        another process with zero retraces.
+        """
+        import json
+        import os
+
+        from ..tools import aot
+
+        if self.cache_layout != "contiguous":
+            raise ValueError("bucketed AOT serving supports the contiguous "
+                             "cache layout")
+        c = self.model.config
+        buckets = sorted(set(int(x) for x in prompt_buckets))
+        if not buckets or buckets[0] < 1 or buckets[-1] > c.max_length:
+            raise ValueError(
+                f"buckets must be within [1, max_length={c.max_length}]; "
+                f"got {buckets}"
+            )
+        from ..core import compilation
+
+        cache0 = reset(self.cache)
+        # a fresh bucket set REPLACES any previous one: accumulating would
+        # desynchronize the in-memory dispatch from the saved manifest
+        self._prefill_exec = {}
+        for L in buckets:
+            ids = jnp.zeros((self.batch, L), jnp.int32)
+            self._prefill_exec[L] = self._prefill.lower(
+                self.params, cache0, ids, jnp.int32(L)
+            ).compile()
+        self._decode_exec = self._decode.lower(
+            self.params, cache0, jnp.zeros((self.batch,), jnp.int32)
+        ).compile()
+        manifest = {
+            "buckets": buckets,
+            "batch": self.batch,
+            "max_length": c.max_length,
+            "vocab": c.vocab,
+            "decode_mode": self.model.decode_mode,
+        }
+        if save_dir is not None:
+            if compilation.interpret_mode():
+                raise RuntimeError(
+                    "serializing AOT bundles requires real-TPU lowering "
+                    "(interpret kernels embed python callbacks XLA cannot "
+                    "serialize)"
+                )
+            os.makedirs(save_dir, exist_ok=True)
+            for L, ex in self._prefill_exec.items():
+                aot.save(ex, os.path.join(save_dir, f"prefill_{L}.xla"))
+            aot.save(self._decode_exec, os.path.join(save_dir, "decode.xla"))
+            with open(os.path.join(save_dir, self._MANIFEST), "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+        return manifest
+
+    def load_precompiled(self, save_dir: str) -> dict:
+        """Restore :meth:`precompile`'s serialized executables — the
+        second-process serving path: after this, prefill (for lengths
+        within the buckets) and decode never trace or compile."""
+        import json
+        import os
+
+        from ..tools import aot
+
+        with open(os.path.join(save_dir, self._MANIFEST)) as f:
+            manifest = json.load(f)
+        c = self.model.config
+        mine = {"batch": self.batch, "max_length": c.max_length,
+                "vocab": c.vocab, "decode_mode": self.model.decode_mode}
+        for field, have in mine.items():
+            want = manifest.get(field)
+            if want != have:
+                raise ValueError(
+                    f"AOT bundle was compiled for {field}={want!r}; this "
+                    f"engine has {field}={have!r}"
+                )
+        self._prefill_exec = {
+            int(L): aot.load(os.path.join(save_dir, f"prefill_{L}.xla"))
+            for L in manifest["buckets"]
+        }
+        self._decode_exec = aot.load(os.path.join(save_dir, "decode.xla"))
+        return manifest
 
     def _check_length(self, prompt_len: int, gen_len: int) -> None:
         # dynamic_update_slice CLAMPS out-of-range writes: past max_length

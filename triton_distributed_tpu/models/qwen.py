@@ -260,9 +260,20 @@ class Qwen3:
         return gemm_rs(out, p.wo, self.mesh, self.axis), k_new, v_new
 
     def prefill(self, params: QwenParams, cache: KVCache,
-                input_ids: jax.Array):
+                input_ids: jax.Array, true_len: jax.Array | int | None = None):
         """Forward all prompt tokens; fills the cache.  ``input_ids``:
-        (B, S).  Returns (logits (B, S, V), cache)."""
+        (B, S).  Returns (logits (B, S, V), cache).
+
+        ``true_len`` (scalar, traceable) marks the REAL prompt length when
+        ``input_ids`` is right-padded to a bucketed shape (the AOT serving
+        path, ``Engine.precompile``): attention is causal, so pad
+        positions never influence logits at positions < true_len, and
+        setting the cache length to ``true_len`` masks the garbage K/V
+        the pads wrote — the next decode step overwrites position
+        true_len and proceeds as if the pads never ran.  One compiled
+        bucket executable therefore serves every prompt length <= its
+        shape exactly.
+        """
         c = self.config
         b, s = input_ids.shape
         x = params.embed[input_ids.reshape(-1)]
@@ -284,7 +295,11 @@ class Qwen3:
                          preferred_element_type=jnp.float32)
         # prefill always writes positions [0, s): SET the length rather than
         # advancing it, so a stale cache cannot desynchronize from the data
-        return logits.reshape(b, s, c.vocab), with_length(cache, s)
+        # (true_len < s = the bucketed-pad case, see the docstring)
+        return (
+            logits.reshape(b, s, c.vocab),
+            with_length(cache, s if true_len is None else true_len),
+        )
 
     # -- decode -----------------------------------------------------------
 

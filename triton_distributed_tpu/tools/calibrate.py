@@ -151,11 +151,13 @@ def _measure_hop(mesh, axis: str, sizes_bytes) -> tuple[float, float]:
         def hop(x):
             return jax.lax.ppermute(x, axis, perm)
 
-        fn = jax.jit(jax.shard_map(
-            hop, mesh=mesh,
+        from ..core import compilation
+
+        fn = compilation.jit_shard_map(
+            hop, mesh,
             in_specs=jax.sharding.PartitionSpec(axis),
             out_specs=jax.sharding.PartitionSpec(axis),
-        ))
+        )
         _, ms = perf_func(lambda: fn(x), iters=32, warmup_iters=3)
         times.append(ms / 1e3)
     sizes_actual = [max(1, b // (128 * 4)) * 128 * 4 for b in sizes_bytes]
@@ -223,14 +225,18 @@ def calibrate(mesh=None, *, save: bool | None = None,
 def _bdp_bytes(cal: LinkCalibration | None) -> float | None:
     if cal is None or not cal.ici_gbps or cal.ici_hop_us is None:
         return None
-    return cal.ici_gbps * 1e9 * cal.ici_hop_us * 1e-6
+    # a measured hop_us of exactly 0.0 (noise-clamped intercept) is a
+    # REAL ultra-low-latency calibration, not a cold start: floor the
+    # BDP at one wire MTU-ish chunk rather than discarding the
+    # measurement through a falsy-zero check
+    return max(cal.ici_gbps * 1e9 * cal.ici_hop_us * 1e-6, 8192.0)
 
 
 def push_bytes_threshold() -> int:
     """AllGather one-shot-push vs ring crossover (bytes per shard): the
     measured bandwidth-delay product, else the 256 KiB cold default."""
     bdp = _bdp_bytes(load_calibration())
-    return int(bdp) if bdp else DEFAULT_PUSH_BYTES
+    return int(bdp) if bdp is not None else DEFAULT_PUSH_BYTES
 
 
 def one_shot_bytes_threshold() -> int:
@@ -238,7 +244,7 @@ def one_shot_bytes_threshold() -> int:
     the bandwidth-delay product (the two-shot pays 2(n-1) chained hops),
     else the 512 KiB cold default."""
     bdp = _bdp_bytes(load_calibration())
-    return int(2 * bdp) if bdp else DEFAULT_ONE_SHOT_BYTES
+    return int(2 * bdp) if bdp is not None else DEFAULT_ONE_SHOT_BYTES
 
 
 def main() -> int:

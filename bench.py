@@ -470,6 +470,18 @@ def _decode_mode_wire_bytes(cfg, batch: int, ntp: int) -> dict:
     }
 
 
+def _fp8_auto_policy() -> dict:
+    """Per-wire-class decisions of the fp8 "auto" policy, evaluated on
+    probe meshes through the same wire_class the layer consults."""
+    from triton_distributed_tpu.core import mesh as mesh_lib
+
+    ici = mesh_lib.wire_class(mesh_lib.tp_mesh(), "tp") == "dcn"
+    dcn = mesh_lib.wire_class(
+        mesh_lib.make_mesh({"dcn": 1, "tp": jax.device_count()}), "dcn"
+    ) == "dcn"
+    return {"ici": ici, "dcn": dcn}
+
+
 def bench_moe_ep_wire(tokens: int = 4096):
     """EP A2A wire cost with the fp8 (e4m3 + scale sidecar) payload vs the
     bf16 payload (the reference's production low-latency A2A config, README
@@ -540,8 +552,42 @@ def bench_moe_ep_wire(tokens: int = 4096):
         "net_us_per_token_hop_dcn": round(net_dcn, 4),
         # what MoEMLP(fp8_wire="auto") resolves per wire class (the
         # policy the measured nets above justify: codec on the slow
-        # cross-slice wire only) — layers/moe.py::fp8_wire_enabled
-        "fp8_auto_policy": {"ici": False, "dcn": True},
+        # cross-slice wire only) — DERIVED from the live policy code
+        # (core.mesh.wire_class feeding fp8_wire_enabled), so a policy
+        # change reaches the record automatically
+        "fp8_auto_policy": _fp8_auto_policy(),
+    }
+
+
+def bench_overlap():
+    """Measured DMA/MXU overlap of the tile pipeline (the compute core of
+    the fused collective GEMMs) via the three-kernel decomposition in
+    ``tools/overlap.py`` — fused vs dma-only vs mxu-only wall times,
+    reporting what fraction of the smaller phase the pipeline hides.
+    Converts ``tests/test_overlap_structure.py``'s program-order argument
+    into a measured claim; on a slice the v5p >= 90%-hidden BASELINE
+    target inherits this metric."""
+    from triton_distributed_tpu.tools.overlap import hidden_pct, overlap_kernels
+
+    m = n = k = 4096
+    fused, dma, mxu = overlap_kernels(m, n, k)
+    ka, kb = jax.random.split(jax.random.key(0))
+    a = jax.random.normal(ka, (m, k), jnp.bfloat16)
+    b = jax.random.normal(kb, (k, n), jnp.bfloat16)
+    times = _bench_interleaved({
+        "fused": lambda: fused(a, b),
+        "dma": lambda: dma(a, b),
+        "mxu": lambda: mxu(a, b),
+    }, iters=16, rounds=9, window_s=0.3)
+    tf_, td, tm = (_median(times[x]) for x in ("fused", "dma", "mxu"))
+    pct = hidden_pct(tf_, td, tm)
+    return {
+        "metric": f"overlap_hidden_pct_m{m}",
+        "value": round(pct, 4),
+        "unit": "fraction of smaller phase hidden",
+        "fused_us": round(tf_ * 1e6, 1),
+        "dma_only_us": round(td * 1e6, 1),
+        "mxu_only_us": round(tm * 1e6, 1),
     }
 
 
@@ -684,6 +730,8 @@ def main():
         print(json.dumps(bench_moe_ep_wire()))
     elif mode == "latency":
         print(json.dumps(bench_latency()))
+    elif mode == "overlap":
+        print(json.dumps(bench_overlap()))
     elif mode == "auto":
         # whole perf surface, one JSON line per mode; headline GEMM first
         _emit(bench_single_chip)
@@ -696,6 +744,7 @@ def main():
         _emit(bench_decode_modes)
         _emit(bench_moe_ep_wire)
         _emit(bench_latency)
+        _emit(bench_overlap)
         if jax.device_count() > 1:
             _emit(bench_multi_chip)
         if _EMIT_FAILED:
@@ -705,7 +754,7 @@ def main():
     else:
         raise SystemExit(
             f"unknown bench mode {mode!r} "
-            "(auto|gemm|attn|mlp|moe|decode|decode_modes|moe_ep|latency)"
+            "(auto|gemm|attn|mlp|moe|decode|decode_modes|moe_ep|latency|overlap)"
         )
 
 

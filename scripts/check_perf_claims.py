@@ -125,6 +125,12 @@ CLAIMS = {
     # dispatch included): a gross-regression tripwire only — absolute
     # latency on this dev box is dominated by the tunnel RTT
     "latency_class_us": {"value_max": 2000.0, "since": 5},
+    # measured DMA/MXU overlap of the tile pipeline (tools/overlap.py
+    # three-kernel decomposition): a serialized pipeline reads ~0, the
+    # r05 capture read 0.76; the clamp makes 1.0 the hard maximum
+    "overlap_hidden_pct_m4096": {
+        "floor": 0.5, "value_max": 1.0, "since": 5,
+    },
 }
 
 def parse_record(path: str) -> list[dict]:

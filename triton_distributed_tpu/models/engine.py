@@ -166,17 +166,19 @@ class Engine:
         cache/tokens/length trees pay the per-call traversal on the
         per-token decode path."""
         arg_sh = tuple(ex.input_shardings[0])
-        key = (id(ex), id(params))
-        if self._exec_params_put.get("key") != key:
-            self._exec_params_put = {
-                "key": key,
-                "params": jax.tree.map(jax.device_put, params, arg_sh[0]),
-            }
+        # keyed by the EXECUTABLE OBJECT (strong ref; a handful exist) and
+        # validated by params IDENTITY — an id()-keyed memo without a
+        # retained reference could match a recycled id after a weight
+        # swap and silently serve stale weights
+        hit = self._exec_params_put.get(ex)
+        if hit is None or hit[0] is not params:
+            hit = (params, jax.tree.map(jax.device_put, params, arg_sh[0]))
+            self._exec_params_put[ex] = hit
         rest = tuple(
             jax.tree.map(jax.device_put, r, s)
             for r, s in zip(rest, arg_sh[1:])
         )
-        return ex(self._exec_params_put["params"], *rest)
+        return ex(hit[1], *rest)
 
     def decode_step(self, tokens: jax.Array) -> jax.Array:
         if self._decode_exec is not None:
@@ -225,6 +227,7 @@ class Engine:
         # a fresh bucket set REPLACES any previous one: accumulating would
         # desynchronize the in-memory dispatch from the saved manifest
         self._prefill_exec = {}
+        self._exec_params_put = {}
         for L in buckets:
             ids = jnp.zeros((self.batch, L), jnp.int32)
             self._prefill_exec[L] = self._prefill.lower(
@@ -239,6 +242,7 @@ class Engine:
             "max_length": c.max_length,
             "vocab": c.vocab,
             "decode_mode": self.model.decode_mode,
+            "cache_layout": self.cache_layout,
         }
         if save_dir is not None:
             if compilation.interpret_mode():
@@ -268,7 +272,8 @@ class Engine:
             manifest = json.load(f)
         c = self.model.config
         mine = {"batch": self.batch, "max_length": c.max_length,
-                "vocab": c.vocab, "decode_mode": self.model.decode_mode}
+                "vocab": c.vocab, "decode_mode": self.model.decode_mode,
+                "cache_layout": self.cache_layout}
         for field, have in mine.items():
             want = manifest.get(field)
             if want != have:

@@ -84,6 +84,20 @@ np.testing.assert_allclose(
     got, want_sum[other * half:(other + 1) * half], rtol=1e-5, atol=1e-5
 )
 print(f"proc {proc_id} multihost collectives ok", flush=True)
+
+# link calibration's DCN branch + cross-process agreement: both procs
+# must compute the IDENTICAL (mean) numbers or per-host thresholds could
+# steer choose_method into mismatched collective methods across hosts
+import os as _os, tempfile as _tf
+_os.environ["TDT_LINKCAL_CACHE"] = _os.path.join(_tf.mkdtemp(), "cal.json")
+from triton_distributed_tpu.tools import calibrate as _cal
+got = _cal.calibrate(mesh=mesh, force=True, save=False,
+                     sizes_bytes=(65536, 262144, 1048576))
+assert got.ici_gbps and got.ici_gbps > 0, got
+assert got.dcn_gbps is not None and got.dcn_gbps > 0, got
+print(f"proc {proc_id} dcn calibration "
+      f"ici={got.ici_gbps:.4f}/{got.ici_hop_us:.4f} "
+      f"dcn={got.dcn_gbps:.4f}/{got.dcn_hop_us:.4f} ok", flush=True)
 """
 
 
@@ -145,3 +159,11 @@ def test_two_process_bootstrap_and_dcn_collectives(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} rc={p.returncode}:\n{out[-4000:]}"
         assert f"proc {i} multihost collectives ok" in out, out[-2000:]
+    # the agreed calibration numbers must be IDENTICAL on both processes
+    # (the printed line carries them to 4 decimals)
+    import re
+
+    cals = [
+        re.search(r"dcn calibration (.*) ok", out).group(1) for out in outs
+    ]
+    assert cals[0] == cals[1], cals

@@ -254,6 +254,24 @@ def timer(name: str = ""):
     dist_print(f"{name}: {(time.perf_counter() - t0) * 1e3:.3f} ms", rank=0)
 
 
+def process_mean(values) -> list[float]:
+    """Cross-process elementwise mean of a small float vector — identical
+    on every process (the agreement primitive behind the autotuner's
+    rank-synced winner choice and the link calibration's persisted
+    numbers; divergent per-host values feeding method choice would
+    launch MISMATCHED collectives across hosts).  Single-process: the
+    values unchanged."""
+    if jax.process_count() == 1:
+        return [float(v) for v in values]
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        jnp.asarray(list(values), jnp.float32)
+    )
+    return [float(v) for v in
+            np.asarray(gathered).reshape(-1, len(list(values))).mean(axis=0)]
+
+
 def sleep_async(ms: float):
     """Straggler injection (reference ``utils.py:1010`` ``sleep_async``): a
     host-side delay a test can insert on one rank to simulate skew.  Device-

@@ -199,13 +199,34 @@ def calibrate(mesh=None, *, save: bool | None = None,
     if jax.process_count() > 1:
         # cross-process hops ride the DCN: a mesh whose outer "dcn" axis
         # spans processes (the hierarchical collectives' convention,
-        # mesh.DCN_AXES) measures the slow wire class
-        dcn_mesh = mesh_lib.make_mesh({
-            "dcn": jax.process_count(),
-            "ici": jax.device_count() // jax.process_count(),
-        })
-        if dcn_mesh.shape["dcn"] >= 2:
-            dcn_us, dcn_gbps = _measure_hop(dcn_mesh, "dcn", sizes_bytes)
+        # mesh.DCN_AXES) measures the slow wire class.  On the CPU
+        # (interpret) platform the mesh leaves the spare devices OUT —
+        # a full-occupancy collective mesh can park every XLA client
+        # pool thread (core/platform.py force_cpu docstring)
+        import numpy as np
+
+        per = jax.device_count() // jax.process_count()
+        if platform.on_cpu():
+            per = max(1, per - platform.SPARE_VIRTUAL_DEVICES)
+        devs = np.array(jax.devices()).reshape(
+            jax.process_count(), -1
+        )[:, :per]
+        from jax.sharding import Mesh
+
+        dcn_us, dcn_gbps = _measure_hop(
+            Mesh(devs, ("dcn", "ici")), "dcn", sizes_bytes
+        )
+        # AGREEMENT across processes (core.utils.process_mean — the
+        # same invariant the autotuner's rank-synced winner choice
+        # upholds): thresholds derived from per-host calibrations feed
+        # choose_method, and hosts disagreeing on push-vs-ring launch
+        # MISMATCHED collective kernels — every host must persist the
+        # identical (mean) numbers
+        from ..core.utils import process_mean
+
+        ici_us, ici_gbps, dcn_us, dcn_gbps = process_mean(
+            [ici_us, ici_gbps, dcn_us, dcn_gbps]
+        )
     cal = LinkCalibration(
         ici_gbps=round(ici_gbps, 2), ici_hop_us=round(ici_us, 3),
         dcn_gbps=None if dcn_gbps is None else round(dcn_gbps, 2),

@@ -203,16 +203,12 @@ class Autotuner:
 
     def _agree(self, times: list[float]) -> list[float]:
         """Average candidate times over processes so every rank picks the
-        same winner (reference: the rank sync in ``autotuner.py:200-230``)."""
-        if jax.process_count() == 1:
-            return times
-        import jax.numpy as jnp
+        same winner (reference: the rank sync in ``autotuner.py:200-230``;
+        shared primitive: ``core.utils.process_mean`` — the link
+        calibration persists through the same agreement)."""
+        from ..core.utils import process_mean
 
-        arr = jnp.asarray(times)
-        mean = jax.pmap(  # one device per process suffices for the mean
-            lambda x: jax.lax.pmean(x, "p"), axis_name="p"
-        )(arr[None])[0]
-        return [float(t) for t in mean]
+        return process_mean(times)
 
     # -- entry ------------------------------------------------------------
 

@@ -133,16 +133,19 @@ CLAIMS = {
     },
 }
 
-def parse_record(path: str) -> list[dict]:
-    """Metric lines from a BENCH_r*.json: either the driver envelope
-    (JSON object whose "tail" holds the stdout lines) or raw JSON-lines."""
+def parse_record(path: str) -> tuple[list[dict], int | None]:
+    """(metric lines, envelope rc) from a BENCH_r*.json: either the
+    driver envelope (JSON object whose "tail" holds the stdout lines and
+    "rc" the bench exit code) or raw JSON-lines (rc None)."""
     with open(path) as f:
         text = f.read()
     metrics = []
+    rc = None
     try:
         obj = json.loads(text)
         if isinstance(obj, dict) and "tail" in obj:
             text = obj["tail"]
+            rc = obj.get("rc")
     except ValueError:
         pass
     for line in text.splitlines():
@@ -155,7 +158,7 @@ def parse_record(path: str) -> list[dict]:
             continue
         if isinstance(rec, dict) and "metric" in rec:
             metrics.append(rec)
-    return metrics
+    return metrics, rc
 
 
 def newest_record(root: str) -> str | None:
@@ -228,24 +231,55 @@ def check(root: str) -> int:
         return 0
     m = re.search(r"BENCH_r(\d+)\.json$", path)
     record_round = int(m.group(1)) if m else 0
-    metrics = parse_record(path)
+    metrics, rc = parse_record(path)
     if not metrics:
         print(f"{path}: no metric lines parsed — record format drifted?")
         return 1
     failures, warnings = [], []
     checked = 0
+    seen_prefixes = set()
     for rec in metrics:
-        claim = next(
-            (c for prefix, c in CLAIMS.items()
+        hit = next(
+            ((prefix, c) for prefix, c in CLAIMS.items()
              if rec["metric"].startswith(prefix)),
             None,
         )
-        if claim is None or record_round < claim.get("since", 0):
+        if hit is None or record_round < hit[1].get("since", 0):
             continue
+        seen_prefixes.add(hit[0])
         checked += 1
-        f, w = _check_metric(rec, claim)
+        f, w = _check_metric(rec, hit[1])
         failures.extend(f)
         warnings.extend(w)
+    # every BINDING claim must have a matching metric in the record: a
+    # renamed bench metric or a crashed bench mode would otherwise make
+    # its claims silently unchecked — the gate must notice absence, not
+    # just violation.  Completeness binds to FULL-SWEEP records,
+    # identified explicitly: `bench.py auto` always ends with the
+    # bench_sweep_complete sentinel (value 0 = some mode crashed).
+    # Driver-envelope records with a nonzero rc fail outright —
+    # a sweep that died before the sentinel must not pass by absence.
+    sentinel = next(
+        (r for r in metrics if r["metric"] == "bench_sweep_complete"), None
+    )
+    if rc not in (None, 0):
+        failures.append(
+            f"driver envelope records bench exit code {rc} — the sweep "
+            f"crashed; the record is incomplete"
+        )
+    if sentinel is not None:
+        if not sentinel.get("value"):
+            failures.append(
+                "bench_sweep_complete=0 — one or more bench modes crashed "
+                "mid-sweep (see the driver log)"
+            )
+        for prefix, claim in CLAIMS.items():
+            if (record_round >= claim.get("since", 0)
+                    and prefix not in seen_prefixes):
+                failures.append(
+                    f"claimed metric {prefix!r} is MISSING from the record "
+                    f"— its bench mode crashed or the metric was renamed"
+                )
     tag = os.path.basename(path)
     for w in warnings:
         print(f"{tag}: WARNING {w}")

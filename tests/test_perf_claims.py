@@ -89,6 +89,30 @@ def test_deterministic_ratio_drift_fails(tmp_path):
     assert cpc.check(str(tmp_path)) == 1
 
 
+def test_missing_claimed_metric_fails_full_records(tmp_path):
+    """A full-sweep record (bench_sweep_complete sentinel present)
+    missing a binding claimed metric must fail: a crashed bench mode or
+    a renamed metric would otherwise leave its claims silently
+    unchecked.  Targeted records (no sentinel) are exempt, and a
+    driver envelope with nonzero rc fails outright."""
+    sentinel = json.dumps({"metric": "bench_sweep_complete", "value": 1,
+                           "unit": "bool"})
+    (tmp_path / "BENCH_r09.json").write_text(_line() + "\n" + sentinel + "\n")
+    assert cpc.check(str(tmp_path)) == 1  # all other claims missing
+    # a targeted record without the sentinel is exempt from completeness
+    (tmp_path / "BENCH_r09.json").write_text(_line() + "\n")
+    assert cpc.check(str(tmp_path)) == 0
+    # sentinel value 0 = a mode crashed mid-sweep: hard failure
+    crashed = json.dumps({"metric": "bench_sweep_complete", "value": 0,
+                          "unit": "bool"})
+    (tmp_path / "BENCH_r09.json").write_text(_line() + "\n" + crashed + "\n")
+    assert cpc.check(str(tmp_path)) == 1
+    # a driver envelope recording a nonzero bench exit code fails
+    env = {"n": 9, "rc": 1, "tail": _line() + "\n"}
+    (tmp_path / "BENCH_r09.json").write_text(json.dumps(env))
+    assert cpc.check(str(tmp_path)) == 1
+
+
 def test_since_round_scopes_old_records(tmp_path):
     """A claim introduced in round N must not fail a round N-1 record."""
     line = _line(value=90.0)

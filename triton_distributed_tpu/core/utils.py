@@ -196,15 +196,33 @@ def interleaved_time_samples(
             slope_b = (b2 - cal_b) / kb
             samples[na].append((slope_a, (a1 + a2) / (2 * (1 + ka))))
             samples[nb].append((slope_b, (b1 + b2) / (2 * (1 + kb))))
+            if target_window_s:
+                # RE-calibrate trips every round: a one-time round-0
+                # calibration leaves the two engines' window durations
+                # diverging as the chip's clock drifts (observed: an
+                # ALIASED pair — the same executable — reading a 0.85
+                # "self-ratio" because its two windows no longer
+                # matched), and the raw estimator's common-mode
+                # cancellation needs equal-duration windows
+                for nm, raw_dt in ((na, (a1 + a2) / (2 * (1 + ka))),
+                                   (nb, (b1 + b2) / (2 * (1 + kb)))):
+                    if raw_dt > 0:
+                        trips[nm] = max(iters, min(
+                            int(target_window_s / raw_dt), 8192))
             continue
         for name, thunk in order:
             k = trips[name]
             t_long = timed_run(thunk, 1 + k)
             dt = (t_long - timed_run(thunk, 1)) / k
             samples[name].append((dt, t_long / (1 + k)))
-            if r == 0 and target_window_s and dt > 0:
+            raw_dt = t_long / (1 + k)
+            if target_window_s and raw_dt > 0:
+                # every round, not just round 0 (see the ABBA branch) —
+                # and from the RAW per-iter time: the slope dt's
+                # independent calibration noise can read tiny-positive
+                # and explode the trip count to the cap
                 trips[name] = max(iters,
-                                  min(int(target_window_s / dt), 8192))
+                                  min(int(target_window_s / raw_dt), 8192))
     return samples
 
 

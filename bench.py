@@ -440,13 +440,29 @@ def _emit(fn, *args, **kw):
                 (c for prefix, c in cpc.CLAIMS.items()
                  if rec.get("metric", "").startswith(prefix)), None,
             )
-            needs_retry = (claim is not None
-                           and bool(cpc._check_metric(rec, claim)[0]))
+            fails = (cpc._check_metric(rec, claim)[0]
+                     if claim is not None else [])
+            # retry ONLY pure floor violations (the thermal-dip class);
+            # a ceiling/impossible-baseline failure is a measurement
+            # ARTIFACT the gate exists to surface — re-rolling until it
+            # passes would hide it, so those records print as-is and
+            # the gate goes red
+            needs_retry = bool(fails) and all(
+                "below the claimed floor" in f for f in fails
+            )
         except Exception:
             traceback.print_exc(file=sys.stderr)
             needs_retry = False
         if needs_retry:
-            retry = fn(*args, **kw)
+            try:
+                retry = fn(*args, **kw)
+            except Exception:
+                # the first attempt is a complete record: partial
+                # results must survive a crashed retry
+                rec["attempts"] = 2
+                rec["retry_crashed"] = True
+                print(json.dumps(rec), flush=True)
+                raise
             retry["attempts"] = 2
             retry["first_attempt_value"] = rec.get("value")
             if not cpc._check_metric(retry, claim)[0]:

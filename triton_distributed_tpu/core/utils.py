@@ -152,10 +152,12 @@ def interleaved_time_samples(
       ~sync/window (~10% of the gap at 0.4 s windows) — the right
       basis for ratios and for crowning decisions.
 
-    With ``target_window_s``, each thunk's trip count is raised (after
-    the first round's estimate) until its timed window reaches that
-    duration — EVERY thunk to the same duration, which is what makes
-    the raw estimator's sync share common mode (the trip cap is high
+    With ``target_window_s``, each thunk's trip count is RE-calibrated
+    every round from its latest raw per-iter time, holding every
+    thunk's window at that duration as the chip's clock drifts — equal
+    window durations are what make the raw estimator's fixed-cost share
+    common mode (a one-time round-0 calibration let windows drift apart
+    and a literal self-vs-self pair drew 0.85; the trip cap is high
     enough that sub-0.1 ms thunks still reach a 0.4 s window).  Callers
     warm thunks up first, apply their own non-positive-sample policy,
     and should DROP round 0 of the raw samples (taken before the
@@ -194,18 +196,14 @@ def interleaved_time_samples(
             a2 = timed_run(fa, 1 + ka)
             slope_a = (a1 - cal_a) / ka
             slope_b = (b2 - cal_b) / kb
-            samples[na].append((slope_a, (a1 + a2) / (2 * (1 + ka))))
-            samples[nb].append((slope_b, (b1 + b2) / (2 * (1 + kb))))
+            raw_a = (a1 + a2) / (2 * (1 + ka))
+            raw_b = (b1 + b2) / (2 * (1 + kb))
+            samples[na].append((slope_a, raw_a))
+            samples[nb].append((slope_b, raw_b))
             if target_window_s:
-                # RE-calibrate trips every round: a one-time round-0
-                # calibration leaves the two engines' window durations
-                # diverging as the chip's clock drifts (observed: an
-                # ALIASED pair — the same executable — reading a 0.85
-                # "self-ratio" because its two windows no longer
-                # matched), and the raw estimator's common-mode
-                # cancellation needs equal-duration windows
-                for nm, raw_dt in ((na, (a1 + a2) / (2 * (1 + ka))),
-                                   (nb, (b1 + b2) / (2 * (1 + kb)))):
+                # RE-calibrate trips every round (see the docstring's
+                # equal-window rationale)
+                for nm, raw_dt in ((na, raw_a), (nb, raw_b)):
                     if raw_dt > 0:
                         trips[nm] = max(iters, min(
                             int(target_window_s / raw_dt), 8192))
@@ -214,8 +212,8 @@ def interleaved_time_samples(
             k = trips[name]
             t_long = timed_run(thunk, 1 + k)
             dt = (t_long - timed_run(thunk, 1)) / k
-            samples[name].append((dt, t_long / (1 + k)))
             raw_dt = t_long / (1 + k)
+            samples[name].append((dt, raw_dt))
             if target_window_s and raw_dt > 0:
                 # every round, not just round 0 (see the ABBA branch) —
                 # and from the RAW per-iter time: the slope dt's

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Overlap-efficiency report from recorded span traces.
+
+The standing instrument for every perf PR (ISSUE 1): given one or more
+Chrome-trace span files exported by ``triton_distributed_tpu.obs``
+(one per process — e.g. ``obs.tracing.export(f"spans_r{rank}.json")``
+after a traced decode), print the per-step table of comm-exposed vs
+compute time and the overlap ratio the paper's design is supposed to
+maximize.
+
+Usage:
+    python scripts/obs_report.py spans_r0.json spans_r1.json
+    python scripts/obs_report.py merged_trace.json.gz
+    python scripts/obs_report.py --selftest
+    python scripts/obs_report.py r0.json r1.json --json report.json
+
+Multiple inputs are merged with ``tools.trace_merge`` (rank i = argv
+order), so per-rank lanes stay disjoint; a single input may already be a
+merged trace.  ``--json`` additionally writes the rows + aggregate as
+JSON for machine consumers (CI gates on mean overlap).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("traces", nargs="*",
+                    help="span trace files (one per rank, or one merged)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run on the canned two-rank span set and verify "
+                         "the known ratios")
+    ap.add_argument("--json", metavar="PATH",
+                    help="also write rows + aggregate as JSON")
+    args = ap.parse_args(argv)
+
+    from triton_distributed_tpu.obs import report
+
+    if args.selftest:
+        sys.stdout.write(report.selftest())
+        print("selftest OK")
+        return 0
+    if not args.traces:
+        ap.error("no trace files given (or use --selftest)")
+
+    if len(args.traces) == 1:
+        events = report.load_trace(args.traces[0])
+    else:
+        from triton_distributed_tpu.tools.trace_merge import merge_traces
+
+        with tempfile.TemporaryDirectory() as td:
+            merged = os.path.join(td, "merged.json")
+            merge_traces(list(args.traces), list(range(len(args.traces))),
+                         merged)
+            events = report.load_trace(merged)
+
+    rows = report.overlap_report(events)
+    sys.stdout.write(report.format_report(rows))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"rows": rows, "aggregate": report.aggregate(rows)},
+                      f, indent=1, sort_keys=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

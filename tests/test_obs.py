@@ -1,0 +1,425 @@
+"""Observability subsystem (ISSUE 1): registry semantics, histogram
+bucketing, JSONL/Prometheus round trips, span tracing + merged-trace
+overlap report, instrumentation hooks, and the ``obs_report --selftest``
+CLI."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import types
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from triton_distributed_tpu import obs
+from triton_distributed_tpu.obs import report
+from triton_distributed_tpu.obs.registry import Registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def obs_on():
+    """Enabled obs with a clean registry/trace buffer, restored after."""
+    prev = obs.enabled()
+    obs.enable(True)
+    obs.REGISTRY.reset()
+    obs.tracing.clear()
+    yield obs
+    obs.REGISTRY.reset()
+    obs.tracing.clear()
+    obs.enable(prev)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+
+
+def test_counter_gauge_semantics():
+    r = Registry()
+    c = r.counter("reqs", op="ag")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    # get-or-create: same identity for same (name, labels)
+    assert r.counter("reqs", op="ag") is c
+    # distinct labels -> distinct series
+    assert r.counter("reqs", op="rs") is not c
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = r.gauge("occ")
+    g.set(0.5)
+    g.add(0.25)
+    assert g.value == 0.75
+
+
+def test_histogram_bucketing_and_quantiles():
+    r = Registry()
+    h = r.histogram("lat_ms", (1.0, 10.0, 100.0))
+    for v in (0.5, 0.9, 5.0, 50.0, 500.0):
+        h.observe(v)
+    # cumulative bucket counts: <=1: 2, <=10: 3, <=100: 4, +Inf: 5
+    row = h.row()
+    assert row["counts"] == [2, 3, 4]
+    assert row["count"] == 5
+    assert row["sum"] == pytest.approx(556.4)
+    assert row["min"] == 0.5 and row["max"] == 500.0
+    assert h.quantile(0.5) == 10.0      # 3rd of 5 lands in the <=10 bucket
+    assert h.quantile(1.0) == 500.0     # +Inf bucket reports observed max
+    with pytest.raises(ValueError):
+        r.histogram("bad", (3.0, 1.0))
+
+
+def test_registry_thread_safety():
+    r = Registry()
+    def work():
+        for _ in range(1000):
+            r.counter("n").inc()
+            r.histogram("h", (1.0,)).observe(0.5)
+    ts = [threading.Thread(target=work) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert r.counter("n").value == 4000
+    assert r.histogram("h", (1.0,)).count == 4000
+
+
+def test_snapshot_sorted_and_reset():
+    r = Registry()
+    r.counter("b").inc()
+    r.counter("a", x="2").inc()
+    r.counter("a", x="1").inc()
+    names = [(row["name"], row["labels"]) for row in r.snapshot()]
+    assert names == [("a", {"x": "1"}), ("a", {"x": "2"}), ("b", {})]
+    r.reset()
+    assert r.snapshot() == []
+
+
+# ---------------------------------------------------------------------------
+# exporters
+
+
+def _populate(r: Registry):
+    r.counter("comm_calls", op="ag", method="ring_1d").inc(3)
+    r.gauge("tokens_per_s").set(123.5)
+    h = r.histogram("lat_ms", (1.0, 10.0))
+    h.observe(0.5)
+    h.observe(20.0)
+
+
+def test_jsonl_round_trip(tmp_path):
+    r = Registry()
+    _populate(r)
+    p = str(tmp_path / "m.jsonl")
+    n = obs.write_jsonl(r, p, extra={"run": "t1"})
+    assert n == 3
+    obs.write_jsonl(r, p)  # append a second snapshot
+    rows = obs.read_jsonl(p)
+    assert len(rows) == 6
+    first = {row["name"]: row for row in rows[:3]}
+    assert first["comm_calls"]["value"] == 3
+    assert first["comm_calls"]["labels"] == {"op": "ag", "method": "ring_1d"}
+    assert first["comm_calls"]["run"] == "t1"
+    assert first["lat_ms"]["counts"] == [1, 1]
+    assert first["lat_ms"]["count"] == 2
+    # one append shares one ts; the second append has a later ts
+    assert len({row["ts"] for row in rows[:3]}) == 1
+    assert rows[3]["ts"] >= rows[0]["ts"]
+
+
+def test_prometheus_round_trip():
+    r = Registry()
+    _populate(r)
+    text = obs.to_prometheus(r)
+    assert "# TYPE comm_calls_total counter" in text
+    got = obs.parse_prometheus(text)
+    assert got['comm_calls_total{method="ring_1d",op="ag"}'] == 3.0
+    assert got["tokens_per_s"] == 123.5
+    assert got['lat_ms_bucket{le="1"}'] == 1.0
+    assert got['lat_ms_bucket{le="10"}'] == 1.0
+    assert got['lat_ms_bucket{le="+Inf"}'] == 2.0
+    assert got["lat_ms_count"] == 2.0
+    assert got["lat_ms_sum"] == pytest.approx(20.5)
+
+
+def test_summary_table():
+    r = Registry()
+    _populate(r)
+    t = obs.summary_table(r)
+    assert "comm_calls" in t and "ring_1d" in t and "lat_ms" in t
+    assert obs.summary_table(Registry()).startswith("(no metrics")
+
+
+# ---------------------------------------------------------------------------
+# enable gating
+
+
+def test_disabled_is_noop():
+    obs.enable(False)
+    obs.REGISTRY.reset()
+    obs.tracing.clear()
+    try:
+        obs.record_collective("ag", payload_bytes=1, wire_bytes=1, chunks=1,
+                              method="m")
+        obs.observe_timer("t", 1.0)
+        with obs.span("s", "step"):
+            pass
+        assert obs.REGISTRY.snapshot() == []
+        assert obs.tracing.events() == []
+    finally:
+        obs.enable(None)  # restore the env-derived default
+
+
+def test_env_flag(monkeypatch):
+    monkeypatch.setenv("TDT_OBS", "1")
+    assert obs.enable(None) is True
+    monkeypatch.setenv("TDT_OBS", "0")
+    assert obs.enable(None) is False
+    monkeypatch.delenv("TDT_OBS", raising=False)
+    obs.enable(None)
+
+
+# ---------------------------------------------------------------------------
+# tracing + overlap report
+
+
+def test_span_records_chrome_events(obs_on, tmp_path):
+    with obs.span("decode_step", "step", idx=0):
+        with obs.span("mlp", "compute"):
+            pass
+    evs = obs.tracing.events()
+    assert [e["name"] for e in evs] == ["mlp", "decode_step"]  # exit order
+    step = evs[1]
+    assert step["ph"] == "X" and step["cat"] == "step"
+    assert step["args"] == {"idx": 0}
+    p = obs.tracing.export(str(tmp_path / "t.json"), clear_buffer=True)
+    assert obs.tracing.events() == []
+    trace = json.load(open(p))
+    assert list(trace.keys()) == ["displayTimeUnit", "traceEvents"]
+    assert len(trace["traceEvents"]) == 2
+
+
+def test_overlap_report_two_rank_merge(obs_on, tmp_path):
+    """Two per-process span exports merged into one timeline produce the
+    per-step overlap table (the 2-process decode workflow, simulated by
+    exporting the buffer twice and merging under two rank offsets)."""
+    from triton_distributed_tpu.tools.trace_merge import merge_traces
+
+    with obs.span("decode_step", "step"):
+        with obs.span("mlp", "compute"):
+            with obs.span("all_gather", "comm"):
+                pass  # comm fully inside compute -> overlap 1.0
+    r0 = obs.tracing.export(str(tmp_path / "r0.json"), clear_buffer=True)
+    with obs.span("decode_step", "step"):
+        with obs.span("all_reduce", "comm"):
+            pass  # comm with no compute -> overlap 0.0
+    r1 = obs.tracing.export(str(tmp_path / "r1.json"), clear_buffer=True)
+
+    merged = str(tmp_path / "merged.json")
+    merge_traces([r0, r1], [0, 1], merged)
+    rows = report.overlap_report(report.load_trace(merged))
+    assert [r["rank"] for r in rows] == [0, 1]
+    assert rows[0]["overlap"] == pytest.approx(1.0)
+    assert rows[1]["overlap"] == pytest.approx(0.0)
+    agg = report.aggregate(rows)
+    assert agg["steps_with_comm"] == 2
+    assert agg["mean_overlap"] == pytest.approx(0.5)
+    table = report.format_report(rows)
+    assert "overlap" in table and "mean overlap: 0.500" in table
+
+
+def test_obs_report_cli_selftest():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         "--selftest"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "selftest OK" in proc.stdout
+    assert "decode_step" in proc.stdout
+
+
+def test_obs_report_cli_on_files(obs_on, tmp_path):
+    with obs.span("decode_step", "step"):
+        with obs.span("all_gather", "comm"):
+            pass
+    r0 = obs.tracing.export(str(tmp_path / "r0.json"), clear_buffer=True)
+    out_json = str(tmp_path / "rep.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         r0, "--json", out_json],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    rep = json.load(open(out_json))
+    assert rep["aggregate"]["steps"] == 1
+    assert rep["rows"][0]["overlap"] == pytest.approx(0.0)
+
+
+# ---------------------------------------------------------------------------
+# instrumentation hooks
+
+
+def test_timer_and_perf_func_route_into_registry(obs_on, capsys):
+    from triton_distributed_tpu.core.utils import perf_func, timer
+
+    with timer("unit_block"):
+        pass
+    assert "unit_block" in capsys.readouterr().out  # print behavior kept
+    _, ms = perf_func(lambda: jnp.zeros((8,)), iters=1, warmup_iters=1,
+                      name="zeros")
+    assert ms > 0
+    h = obs.REGISTRY.histogram("timer_ms", name="unit_block")
+    assert h.count == 1
+    h2 = obs.REGISTRY.histogram("timer_ms", name="perf_func/zeros")
+    assert h2.count == 1 and h2.sum == pytest.approx(ms)
+
+
+def test_record_collective_metrics(obs_on):
+    obs.record_collective("all_gather", payload_bytes=1024, wire_bytes=7168,
+                          chunks=7, method="ring_1d")
+    obs.record_collective("all_gather", payload_bytes=1024, wire_bytes=7168,
+                          chunks=7, method="ring_1d")
+    c = obs.REGISTRY.counter("comm_calls", op="all_gather", method="ring_1d")
+    assert c.value == 2
+    assert obs.REGISTRY.counter("comm_wire_bytes", op="all_gather",
+                                method="ring_1d").value == 2 * 7168
+    assert obs.REGISTRY.histogram("comm_payload_bytes_hist",
+                                  op="all_gather").count == 2
+
+
+def test_all_gather_entry_instrumented(obs_on, mesh8):
+    """The eager all_gather entry records bytes/chunks/method and a comm
+    span before dispatching the kernel."""
+    from triton_distributed_tpu.comm import AllGatherMethod, all_gather
+    from triton_distributed_tpu.core.mesh import TP_AXIS, shard
+    from triton_distributed_tpu.core.utils import rand_tensor
+
+    x = rand_tensor((16, 128), jnp.float32)
+    xs = shard(mesh8, x, TP_AXIS)
+    try:
+        all_gather(xs, mesh8, TP_AXIS, method=AllGatherMethod.RING_1D)
+    except AttributeError:
+        # the kernel layer needs newer jax APIs (shard_map /
+        # pltpu.CompilerParams); the instrumentation at the entry point
+        # must still have recorded before dispatch, which is what the
+        # asserts below check either way
+        pass
+    shard_bytes = (16 // 8) * 128 * 4
+    assert obs.REGISTRY.counter("comm_calls", op="all_gather",
+                                method="ring_1d").value == 1
+    assert obs.REGISTRY.counter("comm_payload_bytes", op="all_gather",
+                                method="ring_1d").value == shard_bytes
+    assert obs.REGISTRY.counter("comm_wire_bytes", op="all_gather",
+                                method="ring_1d").value == shard_bytes * 7
+    assert obs.REGISTRY.counter("comm_chunks", op="all_gather",
+                                method="ring_1d").value == 7
+
+
+def test_autotuner_records_search_metrics(obs_on, tmp_path):
+    from triton_distributed_tpu.tune.autotuner import Autotuner
+
+    t = Autotuner(path=str(tmp_path / "cache.json"))
+    f1 = jax.jit(lambda: jnp.zeros((32, 32)) + 1)
+    f2 = jax.jit(lambda: jnp.zeros((32, 32)) + 2)
+    mk = lambda c: f1 if c == "a" else f2  # noqa: E731
+    t.tune("unit_op", ("k",), ["a", "b"], mk, iters=1)
+    t.tune("unit_op", ("k",), ["a", "b"], mk, iters=1)  # mem-cache hit
+    r = obs.REGISTRY
+    assert r.counter("autotune_searches", name="unit_op").value == 1
+    assert r.counter("autotune_candidates_tried", name="unit_op").value == 2
+    assert r.counter("autotune_cache_hits", name="unit_op",
+                     source="mem").value == 1
+    assert r.gauge("autotune_last_search_s", name="unit_op").value > 0
+    assert r.histogram("autotune_winner_ms", name="unit_op").count == 1
+    # the sweep also dropped a timeline marker
+    assert any(e["name"] == "autotune" for e in obs.tracing.events())
+
+
+def test_engine_serve_metrics_recorded(obs_on):
+    """The serve-loop recorder lands latency histograms + occupancy
+    gauges (exercised directly; the full engine needs the TPU-interpret
+    stack)."""
+    from triton_distributed_tpu.models.engine import Engine
+
+    eng = types.SimpleNamespace(
+        batch=2,
+        model=types.SimpleNamespace(
+            config=types.SimpleNamespace(max_length=64)),
+    )
+    stats = {"prefill_ms": 12.0, "decode_ms_per_token": 3.0,
+             "decode_tokens_per_s": 666.0}
+    Engine._record_serve_metrics(eng, 8, 16, stats)
+    r = obs.REGISTRY
+    assert r.histogram("engine_prefill_ms").count == 1
+    assert r.histogram("engine_decode_ms_per_token").sum == pytest.approx(3.0)
+    assert r.gauge("engine_decode_tokens_per_s").value == 666.0
+    assert r.counter("engine_tokens_generated").value == 2 * 16
+    assert r.gauge("kv_cache_seq_occupancy").value == pytest.approx(24 / 64)
+
+
+def test_disabled_overhead_smoke(obs_on):
+    """The disabled fast path must stay allocation-free and near-free:
+    span() returns the shared null context and record_collective returns
+    before touching the registry (the < 1% bench.py acceptance bar rides
+    on this shape, not on a timing assert that would flake in CI)."""
+    obs.enable(False)
+    s1 = obs.span("x", "step")
+    s2 = obs.span("y", "comm")
+    assert s1 is s2  # the one shared nullcontext: no per-call allocation
+    import timeit
+
+    t_obs = timeit.timeit(lambda: obs.span("x", "step"), number=10_000)
+    assert t_obs < 0.5  # ~50 us/call ceiling: catches accidental work only
+
+
+def test_suppress_blocks_recording(obs_on):
+    with obs.suppress():
+        assert not obs.enabled()
+        obs.record_collective("ghost", payload_bytes=1, wire_bytes=1,
+                              chunks=1, method="m")
+        obs.observe_timer("ghost", 1.0)
+        with obs.span("ghost", "step"):
+            pass
+    assert obs.enabled()
+    assert obs.REGISTRY.snapshot() == []
+    assert obs.tracing.events() == []
+
+
+def test_autotune_sweep_traffic_is_suppressed(obs_on, tmp_path):
+    """Measurement thunks re-enter instrumented entry points hundreds of
+    times; none of that may count as real comm traffic (only the
+    autotuner's own search metrics land)."""
+    from triton_distributed_tpu.tune.autotuner import Autotuner
+
+    def make_thunk(cand):
+        def thunk():
+            # stands in for an instrumented comm entry point the sweep
+            # would re-invoke (e.g. all_gather in the ag_method sweep)
+            obs.record_collective("all_gather", payload_bytes=1024,
+                                  wire_bytes=1024, chunks=1, method=cand)
+            with obs.span("all_gather", "comm"):
+                return jnp.zeros((8,))
+        return thunk
+
+    t = Autotuner(path=str(tmp_path / "cache.json"))
+    t.tune("sweep_op", ("k",), ["a", "b"], make_thunk, iters=1)
+    rows = obs.REGISTRY.snapshot()
+    assert not any(r["name"].startswith("comm_") for r in rows), rows
+    assert not any(e.get("cat") == "comm" for e in obs.tracing.events())
+    assert obs.REGISTRY.counter("autotune_searches", name="sweep_op").value == 1
+
+
+def test_prometheus_large_counter_exact():
+    """Large byte counters must survive the exposition exactly (%g's 6
+    significant digits silently truncated them)."""
+    r = Registry()
+    r.counter("comm_payload_bytes", op="ag").inc(123_456_789)
+    r.gauge("big").set(987_654_321.0)
+    got = obs.parse_prometheus(obs.to_prometheus(r))
+    assert got['comm_payload_bytes_total{op="ag"}'] == 123_456_789.0
+    assert got["big"] == 987_654_321.0

@@ -192,3 +192,97 @@ def test_overlap_kernels_structure_and_math():
     assert hidden_pct(1.6, 0.6, 1.0) == 0.0     # fused == sum: serialized
     assert hidden_pct(2.0, 0.6, 1.0) == 0.0     # noise below zero: clamped
     assert hidden_pct(0.9, 0.6, 1.0) == 1.0     # noise above one: clamped
+
+
+def test_merge_traces_native_python_byte_identical(tmp_path):
+    """On compact inputs with ``traceEvents`` last — the layout
+    ``obs.tracing.export`` writes — the native and pure-Python mergers
+    must produce BYTE-identical (non-gz) output: the native path splices
+    input text, the Python path re-serializes compactly, and any drift
+    between them would silently fork the merged-trace format."""
+    import json
+
+    from triton_distributed_tpu.tools import trace_merge
+    from triton_distributed_tpu.tools.trace_merge import merge_traces
+
+    if not trace_merge._load_native():
+        pytest.skip("no C++ toolchain: native merger unavailable")
+
+    paths = []
+    for r in range(2):
+        events = [
+            {"name": f"op{r}_{i}", "cat": "comm", "ph": "X", "pid": 3,
+             "tid": r, "ts": 10 * i, "dur": 5,
+             "args": {"note": 'tricky "quoted] text', "pid": 9}}
+            for i in range(r + 2)
+        ]
+        p = str(tmp_path / f"rank{r}.json")
+        with open(p, "w") as f:
+            # compact, traceEvents last — obs.tracing.export's layout
+            f.write('{"displayTimeUnit":"ms","traceEvents":'
+                    + json.dumps(events, separators=(",", ":")) + "}")
+        paths.append(p)
+
+    out_n = str(tmp_path / "native.json")
+    out_p = str(tmp_path / "python.json")
+    merge_traces(paths, [0, 1], out_n, native=True)
+    merge_traces(paths, [0, 1], out_p, native=False)
+    a = open(out_n, "rb").read()
+    b = open(out_p, "rb").read()
+    assert a == b
+    merged = json.loads(a)
+    assert len(merged["traceEvents"]) == 5
+    assert sorted({e["pid"] for e in merged["traceEvents"]}) == [3, 1000003]
+
+
+def test_obs_export_merge_byte_identical(tmp_path):
+    """The real producer path: two ``obs.tracing.export`` files merge
+    byte-identically through both merger backends."""
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.tools import trace_merge
+    from triton_distributed_tpu.tools.trace_merge import merge_traces
+
+    if not trace_merge._load_native():
+        pytest.skip("no C++ toolchain: native merger unavailable")
+
+    prev = obs.enabled()
+    obs.enable(True)
+    obs.tracing.clear()
+    try:
+        paths = []
+        for r in range(2):
+            with obs.span("decode_step", "step", rank=r):
+                pass
+            paths.append(obs.tracing.export(str(tmp_path / f"r{r}.json"),
+                                            clear_buffer=True))
+    finally:
+        obs.enable(prev)
+    out_n = str(tmp_path / "native.json")
+    out_p = str(tmp_path / "python.json")
+    merge_traces(paths, [0, 1], out_n, native=True)
+    merge_traces(paths, [0, 1], out_p, native=False)
+    assert open(out_n, "rb").read() == open(out_p, "rb").read()
+
+
+def test_group_profile_single_process_path(tmp_path):
+    """Single-process: flat ``logdir/name`` (no proc subdir)."""
+    import os
+
+    with group_profile("sp", str(tmp_path)) as path:
+        jnp.zeros((4,)).block_until_ready()
+    assert path == os.path.join(str(tmp_path), "sp")
+    assert "proc" not in os.path.basename(path)
+
+
+def test_group_profile_multi_process_path(tmp_path, monkeypatch):
+    """Multi-process: rank-disambiguated ``logdir/name/procN`` subdirs so
+    per-host captures on a shared filesystem never clobber each other
+    (the docstring's promise; previously the rank was dropped)."""
+    import os
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    with group_profile("mp", str(tmp_path)) as path:
+        jnp.zeros((4,)).block_until_ready()
+    assert path == os.path.join(str(tmp_path), "mp", "proc1")
+    assert os.path.isdir(path)

@@ -20,6 +20,8 @@ Layer map (vs SURVEY.md section 1):
 - ``parallel`` shard_map/pjit conventions and sharding rules
 - ``tune``     contextual autotuner
 - ``tools``    profiling, AOT serialization, perf (SOL) models
+- ``obs``      runtime observability: metrics registry, span tracing,
+               exporters, overlap-efficiency reporting (``TDT_OBS=1``)
 
 (host-side helpers live in ``core.utils``; there is deliberately no
 separate ``utils`` package)
@@ -39,3 +41,4 @@ from .core.mesh import make_mesh, tp_mesh, TP_AXIS, EP_AXIS, SP_AXIS, DP_AXIS, P
 from .core.utils import assert_allclose, dist_print, perf_func, rand_tensor
 from .core.symm import symm_buffer, symm_signal, SymmetricBuffer
 from .layers import TPAttn, TPAttnParams, TPMLP, TPMLPParams, rms_norm
+from . import obs

@@ -242,8 +242,12 @@ def perf_func(
     func: Callable[[], object],
     iters: int = 16,
     warmup_iters: int = 3,
+    *,
+    name: str | None = None,
 ) -> tuple[object, float]:
     """Wall-clock timing of a device thunk, returning (last_output, ms/iter).
+    When observability is on (``TDT_OBS=1``) each call also lands one
+    sample in the ``timer_ms{name="perf_func/<name>"}`` histogram.
 
     Reference ``perf_func`` (``utils.py:269-281``) uses CUDA events; here the
     per-iteration time is the two-point slope between a 1-iteration and a
@@ -260,14 +264,29 @@ def perf_func(
     t1 = min(run(1), run(1))
     t2 = min(run(1 + iters), run(1 + iters))
     dt = max(t2 - t1, 1e-9) / max(iters, 1)
-    return out, dt * 1e3
+    ms = dt * 1e3
+    from .. import obs
+
+    if obs.enabled():
+        # existing benches populate telemetry for free: one histogram
+        # sample per perf_func call, keyed by the caller's name for the
+        # thunk (or the thunk's own name when anonymous)
+        label = name or getattr(func, "__qualname__", None) \
+            or getattr(func, "__name__", "<thunk>")
+        obs.observe_timer(f"perf_func/{label}", ms)
+    return out, ms
 
 
 @contextlib.contextmanager
 def timer(name: str = ""):
     t0 = time.perf_counter()
     yield
-    dist_print(f"{name}: {(time.perf_counter() - t0) * 1e3:.3f} ms", rank=0)
+    ms = (time.perf_counter() - t0) * 1e3
+    dist_print(f"{name}: {ms:.3f} ms", rank=0)
+    from .. import obs
+
+    if obs.enabled():
+        obs.observe_timer(name or "<anonymous>", ms)
 
 
 def process_mean(values) -> list[float]:

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from .. import obs
 from ..core.mesh import TP_AXIS
 from .config import ModelConfig
 from .kv_cache import KVCache, init_cache, init_paged_cache, reset
@@ -121,6 +122,8 @@ class Engine:
 
     def prefill(self, input_ids: jax.Array) -> jax.Array:
         """Run the prompt; returns last-position logits (B, V).
+        With ``TDT_OBS=1`` the call is recorded as a ``prefill`` step span
+        (host wall time of the dispatch; device time is async).
 
         With precompiled buckets (:meth:`precompile` /
         :meth:`load_precompiled`) the prompt is right-padded to the
@@ -134,6 +137,10 @@ class Engine:
             raise ValueError(
                 f"prompt length {plen} exceeds max_length={max_len}"
             )
+        with obs.span("prefill", cat="step", batch=b, prompt_len=plen):
+            return self._prefill_dispatch(input_ids, b, plen)
+
+    def _prefill_dispatch(self, input_ids, b: int, plen: int) -> jax.Array:
         self.cache = reset(self.cache)
         if self._prefill_exec:
             bucket = min(
@@ -181,13 +188,15 @@ class Engine:
         return ex(hit[1], *rest)
 
     def decode_step(self, tokens: jax.Array) -> jax.Array:
-        if self._decode_exec is not None:
-            logits, self.cache = self._call_exec(
-                self._decode_exec, self.params, self.cache, tokens
-            )
+        with obs.span("decode_dispatch", cat="compute"):
+            if self._decode_exec is not None:
+                logits, self.cache = self._call_exec(
+                    self._decode_exec, self.params, self.cache, tokens
+                )
+                return logits
+            logits, self.cache = self._decode(self.params, self.cache,
+                                              tokens)
             return logits
-        logits, self.cache = self._decode(self.params, self.cache, tokens)
-        return logits
 
     # -- bucketed AOT serving ---------------------------------------------
 
@@ -320,9 +329,14 @@ class Engine:
         # warmup/compile both steps outside the timed region (the
         # reference's graph capture happens before its timed replay too);
         # run through the stateful path — the donated cache buffers are
-        # consumed and replaced, and the timed prefill resets the length
-        jax.block_until_ready(self.prefill(input_ids))
-        jax.block_until_ready(self.decode_step(jnp.zeros((b,), jnp.int32)))
+        # consumed and replaced, and the timed prefill resets the length.
+        # Span recording is suppressed: a compile-time warmup is not a
+        # serving step and would land a multi-second outlier in the
+        # overlap report's per-step table
+        with obs.suppress():
+            jax.block_until_ready(self.prefill(input_ids))
+            jax.block_until_ready(
+                self.decode_step(jnp.zeros((b,), jnp.int32)))
 
         t0 = time.perf_counter()
         logits = self.prefill(input_ids)
@@ -337,7 +351,36 @@ class Engine:
             "decode_ms_per_token": (t2 - t1) * 1e3 / decode_steps,
             "decode_tokens_per_s": b * decode_steps / max(t2 - t1, 1e-9),
         }
+        if obs.enabled():
+            self._record_serve_metrics(prompt_len, gen_len, stats)
         return tokens, stats
+
+    def _record_serve_metrics(self, prompt_len: int, gen_len: int,
+                              stats: dict) -> None:
+        """Serve-loop telemetry (``TDT_OBS=1``): latency histograms,
+        throughput gauge, tokens counter, and KV-cache / device-memory
+        occupancy gauges (``docs/observability.md``)."""
+        obs.histogram("engine_prefill_ms").observe(stats["prefill_ms"])
+        obs.histogram("engine_decode_ms_per_token").observe(
+            stats["decode_ms_per_token"])
+        obs.gauge("engine_decode_tokens_per_s").set(
+            stats["decode_tokens_per_s"])
+        obs.counter("engine_tokens_generated").inc(self.batch * gen_len)
+        c = self.model.config
+        # sequence occupancy: how full the (contiguous or paged) cache's
+        # length budget is after this request
+        obs.gauge("kv_cache_seq_occupancy").set(
+            (prompt_len + gen_len) / c.max_length)
+        from ..tools.profile import memory_stats
+
+        for dev, st in memory_stats().items():
+            in_use = st.get("bytes_in_use")
+            limit = st.get("bytes_limit")
+            if in_use is not None:
+                obs.gauge("device_bytes_in_use", device=dev).set(in_use)
+            if in_use and limit:
+                obs.gauge("device_memory_occupancy", device=dev).set(
+                    in_use / limit)
 
     def generate_from_logits(self, logits: jax.Array, gen_len: int,
                              key: jax.Array | None = None) -> jax.Array:
@@ -349,9 +392,13 @@ class Engine:
                            top_p=self.top_p)
         outs.append(tok)
         for i in range(gen_len - 1):
-            step_logits = self.decode_step(tok)
-            key = jax.random.fold_in(key, i)
-            tok = sample_token(step_logits, key, temperature=self.temperature,
-                               top_p=self.top_p)
+            # one "step" span per generated token: the unit the overlap
+            # report (scripts/obs_report.py) groups comm/compute spans by
+            with obs.span("decode_step", cat="step", idx=i):
+                step_logits = self.decode_step(tok)
+                key = jax.random.fold_in(key, i)
+                tok = sample_token(step_logits, key,
+                                   temperature=self.temperature,
+                                   top_p=self.top_p)
             outs.append(tok)
         return jnp.stack(outs, axis=1)

@@ -1,0 +1,185 @@
+"""Runtime observability: metrics registry, span tracing, exporters.
+
+The reference framework proves its overlap claims with one-off profiler
+charts; a production serving system needs the overlap *continuously
+measured* (T3, arxiv 2401.16677: fine-grained tracking of the
+compute/collective interleave is the enabler for overlap optimization).
+This package is that layer:
+
+- ``obs.registry``  counters / gauges / histograms (process-local,
+  thread-safe, zero-dep) — written by the engine, collectives, autotuner
+  and ``core.utils`` timers.
+- ``obs.tracing``   ``span(...)`` wall-time events exporting Chrome-trace
+  JSON that ``tools.trace_merge`` merges across hosts.
+- ``obs.export``    JSONL append, Prometheus text format, summary table.
+- ``obs.report``    the derived overlap-efficiency report
+  (``scripts/obs_report.py``): per-step comm-exposed vs compute time.
+
+Everything is OFF by default and gated by ``TDT_OBS=1`` (or
+:func:`enable`); a disabled call site costs one cached-bool check, so the
+instrumented hot paths (``bench.py`` loops, the serve loop) are unchanged
+when observability is off.  Metric names and conventions are documented
+in ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+from . import export, registry, report, tracing
+from .export import (
+    parse_prometheus,
+    read_jsonl,
+    summary_table,
+    to_prometheus,
+    write_jsonl,
+)
+from .registry import (
+    DEFAULT_BYTES_BUCKETS,
+    DEFAULT_LATENCY_BUCKETS_MS,
+    REGISTRY,
+    Registry,
+)
+from .tracing import instant, span
+
+__all__ = [
+    "DEFAULT_BYTES_BUCKETS", "DEFAULT_LATENCY_BUCKETS_MS", "REGISTRY",
+    "Registry", "comm_call", "counter", "dump_jsonl", "dump_prometheus",
+    "enable", "enabled", "gauge", "histogram", "instant", "observe_timer",
+    "parse_prometheus", "read_jsonl", "record_collective", "span",
+    "summary", "summary_table", "suppress", "suppressed_thunk",
+    "to_prometheus", "write_jsonl",
+]
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("TDT_OBS", "").lower() not in ("", "0", "off",
+                                                         "false", "no")
+
+
+# Cached so the per-call cost at a disabled site is one global load +
+# one function call; re-read the env only through enable(None).
+_ENABLED = _env_enabled()
+
+_tls = threading.local()
+
+
+def _suppressed() -> bool:
+    return getattr(_tls, "depth", 0) > 0
+
+
+def enabled() -> bool:
+    """Whether instrumentation records (``TDT_OBS=1`` or :func:`enable`,
+    and not inside a :func:`suppress` block on this thread)."""
+    return _ENABLED and not _suppressed()
+
+
+def enable(on: bool | None = True) -> bool:
+    """Turn recording on/off at runtime; ``None`` re-reads ``TDT_OBS``.
+    Returns the new state."""
+    global _ENABLED
+    _ENABLED = _env_enabled() if on is None else bool(on)
+    return _ENABLED
+
+
+@contextlib.contextmanager
+def suppress():
+    """Pause recording on this thread.  Used around measurement-only
+    traffic — autotune sweeps re-invoke the instrumented comm entry
+    points hundreds of times per candidate, and ``Engine.serve``'s
+    compile warmup is not a serving step — so counters, spans, and the
+    overlap report describe REAL traffic only."""
+    _tls.depth = getattr(_tls, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _tls.depth -= 1
+
+
+def suppressed_thunk(f):
+    """Wrap a measurement thunk so everything it records is suppressed
+    (``tune.autotuner`` wraps each candidate thunk once; all later timed
+    invocations stay silent)."""
+    def g():
+        with suppress():
+            return f()
+    return g
+
+
+# -- thin registry front-door (the names call sites use) -------------------
+
+def counter(name: str, /, **labels) -> registry.Counter:
+    return REGISTRY.counter(name, **labels)
+
+
+def gauge(name: str, /, **labels) -> registry.Gauge:
+    return REGISTRY.gauge(name, **labels)
+
+
+def histogram(name: str, buckets=DEFAULT_LATENCY_BUCKETS_MS,
+              /, **labels) -> registry.Histogram:
+    return REGISTRY.histogram(name, buckets, **labels)
+
+
+def summary() -> str:
+    """Human-readable table of every recorded metric."""
+    return summary_table(REGISTRY)
+
+
+def dump_jsonl(path: str, *, extra: dict | None = None) -> int:
+    """Append a snapshot of the global registry to ``path`` (JSONL)."""
+    return write_jsonl(REGISTRY, path, extra=extra)
+
+
+def dump_prometheus() -> str:
+    """Prometheus text exposition of the global registry."""
+    return to_prometheus(REGISTRY)
+
+
+# -- shared instrumentation helpers ----------------------------------------
+
+def observe_timer(name: str, ms: float) -> None:
+    """Route a ``core.utils.timer`` / ``perf_func`` measurement into the
+    registry (``timer_ms{name=...}``).  Call sites gate on
+    :func:`enabled` themselves; this also no-ops when disabled so direct
+    callers stay safe."""
+    if not enabled():
+        return
+    REGISTRY.histogram("timer_ms", DEFAULT_LATENCY_BUCKETS_MS,
+                       name=name).observe(ms)
+
+
+def record_collective(op: str, *, payload_bytes: int, wire_bytes: int,
+                      chunks: int, method: str) -> None:
+    """One collective invocation, from the host entry points in ``comm/``.
+
+    ``payload_bytes``: the local input shard; ``wire_bytes``: the
+    per-rank wire estimate for the selected method (the formulas are in
+    ``docs/observability.md``); ``chunks``: ring steps / chunk count.
+    Eager calls only — traced (jit) calls run this Python once at trace
+    time, so the entry points skip recording for tracer inputs.
+    """
+    if not enabled():
+        return
+    REGISTRY.counter("comm_calls", op=op, method=method).inc()
+    REGISTRY.counter("comm_payload_bytes", op=op, method=method).inc(
+        payload_bytes)
+    REGISTRY.counter("comm_wire_bytes", op=op, method=method).inc(wire_bytes)
+    REGISTRY.counter("comm_chunks", op=op, method=method).inc(chunks)
+    REGISTRY.histogram("comm_payload_bytes_hist", DEFAULT_BYTES_BUCKETS,
+                       op=op).observe(payload_bytes)
+
+
+def comm_call(op: str, thunk, *, payload_bytes: int, wire_bytes: int,
+              chunks: int, method: str, ranks: int):
+    """The one shared shape of a comm entry point's instrumentation:
+    record the call's counters, then run ``thunk`` under a ``comm`` span.
+    Call sites gate on :func:`enabled` + non-tracer inputs and compute
+    the per-method byte formulas (``docs/observability.md``)."""
+    record_collective(op, payload_bytes=payload_bytes,
+                      wire_bytes=wire_bytes, chunks=chunks, method=method)
+    with tracing.span(op, "comm", method=method, bytes=payload_bytes,
+                      ranks=ranks):
+        return thunk()

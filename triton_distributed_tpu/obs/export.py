@@ -1,0 +1,161 @@
+"""Metric exporters: JSONL append, Prometheus text format, summary table.
+
+All three render :meth:`obs.registry.Registry.snapshot` rows; none hold
+references into the registry, so exporting is safe while hot paths keep
+recording.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+
+from .registry import bucket_quantile
+
+
+def _num(v) -> str:
+    """Exact float text for exposition values: ``repr`` round-trips every
+    float (what prometheus_client emits), where ``%g``'s 6 significant
+    digits would silently truncate large byte counters."""
+    return repr(float(v))
+
+
+def write_jsonl(registry, path: str, *, extra: dict | None = None) -> int:
+    """Append one JSON line per metric to ``path``; returns the number of
+    lines written.  Every line carries the same ``ts`` (seconds since
+    epoch) so one append is one identifiable snapshot; ``extra`` keys
+    (run id, step, host) are merged into every line."""
+    rows = registry.snapshot()
+    ts = time.time()
+    with open(path, "a") as f:
+        for row in rows:
+            rec = {"ts": ts, **(extra or {}), **row}
+            f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+    return len(rows)
+
+
+def read_jsonl(path: str) -> list[dict]:
+    """Parse a :func:`write_jsonl` file back into rows (all snapshots,
+    oldest first) — the round-trip half used by tests and the report
+    tooling."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _prom_name(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _prom_labels(labels: dict, extra: dict | None = None) -> str:
+    items = {**labels, **(extra or {})}
+    if not items:
+        return ""
+    body = ",".join(
+        f'{_prom_name(str(k))}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
+        for k, v in sorted(items.items())
+    )
+    return "{" + body + "}"
+
+
+def to_prometheus(registry) -> str:
+    """Prometheus text exposition (v0.0.4) of the registry: counters as
+    ``<name>_total``, histograms as cumulative ``_bucket{le=...}`` series
+    plus ``_sum``/``_count`` — scrapeable by a stock Prometheus or
+    inspectable with grep."""
+    lines: list[str] = []
+    seen_types: set[tuple[str, str]] = set()
+    for row in registry.snapshot():
+        kind, labels = row["kind"], row["labels"]
+        if kind == "counter":
+            name = _prom_name(row["name"]) + "_total"
+            if (name, "counter") not in seen_types:
+                seen_types.add((name, "counter"))
+                lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name}{_prom_labels(labels)} {_num(row['value'])}")
+        elif kind == "gauge":
+            name = _prom_name(row["name"])
+            if (name, "gauge") not in seen_types:
+                seen_types.add((name, "gauge"))
+                lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name}{_prom_labels(labels)} {_num(row['value'])}")
+        elif kind == "histogram":
+            name = _prom_name(row["name"])
+            if (name, "histogram") not in seen_types:
+                seen_types.add((name, "histogram"))
+                lines.append(f"# TYPE {name} histogram")
+            for bound, cnt in zip(row["buckets"], row["counts"]):
+                lines.append(
+                    f"{name}_bucket{_prom_labels(labels, {'le': f'{bound:g}'})}"
+                    f" {cnt}"
+                )
+            lines.append(
+                f"{name}_bucket{_prom_labels(labels, {'le': '+Inf'})}"
+                f" {row['count']}"
+            )
+            lines.append(f"{name}_sum{_prom_labels(labels)} {_num(row['sum'])}")
+            lines.append(f"{name}_count{_prom_labels(labels)} {row['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def parse_prometheus(text: str) -> dict[str, float]:
+    """Minimal parser for :func:`to_prometheus` output: maps
+    ``name{labels}`` -> value.  For round-trip tests and quick asserts,
+    not a general scrape client."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        series, _, value = line.rpartition(" ")
+        out[series] = float(value)
+    return out
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v != v or v in (math.inf, -math.inf):
+            return "-"
+        return f"{v:.4g}"
+    return str(v)
+
+
+def summary_table(registry) -> str:
+    """Human-readable aligned table of every metric — the operator view
+    (``TDT_OBS=1 python ... ; print(obs.summary())``)."""
+    rows = registry.snapshot()
+    if not rows:
+        return "(no metrics recorded)\n"
+    table = [("metric", "labels", "kind", "value / mean", "count",
+              "p50", "p99", "max")]
+    for row in rows:
+        labels = ",".join(f"{k}={v}" for k, v in sorted(row["labels"].items()))
+        if row["kind"] == "histogram":
+            cnt = row["count"]
+            mean = row["sum"] / cnt if cnt else 0.0
+            p50 = _quantile_from_row(row, 0.5)
+            p99 = _quantile_from_row(row, 0.99)
+            table.append((row["name"], labels, "hist", _fmt(mean),
+                          str(cnt), _fmt(p50), _fmt(p99), _fmt(row["max"])))
+        else:
+            table.append((row["name"], labels, row["kind"],
+                          _fmt(row["value"]), "-", "-", "-", "-"))
+    widths = [max(len(r[i]) for r in table) for i in range(len(table[0]))]
+    lines = []
+    for i, r in enumerate(table):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines) + "\n"
+
+
+def _quantile_from_row(row: dict, q: float):
+    return bucket_quantile(row["buckets"], row["counts"], row["count"],
+                           row["max"], q)

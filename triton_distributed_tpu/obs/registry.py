@@ -1,0 +1,239 @@
+"""Process-local metrics registry: counters, gauges, histograms.
+
+Reference: the reference framework exposes its runtime state only through
+ad-hoc prints and the torch profiler; T3 (arxiv 2401.16677) argues the
+compute/collective interleave must be *observable* before it is tunable.
+This registry is the zero-dependency substrate every instrumented call
+site writes into: thread-safe, allocation-light, and snapshot-exportable
+(``obs.export``) without stopping the world.
+
+Design constraints:
+
+- **Zero deps**: stdlib only — the serving container must not grow a
+  prometheus_client/opentelemetry wheel for this.
+- **Thread-safe**: one lock per registry guards the metric map; each
+  metric guards its own mutation (collectives and the engine can be
+  driven from multiple host threads).
+- **Fixed histogram buckets**: cumulative bucket counts with boundaries
+  frozen at creation, so the Prometheus text exposition is exact (no
+  client-side rebinning) and two processes' histograms merge by adding
+  counts.
+- **Labels**: small, closed sets only (op name, method name).  Label
+  values become part of the metric identity; unbounded label values
+  (shapes, request ids) belong in spans (``obs.tracing``), not here.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Iterable
+
+# Latency buckets in milliseconds: 50 us .. 10 s, the span from one
+# sub-millisecond collective chunk to a cold-compile prefill.
+DEFAULT_LATENCY_BUCKETS_MS: tuple[float, ...] = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+    100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
+)
+
+# Byte-size buckets: 1 KiB .. 1 GiB in powers of 4 — collective payloads.
+DEFAULT_BYTES_BUCKETS: tuple[float, ...] = tuple(
+    float(1 << s) for s in range(10, 31, 2)
+)
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def bucket_quantile(buckets, counts, count: int, maximum, q: float):
+    """Quantile estimate from cumulative bucket counts: the bound of the
+    first bucket whose count covers ``q``, the observed ``maximum`` for
+    quantiles landing in the +Inf bucket, ``None`` when empty.  Shared by
+    :meth:`Histogram.quantile` and the exporters' summary table."""
+    if not count:
+        return None
+    target = q * count
+    for b, c in zip(buckets, counts):
+        if c >= target:
+            return b
+    return maximum
+
+
+class Counter:
+    """Monotonically increasing float counter."""
+
+    __slots__ = ("name", "labels", "_lock", "_value")
+
+    def __init__(self, name: str, labels: dict):
+        self.name = name
+        self.labels = dict(labels)
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name}: negative increment {n}")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def row(self) -> dict:
+        return {"kind": "counter", "name": self.name, "labels": self.labels,
+                "value": self._value}
+
+
+class Gauge:
+    """Last-write-wins float gauge."""
+
+    __slots__ = ("name", "labels", "_lock", "_value")
+
+    def __init__(self, name: str, labels: dict):
+        self.name = name
+        self.labels = dict(labels)
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def add(self, v: float) -> None:
+        with self._lock:
+            self._value += float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def row(self) -> dict:
+        return {"kind": "gauge", "name": self.name, "labels": self.labels,
+                "value": self._value}
+
+
+class Histogram:
+    """Fixed-boundary histogram with cumulative Prometheus semantics.
+
+    ``counts[i]`` counts observations ``<= buckets[i]``; the implicit
+    final bucket (``+Inf``) is ``count``.  Boundaries are frozen at
+    creation so exported bucket counts from different processes/rounds
+    are directly addable.
+    """
+
+    __slots__ = ("name", "labels", "buckets", "_lock", "_counts",
+                 "_sum", "_count", "_min", "_max")
+
+    def __init__(self, name: str, labels: dict,
+                 buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS_MS):
+        bs = tuple(float(b) for b in buckets)
+        if not bs or list(bs) != sorted(bs):
+            raise ValueError(f"histogram {name}: buckets must be sorted "
+                             f"and non-empty, got {bs}")
+        self.name = name
+        self.labels = dict(labels)
+        self.buckets = bs
+        self._lock = threading.Lock()
+        self._counts = [0] * len(bs)
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            # cumulative: bump every bucket whose bound admits v
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Bucket-boundary quantile estimate (see :func:`bucket_quantile`);
+        0.0 when no observations."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        with self._lock:
+            est = bucket_quantile(self.buckets, self._counts, self._count,
+                                  self._max, q)
+            return 0.0 if est is None else est
+
+    def row(self) -> dict:
+        with self._lock:
+            return {
+                "kind": "histogram", "name": self.name,
+                "labels": self.labels, "buckets": list(self.buckets),
+                "counts": list(self._counts), "sum": self._sum,
+                "count": self._count,
+                "min": self._min if self._count else None,
+                "max": self._max if self._count else None,
+            }
+
+
+class Registry:
+    """Named metric map; ``counter``/``gauge``/``histogram`` are
+    get-or-create (idempotent at a call site in a hot loop)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[tuple, object] = {}
+
+    def _get(self, kind: str, cls, name: str, labels: dict, *args):
+        key = (kind, name, _label_key(labels))
+        m = self._metrics.get(key)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    m = cls(name, labels, *args)
+                    self._metrics[key] = m
+        return m
+
+    def counter(self, name: str, /, **labels) -> Counter:
+        return self._get("counter", Counter, name, labels)
+
+    def gauge(self, name: str, /, **labels) -> Gauge:
+        return self._get("gauge", Gauge, name, labels)
+
+    def histogram(self, name: str,
+                  buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS_MS,
+                  /, **labels) -> Histogram:
+        return self._get("histogram", Histogram, name, labels, buckets)
+
+    def snapshot(self) -> list[dict]:
+        """Point-in-time rows for the exporters, sorted by (name, labels)
+        so diffs and round trips are stable."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+        rows = [m.row() for _, m in metrics]
+        rows.sort(key=lambda r: (r["name"], _label_key(r["labels"])))
+        return rows
+
+    def reset(self) -> None:
+        """Drop every metric (tests and per-capture benches)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+# The process-global registry every instrumented call site writes into.
+REGISTRY = Registry()

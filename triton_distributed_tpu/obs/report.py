@@ -1,0 +1,222 @@
+"""Overlap-efficiency report from (merged) Chrome-trace span files.
+
+The paper's design goal is tile-granular compute–communication overlap;
+this module turns recorded spans into the number that goal is measured
+by.  For every ``cat: "step"`` span (one serving iteration), the comm
+intervals (``cat: "comm"``) inside it are intersected against the
+compute intervals (``cat: "compute"``):
+
+    comm_total   = |union(comm)|
+    comm_exposed = |union(comm) - union(compute)|   (comm not hidden
+                                                     under any compute)
+    overlap      = 1 - comm_exposed / comm_total    (1.0 = fully hidden)
+
+A step with no comm spans reports ``overlap = None`` (nothing to hide —
+excluded from aggregates rather than counted as a free 1.0).  Steps are
+grouped per pid (per process/rank: ``tools.trace_merge`` offsets each
+rank's pids by 1e6, so rank lanes never mix), which also makes the
+arithmetic immune to cross-host clock skew.
+
+Consumed by ``scripts/obs_report.py``; spans come from ``obs.tracing``
+exports, one file per process, merged with ``tools.trace_merge``.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+
+STEP_CAT = "step"
+COMM_CAT = "comm"
+COMPUTE_CAT = "compute"
+
+
+def load_trace(path: str) -> list[dict]:
+    """Events of a Chrome-trace JSON file (``.gz`` transparent)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        trace = json.load(f)
+    if isinstance(trace, list):  # bare event-array form is legal chrome trace
+        return trace
+    return trace.get("traceEvents", [])
+
+
+def _union(intervals: list[tuple[float, float]]) -> list[tuple[float, float]]:
+    """Merge overlapping/touching intervals; result sorted and disjoint."""
+    out: list[list[float]] = []
+    for b, e in sorted(i for i in intervals if i[1] > i[0]):
+        if out and b <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([b, e])
+    return [(b, e) for b, e in out]
+
+
+def _total(intervals: list[tuple[float, float]]) -> float:
+    return sum(e - b for b, e in intervals)
+
+
+def _subtract(a: list[tuple[float, float]],
+              b: list[tuple[float, float]]) -> list[tuple[float, float]]:
+    """``union(a) - union(b)`` as disjoint intervals."""
+    a = _union(a)
+    b = _union(b)
+    out: list[tuple[float, float]] = []
+    j = 0
+    for b0, e0 in a:
+        cur = b0
+        while j < len(b) and b[j][1] <= cur:
+            j += 1
+        k = j
+        while k < len(b) and b[k][0] < e0:
+            bb, be = b[k]
+            if bb > cur:
+                out.append((cur, bb))
+            cur = max(cur, be)
+            if cur >= e0:
+                break
+            k += 1
+        if cur < e0:
+            out.append((cur, e0))
+    return out
+
+
+def _clip(intervals, lo: float, hi: float) -> list[tuple[float, float]]:
+    return [(max(b, lo), min(e, hi)) for b, e in intervals
+            if min(e, hi) > max(b, lo)]
+
+
+def overlap_report(events: list[dict]) -> list[dict]:
+    """Per-step overlap rows from complete (``ph: X``) span events.
+
+    Returns one dict per step span, ordered by (pid, start time):
+    ``pid``, ``rank`` (pid // 1e6 — the trace_merge offset), ``step``
+    (name), ``idx`` (per-pid ordinal), ``t_ms`` (step duration),
+    ``compute_ms``, ``comm_ms``, ``exposed_ms``, ``overlap``.
+    """
+    by_pid: dict[int, dict[str, list]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        cat = ev.get("cat")
+        if cat not in (STEP_CAT, COMM_CAT, COMPUTE_CAT):
+            continue
+        pid = int(ev.get("pid", 0))
+        lane = by_pid.setdefault(pid, {STEP_CAT: [], COMM_CAT: [],
+                                       COMPUTE_CAT: []})
+        ts = float(ev.get("ts", 0.0))
+        dur = float(ev.get("dur", 0.0))
+        lane[cat].append((ts, ts + dur, ev.get("name", "")))
+
+    rows: list[dict] = []
+    for pid in sorted(by_pid):
+        lane = by_pid[pid]
+        comm = [(b, e) for b, e, _ in lane[COMM_CAT]]
+        compute = [(b, e) for b, e, _ in lane[COMPUTE_CAT]]
+        for idx, (b, e, name) in enumerate(sorted(lane[STEP_CAT])):
+            c_in = _clip(comm, b, e)
+            x_in = _clip(compute, b, e)
+            comm_u = _union(c_in)
+            comm_ms = _total(comm_u) / 1e3
+            exposed_ms = _total(_subtract(comm_u, x_in)) / 1e3
+            overlap = (1.0 - exposed_ms / comm_ms) if comm_ms > 0 else None
+            rows.append({
+                "pid": pid, "rank": pid // 1_000_000, "step": name,
+                "idx": idx, "t_ms": (e - b) / 1e3,
+                "compute_ms": _total(_union(x_in)) / 1e3,
+                "comm_ms": comm_ms, "exposed_ms": exposed_ms,
+                "overlap": overlap,
+            })
+    return rows
+
+
+def aggregate(rows: list[dict]) -> dict:
+    """Whole-trace summary: mean/min overlap over steps that had comm,
+    plus total comm-exposed milliseconds (the time overlap failed to
+    hide — the quantity every perf PR should shrink)."""
+    with_comm = [r for r in rows if r["overlap"] is not None]
+    if not with_comm:
+        return {"steps": len(rows), "steps_with_comm": 0,
+                "mean_overlap": None, "min_overlap": None,
+                "exposed_ms_total": 0.0}
+    return {
+        "steps": len(rows),
+        "steps_with_comm": len(with_comm),
+        "mean_overlap": sum(r["overlap"] for r in with_comm) / len(with_comm),
+        "min_overlap": min(r["overlap"] for r in with_comm),
+        "exposed_ms_total": sum(r["exposed_ms"] for r in with_comm),
+    }
+
+
+def format_report(rows: list[dict]) -> str:
+    """The per-step overlap-efficiency table + aggregate footer."""
+    header = ("rank", "step", "idx", "t_ms", "compute_ms", "comm_ms",
+              "exposed_ms", "overlap")
+    table = [header]
+    for r in rows:
+        table.append((
+            str(r["rank"]), r["step"], str(r["idx"]), f"{r['t_ms']:.3f}",
+            f"{r['compute_ms']:.3f}", f"{r['comm_ms']:.3f}",
+            f"{r['exposed_ms']:.3f}",
+            "-" if r["overlap"] is None else f"{r['overlap']:.3f}",
+        ))
+    widths = [max(len(row[i]) for row in table) for i in range(len(header))]
+    lines = []
+    for i, row in enumerate(table):
+        lines.append("  ".join(c.rjust(w) if j != 1 else c.ljust(w)
+                               for j, (c, w) in enumerate(zip(row, widths))))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    agg = aggregate(rows)
+    lines.append("")
+    if agg["steps_with_comm"]:
+        lines.append(
+            f"steps: {agg['steps']} ({agg['steps_with_comm']} with comm)  "
+            f"mean overlap: {agg['mean_overlap']:.3f}  "
+            f"min overlap: {agg['min_overlap']:.3f}  "
+            f"comm exposed total: {agg['exposed_ms_total']:.3f} ms"
+        )
+    else:
+        lines.append(f"steps: {agg['steps']} (none recorded comm spans)")
+    return "\n".join(lines) + "\n"
+
+
+def selftest() -> str:
+    """Canned two-rank span set with known overlap ratios; raises on any
+    mismatch, returns the formatted table (``obs_report.py --selftest``).
+
+    Rank 0 (pid 0): step A's comm [10, 20] fully inside compute [5, 25]
+    -> overlap 1.0; step B's comm [110, 130] half-covered by compute
+    [120, 140] -> overlap 0.5.  Rank 1 (pid 1e6): comm [15, 25] with no
+    compute -> overlap 0.0; a comm-less step -> overlap None.
+    """
+    us = 1000.0  # all canned times in ms for readability
+
+    def ev(name, cat, pid, b_ms, e_ms):
+        return {"name": name, "cat": cat, "ph": "X", "pid": pid, "tid": 0,
+                "ts": b_ms * us, "dur": (e_ms - b_ms) * us}
+
+    events = [
+        ev("decode_step", "step", 0, 0, 30),
+        ev("mlp", "compute", 0, 5, 25),
+        ev("all_gather", "comm", 0, 10, 20),
+        ev("decode_step", "step", 0, 100, 150),
+        ev("mlp", "compute", 0, 120, 140),
+        ev("all_gather", "comm", 0, 110, 130),
+        ev("decode_step", "step", 1_000_000, 0, 40),
+        ev("all_reduce", "comm", 1_000_000, 15, 25),
+        ev("decode_step", "step", 1_000_000, 100, 120),
+    ]
+    rows = overlap_report(events)
+    want = [1.0, 0.5, 0.0, None]
+    got = [r["overlap"] for r in rows]
+    for w, g in zip(want, got):
+        ok = (g is None) if w is None else (g is not None
+                                            and abs(g - w) < 1e-9)
+        if not ok:
+            raise AssertionError(f"selftest overlap mismatch: want {want}, "
+                                 f"got {got}")
+    agg = aggregate(rows)
+    if abs(agg["mean_overlap"] - 0.5) > 1e-9 or agg["steps_with_comm"] != 3:
+        raise AssertionError(f"selftest aggregate mismatch: {agg}")
+    return format_report(rows)

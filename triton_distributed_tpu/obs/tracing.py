@@ -1,0 +1,154 @@
+"""Span-based wall-time tracing with Chrome-trace export.
+
+``span("decode_step", cat="step")`` context managers record host
+wall-time intervals into a process-local bounded buffer; ``export``
+writes the buffer as Chrome-trace JSON that ``tools.trace_merge`` can
+merge across hosts (each process exports its own file; the merger
+offsets pids so the lanes stay disjoint in one timeline).
+
+Categories are the contract the overlap report (``obs.report``) reads:
+
+- ``step``     one serving iteration (``decode_step``, ``prefill``)
+- ``comm``     a collective's host-side interval (eager calls only — a
+               collective traced into a jit program records once, at
+               trace time, and is skipped; see ``obs.record_collective``)
+- ``compute``  a compute interval inside a step
+- anything else is carried through for the timeline but ignored by the
+  overlap arithmetic.
+
+Timebase: ``ts`` is ``time.time_ns() // 1000`` (wall clock, us — so
+per-host traces land in roughly the same epoch when merged) and ``dur``
+is measured with ``perf_counter_ns`` (monotonic).  Cross-host clock skew
+shifts lanes relative to each other but never distorts the per-step
+overlap ratios, which are computed within one pid.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import deque
+
+# Bounded: ~180 bytes/event; 200k events ~= 36 MB worst case.  Oldest
+# events drop first — a long serve loop keeps its most recent window.
+MAX_EVENTS = 200_000
+
+_lock = threading.Lock()
+_events: deque = deque(maxlen=MAX_EVENTS)
+_tids: dict[int, int] = {}
+_pid_cache: list = []
+
+
+def _pid() -> int:
+    """JAX process index when a backend exists, else 0 — lazy so that
+    importing ``obs`` (e.g. from ``scripts/obs_report.py --selftest``)
+    never initializes a device backend."""
+    if not _pid_cache:
+        try:
+            import jax
+
+            _pid_cache.append(int(jax.process_index()))
+        except Exception:
+            _pid_cache.append(0)
+    return _pid_cache[0]
+
+
+def _tid() -> int:
+    ident = threading.get_ident()
+    t = _tids.get(ident)
+    if t is None:
+        with _lock:
+            t = _tids.setdefault(ident, len(_tids))
+    return t
+
+
+class _Span:
+    __slots__ = ("name", "cat", "args", "_t0_wall", "_t0_mono")
+
+    def __init__(self, name: str, cat: str, args: dict):
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self._t0_wall = time.time_ns()
+        self._t0_mono = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        dur_us = (time.perf_counter_ns() - self._t0_mono) / 1e3
+        ev = {
+            "name": self.name, "cat": self.cat, "ph": "X",
+            "ts": self._t0_wall // 1000, "dur": dur_us,
+            "pid": _pid(), "tid": _tid(),
+        }
+        if self.args:
+            ev["args"] = self.args
+        _events.append(ev)
+        return False
+
+
+_NULL = contextlib.nullcontext()
+
+_pkg_cache: list = []
+
+
+def _enabled() -> bool:
+    # read the package's cached flag through a memoized module ref: the
+    # disabled fast path costs one attribute load, not an import lookup
+    # per call (spans sit on the serve loop's per-token path); the
+    # thread-local suppression check only runs once recording is on
+    if not _pkg_cache:
+        import sys
+
+        _pkg_cache.append(sys.modules[__package__])
+    pkg = _pkg_cache[0]
+    return pkg._ENABLED and not pkg._suppressed()
+
+
+def span(name: str, cat: str = "compute", /, **args):
+    """Record a wall-time interval for the enclosed block.  A no-op
+    (shared null context, zero allocation) when observability is off."""
+    if not _enabled():
+        return _NULL
+    return _Span(name, cat, args)
+
+
+def instant(name: str, cat: str = "mark", /, **args) -> None:
+    """Record a zero-duration instant event (``ph: i``)."""
+    if not _enabled():
+        return
+    ev = {"name": name, "cat": cat, "ph": "i", "s": "p",
+          "ts": time.time_ns() // 1000, "pid": _pid(), "tid": _tid()}
+    if args:
+        ev["args"] = args
+    _events.append(ev)
+
+
+def events() -> list[dict]:
+    """Copy of the recorded events (oldest first)."""
+    return list(_events)
+
+
+def clear() -> None:
+    _events.clear()
+
+
+def export(path: str, *, clear_buffer: bool = False) -> str:
+    """Write the buffered spans as Chrome-trace JSON.
+
+    The envelope is compact with ``traceEvents`` LAST — the exact layout
+    under which ``tools.trace_merge``'s native and Python paths produce
+    byte-identical merges — so per-process exports from a multi-host run
+    merge into one timeline with ``merge_traces([...], ranks=[...])``.
+    """
+    evs = list(_events)
+    if clear_buffer:
+        _events.clear()
+    with open(path, "w") as f:
+        f.write('{"displayTimeUnit":"ms","traceEvents":')
+        f.write(json.dumps(evs, separators=(",", ":")))
+        f.write("}")
+    return path

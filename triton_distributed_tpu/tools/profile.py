@@ -24,11 +24,18 @@ import jax
 def group_profile(name: str = "trace", logdir: str = "/tmp/tdt_profile",
                   *, enabled: bool = True):
     """Capture a trace of the enclosed block on every process into a shared
-    logdir (reference ``group_profile``).  View with TensorBoard/XProf."""
+    logdir (reference ``group_profile``).  View with TensorBoard/XProf.
+
+    Multi-process runs write rank-disambiguated subdirs
+    (``logdir/name/procN``) so per-host captures on a shared filesystem
+    never clobber each other's artifacts; single-process runs keep the
+    flat ``logdir/name`` path."""
     if not enabled:
         yield None
         return
     path = os.path.join(logdir, name)
+    if jax.process_count() > 1:
+        path = os.path.join(path, f"proc{jax.process_index()}")
     os.makedirs(path, exist_ok=True)
     with jax.profiler.trace(path):
         yield path

@@ -54,7 +54,12 @@ def _merge_python(inputs: Sequence[str], ranks: Sequence[int],
             events.append(ev)
     envelope = dict(envelope or {})
     envelope["traceEvents"] = events
-    data = json.dumps(envelope).encode()
+    # compact separators: the native path splices the inputs' own JSON
+    # text (joining files with a bare ','), so on compact inputs whose
+    # envelope puts traceEvents last — the layout ``obs.tracing.export``
+    # writes — the two paths produce byte-identical merged output
+    # (tests/test_tools.py pins this)
+    data = json.dumps(envelope, separators=(",", ":")).encode()
     opener = gzip.open if gzip_out else open
     with opener(out_path, "wb") as f:
         f.write(data)

@@ -250,11 +250,16 @@ class Autotuner:
         previously persisted winner removes the stale disk entry either
         way.
         """
+        from .. import obs
+
         ck = _cache_key(name, key, candidates)
         multi = jax.process_count() > 1
         if not fresh:
             with self._lock:
                 if ck in self._mem:
+                    if obs.enabled():
+                        obs.counter("autotune_cache_hits", name=name,
+                                    source="mem").inc()
                     # per-process memory: identical on every rank because
                     # SPMD programs issue the same tune() sequence
                     return TuneResult(candidates[self._mem[ck]],
@@ -268,6 +273,9 @@ class Autotuner:
                     disk = self._load_disk()
                     if ck in disk and disk[ck] < len(candidates):
                         self._mem[ck] = disk[ck]
+                        if obs.enabled():
+                            obs.counter("autotune_cache_hits", name=name,
+                                        source="disk").inc()
                         return TuneResult(candidates[disk[ck]], float("nan"),
                                           True)
         if len(candidates) == 1:
@@ -276,11 +284,21 @@ class Autotuner:
                 self._mem[ck] = 0
             return TuneResult(candidates[0], float("nan"), True)
 
+        import time as _obs_time
+
+        _search_t0 = _obs_time.monotonic()
         # phase 1: compile/validate every candidate (first call builds)
         live: dict[int, Callable[[], Any]] = {}
         for i, cand in enumerate(candidates):
             try:
                 thunk = make_thunk(cand)
+                if obs.enabled():
+                    # measurement thunks re-enter instrumented entry
+                    # points (e.g. the ag_method sweep times all_gather
+                    # itself, hundreds of calls per candidate): silence
+                    # everything they record so comm counters/spans
+                    # describe real traffic, not sweep traffic
+                    thunk = obs.suppressed_thunk(thunk)
                 from ..core.utils import sync
 
                 sync(thunk())
@@ -437,6 +455,18 @@ class Autotuner:
             # any memoized resolution may now be stale (fresh re-tunes
             # overwrite winners); the dict is tiny — drop it wholesale
             self._resolved.clear()
+        if obs.enabled():
+            search_s = _obs_time.monotonic() - _search_t0
+            obs.counter("autotune_searches", name=name).inc()
+            obs.counter("autotune_candidates_tried", name=name).inc(len(live))
+            obs.gauge("autotune_last_search_s", name=name).set(search_s)
+            obs.gauge("autotune_winner_index", name=name).set(best)
+            if times[best] == times[best]:  # finite winner time
+                obs.histogram("autotune_winner_ms", name=name).observe(
+                    times[best])
+            obs.instant("autotune", cat="tune", name=name,
+                        winner=str(candidates[best]), search_s=search_s,
+                        candidates=len(live), fresh=bool(fresh))
         frac = None
         if sol_ms and times[best] > 0 and times[best] == times[best]:
             frac = sol_ms / times[best]

@@ -393,6 +393,10 @@ def bench_decode():
 
 
 _EMIT_FAILED = False
+# metric names the sweep actually printed: the sentinel carries these so
+# the claims gate can distinguish a tail-truncated head line from a
+# crashed bench mode (scripts/check_perf_claims.py completeness check)
+_EMITTED: list = []
 
 
 _CLAIMS_MODULE = None
@@ -461,6 +465,8 @@ def _emit(fn, *args, **kw):
                 # results must survive a crashed retry
                 rec["attempts"] = 2
                 rec["retry_crashed"] = True
+                if rec.get("metric"):
+                    _EMITTED.append(rec["metric"])
                 print(json.dumps(rec), flush=True)
                 raise
             retry["attempts"] = 2
@@ -470,6 +476,8 @@ def _emit(fn, *args, **kw):
             else:
                 rec["attempts"] = 2
                 rec["retry_value"] = retry.get("value")
+        if rec.get("metric"):
+            _EMITTED.append(rec["metric"])
         print(json.dumps(rec), flush=True)
     except Exception:  # keep the remaining modes alive, but fail the run
         _EMIT_FAILED = True
@@ -898,6 +906,9 @@ def main():
             "metric": "bench_sweep_complete",
             "value": 1 if not _EMIT_FAILED else 0,
             "unit": "bool",
+            # survives tail truncation (the sentinel is the LAST line):
+            # lets the gate tell truncated-away head lines from crashes
+            "emitted": _EMITTED,
         }), flush=True)
         if _EMIT_FAILED:
             # partial lines already flushed; the exit code must still

@@ -114,11 +114,14 @@ CLAIMS = {
         "baseline_ceiling": _MXU_CEIL_TFLOPS,
         "ratio_spread": (0.95, 1.30), "since": 4,
     },
-    # tp=1 record: ms/step is chip-state dependent (lower is better) —
-    # value_max is a gross-regression tripwire, the ratio is
-    # definitional parity (accounting-only metric, VERDICT r4 weak #5;
-    # the distributed property in this line is the wire-bytes fields)
-    "qwen_decode_step_b128_tp1_psum_vs_ar": {
+    # ms/step is chip-state dependent (lower is better) — value_max is a
+    # gross-regression tripwire, the ratio is definitional parity at tp=1
+    # (accounting-only metric, VERDICT r4 weak #5; the distributed
+    # property in this line is the wire-bytes fields).  The prefix is
+    # tp-AGNOSTIC (bench.py emits ..._tp{ntp}_...): a multi-chip capture
+    # must satisfy the same claim, not trip a spurious MISSING failure
+    # (ADVICE r5 low #2)
+    "qwen_decode_step_b128_tp": {
         "value_max": 20.0, "ratio_spread": (0.90, 1.35), "since": 4,
     },
     # byte accounting is deterministic: any drift is a payload-format
@@ -265,6 +268,14 @@ def check(root: str) -> int:
     # bench_sweep_complete sentinel (value 0 = some mode crashed).
     # Driver-envelope records with a nonzero rc fail outright —
     # a sweep that died before the sentinel must not pass by absence.
+    #
+    # Driver envelopes keep only the last N bytes of stdout, so a healthy
+    # sweep's HEAD lines can be tail-truncated away (ADVICE r5 medium #1,
+    # the BENCH_r05 false "bench mode crashed").  The sentinel therefore
+    # carries ``emitted``, the list of metric names the sweep actually
+    # printed: a claim whose line was truncated but whose name is in
+    # ``emitted`` is a WARNING (its value went ungated this round), not a
+    # crash; truly absent names still fail hard.
     sentinel = next(
         (r for r in metrics if r["metric"] == "bench_sweep_complete"), None
     )
@@ -279,9 +290,32 @@ def check(root: str) -> int:
                 "bench_sweep_complete=0 — one or more bench modes crashed "
                 "mid-sweep (see the driver log)"
             )
+        emitted = sentinel.get("emitted")
+        # legacy full-sweep envelopes (captured before the sentinel grew
+        # ``emitted``) are tail-truncated BY CONSTRUCTION, and their
+        # sentinel=1 already attests no mode crashed: absence there is
+        # truncation, not a crash.  Only envelopes (rc recorded) qualify —
+        # a raw JSONL record was never truncated, so absence stays hard.
+        legacy_truncated = (emitted is None and rc is not None
+                            and bool(sentinel.get("value")))
         for prefix, claim in CLAIMS.items():
-            if (record_round >= claim.get("since", 0)
-                    and prefix not in seen_prefixes):
+            if (record_round < claim.get("since", 0)
+                    or prefix in seen_prefixes):
+                continue
+            if emitted is not None and any(
+                    str(name).startswith(prefix) for name in emitted):
+                warnings.append(
+                    f"claimed metric {prefix!r} was emitted by the sweep "
+                    f"but tail-truncated from the envelope — its value is "
+                    f"unchecked this round (raise the driver tail budget)"
+                )
+            elif legacy_truncated:
+                warnings.append(
+                    f"claimed metric {prefix!r} absent from the truncated "
+                    f"envelope tail (legacy sentinel without 'emitted'; "
+                    f"sentinel=1 attests the mode ran) — value unchecked"
+                )
+            else:
                 failures.append(
                     f"claimed metric {prefix!r} is MISSING from the record "
                     f"— its bench mode crashed or the metric was renamed"

@@ -113,6 +113,60 @@ def test_missing_claimed_metric_fails_full_records(tmp_path):
     assert cpc.check(str(tmp_path)) == 1
 
 
+def test_decode_claim_prefix_is_tp_agnostic(tmp_path):
+    """bench.py emits qwen_decode_step_b{batch}_tp{ntp}_...: a multi-chip
+    capture (tp>1) must satisfy the same claim rather than trip a
+    spurious MISSING failure (ADVICE r5 low #2)."""
+    for ntp in (1, 4, 8):
+        rec = {"metric": f"qwen_decode_step_b128_tp{ntp}_psum_vs_ar",
+               "value": 5.0, "unit": "ms/step (ar mode)",
+               "vs_baseline": 1.05}
+        (tmp_path / "BENCH_r09.json").write_text(json.dumps(rec) + "\n")
+        assert cpc.check(str(tmp_path)) == 0, ntp
+        rec["value"] = 25.0   # and the value_max claim still binds
+        (tmp_path / "BENCH_r09.json").write_text(json.dumps(rec) + "\n")
+        assert cpc.check(str(tmp_path)) == 1, ntp
+
+
+def test_truncated_but_emitted_metric_warns_not_fails(tmp_path, capsys):
+    """A healthy full-sweep capture whose HEAD lines were tail-truncated by
+    the driver envelope must not read as 'bench mode crashed': the sweep
+    sentinel records every emitted metric name, and a claim present there
+    but absent from the surviving lines is a WARNING (value unchecked),
+    while a name absent from BOTH still fails hard (ADVICE r5 medium #1)."""
+    emitted = [p + "_suffix" for p in cpc.CLAIMS]
+    sentinel = {"metric": "bench_sweep_complete", "value": 1, "unit": "bool",
+                "emitted": emitted}
+    body = _line() + "\n" + json.dumps(sentinel) + "\n"
+    (tmp_path / "BENCH_r09.json").write_text(body)
+    assert cpc.check(str(tmp_path)) == 0
+    out = capsys.readouterr().out
+    assert "tail-truncated" in out and "WARNING" in out
+    # a claim missing from the emitted list too is still a hard failure
+    sentinel["emitted"] = [p + "_suffix" for p in cpc.CLAIMS
+                           if not p.startswith("flash_attn")]
+    body = _line() + "\n" + json.dumps(sentinel) + "\n"
+    (tmp_path / "BENCH_r09.json").write_text(body)
+    assert cpc.check(str(tmp_path)) == 1
+
+
+def test_legacy_truncated_envelope_warns_not_fails(tmp_path, capsys):
+    """Pre-'emitted' full-sweep ENVELOPES (the committed BENCH_r05 shape:
+    rc=0, sentinel=1, head lines truncated) warn instead of reporting a
+    phantom crash; a raw (untruncated) record with the same legacy
+    sentinel still fails hard on absence."""
+    legacy = json.dumps({"metric": "bench_sweep_complete", "value": 1,
+                         "unit": "bool"})
+    body = _line() + "\n" + legacy + "\n"
+    env = {"n": 9, "rc": 0, "tail": body}
+    (tmp_path / "BENCH_r09.json").write_text(json.dumps(env))
+    assert cpc.check(str(tmp_path)) == 0
+    assert "absent from the truncated envelope tail" in \
+        capsys.readouterr().out
+    (tmp_path / "BENCH_r09.json").write_text(body)   # raw: never truncated
+    assert cpc.check(str(tmp_path)) == 1
+
+
 def test_since_round_scopes_old_records(tmp_path):
     """A claim introduced in round N must not fail a round N-1 record."""
     line = _line(value=90.0)

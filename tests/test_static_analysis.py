@@ -1,0 +1,262 @@
+"""tdt.analysis: the static protocol verifier (ISSUE 2).
+
+CPU-only, no interpret mode: kernels are symbolically executed per rank
+through the record mode in ``lang.primitives`` and the composed N-rank
+traces checked for signal balance, deadlock freedom, write-overlap, and
+collective divergence.  The shipped collective kernels must verify clean
+at every rank count; the seeded-bad fixtures must each be flagged with
+the violating semaphore/chunk named.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from triton_distributed_tpu import analysis
+from triton_distributed_tpu.analysis import fixtures
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# shipped kernels: the full registry must verify clean
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_shipped_kernels_clean(n):
+    results = analysis.verify_all(ranks=(n,))
+    assert results, "registry enumerated no kernel cases"
+    bad = {c.name: [str(v) for v in vs] for c, vs in results if vs}
+    assert not bad, bad
+
+
+def test_registry_covers_required_families():
+    """The ISSUE-2 matrix: every kernel builder family in comm/ and ops/."""
+    names = {c.name for c in analysis.all_cases(ranks=(4,))}
+    required = {
+        "allgather/push_1shot", "allgather/ring_1d", "allgather/ring_bidir",
+        "reduce_scatter/ring", "allreduce/one_shot", "allreduce/two_shot",
+        "all_to_all/dispatch", "all_to_all/combine",
+        "ag_gemm/unidir", "ag_gemm/bidir", "gemm_rs/ring", "gemm_ar/ring",
+    }
+    assert required <= names, required - names
+
+
+def test_fori_loop_patch_is_thread_scoped():
+    """While one thread records, OTHER threads must still reach the real
+    jax.lax.fori_loop (the patch dispatches on the thread-local recorder,
+    so TDT_VERIFY verification cannot corrupt concurrent jax tracing)."""
+    import threading
+
+    import jax
+
+    done = {}
+    gate = threading.Barrier(2)
+
+    def other_thread():
+        gate.wait()
+        done["val"] = int(jax.lax.fori_loop(0, 3, lambda i, v: v + i, 0))
+
+    t = threading.Thread(target=other_thread)
+    orig = jax.lax.fori_loop
+    with analysis.recording((("tp", 2),), {"tp": 0}):
+        assert jax.lax.fori_loop is not orig   # patched...
+        t.start()
+        gate.wait()                            # ...while the other runs
+        t.join()
+    assert done["val"] == 3
+    assert jax.lax.fori_loop is orig
+
+
+def test_start_false_rejected_in_record_mode():
+    """An unstarted descriptor has no static issue point: modeling it at
+    creation would credit semaphores for a copy that may never run, so
+    record mode refuses loudly instead of verifying a false CLEAN."""
+    from triton_distributed_tpu.analysis import FakeRef, FakeSem
+    from triton_distributed_tpu.lang import primitives as dl
+
+    with analysis.recording((("tp", 2),), {"tp": 0}):
+        with pytest.raises(NotImplementedError, match="start=False"):
+            dl.remote_copy(FakeRef("x", (4,)), FakeRef("y", (4,)),
+                           FakeSem("s"), FakeSem("r"), 1, start=False)
+        with pytest.raises(NotImplementedError, match="start=False"):
+            dl.local_copy(FakeRef("x", (4,)), FakeRef("y", (4,)),
+                          FakeSem("s"), start=False)
+
+
+def test_record_mode_restores_state():
+    """Recording must leave no trace: the thread-local recorder cleared and
+    jax.lax.fori_loop unpatched, even after a kernel body raises."""
+    import jax
+
+    from triton_distributed_tpu.lang import primitives as dl
+
+    orig_fori = jax.lax.fori_loop
+    with pytest.raises(RuntimeError, match="boom"):
+        with analysis.recording((("tp", 2),), {"tp": 0}):
+            raise RuntimeError("boom")
+    assert dl.active_recorder() is None
+    assert jax.lax.fori_loop is orig_fori
+
+
+# ---------------------------------------------------------------------------
+# seeded-bad protocols: each defect class must be flagged, by name
+
+
+def _violations(case_name, n=4):
+    case = {c.name: c for c in fixtures.fixture_cases(n)}[case_name]
+    return analysis.verify_case(case)
+
+
+def test_missing_notify_flagged_as_signal_imbalance():
+    vs = _violations("fixture/missing_notify")
+    hits = [v for v in vs if v.check == "signal_balance"]
+    assert hits, [str(v) for v in vs]
+    # the message names the violating semaphore and the count mismatch
+    assert any("ready" in v.message and "1" in v.message
+               and "2" in v.message for v in hits)
+
+
+def test_crossed_wait_flagged_as_deadlock_cycle():
+    vs = _violations("fixture/crossed_wait")
+    assert [v.check for v in vs] == ["deadlock"], [str(v) for v in vs]
+    msg = vs[0].message
+    assert "flag" in msg                       # the semaphore
+    assert "wait-for cycle" in msg             # the cycle itself
+
+
+def test_overlapping_destination_flagged_as_write_overlap():
+    vs = _violations("fixture/overlapping_writes")
+    hits = [v for v in vs if v.check == "write_overlap"]
+    assert hits, [str(v) for v in vs]
+    # names the destination buffer + chunk rows
+    assert any("out[0:4" in v.message for v in hits)
+
+
+def test_method_divergence_flagged():
+    vs = _violations("fixture/diverged_method")
+    assert [v.check for v in vs] == ["collective_divergence"]
+    assert "one_shot" in vs[0].message and "two_shot" in vs[0].message
+
+
+def test_fixture_selftest_battery():
+    assert fixtures.run_selftest() == []
+
+
+def test_unacked_slot_reuse_flagged():
+    """The subtle case the vector-clock model exists for: two program-
+    ordered sends into the SAME remote slot are unordered ON THE WIRE;
+    only an ACK credit chain (the ring-RS protocol) orders the landings."""
+    from jax.experimental import pallas as pl  # noqa: F401  (parity w/ kernels)
+
+    from triton_distributed_tpu.analysis import FakeRef, FakeSem, analyze
+    from triton_distributed_tpu.analysis.record import record_kernel
+    from triton_distributed_tpu.lang import primitives as dl
+    from triton_distributed_tpu.lang.primitives import Team
+
+    n = 2
+    team = Team((("tp", n),), "tp")
+
+    def kernel(with_ack):
+        _, right = team.neighbor_ranks()
+        left, _ = team.neighbor_ranks()
+        rid = team.device_id(right)
+        x = FakeRef("x", (4, 8))
+        slot = FakeRef("recv_slot", (4, 8))
+        ss, rs = FakeSem("send_sem"), FakeSem("recv_sem")
+        ack = FakeSem("ack", kind="regular")
+        dl.remote_copy(x, slot, ss, rs, rid)
+        # consume the FIRST arrival and credit its producer before the
+        # second send (the ack chain), or skip the ack entirely
+        dl.wait_recv(slot, rs)
+        dl.notify(ack, team.device_id(left))
+        if with_ack:
+            dl.wait(ack, 1)
+        dl.remote_copy(x, slot, ss, rs, rid)
+        dl.wait_recv(slot, rs)
+        dl.wait_send(x, ss)
+        dl.wait_send(x, ss)
+        if not with_ack:
+            dl.wait(ack, 1)   # keep the credit balance identical
+
+    def run(with_ack):
+        traces, sigs = [], []
+        for r in range(n):
+            rec = record_kernel(lambda: kernel(with_ack), n=n, rank=r)
+            traces.append(rec.events)
+            sigs.append(rec.collapsed_signature())
+        return analyze("unacked", n, traces, sigs, ["v"] * n)
+
+    assert any(v.check == "write_overlap" for v in run(False))
+    assert run(True) == []
+
+
+# ---------------------------------------------------------------------------
+# build hook + obs counters
+
+
+def test_verify_build_hook(monkeypatch):
+    from triton_distributed_tpu.analysis import registry as reg
+    from triton_distributed_tpu.core import compilation
+
+    monkeypatch.delenv("TDT_VERIFY", raising=False)
+    assert not compilation.protocol_verify_enabled()
+    compilation.verify_protocol("allgather", 4)   # off: no-op
+
+    monkeypatch.setenv("TDT_VERIFY", "1")
+    assert compilation.protocol_verify_enabled()
+    monkeypatch.setattr(reg, "_VERIFIED", set())
+    compilation.verify_protocol("allgather", 4)   # clean family passes
+    assert ("allgather", 4) in reg._VERIFIED
+    compilation.verify_protocol("ep_dispatch", 4)  # alias resolves
+    assert ("all_to_all", 4) in reg._VERIFIED
+    compilation.verify_protocol("allgather", 1)   # degenerate mesh: skip
+    with pytest.raises(KeyError, match="unknown kernel family"):
+        compilation.verify_protocol("nonexistent", 4)
+
+
+def test_obs_counters_record_checks_and_violations():
+    from triton_distributed_tpu import obs
+
+    obs.enable(True)
+    obs.REGISTRY.reset()
+    try:
+        analysis.verify_case(analysis.cases_for("gemm_rs", 4)[0])
+        bad = {c.name: c for c in fixtures.fixture_cases(2)}
+        analysis.verify_case(bad["fixture/crossed_wait"])
+        rows = {(r["name"], r["labels"].get("kernel"),
+                 r["labels"].get("check")): r["value"]
+                for r in obs.REGISTRY.snapshot()}
+        assert rows[("verify_checks", "gemm_rs", "deadlock")] == 1
+        assert rows[("verify_violations", "fixture", "deadlock")] >= 1
+        assert ("verify_violations", "gemm_rs", "deadlock") not in rows
+    finally:
+        obs.REGISTRY.reset()
+        obs.enable(None)   # restore the env-driven default
+
+
+# ---------------------------------------------------------------------------
+# the CLI (satellite: tier-1 shells the full lint matrix)
+
+
+def _run_lint(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tdt_lint.py"),
+         *args],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+
+
+def test_cli_full_matrix_clean():
+    res = _run_lint()
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "36 kernel cases" in res.stdout
+    assert "0 violation(s)" in res.stdout
+
+
+def test_cli_selftest():
+    res = _run_lint("--selftest")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "selftest OK" in res.stdout

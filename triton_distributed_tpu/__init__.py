@@ -22,6 +22,10 @@ Layer map (vs SURVEY.md section 1):
 - ``tools``    profiling, AOT serialization, perf (SOL) models
 - ``obs``      runtime observability: metrics registry, span tracing,
                exporters, overlap-efficiency reporting (``TDT_OBS=1``)
+- ``analysis`` static protocol verifier for the collective kernels:
+               signal balance / deadlock freedom / write-overlap /
+               divergence, no hardware or interpret mode needed
+               (``TDT_VERIFY=1`` build gate, ``scripts/tdt_lint.py``)
 
 (host-side helpers live in ``core.utils``; there is deliberately no
 separate ``utils`` package)
@@ -42,3 +46,4 @@ from .core.utils import assert_allclose, dist_print, perf_func, rand_tensor
 from .core.symm import symm_buffer, symm_signal, SymmetricBuffer
 from .layers import TPAttn, TPAttnParams, TPMLP, TPMLPParams, rms_norm
 from . import obs
+from . import analysis

@@ -1,0 +1,45 @@
+"""tdt.analysis: static protocol verification for distributed Pallas kernels.
+
+The reference framework validates its device-side wait/notify/putmem_signal
+protocols only dynamically (compute-sanitizer racecheck, SURVEY.md §5), and
+our interpret-mode stand-in (``core.compilation.enable_race_detection``)
+needs a jax able to run Pallas interpret mode at all.  This package proves
+the protocol properties STATICALLY, on any CPU, by symbolically executing
+each collective kernel's primitive vocabulary per rank (record mode in
+``lang.primitives``) and composing the N-rank traces:
+
+1. signal balance        every wait's expected count is produced
+2. deadlock freedom      the cross-rank wait-for structure is acyclic
+3. write-overlap         no unordered overlapping destination writes
+4. collective divergence all ranks run the same collective program
+
+Entry points:
+
+- ``verify_all()`` / ``verify_case``   the registry matrix (CLI:
+  ``scripts/tdt_lint.py``)
+- ``maybe_verify_build(family, n)``    build-time gate, ``TDT_VERIFY=1``
+- ``fixtures.run_selftest()``          seeded-bad kernels battery
+
+See docs/static_analysis.md for the event model and check semantics.
+"""
+
+from .checks import CHECKS, ProtocolViolationError, Violation, analyze
+from .events import FakeRef, FakeSem, FakeSmem, Region
+from .record import KernelRecorder, record_kernel, recording
+from .registry import (
+    DEFAULT_RANKS,
+    FAMILIES,
+    KernelCase,
+    all_cases,
+    cases_for,
+    maybe_verify_build,
+    verify_all,
+    verify_case,
+)
+
+__all__ = [
+    "CHECKS", "DEFAULT_RANKS", "FAMILIES", "FakeRef", "FakeSem", "FakeSmem",
+    "KernelCase", "KernelRecorder", "ProtocolViolationError", "Region",
+    "Violation", "all_cases", "analyze", "cases_for", "maybe_verify_build",
+    "record_kernel", "recording", "verify_all", "verify_case",
+]

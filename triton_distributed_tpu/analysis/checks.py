@@ -1,0 +1,353 @@
+"""The four protocol checks over composed N-rank traces.
+
+Given one recorded trace per rank (``analysis.record``), the verifier
+proves — for THIS rank count and THIS set of example shapes/counts, which
+for the shipped kernels covers all control flow since their loops are
+static in (rank, n) — four properties the reference framework only ever
+probed dynamically with racecheck runs (SURVEY.md §5):
+
+1. **signal balance** — for every (rank, semaphore): the credits produced
+   by matching notifies / DMA completions targeting that instance equal
+   the credits its waits consume.  A deficit starves a wait (deadlock on
+   hardware); a surplus leaks into the NEXT invocation of the kernel and
+   satisfies a future wait early — the mismatched-signal-count failure
+   class of T3 (arXiv:2401.16677).
+
+2. **deadlock freedom** — the cross-rank wait-for structure admits an
+   execution: a round-robin scheduler advances every rank past its waits;
+   a stall is reported with the blocked waits and the wait-for cycle.
+   Semaphore credits make this schedule-insensitive for the properties
+   checked: sends are asynchronous (credits appear at issue) and a wait
+   only ever consumes credits, so an event enabled once stays enabled —
+   the simulation is a canonical maximal execution, and it stalls iff
+   every interleaving stalls.
+
+3. **write-overlap** — the static analogue of interpret-mode
+   ``detect_races``: no two writes (remote DMA landings, local DMA, or
+   compute outputs) touch overlapping regions of the same rank's buffer
+   without a happens-before edge.  Ordering is tracked with vector
+   clocks; crucially a DMA write is NOT ordered by its issuer's program
+   order — it is "settled" only when a wait consumes its recv credit, so
+   two back-to-back sends into the same remote slot are flagged unless an
+   ACK chain (the ring-RS credit protocol) interposes.
+
+4. **collective divergence** — all ranks must run the same collective
+   program: same kernel variant (the hazard per-host autotune/calibration
+   thresholds can create, ``tools/calibrate.py``) and the same collapsed
+   op-kind signature.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+
+from .events import ComputeEv, CopyEv, NotifyEv, WaitEv, sem_label
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    check: str      # signal_balance | deadlock | write_overlap | collective_divergence
+    kernel: str
+    ranks: int
+    message: str
+
+    def __str__(self):
+        return f"[{self.check}] {self.kernel} @ ranks={self.ranks}: " \
+               f"{self.message}"
+
+
+class ProtocolViolationError(RuntimeError):
+    """Raised by the build-time hook (TDT_VERIFY=1) when a kernel's
+    protocol fails static verification."""
+
+    def __init__(self, violations: list[Violation]):
+        self.violations = violations
+        super().__init__(
+            "static protocol verification failed:\n" +
+            "\n".join(f"  {v}" for v in violations)
+        )
+
+
+# ---------------------------------------------------------------------------
+# vector clocks
+
+
+def _leq(a: tuple[int, ...], b: tuple[int, ...]) -> bool:
+    return all(x <= y for x, y in zip(a, b))
+
+
+def _join(a: tuple[int, ...], b: tuple[int, ...]) -> tuple[int, ...]:
+    return tuple(max(x, y) for x, y in zip(a, b))
+
+
+@dataclasses.dataclass
+class _Credit:
+    amount: int
+    clock: tuple[int, ...]
+    settle_tid: int | None   # transfer settled when this credit is consumed
+
+
+@dataclasses.dataclass
+class _Write:
+    owner: int
+    region: object
+    start: tuple[int, ...]
+    tid: int | None          # None: synchronous (compute) write
+    writer: int
+    what: str
+
+
+def _static_balance(kernel, n, traces) -> list[Violation]:
+    produced: dict[tuple[int, tuple], int] = {}
+    consumed: dict[tuple[int, tuple], int] = {}
+    for r, events in enumerate(traces):
+        for ev in events:
+            if isinstance(ev, NotifyEv):
+                key = (ev.target, ev.sem)
+                produced[key] = produced.get(key, 0) + ev.amount
+            elif isinstance(ev, CopyEv):
+                if ev.send_sem is not None:
+                    key = (r, ev.send_sem)
+                    produced[key] = produced.get(key, 0) + \
+                        ev.src.elements()
+                key = (ev.dst_rank, ev.recv_sem)
+                produced[key] = produced.get(key, 0) + ev.dst.elements()
+            elif isinstance(ev, WaitEv):
+                key = (r, ev.sem)
+                consumed[key] = consumed.get(key, 0) + ev.amount
+    out = []
+    for key in sorted(set(produced) | set(consumed)):
+        p, c = produced.get(key, 0), consumed.get(key, 0)
+        if p != c:
+            rank, sem = key
+            surplus = "leaks into the next invocation" if p > c else \
+                "starves the wait (deadlock on hardware)"
+            out.append(Violation(
+                "signal_balance", kernel, n,
+                f"semaphore {sem_label(sem)} on rank {rank}: signals "
+                f"produced {p} != waited {c} — the surplus/deficit of "
+                f"{abs(p - c)} {surplus}",
+            ))
+    return out
+
+
+def _simulate(kernel, n, traces):
+    """Run the canonical maximal execution.  Returns
+    (violations, writes, settle) — violations non-empty iff deadlocked."""
+    credits: dict[tuple[int, tuple], deque[_Credit]] = {}
+    clocks = [tuple(0 for _ in range(n)) for _ in range(n)]
+    pcs = [0] * n
+    writes: list[_Write] = []
+    settle: dict[int, tuple[int, ...]] = {}
+    next_tid = 0
+
+    def bump(r):
+        c = list(clocks[r])
+        c[r] += 1
+        clocks[r] = tuple(c)
+
+    def add_credit(rank, sem, amount, clock, tid=None):
+        credits.setdefault((rank, sem), deque()).append(
+            _Credit(amount, clock, tid)
+        )
+
+    def available(rank, sem) -> int:
+        return sum(c.amount for c in credits.get((rank, sem), ()))
+
+    def step(r) -> bool:
+        """Try to execute rank r's next event; True on progress."""
+        nonlocal next_tid
+        if pcs[r] >= len(traces[r]):
+            return False
+        ev = traces[r][pcs[r]]
+        if isinstance(ev, WaitEv):
+            if available(r, ev.sem) < ev.amount:
+                return False
+            need = ev.amount
+            q = credits.setdefault((r, ev.sem), deque())
+            while need > 0:
+                c = q[0]
+                take = min(need, c.amount)
+                c.amount -= take
+                need -= take
+                clocks[r] = _join(clocks[r], c.clock)
+                if c.settle_tid is not None:
+                    # the consumer has OBSERVED this transfer's landing:
+                    # anything causally after this wait is ordered after
+                    # the transfer's write
+                    prev = settle.get(c.settle_tid)
+                    settle[c.settle_tid] = clocks[r] if prev is None \
+                        else _join(prev, clocks[r])
+                if c.amount == 0:
+                    q.popleft()
+        elif isinstance(ev, NotifyEv):
+            add_credit(ev.target, ev.sem, ev.amount, clocks[r])
+        elif isinstance(ev, CopyEv):
+            tid = next_tid
+            next_tid += 1
+            if ev.send_sem is not None:
+                add_credit(r, ev.send_sem, ev.src.elements(), clocks[r])
+            add_credit(ev.dst_rank, ev.recv_sem, ev.dst.elements(),
+                       clocks[r], tid=tid)
+            writes.append(_Write(
+                ev.dst_rank, ev.dst, clocks[r], tid, r,
+                "remote_copy" if ev.dst_rank != r else "local_copy",
+            ))
+        elif isinstance(ev, ComputeEv):
+            writes.append(_Write(r, ev.write, clocks[r], None, r,
+                                 f"compute:{ev.kind}"))
+        pcs[r] += 1
+        bump(r)
+        return True
+
+    progress = True
+    while progress:
+        progress = False
+        for r in range(n):
+            while step(r):
+                progress = True
+
+    if all(pcs[r] >= len(traces[r]) for r in range(n)):
+        return [], writes, settle, clocks
+
+    # deadlock: describe each blocked rank and find a wait-for cycle
+    blocked = {}
+    for r in range(n):
+        if pcs[r] < len(traces[r]):
+            ev = traces[r][pcs[r]]
+            blocked[r] = ev
+    def producers_of(rank, sem):
+        """Blocked ranks whose REMAINING events could credit (rank, sem)."""
+        out = set()
+        for p, evp in blocked.items():
+            for ev in traces[p][pcs[p]:]:
+                if isinstance(ev, NotifyEv) and ev.target == rank \
+                        and ev.sem == sem:
+                    out.add(p)
+                elif isinstance(ev, CopyEv) and (
+                    (ev.dst_rank == rank and ev.recv_sem == sem)
+                    or (p == rank and ev.send_sem == sem)
+                ):
+                    out.add(p)
+        return out
+
+    lines = []
+    edges = {}
+    for r, ev in sorted(blocked.items()):
+        if isinstance(ev, WaitEv):
+            lines.append(
+                f"rank {r} blocked at event #{pcs[r]} "
+                f"wait({sem_label(ev.sem)}, need {ev.amount}, "
+                f"have {available(r, ev.sem)})"
+            )
+            edges[r] = producers_of(r, ev.sem)
+        else:  # pragma: no cover - only waits block
+            lines.append(f"rank {r} stuck at event #{pcs[r]}: {ev}")
+            edges[r] = set()
+    cycle = _find_cycle(edges)
+    if cycle:
+        lines.append(
+            "wait-for cycle: " + " -> ".join(f"rank {r}" for r in cycle)
+        )
+    return (
+        [Violation("deadlock", kernel, n, "; ".join(lines))],
+        writes, settle, clocks,
+    )
+
+
+def _find_cycle(edges: dict[int, set[int]]) -> list[int] | None:
+    """A wait-for cycle among blocked ranks (greedy lowest-successor walk;
+    advisory — the deadlock itself is already established)."""
+    for start in sorted(edges):
+        path, node = [start], start
+        for _ in range(len(edges) + 1):
+            nxts = sorted(edges.get(node, ()))
+            if not nxts:
+                break
+            node = nxts[0]
+            if node in path:
+                return path[path.index(node):] + [node]
+            path.append(node)
+    return None
+
+
+def _write_overlap(kernel, n, writes: list[_Write],
+                   settle: dict[int, tuple[int, ...]]) -> list[Violation]:
+    def settled(w: _Write) -> tuple[int, ...] | None:
+        if w.tid is None:
+            # synchronous write: complete at its start clock (program order
+            # on its own rank orders it against later same-rank events)
+            return w.start
+        return settle.get(w.tid)
+
+    out = []
+    by_owner: dict[tuple[int, str], list[_Write]] = {}
+    for w in writes:
+        by_owner.setdefault((w.owner, w.region.buffer), []).append(w)
+    for (owner, _buf), ws in sorted(by_owner.items()):
+        for i in range(len(ws)):
+            for j in range(i + 1, len(ws)):
+                a, b = ws[i], ws[j]
+                if not a.region.overlaps(b.region):
+                    continue
+                sa, sb = settled(a), settled(b)
+                ordered = (sa is not None and _leq(sa, b.start)) or \
+                          (sb is not None and _leq(sb, a.start))
+                if not ordered:
+                    out.append(Violation(
+                        "write_overlap", kernel, n,
+                        f"unordered writes to rank {owner}'s "
+                        f"{a.region.label()} ({a.what} from rank "
+                        f"{a.writer}) and {b.region.label()} ({b.what} "
+                        f"from rank {b.writer}) — no happens-before edge "
+                        f"orders the landings (the static analogue of an "
+                        f"interpret-mode race report)",
+                    ))
+    return out
+
+
+def _divergence(kernel, n, sigs, variants) -> list[Violation]:
+    out = []
+    if len(set(variants)) > 1:
+        out.append(Violation(
+            "collective_divergence", kernel, n,
+            "ranks selected different collective variants: " + ", ".join(
+                f"rank {r}={v}" for r, v in enumerate(variants)
+            ) + " — per-host thresholds (tools/calibrate.py) must resolve "
+            "identically on every process",
+        ))
+        return out
+    base = sigs[0]
+    for r, s in enumerate(sigs[1:], start=1):
+        if s != base:
+            k = next(
+                (i for i, (x, y) in enumerate(zip(base, s)) if x != y),
+                min(len(base), len(s)),
+            )
+            out.append(Violation(
+                "collective_divergence", kernel, n,
+                f"rank 0 and rank {r} issue different collective-op "
+                f"sequences (first divergence at step {k}: "
+                f"{base[k] if k < len(base) else '<end>'} vs "
+                f"{s[k] if k < len(s) else '<end>'})",
+            ))
+            break
+    return out
+
+
+def analyze(kernel: str, n: int, traces, sigs, variants) -> list[Violation]:
+    """Run all four checks over per-rank (events, collapsed signature,
+    variant label) and return every violation found."""
+    out = []
+    out.extend(_divergence(kernel, n, sigs, variants))
+    out.extend(_static_balance(kernel, n, traces))
+    dead, writes, settle, _clocks = _simulate(kernel, n, traces)
+    out.extend(dead)
+    if not dead:
+        out.extend(_write_overlap(kernel, n, writes, settle))
+    return out
+
+
+CHECKS = ("collective_divergence", "signal_balance", "deadlock",
+          "write_overlap")

@@ -1,0 +1,228 @@
+"""The event model of the static protocol verifier.
+
+A distributed Pallas kernel in this framework is, protocol-wise, a
+per-rank sequence of a SMALL vocabulary of effects (``lang/primitives``):
+
+- ``notify``       +inc on a (possibly remote) REGULAR semaphore
+- ``wait``         blocking -value on a local REGULAR semaphore
+- ``remote_copy``  async RDMA: credits the send DMA semaphore locally and
+                   the recv DMA semaphore on the target, and writes a
+                   destination region of a named symmetric buffer there
+- ``local_copy``   async local DMA: credits a local DMA semaphore, writes
+                   a local region
+- ``wait_recv`` /
+  ``wait_send``    blocking consumption of DMA credits, denominated in
+                   ELEMENTS of the shaped ref they are constructed from
+                   (the static analogue of byte-counting DMA semaphores)
+- ``compute``      an emit_pipeline body: reads input regions, writes one
+                   output region (recorded via the ``ops.blocks`` stubs)
+- ``barrier_all`` / ``barrier_neighbors``  expanded to their constituent
+                   signal/wait events against the global barrier semaphore
+
+Record mode (``lang.primitives.active_recorder``) captures these without
+touching jax arrays: refs and semaphores are the symbolic stand-ins below,
+identified by NAME (the symmetric-memory property: every rank owns an
+instance of each named buffer/semaphore, and remote ops address the
+peer's same-named instance by device id).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+def _as_int(x) -> int:
+    """Concretize an index that may be a Python int or an eager jax scalar
+    (kernels do ring arithmetic through ``jax.lax.rem``, which returns
+    0-d arrays even for concrete operands)."""
+    return int(x)
+
+
+# ---------------------------------------------------------------------------
+# regions
+
+
+@dataclasses.dataclass(frozen=True)
+class Region:
+    """A rectangular slice of a named buffer: per-dimension [lo, hi) bounds
+    (every dimension materialized, unindexed dims span the full extent)."""
+
+    buffer: str
+    shape: tuple[int, ...]
+    bounds: tuple[tuple[int, int], ...]
+
+    def elements(self) -> int:
+        n = 1
+        for lo, hi in self.bounds:
+            n *= max(hi - lo, 0)
+        return n
+
+    def overlaps(self, other: "Region") -> bool:
+        if self.buffer != other.buffer:
+            return False
+        return all(
+            a_lo < b_hi and b_lo < a_hi
+            for (a_lo, a_hi), (b_lo, b_hi) in zip(self.bounds, other.bounds)
+        )
+
+    def label(self) -> str:
+        idx = ", ".join(
+            f"{lo}:{hi}" if (lo, hi) != (0, s) else ":"
+            for (lo, hi), s in zip(self.bounds, self.shape)
+        )
+        return f"{self.buffer}[{idx}]"
+
+
+def _interval(idx: Any, size: int) -> tuple[int, int]:
+    """One dimension's [lo, hi) from an index expression: an int (or eager
+    jax scalar), a ``pl.ds``/``pl.Slice`` (duck-typed on .start/.size), or
+    a Python slice."""
+    if isinstance(idx, slice):
+        lo = 0 if idx.start is None else _as_int(idx.start)
+        hi = size if idx.stop is None else _as_int(idx.stop)
+        return lo, hi
+    start = getattr(idx, "start", None)
+    if start is not None and hasattr(idx, "size"):
+        lo = _as_int(start)
+        return lo, lo + _as_int(idx.size)
+    i = _as_int(idx)
+    return i, i + 1
+
+
+# ---------------------------------------------------------------------------
+# symbolic refs / semaphores
+
+
+class _RefIndexer:
+    def __init__(self, ref: "FakeRef"):
+        self._ref = ref
+
+    def __getitem__(self, idx) -> "FakeRef":
+        items = idx if isinstance(idx, tuple) else (idx,)
+        r = self._ref
+        depth = len(r.ivals)
+        if depth + len(items) > len(r.shape):
+            raise IndexError(
+                f"{r.name}: {depth + len(items)} indices on rank-"
+                f"{len(r.shape)} buffer"
+            )
+        new = r.ivals + tuple(
+            _interval(it, r.shape[depth + k]) for k, it in enumerate(items)
+        )
+        return FakeRef(r.name, r.shape, new)
+
+
+class FakeRef:
+    """Symbolic stand-in for a (HBM/ANY) ref inside a recorded kernel:
+    carries only a buffer name, shape, and the interval stack built by
+    ``.at[...]`` indexing.  No data, no jax."""
+
+    def __init__(self, name: str, shape: tuple[int, ...],
+                 ivals: tuple[tuple[int, int], ...] = ()):
+        self.name = name
+        self.shape = tuple(int(s) for s in shape)
+        self.ivals = ivals
+
+    @property
+    def at(self) -> _RefIndexer:
+        return _RefIndexer(self)
+
+    def region(self) -> Region:
+        bounds = self.ivals + tuple(
+            (0, s) for s in self.shape[len(self.ivals):]
+        )
+        return Region(self.name, self.shape, bounds)
+
+    def __repr__(self):
+        return f"FakeRef({self.region().label()})"
+
+
+class FakeSmem(FakeRef):
+    """Scalar-memory ref with concrete example values (the per-peer counts
+    an all-to-all kernel reads to size its chunk loops)."""
+
+    def __init__(self, name: str, values):
+        super().__init__(name, (len(values),))
+        self.values = [int(v) for v in values]
+
+    def __getitem__(self, idx) -> int:
+        return self.values[_as_int(idx)]
+
+
+class _SemIndexer:
+    def __init__(self, sem: "FakeSem"):
+        self._sem = sem
+
+    def __getitem__(self, idx) -> "FakeSem":
+        if self._sem.index is not None:
+            raise IndexError(f"{self._sem.label()}: already indexed")
+        return FakeSem(self._sem.name, self._sem.kind, _as_int(idx))
+
+
+class FakeSem:
+    """Symbolic semaphore (scalar or 1-D array): identity is (name, index).
+    ``kind``: "dma" (credits in elements) or "regular" (credits in counts).
+    """
+
+    def __init__(self, name: str, kind: str = "dma",
+                 index: int | None = None):
+        if kind not in ("dma", "regular"):
+            raise ValueError(f"semaphore kind {kind!r}")
+        self.name = name
+        self.kind = kind
+        self.index = index
+
+    @property
+    def at(self) -> _SemIndexer:
+        return _SemIndexer(self)
+
+    def key(self) -> tuple[str, int | None]:
+        return (self.name, self.index)
+
+    def label(self) -> str:
+        return self.name if self.index is None else \
+            f"{self.name}[{self.index}]"
+
+
+BARRIER_SEM = "<collective_barrier>"
+
+
+def sem_label(key: tuple[str, int | None]) -> str:
+    name, index = key
+    return name if index is None else f"{name}[{index}]"
+
+
+# ---------------------------------------------------------------------------
+# events (one rank's recorded trace is a list of these)
+
+
+@dataclasses.dataclass(frozen=True)
+class NotifyEv:
+    sem: tuple[str, int | None]
+    target: int            # device id whose semaphore instance is credited
+    amount: int
+    kind: str = "regular"  # credit unit: "regular" counts
+
+
+@dataclasses.dataclass(frozen=True)
+class WaitEv:
+    sem: tuple[str, int | None]
+    amount: int
+    unit: str              # "count" (regular) | "elem" (DMA)
+
+
+@dataclasses.dataclass(frozen=True)
+class CopyEv:
+    src: Region
+    dst: Region
+    dst_rank: int          # owner of the destination buffer instance
+    send_sem: tuple[str, int | None] | None   # credited locally (elements of src)
+    recv_sem: tuple[str, int | None]          # credited on dst_rank (elements of dst)
+
+
+@dataclasses.dataclass(frozen=True)
+class ComputeEv:
+    kind: str
+    reads: tuple[Region, ...]
+    write: Region

@@ -1,0 +1,149 @@
+"""Seeded-bad protocol fixtures: known-broken kernels each check must flag.
+
+These are the verifier's own regression battery (``scripts/tdt_lint.py
+--selftest`` and ``tests/test_static_analysis.py``): one minimal kernel
+per defect class, written against the same ``lang.primitives`` vocabulary
+as the shipped collectives, so a verifier change that stops catching a
+class fails loudly.
+"""
+
+from __future__ import annotations
+
+from jax.experimental import pallas as pl
+
+from ..lang import primitives as dl
+from .events import FakeRef, FakeSem
+from .registry import KernelCase, verify_case
+
+
+def _team(n: int):
+    from ..lang.primitives import Team
+
+    return Team((("tp", n),), "tp")
+
+
+# ---------------------------------------------------------------------------
+# the bad kernels
+
+
+def bad_missing_notify_kernel(team, ready):
+    """Signal balance: every rank credits its right neighbor ONCE but waits
+    for TWO arrivals — one notify per semaphore is missing."""
+    me, n = team.rank(), team.size
+    _, right = team.neighbor_ranks()
+    dl.notify(ready, team.device_id(right))
+    dl.wait(ready, 2)
+
+
+def bad_crossed_wait_kernel(team, flag):
+    """Deadlock: every rank WAITS for its right neighbor's signal before
+    SENDING its own — signal counts balance perfectly, but the wait-for
+    graph is one big cycle."""
+    me, n = team.rank(), team.size
+    _, right = team.neighbor_ranks()
+    dl.wait(flag, 1)
+    dl.notify(flag, team.device_id(right))
+
+
+def bad_overlapping_writes_kernel(team, m, x_ref, out_ref, send_sem,
+                                  recv_sem):
+    """Write overlap: every rank pushes its shard into rows [0, m) of BOTH
+    neighbors' output — two unordered remote writes land on the same
+    destination chunk (a miscomputed ring offset would look like this)."""
+    me, n = team.rank(), team.size
+    left, right = team.neighbor_ranks()
+    dst = out_ref.at[pl.ds(0, m)]
+    dl.remote_copy(x_ref, dst, send_sem, recv_sem, team.device_id(left))
+    dl.remote_copy(x_ref, dst, send_sem, recv_sem, team.device_id(right))
+    dl.wait_recv(dst, recv_sem)
+    dl.wait_recv(dst, recv_sem)
+    dl.wait_send(x_ref, send_sem)
+    dl.wait_send(x_ref, send_sem)
+
+
+def diverged_method_kernel(team, sem, *, one_shot: bool):
+    """Collective divergence: the op sequence depends on which method this
+    HOST resolved (the ``tools/calibrate.py`` per-host-threshold hazard) —
+    here rank 0 runs the short protocol and everyone else the long one."""
+    dl.barrier_all(team)
+    if not one_shot:
+        dl.notify(sem)          # local self-credit
+        dl.wait(sem, 1)
+
+
+# ---------------------------------------------------------------------------
+# cases
+
+
+def fixture_cases(n: int = 4) -> list[KernelCase]:
+    team = _team(n)
+    m, r = 4, 8
+
+    def make_missing_notify(rank):
+        return "default", lambda: bad_missing_notify_kernel(
+            team, FakeSem("ready", kind="regular")
+        )
+
+    def make_crossed_wait(rank):
+        return "default", lambda: bad_crossed_wait_kernel(
+            team, FakeSem("flag", kind="regular")
+        )
+
+    def make_overlap(rank):
+        return "default", lambda: bad_overlapping_writes_kernel(
+            team, m, FakeRef("x", (m, r)), FakeRef("out", (n * m, r)),
+            FakeSem("send_sem"), FakeSem("recv_sem"),
+        )
+
+    def make_diverged(rank):
+        method = "one_shot" if rank == 0 else "two_shot"
+        return method, lambda: diverged_method_kernel(
+            team, FakeSem("sem", kind="regular"), one_shot=(rank == 0)
+        )
+
+    return [
+        KernelCase("fixture/missing_notify", "fixture", n,
+                   make_missing_notify),
+        KernelCase("fixture/crossed_wait", "fixture", n, make_crossed_wait),
+        KernelCase("fixture/overlapping_writes", "fixture", n, make_overlap),
+        KernelCase("fixture/diverged_method", "fixture", n, make_diverged),
+    ]
+
+
+# which check each fixture MUST trip (selftest contract); extra findings
+# (a missing notify also deadlocks) are allowed
+EXPECTED = {
+    "fixture/missing_notify": "signal_balance",
+    "fixture/crossed_wait": "deadlock",
+    "fixture/overlapping_writes": "write_overlap",
+    "fixture/diverged_method": "collective_divergence",
+}
+
+
+def run_selftest(n: int = 4) -> list[str]:
+    """Verify every fixture trips its expected check (and that the flagged
+    message names the offending semaphore/chunk).  Returns failure lines;
+    empty means the selftest passed."""
+    problems = []
+    named = {
+        "fixture/missing_notify": "ready",
+        "fixture/crossed_wait": "flag",
+        "fixture/overlapping_writes": "out[0:4",
+    }
+    for case in fixture_cases(n):
+        violations = verify_case(case)
+        want = EXPECTED[case.name]
+        hits = [v for v in violations if v.check == want]
+        if not hits:
+            problems.append(
+                f"{case.name}: expected a {want} violation, got "
+                f"{[v.check for v in violations]}"
+            )
+            continue
+        token = named.get(case.name)
+        if token and not any(token in v.message for v in hits):
+            problems.append(
+                f"{case.name}: {want} message does not name the violating "
+                f"semaphore/chunk ({token!r}): {hits[0].message}"
+            )
+    return problems

@@ -1,0 +1,390 @@
+"""Registry of verifiable kernel builders: every collective kernel body in
+``comm/`` and ``ops/``, bound to symbolic refs/semaphores shaped exactly
+like the real builders' ``scratch_shapes``, across rank counts.
+
+Each :class:`KernelCase` knows how to run ONE rank of one kernel variant
+under record mode; ``verify_case`` records all N ranks, composes the
+traces, and runs the four checks (``analysis.checks``).  Example dims are
+tiny (protocol structure is invariant in them — the kernels' loops are
+static in ``(rank, n)``; the all-to-all chunk counts are data-dependent
+and get a deliberately asymmetric example matrix).
+
+``maybe_verify_build`` is the opt-in build-time hook (``TDT_VERIFY=1``)
+the op builders call before constructing their pallas_call: the family is
+verified once per (family, n) per process and a violation raises
+:class:`~analysis.checks.ProtocolViolationError` instead of building a
+kernel with a broken protocol.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable
+
+from .checks import ProtocolViolationError, Violation, analyze
+from .events import FakeRef, FakeSem, FakeSmem
+from .record import record_kernel
+
+DEFAULT_RANKS = (2, 4, 8)
+
+# families the CLI and the build hook know; collective_id families of the
+# a2a builders map onto the one shared kernel body
+FAMILIES = (
+    "allgather", "reduce_scatter", "allreduce", "all_to_all",
+    "ag_gemm", "gemm_rs", "gemm_ar",
+)
+
+_FAMILY_ALIASES = {"ep_dispatch": "all_to_all", "ep_combine": "all_to_all"}
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelCase:
+    """One verifiable (kernel variant, rank count): ``make(rank)`` returns
+    ``(variant_label, thunk)`` where the thunk runs the kernel body for
+    that rank with fresh symbolic args."""
+
+    name: str
+    family: str
+    n: int
+    make: Callable[[int], tuple[str, Callable[[], None]]]
+
+
+def _team(n: int):
+    from ..lang.primitives import Team
+
+    return Team((("tp", n),), "tp")
+
+
+# ---------------------------------------------------------------------------
+# per-family case builders (arg layouts mirror the real scratch_shapes)
+
+
+def _ag_cases(n: int) -> list[KernelCase]:
+    from ..comm.allgather import _KERNELS as _AG_KERNELS
+
+    m, r = 4, 8
+    team = _team(n)
+
+    def make(kern, two_send):
+        def _make(rank, kern=kern, two_send=two_send):
+            x = FakeRef("x", (m, r))
+            out = FakeRef("out", (n * m, r))
+            local_sem = FakeSem("local_sem")
+            send = FakeSem("send_sems") if two_send else FakeSem("send_sem")
+            recv = FakeSem("recv_sems")
+            return "default", lambda: kern(
+                team, m, x, out, local_sem, send, recv
+            )
+        return _make
+
+    return [
+        KernelCase(f"allgather/{meth.value}", "allgather", n,
+                   make(kern, two_send))
+        for meth, (kern, two_send) in _AG_KERNELS.items()
+    ]
+
+
+def _rs_cases(n: int) -> list[KernelCase]:
+    from ..comm.reduce_scatter import ReduceScatterConfig, _rs_ring_kernel
+
+    m_loc, r = 4, 8
+    team = _team(n)
+    cfg = ReduceScatterConfig()
+
+    def make(rank):
+        x = FakeRef("x", (n * m_loc, r))
+        out = FakeRef("out", (m_loc, r))
+        recv_buf = FakeRef("recv_buf", (2, m_loc, r))
+        send_buf = FakeRef("send_buf", (2, m_loc, r))
+        send_sems = FakeSem("send_sems")
+        recv_sems = FakeSem("recv_sems")
+        ack_sems = FakeSem("ack_sems", kind="regular")
+        return "ring", lambda: _rs_ring_kernel(
+            team, m_loc, r, cfg, x, out, recv_buf, send_buf,
+            send_sems, recv_sems, ack_sems,
+        )
+
+    return [KernelCase("reduce_scatter/ring", "reduce_scatter", n, make)]
+
+
+def _ar_cases(n: int) -> list[KernelCase]:
+    import jax.numpy as jnp
+
+    from ..comm.allreduce import (
+        AllReduceConfig,
+        _ar_one_shot_kernel,
+        _ar_two_shot_kernel,
+    )
+
+    r = 8
+    team = _team(n)
+    cfg = AllReduceConfig()
+
+    def make_one(rank):
+        m = 4
+        x = FakeRef("x", (m, r))
+        out = FakeRef("out", (m, r))
+        slots = FakeRef("slots", (n, m, r))
+        return "one_shot", lambda: _ar_one_shot_kernel(
+            team, m, r, cfg, jnp.float32, x, out, slots,
+            FakeSem("local_sem"), FakeSem("send_sem"), FakeSem("recv_sems"),
+        )
+
+    def make_two(rank):
+        m_chunk = 2
+        x = FakeRef("x", (n * m_chunk, r))
+        out = FakeRef("out", (n * m_chunk, r))
+        return "two_shot", lambda: _ar_two_shot_kernel(
+            team, m_chunk, r, cfg, jnp.float32, x, out,
+            FakeRef("recv_buf", (2, m_chunk, r)),
+            FakeRef("send_buf", (2, m_chunk, r)),
+            FakeSem("rs_send_sems"), FakeSem("rs_recv_sems"),
+            FakeSem("ack_sems", kind="regular"),
+            FakeSem("ag_send_sem"), FakeSem("ag_recv_sems"),
+        )
+
+    return [
+        KernelCase("allreduce/one_shot", "allreduce", n, make_one),
+        KernelCase("allreduce/two_shot", "allreduce", n, make_two),
+    ]
+
+
+def _a2a_counts(n: int) -> list[list[int]]:
+    """Deliberately asymmetric example split matrix: counts[src][dst] rows
+    from src to dst (includes the self-zone copy the kernel issues)."""
+    return [[(src + 2 * dst) % 3 + 1 for dst in range(n)] for src in range(n)]
+
+
+def _a2a_cases(n: int) -> list[KernelCase]:
+    from ..comm.all_to_all import _a2a_push_kernel
+
+    chunk, h, z = 2, 4, 8
+    team = _team(n)
+    counts = _a2a_counts(n)
+
+    def _offsets(row):
+        offs, acc = [], 0
+        for c in row:
+            offs.append(acc)
+            acc += c
+        return offs
+
+    def make_dispatch(rank):
+        row = counts[rank]
+        expected = [counts[p][rank] for p in range(n)]
+        x = FakeRef("x", (4 * n + chunk, h))
+        out = FakeRef("zones", (n, z, h))
+        return "push", lambda: _a2a_push_kernel(
+            team, chunk, z, h,
+            FakeSmem("counts", row), FakeSmem("offs", _offsets(row)),
+            FakeSmem("expected", expected), x, out,
+            FakeSem("send_sem"), FakeSem("recv_sems"),
+        )
+
+    def make_combine(rank):
+        # roles reversed (comm.all_to_all._build_combine): send each zone
+        # back to its source; zone p's rows start at p*z in the flattened y
+        back = [counts[p][rank] for p in range(n)]     # rows back to p
+        expected = counts[rank]                        # rows p returns me
+        y = FakeRef("y", (n * z, h))
+        out = FakeRef("zones", (n, z, h))
+        return "push", lambda: _a2a_push_kernel(
+            team, chunk, z, h,
+            FakeSmem("counts", back),
+            FakeSmem("offs", [p * z for p in range(n)]),
+            FakeSmem("expected", expected), y, out,
+            FakeSem("send_sem"), FakeSem("recv_sems"),
+        )
+
+    return [
+        KernelCase("all_to_all/dispatch", "all_to_all", n, make_dispatch),
+        KernelCase("all_to_all/combine", "all_to_all", n, make_combine),
+    ]
+
+
+def _ag_gemm_cases(n: int) -> list[KernelCase]:
+    import jax.numpy as jnp
+
+    from ..ops.ag_gemm import (
+        AgGemmConfig,
+        _ag_gemm_bidir_kernel,
+        _ag_gemm_kernel,
+    )
+
+    m_loc, k, n_loc = 4, 8, 4
+    team = _team(n)
+    cfg = AgGemmConfig()
+
+    def make(kern, label, two_send):
+        def _make(rank, kern=kern, label=label, two_send=two_send):
+            a = FakeRef("a", (m_loc, k))
+            b = FakeRef("b", (k, n_loc))
+            ag_ref = FakeRef("ag", (n * m_loc, k))
+            c = FakeRef("c", (n * m_loc, n_loc))
+            acc = FakeRef("acc", (1, 1))
+            send = FakeSem("send_sems") if two_send else FakeSem("send_sem")
+            return label, lambda: kern(
+                team, m_loc, k, n_loc, cfg, jnp.float32, a, b, ag_ref, c,
+                FakeSem("local_sem"), send, FakeSem("recv_sems"), acc,
+            )
+        return _make
+
+    return [
+        KernelCase("ag_gemm/unidir", "ag_gemm", n,
+                   make(_ag_gemm_kernel, "unidir", False)),
+        KernelCase("ag_gemm/bidir", "ag_gemm", n,
+                   make(_ag_gemm_bidir_kernel, "bidir", True)),
+    ]
+
+
+def _gemm_rs_cases(n: int) -> list[KernelCase]:
+    import jax.numpy as jnp
+
+    from ..ops.gemm_rs import GemmRsConfig, _gemm_rs_kernel
+
+    m_loc, k_loc, n_dim = 4, 8, 4
+    team = _team(n)
+    cfg = GemmRsConfig()
+
+    def make(rank):
+        a = FakeRef("a", (n * m_loc, k_loc))
+        b = FakeRef("b", (k_loc, n_dim))
+        out = FakeRef("out", (m_loc, n_dim))
+        return "ring", lambda: _gemm_rs_kernel(
+            team, m_loc, k_loc, n_dim, cfg, jnp.float32, a, b, out,
+            FakeRef("mm_buf", (2, m_loc, n_dim)),
+            FakeRef("recv_buf", (2, m_loc, n_dim)),
+            FakeRef("send_buf", (2, m_loc, n_dim)),
+            FakeSem("send_sems"), FakeSem("recv_sems"),
+            FakeSem("ack_sems", kind="regular"), FakeRef("acc", (1, 1)),
+        )
+
+    return [KernelCase("gemm_rs/ring", "gemm_rs", n, make)]
+
+
+def _gemm_ar_cases(n: int) -> list[KernelCase]:
+    import jax.numpy as jnp
+
+    from ..ops.gemm_ar import GemmArConfig, _gemm_ar_kernel
+
+    m_loc, k_loc, n_dim = 4, 8, 4
+    team = _team(n)
+    cfg = GemmArConfig()
+
+    def make(rank):
+        a = FakeRef("a", (n * m_loc, k_loc))
+        b = FakeRef("b", (k_loc, n_dim))
+        out = FakeRef("out", (n * m_loc, n_dim))
+        return "ring", lambda: _gemm_ar_kernel(
+            team, m_loc, k_loc, n_dim, cfg, jnp.float32, a, b, out,
+            FakeRef("mm_buf", (2, m_loc, n_dim)),
+            FakeRef("recv_buf", (2, m_loc, n_dim)),
+            FakeRef("send_buf", (2, m_loc, n_dim)),
+            FakeSem("send_sems"), FakeSem("recv_sems"),
+            FakeSem("ack_sems", kind="regular"),
+            FakeSem("ag_send_sem"), FakeSem("ag_recv_sems"),
+            FakeRef("acc", (1, 1)),
+        )
+
+    return [KernelCase("gemm_ar/ring", "gemm_ar", n, make)]
+
+
+_FAMILY_CASES = {
+    "allgather": _ag_cases,
+    "reduce_scatter": _rs_cases,
+    "allreduce": _ar_cases,
+    "all_to_all": _a2a_cases,
+    "ag_gemm": _ag_gemm_cases,
+    "gemm_rs": _gemm_rs_cases,
+    "gemm_ar": _gemm_ar_cases,
+}
+
+
+def cases_for(family: str, n: int) -> list[KernelCase]:
+    family = _FAMILY_ALIASES.get(family, family)
+    try:
+        builder = _FAMILY_CASES[family]
+    except KeyError:
+        raise KeyError(
+            f"unknown kernel family {family!r}; register it in "
+            "analysis.registry._FAMILY_CASES"
+        ) from None
+    return builder(n)
+
+
+def all_cases(ranks=DEFAULT_RANKS) -> list[KernelCase]:
+    out = []
+    for n in ranks:
+        for family in FAMILIES:
+            out.extend(cases_for(family, n))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# verification entry points
+
+
+def verify_case(case: KernelCase) -> list[Violation]:
+    """Record all N ranks of one case and run the four checks.  Check and
+    violation totals land in the obs registry when observability is on."""
+    traces, sigs, variants = [], [], []
+    for rank in range(case.n):
+        label, thunk = case.make(rank)
+        rec = record_kernel(thunk, n=case.n, rank=rank)
+        traces.append(rec.events)
+        sigs.append(rec.collapsed_signature())
+        variants.append(label)
+    violations = analyze(case.name, case.n, traces, sigs, variants)
+    from .. import obs
+
+    if obs.enabled():
+        from .checks import CHECKS
+
+        for check in CHECKS:
+            obs.counter("verify_checks", kernel=case.family,
+                        check=check).inc()
+        for v in violations:
+            obs.counter("verify_violations", kernel=case.family,
+                        check=v.check).inc()
+    return violations
+
+
+def verify_all(ranks=DEFAULT_RANKS, *, kernel_filter: str | None = None):
+    """Run the full matrix; returns ``[(case, violations), ...]``."""
+    out = []
+    for case in all_cases(ranks):
+        if kernel_filter and kernel_filter not in case.name:
+            continue
+        out.append((case, verify_case(case)))
+    return out
+
+
+# one verification per (family, n) per process: builders are themselves
+# cached, but the flat entry points re-invoke them per shape class
+_VERIFIED: set[tuple[str, int]] = set()
+_VERIFIED_LOCK = threading.Lock()
+
+
+def maybe_verify_build(family: str, n: int) -> None:
+    """Statically verify ``family`` at ``n`` ranks before the kernel is
+    built; raises :class:`ProtocolViolationError` on any violation — a
+    kernel with a broken wait/notify protocol must not reach the compiler.
+
+    The ``TDT_VERIFY`` env gate is owned by its one caller,
+    ``core.compilation.verify_protocol`` (a direct call here verifies
+    unconditionally); degenerate meshes have no protocol to check."""
+    if n < 2:
+        return
+    family = _FAMILY_ALIASES.get(family, family)
+    key = (family, int(n))
+    with _VERIFIED_LOCK:
+        if key in _VERIFIED:
+            return
+    violations = []
+    for case in cases_for(family, n):
+        violations.extend(verify_case(case))
+    if violations:
+        raise ProtocolViolationError(violations)
+    with _VERIFIED_LOCK:
+        _VERIFIED.add(key)

@@ -120,6 +120,7 @@ def _a2a_push_kernel(
 
 def _make_push_call(team: Team, chunk: int, z: int, h: int, n: int,
                     family: str, dtype: jnp.dtype):
+    compilation.verify_protocol(family, n)   # aliases to all_to_all
     kernel = functools.partial(_a2a_push_kernel, team, chunk, z, h)
     return pl.pallas_call(
         kernel,

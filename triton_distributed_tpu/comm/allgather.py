@@ -172,6 +172,7 @@ def _build_ag_call(
     bodies via ``_KERNELS``.)"""
     team = Team.of(mesh, axis)
     n = team.size
+    compilation.verify_protocol("allgather", n)   # TDT_VERIFY=1 static gate
     m_local = shard_shape[0]
     kern, two_send_sems = _KERNELS[method]
     kernel = functools.partial(kern, team, m_local)

@@ -214,6 +214,7 @@ def _build_all_reduce(
 ):
     team = Team.of(mesh, axis)
     n = team.size
+    compilation.verify_protocol("allreduce", n)
     if method == AllReduceMethod.ONE_SHOT:
         kernel = functools.partial(_ar_one_shot_kernel, team, m, r_dim, cfg,
                                    out_dtype)

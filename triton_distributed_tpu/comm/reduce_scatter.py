@@ -123,6 +123,7 @@ def _build_rs_call(
     ``axis`` (used directly by the hierarchical paths here and in
     ``allreduce``)."""
     team = Team.of(mesh, axis)
+    compilation.verify_protocol("reduce_scatter", team.size)
     kernel = functools.partial(_rs_ring_kernel, team, m_loc, r_dim, cfg)
     return pl.pallas_call(
         kernel,

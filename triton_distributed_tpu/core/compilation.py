@@ -72,6 +72,32 @@ def enable_race_detection(on: bool = True) -> None:
     _race_detection["enabled"] = bool(on)
 
 
+def protocol_verify_enabled() -> bool:
+    """Whether the build-time static protocol gate is on (``TDT_VERIFY=1``).
+
+    The second, CPU-only half of the correctness policy next to interpret
+    -mode race detection: when enabled, every collective kernel builder
+    runs the ``tdt.analysis`` verifier (signal balance / deadlock freedom /
+    write-overlap / divergence, docs/static_analysis.md) for its family at
+    its rank count BEFORE constructing the pallas_call, and a violation
+    raises instead of compiling a broken protocol."""
+    from .utils import env_flag
+
+    return env_flag("TDT_VERIFY")
+
+
+def verify_protocol(family: str, num_ranks: int) -> None:
+    """Build-time hook the collective op builders call: no-op unless
+    ``TDT_VERIFY=1`` (one env read + int compare), else delegates to
+    ``analysis.registry.maybe_verify_build`` (memoized per family x ranks;
+    raises ``analysis.ProtocolViolationError`` on violation)."""
+    if num_ranks < 2 or not protocol_verify_enabled():
+        return
+    from ..analysis import maybe_verify_build
+
+    maybe_verify_build(family, num_ranks)
+
+
 def interpret_mode() -> pltpu.InterpretParams | bool:
     """The value to pass as ``pallas_call(..., interpret=...)``.
 

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import os
 import sys
 import time
 from typing import Callable, Iterable
@@ -18,6 +19,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import platform
+
+
+def env_flag(name: str) -> bool:
+    """The one truthy-env-flag convention for feature gates (``TDT_OBS``,
+    ``TDT_VERIFY``): unset/empty/0/off/false/no mean OFF, anything else ON."""
+    return os.environ.get(name, "").lower() not in ("", "0", "off", "false",
+                                                    "no")
 
 
 def rand_tensor(

@@ -38,12 +38,38 @@ fence/quiet         DMA completion semaphores subsume memory fencing
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# ---------------------------------------------------------------------------
+# protocol record mode (tdt.analysis)
+#
+# The static protocol verifier (``triton_distributed_tpu.analysis``) executes
+# kernel BODIES as plain Python with symbolic refs/semaphores, one concrete
+# rank at a time, and needs every primitive below to *describe* its event
+# (semaphore identity, peer id, destination chunk) instead of lowering to
+# Mosaic/interpret machinery.  When a recorder is installed on the current
+# thread, each primitive forwards to it and returns; nothing in jax.pallas
+# is touched (this is what makes the verifier run on a CPU-only container
+# whose jax cannot even build a pallas_call).  See docs/static_analysis.md.
+
+_RECORD_STATE = threading.local()
+
+
+def active_recorder():
+    """The protocol recorder capturing primitive events on this thread, or
+    None (normal operation).  Installed by ``analysis.record.record_kernel``."""
+    return getattr(_RECORD_STATE, "recorder", None)
+
+
+def _set_recorder(rec) -> None:
+    _RECORD_STATE.recorder = rec
+
 
 # ---------------------------------------------------------------------------
 # teams: axis-rank -> logical device id translation
@@ -78,10 +104,21 @@ class Team:
         return dict(self.axes)[self.axis]
 
     def rank(self) -> jax.Array:
+        rec = active_recorder()
+        if rec is not None:
+            return rec.axis_rank(self.axis)
         return jax.lax.axis_index(self.axis)
 
     def device_id(self, peer_rank: jax.Array | int) -> jax.Array | int:
         """Logical device id of the team member with rank ``peer_rank``."""
+        rec = active_recorder()
+        if rec is not None:
+            lid = 0
+            for name, s in self.axes:
+                idx = int(peer_rank) if name == self.axis \
+                    else rec.axis_rank(name)
+                lid = lid * s + idx
+            return lid
         if len(self.axes) == 1:
             return peer_rank
         lid = None
@@ -99,6 +136,9 @@ class Team:
 def _as_team(axis: "str | Team") -> Team:
     if isinstance(axis, Team):
         return axis
+    rec = active_recorder()
+    if rec is not None:
+        return Team(((axis, rec.axis_size(axis)),), axis)
     # Single-axis view: correct when the mesh has only this axis; callers on
     # multi-axis meshes must pass a Team built with Team.of(mesh, axis).
     return Team(((axis, jax.lax.axis_size(axis)),), axis)
@@ -110,11 +150,17 @@ def _as_team(axis: "str | Team") -> Team:
 
 def rank(axis: str) -> jax.Array:
     """This device's index along a mesh axis (reference ``dl.rank``)."""
+    rec = active_recorder()
+    if rec is not None:
+        return rec.axis_rank(axis)
     return jax.lax.axis_index(axis)
 
 
 def num_ranks(axis: str) -> int:
     """Number of devices along a mesh axis (reference ``dl.num_ranks``)."""
+    rec = active_recorder()
+    if rec is not None:
+        return rec.axis_size(axis)
     return jax.lax.axis_size(axis)
 
 
@@ -142,6 +188,10 @@ def notify(
     semantics exist on TPU; protocols written against SET re-encode the
     expected value as an arrival count.
     """
+    rec = active_recorder()
+    if rec is not None:
+        rec.on_notify(sem, device_id, inc)
+        return
     if device_id is None:
         pltpu.semaphore_signal(sem, inc=inc)
     else:
@@ -156,6 +206,10 @@ def notify(
 def wait(sem, value: int | jax.Array = 1) -> None:
     """Block until ``sem >= value``, consuming ``value`` (reference
     ``dl.wait``; spin-wait lowering ``DistributedOpToLLVM.cpp:146-219``)."""
+    rec = active_recorder()
+    if rec is not None:
+        rec.on_wait(sem, value)
+        return
     pltpu.semaphore_wait(sem, value)
 
 
@@ -168,6 +222,11 @@ def peek(sem) -> jax.Array:
     so under interpret mode this raises ``NotImplementedError`` from the
     lowering.  Interpret-mode tests observe counts through exact-valued
     ``wait`` round-trips instead (``tests/test_lang_primitives.py``)."""
+    if active_recorder() is not None:
+        raise NotImplementedError(
+            "tdt.analysis record mode cannot model non-blocking peek: a "
+            "polling protocol has no static wait-for structure to verify"
+        )
     return pltpu.semaphore_read(sem)
 
 
@@ -203,6 +262,10 @@ def remote_copy(
     Returns the descriptor; call ``.wait()`` (or ``wait_send``/``wait_recv``)
     to block.  ``start=False`` returns an unstarted descriptor.
     """
+    rec = active_recorder()
+    if rec is not None:
+        return rec.on_remote_copy(src, dst, send_sem, recv_sem, device_id,
+                                  start=start)
     copy = pltpu.make_async_remote_copy(
         src_ref=src,
         dst_ref=dst,
@@ -219,6 +282,9 @@ def remote_copy(
 def local_copy(src, dst, sem, *, start: bool = True):
     """Async local DMA (HBM<->VMEM) — the reference's cp.async / copy-engine
     path collapses to this on TPU."""
+    rec = active_recorder()
+    if rec is not None:
+        return rec.on_local_copy(src, dst, sem, start=start)
     copy = pltpu.make_async_copy(src, dst, sem)
     if start:
         copy.start()
@@ -234,6 +300,10 @@ def wait_recv(dst_ref, sem) -> None:
     different points in the program (the reference's ``dl.wait`` on ready
     flags / ``signal_wait_until``).
     """
+    rec = active_recorder()
+    if rec is not None:
+        rec.on_wait_recv(dst_ref, sem)
+        return
     pltpu.make_async_copy(dst_ref, dst_ref, sem).wait()
 
 
@@ -241,6 +311,10 @@ def wait_send(src_ref, sem) -> None:
     """Drain one outgoing ``remote_copy`` of ``src_ref``'s shape/size (the
     reference's ``nvshmem_quiet`` per-transfer analogue).  Counting
     semantics: call once per outstanding send of this size."""
+    rec = active_recorder()
+    if rec is not None:
+        rec.on_wait_send(src_ref, sem)
+        return
     pltpu.make_async_copy(src_ref, src_ref, sem).wait()
 
 
@@ -266,6 +340,10 @@ def barrier_all(axis: "str | Team", sem=None) -> None:
     ``collective_id`` in their CompilerParams.
     """
     team = _as_team(axis)
+    rec = active_recorder()
+    if rec is not None:
+        rec.on_barrier_all(team, sem)
+        return
     if sem is None:
         sem = pltpu.get_barrier_semaphore()
     me = team.rank()
@@ -309,6 +387,10 @@ def barrier_neighbors(axis: "str | Team", sem=None) -> None:
     ``collective_prologue`` defaults to it.
     """
     team = _as_team(axis)
+    rec = active_recorder()
+    if rec is not None:
+        rec.on_barrier_neighbors(team, sem)
+        return
     if sem is None:
         sem = pltpu.get_barrier_semaphore()
     if team.size == 1:

@@ -54,6 +54,47 @@ def sample_token(
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def arch_fingerprint(config: ModelConfig, mesh: Mesh, axis: str) -> dict:
+    """JSON-safe identity of (model architecture, mesh topology): the full
+    ModelConfig field dict plus the mesh axis sizes and the TP axis.  Saved
+    in the AOT manifest and compared at load, so a bundle compiled for a
+    DIFFERENT model or topology fails with a clear error instead of an
+    opaque call-time sharding/shape error — or, worse, running when shapes
+    coincide (ADVICE r5 low #4)."""
+    cfg = {}
+    for f in dataclasses.fields(config):
+        v = getattr(config, f.name)
+        cfg[f.name] = str(jnp.dtype(v)) if f.name == "dtype" else v
+    return {
+        "model_config": cfg,
+        "mesh": {str(name): int(mesh.shape[name])
+                 for name in mesh.axis_names},
+        "axis": str(axis),
+    }
+
+
+def check_arch(manifest: dict, have: dict) -> None:
+    """Raise ValueError naming every differing fingerprint field.  Bundles
+    from before the fingerprint was recorded (no ``arch`` key) pass — the
+    coarse batch/vocab/max_length checks still apply to them."""
+    want = manifest.get("arch")
+    if want is None or want == have:
+        return
+    diffs = []
+    w_cfg, h_cfg = want.get("model_config", {}), have.get("model_config", {})
+    for k in sorted(set(w_cfg) | set(h_cfg)):
+        if w_cfg.get(k) != h_cfg.get(k):
+            diffs.append(f"model.{k}: bundle={w_cfg.get(k)!r} "
+                         f"engine={h_cfg.get(k)!r}")
+    for k in ("mesh", "axis"):
+        if want.get(k) != have.get(k):
+            diffs.append(f"{k}: bundle={want.get(k)!r} engine={have.get(k)!r}")
+    raise ValueError(
+        "AOT bundle was compiled for a different model architecture / mesh "
+        "topology: " + "; ".join(diffs or ["<unstructured fingerprint>"])
+    )
+
+
 @dataclasses.dataclass
 class Engine:
     """Owns model definition, params, cache, and the compiled step fns.
@@ -252,6 +293,7 @@ class Engine:
             "vocab": c.vocab,
             "decode_mode": self.model.decode_mode,
             "cache_layout": self.cache_layout,
+            "arch": arch_fingerprint(c, self.model.mesh, self.model.axis),
         }
         if save_dir is not None:
             if compilation.interpret_mode():
@@ -290,6 +332,8 @@ class Engine:
                     f"AOT bundle was compiled for {field}={want!r}; this "
                     f"engine has {field}={have!r}"
                 )
+        check_arch(manifest,
+                   arch_fingerprint(c, self.model.mesh, self.model.axis))
         self._prefill_exec = {
             int(L): aot.load(os.path.join(save_dir, f"prefill_{L}.xla"))
             for L in manifest["buckets"]

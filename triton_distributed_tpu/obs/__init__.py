@@ -25,7 +25,6 @@ in ``docs/observability.md``.
 from __future__ import annotations
 
 import contextlib
-import os
 import threading
 
 from . import export, registry, report, tracing
@@ -55,8 +54,9 @@ __all__ = [
 
 
 def _env_enabled() -> bool:
-    return os.environ.get("TDT_OBS", "").lower() not in ("", "0", "off",
-                                                         "false", "no")
+    from ..core.utils import env_flag
+
+    return env_flag("TDT_OBS")
 
 
 # Cached so the per-call cost at a disabled site is one global load +

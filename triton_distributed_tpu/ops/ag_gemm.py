@@ -177,6 +177,7 @@ def _build_ag_gemm(
 ):
     team = Team.of(mesh, axis)
     n = team.size
+    compilation.verify_protocol("ag_gemm", n)
 
     kern = _ag_gemm_bidir_kernel if bidir else _ag_gemm_kernel
     kernel = functools.partial(

@@ -16,6 +16,28 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+def _protocol_stub(kind: str):
+    """Under tdt.analysis record mode the emit_pipeline bodies must not be
+    built (they touch Mosaic pipeline internals and real refs); the stub
+    records one compute event — reads of every input ref, a write of the
+    output ref — which is all the protocol checks need from local compute.
+    Returns None in normal operation."""
+    from ..lang import primitives as dl
+
+    if dl.active_recorder() is None:
+        return None
+
+    def stub(*refs, scratches=None, allocations=None):
+        rec = dl.active_recorder()
+        if rec is None:
+            raise RuntimeError(
+                "protocol-stub pipeline called outside record mode"
+            )
+        rec.on_compute(kind, refs[:-1], refs[-1])
+
+    return stub
+
+
 def matmul_body(nk: int, out_dtype, a_ref, b_ref, c_ref, acc_ref):
     """Blocked matmul step with f32 accumulation.
 
@@ -48,6 +70,9 @@ def make_matmul_pipeline(m: int, n: int, k: int, bm: int, bn: int, bk: int,
     Call as ``pipe(a_ref, b_ref, c_ref, scratches=[acc_ref])`` with an
     (bm, bn) f32 VMEM accumulator.
     """
+    stub = _protocol_stub("matmul")
+    if stub is not None:
+        return stub
     grid = (m // bm, n // bn, k // bk)
     return pltpu.emit_pipeline(
         functools.partial(matmul_body, grid[2], out_dtype),
@@ -74,6 +99,9 @@ def make_sum_pipeline(num_in: int, m: int, n: int, bm: int, bn: int, out_dtype):
 
     Call as ``pipe(in0, in1, ..., out_ref)``.
     """
+    stub = _protocol_stub("sum")
+    if stub is not None:
+        return stub
     spec = pl.BlockSpec((bm, bn), lambda i, j: (i, j))
     return pltpu.emit_pipeline(
         functools.partial(sum_body, out_dtype),
@@ -85,6 +113,9 @@ def make_sum_pipeline(num_in: int, m: int, n: int, bm: int, bn: int, out_dtype):
 
 def make_add_pipeline(m: int, n: int, bm: int, bn: int):
     """An ``emit_pipeline`` computing O[m,n] = A + B blockwise."""
+    stub = _protocol_stub("add")
+    if stub is not None:
+        return stub
     return pltpu.emit_pipeline(
         add_body,
         grid=(m // bm, n // bn),

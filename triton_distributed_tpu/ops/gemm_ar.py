@@ -151,6 +151,7 @@ def _build_gemm_ar(
 ):
     team = Team.of(mesh, axis)
     n = team.size
+    compilation.verify_protocol("gemm_ar", n)
     kernel = functools.partial(
         _gemm_ar_kernel, team, m_loc, k_loc, n_dim, cfg, out_dtype
     )

@@ -150,6 +150,7 @@ def _build_gemm_rs(
 ):
     team = Team.of(mesh, axis)
     n = team.size
+    compilation.verify_protocol("gemm_rs", n)
     kernel = functools.partial(
         _gemm_rs_kernel, team, m_loc, k_loc, n_dim, cfg, out_dtype
     )

@@ -398,6 +398,57 @@ _EMIT_FAILED = False
 # crashed bench mode (scripts/check_perf_claims.py completeness check)
 _EMITTED: list = []
 
+# on-disk tee of the full `auto` JSONL stream (VERDICT r5 next #1): the
+# driver envelope keeps only the last N bytes of stdout, so head lines
+# can be truncated away; the LOCAL record is complete by construction
+# and the claims gate prefers it over the envelope tail when committed
+_LOCAL_SINK = None
+
+
+def _record_line(line: str) -> None:
+    """Emit one JSONL record line to stdout (the driver captures its
+    tail) AND to the on-disk local record when one is open."""
+    print(line, flush=True)
+    if _LOCAL_SINK is not None:
+        _LOCAL_SINK.write(line + "\n")
+        _LOCAL_SINK.flush()
+
+
+def _open_local_record() -> None:
+    """Open ``BENCH_LOCAL_rNN.jsonl`` next to the committed records,
+    NN = the round this capture will become (newest committed
+    ``BENCH_r*.json`` + 1, zero-padded, by a plain glob — deliberately
+    NOT via the claims module, whose bugs must not break a capture).
+    ``TDT_BENCH_LOCAL`` overrides the path; ``0``/``off`` disables the
+    tee.  Any failure here is non-fatal — stdout (the envelope path)
+    still carries the stream."""
+    import glob
+    import os
+    import re
+    import sys
+    import traceback
+
+    global _LOCAL_SINK
+    try:
+        env = os.environ.get("TDT_BENCH_LOCAL", "")
+        if env.lower() in ("0", "off", "false", "no"):
+            return
+        root = os.path.dirname(os.path.abspath(__file__))
+        if env:
+            path = env
+        else:
+            rounds = []
+            for p in glob.glob(os.path.join(root, "BENCH_r*.json")):
+                m = re.search(r"BENCH_r(\d+)\.json$", p)
+                if m:
+                    rounds.append(int(m.group(1)))
+            rnd = max(rounds) + 1 if rounds else 1
+            path = os.path.join(root, f"BENCH_LOCAL_r{rnd:02d}.jsonl")
+        _LOCAL_SINK = open(path, "w")
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        _LOCAL_SINK = None
+
 
 _CLAIMS_MODULE = None
 
@@ -426,8 +477,11 @@ def _emit(fn, *args, **kw):
     (observed: a mid-sweep dip pulled even the crowned backend to 131
     TF/s while the same sweep's dense GEMM read 189), and a floor claim
     asserts the kernel's capability, not the thermal luck of one draw.
-    Both attempts land in the record (``first_attempt_value``); a
-    genuine regression fails twice and the gate stays red."""
+    The retry is SYMMETRIC (ADVICE r5 low #3): the published ``value``
+    is always the first draw, the retry lands as ``retry_value``, and
+    the claims gate — not the bench — decides whether a dip-with-
+    passing-retry is acceptable; a genuine regression fails both draws
+    and the gate stays red."""
     import sys
     import traceback
 
@@ -467,18 +521,20 @@ def _emit(fn, *args, **kw):
                 rec["retry_crashed"] = True
                 if rec.get("metric"):
                     _EMITTED.append(rec["metric"])
-                print(json.dumps(rec), flush=True)
+                _record_line(json.dumps(rec))
                 raise
-            retry["attempts"] = 2
-            retry["first_attempt_value"] = rec.get("value")
-            if not cpc._check_metric(retry, claim)[0]:
-                rec = retry
-            else:
-                rec["attempts"] = 2
-                rec["retry_value"] = retry.get("value")
+            # SYMMETRIC retry (ADVICE r5 low #3): the published value is
+            # ALWAYS the first draw — high and low draws get identical
+            # treatment, removing the max-of-two bias on floor dips.  The
+            # retry rides along as ``retry_value`` and the claims GATE
+            # owns the accept/reject decision: a floor dip whose retry
+            # clears the floor downgrades to a warning there
+            # (scripts/check_perf_claims.py::_check_metric).
+            rec["attempts"] = 2
+            rec["retry_value"] = retry.get("value")
         if rec.get("metric"):
             _EMITTED.append(rec["metric"])
-        print(json.dumps(rec), flush=True)
+        _record_line(json.dumps(rec))
     except Exception:  # keep the remaining modes alive, but fail the run
         _EMIT_FAILED = True
         traceback.print_exc(file=sys.stderr)
@@ -882,7 +938,11 @@ def main():
     elif mode == "overlap_collective":
         print(json.dumps(bench_overlap_collective()))
     elif mode == "auto":
-        # whole perf surface, one JSON line per mode; headline GEMM first
+        # whole perf surface, one JSON line per mode; headline GEMM
+        # first.  The complete stream also lands in BENCH_LOCAL_rNN.jsonl
+        # (commit it next to the driver's BENCH_rNN.json: the claims gate
+        # prefers the untruncatable local record)
+        _open_local_record()
         _emit(bench_single_chip)
         _emit(bench_single_chip, 4096, 4096, 4096, rounds=13)
         _emit(bench_single_chip, 8192, 2048, 7168, rounds=13)
@@ -902,14 +962,16 @@ def main():
         # claim must appear) and whether any mode crashed.  A run that
         # dies before even this line leaves no sentinel, which the gate
         # treats as an incomplete record via the driver envelope's rc.
-        print(json.dumps({
+        _record_line(json.dumps({
             "metric": "bench_sweep_complete",
             "value": 1 if not _EMIT_FAILED else 0,
             "unit": "bool",
             # survives tail truncation (the sentinel is the LAST line):
             # lets the gate tell truncated-away head lines from crashes
             "emitted": _EMITTED,
-        }), flush=True)
+        }))
+        if _LOCAL_SINK is not None:
+            _LOCAL_SINK.close()
         if _EMIT_FAILED:
             # partial lines already flushed; the exit code must still
             # reflect that some modes crashed

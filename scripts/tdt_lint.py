@@ -14,10 +14,19 @@ Usage:
     python scripts/tdt_lint.py --ranks 2,4       # restrict rank counts
     python scripts/tdt_lint.py --kernel gemm_rs  # name filter (substring)
     python scripts/tdt_lint.py --selftest        # seeded-bad fixture battery
+    python scripts/tdt_lint.py --faults          # fault-injection matrix
+    python scripts/tdt_lint.py --faults --seed 7 # reseed the injection
     python scripts/tdt_lint.py --json report.json
 
-Exit status: 0 = every kernel clean (or selftest passed); 1 = violations
-(each printed with the violating semaphore/chunk named).
+``--faults`` runs the ``tdt.resilience`` fault-injection matrix
+headlessly (docs/robustness.md): every fault class (dropped/delayed
+notify, stale credit, straggler, rank abort) against every guarded
+kernel family, asserting each injection is either DETECTED (timeout /
+hazard naming the pending semaphore or chunk) or SURVIVED (completed in
+budget with balanced credits).
+
+Exit status: 0 = every kernel clean (or selftest/fault matrix passed);
+1 = violations (each printed with the violating semaphore/chunk named).
 """
 
 from __future__ import annotations
@@ -42,9 +51,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--selftest", action="store_true",
                     help="verify the seeded-bad fixtures are each flagged "
                          "and a clean kernel passes")
+    ap.add_argument("--faults", action="store_true",
+                    help="run the resilience fault-injection matrix: every "
+                         "fault class must be detected or survived")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="fault-injection target sampling seed (--faults)")
     ap.add_argument("--json", metavar="PATH",
                     help="also write the per-case results as JSON")
     args = ap.parse_args(argv)
+
+    if args.faults:
+        return _run_faults(args)
 
     from triton_distributed_tpu import analysis
 
@@ -93,6 +110,30 @@ def main(argv: list[str] | None = None) -> int:
             json.dump({"cases": rows, "violations": n_violations}, f,
                       indent=1, sort_keys=True)
     return 1 if n_violations else 0
+
+
+def _run_faults(args) -> int:
+    from triton_distributed_tpu import resilience
+
+    rows = resilience.run_matrix(seed=args.seed)
+    for row in rows:
+        named = f"  [{', '.join(row['named'])}]" if row["named"] else ""
+        print(f"{row['kernel']:<24} {row['fault']:<14} "
+              f"{row['outcome'].upper():<9}{named}")
+    problems = resilience.verify_matrix(rows)
+    detected = sum(r["outcome"] == "detected" for r in rows)
+    survived = sum(r["outcome"] == "survived" for r in rows)
+    print(f"\n{len(rows)} injections: {detected} detected, "
+          f"{survived} survived, {len(problems)} problem(s)")
+    for p in problems:
+        print(f"FAULT MATRIX FAIL: {p}")
+    if args.json:
+        import json as _json
+
+        with open(args.json, "w") as f:
+            _json.dump({"rows": rows, "problems": problems}, f,
+                       indent=1, sort_keys=True)
+    return 1 if problems else 0
 
 
 if __name__ == "__main__":

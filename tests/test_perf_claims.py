@@ -174,3 +174,162 @@ def test_since_round_scopes_old_records(tmp_path):
     assert cpc.check(str(tmp_path)) == 0
     (tmp_path / "BENCH_r04.json").write_text(line + "\n")
     assert cpc.check(str(tmp_path)) == 1
+
+
+# ---------------------------------------------------------------------------
+# local-record plumbing (VERDICT r5 next #1): bench.py persists the full
+# JSONL stream; the gate prefers it and treats the envelope tail as a
+# fallback that fails loudly on detectable truncation
+
+
+def test_local_record_preferred_over_envelope(tmp_path):
+    """A committed BENCH_LOCAL_rNN.jsonl with round >= the envelope's is
+    the gated record: a value the envelope truncated away still binds."""
+    # envelope says 150 (passing); local record says 90 (floor breach):
+    # the local record must win and fail the gate ON THE VALUE (sentinel
+    # included so the failure comes from the floor check, not the
+    # local-record completeness gate)
+    sentinel = json.dumps({"metric": "bench_sweep_complete", "value": 1,
+                           "unit": "bool", "emitted": list(cpc.CLAIMS)})
+    env = {"n": 9, "rc": 0, "tail": _line(value=150.0) + "\n"}
+    (tmp_path / "BENCH_r09.json").write_text(json.dumps(env))
+    assert cpc.check(str(tmp_path)) == 0
+    (tmp_path / "BENCH_LOCAL_r09.jsonl").write_text(
+        _line(value=90.0) + "\n" + sentinel + "\n")
+    assert cpc.check(str(tmp_path)) == 1
+    (tmp_path / "BENCH_LOCAL_r09.jsonl").write_text(
+        _line(value=150.0) + "\n" + sentinel + "\n")
+    assert cpc.check(str(tmp_path)) == 0   # same record, passing value
+    (tmp_path / "BENCH_LOCAL_r09.jsonl").write_text(
+        _line(value=90.0) + "\n" + sentinel + "\n")
+    # an OLDER local record does not shadow a newer envelope
+    (tmp_path / "BENCH_r10.json").write_text(
+        json.dumps({"n": 10, "rc": 0, "tail": _line(value=150.0) + "\n"}))
+    assert cpc.check(str(tmp_path)) == 0
+
+
+def test_truncated_envelope_fails_loudly_without_local_record(
+        tmp_path, capsys):
+    """From round >= 6 (bench.py writes the local record), an envelope
+    whose tail starts mid-line (detectable truncation) without a
+    committed local record is a HARD failure, not a warning — the
+    complete stream exists on the bench host and must be committed."""
+    truncated_tail = '"value": 150.0, "unit": "TFLOP/s"}\n' + _line() + "\n"
+    env = {"n": 9, "rc": 0, "tail": truncated_tail}
+    (tmp_path / "BENCH_r09.json").write_text(json.dumps(env))
+    assert cpc.check(str(tmp_path)) == 1
+    assert "truncated" in capsys.readouterr().out
+    # the committed local record for the same round resolves it (a real
+    # local record always ends with the auto sweep's sentinel)
+    sentinel = json.dumps({"metric": "bench_sweep_complete", "value": 1,
+                           "unit": "bool", "emitted": list(cpc.CLAIMS)})
+    (tmp_path / "BENCH_LOCAL_r09.jsonl").write_text(
+        _line() + "\n" + sentinel + "\n")
+    assert cpc.check(str(tmp_path)) == 0
+    # pre-round-6 envelopes (no local record ever existed) keep the
+    # legacy warning path — the committed r05 shape must not turn red
+    (tmp_path / "BENCH_r09.json").unlink()
+    (tmp_path / "BENCH_LOCAL_r09.jsonl").unlink()
+    (tmp_path / "BENCH_r05.json").write_text(json.dumps(
+        {"n": 5, "rc": 0, "tail": truncated_tail}))
+    assert cpc.check(str(tmp_path)) == 0
+
+
+def test_local_record_keeps_crash_gates(tmp_path, capsys):
+    """Preferring the local record must not drop the crash gates: a
+    local stream without the sweep sentinel is a sweep that died
+    mid-run (bench.py only tees in `auto` mode, which always ends with
+    the sentinel), and the same-round envelope's nonzero rc still
+    binds."""
+    # (a) local record without the sentinel: incomplete — hard failure
+    (tmp_path / "BENCH_LOCAL_r09.jsonl").write_text(_line() + "\n")
+    assert cpc.check(str(tmp_path)) == 1
+    assert "no bench_sweep_complete sentinel" in capsys.readouterr().out
+    # a healthy local stream (sentinel listing every claim as emitted ->
+    # absence downgrades to truncation-free warnings is NOT possible for
+    # raw records, so list them all as real lines): build a full record
+    lines = [_line()]
+    sentinel = {"metric": "bench_sweep_complete", "value": 1,
+                "unit": "bool", "emitted": list(cpc.CLAIMS)}
+    body = "\n".join(lines + [json.dumps(sentinel)]) + "\n"
+    # (b) the same-round envelope's rc still binds even when the local
+    # record itself carries a green sentinel
+    (tmp_path / "BENCH_LOCAL_r09.jsonl").write_text(body)
+    (tmp_path / "BENCH_r09.json").write_text(
+        json.dumps({"n": 9, "rc": 137, "tail": ""}))
+    assert cpc.check(str(tmp_path)) == 1
+    assert "exit code 137" in capsys.readouterr().out
+
+
+def test_floor_dip_with_passing_retry_warns_not_fails(tmp_path, capsys):
+    """The gate owns the retry decision (ADVICE r5 low #3): bench.py
+    publishes the FIRST draw plus ``retry_value``; a dip whose retry
+    clears the floor is a transient-throttle warning, a double miss is
+    a hard regression."""
+    (tmp_path / "BENCH_r09.json").write_text(
+        _line(value=90.0, retry_value=150.0, attempts=2) + "\n")
+    assert cpc.check(str(tmp_path)) == 0
+    out = capsys.readouterr().out
+    assert "WARNING" in out and "retry" in out
+    (tmp_path / "BENCH_r09.json").write_text(
+        _line(value=90.0, retry_value=95.0, attempts=2) + "\n")
+    assert cpc.check(str(tmp_path)) == 1
+
+
+def test_bench_emit_publishes_first_draw_and_tees_local_record(
+        monkeypatch, capsys):
+    """bench._emit symmetry + tee: the printed value is the first draw
+    (never max-of-two), the retry rides along, and every line lands in
+    the open local sink byte-identical to stdout."""
+    import io
+
+    bench = _load_bench()
+    sink = io.StringIO()
+    monkeypatch.setattr(bench, "_LOCAL_SINK", sink)
+    monkeypatch.setattr(bench, "_EMITTED", [])
+    draws = iter([90.0, 150.0])
+
+    def fake_bench():
+        return {"metric": "group_gemm_t8192_k7168_n2048_e8",
+                "value": next(draws), "unit": "TFLOP/s"}
+
+    bench._emit(fake_bench)
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["value"] == 90.0          # first draw published
+    assert rec["retry_value"] == 150.0   # retry attached, not substituted
+    assert rec["attempts"] == 2
+    assert sink.getvalue().strip().splitlines()[-1] == line
+    assert bench._EMITTED == ["group_gemm_t8192_k7168_n2048_e8"]
+
+
+def test_bench_local_record_path_round_numbering(monkeypatch, tmp_path):
+    """TDT_BENCH_LOCAL overrides the sink path; '0' disables the tee."""
+    bench = _load_bench()
+    target = tmp_path / "stream.jsonl"
+    monkeypatch.setenv("TDT_BENCH_LOCAL", str(target))
+    monkeypatch.setattr(bench, "_LOCAL_SINK", None)
+    bench._open_local_record()
+    try:
+        assert bench._LOCAL_SINK is not None
+        bench._record_line('{"metric": "x", "value": 1}')
+    finally:
+        bench._LOCAL_SINK.close()
+        monkeypatch.setattr(bench, "_LOCAL_SINK", None)
+    assert target.read_text() == '{"metric": "x", "value": 1}\n'
+    monkeypatch.setenv("TDT_BENCH_LOCAL", "0")
+    bench._open_local_record()
+    assert bench._LOCAL_SINK is None
+
+
+_BENCH_MODULE = None
+
+
+def _load_bench():
+    global _BENCH_MODULE
+    if _BENCH_MODULE is None:
+        spec = importlib.util.spec_from_file_location(
+            "bench_under_test", os.path.join(REPO, "bench.py"))
+        _BENCH_MODULE = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_BENCH_MODULE)
+    return _BENCH_MODULE

@@ -26,6 +26,11 @@ Layer map (vs SURVEY.md section 1):
                signal balance / deadlock freedom / write-overlap /
                divergence, no hardware or interpret mode needed
                (``TDT_VERIFY=1`` build gate, ``scripts/tdt_lint.py``)
+- ``resilience`` runtime fault tolerance: primitives-level fault
+               injection, bounded-wait watchdog with named-semaphore
+               timeout diagnoses, retry/degrade/circuit-breaker ladder
+               (``TDT_RESILIENCE=1`` runtime gate,
+               ``scripts/tdt_lint.py --faults``)
 
 (host-side helpers live in ``core.utils``; there is deliberately no
 separate ``utils`` package)
@@ -47,3 +52,4 @@ from .core.symm import symm_buffer, symm_signal, SymmetricBuffer
 from .layers import TPAttn, TPAttnParams, TPMLP, TPMLPParams, rms_norm
 from . import obs
 from . import analysis
+from . import resilience

@@ -328,26 +328,33 @@ def ep_dispatch(
     ``x`` (the adjoint is :func:`ep_combine`).
     """
     cfg = config or AllToAllConfig()
-    from .. import obs
+    from .. import obs, resilience
+    from ..tune.autotuner import is_tracer
 
-    if obs.enabled():
-        from ..tune.autotuner import is_tracer
-
-        if not (is_tracer(x) or is_tracer(splits)):
-            n = mesh.shape[axis]
-            t = x.shape[0] // max(n, 1)
-            payload = t * x.shape[1] * jnp.dtype(x.dtype).itemsize
-            chunk = min(cfg.chunk, _round_up(max(t, 1), 8))
-            return obs.comm_call(
-                "ep_dispatch",
-                lambda: _ep_dispatch_diff(mesh, axis, cfg, x, splits),
-                # wire: static upper bound — every local token leaves the
-                # rank (true counts live in `splits`, a device array)
-                payload_bytes=payload, wire_bytes=payload,
-                chunks=_cdiv(max(t, 1), chunk),
-                method=f"push_chunk{chunk}", ranks=n,
-            )
-    return _ep_dispatch_diff(mesh, axis, cfg, x, splits)
+    n = mesh.shape[axis]
+    t = x.shape[0] // max(n, 1)
+    payload = t * x.shape[1] * jnp.dtype(x.dtype).itemsize
+    core = lambda: _ep_dispatch_diff(mesh, axis, cfg, x, splits)  # noqa: E731
+    eager = not (is_tracer(x) or is_tracer(splits))
+    if eager and resilience.enabled():
+        # watchdog-only: the ragged zone layout has no one-line jax.lax
+        # equivalent, so a stall is DETECTED (named) rather than degraded
+        # (docs/robustness.md "degradation ladder")
+        core = resilience.guarded(
+            "ep_dispatch", core, family="all_to_all", ranks=n,
+            payload_bytes=payload,
+        )
+    if obs.enabled() and eager:
+        chunk = min(cfg.chunk, _round_up(max(t, 1), 8))
+        return obs.comm_call(
+            "ep_dispatch", core,
+            # wire: static upper bound — every local token leaves the
+            # rank (true counts live in `splits`, a device array)
+            payload_bytes=payload, wire_bytes=payload,
+            chunks=_cdiv(max(t, 1), chunk),
+            method=f"push_chunk{chunk}", ranks=n,
+        )
+    return core()
 
 
 def _ep_dispatch_run(mesh, axis, cfg, x, splits):
@@ -397,24 +404,29 @@ def ep_combine(
     is :func:`ep_dispatch`).
     """
     cfg = config or AllToAllConfig()
-    from .. import obs
+    from .. import obs, resilience
+    from ..tune.autotuner import is_tracer
 
-    if obs.enabled():
-        from ..tune.autotuner import is_tracer
-
-        if not (is_tracer(y) or is_tracer(splits)):
-            n = mesh.shape[axis]
-            payload = token_dim * y.shape[-1] * jnp.dtype(y.dtype).itemsize
-            chunk = min(cfg.chunk, _round_up(max(token_dim, 1), 8))
-            return obs.comm_call(
-                "ep_combine",
-                lambda: _ep_combine_diff(mesh, axis, cfg, token_dim, y,
-                                         splits),
-                payload_bytes=payload, wire_bytes=payload,
-                chunks=_cdiv(max(token_dim, 1), chunk),
-                method=f"push_chunk{chunk}", ranks=n,
-            )
-    return _ep_combine_diff(mesh, axis, cfg, token_dim, y, splits)
+    n = mesh.shape[axis]
+    payload = token_dim * y.shape[-1] * jnp.dtype(y.dtype).itemsize
+    core = lambda: _ep_combine_diff(mesh, axis, cfg, token_dim, y,  # noqa: E731
+                                    splits)
+    eager = not (is_tracer(y) or is_tracer(splits))
+    if eager and resilience.enabled():
+        # watchdog-only, like ep_dispatch
+        core = resilience.guarded(
+            "ep_combine", core, family="all_to_all", ranks=n,
+            payload_bytes=payload,
+        )
+    if obs.enabled() and eager:
+        chunk = min(cfg.chunk, _round_up(max(token_dim, 1), 8))
+        return obs.comm_call(
+            "ep_combine", core,
+            payload_bytes=payload, wire_bytes=payload,
+            chunks=_cdiv(max(token_dim, 1), chunk),
+            method=f"push_chunk{chunk}", ranks=n,
+        )
+    return core()
 
 
 def _ep_combine_run(mesh, axis, cfg, token_dim, y, splits):

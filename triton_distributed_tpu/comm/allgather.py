@@ -352,22 +352,29 @@ def all_gather(
             lambda mth: (lambda: all_gather(x, mesh, axis, method=mth)),
             tracing=is_tracer(x),
         )
-    from .. import obs
+    from .. import obs, resilience
+    from ..tune.autotuner import is_tracer as _is_tracer
 
-    if obs.enabled():
-        from ..tune.autotuner import is_tracer as _is_tracer
+    import math
 
-        # eager calls only: a traced call runs this Python once, at trace
-        # time, and would record one phantom sample per compile
-        if not _is_tracer(x):
-            import math
-
-            shard_bytes = math.prod(shard_shape) * jnp.dtype(x.dtype).itemsize
-            # every method moves each shard through n-1 per-rank hops
-            return obs.comm_call(
-                "all_gather",
-                lambda: _all_gather_core(mesh, axis, method, x),
-                payload_bytes=shard_bytes, wire_bytes=shard_bytes * (n - 1),
-                chunks=n - 1, method=method.value, ranks=n,
-            )
-    return _all_gather_core(mesh, axis, method, x)
+    shard_bytes = math.prod(shard_shape) * jnp.dtype(x.dtype).itemsize
+    core = lambda: _all_gather_core(mesh, axis, method, x)  # noqa: E731
+    # eager calls only for both wrappers: a traced call runs this Python
+    # once, at trace time — obs would record one phantom sample per
+    # compile, and a host-side watchdog cannot bound a traced subcall
+    eager = not _is_tracer(x)
+    if eager and resilience.enabled():
+        core = resilience.guarded(
+            "all_gather", core, family="allgather", ranks=n,
+            payload_bytes=shard_bytes,
+            fallback=lambda: resilience.fallbacks.xla_all_gather(
+                x, mesh, axis),
+        )
+    if obs.enabled() and eager:
+        # every method moves each shard through n-1 per-rank hops
+        return obs.comm_call(
+            "all_gather", core,
+            payload_bytes=shard_bytes, wire_bytes=shard_bytes * (n - 1),
+            chunks=n - 1, method=method.value, ranks=n,
+        )
+    return core()

@@ -412,24 +412,30 @@ def all_reduce(
     cfg = (config or AllReduceConfig()).clip(
         m // n if method == AllReduceMethod.TWO_SHOT else m, x.shape[1]
     )
-    from .. import obs
+    from .. import obs, resilience
+    from ..tune.autotuner import is_tracer
 
-    if obs.enabled():
-        from ..tune.autotuner import is_tracer
-
-        if not is_tracer(x):  # eager calls only (see all_gather)
-            partial = m * x.shape[1] * jnp.dtype(x.dtype).itemsize
-            if method == AllReduceMethod.TWO_SHOT:
-                # RS ring + AG ring, each n-1 hops of 1/n of the partial
-                wire, chunks = 2 * (n - 1) * partial // n, 2 * (n - 1)
-            else:
-                # every rank receives n-1 whole partials
-                wire, chunks = (n - 1) * partial, n - 1
-            return obs.comm_call(
-                "all_reduce",
-                lambda: _all_reduce_core(mesh, axis, method, out_dtype,
-                                         cfg, x),
-                payload_bytes=partial, wire_bytes=wire, chunks=chunks,
-                method=method.value, ranks=n,
-            )
-    return _all_reduce_core(mesh, axis, method, out_dtype, cfg, x)
+    partial = m * x.shape[1] * jnp.dtype(x.dtype).itemsize
+    core = lambda: _all_reduce_core(mesh, axis, method, out_dtype,  # noqa: E731
+                                    cfg, x)
+    eager = not is_tracer(x)  # eager calls only (see all_gather)
+    if eager and resilience.enabled():
+        core = resilience.guarded(
+            "all_reduce", core, family="allreduce", ranks=n,
+            payload_bytes=partial,
+            fallback=lambda: resilience.fallbacks.xla_all_reduce(
+                x, mesh, axis, out_dtype),
+        )
+    if obs.enabled() and eager:
+        if method == AllReduceMethod.TWO_SHOT:
+            # RS ring + AG ring, each n-1 hops of 1/n of the partial
+            wire, chunks = 2 * (n - 1) * partial // n, 2 * (n - 1)
+        else:
+            # every rank receives n-1 whole partials
+            wire, chunks = (n - 1) * partial, n - 1
+        return obs.comm_call(
+            "all_reduce", core,
+            payload_bytes=partial, wire_bytes=wire, chunks=chunks,
+            method=method.value, ranks=n,
+        )
+    return core()

@@ -285,19 +285,25 @@ def reduce_scatter(
         )
     m_loc = m_partial // n            # output rows per device
     cfg = (config or ReduceScatterConfig()).clip(m_loc, x.shape[1])
-    from .. import obs
+    from .. import obs, resilience
+    from ..tune.autotuner import is_tracer
 
-    if obs.enabled():
-        from ..tune.autotuner import is_tracer
-
-        if not is_tracer(x):  # eager calls only (see all_gather)
-            chunk_bytes = m_loc * x.shape[1] * jnp.dtype(x.dtype).itemsize
-            return obs.comm_call(
-                "reduce_scatter",
-                lambda: _reduce_scatter_core(mesh, axis, cfg, x),
-                payload_bytes=chunk_bytes * n,
-                # ring: n-1 hops, each carrying one m_loc-row chunk
-                wire_bytes=chunk_bytes * (n - 1), chunks=n - 1,
-                method="ring", ranks=n,
-            )
-    return _reduce_scatter_core(mesh, axis, cfg, x)
+    chunk_bytes = m_loc * x.shape[1] * jnp.dtype(x.dtype).itemsize
+    core = lambda: _reduce_scatter_core(mesh, axis, cfg, x)  # noqa: E731
+    eager = not is_tracer(x)  # eager calls only (see all_gather)
+    if eager and resilience.enabled():
+        core = resilience.guarded(
+            "reduce_scatter", core, family="reduce_scatter", ranks=n,
+            payload_bytes=chunk_bytes * n,
+            fallback=lambda: resilience.fallbacks.xla_reduce_scatter(
+                x, mesh, axis),
+        )
+    if obs.enabled() and eager:
+        return obs.comm_call(
+            "reduce_scatter", core,
+            payload_bytes=chunk_bytes * n,
+            # ring: n-1 hops, each carrying one m_loc-row chunk
+            wire_bytes=chunk_bytes * (n - 1), chunks=n - 1,
+            method="ring", ranks=n,
+        )
+    return core()

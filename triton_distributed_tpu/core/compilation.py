@@ -217,3 +217,13 @@ def reset_interpret_state() -> None:
 def supports_remote_dma() -> bool:
     """Whether device-to-device Pallas RDMA is available (multi-device mesh)."""
     return jax.device_count() > 1 or platform.on_cpu()
+
+
+def interpret_supported() -> bool:
+    """Whether this jax build carries the APIs the interpret-mode path
+    needs (``pltpu.InterpretParams``/``CompilerParams``, ``jax.shard_map``).
+    Older builds (e.g. 0.4.37) lack them; capability-gated tests use this
+    one probe instead of per-file hasattr copies."""
+    return (hasattr(pltpu, "InterpretParams")
+            and hasattr(pltpu, "CompilerParams")
+            and hasattr(jax, "shard_map"))

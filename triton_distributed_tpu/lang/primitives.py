@@ -72,6 +72,29 @@ def _set_recorder(rec) -> None:
 
 
 # ---------------------------------------------------------------------------
+# fault-injection scope (tdt.resilience)
+#
+# The fault harness (``triton_distributed_tpu.resilience.faults``) hooks the
+# SAME interception points the recorder uses: when a scope is installed on
+# the current thread, each primitive below consults it BEFORE dispatching —
+# so a dropped signal never reaches the recorder (or the device), exactly as
+# it would never reach the wire.  The scope may also raise ``RankAborted``
+# to model a rank dying mid-kernel.  See docs/robustness.md.
+
+_FAULT_STATE = threading.local()
+
+
+def active_fault_scope():
+    """The fault-injection scope intercepting primitives on this thread,
+    or None (normal operation).  Installed by ``resilience.faults.scoped``."""
+    return getattr(_FAULT_STATE, "scope", None)
+
+
+def _set_fault_scope(scope) -> None:
+    _FAULT_STATE.scope = scope
+
+
+# ---------------------------------------------------------------------------
 # teams: axis-rank -> logical device id translation
 
 
@@ -188,10 +211,23 @@ def notify(
     semantics exist on TPU; protocols written against SET re-encode the
     expected value as an arrival count.
     """
+    scope = active_fault_scope()
+    action = None
+    if scope is not None:
+        action = scope.on_notify(sem, device_id, inc)
+        if action == "drop":
+            # the signal is lost in flight: neither the recorder nor the
+            # device semaphore ever sees it
+            return
     rec = active_recorder()
     if rec is not None:
         rec.on_notify(sem, device_id, inc)
+        if isinstance(action, tuple) and action[0] == "delay":
+            scope.mark_delayed(len(rec.events) - 1, action[1])
         return
+    if isinstance(action, tuple) and action[0] == "delay":
+        # live mode has no host-side lever over in-flight signal latency
+        scope.mark_live_unsupported("delay_notify")
     if device_id is None:
         pltpu.semaphore_signal(sem, inc=inc)
     else:
@@ -205,11 +241,23 @@ def notify(
 
 def wait(sem, value: int | jax.Array = 1) -> None:
     """Block until ``sem >= value``, consuming ``value`` (reference
-    ``dl.wait``; spin-wait lowering ``DistributedOpToLLVM.cpp:146-219``)."""
+    ``dl.wait``; spin-wait lowering ``DistributedOpToLLVM.cpp:146-219``).
+
+    No device-side timeout exists: boundedness is the HOST's job (the
+    ``resilience`` watchdog wraps the collective entry points with a
+    perf-model-derived deadline and raises ``CollectiveTimeoutError``
+    naming the pending semaphore instead of hanging — see
+    docs/robustness.md)."""
+    scope = active_fault_scope()
+    action = scope.on_wait(sem, value) if scope is not None else None
     rec = active_recorder()
     if rec is not None:
         rec.on_wait(sem, value)
         return
+    if isinstance(action, tuple) and action[0] == "stale":
+        # a leftover credit from a previous invocation: pre-credit the
+        # local semaphore so this wait passes early (live injection)
+        pltpu.semaphore_signal(sem, inc=action[1])
     pltpu.semaphore_wait(sem, value)
 
 
@@ -262,10 +310,22 @@ def remote_copy(
     Returns the descriptor; call ``.wait()`` (or ``wait_send``/``wait_recv``)
     to block.  ``start=False`` returns an unstarted descriptor.
     """
+    scope = active_fault_scope()
+    action = None
+    if scope is not None:
+        action = scope.on_remote_copy(src, dst, send_sem, recv_sem,
+                                      device_id)
     rec = active_recorder()
     if rec is not None:
-        return rec.on_remote_copy(src, dst, send_sem, recv_sem, device_id,
+        desc = rec.on_remote_copy(src, dst, send_sem, recv_sem, device_id,
                                   start=start)
+        if action == "drop_recv":
+            scope.mark_dropped_recv(len(rec.events) - 1)
+        return desc
+    if action == "drop_recv":
+        # losing only the DMA completion signal (data landed, signal
+        # didn't) is not expressible through the Pallas DMA API
+        scope.mark_live_unsupported("drop_recv")
     copy = pltpu.make_async_remote_copy(
         src_ref=src,
         dst_ref=dst,
@@ -282,6 +342,9 @@ def remote_copy(
 def local_copy(src, dst, sem, *, start: bool = True):
     """Async local DMA (HBM<->VMEM) — the reference's cp.async / copy-engine
     path collapses to this on TPU."""
+    scope = active_fault_scope()
+    if scope is not None:
+        scope.on_local_copy(src, dst, sem)
     rec = active_recorder()
     if rec is not None:
         return rec.on_local_copy(src, dst, sem, start=start)
@@ -300,6 +363,9 @@ def wait_recv(dst_ref, sem) -> None:
     different points in the program (the reference's ``dl.wait`` on ready
     flags / ``signal_wait_until``).
     """
+    scope = active_fault_scope()
+    if scope is not None:
+        scope.on_wait_recv(dst_ref, sem)
     rec = active_recorder()
     if rec is not None:
         rec.on_wait_recv(dst_ref, sem)
@@ -311,6 +377,9 @@ def wait_send(src_ref, sem) -> None:
     """Drain one outgoing ``remote_copy`` of ``src_ref``'s shape/size (the
     reference's ``nvshmem_quiet`` per-transfer analogue).  Counting
     semantics: call once per outstanding send of this size."""
+    scope = active_fault_scope()
+    if scope is not None:
+        scope.on_wait_send(src_ref, sem)
     rec = active_recorder()
     if rec is not None:
         rec.on_wait_send(src_ref, sem)

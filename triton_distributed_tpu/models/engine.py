@@ -110,8 +110,23 @@ class Engine:
     top_p: float = 1.0
     cache_layout: str = "contiguous"
     page_size: int = 64
+    # default per-request wall budget for :meth:`serve` when resilience
+    # is enabled (TDT_RESILIENCE=1); None = unbounded unless the call
+    # passes ``deadline_ms`` explicitly
+    request_deadline_ms: float | None = None
 
     def __post_init__(self):
+        import threading
+
+        self._failed_requests = 0
+        self._last_failure: str | None = None
+        # watchdog dispatch threads abandoned by a deadline breach: their
+        # in-flight steps must not clobber the engine's (reset) cache —
+        # thread OBJECTS, not idents (idents recycle after thread death).
+        # The lock orders the membership checks against _mark_failed's
+        # add-then-reset (no check-then-assign window on a timeout).
+        self._abandoned_threads: set = set()
+        self._fence_lock = threading.Lock()
         c = self.model.config
         if self.cache_layout == "paged":
             self.cache = init_paged_cache(
@@ -181,8 +196,54 @@ class Engine:
         with obs.span("prefill", cat="step", batch=b, prompt_len=plen):
             return self._prefill_dispatch(input_ids, b, plen)
 
+    def _set_cache(self, cache) -> None:
+        """Adopt a step's updated cache UNLESS this thread was abandoned
+        by a watchdog deadline breach — a stale dispatch completing
+        after :meth:`_mark_failed` reset the cache must not clobber the
+        next request's clean state (failed-step isolation).  Check and
+        assignment share ``_fence_lock`` with ``_mark_failed``'s
+        add-then-reset, so a timeout firing between them cannot slip a
+        stale cache past the fence.  Refusal RAISES (same abort as
+        ``_check_abandoned``): falling through would let the stale step
+        keep running and read — with donation, consume — the fresh
+        cache on its next use of ``self.cache``."""
+        import threading
+
+        with self._fence_lock:
+            if threading.current_thread() not in self._abandoned_threads:
+                self.cache = cache
+                return
+        self._raise_abandoned()
+
+    def _check_abandoned(self) -> None:
+        """Kill an abandoned serving thread at its next step: letting it
+        continue would READ (and, with donation, consume) the reset
+        cache the next request owns.  The raise lands in the watchdog's
+        result box, which nobody reads."""
+        import threading
+
+        with self._fence_lock:
+            abandoned = threading.current_thread() in \
+                self._abandoned_threads
+        if abandoned:
+            self._raise_abandoned()
+
+    def _raise_abandoned(self) -> None:
+        import threading
+
+        # this thread is about to unwind out of the engine for good:
+        # drop its fence entry so the set stays bounded by in-flight
+        # breaches, not by the engine's lifetime breach count
+        with self._fence_lock:
+            self._abandoned_threads.discard(threading.current_thread())
+        raise RuntimeError(
+            "serving thread abandoned after a deadline breach; "
+            "aborting stale dispatch"
+        )
+
     def _prefill_dispatch(self, input_ids, b: int, plen: int) -> jax.Array:
-        self.cache = reset(self.cache)
+        self._check_abandoned()
+        self._set_cache(reset(self.cache))
         if self._prefill_exec:
             bucket = min(
                 (L for L in self._prefill_exec if L >= plen), default=None
@@ -192,13 +253,15 @@ class Engine:
                     [input_ids,
                      jnp.zeros((b, bucket - plen), input_ids.dtype)], axis=1
                 )
-                logits, self.cache = self._call_exec(
+                logits, cache = self._call_exec(
                     self._prefill_exec[bucket],
                     self.params, self.cache, ids, jnp.int32(plen),
                 )
+                self._set_cache(cache)
                 return logits[:, plen - 1]
             # longer than every bucket: fall through to the jit path
-        logits, self.cache = self._prefill(self.params, self.cache, input_ids)
+        logits, cache = self._prefill(self.params, self.cache, input_ids)
+        self._set_cache(cache)
         return logits[:, -1]
 
     def _call_exec(self, ex, params, *rest):
@@ -229,14 +292,16 @@ class Engine:
         return ex(hit[1], *rest)
 
     def decode_step(self, tokens: jax.Array) -> jax.Array:
+        self._check_abandoned()
         with obs.span("decode_dispatch", cat="compute"):
             if self._decode_exec is not None:
-                logits, self.cache = self._call_exec(
+                logits, cache = self._call_exec(
                     self._decode_exec, self.params, self.cache, tokens
                 )
+                self._set_cache(cache)
                 return logits
-            logits, self.cache = self._decode(self.params, self.cache,
-                                              tokens)
+            logits, cache = self._decode(self.params, self.cache, tokens)
+            self._set_cache(cache)
             return logits
 
     # -- bucketed AOT serving ---------------------------------------------
@@ -360,35 +425,61 @@ class Engine:
         return self.generate_from_logits(logits, gen_len, key)
 
     def serve(self, input_ids: jax.Array, gen_len: int,
-              key: jax.Array | None = None):
+              key: jax.Array | None = None, *,
+              deadline_ms: float | None = None):
         """Timed generate with a throughput report (reference
         ``Engine.serve:113``: prefill then graph-replayed decode, printing
         tokens/s).  Returns ``(tokens, stats)`` where stats has
         ``prefill_ms``, ``decode_ms_per_token``, ``decode_tokens_per_s``
-        (wall-clock, compile excluded by a 1-token warmup)."""
+        (wall-clock, compile excluded by a 1-token warmup).
+
+        ``deadline_ms`` (or, with ``TDT_RESILIENCE=1``, the engine's
+        ``request_deadline_ms``) bounds the REQUEST: the prefill block
+        and the decode block each run under the remaining budget and a
+        breach raises ``CollectiveTimeoutError`` instead of hanging the
+        serve loop.  Failed-step isolation: any failure inside the timed
+        region resets the KV cache (the donated buffers are in an
+        unknown state after an abandoned dispatch) and lands in
+        :meth:`health` before re-raising — the engine object stays
+        serviceable for the next request."""
         import time
 
         b, prompt_len = input_ids.shape
         self._check_length(prompt_len, gen_len)
+        if deadline_ms is None:
+            from .. import resilience
+
+            if resilience.enabled():
+                deadline_ms = self.request_deadline_ms
         # warmup/compile both steps outside the timed region (the
         # reference's graph capture happens before its timed replay too);
         # run through the stateful path — the donated cache buffers are
         # consumed and replaced, and the timed prefill resets the length.
         # Span recording is suppressed: a compile-time warmup is not a
         # serving step and would land a multi-second outlier in the
-        # overlap report's per-step table
+        # overlap report's per-step table.  The warmup is also outside
+        # the request deadline: a first-call compile is not request work.
         with obs.suppress():
             jax.block_until_ready(self.prefill(input_ids))
             jax.block_until_ready(
                 self.decode_step(jnp.zeros((b,), jnp.int32)))
 
         t0 = time.perf_counter()
-        logits = self.prefill(input_ids)
-        jax.block_until_ready(logits)
-        t1 = time.perf_counter()
-        tokens = self.generate_from_logits(logits, gen_len, key)
-        jax.block_until_ready(tokens)
-        t2 = time.perf_counter()
+        try:
+            logits = self._step_bounded(
+                "engine_prefill",
+                lambda: jax.block_until_ready(self.prefill(input_ids)),
+                deadline_ms, t0)
+            t1 = time.perf_counter()
+            tokens = self._step_bounded(
+                "engine_decode",
+                lambda: jax.block_until_ready(
+                    self.generate_from_logits(logits, gen_len, key)),
+                deadline_ms, t0)
+            t2 = time.perf_counter()
+        except Exception as e:
+            self._mark_failed(e)
+            raise
         decode_steps = max(gen_len - 1, 1)
         stats = {
             "prefill_ms": (t1 - t0) * 1e3,
@@ -398,6 +489,68 @@ class Engine:
         if obs.enabled():
             self._record_serve_metrics(prompt_len, gen_len, stats)
         return tokens, stats
+
+    def _step_bounded(self, op: str, thunk, deadline_ms: float | None,
+                      t0: float):
+        """Run one serving step under what remains of the request budget
+        (None = unbounded)."""
+        if deadline_ms is None:
+            return thunk()
+        import time
+
+        from .. import resilience
+
+        remaining = deadline_ms - (time.perf_counter() - t0) * 1e3
+        if remaining <= 0:
+            raise resilience.CollectiveTimeoutError(
+                op, deadline_ms, resilience.TimeoutDiagnosis(
+                    op, 0, note="request budget exhausted before this "
+                                "step started"))
+        return resilience.call_with_deadline(op, thunk, remaining)
+
+    def _mark_failed(self, err: BaseException) -> None:
+        """Failed-step isolation: record the failure, fence the
+        abandoned dispatch thread (its in-flight step must neither write
+        its stale cache over ours nor read/donate the fresh one — see
+        ``_set_cache`` / ``_check_abandoned``), and reset the KV cache
+        so the NEXT request starts from clean state."""
+        self._failed_requests += 1
+        self._last_failure = f"{type(err).__name__}: {err}"
+        abandoned = getattr(err, "abandoned_thread", None)
+        with self._fence_lock:
+            # prune threads that already exited (their identity can
+            # never re-enter the engine) so the set stays bounded
+            self._abandoned_threads = {
+                t for t in self._abandoned_threads if t.is_alive()
+            }
+            if abandoned is not None:
+                self._abandoned_threads.add(abandoned)
+            try:
+                self.cache = reset(self.cache)
+            except Exception:
+                pass  # best effort: health still records the failure
+        if obs.enabled():
+            obs.counter("engine_failed_requests",
+                        kind=type(err).__name__).inc()
+
+    def health(self) -> dict:
+        """Serving-health snapshot: resilience breaker/counter state
+        (``resilience.health_snapshot``) plus this engine's request
+        failure history and configuration — the ``/health`` payload of a
+        serving wrapper."""
+        from .. import resilience
+
+        snap = resilience.health_snapshot()
+        snap["engine"] = {
+            "failed_requests": self._failed_requests,
+            "last_failure": self._last_failure,
+            "batch": self.batch,
+            "cache_layout": self.cache_layout,
+            "decode_mode": self.model.decode_mode,
+            "request_deadline_ms": self.request_deadline_ms,
+            "aot_prefill_buckets": sorted(self._prefill_exec),
+        }
+        return snap
 
     def _record_serve_metrics(self, prompt_len: int, gen_len: int,
                               stats: dict) -> None:

@@ -331,4 +331,20 @@ def ag_gemm(
         )
         gathered, c = fn(a, b)
         return c, gathered
+    from .. import resilience
+    from ..tune.autotuner import is_tracer
+
+    if resilience.enabled() and not is_tracer(a):
+        # eager calls only (see comm/allgather.py): ride the failure
+        # ladder — watchdog deadline from the AG wire estimate, degraded
+        # fallback = unfused XLA AllGather + local GEMM
+        return resilience.guarded(
+            "ag_gemm",
+            lambda: _ag_gemm_core(mesh, axis, cfg, bool(bidir), out_dtype,
+                                  a, b),
+            family="ag_gemm", ranks=n,
+            payload_bytes=(m_tot // n) * k_dim * jnp.dtype(a.dtype).itemsize,
+            fallback=lambda: resilience.fallbacks.xla_ag_gemm(
+                a, b, mesh, axis, out_dtype),
+        )()
     return _ag_gemm_core(mesh, axis, cfg, bool(bidir), out_dtype, a, b)

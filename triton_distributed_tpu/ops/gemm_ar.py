@@ -267,4 +267,18 @@ def gemm_ar(
 
     m_loc, k_loc = m_tot // n, k_dim // n
     cfg = cfg.clip(m_loc, k_loc, n_dim)
+    from .. import resilience
+    from ..tune.autotuner import is_tracer
+
+    if resilience.enabled() and not is_tracer(a):
+        # eager calls only (see comm/allgather.py): watchdog + ladder,
+        # degraded fallback = local partial GEMM + XLA AllReduce
+        return resilience.guarded(
+            "gemm_ar",
+            lambda: _gemm_ar_core(mesh, axis, cfg, out_dtype, a, b),
+            family="gemm_ar", ranks=n,
+            payload_bytes=m_tot * n_dim * jnp.dtype(out_dtype).itemsize,
+            fallback=lambda: resilience.fallbacks.xla_gemm_ar(
+                a, b, mesh, axis, out_dtype),
+        )()
     return _gemm_ar_core(mesh, axis, cfg, out_dtype, a, b)

@@ -270,4 +270,18 @@ def gemm_rs(
 
     m_loc, k_loc = m_tot // n, k_dim // n
     cfg = cfg.clip(m_loc, k_loc, n_dim)
+    from .. import resilience
+    from ..tune.autotuner import is_tracer
+
+    if resilience.enabled() and not is_tracer(a):
+        # eager calls only (see comm/allgather.py): watchdog + ladder,
+        # degraded fallback = local partial GEMM + XLA ReduceScatter
+        return resilience.guarded(
+            "gemm_rs",
+            lambda: _gemm_rs_core(mesh, axis, cfg, out_dtype, a, b),
+            family="gemm_rs", ranks=n,
+            payload_bytes=m_loc * n_dim * jnp.dtype(out_dtype).itemsize * n,
+            fallback=lambda: resilience.fallbacks.xla_gemm_rs(
+                a, b, mesh, axis, out_dtype),
+        )()
     return _gemm_rs_core(mesh, axis, cfg, out_dtype, a, b)

@@ -1,0 +1,140 @@
+"""Runtime fault tolerance: bounded collectives, fault injection, and
+graceful degradation.
+
+Every collective in this framework ultimately spins on a semaphore
+(``lang/primitives.py``), and a device-side spin has no timeout — the
+failure mode device-initiated symmetric-memory communication is known
+for ("Demystifying NVSHMEM", PAPERS.md).  PR 2 (``tdt.analysis``) made
+the protocols statically verifiable; this package is the RUNTIME
+counterpart — detect a stuck collective, name the offending
+semaphore/chunk, and keep serving.  Three pillars
+(docs/robustness.md):
+
+- ``resilience.faults``    seedable, scoped fault injection hooked into
+  the same primitives-layer interception points the analysis recorder
+  uses (dropped/delayed notifies, stale recv credits, stragglers, rank
+  aborts), composable with record mode and — for the signal-shaped
+  classes — live kernels.
+- ``resilience.watchdog`` + ``resilience.simulate``   bounded waits: a
+  host-side deadline derived from ``tools/perf_model`` estimates x
+  ``TDT_WATCHDOG_SLACK``, raising :class:`CollectiveTimeoutError` with
+  a protocol-state diagnosis instead of hanging; the simulator executes
+  (faulty) recorded traces under tick deadlines and produces the same
+  named diagnosis.
+- ``resilience.policy`` + ``resilience.fallbacks``   the per-op failure
+  ladder: retry with backoff -> degrade to the equivalent ``jax.lax``
+  XLA collective -> sticky circuit breaker; health snapshot for the
+  engine's serve loop.
+
+Everything is OFF by default and gated by ``TDT_RESILIENCE=1`` (or
+:func:`enable`): a disabled guard site costs one cached-bool check and
+the collective entry points behave exactly as before.
+"""
+
+from __future__ import annotations
+
+from . import fallbacks, faults, matrix, policy, simulate, watchdog
+from .errors import (
+    CircuitOpenError,
+    CollectiveTimeoutError,
+    PendingWait,
+    TimeoutDiagnosis,
+)
+from .faults import (
+    FAULT_KINDS,
+    FaultKind,
+    FaultScope,
+    FaultSpec,
+    FaultyTraces,
+    RankAborted,
+    record_faulty_case,
+    sample_spec,
+    scoped,
+)
+from .matrix import run_matrix, verify_matrix
+from .policy import (
+    DEFAULT_POLICY,
+    CircuitBreaker,
+    RetryPolicy,
+    breaker,
+    guarded,
+    health_snapshot,
+    reset_breaker,
+    resilient_call,
+)
+from .simulate import SimResult, check_hazards, clean_ticks, run_bounded
+from .watchdog import call_with_deadline, deadline_ms, protocol_pending
+
+__all__ = [
+    "CircuitBreaker", "CircuitOpenError", "CollectiveTimeoutError",
+    "DEFAULT_POLICY", "FAULT_KINDS", "FaultKind", "FaultScope", "FaultSpec",
+    "FaultyTraces", "PendingWait", "RankAborted", "RetryPolicy", "SimResult",
+    "TimeoutDiagnosis", "breaker", "call_with_deadline", "check_hazards",
+    "clean_ticks", "deadline_ms", "enable", "enabled", "fallbacks", "faults",
+    "guarded", "health_snapshot", "matrix", "policy", "protocol_pending",
+    "record_faulty_case", "reset_breaker", "resilient_call", "run_bounded",
+    "run_matrix", "sample_spec", "scoped", "simulate", "suppress",
+    "suppressed_thunk", "verify_matrix", "watchdog",
+]
+
+
+def _env_enabled() -> bool:
+    from ..core.utils import env_flag
+
+    return env_flag("TDT_RESILIENCE")
+
+
+# cached like obs._ENABLED: a disabled guard site pays one global load
+_ENABLED = _env_enabled()
+
+import contextlib as _contextlib
+import threading as _threading
+
+_tls = _threading.local()
+
+
+def _suppressed() -> bool:
+    from .. import obs
+
+    # measurement-only traffic must not ride the failure ladder: a
+    # deliberately timed slow candidate would burn a watchdog deadline,
+    # feed the FALLBACK's time to the tuner, and walk the sticky
+    # breaker toward open.  Both this package's own suppression and
+    # obs's (the marker every measurement path already sets: autotune
+    # sweeps, serve warmup) disarm the guards on this thread.
+    return getattr(_tls, "depth", 0) > 0 or obs._suppressed()
+
+
+def enabled() -> bool:
+    """Whether the runtime guards are active (``TDT_RESILIENCE=1`` or
+    :func:`enable`, and not inside a :func:`suppress` /
+    ``obs.suppress`` block on this thread)."""
+    return _ENABLED and not _suppressed()
+
+
+def enable(on: bool | None = True) -> bool:
+    """Turn the runtime guards on/off; ``None`` re-reads
+    ``TDT_RESILIENCE``.  Returns the new state."""
+    global _ENABLED
+    _ENABLED = _env_enabled() if on is None else bool(on)
+    return _ENABLED
+
+
+@_contextlib.contextmanager
+def suppress():
+    """Disarm the runtime guards on this thread (measurement-only
+    traffic — see :func:`_suppressed`)."""
+    _tls.depth = getattr(_tls, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _tls.depth -= 1
+
+
+def suppressed_thunk(f):
+    """Wrap a measurement thunk so every later invocation runs
+    unguarded (``tune.autotuner`` wraps each candidate once)."""
+    def g():
+        with suppress():
+            return f()
+    return g

@@ -1,0 +1,112 @@
+"""The failure vocabulary of the runtime fault-tolerance layer.
+
+Every collective in this framework ultimately spins on a semaphore
+(``lang/primitives.py::wait`` / ``wait_recv``), and a device-side spin
+wait has NO timeout: a single dropped notify, stale recv credit, or dead
+rank hangs the whole mesh forever ("Demystifying NVSHMEM", PAPERS.md).
+The resilience layer converts that silent stall into a *named* event:
+:class:`CollectiveTimeoutError` carries a :class:`TimeoutDiagnosis` that
+says which rank is blocked on which semaphore, how many credits it holds
+vs needs, which destination chunk never arrived, and (when one exists)
+the wait-for cycle — the protocol-state metadata the static verifier
+(``tdt.analysis``) already knows how to extract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class PendingWait:
+    """One blocked wait point: the unit of a hang diagnosis."""
+
+    rank: int
+    sem: str            # semaphore label, e.g. "recv_sems[1]"
+    need: int           # credits the wait still requires
+    have: int           # credits currently available
+    event_index: int    # position in the rank's protocol trace
+    chunk: str | None = None   # dst region of the missing transfer, if known
+    source: int | None = None  # rank that should have produced the credit
+
+    def describe(self) -> str:
+        s = (f"rank {self.rank} blocked at event #{self.event_index} on "
+             f"semaphore {self.sem} (need {self.need}, have {self.have})")
+        if self.chunk is not None:
+            s += f"; missing transfer into {self.chunk}"
+            if self.source is not None:
+                s += f" from rank {self.source}"
+        return s
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeoutDiagnosis:
+    """Protocol-state snapshot attached to a collective timeout.
+
+    ``pending`` is empty for a *late completion* (straggler beyond the
+    deadline — the op would finish, just not in budget); non-empty for a
+    permanent stall.  ``static`` marks a diagnosis derived from the
+    protocol's recorded structure (the live device state is not
+    introspectable from the host once a kernel hangs) rather than from a
+    simulated execution.
+    """
+
+    kernel: str
+    ranks: int
+    pending: tuple[PendingWait, ...] = ()
+    cycle: tuple[int, ...] = ()
+    aborted: tuple[int, ...] = ()
+    note: str = ""
+    static: bool = False
+
+    def describe(self) -> str:
+        lines = []
+        if self.note:
+            lines.append(self.note)
+        lines.extend(p.describe() for p in self.pending)
+        if self.cycle:
+            lines.append("wait-for cycle: " +
+                         " -> ".join(f"rank {r}" for r in self.cycle))
+        if self.aborted:
+            lines.append("aborted rank(s): " +
+                         ", ".join(str(r) for r in self.aborted))
+        return "; ".join(lines) if lines else "no protocol state recorded"
+
+    def semaphores(self) -> tuple[str, ...]:
+        return tuple(sorted({p.sem for p in self.pending}))
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A collective exceeded its watchdog deadline (or is provably
+    stalled).  Replaces the un-debuggable infinite spin with an error
+    naming the pending semaphore/chunk; the policy layer
+    (``resilience.policy``) may catch it and degrade to the XLA
+    fallback."""
+
+    def __init__(self, op: str, deadline_ms: float | None,
+                 diagnosis: TimeoutDiagnosis | None = None):
+        self.op = op
+        self.deadline_ms = deadline_ms
+        self.diagnosis = diagnosis
+        head = f"collective {op!r}"
+        if deadline_ms is not None:
+            head += f" exceeded its watchdog deadline ({deadline_ms:.1f} ms)"
+        else:
+            head += " stalled"
+        body = diagnosis.describe() if diagnosis is not None else \
+            "no diagnosis available"
+        super().__init__(f"{head}: {body}")
+
+
+class CircuitOpenError(RuntimeError):
+    """The sticky circuit breaker for an op is open and no degraded
+    fallback exists — the caller must shed or reroute this op."""
+
+    def __init__(self, op: str, failures: int):
+        self.op = op
+        self.failures = failures
+        super().__init__(
+            f"circuit breaker for {op!r} is open after {failures} "
+            f"consecutive failures; no fallback is wired — call "
+            f"resilience.reset_breaker({op!r}) after remediation"
+        )

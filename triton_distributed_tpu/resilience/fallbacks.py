@@ -1,0 +1,139 @@
+"""XLA (``jax.lax``) equivalents of the fused collective ops — the
+degradation targets of the failure ladder.
+
+Each fallback computes the SAME global-semantics result as its fused
+Pallas counterpart (the goldens the op tests assert against), through
+XLA's own collectives: no Pallas kernel, no custom semaphore protocol —
+the code path a stuck ICI semaphore cannot reach.  Slower (no
+compute/communication overlap), but correct; that is the contract of
+"graceful degradation".
+
+Builders are cached per (mesh, axis, ndim/shape class) like the fused
+builders, so a degraded steady state pays the jit cache, not retracing.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core import compilation
+
+
+@functools.lru_cache(maxsize=None)
+def _build_all_gather(mesh, axis: str, ndim: int):
+    return compilation.jit_shard_map(
+        lambda s: jax.lax.all_gather(s, axis, axis=0, tiled=True),
+        mesh,
+        in_specs=P(axis, *([None] * (ndim - 1))),
+        out_specs=P(*([None] * ndim)),
+    )
+
+
+def xla_all_gather(x: jax.Array, mesh, axis: str) -> jax.Array:
+    """Degraded ``comm.allgather.all_gather``."""
+    return _build_all_gather(mesh, axis, x.ndim)(x)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_all_reduce(mesh, axis: str, out_dtype):
+    def local(s):
+        return jax.lax.psum(s, axis).astype(out_dtype)
+
+    return compilation.jit_shard_map(
+        local, mesh, in_specs=P(axis, None), out_specs=P(None, None),
+    )
+
+
+def xla_all_reduce(x: jax.Array, mesh, axis: str, out_dtype=None
+                   ) -> jax.Array:
+    """Degraded ``comm.allreduce.all_reduce``: x is (n*M, R) stacked
+    partials; returns the replicated (M, R) sum."""
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(x.dtype)
+    return _build_all_reduce(mesh, axis, out_dtype)(x)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_reduce_scatter(mesh, axis: str):
+    def local(s):
+        return jax.lax.psum_scatter(s, axis, scatter_dimension=0,
+                                    tiled=True)
+
+    return compilation.jit_shard_map(
+        local, mesh, in_specs=P(axis, None), out_specs=P(axis, None),
+    )
+
+
+def xla_reduce_scatter(x: jax.Array, mesh, axis: str) -> jax.Array:
+    """Degraded ``comm.reduce_scatter.reduce_scatter``: x is (n*M, R)
+    stacked partials; returns (M, R) sharded row-chunks of the sum."""
+    return _build_reduce_scatter(mesh, axis)(x)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_ag_gemm(mesh, axis: str, out_dtype):
+    def local(a_shard, b_shard):
+        ag = jax.lax.all_gather(a_shard, axis, axis=0, tiled=True)
+        return jnp.dot(ag, b_shard,
+                       preferred_element_type=jnp.float32).astype(out_dtype)
+
+    return compilation.jit_shard_map(
+        local, mesh,
+        in_specs=(P(axis, None), P(None, axis)),
+        out_specs=P(None, axis),
+    )
+
+
+def xla_ag_gemm(a: jax.Array, b: jax.Array, mesh, axis: str,
+                out_dtype=None) -> jax.Array:
+    """Degraded ``ops.ag_gemm.ag_gemm``: unfused AllGather + local GEMM."""
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
+    return _build_ag_gemm(mesh, axis, out_dtype)(a, b)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_gemm_rs(mesh, axis: str, out_dtype):
+    def local(a_shard, b_shard):
+        part = jnp.dot(a_shard, b_shard,
+                       preferred_element_type=jnp.float32)
+        return jax.lax.psum_scatter(
+            part, axis, scatter_dimension=0, tiled=True).astype(out_dtype)
+
+    return compilation.jit_shard_map(
+        local, mesh,
+        in_specs=(P(None, axis), P(axis, None)),
+        out_specs=P(axis, None),
+    )
+
+
+def xla_gemm_rs(a: jax.Array, b: jax.Array, mesh, axis: str,
+                out_dtype=None) -> jax.Array:
+    """Degraded ``ops.gemm_rs.gemm_rs``: local partial GEMM + XLA
+    ReduceScatter."""
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
+    return _build_gemm_rs(mesh, axis, out_dtype)(a, b)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_gemm_ar(mesh, axis: str, out_dtype):
+    def local(a_shard, b_shard):
+        part = jnp.dot(a_shard, b_shard,
+                       preferred_element_type=jnp.float32)
+        return jax.lax.psum(part, axis).astype(out_dtype)
+
+    return compilation.jit_shard_map(
+        local, mesh,
+        in_specs=(P(None, axis), P(axis, None)),
+        out_specs=P(None, None),
+    )
+
+
+def xla_gemm_ar(a: jax.Array, b: jax.Array, mesh, axis: str,
+                out_dtype=None) -> jax.Array:
+    """Degraded ``ops.gemm_ar.gemm_ar``: local partial GEMM + XLA
+    AllReduce."""
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
+    return _build_gemm_ar(mesh, axis, out_dtype)(a, b)

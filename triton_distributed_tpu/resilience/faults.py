@@ -1,0 +1,375 @@
+"""Seedable, scoped fault injection at the primitives layer.
+
+The harness hooks the SAME interception points the ``tdt.analysis``
+recorder uses (``lang/primitives.py``: every ``notify`` / ``wait`` /
+``remote_copy`` / ``wait_recv`` / ``wait_send`` / ``local_copy`` call
+consults the thread's active fault scope before dispatching), so a fault
+is injected where the wire would lose it — not by editing traces after
+the fact.  Five fault classes, the failure taxonomy of device-initiated
+symmetric-memory communication ("Demystifying NVSHMEM", PAPERS.md):
+
+==================  ======================================================
+``DROP_NOTIFY``     a semaphore signal is lost in flight.  On kernels with
+                    no flat ``notify`` (pure DMA protocols) the nth
+                    ``remote_copy``'s completion signal is lost instead
+                    (the recv DMA semaphore is never credited) — the same
+                    class seen from the DMA engine.
+``DELAY_NOTIFY``    the signal arrives, arbitrarily late (delivery delay
+                    in scheduler ticks).
+``STALE_CREDIT``    a leftover credit from a previous invocation sits on
+                    the semaphore the nth ``wait_recv``/``wait`` consumes,
+                    so the wait can pass BEFORE its data lands — the
+                    un-ACKed slot-reuse hazard.
+``STRAGGLER``       one rank enters the kernel late by ``delay`` ticks.
+``RANK_ABORT``      one rank dies mid-kernel: its nth primitive call
+                    raises and nothing after it executes.
+==================  ======================================================
+
+Injection composes with record mode: ``record_faulty_case`` records every
+rank of an ``analysis.registry`` kernel case with the victim rank's scope
+active, yielding :class:`FaultyTraces` (per-rank event lists plus timing
+annotations) that ``resilience.simulate.run_bounded`` executes under a
+deadline.  In LIVE (interpret / real hardware) mode the same scope makes
+``notify`` genuinely skip its ``semaphore_signal`` at trace time, baking
+the dropped signal into the built kernel; the time-shaped classes
+(delay / straggler) and DMA-signal loss have no host-side lever once the
+kernel is on the device and are record/simulation-only — the scope notes
+them in ``live_unsupported`` instead of silently passing
+(docs/robustness.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import enum
+
+from ..lang import primitives as dl
+from ..analysis.events import NotifyEv
+
+
+class FaultKind(enum.Enum):
+    DROP_NOTIFY = "drop_notify"
+    DELAY_NOTIFY = "delay_notify"
+    STALE_CREDIT = "stale_credit"
+    STRAGGLER = "straggler"
+    RANK_ABORT = "rank_abort"
+
+
+FAULT_KINDS = tuple(FaultKind)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One injected fault: ``kind`` at the victim ``rank``'s ``nth``
+    matching primitive call (0-based).  ``delay`` is in scheduler ticks
+    (DELAY_NOTIFY / STRAGGLER); ``amount`` overrides the stale credit
+    size (default: exactly what the targeted wait consumes)."""
+
+    kind: FaultKind
+    rank: int
+    nth: int = 0
+    delay: int = 0
+    amount: int | None = None
+
+
+class RankAborted(RuntimeError):
+    """Raised inside the victim rank's kernel body by RANK_ABORT: the
+    rank dies at this primitive call; the harness records the truncated
+    trace."""
+
+    def __init__(self, rank: int, at_event: int):
+        self.rank = rank
+        self.at_event = at_event
+        super().__init__(f"rank {rank} aborted at primitive call #{at_event}")
+
+
+class FaultScope:
+    """Per-thread interception state for ONE victim rank's execution.
+
+    ``lang.primitives`` calls ``on_*`` before dispatching each primitive;
+    the scope counts matching calls and fires at the nth.  ``on_notify``
+    and ``on_remote_copy`` return an ACTION the primitive applies
+    ("drop", ("delay", ticks), "drop_recv", or None); the primitive
+    reports recorded event positions back via ``mark_*`` so the harness
+    never has to re-derive them.  RANK_ABORT raises from the counting
+    step itself.
+    """
+
+    def __init__(self, spec: FaultSpec, *, has_wait_recv: bool = True):
+        self.spec = spec
+        self.has_wait_recv = has_wait_recv
+        self.counts: dict[str, int] = {}
+        self.total_calls = 0
+        self.fired = False
+        self.delayed_events: list[tuple[int, int]] = []  # (event pos, ticks)
+        self.dropped_recv_events: list[int] = []         # event positions
+        self.stale: list[tuple[tuple, int]] = []         # (sem key, amount)
+        self.live_unsupported: list[str] = []
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _tick(self, kind: str) -> int:
+        """Count one primitive call; returns this kind's 0-based ordinal.
+        RANK_ABORT fires on the TOTAL call ordinal (the rank dies at an
+        arbitrary point, whatever primitive happens to be there)."""
+        ordinal = self.counts.get(kind, 0)
+        self.counts[kind] = ordinal + 1
+        at = self.total_calls
+        self.total_calls += 1
+        if self.spec.kind is FaultKind.RANK_ABORT and at == self.spec.nth:
+            self.fired = True
+            raise RankAborted(self.spec.rank, at)
+        return ordinal
+
+    def _matches(self, kind: FaultKind, ordinal: int) -> bool:
+        return self.spec.kind is kind and ordinal == self.spec.nth
+
+    # -- interception points (called from lang.primitives) ------------------
+
+    def on_notify(self, sem, device_id, inc):
+        ordinal = self._tick("notify")
+        if self._matches(FaultKind.DROP_NOTIFY, ordinal):
+            self.fired = True
+            return "drop"
+        if self._matches(FaultKind.DELAY_NOTIFY, ordinal):
+            self.fired = True
+            return ("delay", max(int(self.spec.delay), 1))
+        return None
+
+    def on_wait(self, sem, value):
+        ordinal = self._tick("wait")
+        if self._matches(FaultKind.STALE_CREDIT, ordinal) and \
+                not self.has_wait_recv:
+            self.fired = True
+            amount = self.spec.amount if self.spec.amount is not None \
+                else int(value)
+            # live-mode semaphores have no symbolic identity; the key is
+            # only needed by the record-mode harness
+            self.stale.append((self._sem_key(sem), amount))
+            return ("stale", amount)
+        return None
+
+    @staticmethod
+    def _sem_key(sem):
+        key = getattr(sem, "key", None)
+        return key() if callable(key) else None
+
+    def on_remote_copy(self, src, dst, send_sem, recv_sem, device_id):
+        ordinal = self._tick("remote_copy")
+        if self._matches(FaultKind.DROP_NOTIFY, ordinal) and \
+                self.counts.get("notify", 0) == 0:
+            # DMA-only protocol: lose this copy's completion signal
+            self.fired = True
+            return "drop_recv"
+        return None
+
+    def on_local_copy(self, src, dst, sem):
+        self._tick("local_copy")
+        return None
+
+    def on_wait_recv(self, dst_ref, sem):
+        ordinal = self._tick("wait_recv")
+        if self._matches(FaultKind.STALE_CREDIT, ordinal) and \
+                self.has_wait_recv:
+            self.fired = True
+            amount = self.spec.amount
+            if amount is None:
+                region = getattr(dst_ref, "region", None)
+                amount = region().elements() if region is not None else 1
+            self.stale.append((self._sem_key(sem), amount))
+        return None
+
+    def on_wait_send(self, src_ref, sem):
+        self._tick("wait_send")
+        return None
+
+    # -- result plumbing (called from lang.primitives) ----------------------
+
+    def mark_delayed(self, event_pos: int, ticks: int) -> None:
+        self.delayed_events.append((event_pos, ticks))
+
+    def mark_dropped_recv(self, event_pos: int) -> None:
+        self.dropped_recv_events.append(event_pos)
+
+    def mark_live_unsupported(self, what: str) -> None:
+        self.live_unsupported.append(what)
+
+
+# modules whose @lru_cache'd builders close over pallas_call kernels: a
+# LIVE fault fires at trace time, so a faulty kernel must never persist
+# in (nor a pre-cached clean kernel mask injection from) these caches
+_LIVE_BUILDER_MODULES = (
+    "triton_distributed_tpu.comm.allgather",
+    "triton_distributed_tpu.comm.allreduce",
+    "triton_distributed_tpu.comm.reduce_scatter",
+    "triton_distributed_tpu.comm.all_to_all",
+    "triton_distributed_tpu.ops.ag_gemm",
+    "triton_distributed_tpu.ops.gemm_rs",
+    "triton_distributed_tpu.ops.gemm_ar",
+    "triton_distributed_tpu.resilience.fallbacks",
+)
+
+
+def _clear_live_kernel_caches() -> None:
+    import sys
+
+    for name in _LIVE_BUILDER_MODULES:
+        mod = sys.modules.get(name)
+        if mod is None:
+            continue
+        for attr in list(vars(mod).values()):
+            clear = getattr(attr, "cache_clear", None)
+            if callable(clear):
+                try:
+                    clear()
+                except Exception:
+                    pass
+
+
+@contextlib.contextmanager
+def scoped(scope: FaultScope | None):
+    """Install ``scope`` as this thread's active fault scope for the
+    duration (None = no-op).  Composes with record mode: the scope is
+    consulted BEFORE the recorder, so a dropped signal never reaches the
+    recorded trace — exactly as it never reaches the wire.
+
+    LIVE usage (no recorder active): trace-time injection interacts with
+    the builders' ``lru_cache``s — a pre-cached clean kernel would never
+    retrace (injection silently no-ops), and a kernel traced under the
+    scope has the fault baked in forever.  Both are handled by clearing
+    the kernel-builder caches on entry AND exit: the scope always sees a
+    fresh trace, and the faulty kernel never outlives it."""
+    if scope is None:
+        yield None
+        return
+    if dl.active_fault_scope() is not None:
+        raise RuntimeError("fault scopes do not nest")
+    live = dl.active_recorder() is None
+    if live:
+        _clear_live_kernel_caches()
+    dl._set_fault_scope(scope)
+    try:
+        yield scope
+    finally:
+        dl._set_fault_scope(None)
+        if live:
+            _clear_live_kernel_caches()
+
+
+# ---------------------------------------------------------------------------
+# recording a faulty execution of a registry kernel case
+
+
+@dataclasses.dataclass
+class FaultyTraces:
+    """Per-rank recorded traces of one kernel case under one fault, plus
+    the timing annotations the bounded simulator consumes."""
+
+    kernel: str
+    n: int
+    spec: FaultSpec
+    traces: list                        # per-rank event lists
+    start_delay: dict[int, int]         # rank -> entry delay ticks
+    notify_delay: dict[tuple[int, int], int]  # (rank, event pos) -> ticks
+    drop_recv: set[tuple[int, int]]     # (rank, event pos) of lost signals
+    aborted: set[int]
+    fired: bool                         # the fault found its target
+
+
+def record_faulty_case(case, spec: FaultSpec) -> FaultyTraces:
+    """Record all N ranks of an ``analysis.registry.KernelCase`` with
+    ``spec`` injected on its victim rank, via the primitives-layer
+    interception points."""
+    from ..analysis.record import recording
+
+    if not 0 <= spec.rank < case.n:
+        raise ValueError(f"victim rank {spec.rank} outside [0, {case.n})")
+    has_recv = _case_has_wait_recv(case) \
+        if spec.kind is FaultKind.STALE_CREDIT else True
+    traces: list = []
+    start_delay: dict[int, int] = {}
+    notify_delay: dict[tuple[int, int], int] = {}
+    drop_recv: set[tuple[int, int]] = set()
+    aborted: set[int] = set()
+    fired = False
+    for rank in range(case.n):
+        _, thunk = case.make(rank)
+        scope = FaultScope(spec, has_wait_recv=has_recv) \
+            if rank == spec.rank else None
+        with recording((("tp", case.n),), {"tp": rank}) as rec:
+            with scoped(scope):
+                try:
+                    thunk()
+                except RankAborted:
+                    aborted.add(rank)
+        events = list(rec.events)
+        if scope is not None:
+            fired = scope.fired
+            if spec.kind is FaultKind.STRAGGLER:
+                start_delay[rank] = max(int(spec.delay), 1)
+                fired = True
+            for pos, ticks in scope.delayed_events:
+                notify_delay[(rank, pos)] = ticks
+            drop_recv.update((rank, p) for p in scope.dropped_recv_events)
+            # a stale credit pre-exists the kernel: it lands as a credit
+            # event BEFORE the rank's first real event
+            for sem_key, amount in scope.stale:
+                events.insert(0, NotifyEv(sem_key, rank, amount))
+    # single-axis harness meshes: device id == team rank, so the stale
+    # self-credit above targets the victim's own instance
+        traces.append(events)
+    return FaultyTraces(case.name, case.n, spec, traces, start_delay,
+                        notify_delay, drop_recv, aborted, fired)
+
+
+def _case_has_wait_recv(case) -> bool:
+    from ..analysis.record import record_kernel
+
+    _, thunk = case.make(0)
+    rec = record_kernel(thunk, n=case.n, rank=0)
+    return "wait_recv" in rec.signature
+
+
+def sample_spec(case, kind: FaultKind, rng) -> FaultSpec:
+    """Seedable target selection: pick a victim rank and a valid nth for
+    ``kind`` from the case's clean trace structure (``rng``: a
+    ``random.Random``)."""
+    from ..analysis.record import record_kernel
+
+    rank = rng.randrange(case.n)
+    _, thunk = case.make(rank)
+    rec = record_kernel(thunk, n=case.n, rank=rank)
+    sig = rec.signature
+
+    def count(name: str) -> int:
+        return sum(1 for s in sig if s == name)
+
+    if kind is FaultKind.STRAGGLER:
+        return FaultSpec(kind, rank, delay=rng.randrange(1, 8))
+    if kind is FaultKind.RANK_ABORT:
+        total = sum(count(k) for k in ("notify", "wait", "remote_copy",
+                                       "local_copy", "wait_recv",
+                                       "wait_send"))
+        nth = rng.randrange(max(total, 1))
+        return FaultSpec(kind, rank, nth=nth)
+    if kind in (FaultKind.DROP_NOTIFY, FaultKind.DELAY_NOTIFY):
+        n_not = count("notify")
+        if n_not == 0 and kind is FaultKind.DROP_NOTIFY:
+            n_copy = count("remote_copy")
+            if n_copy == 0:
+                raise ValueError(
+                    f"{case.name}: no notify or remote_copy to drop"
+                )
+            return FaultSpec(kind, rank, nth=rng.randrange(n_copy))
+        if n_not == 0:
+            raise ValueError(f"{case.name}: no notify to delay")
+        return FaultSpec(kind, rank, nth=rng.randrange(n_not),
+                         delay=rng.randrange(1, 8))
+    # STALE_CREDIT: an observable stale credit targets a wait the victim
+    # actually executes
+    has_recv = "wait_recv" in sig
+    n_tgt = count("wait_recv") if has_recv else count("wait")
+    if n_tgt == 0:
+        raise ValueError(f"{case.name}: no wait to pre-credit")
+    return FaultSpec(kind, rank, nth=rng.randrange(n_tgt))

@@ -1,0 +1,169 @@
+"""The fault-injection matrix: every fault class against every guarded
+kernel family, headlessly (CPU-only, no interpret mode, no hardware).
+
+For each (kernel case, fault class) the matrix:
+
+1. samples a seedable injection target from the clean trace structure
+   (``faults.sample_spec``),
+2. records the faulty execution through the primitives-layer
+   interception points (``faults.record_faulty_case``),
+3. runs the bounded simulator under a deadline derived from the
+   fault-free completion ticks x slack (the simulator-world analogue of
+   the live watchdog's perf-model x slack deadline), and
+4. classifies the outcome:
+
+   - ``detected``  — :class:`CollectiveTimeoutError` raised (stall or
+     beyond-deadline completion) naming the pending semaphore/chunk, OR
+     the protocol completed but the hazard check names a credit
+     imbalance (the stale-credit corruption class);
+   - ``survived``  — completed within deadline with clean credits: the
+     protocol absorbed the fault and the results are trustworthy.
+
+``verify_matrix`` turns the rows into CI problems: a fault class that is
+neither detected nor survived anywhere it applies (or a detection that
+fails to NAME a semaphore/chunk) fails ``scripts/tdt_lint.py --faults``.
+"""
+
+from __future__ import annotations
+
+import random
+
+from .errors import CollectiveTimeoutError
+from .faults import (
+    FAULT_KINDS,
+    FaultKind,
+    record_faulty_case,
+    sample_spec,
+)
+from .simulate import check_hazards, clean_ticks, run_bounded
+
+# simulator-tick deadline: clean completion x slack + floor; injected
+# delays are sampled in [1, 8) ticks so the time-shaped faults land
+# within slack (the "survived" leg) — the beyond-slack leg is exercised
+# separately (tests/test_resilience.py straggler-overrun case)
+DEADLINE_SLACK = 4
+DEADLINE_FLOOR = 16
+
+DEFAULT_KERNELS = (
+    "allgather/push_1shot",
+    "reduce_scatter/ring",
+    "allreduce/two_shot",
+    "all_to_all/dispatch",
+    "gemm_rs/ring",
+    "gemm_ar/ring",
+)
+
+# classes whose injection MUST be caught: they stall or corrupt
+MUST_DETECT = (FaultKind.DROP_NOTIFY, FaultKind.STALE_CREDIT,
+               FaultKind.RANK_ABORT)
+
+
+def _cases(kernels, n: int):
+    from ..analysis.registry import all_cases
+
+    by_name = {c.name: c for c in all_cases(ranks=(n,))}
+    out = []
+    for name in kernels:
+        if name not in by_name:
+            raise KeyError(f"unknown kernel case {name!r}; known: "
+                           f"{sorted(by_name)}")
+        out.append(by_name[name])
+    return out
+
+
+def run_case(case, kind: FaultKind, rng) -> dict | None:
+    """One matrix cell; None when the fault class has no valid target in
+    this kernel (e.g. DELAY_NOTIFY on a pure-DMA protocol)."""
+    from .. import obs
+
+    try:
+        spec = sample_spec(case, kind, rng)
+    except ValueError:
+        return None
+    ft = record_faulty_case(case, spec)
+    deadline = clean_ticks(case) * DEADLINE_SLACK + DEADLINE_FLOOR
+    row = {
+        "kernel": case.name, "ranks": case.n, "fault": kind.value,
+        "victim_rank": spec.rank, "nth": spec.nth, "fired": ft.fired,
+        "deadline_ticks": deadline,
+    }
+    if obs.enabled():
+        obs.counter("resilience_faults_injected", kernel=case.family,
+                    fault=kind.value).inc()
+    try:
+        res = run_bounded(ft, deadline_ticks=deadline)
+    except CollectiveTimeoutError as e:
+        row["outcome"] = "detected"
+        row["detail"] = str(e)
+        row["named"] = list(e.diagnosis.semaphores()) \
+            if e.diagnosis is not None else []
+        if obs.enabled():
+            obs.counter("resilience_timeouts", op=case.name).inc()
+        return row
+    hazards = check_hazards(ft)
+    if hazards:
+        row["outcome"] = "detected"
+        row["detail"] = "; ".join(hazards)
+        row["named"] = [h.split(":", 1)[0] for h in hazards]
+    else:
+        row["outcome"] = "survived"
+        row["detail"] = (f"completed at tick {res.ticks} <= deadline "
+                         f"{deadline} with balanced credits")
+        row["named"] = []
+    return row
+
+
+def run_matrix(seed: int = 0, *, kernels=DEFAULT_KERNELS, ranks: int = 4
+               ) -> list[dict]:
+    """The full (kernel x fault class) sweep; rows sorted by kernel."""
+    rng = random.Random(seed)
+    rows = []
+    for case in _cases(kernels, ranks):
+        for kind in FAULT_KINDS:
+            row = run_case(case, kind, rng)
+            if row is not None:
+                rows.append(row)
+    return rows
+
+
+def verify_matrix(rows: list[dict], *, min_kernels_per_class: int = 3
+                  ) -> list[str]:
+    """CI problems in a matrix run (empty = pass):
+
+    - a fired fault whose outcome is neither detected nor survived
+      (cannot happen by construction — guards classifier drift);
+    - a MUST_DETECT class that some kernel survived silently;
+    - a detection with no semaphore/chunk named;
+    - a fault class applicable to fewer than ``min_kernels_per_class``
+      kernels (matrix rot).
+    """
+    problems = []
+    per_class: dict[str, int] = {}
+    for row in rows:
+        key = f"{row['kernel']} x {row['fault']}"
+        per_class[row["fault"]] = per_class.get(row["fault"], 0) + 1
+        if not row["fired"]:
+            problems.append(f"{key}: injection never reached its target "
+                            f"(nth={row['nth']} sampling drifted)")
+            continue
+        if row["outcome"] not in ("detected", "survived"):
+            problems.append(f"{key}: unclassified outcome {row['outcome']!r}")
+        if row["fault"] in {k.value for k in MUST_DETECT} and \
+                row["outcome"] != "detected":
+            problems.append(
+                f"{key}: a {row['fault']} fault completed undetected — "
+                f"the protocol would serve corrupt results"
+            )
+        if row["outcome"] == "detected" and not row["named"]:
+            problems.append(
+                f"{key}: detected but no semaphore/chunk named — the "
+                f"diagnosis lost its protocol state"
+            )
+    for kind in FAULT_KINDS:
+        if per_class.get(kind.value, 0) < min_kernels_per_class:
+            problems.append(
+                f"fault class {kind.value!r} exercised on only "
+                f"{per_class.get(kind.value, 0)} kernel(s) "
+                f"(need >= {min_kernels_per_class})"
+            )
+    return problems

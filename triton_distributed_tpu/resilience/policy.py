@@ -1,0 +1,225 @@
+"""Retry, graceful degradation, and the sticky circuit breaker.
+
+The per-op failure ladder (reference world: NCCL/NVSHMEM jobs simply
+die; a serving system must keep answering):
+
+1. **retry with backoff** — a timeout may be transient (interference, a
+   straggler beyond slack); the fused kernel is retried up to
+   ``max_retries`` times with exponential backoff.
+2. **degrade to the XLA collective** — the fused Pallas kernel is a
+   performance optimization over a semantically equal ``jax.lax``
+   collective (``resilience.fallbacks``); when retries are exhausted the
+   op completes through XLA, numerically correct and merely slower.
+3. **sticky circuit breaker** — after ``breaker_threshold`` consecutive
+   ladder-bottom failures the breaker OPENS and stays open (sticky):
+   every subsequent call goes straight to the fallback without paying
+   the timeout, until an operator calls :func:`reset_breaker` after
+   remediation.  A flapping link must not cost a deadline per request.
+
+Only :class:`~.errors.CollectiveTimeoutError` (and explicitly listed
+exception types) ride the ladder: a shape/sharding ``ValueError`` is a
+caller bug and propagates immediately.
+
+``obs`` counters (``docs/observability.md``): ``resilience_timeouts``
+(bumped by the watchdog), ``resilience_retries``,
+``resilience_degraded_calls``, ``resilience_breaker_open``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from .errors import CircuitOpenError, CollectiveTimeoutError
+from . import watchdog
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Ladder knobs for one op class."""
+
+    max_retries: int = 1
+    backoff_ms: float = 25.0
+    backoff_factor: float = 2.0
+    breaker_threshold: int = 3
+    retry_on: tuple[type, ...] = (CollectiveTimeoutError,)
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker; OPEN is sticky until reset."""
+
+    def __init__(self, op: str, threshold: int):
+        self.op = op
+        self.threshold = threshold
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._open = False
+
+    @property
+    def open(self) -> bool:
+        return self._open
+
+    @property
+    def failures(self) -> int:
+        return self._consecutive
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0   # sticky: success does not close
+
+    def record_failure(self) -> bool:
+        """Count one ladder-bottom failure; returns True when this
+        failure opened the breaker."""
+        with self._lock:
+            self._consecutive += 1
+            if not self._open and self._consecutive >= self.threshold:
+                self._open = True
+                from .. import obs
+
+                if obs.enabled():
+                    obs.counter("resilience_breaker_open", op=self.op).inc()
+                return True
+        return False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._open = False
+
+
+_BREAKERS: dict[str, CircuitBreaker] = {}
+_BREAKERS_LOCK = threading.Lock()
+_LAST_ERROR: dict[str, str] = {}
+
+
+def breaker(op: str, threshold: int | None = None) -> CircuitBreaker:
+    """Get-or-create the op's breaker.  An explicit ``threshold``
+    updates an existing breaker too (the LATEST policy governs — a
+    cached breaker must not silently pin the first caller's value)."""
+    b = _BREAKERS.get(op)
+    if b is None:
+        with _BREAKERS_LOCK:
+            b = _BREAKERS.get(op)
+            if b is None:
+                b = CircuitBreaker(
+                    op, threshold if threshold is not None
+                    else DEFAULT_POLICY.breaker_threshold)
+                _BREAKERS[op] = b
+    if threshold is not None and b.threshold != threshold:
+        with b._lock:
+            b.threshold = threshold
+    return b
+
+
+def reset_breaker(op: str | None = None) -> None:
+    """Close the breaker for ``op`` (None = all) after remediation."""
+    with _BREAKERS_LOCK:
+        targets = [_BREAKERS[op]] if op in _BREAKERS else (
+            list(_BREAKERS.values()) if op is None else [])
+    for b in targets:
+        b.reset()
+
+
+def resilient_call(op: str, thunk, *, fallback=None,
+                   deadline_ms: float | None = None,
+                   policy: RetryPolicy = DEFAULT_POLICY,
+                   family: str | None = None, ranks: int | None = None):
+    """Run ``thunk`` down the failure ladder (see module docstring).
+
+    ``fallback`` (a zero-arg thunk computing the XLA-equivalent result)
+    enables degradation; without one, the final error propagates and an
+    open breaker raises :class:`CircuitOpenError` immediately.
+    """
+    from .. import obs
+
+    br = breaker(op, policy.breaker_threshold)
+    if br.open:
+        if fallback is None:
+            raise CircuitOpenError(op, br.failures)
+        if obs.enabled():
+            obs.counter("resilience_degraded_calls", op=op,
+                        reason="breaker_open").inc()
+        return fallback()
+
+    last: BaseException | None = None
+    backoff = policy.backoff_ms
+    for attempt in range(policy.max_retries + 1):
+        try:
+            result = watchdog.call_with_deadline(
+                op, thunk, deadline_ms, family=family, ranks=ranks)
+            br.record_success()
+            return result
+        except policy.retry_on as e:
+            last = e
+            _LAST_ERROR[op] = str(e)
+            if attempt < policy.max_retries:
+                if obs.enabled():
+                    obs.counter("resilience_retries", op=op).inc()
+                if backoff > 0:
+                    time.sleep(backoff / 1e3)
+                backoff *= policy.backoff_factor
+
+    br.record_failure()
+    if fallback is not None:
+        if obs.enabled():
+            obs.counter("resilience_degraded_calls", op=op,
+                        reason="retries_exhausted").inc()
+        result = fallback()
+        return result
+    assert last is not None
+    raise last
+
+
+def guarded(op: str, thunk, *, fallback=None, payload_bytes: int = 0,
+            ranks: int = 1, family: str | None = None,
+            policy: RetryPolicy = DEFAULT_POLICY):
+    """The shape every ``comm``/``ops`` entry point wires: returns a
+    zero-arg thunk running ``thunk`` under the perf-model-derived
+    watchdog deadline and the failure ladder.  Composes under
+    ``obs.comm_call`` so the recorded span covers retries and the
+    degraded path too."""
+    dl = watchdog.deadline_ms(op, payload_bytes=payload_bytes,
+                              num_ranks=ranks)
+
+    def run():
+        return resilient_call(op, thunk, fallback=fallback, deadline_ms=dl,
+                              policy=policy, family=family, ranks=ranks)
+    return run
+
+
+def health_snapshot() -> dict:
+    """Point-in-time serving-health view: breaker states, last errors,
+    and the resilience counters — the engine's ``/health`` payload."""
+    from .. import obs
+    from ..obs.registry import REGISTRY
+
+    counters = {}
+    for row in REGISTRY.snapshot():
+        if row["name"].startswith("resilience_") and \
+                row["kind"] == "counter":
+            label = ",".join(f"{k}={v}" for k, v in
+                             sorted(row["labels"].items()))
+            counters[f"{row['name']}{{{label}}}"] = row["value"]
+    with _BREAKERS_LOCK:
+        breakers = {
+            op: {"open": b.open, "consecutive_failures": b.failures}
+            for op, b in sorted(_BREAKERS.items())
+        }
+    degraded = any(b["open"] for b in breakers.values())
+    return {
+        "status": "degraded" if degraded else "ok",
+        "obs_enabled": obs.enabled(),
+        "breakers": breakers,
+        "last_errors": dict(sorted(_LAST_ERROR.items())),
+        "counters": counters,
+    }
+
+
+def _reset_state_for_tests() -> None:
+    with _BREAKERS_LOCK:
+        _BREAKERS.clear()
+        _LAST_ERROR.clear()

@@ -1,0 +1,237 @@
+"""Bounded execution of composed (possibly faulty) protocol traces.
+
+The static verifier's scheduler (``analysis.checks._simulate``) answers
+"does an execution exist?"; this module answers the RUNTIME questions a
+watchdog needs: *when* does the protocol finish under injected timing
+faults, and — when it cannot finish — *which* semaphore/chunk is it
+stuck on?  The model is a discrete-tick maximal execution:
+
+- every executed event advances its rank's local clock by one tick;
+- a wait completes at ``max(own clock, ready time of the credits it
+  consumes) + 1`` — an injected delivery delay (DELAY_NOTIFY) or entry
+  delay (STRAGGLER) therefore propagates through the wait-for structure
+  exactly like real skew;
+- credit AVAILABILITY ignores ready times (credits only ever accumulate,
+  so the maximal execution stays schedule-insensitive: a rank blocks iff
+  it blocks in every interleaving);
+- a dropped completion signal (``drop_recv``) issues the data write but
+  never credits the recv semaphore; an aborted rank's trace simply ends.
+
+``run_bounded`` returns a :class:`SimResult` on completion and raises
+:class:`~.errors.CollectiveTimeoutError` on a permanent stall, with the
+pending semaphores, missing destination chunks, responsible source ranks
+and the wait-for cycle named.  ``check_hazards`` runs the signal-balance
+and unsettled-write checks over the same faulty traces — the detector
+for faults that do NOT stall (a stale credit lets the protocol "finish"
+with corrupt data; the surplus/unsettled write names it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+
+from ..analysis.events import CopyEv, NotifyEv, WaitEv, sem_label
+from .errors import CollectiveTimeoutError, PendingWait, TimeoutDiagnosis
+from .faults import FaultyTraces
+
+
+@dataclasses.dataclass(frozen=True)
+class SimResult:
+    kernel: str
+    n: int
+    ticks: int                       # completion time (max rank clock)
+    clean_ticks: int | None = None   # fault-free completion, if computed
+
+
+@dataclasses.dataclass
+class _Credit:
+    amount: int
+    ready: int           # tick at which the credit becomes consumable
+
+
+def run_bounded(ft: FaultyTraces, *, deadline_ticks: int | None = None,
+                op: str | None = None) -> SimResult:
+    """Execute the composed traces to completion or a provable stall.
+
+    ``deadline_ticks`` bounds the COMPLETION time: a protocol that
+    finishes later than the deadline (straggler/delay beyond the slack)
+    raises the same :class:`CollectiveTimeoutError` a host watchdog
+    would, with the overrun described.  ``None`` = unbounded (only
+    permanent stalls raise).
+    """
+    n, traces = ft.n, ft.traces
+    op = op or ft.kernel
+    credits: dict[tuple[int, tuple], deque[_Credit]] = {}
+    pcs = [0] * n
+    clocks = [ft.start_delay.get(r, 0) for r in range(n)]
+
+    def add_credit(rank, sem, amount, ready):
+        credits.setdefault((rank, sem), deque()).append(
+            _Credit(amount, ready))
+
+    def available(rank, sem) -> int:
+        return sum(c.amount for c in credits.get((rank, sem), ()))
+
+    def step(r) -> bool:
+        if pcs[r] >= len(traces[r]):
+            return False
+        ev = traces[r][pcs[r]]
+        t = clocks[r]
+        if isinstance(ev, WaitEv):
+            if available(r, ev.sem) < ev.amount:
+                return False
+            need = ev.amount
+            q = credits[(r, ev.sem)]
+            latest = t
+            while need > 0:
+                c = q[0]
+                take = min(need, c.amount)
+                c.amount -= take
+                need -= take
+                latest = max(latest, c.ready)
+                if c.amount == 0:
+                    q.popleft()
+            clocks[r] = latest + 1
+        elif isinstance(ev, NotifyEv):
+            ready = t + ft.notify_delay.get((r, pcs[r]), 0)
+            add_credit(ev.target, ev.sem, ev.amount, ready)
+            clocks[r] = t + 1
+        elif isinstance(ev, CopyEv):
+            if ev.send_sem is not None:
+                add_credit(r, ev.send_sem, ev.src.elements(), t)
+            if (r, pcs[r]) not in ft.drop_recv:
+                add_credit(ev.dst_rank, ev.recv_sem, ev.dst.elements(), t)
+            clocks[r] = t + 1
+        else:  # ComputeEv and anything credit-neutral
+            clocks[r] = t + 1
+        pcs[r] += 1
+        return True
+
+    progress = True
+    while progress:
+        progress = False
+        for r in range(n):
+            while step(r):
+                progress = True
+
+    if all(pcs[r] >= len(traces[r]) for r in range(n)):
+        ticks = max(clocks) if clocks else 0
+        if deadline_ticks is not None and ticks > deadline_ticks:
+            slow = max(range(n), key=lambda r: clocks[r])
+            raise CollectiveTimeoutError(op, float(deadline_ticks),
+                TimeoutDiagnosis(
+                    ft.kernel, n, aborted=tuple(sorted(ft.aborted)),
+                    note=(f"completed at tick {ticks} > deadline "
+                          f"{deadline_ticks} (rank {slow} finished last — "
+                          f"straggler/delayed-signal beyond the watchdog "
+                          f"slack)"),
+                ))
+        return SimResult(ft.kernel, n, ticks)
+
+    # permanent stall: name every blocked wait, its missing producer,
+    # and the wait-for cycle
+    blocked = {r: traces[r][pcs[r]] for r in range(n)
+               if pcs[r] < len(traces[r])}
+    pending: list[PendingWait] = []
+    edges: dict[int, set[int]] = {}
+    for r, ev in sorted(blocked.items()):
+        chunk = source = None
+        producers: set[int] = set()
+        for p in range(n):
+            for evp in traces[p][pcs[p]:]:
+                if isinstance(evp, NotifyEv) and evp.target == r \
+                        and evp.sem == ev.sem:
+                    producers.add(p)
+                elif isinstance(evp, CopyEv) and evp.dst_rank == r \
+                        and evp.recv_sem == ev.sem:
+                    producers.add(p)
+                    chunk, source = evp.dst.label(), p
+        if chunk is None:
+            # the transfer may have EXECUTED with its signal dropped
+            for (p, pos) in ft.drop_recv:
+                evp = traces[p][pos]
+                if evp.dst_rank == r and evp.recv_sem == ev.sem:
+                    chunk, source = evp.dst.label(), p
+        if source is None and ft.aborted:
+            source = next(iter(sorted(ft.aborted)))
+        pending.append(PendingWait(
+            r, sem_label(ev.sem), ev.amount, available(r, ev.sem),
+            pcs[r], chunk=chunk, source=source,
+        ))
+        edges[r] = {p for p in producers if p in blocked}
+    diag = TimeoutDiagnosis(
+        ft.kernel, n, pending=tuple(pending), cycle=tuple(_cycle(edges)),
+        aborted=tuple(sorted(ft.aborted)),
+        note="protocol is permanently stalled (no interleaving can make "
+             "progress)",
+    )
+    raise CollectiveTimeoutError(op, None, diag)
+
+
+def _cycle(edges: dict[int, set[int]]) -> list[int]:
+    for start in sorted(edges):
+        path, node = [start], start
+        for _ in range(len(edges) + 1):
+            nxts = sorted(edges.get(node, ()))
+            if not nxts:
+                break
+            node = nxts[0]
+            if node in path:
+                return path[path.index(node):] + [node]
+            path.append(node)
+    return []
+
+
+# ---------------------------------------------------------------------------
+# hazard checks for faults that complete
+
+
+def check_hazards(ft: FaultyTraces) -> list[str]:
+    """Signal-balance over the faulty traces: a fault that does not
+    stall the protocol still corrupts it when credits no longer balance
+    — a surplus (stale credit) lets a FUTURE wait pass before its data
+    lands; a deficit that happened not to starve this invocation starves
+    the next.  Returns human-readable findings naming the semaphore."""
+    produced: dict[tuple[int, tuple], int] = {}
+    consumed: dict[tuple[int, tuple], int] = {}
+    for r, events in enumerate(ft.traces):
+        for pos, ev in enumerate(events):
+            if isinstance(ev, NotifyEv):
+                key = (ev.target, ev.sem)
+                produced[key] = produced.get(key, 0) + ev.amount
+            elif isinstance(ev, CopyEv):
+                if ev.send_sem is not None:
+                    key = (r, ev.send_sem)
+                    produced[key] = produced.get(key, 0) + ev.src.elements()
+                if (r, pos) not in ft.drop_recv:
+                    key = (ev.dst_rank, ev.recv_sem)
+                    produced[key] = produced.get(key, 0) + ev.dst.elements()
+            elif isinstance(ev, WaitEv):
+                key = (r, ev.sem)
+                consumed[key] = consumed.get(key, 0) + ev.amount
+    findings = []
+    for key in sorted(set(produced) | set(consumed)):
+        p, c = produced.get(key, 0), consumed.get(key, 0)
+        if p != c:
+            rank, sem = key
+            what = ("stale surplus: a future wait passes before its data "
+                    "lands" if p > c else
+                    "credit deficit: the next invocation's wait starves")
+            findings.append(
+                f"semaphore {sem_label(sem)} on rank {rank}: produced {p} "
+                f"!= consumed {c} — {what}"
+            )
+    return findings
+
+
+def clean_ticks(case) -> int:
+    """Fault-free completion ticks of a registry kernel case — the
+    simulator-world analogue of the perf-model estimate the live
+    watchdog derives deadlines from."""
+    from .faults import FaultKind, FaultSpec, record_faulty_case
+
+    # a spec whose nth is unreachable never fires: records clean traces
+    ft = record_faulty_case(
+        case, FaultSpec(FaultKind.DELAY_NOTIFY, rank=0, nth=10 ** 9))
+    return run_bounded(ft).ticks

@@ -1,0 +1,197 @@
+"""Host-side collective watchdog: bounded waits for unbounded spins.
+
+A Pallas semaphore wait has no timeout, so a lost signal parks the
+device — and the host call that dispatched the collective — forever.
+The watchdog bounds the HOST-visible wall time instead: every guarded
+``comm``/``ops`` entry point runs under a deadline derived from the
+``tools/perf_model`` speed-of-light estimate for its shape times a
+configurable slack (``TDT_WATCHDOG_SLACK``, default 64x — generous
+enough for autotune noise, interference and retries, still finite),
+plus a floor (``TDT_WATCHDOG_FLOOR_MS``) covering dispatch/compile
+fixed costs; the floor is raised massively under interpret mode, where
+a simulated collective costs ~100 ms regardless of size.
+
+On expiry :func:`call_with_deadline` raises
+:class:`~.errors.CollectiveTimeoutError` carrying a STATIC protocol
+diagnosis (``protocol_pending``): the live device state is not
+introspectable from the host once a kernel hangs, but the protocol's
+wait structure is — the ``tdt.analysis`` recorder lists exactly which
+semaphores/chunks each rank spins on, so the error names the candidate
+stall points instead of "it hangs".
+
+The abandoned dispatch thread cannot be killed (Python threads are not
+cancellable and the underlying XLA call is stuck in C++); it is leaked
+as a daemon thread and the error says so — the process survives to
+serve degraded traffic, which is the point.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+from .errors import CollectiveTimeoutError, PendingWait, TimeoutDiagnosis
+
+
+def slack() -> float:
+    try:
+        return float(os.environ.get("TDT_WATCHDOG_SLACK", "") or 64.0)
+    except ValueError:
+        return 64.0
+
+
+def floor_ms() -> float:
+    env = os.environ.get("TDT_WATCHDOG_FLOOR_MS", "")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    from ..core import platform
+
+    # interpret mode (the CPU backend) simulates DMA/semaphores in
+    # Python: a collective costs ~100 ms + compile; real hardware pays
+    # dispatch + possible first-call compile, covered by retries rather
+    # than the floor.  platform.on_cpu (not compilation.interpret_mode)
+    # on purpose: the floor must resolve even on jax builds whose
+    # pltpu lacks InterpretParams.
+    return 60_000.0 if platform.on_cpu() else 1_000.0
+
+
+# op name -> perf_model estimator(payload_bytes, num_ranks) in ms.  The
+# fused GEMM ops use their collective half's wire model: the GEMM time
+# is bounded separately by the same payload heuristic and the slack
+# absorbs the difference.
+def _estimate_ms(op: str, payload_bytes: int, num_ranks: int) -> float:
+    from ..tools import perf_model
+
+    n = max(int(num_ranks), 2)
+    b = max(int(payload_bytes), 1)
+    if op in ("all_gather", "ag_gemm"):
+        return perf_model.allgather_sol_ms(b, n)
+    if op in ("reduce_scatter", "gemm_rs"):
+        return perf_model.reduce_scatter_sol_ms(b, n)
+    if op in ("all_reduce", "gemm_ar"):
+        return perf_model.allreduce_sol_ms(b, n)
+    if op in ("ep_dispatch", "ep_combine"):
+        # worst case: the whole local payload crosses the wire once
+        return perf_model.allgather_sol_ms(b, 2)
+    # unknown op: price it as a ring moving the payload once per rank
+    return perf_model.allgather_sol_ms(b, n)
+
+
+def deadline_ms(op: str, *, payload_bytes: int, num_ranks: int) -> float:
+    """The watchdog budget for one collective call: SOL estimate x slack
+    + floor.  Monotone in payload and rank count."""
+    return _estimate_ms(op, payload_bytes, num_ranks) * slack() + floor_ms()
+
+
+@functools.lru_cache(maxsize=None)
+def protocol_pending(family: str, n: int) -> TimeoutDiagnosis | None:
+    """Static wait-structure diagnosis for a kernel family at ``n``
+    ranks: every (rank, semaphore, chunk) the protocol blocks on,
+    extracted by recording the registry case — the best the host can say
+    about a device-side hang it cannot introspect."""
+    if not family or n < 2:
+        return None
+    try:
+        from ..analysis.events import CopyEv, WaitEv
+        from ..analysis.record import record_kernel
+        from ..analysis.registry import cases_for
+
+        cases = cases_for(family, n)
+    except Exception:
+        return None
+    if not cases:
+        return None
+    case = cases[0]
+    pending: list[PendingWait] = []
+    for rank in range(case.n):
+        _, thunk = case.make(rank)
+        rec = record_kernel(thunk, n=case.n, rank=rank)
+        # chunk attribution: the most recent copy landing through a
+        # semaphore is the transfer a wait on it would starve for
+        last_chunk: dict[tuple, str] = {}
+        for pos, ev in enumerate(rec.events):
+            if isinstance(ev, CopyEv):
+                last_chunk[ev.recv_sem] = ev.dst.label()
+            elif isinstance(ev, WaitEv):
+                from ..analysis.events import sem_label
+
+                pending.append(PendingWait(
+                    rank, sem_label(ev.sem), ev.amount, 0, pos,
+                    chunk=last_chunk.get(ev.sem),
+                ))
+    # cap: a kernel has O(n^2) waits; the first few per rank carry the
+    # semaphore/chunk names a human needs
+    by_rank: dict[int, int] = {}
+    capped = []
+    for p in pending:
+        if by_rank.get(p.rank, 0) < 4:
+            by_rank[p.rank] = by_rank.get(p.rank, 0) + 1
+            capped.append(p)
+    return TimeoutDiagnosis(
+        f"{family}@{n}", n, pending=tuple(capped), static=True,
+        note="static protocol wait points (live device state is not "
+             "host-introspectable; one of these semaphores is starved)",
+    )
+
+
+def call_with_deadline(op: str, thunk, deadline_ms: float | None, *,
+                       family: str | None = None, ranks: int | None = None):
+    """Run ``thunk`` bounded by ``deadline_ms`` host wall time.
+
+    ``None``/non-positive deadline = unguarded direct call.  On expiry,
+    the dispatch thread is abandoned (daemon; not cancellable), the
+    ``resilience_timeouts`` counter is bumped, and
+    :class:`CollectiveTimeoutError` is raised with the static protocol
+    diagnosis for ``family``/``ranks`` when available.
+    """
+    if deadline_ms is None or deadline_ms <= 0:
+        return thunk()
+    from ..lang import primitives as dl
+
+    # the fault-injection scope is thread-local; the dispatch thread
+    # must inherit the caller's so live injection (docs/robustness.md)
+    # still fires through the guard
+    caller_scope = dl.active_fault_scope()
+    box: dict = {}
+    done = threading.Event()
+
+    def run():
+        if caller_scope is not None:
+            dl._set_fault_scope(caller_scope)
+        try:
+            box["value"] = thunk()
+        except BaseException as e:  # surfaced on the caller thread
+            box["error"] = e
+        finally:
+            if caller_scope is not None:
+                dl._set_fault_scope(None)
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True,
+                         name=f"tdt-watchdog-{op}")
+    t.start()
+    if not done.wait(deadline_ms / 1e3):
+        from .. import obs
+
+        if obs.enabled():
+            obs.counter("resilience_timeouts", op=op).inc()
+        diag = protocol_pending(family, int(ranks)) \
+            if family and ranks else None
+        err = CollectiveTimeoutError(op, deadline_ms, diag)
+        # callers with mutable state the abandoned thread might still
+        # touch (Engine._mark_failed) need its identity to fence writes
+        err.abandoned_thread = t
+        if hasattr(err, "add_note"):
+            err.add_note(
+                f"the dispatch thread {t.name!r} is abandoned (a hung "
+                f"XLA call cannot be cancelled); the process remains "
+                f"serviceable"
+            )
+        raise err
+    if "error" in box:
+        raise box["error"]
+    return box["value"]

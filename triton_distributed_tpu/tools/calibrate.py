@@ -94,7 +94,8 @@ def load_calibration() -> LinkCalibration | None:
 
 
 def save_calibration(cal: LinkCalibration) -> None:
-    global _cached, _cached_path
+    global _cached, _cached_path, _agreed
+    _agreed = None   # derived thresholds must re-agree on new numbers
     path = calibration_path()
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = path + f".tmp.{os.getpid()}"
@@ -107,8 +108,9 @@ def save_calibration(cal: LinkCalibration) -> None:
 def invalidate_cache() -> None:
     """Drop the in-process calibration cache (tests; after re-calibration
     by another process)."""
-    global _cached, _cached_path
+    global _cached, _cached_path, _agreed
     _cached = _cached_path = None
+    _agreed = None
 
 
 # ---------------------------------------------------------------------------
@@ -253,19 +255,91 @@ def _bdp_bytes(cal: LinkCalibration | None) -> float | None:
     return max(cal.ici_gbps * 1e9 * cal.ici_hop_us * 1e-6, 8192.0)
 
 
+# Cross-host agreement (ADVICE r5 low #5): the thresholds feed
+# choose_method, and choose_method selects which collective KERNEL every
+# host launches — hosts disagreeing on push-vs-ring launch MISMATCHED
+# kernels and deadlock the mesh (exactly the divergence hazard
+# analysis.checks flags statically).  A per-host ~/.cache linkcal.json
+# gives no load-time guarantee: one host may lack the file or hold a
+# stale one.  So in multi-process runs the DERIVED thresholds are
+# agreed at first use: every process computes the cross-process mean
+# and relative spread (via process_mean of values and squares — both
+# identical on every host); agreement within tolerance adopts the mean,
+# disagreement falls back to the cold defaults (also identical
+# everywhere) and counts a ``resilience_degraded_calls`` event.
+
+AGREE_REL_TOL = 0.05
+
+_agreed: tuple[int, int] | None = None
+
+
+def agree_thresholds(push_local: float, one_shot_local: float, *,
+                     n_proc: int | None = None, mean_fn=None,
+                     rel_tol: float = AGREE_REL_TOL) -> tuple[int, int]:
+    """Resolve (push, one_shot) thresholds identically on every process.
+
+    ``mean_fn``/``n_proc`` are injectable for tests; production uses
+    ``core.utils.process_mean`` and ``jax.process_count``.
+
+    CONTRACT (multi-process): ``process_mean`` is a COLLECTIVE — every
+    process must reach it together.  First use is naturally aligned
+    (the thresholds are consulted from ``choose_method`` at SPMD
+    program points every host executes identically), and the result is
+    memoized per process.  Consequently the memo must be invalidated on
+    EVERY process or none: ``save_calibration``/``invalidate_cache``
+    reset only the local memo, so re-calibrating one host of a live
+    multi-host job without the others invalidating too would have that
+    host issue a collective its peers never join.  Re-calibration is a
+    whole-job (all-hosts) operation, same as the calibration run itself.
+    """
+    if n_proc is None:
+        n_proc = jax.process_count()
+    if n_proc == 1:
+        return int(push_local), int(one_shot_local)
+    if mean_fn is None:
+        from ..core.utils import process_mean as mean_fn
+    p, o = float(push_local), float(one_shot_local)
+    mp, mo, mp2, mo2 = mean_fn([p, o, p * p, o * o])
+
+    def rel_spread(m, m2) -> float:
+        var = max(m2 - m * m, 0.0)
+        return (var ** 0.5) / m if m else 0.0
+
+    if rel_spread(mp, mp2) > rel_tol or rel_spread(mo, mo2) > rel_tol:
+        from .. import obs
+
+        if obs.enabled():
+            obs.counter("resilience_degraded_calls", op="calibrate",
+                        reason="threshold_disagreement").inc()
+        return DEFAULT_PUSH_BYTES, DEFAULT_ONE_SHOT_BYTES
+    return int(round(mp)), int(round(mo))
+
+
+def _thresholds() -> tuple[int, int]:
+    """Local derivation + (memoized) cross-process agreement."""
+    global _agreed
+    if _agreed is not None:
+        return _agreed
+    bdp = _bdp_bytes(load_calibration())
+    push = int(bdp) if bdp is not None else DEFAULT_PUSH_BYTES
+    one = int(2 * bdp) if bdp is not None else DEFAULT_ONE_SHOT_BYTES
+    _agreed = agree_thresholds(push, one)
+    return _agreed
+
+
 def push_bytes_threshold() -> int:
     """AllGather one-shot-push vs ring crossover (bytes per shard): the
-    measured bandwidth-delay product, else the 256 KiB cold default."""
-    bdp = _bdp_bytes(load_calibration())
-    return int(bdp) if bdp is not None else DEFAULT_PUSH_BYTES
+    measured bandwidth-delay product, else the 256 KiB cold default;
+    cross-process agreed (cold defaults on disagreement)."""
+    return _thresholds()[0]
 
 
 def one_shot_bytes_threshold() -> int:
     """AllReduce one-shot vs two-shot crossover (bytes per rank): ~2x
     the bandwidth-delay product (the two-shot pays 2(n-1) chained hops),
-    else the 512 KiB cold default."""
-    bdp = _bdp_bytes(load_calibration())
-    return int(2 * bdp) if bdp is not None else DEFAULT_ONE_SHOT_BYTES
+    else the 512 KiB cold default; cross-process agreed (cold defaults
+    on disagreement)."""
+    return _thresholds()[1]
 
 
 def main() -> int:

@@ -299,6 +299,14 @@ class Autotuner:
                     # everything they record so comm counters/spans
                     # describe real traffic, not sweep traffic
                     thunk = obs.suppressed_thunk(thunk)
+                from .. import resilience
+
+                if resilience.enabled():
+                    # ...and disarm the runtime guards: a deliberately
+                    # timed candidate must not burn watchdog deadlines,
+                    # feed the XLA fallback's time to the tuner, or walk
+                    # the sticky breaker open from sweep traffic
+                    thunk = resilience.suppressed_thunk(thunk)
                 from ..core.utils import sync
 
                 sync(thunk())

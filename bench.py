@@ -166,6 +166,18 @@ def bench_single_chip(m: int = 7168, n: int = 7168, k: int = 7168,
     }
 
 
+def _interpret_capture() -> bool:
+    """Whether this capture runs under CPU interpret mode (functional
+    smoke, not timing): the record carries the flag so the claims gate
+    never hard-gates simulated numbers (scripts/check_perf_claims.py)."""
+    try:
+        from triton_distributed_tpu.core import compilation
+
+        return bool(compilation.interpret_mode())
+    except Exception:
+        return False
+
+
 def _ag_gemm_operands(mesh, m, k, n):
     """The shared (a sharded, b sharded, a replicated) operand set of the
     multi-chip AG-GEMM benches — one definition so both metrics measure
@@ -211,6 +223,8 @@ def bench_multi_chip():
         "value": round(tflops, 2),
         "unit": "TFLOP/s/chip",
         "vs_baseline": round(_median_ratio(times, "base", "fused"), 4),
+        "devices": jax.device_count(),
+        "interpret": _interpret_capture(),
     }
 
 
@@ -579,6 +593,11 @@ def bench_decode_modes(batch: int = 128):
         "value": round(ms, 3),
         "unit": "ms/step (ar mode)",
         "vs_baseline": round(_median_ratio(times, "psum", "ar"), 4),
+        # slice-gated claims key on this: at devices>1 the psum/ar ratio
+        # is a distributed measurement the gate binds on, at 1 it is
+        # definitional parity (scripts/check_perf_claims.py)
+        "devices": jax.device_count(),
+        "interpret": _interpret_capture(),
         # tp=1 timing is degenerate (both modes local); the wire volume per
         # step is the mode property measurable anywhere — computed from the
         # model shapes for an 8-way tp mesh, per chip, per decode step
@@ -791,6 +810,12 @@ def bench_overlap_collective():
         "fused_us": round(tf_ * 1e6, 1),
         "comm_only_us": round(tc * 1e6, 1),
         "gemm_only_us": round(tg * 1e6, 1),
+        # the >= 90%-hidden BASELINE claim binds only on real slices —
+        # the gate keys on this field (min_devices); an interpret-mode
+        # capture ("structure smoke, not timing" above) is never
+        # hard-gated
+        "devices": jax.device_count(),
+        "interpret": _interpret_capture(),
     }
 
 
@@ -969,6 +994,9 @@ def main():
             # survives tail truncation (the sentinel is the LAST line):
             # lets the gate tell truncated-away head lines from crashes
             "emitted": _EMITTED,
+            # the completeness gate requires slice-gated claims only on
+            # sweeps that actually ran on a slice
+            "devices": jax.device_count(),
         }))
         if _LOCAL_SINK is not None:
             _LOCAL_SINK.close()

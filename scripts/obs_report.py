@@ -13,16 +13,30 @@ Usage:
     python scripts/obs_report.py merged_trace.json.gz
     python scripts/obs_report.py --selftest
     python scripts/obs_report.py r0.json r1.json --json report.json
+    python scripts/obs_report.py --timeline ag_gemm --ranks 4
+    python scripts/obs_report.py --timeline flight_streams.json --chrome t.json
 
 Multiple inputs are merged with ``tools.trace_merge`` (rank i = argv
 order), so per-rank lanes stay disjoint; a single input may already be a
 merged trace.  ``--json`` additionally writes the rows + aggregate as
 JSON for machine consumers (CI gates on mean overlap).
+
+``--timeline`` is the flight-recorder view (docs/observability.md
+"Flight recorder"): given a kernel family name it records every rank of
+the registry case under deterministic record mode, reconstructs the
+cross-rank timeline (``obs.timeline``), and prints the per-collective
+table — compute / wire / exposed-wait / straggler-skew columns, the
+achieved-vs-SOL percentage, and every stall attributed to its
+(semaphore, chunk, peer) triple.  Given a path (``obs.flight.
+save_streams`` JSON) it reconstructs the saved streams instead.
+``--chrome`` additionally writes the timeline as Chrome-trace JSON with
+flow arrows linking each stall to the transfer it starved for.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -37,15 +51,36 @@ def main(argv: list[str] | None = None) -> int:
                     help="span trace files (one per rank, or one merged)")
     ap.add_argument("--selftest", action="store_true",
                     help="run on the canned two-rank span set and verify "
-                         "the known ratios")
+                         "the known ratios (plus a 2-rank flight-timeline "
+                         "reconstruction check)")
     ap.add_argument("--json", metavar="PATH",
                     help="also write rows + aggregate as JSON")
+    ap.add_argument("--timeline", metavar="FAMILY_OR_PATH",
+                    help="flight-recorder timeline: a kernel family "
+                         "(recorded fresh at --ranks) or a saved "
+                         "flight-streams JSON")
+    ap.add_argument("--ranks", type=int, default=4,
+                    help="rank count for --timeline family recording "
+                         "(default 4)")
+    ap.add_argument("--variant", default=None,
+                    help="registry case variant filter for --timeline "
+                         "(e.g. unidir)")
+    ap.add_argument("--save", metavar="PATH",
+                    help="with --timeline: also save the recorded flight "
+                         "streams as JSON")
+    ap.add_argument("--chrome", metavar="PATH",
+                    help="with --timeline: also write the reconstructed "
+                         "timeline as Chrome-trace JSON with stall flow "
+                         "arrows")
     args = ap.parse_args(argv)
 
     from triton_distributed_tpu.obs import report
 
+    if args.timeline:
+        return _run_timeline(args)
     if args.selftest:
         sys.stdout.write(report.selftest())
+        _timeline_selftest()
         print("selftest OK")
         return 0
     if not args.traces:
@@ -69,6 +104,60 @@ def main(argv: list[str] | None = None) -> int:
             json.dump({"rows": rows, "aggregate": report.aggregate(rows)},
                       f, indent=1, sort_keys=True)
     return 0
+
+
+def _run_timeline(args) -> int:
+    from triton_distributed_tpu.obs import flight, timeline
+
+    if os.path.exists(args.timeline):
+        name, streams = flight.load_streams(args.timeline)
+    else:
+        name, streams = flight.record_family(
+            args.timeline, args.ranks, variant=args.variant)
+    if args.save:
+        flight.save_streams(name, streams, args.save)
+    tl = timeline.reconstruct(streams, kernel=name)
+    sys.stdout.write(timeline.format_table(tl))
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            f.write('{"displayTimeUnit":"ms","traceEvents":')
+            f.write(json.dumps(timeline.to_chrome(tl),
+                               separators=(",", ":")))
+            f.write("}")
+        print(f"chrome trace (with stall flow arrows): {args.chrome}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({
+                "kernel": tl.kernel, "ranks": tl.n,
+                "critical_us": tl.critical_us, "skew_us": tl.skew_us,
+                "sol_us": tl.sol_us, "pct_sol": tl.pct_sol,
+                "stalled": tl.stalled, "pending": list(tl.pending),
+                "rows": [vars(r) for r in tl.rows],
+                "waits": [dataclasses.asdict(w) for w in tl.waits],
+            }, f, indent=1, sort_keys=True)
+    return 1 if tl.stalled else 0
+
+
+def _timeline_selftest() -> None:
+    """Record a 2-rank AllGather, reconstruct, and assert the
+    reconstruction is complete, symmetric, and fully attributed — the
+    flight-timeline half of ``--selftest``."""
+    from triton_distributed_tpu.obs import flight, timeline
+
+    name, streams = flight.record_family("allgather", 2, variant="ring_1d")
+    tl = timeline.reconstruct(streams, kernel=name)
+    problems = timeline.check_balanced(tl)
+    if problems:
+        raise AssertionError(
+            f"timeline selftest: {name} reconstruction unbalanced: "
+            f"{problems}")
+    if not tl.waits or tl.critical_us <= 0:
+        raise AssertionError(
+            f"timeline selftest: {name} reconstructed no attributed "
+            f"waits / zero critical path")
+    print(f"timeline selftest: {name} ranks={tl.n} "
+          f"critical={tl.critical_us:.3f}us pct_sol={100 * tl.pct_sol:.1f}% "
+          f"waits attributed={len(tl.waits)}")
 
 
 if __name__ == "__main__":

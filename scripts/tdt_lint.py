@@ -16,6 +16,7 @@ Usage:
     python scripts/tdt_lint.py --selftest        # seeded-bad fixture battery
     python scripts/tdt_lint.py --faults          # fault-injection matrix
     python scripts/tdt_lint.py --faults --seed 7 # reseed the injection
+    python scripts/tdt_lint.py --timeline        # flight-timeline smoke
     python scripts/tdt_lint.py --json report.json
 
 ``--faults`` runs the ``tdt.resilience`` fault-injection matrix
@@ -24,6 +25,14 @@ notify, stale credit, straggler, rank abort) against every guarded
 kernel family, asserting each injection is either DETECTED (timeout /
 hazard naming the pending semaphore or chunk) or SURVIVED (completed in
 budget with balanced credits).
+
+``--timeline`` is the flight-recorder regression smoke
+(docs/observability.md "Flight recorder"): record a 2-rank AllGather
+under deterministic record mode, reconstruct the cross-rank timeline
+(``obs.timeline``), and assert the reconstruction completes with
+BALANCED attribution — symmetric per-rank exposed-wait totals and every
+recv stall named with its (semaphore, chunk, peer) triple.  Headless
+and CPU-only, like the rest of the lint.
 
 Exit status: 0 = every kernel clean (or selftest/fault matrix passed);
 1 = violations (each printed with the violating semaphore/chunk named).
@@ -54,6 +63,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--faults", action="store_true",
                     help="run the resilience fault-injection matrix: every "
                          "fault class must be detected or survived")
+    ap.add_argument("--timeline", action="store_true",
+                    help="flight-timeline smoke: record a 2-rank AG, "
+                         "reconstruct, assert balanced attribution")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection target sampling seed (--faults)")
     ap.add_argument("--json", metavar="PATH",
@@ -62,6 +74,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.faults:
         return _run_faults(args)
+    if args.timeline:
+        return _run_timeline(args)
 
     from triton_distributed_tpu import analysis
 
@@ -134,6 +148,40 @@ def _run_faults(args) -> int:
             _json.dump({"rows": rows, "problems": problems}, f,
                        indent=1, sort_keys=True)
     return 1 if problems else 0
+
+
+def _run_timeline(args) -> int:
+    from triton_distributed_tpu.obs import flight, timeline
+
+    problems = []
+    results = []
+    for family, n, variant in (("allgather", 2, "ring_1d"),
+                               ("ag_gemm", 2, "unidir")):
+        name, streams = flight.record_family(family, n, variant=variant)
+        tl = timeline.reconstruct(streams, kernel=name)
+        results.append(tl)
+        print(f"{name:<28} ranks={tl.n:<2} critical={tl.critical_us:.3f}us "
+              f"skew={tl.skew_us:.3f}us pct_sol={100 * tl.pct_sol:.1f}% "
+              f"waits={len(tl.waits)}")
+        problems += [f"{name}: {p}" for p in timeline.check_balanced(tl)]
+        if not tl.waits:
+            problems.append(f"{name}: no attributed waits reconstructed")
+    for p in problems:
+        print(f"TIMELINE FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({
+                "cases": [{"kernel": tl.kernel, "ranks": tl.n,
+                           "critical_us": tl.critical_us,
+                           "pct_sol": tl.pct_sol,
+                           "waits": len(tl.waits)} for tl in results],
+                "problems": problems,
+            }, f, indent=1, sort_keys=True)
+    if problems:
+        return 1
+    print("timeline OK: reconstruction complete, attribution balanced, "
+          "every stall named with its (semaphore, chunk, peer)")
+    return 0
 
 
 if __name__ == "__main__":

@@ -119,6 +119,7 @@ FAST_NODES = frozenset((
     "tests/test_loader_checkpoint.py::test_safetensors_round_trip[True]",
     "tests/test_perf_claims.py::test_repo_records_consistent",
     "tests/test_autotuner.py::test_picks_fastest_candidate",
+    "tests/test_obs.py::test_tdt_lint_timeline_smoke",
 ))
 
 
@@ -151,6 +152,27 @@ def pytest_collection_modifyitems(config, items):
                 f"tests/conftest.py FAST_NODES lists tests that no longer "
                 f"collect: {sorted(missing)}"
             )
+
+
+def pytest_terminal_summary(terminalreporter):
+    """Make skipped HF-parity convention checks LOUD (VERDICT weak #6):
+    a run whose model conventions were not validated against the
+    canonical Hugging Face implementation must say so in the summary,
+    not hide in the 's' column."""
+    skipped = [
+        r for r in terminalreporter.stats.get("skipped", [])
+        if "test_hf_parity" in str(getattr(r, "nodeid", ""))
+    ]
+    if skipped:
+        terminalreporter.write_line(
+            f"WARNING: {len(skipped)} HF-parity convention check(s) "
+            f"SKIPPED (torch/transformers not installed) — prefill/decode "
+            f"logits were NOT validated against the canonical HF "
+            f"implementation this run.  The HF CI shard must set "
+            f"TDT_REQUIRE_HF_PARITY=1 so a broken provision step fails "
+            f"instead of skipping.",
+            yellow=True,
+        )
 
 
 @pytest.fixture(scope="session")

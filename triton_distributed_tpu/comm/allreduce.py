@@ -426,7 +426,7 @@ def all_reduce(
             fallback=lambda: resilience.fallbacks.xla_all_reduce(
                 x, mesh, axis, out_dtype),
         )
-    if obs.enabled() and eager:
+    if eager and (obs.enabled() or obs.flight.enabled()):
         if method == AllReduceMethod.TWO_SHOT:
             # RS ring + AG ring, each n-1 hops of 1/n of the partial
             wire, chunks = 2 * (n - 1) * partial // n, 2 * (n - 1)

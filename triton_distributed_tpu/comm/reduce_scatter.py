@@ -298,7 +298,7 @@ def reduce_scatter(
             fallback=lambda: resilience.fallbacks.xla_reduce_scatter(
                 x, mesh, axis),
         )
-    if obs.enabled() and eager:
+    if eager and (obs.enabled() or obs.flight.enabled()):
         return obs.comm_call(
             "reduce_scatter", core,
             payload_bytes=chunk_bytes * n,

@@ -95,6 +95,47 @@ def _set_fault_scope(scope) -> None:
 
 
 # ---------------------------------------------------------------------------
+# flight recorder (tdt.obs)
+#
+# The flight recorder (``triton_distributed_tpu.obs.flight``) rides the
+# SAME interception points: when its thread capture is installed (the
+# record-mode harness) or the TDT_FLIGHT global ring is on, every primitive
+# below reports its event — semaphore identity, destination chunk, peer,
+# credit size, monotonic timestamp — BEFORE dispatching.  The hook sits
+# after the fault scope's verdict (a dropped signal never reaches the
+# flight stream, exactly as it never reaches the wire) and before the
+# analysis recorder (both modes are captured).  See docs/observability.md.
+
+_FLIGHT_MOD: list = []
+
+
+def _flight():
+    """The flight-recorder sink for this thread, or None (≈0 cost when
+    the ring is off and no capture is installed)."""
+    if not _FLIGHT_MOD:
+        from ..obs import flight as fm
+
+        _FLIGHT_MOD.append(fm)
+    return _FLIGHT_MOD[0].active()
+
+
+class _FlightLocalDesc:
+    """Record-mode local-copy descriptor that reports its ``wait`` to the
+    flight stream (the recorder's descriptor bypasses the primitives
+    layer on ``.wait()``)."""
+
+    def __init__(self, inner, fl, dst, sem):
+        self._inner, self._fl, self._dst, self._sem = inner, fl, dst, sem
+
+    def start(self) -> None:
+        self._inner.start()
+
+    def wait(self) -> None:
+        self._fl.on_wait_recv(self._dst, self._sem)
+        self._inner.wait()
+
+
+# ---------------------------------------------------------------------------
 # teams: axis-rank -> logical device id translation
 
 
@@ -219,6 +260,9 @@ def notify(
             # the signal is lost in flight: neither the recorder nor the
             # device semaphore ever sees it
             return
+    fl = _flight()
+    if fl is not None:
+        fl.on_notify(sem, device_id, inc)
     rec = active_recorder()
     if rec is not None:
         rec.on_notify(sem, device_id, inc)
@@ -250,6 +294,9 @@ def wait(sem, value: int | jax.Array = 1) -> None:
     docs/robustness.md)."""
     scope = active_fault_scope()
     action = scope.on_wait(sem, value) if scope is not None else None
+    fl = _flight()
+    if fl is not None:
+        fl.on_wait(sem, value)
     rec = active_recorder()
     if rec is not None:
         rec.on_wait(sem, value)
@@ -265,16 +312,30 @@ def peek(sem) -> jax.Array:
     """Non-blocking semaphore read (no reference analogue — the LL protocols
     poll flags in data; on TPU you can poll the count directly).
 
-    Real-hardware (Mosaic) only: the interpret backend has no
-    ``semaphore_read`` rule (its big-if dispatch covers signal/wait/DMA),
-    so under interpret mode this raises ``NotImplementedError`` from the
-    lowering.  Interpret-mode tests observe counts through exact-valued
-    ``wait`` round-trips instead (``tests/test_lang_primitives.py``)."""
+    Mosaic (real hardware) reads the live count.  The interpret backend
+    has no ``semaphore_read`` rule (its big-if dispatch covers
+    signal/wait/DMA), so under simulation ``peek`` returns the
+    NON-BLOCKING LOWER BOUND 0: "the signal has not arrived yet".  That
+    is the one approximation that preserves a polling protocol's
+    correctness — a poller must already handle 0 (nothing arrived) by
+    falling through to its blocking ``wait`` path, so under interpret
+    mode it simply always takes that path; it can never be tricked into
+    consuming data whose signal hasn't fired.  Count-reading ASSERTIONS
+    still need hardware (``scripts/run_hw_markers.py``); count semantics
+    under simulation are proven through exact-valued ``wait`` round
+    trips (``tests/test_lang_primitives.py``)."""
     if active_recorder() is not None:
         raise NotImplementedError(
             "tdt.analysis record mode cannot model non-blocking peek: a "
             "polling protocol has no static wait-for structure to verify"
         )
+    from ..core import platform
+
+    if platform.on_cpu():
+        # interpret-mode rule: the pessimistic non-blocking approximation
+        # (platform.on_cpu, not compilation.interpret_mode, so the rule
+        # resolves even on jax builds whose pltpu lacks InterpretParams)
+        return jnp.zeros((), jnp.int32)
     return pltpu.semaphore_read(sem)
 
 
@@ -315,6 +376,9 @@ def remote_copy(
     if scope is not None:
         action = scope.on_remote_copy(src, dst, send_sem, recv_sem,
                                       device_id)
+    fl = _flight()
+    if fl is not None:
+        fl.on_remote_copy(src, dst, send_sem, recv_sem, device_id)
     rec = active_recorder()
     if rec is not None:
         desc = rec.on_remote_copy(src, dst, send_sem, recv_sem, device_id,
@@ -345,9 +409,15 @@ def local_copy(src, dst, sem, *, start: bool = True):
     scope = active_fault_scope()
     if scope is not None:
         scope.on_local_copy(src, dst, sem)
+    fl = _flight()
+    if fl is not None:
+        fl.on_local_copy(src, dst, sem)
     rec = active_recorder()
     if rec is not None:
-        return rec.on_local_copy(src, dst, sem, start=start)
+        desc = rec.on_local_copy(src, dst, sem, start=start)
+        # the recorder's descriptor reports its .wait() directly to the
+        # recorder; wrap it so the flight stream sees the wait too
+        return desc if fl is None else _FlightLocalDesc(desc, fl, dst, sem)
     copy = pltpu.make_async_copy(src, dst, sem)
     if start:
         copy.start()
@@ -366,6 +436,9 @@ def wait_recv(dst_ref, sem) -> None:
     scope = active_fault_scope()
     if scope is not None:
         scope.on_wait_recv(dst_ref, sem)
+    fl = _flight()
+    if fl is not None:
+        fl.on_wait_recv(dst_ref, sem)
     rec = active_recorder()
     if rec is not None:
         rec.on_wait_recv(dst_ref, sem)
@@ -380,6 +453,9 @@ def wait_send(src_ref, sem) -> None:
     scope = active_fault_scope()
     if scope is not None:
         scope.on_wait_send(src_ref, sem)
+    fl = _flight()
+    if fl is not None:
+        fl.on_wait_send(src_ref, sem)
     rec = active_recorder()
     if rec is not None:
         rec.on_wait_send(src_ref, sem)
@@ -409,6 +485,9 @@ def barrier_all(axis: "str | Team", sem=None) -> None:
     ``collective_id`` in their CompilerParams.
     """
     team = _as_team(axis)
+    fl = _flight()
+    if fl is not None:
+        fl.on_barrier("barrier_all", team, sem)
     rec = active_recorder()
     if rec is not None:
         rec.on_barrier_all(team, sem)
@@ -456,6 +535,9 @@ def barrier_neighbors(axis: "str | Team", sem=None) -> None:
     ``collective_prologue`` defaults to it.
     """
     team = _as_team(axis)
+    fl = _flight()
+    if fl is not None:
+        fl.on_barrier("barrier_neighbors", team, sem)
     rec = active_recorder()
     if rec is not None:
         rec.on_barrier_neighbors(team, sem)

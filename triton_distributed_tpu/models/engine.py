@@ -120,6 +120,12 @@ class Engine:
 
         self._failed_requests = 0
         self._last_failure: str | None = None
+        # flight-recorder step ordinal (TDT_FLIGHT=1): monotone across
+        # requests so the ring's last-N-steps retention spans request
+        # boundaries; ``_last_flight`` holds the dump of the most recent
+        # failed step for Engine.health()
+        self._flight_step = 0
+        self._last_flight: tuple[str, ...] = ()
         # watchdog dispatch threads abandoned by a deadline breach: their
         # in-flight steps must not clobber the engine's (reset) cache —
         # thread OBJECTS, not idents (idents recycle after thread death).
@@ -193,8 +199,16 @@ class Engine:
             raise ValueError(
                 f"prompt length {plen} exceeds max_length={max_len}"
             )
+        self._flight_tick()
         with obs.span("prefill", cat="step", batch=b, prompt_len=plen):
             return self._prefill_dispatch(input_ids, b, plen)
+
+    def _flight_tick(self) -> None:
+        """One serving-step boundary on the flight ring (≈0 when
+        TDT_FLIGHT is off — one cached-bool check)."""
+        if obs.flight.enabled():
+            self._flight_step += 1
+            obs.flight.mark_step(self._flight_step)
 
     def _set_cache(self, cache) -> None:
         """Adopt a step's updated cache UNLESS this thread was abandoned
@@ -516,6 +530,14 @@ class Engine:
         so the NEXT request starts from clean state."""
         self._failed_requests += 1
         self._last_failure = f"{type(err).__name__}: {err}"
+        if obs.flight.enabled():
+            # dump the ring at failure time: the last-N-steps protocol
+            # history behind this request's death, kept for health() and
+            # attached to the error (docs/observability.md)
+            self._last_flight = obs.flight.recent_lines(32)
+            if hasattr(err, "add_note"):
+                err.add_note("flight recorder (last events): "
+                             + " | ".join(self._last_flight[-8:]))
         abandoned = getattr(err, "abandoned_thread", None)
         with self._fence_lock:
             # prune threads that already exited (their identity can
@@ -549,6 +571,7 @@ class Engine:
             "decode_mode": self.model.decode_mode,
             "request_deadline_ms": self.request_deadline_ms,
             "aot_prefill_buckets": sorted(self._prefill_exec),
+            "last_flight": list(self._last_flight),
         }
         return snap
 
@@ -591,6 +614,7 @@ class Engine:
         for i in range(gen_len - 1):
             # one "step" span per generated token: the unit the overlap
             # report (scripts/obs_report.py) groups comm/compute spans by
+            self._flight_tick()
             with obs.span("decode_step", cat="step", idx=i):
                 step_logits = self.decode_step(tok)
                 key = jax.random.fold_in(key, i)

@@ -27,7 +27,7 @@ from __future__ import annotations
 import contextlib
 import threading
 
-from . import export, registry, report, tracing
+from . import costs, export, flight, registry, report, timeline, tracing
 from .export import (
     parse_prometheus,
     read_jsonl,
@@ -45,11 +45,11 @@ from .tracing import instant, span
 
 __all__ = [
     "DEFAULT_BYTES_BUCKETS", "DEFAULT_LATENCY_BUCKETS_MS", "REGISTRY",
-    "Registry", "comm_call", "counter", "dump_jsonl", "dump_prometheus",
-    "enable", "enabled", "gauge", "histogram", "instant", "observe_timer",
-    "parse_prometheus", "read_jsonl", "record_collective", "span",
-    "summary", "summary_table", "suppress", "suppressed_thunk",
-    "to_prometheus", "write_jsonl",
+    "Registry", "comm_call", "costs", "counter", "dump_jsonl",
+    "dump_prometheus", "enable", "enabled", "flight", "gauge", "histogram",
+    "instant", "observe_timer", "parse_prometheus", "read_jsonl",
+    "record_collective", "span", "summary", "summary_table", "suppress",
+    "suppressed_thunk", "timeline", "to_prometheus", "write_jsonl",
 ]
 
 
@@ -175,11 +175,16 @@ def record_collective(op: str, *, payload_bytes: int, wire_bytes: int,
 def comm_call(op: str, thunk, *, payload_bytes: int, wire_bytes: int,
               chunks: int, method: str, ranks: int):
     """The one shared shape of a comm entry point's instrumentation:
-    record the call's counters, then run ``thunk`` under a ``comm`` span.
-    Call sites gate on :func:`enabled` + non-tracer inputs and compute
-    the per-method byte formulas (``docs/observability.md``)."""
+    record the call's counters, mark the flight ring, then run ``thunk``
+    under a ``comm`` span.  Call sites gate on :func:`enabled` OR
+    ``flight.enabled()`` plus non-tracer inputs and compute the
+    per-method byte formulas (``docs/observability.md``)."""
     record_collective(op, payload_bytes=payload_bytes,
                       wire_bytes=wire_bytes, chunks=chunks, method=method)
+    # flight ring (TDT_FLIGHT=1): the host-side dispatch marker a timeout
+    # dump anchors on — no-op when the ring is off
+    flight.mark_collective(op, payload_bytes=payload_bytes, ranks=ranks,
+                           method=method)
     with tracing.span(op, "comm", method=method, bytes=payload_bytes,
                       ranks=ranks):
         return thunk()

@@ -1,0 +1,212 @@
+"""Kernel cost attribution: one shared flop/byte/transcendental source.
+
+The reference attaches ``launch_metadata`` flop and byte counts to every
+overlapped kernel (``allgather_gemm.py:132-143``) so its profiler can
+label kernel cost in the merged timeline.  Here the same numbers feed
+THREE consumers that previously each had (or lacked) their own
+arithmetic:
+
+- the fused ops' ``pallas_call(cost_estimate=...)`` — Mosaic/XLA use the
+  estimate for scheduling, and profilers surface it per kernel
+  (:func:`pallas_cost`);
+- ``tools.perf_model``'s speed-of-light estimates — the roofline the
+  watchdog derives deadlines from and benches report "% of SOL" against
+  (:func:`sol_ms`);
+- the flight-recorder timeline (``obs.timeline``) — recorded protocol
+  events are placed on a model clock whose compute/wire durations come
+  from these same counts, so the achieved-vs-SOL column of
+  ``scripts/obs_report.py --timeline`` and the watchdog budget can never
+  quote different flop counts for the same kernel.
+
+Conventions: ``flops`` counts multiply-adds as 2 ops (matmul = 2·M·N·K);
+``bytes_accessed`` is HBM traffic (operand reads + result writes +
+DMA-staged traffic for the fused collectives); ``transcendentals``
+counts exp/tanh evaluations (the softmax VPU term that makes attention
+VPU-bound — see docs/perf.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+def _itemsize(dtype) -> int:
+    import jax.numpy as jnp
+
+    return int(jnp.dtype(dtype).itemsize)
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelCost:
+    """Flop/byte/transcendental counts of one kernel invocation (per
+    device).  ``wire_bytes`` is the portion of ``bytes_accessed`` that
+    crosses ICI (0 for local kernels) — the collective half of a fused
+    op's roofline."""
+
+    flops: int
+    bytes_accessed: int
+    transcendentals: int = 0
+    wire_bytes: int = 0
+
+    def scaled(self, k: float) -> "KernelCost":
+        return KernelCost(int(self.flops * k), int(self.bytes_accessed * k),
+                          int(self.transcendentals * k),
+                          int(self.wire_bytes * k))
+
+
+def pallas_cost(cost: KernelCost):
+    """``pl.CostEstimate`` for ``pallas_call(cost_estimate=...)``; None on
+    jax builds that predate the parameter (the call site passes it
+    through — None is the default)."""
+    try:
+        from jax.experimental import pallas as pl
+    except Exception:  # pragma: no cover - jax always importable here
+        return None
+    ce = getattr(pl, "CostEstimate", None)
+    if ce is None:
+        return None
+    return ce(flops=int(cost.flops), bytes_accessed=int(cost.bytes_accessed),
+              transcendentals=int(cost.transcendentals))
+
+
+def sol_ms(cost: KernelCost, device_kind: str | None = None) -> float:
+    """Roofline time of ``cost`` on one chip: max(MXU, HBM, ICI) terms —
+    the same max() shape as ``tools.perf_model.gemm_sol_ms``, extended
+    with the wire term for fused collectives."""
+    from ..tools import perf_model
+
+    spec = perf_model.chip_spec(device_kind)
+    t_flops = cost.flops / (spec.bf16_tflops * 1e12)
+    t_mem = (cost.bytes_accessed - cost.wire_bytes) / (spec.hbm_gbps * 1e9)
+    t_wire = cost.wire_bytes / (spec.ici_gbps * 1e9)
+    return max(t_flops, t_mem, t_wire) * 1e3
+
+
+# ---------------------------------------------------------------------------
+# per-kernel calculators (per DEVICE, at the shapes the builders see)
+
+
+def matmul(m: int, n: int, k: int, dtype, out_dtype=None) -> KernelCost:
+    """Plain blocked matmul C[m,n] = A[m,k] @ B[k,n] (``ops.matmul`` and
+    the inner pipeline of every fused GEMM)."""
+    ib = _itemsize(dtype)
+    ob = _itemsize(out_dtype if out_dtype is not None else dtype)
+    return KernelCost(
+        flops=2 * m * n * k,
+        bytes_accessed=ib * (m * k + k * n) + ob * m * n,
+    )
+
+
+def ag_gemm(m_loc: int, k: int, n_loc: int, num_ranks: int, dtype,
+            out_dtype=None) -> KernelCost:
+    """Fused AllGather-GEMM per device: the consumer matmul runs over the
+    FULL gathered A (n·m_loc rows), and (n-1) A-shards transit this
+    rank's ICI links (ring: each chunk forwarded once per hop)."""
+    n = num_ranks
+    mm = matmul(n * m_loc, n_loc, k, dtype, out_dtype)
+    wire = (n - 1) * m_loc * k * _itemsize(dtype)
+    return KernelCost(
+        flops=mm.flops,
+        # gathered-A workspace write + matmul traffic + wire staging
+        bytes_accessed=mm.bytes_accessed + n * m_loc * k * _itemsize(dtype)
+        + wire,
+        wire_bytes=wire,
+    )
+
+
+def gemm_rs(m_loc: int, k_loc: int, n_dim: int, num_ranks: int, dtype,
+            out_dtype=None) -> KernelCost:
+    """Fused GEMM-ReduceScatter per device: n chunk matmuls over the local
+    K-shard plus the travelling-partial adds; each of the (n-1) forwarded
+    partials crosses one ICI hop."""
+    n = num_ranks
+    ob = _itemsize(out_dtype if out_dtype is not None else dtype)
+    mm = matmul(n * m_loc, n_dim, k_loc, dtype, out_dtype)
+    add_flops = (n - 1) * m_loc * n_dim
+    wire = (n - 1) * m_loc * n_dim * ob
+    return KernelCost(
+        flops=mm.flops + add_flops,
+        # matmul traffic + recv/send partial staging + wire
+        bytes_accessed=mm.bytes_accessed
+        + 2 * (n - 1) * m_loc * n_dim * ob + wire,
+        wire_bytes=wire,
+    )
+
+
+def gemm_ar(m_loc: int, k_loc: int, n_dim: int, num_ranks: int, dtype,
+            out_dtype=None) -> KernelCost:
+    """Fused GEMM-AllReduce: the GEMM-RS phase plus the AG ring returning
+    every reduced chunk to every rank (2(n-1)/n of the output per link)."""
+    n = num_ranks
+    ob = _itemsize(out_dtype if out_dtype is not None else dtype)
+    rs = gemm_rs(m_loc, k_loc, n_dim, n, dtype, out_dtype)
+    ag_wire = (n - 1) * m_loc * n_dim * ob
+    return KernelCost(
+        flops=rs.flops,
+        bytes_accessed=rs.bytes_accessed + ag_wire
+        + (n - 1) * m_loc * n_dim * ob,
+        wire_bytes=rs.wire_bytes + ag_wire,
+    )
+
+
+def flash_attention(b: int, h: int, seq_q: int, seq_kv: int, d: int,
+                    causal: bool, dtype) -> KernelCost:
+    """Prefill flash kernel (also the ring-attention chunk kernel at chunk
+    shapes — ``sp_attention`` folds one (seq_q, seq_c) tile per station).
+    Causal halves the score work; transcendentals count the exp per
+    score entry (the VPU term that bounds this kernel, docs/perf.md)."""
+    ib = _itemsize(dtype)
+    scores = b * h * seq_q * seq_kv
+    if causal:
+        scores //= 2
+    return KernelCost(
+        flops=4 * scores * d,
+        bytes_accessed=ib * (b * h * seq_q * d * 2          # q read, o write
+                             + 2 * b * h * seq_kv * d),     # k, v reads
+        transcendentals=scores,
+    )
+
+
+def decode_attention(b: int, h: int, hk: int, seq_kv: int, d: int,
+                     kv_dtype) -> KernelCost:
+    """Split-KV / fused / paged decode kernels (one token against the
+    cache): KV-bandwidth bound — bytes are dominated by streaming the
+    (B, Hkv, S, D) cache once."""
+    ib = _itemsize(kv_dtype)
+    scores = b * h * seq_kv
+    return KernelCost(
+        flops=4 * scores * d,
+        bytes_accessed=2 * b * hk * seq_kv * d * ib        # K + V stream
+        + b * h * d * ib * 2,                               # q read, o write
+        transcendentals=scores,
+    )
+
+
+def all_to_all(rows: int, h: int, num_ranks: int, dtype) -> KernelCost:
+    """EP A2A push kernel per device: every local row is read once and
+    pushed to its destination zone; peers' rows land in our zones.
+    ``rows`` is the per-device token count (zone capacity bound)."""
+    ib = _itemsize(dtype)
+    wire = rows * h * ib
+    return KernelCost(
+        flops=0,
+        bytes_accessed=2 * rows * h * ib + wire,
+        wire_bytes=wire,
+    )
+
+
+# the registry the report and timeline consume: family -> calculator.
+# (sp_attention and flash_decode ride the attention-family kernels they
+# are built from — flash_attention at chunk shapes, decode_attention at
+# per-rank cache shapes.)
+FAMILY_COSTS = {
+    "matmul": matmul,
+    "ag_gemm": ag_gemm,
+    "gemm_rs": gemm_rs,
+    "gemm_ar": gemm_ar,
+    "flash_attention": flash_attention,
+    "sp_attention": flash_attention,
+    "decode_attention": decode_attention,
+    "flash_decode": decode_attention,
+    "all_to_all": all_to_all,
+}

@@ -1,0 +1,415 @@
+"""Cross-rank collective flight recorder.
+
+The reference merges per-rank profiler traces into one timeline to show
+*where* an overlapped kernel spends its time; this module is the
+always-armable equivalent for the PROTOCOL layer: every distributed
+primitive (``lang/primitives.py``: wait / notify / remote_copy /
+local_copy / wait_recv / wait_send / barrier) reports through the same
+thread-local interception points the analysis recorder and the fault
+injector already use, and the flight recorder captures the stream —
+semaphore identity, destination chunk, peer, credit size, monotonic
+timestamp — into a bounded ring buffer.
+
+Two capture modes:
+
+- **global ring** (``TDT_FLIGHT=1`` or :func:`enable`): every event on
+  any thread lands in one process-wide ring with last-N-steps retention
+  (``TDT_FLIGHT_STEPS``, default 8; the engine marks step boundaries).
+  When a collective times out or a serve step fails, the recent history
+  is attached to the diagnosis (``resilience.watchdog`` /
+  ``models.engine._mark_failed``) — "what was the protocol doing just
+  before it died", not just "it died".  Off (the default) a primitive
+  pays one thread-local read; the engine's per-step mark pays one cached
+  bool.
+- **per-rank capture** (:func:`capture` / :func:`record_case`): the
+  deterministic harness — run every rank of an ``analysis.registry``
+  kernel case under record mode with a capture installed, yielding one
+  event stream per rank.  ``obs.timeline`` reconstructs those streams
+  into a cross-rank timeline with per-wait attribution; this is what
+  ``scripts/obs_report.py --timeline`` and ``scripts/tdt_lint.py
+  --timeline`` run on.
+
+Event identity is symbolic where available (record mode: ``FakeSem``
+labels, ``FakeRef`` region labels) and best-effort live (trace-time
+objects have no stable names; the op/step context still does).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+from collections import deque
+
+# ring capacity: ~120 B/event slotted; 100k events ≈ 12 MB worst case
+MAX_EVENTS = 100_000
+
+_tls = threading.local()
+_lock = threading.Lock()
+_ring: deque = deque(maxlen=MAX_EVENTS)
+_state = {"step": 0}
+
+
+def _env_enabled() -> bool:
+    from ..core.utils import env_flag
+
+    return env_flag("TDT_FLIGHT")
+
+
+_ENABLED = _env_enabled()
+
+_pkg_cache: list = []
+
+
+def _suppressed() -> bool:
+    """Measurement-only traffic (autotune sweeps, serve warmup) runs
+    under ``obs.suppress()``; the flight ring honors the same marker —
+    a timeout dump must show the serving protocol's history, not
+    hundreds of sweep markers (see ``obs.suppress``)."""
+    if not _pkg_cache:
+        import sys
+
+        _pkg_cache.append(sys.modules[__package__])
+    return _pkg_cache[0]._suppressed()
+
+
+def enabled() -> bool:
+    """Whether the global ring records (``TDT_FLIGHT=1`` or
+    :func:`enable`, and not inside an ``obs.suppress()`` block on this
+    thread)."""
+    return _ENABLED and not _suppressed()
+
+
+def enable(on: bool | None = True) -> bool:
+    """Turn the global ring on/off; ``None`` re-reads ``TDT_FLIGHT``."""
+    global _ENABLED
+    _ENABLED = _env_enabled() if on is None else bool(on)
+    return _ENABLED
+
+
+def keep_steps() -> int:
+    try:
+        return max(1, int(os.environ.get("TDT_FLIGHT_STEPS", "") or 8))
+    except ValueError:
+        return 8
+
+
+@dataclasses.dataclass
+class FlightEvent:
+    """One captured primitive event.  ``elems`` is the credit size in the
+    semaphore's own unit (counts for regular, elements for DMA);
+    ``flops``/``bytes`` are filled for compute events (from
+    ``obs.costs`` arithmetic over the recorded regions)."""
+
+    __slots__ = ("kind", "t_us", "rank", "sem", "sem2", "chunk", "peer",
+                 "elems", "flops", "bytes", "op", "step")
+
+    kind: str                 # wait|notify|remote_copy|local_copy|wait_recv|
+    #                           wait_send|barrier|compute|collective|step
+    t_us: float               # monotonic capture time (us)
+    rank: int                 # recording rank; -1 = live / unknown
+    sem: str | None           # primary semaphore (recv side for copies)
+    sem2: str | None          # send-completion semaphore of a remote_copy
+    chunk: str | None         # destination region label, if known
+    peer: int | None          # device id on the other end, if known
+    elems: int
+    flops: int
+    bytes: int
+    op: str | None            # enclosing collective / compute kind
+    step: int                 # serving-step ordinal at capture
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FlightEvent":
+        return cls(**{k: d.get(k, 0 if k in ("elems", "flops", "bytes",
+                                             "step") else None)
+                      for k in cls.__slots__})
+
+    def describe(self) -> str:
+        bits = [f"rank {self.rank}" if self.rank >= 0 else "live",
+                self.kind]
+        if self.op:
+            bits.append(f"op={self.op}")
+        if self.sem:
+            bits.append(f"sem={self.sem}")
+        if self.elems:
+            bits.append(f"n={self.elems}")
+        if self.chunk:
+            bits.append(f"chunk={self.chunk}")
+        if self.peer is not None:
+            bits.append(f"peer={self.peer}")
+        if self.bytes:
+            bits.append(f"bytes={self.bytes}")
+        return f"[step {self.step} t={self.t_us:.1f}us] " + " ".join(bits)
+
+
+def _now_us() -> float:
+    return time.perf_counter_ns() / 1e3
+
+
+def _sem_label(sem) -> str | None:
+    """Symbolic identity when the arg is an analysis FakeSem; a stable
+    best-effort label otherwise (live trace-time objects are unnamed)."""
+    label = getattr(sem, "label", None)
+    if callable(label):
+        try:
+            return label()
+        except Exception:
+            pass
+    if sem is None:
+        return None
+    return type(sem).__name__
+
+
+def _region(ref):
+    r = getattr(ref, "region", None)
+    if callable(r):
+        try:
+            return r()
+        except Exception:
+            return None
+    return None
+
+
+def _region_label(ref) -> str | None:
+    reg = _region(ref)
+    return reg.label() if reg is not None else None
+
+
+def _region_elems(ref) -> int:
+    reg = _region(ref)
+    return reg.elements() if reg is not None else 0
+
+
+def _as_peer(device_id) -> int | None:
+    try:
+        return int(device_id)
+    except Exception:
+        return None
+
+
+class FlightSink:
+    """Hook target ``lang.primitives`` talks to.  The global sink writes
+    the process ring; :class:`FlightCapture` writes its own stream."""
+
+    rank = -1
+
+    def _emit(self, ev: FlightEvent) -> None:
+        _ring.append(ev)
+
+    def _event(self, kind: str, *, sem=None, sem2=None, chunk=None,
+               peer=None, elems: int = 0, flops: int = 0, nbytes: int = 0,
+               op: str | None = None) -> None:
+        self._emit(FlightEvent(kind, _now_us(), self.rank, sem, sem2, chunk,
+                               peer, int(elems), int(flops), int(nbytes), op,
+                               _state["step"]))
+
+    # -- primitive hooks (lang/primitives.py call sites) --------------------
+
+    def on_wait(self, sem, value) -> None:
+        try:
+            v = int(value)
+        except Exception:
+            v = 0
+        self._event("wait", sem=_sem_label(sem), elems=v)
+
+    def on_notify(self, sem, device_id, inc) -> None:
+        try:
+            v = int(inc)
+        except Exception:
+            v = 0
+        self._event("notify", sem=_sem_label(sem), peer=_as_peer(device_id),
+                    elems=v)
+
+    def on_remote_copy(self, src, dst, send_sem, recv_sem, device_id) -> None:
+        self._event("remote_copy", sem=_sem_label(recv_sem),
+                    sem2=_sem_label(send_sem), chunk=_region_label(dst),
+                    peer=_as_peer(device_id), elems=_region_elems(dst))
+
+    def on_local_copy(self, src, dst, sem) -> None:
+        self._event("local_copy", sem=_sem_label(sem),
+                    chunk=_region_label(dst), elems=_region_elems(dst))
+
+    def on_wait_recv(self, dst_ref, sem) -> None:
+        self._event("wait_recv", sem=_sem_label(sem),
+                    chunk=_region_label(dst_ref),
+                    elems=_region_elems(dst_ref))
+
+    def on_wait_send(self, src_ref, sem) -> None:
+        self._event("wait_send", sem=_sem_label(sem),
+                    chunk=_region_label(src_ref),
+                    elems=_region_elems(src_ref))
+
+    def on_barrier(self, kind: str, team, sem) -> None:
+        self._event("barrier", sem=_sem_label(sem), op=kind,
+                    elems=int(team.size))
+
+    def on_compute(self, kind: str, refs) -> None:
+        """From the ``ops.blocks`` pipeline stubs (record mode): derive
+        flop/byte counts from the recorded regions via the same
+        arithmetic ``obs.costs`` uses for the builders."""
+        reads, write = refs[:-1], refs[-1]
+        flops = nbytes = 0
+        regions = [_region(r) for r in reads if _region(r) is not None]
+        wreg = _region(write)
+        if kind == "matmul" and len(regions) >= 2:
+            def dims(reg):
+                return [hi - lo for lo, hi in reg.bounds]
+            a, b = dims(regions[0]), dims(regions[1])
+            if len(a) >= 2 and len(b) >= 2:
+                flops = 2 * a[-2] * a[-1] * b[-1]
+        else:
+            flops = sum(r.elements() for r in regions)
+        nbytes = sum(r.elements() for r in regions)
+        if wreg is not None:
+            nbytes += wreg.elements()
+        self._event("compute", op=kind,
+                    chunk=wreg.label() if wreg is not None else None,
+                    flops=flops, nbytes=nbytes)
+
+
+class FlightCapture(FlightSink):
+    """Per-rank stream capture for the record-mode harness."""
+
+    def __init__(self, rank: int):
+        self.rank = int(rank)
+        self.events: list[FlightEvent] = []
+
+    def _emit(self, ev: FlightEvent) -> None:
+        self.events.append(ev)
+
+
+_GLOBAL = FlightSink()
+
+
+def active() -> FlightSink | None:
+    """The sink ``lang.primitives`` should report to on this thread:
+    an installed capture first, else the global ring when enabled (and
+    not suppressed — measurement sweeps stay out of the ring)."""
+    cap = getattr(_tls, "cap", None)
+    if cap is not None:
+        return cap
+    return _GLOBAL if enabled() else None
+
+
+@contextlib.contextmanager
+def capture(rank: int):
+    """Install a per-rank capture on this thread; yields it.  Nesting is
+    refused — a nested capture would silently split one rank's stream."""
+    if getattr(_tls, "cap", None) is not None:
+        raise RuntimeError("flight captures do not nest")
+    cap = FlightCapture(rank)
+    _tls.cap = cap
+    try:
+        yield cap
+    finally:
+        _tls.cap = None
+
+
+# ---------------------------------------------------------------------------
+# global-ring markers (engine / comm entry points)
+
+
+def mark_step(idx: int) -> None:
+    """Serving-step boundary: tag subsequent events and prune the ring to
+    the last ``keep_steps()`` steps.  ≈0 cost when the ring is off."""
+    if not enabled():
+        return
+    with _lock:
+        _state["step"] = int(idx)
+        _ring.append(FlightEvent("step", _now_us(), -1, None, None, None,
+                                 None, 0, 0, 0, "step", int(idx)))
+        floor = int(idx) - keep_steps()
+        while _ring and _ring[0].step <= floor:
+            _ring.popleft()
+
+
+def mark_collective(op: str, *, payload_bytes: int = 0, ranks: int = 0,
+                    method: str | None = None) -> None:
+    """Host-side collective dispatch marker (``obs.comm_call`` and the
+    fused-op entries): the coarse event a timeout dump anchors on."""
+    if not enabled():
+        return
+    _GLOBAL._event("collective", op=op, nbytes=payload_bytes, elems=ranks,
+                   sem=method)
+
+
+def recent(n: int | None = None) -> list[FlightEvent]:
+    """The global ring's newest ``n`` events (all when None), oldest
+    first."""
+    evs = list(_ring)
+    return evs if n is None else evs[-int(n):]
+
+
+def recent_lines(n: int = 24) -> tuple[str, ...]:
+    return tuple(ev.describe() for ev in recent(n))
+
+
+def clear() -> None:
+    with _lock:
+        _ring.clear()
+        _state["step"] = 0
+
+
+# ---------------------------------------------------------------------------
+# deterministic record-mode harness
+
+
+def record_case(case) -> list[list[FlightEvent]]:
+    """Record every rank of an ``analysis.registry.KernelCase`` with a
+    flight capture installed — the same symbolic execution the protocol
+    verifier runs, with the flight stream captured alongside.  Returns
+    one event list per rank."""
+    from ..analysis.record import recording
+
+    streams: list[list[FlightEvent]] = []
+    for rank in range(case.n):
+        _, thunk = case.make(rank)
+        with recording((("tp", case.n),), {"tp": rank}):
+            with capture(rank) as cap:
+                thunk()
+        streams.append(cap.events)
+    return streams
+
+
+def record_family(family: str, n: int, *, variant: str | None = None):
+    """Record the first (or ``variant``-matching) registry case of
+    ``family`` at ``n`` ranks.  Returns ``(case_name, streams)``."""
+    from ..analysis.registry import cases_for
+
+    cases = cases_for(family, n)
+    if variant:
+        hits = [c for c in cases if variant in c.name]
+        if not hits:
+            raise ValueError(
+                f"no {family} case matches variant {variant!r}; "
+                f"available: {[c.name for c in cases]}"
+            )
+        cases = hits
+    case = cases[0]
+    return case.name, record_case(case)
+
+
+def save_streams(name: str, streams, path: str) -> str:
+    """Persist per-rank streams as JSON (``obs_report.py --timeline`` can
+    reload them; the golden tests pin the format)."""
+    with open(path, "w") as f:
+        json.dump({
+            "kernel": name, "n": len(streams),
+            "streams": [[ev.to_dict() for ev in evs] for evs in streams],
+        }, f, separators=(",", ":"))
+    return path
+
+
+def load_streams(path: str):
+    """Inverse of :func:`save_streams`; returns ``(name, streams)``."""
+    with open(path) as f:
+        data = json.load(f)
+    streams = [[FlightEvent.from_dict(d) for d in evs]
+               for evs in data["streams"]]
+    return data.get("kernel", "?"), streams

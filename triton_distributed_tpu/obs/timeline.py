@@ -1,0 +1,434 @@
+"""Cross-rank timeline reconstruction from flight-recorder streams.
+
+The reference proves its overlap story by merging per-rank profiler
+traces into one chrome timeline; this module does the same for the
+PROTOCOL layer, and goes one step further: it *attributes* every rank's
+stall time to the (semaphore, chunk, peer) it was waiting on.
+
+Input: one flight-event stream per rank (``obs.flight.record_case`` for
+the deterministic record-mode harness, or reloaded ``save_streams``
+files).  The reconstruction is a credit-dataflow replay — the same
+maximal-execution semantics as ``resilience.simulate`` — on a REAL-VALUED
+model clock whose durations come from ``obs.costs`` and the
+``tools.perf_model`` chip spec:
+
+- a ``compute`` event takes ``launch + max(flops/MXU, bytes/HBM)``;
+- a ``remote_copy``'s credits become consumable ``hop + bytes/ICI``
+  after issue (the wire time);
+- a wait completes at ``max(own clock, ready time of the credits it
+  consumes)`` — the gap is that rank's **exposed wait**, attributed to
+  the latest-arriving credit's (semaphore, chunk, producing rank);
+- ``barrier`` events are a rendezvous: clocks join at the max
+  (neighbor barriers are approximated as global — conservative, and
+  exact for the single prologue barrier every kernel opens with).
+  This is also what aligns per-rank clocks: recorded streams start at
+  rank-local zero and the barrier join puts them on one global clock,
+  the model-time analogue of :func:`align_clocks` for wall timestamps.
+
+At registry example dims the reconstruction sits in the latency regime
+(hop latency dominates byte time) — the columns are still exact model
+time, and on real-shape streams the same arithmetic yields the
+bandwidth picture.  ``pct_sol`` compares the reconstructed critical
+path against the per-rank roofline ``max(compute, wire)`` — the
+achieved-vs-SOL figure of ``scripts/obs_report.py --timeline``.
+
+A truncated stream (partial ring buffer: the recorder dropped the
+oldest events) reconstructs as far as the credits allow and reports the
+unreplayable tail as ``pending`` instead of raising — the
+dump-at-failure path must never turn a diagnosis into a crash.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+
+# model-time constants (us): ICI hop latency per transfer/signal, fixed
+# per-pipeline-invocation launch cost, and the bookkeeping epsilon that
+# keeps program order strict on the model clock
+HOP_US = 1.0
+LAUNCH_US = 0.5
+EPS_US = 0.01
+
+
+@dataclasses.dataclass(frozen=True)
+class WaitAttribution:
+    """One attributed stall: ``rank`` spent ``exposed_us`` blocked on
+    ``sem`` waiting for ``chunk`` from ``source``."""
+
+    rank: int
+    kind: str                 # wait | wait_recv | wait_send
+    sem: str | None
+    chunk: str | None
+    source: int | None        # producing rank of the latest credit
+    exposed_us: float
+    t_end_us: float
+
+    def describe(self) -> str:
+        s = (f"rank {self.rank} waited {self.exposed_us:.3f}us on "
+             f"{self.sem or '?'}")
+        if self.chunk:
+            s += f" for chunk {self.chunk}"
+        if self.source is not None:
+            s += f" from rank {self.source}"
+        return s
+
+
+@dataclasses.dataclass(frozen=True)
+class Interval:
+    rank: int
+    lane: str                 # protocol | wire
+    kind: str
+    label: str
+    t0_us: float
+    t1_us: float
+
+
+@dataclasses.dataclass
+class RankRow:
+    rank: int
+    compute_us: float = 0.0
+    wire_us: float = 0.0
+    exposed_us: float = 0.0
+    barrier_us: float = 0.0
+    finish_us: float = 0.0
+
+
+@dataclasses.dataclass
+class Timeline:
+    kernel: str
+    n: int
+    rows: list[RankRow]
+    waits: list[WaitAttribution]
+    intervals: list[Interval]
+    flows: list[tuple[Interval, float, int]]  # (wire interval, wait end, dst)
+    critical_us: float
+    skew_us: float
+    sol_us: float
+    stalled: bool = False
+    pending: tuple[str, ...] = ()
+
+    @property
+    def pct_sol(self) -> float:
+        """Achieved-vs-SOL: the roofline lower bound over the
+        reconstructed critical path (clamped at 1.0 — the bound ignores
+        protocol dependencies, so a latency-pipelined kernel can touch
+        it but never beat it meaningfully)."""
+        if self.critical_us <= 0:
+            return 1.0
+        return min(1.0, self.sol_us / self.critical_us)
+
+
+@dataclasses.dataclass
+class _Credit:
+    amount: int
+    ready: float
+    source: int
+    chunk: str | None
+
+
+def reconstruct(streams, *, kernel: str = "?", device_kind: str | None = None,
+                itemsize: int = 2) -> Timeline:
+    """Replay per-rank flight streams onto one model clock (see module
+    docstring).  ``streams``: list indexed by rank; ``itemsize`` converts
+    recorded element counts to bytes (record-mode refs are untyped)."""
+    from ..tools import perf_model
+
+    spec = perf_model.chip_spec(device_kind)
+    mxu = spec.bf16_tflops * 1e6     # flops per us
+    hbm = spec.hbm_gbps * 1e3        # bytes per us
+    ici = spec.ici_gbps * 1e3        # bytes per us
+
+    n = len(streams)
+    evs = [[e for e in s if e.kind not in ("step", "collective")]
+           for s in streams]
+    clocks = [0.0] * n
+    pcs = [0] * n
+    nbar = [0] * n
+    wire_bytes = [0] * n
+    rows = [RankRow(r) for r in range(n)]
+    credits: dict[tuple[int, str], deque] = {}
+    waits: list[WaitAttribution] = []
+    intervals: list[Interval] = []
+    flows: list[tuple[Interval, float, int]] = []
+    wire_by_credit: dict[tuple[int, str, int], Interval] = {}
+    consumed_seq: dict[tuple[int, str], int] = {}
+    issued_seq: dict[tuple[int, str], int] = {}
+
+    def add_credit(rank, sem, amount, ready, source, chunk,
+                   wire: Interval | None = None):
+        key = (rank, sem)
+        credits.setdefault(key, deque()).append(
+            _Credit(amount, ready, source, chunk))
+        if wire is not None:
+            wire_by_credit[(rank, sem, issued_seq.get(key, 0))] = wire
+        issued_seq[key] = issued_seq.get(key, 0) + 1
+
+    def available(rank, sem) -> int:
+        return sum(c.amount for c in credits.get((rank, sem), ()))
+
+    def wait_step(r, ev) -> bool:
+        sem = ev.sem or "?"
+        need = max(int(ev.elems), 1)
+        if available(r, sem) < need:
+            return False
+        q = credits[(r, sem)]
+        t0 = clocks[r]
+        latest = t0
+        src = chunk = None
+        crit_seq = None
+        while need > 0:
+            c = q[0]
+            take = min(need, c.amount)
+            c.amount -= take
+            need -= take
+            if c.ready >= latest:
+                latest = max(latest, c.ready)
+                src, chunk = c.source, c.chunk
+                crit_seq = consumed_seq.get((r, sem), 0)
+            if c.amount == 0:
+                q.popleft()
+                consumed_seq[(r, sem)] = consumed_seq.get((r, sem), 0) + 1
+        t1 = max(t0, latest) + EPS_US
+        exposed = max(0.0, latest - t0)
+        rows[r].exposed_us += exposed
+        intervals.append(Interval(r, "protocol", ev.kind, sem, t0, t1))
+        if exposed > 0:
+            waits.append(WaitAttribution(
+                r, ev.kind, sem, chunk if chunk else ev.chunk, src,
+                exposed, t1))
+            wire = wire_by_credit.get((r, sem, crit_seq)) \
+                if crit_seq is not None else None
+            if wire is not None:
+                flows.append((wire, t1, r))
+        clocks[r] = t1
+        pcs[r] += 1
+        return True
+
+    def barrier_step(r, ev) -> bool:
+        k = nbar[r]
+        parked = []
+        for p in range(n):
+            if nbar[p] != k:
+                return False
+            if pcs[p] >= len(evs[p]) or evs[p][pcs[p]].kind != "barrier":
+                return False
+            parked.append(p)
+        t_join = max(clocks[p] for p in parked) + EPS_US
+        for p in parked:
+            rows[p].barrier_us += max(0.0, t_join - EPS_US - clocks[p])
+            intervals.append(Interval(p, "protocol", "barrier",
+                                      ev.sem or "barrier", clocks[p], t_join))
+            clocks[p] = t_join
+            pcs[p] += 1
+            nbar[p] += 1
+        return True
+
+    def step(r) -> bool:
+        if pcs[r] >= len(evs[r]):
+            return False
+        ev = evs[r][pcs[r]]
+        t0 = clocks[r]
+        if ev.kind in ("wait", "wait_recv", "wait_send"):
+            return wait_step(r, ev)
+        if ev.kind == "barrier":
+            return barrier_step(r, ev)
+        if ev.kind == "notify":
+            target = ev.peer if ev.peer is not None else r
+            hop = 0.0 if target == r else HOP_US
+            add_credit(target, ev.sem or "?", max(int(ev.elems), 1),
+                       t0 + EPS_US + hop, r, ev.chunk)
+            clocks[r] = t0 + EPS_US
+        elif ev.kind == "remote_copy":
+            nbytes = ev.elems * itemsize
+            wire_t = HOP_US + nbytes / ici
+            target = ev.peer if ev.peer is not None else r
+            wire = Interval(r, "wire", "remote_copy",
+                            f"{ev.chunk or '?'} -> rank {target}",
+                            t0, t0 + wire_t)
+            intervals.append(wire)
+            rows[r].wire_us += wire_t
+            wire_bytes[r] += nbytes
+            if ev.sem2:
+                add_credit(r, ev.sem2, ev.elems, t0 + wire_t, r, ev.chunk)
+            add_credit(target, ev.sem or "?", ev.elems, t0 + wire_t, r,
+                       ev.chunk, wire=wire)
+            clocks[r] = t0 + EPS_US
+        elif ev.kind == "local_copy":
+            nbytes = ev.elems * itemsize
+            add_credit(r, ev.sem or "?", ev.elems,
+                       t0 + LAUNCH_US + nbytes / hbm, r, ev.chunk)
+            clocks[r] = t0 + EPS_US
+        elif ev.kind == "compute":
+            dur = LAUNCH_US + max(ev.flops / mxu, ev.bytes * itemsize / hbm)
+            intervals.append(Interval(r, "protocol", "compute",
+                                      ev.op or "compute", t0, t0 + dur))
+            rows[r].compute_us += dur
+            clocks[r] = t0 + dur
+        else:
+            clocks[r] = t0 + EPS_US
+        pcs[r] += 1
+        return True
+
+    progress = True
+    while progress:
+        progress = False
+        for r in range(n):
+            while step(r):
+                progress = True
+
+    pending = []
+    for r in range(n):
+        rows[r].finish_us = clocks[r]
+        if pcs[r] < len(evs[r]):
+            ev = evs[r][pcs[r]]
+            pending.append(
+                f"rank {r} unreplayable at event #{pcs[r]} "
+                f"({ev.kind} {ev.sem or ''}: need {ev.elems}, "
+                f"have {available(r, ev.sem or '?')}) — truncated or "
+                f"stalled stream")
+    finishes = [rw.finish_us for rw in rows] or [0.0]
+    critical = max(finishes)
+    # SOL lower bound per rank: compute roofline vs wire roofline.  The
+    # wire bound serializes BYTES per link but pipelines hop latency
+    # (one hop, not one per transfer) — the per-transfer hops in the
+    # replay model protocol latency, which overlapped transfers hide.
+    sol = max(
+        (max(rw.compute_us,
+             wire_bytes[rw.rank] / ici + (HOP_US if wire_bytes[rw.rank]
+                                          else 0.0))
+         for rw in rows),
+        default=0.0,
+    )
+    waits.sort(key=lambda w: -w.exposed_us)
+    return Timeline(kernel, n, rows, waits, intervals, flows,
+                    critical_us=critical,
+                    skew_us=max(finishes) - min(finishes),
+                    sol_us=sol, stalled=bool(pending),
+                    pending=tuple(pending))
+
+
+# ---------------------------------------------------------------------------
+# wall-clock alignment (for streams carrying real per-process timestamps)
+
+
+def align_clocks(streams) -> list[float]:
+    """Per-rank offsets (us, add to each rank's ``t_us``) that bring the
+    hub-barrier events into coincidence with rank 0's — the cross-process
+    clock alignment step for wall-timestamped streams (each process's
+    monotonic clock has an arbitrary epoch).  Uses the mean offset over
+    the barrier ordinals every rank recorded; ranks with no common
+    barrier get offset 0."""
+    bars = [[e.t_us for e in s if e.kind == "barrier"] for s in streams]
+    k = min((len(b) for b in bars), default=0)
+    if k == 0:
+        return [0.0] * len(streams)
+    offs = []
+    for b in bars:
+        offs.append(sum(bars[0][i] - b[i] for i in range(k)) / k)
+    return offs
+
+
+def apply_offsets(streams, offsets):
+    """Shifted copies of ``streams`` (event objects are replaced, inputs
+    untouched)."""
+    import copy
+
+    out = []
+    for s, off in zip(streams, offsets):
+        shifted = []
+        for e in s:
+            e2 = copy.copy(e)
+            e2.t_us = e.t_us + off
+            shifted.append(e2)
+        out.append(shifted)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rendering
+
+
+def format_table(timelines) -> str:
+    """The per-collective table: one block per kernel with per-rank
+    compute / wire / exposed-wait / straggler-skew columns, the summary
+    line (critical path, pct of SOL), and the wait-attribution list."""
+    if isinstance(timelines, Timeline):
+        timelines = [timelines]
+    lines = []
+    header = ("kernel", "rank", "compute_us", "wire_us", "exposed_us",
+              "barrier_us", "finish_us")
+    for tl in timelines:
+        table = [header]
+        for rw in tl.rows:
+            table.append((tl.kernel, str(rw.rank), f"{rw.compute_us:.3f}",
+                          f"{rw.wire_us:.3f}", f"{rw.exposed_us:.3f}",
+                          f"{rw.barrier_us:.3f}", f"{rw.finish_us:.3f}"))
+        widths = [max(len(row[i]) for row in table)
+                  for i in range(len(header))]
+        for i, row in enumerate(table):
+            lines.append("  ".join(
+                c.ljust(w) if j == 0 else c.rjust(w)
+                for j, (c, w) in enumerate(zip(row, widths))))
+            if i == 0:
+                lines.append("  ".join("-" * w for w in widths))
+        lines.append(
+            f"{tl.kernel}: ranks={tl.n} critical={tl.critical_us:.3f}us "
+            f"skew={tl.skew_us:.3f}us sol={tl.sol_us:.3f}us "
+            f"pct_sol={100 * tl.pct_sol:.1f}%"
+        )
+        if tl.waits:
+            lines.append("wait attribution (semaphore, chunk, peer):")
+            for w in tl.waits[:16]:
+                lines.append(f"  {w.describe()}")
+        if tl.stalled:
+            lines.append("PARTIAL RECONSTRUCTION:")
+            for p in tl.pending:
+                lines.append(f"  {p}")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def to_chrome(tl: Timeline) -> list[dict]:
+    """Chrome-trace events of a reconstructed timeline: per-rank protocol
+    and wire lanes plus FLOW events linking each attributed wait to the
+    transfer it starved for (the arrows the reference's merged profiler
+    view shows between producer and consumer kernels)."""
+    evs = []
+    lanes = {"protocol": 0, "wire": 1}
+    for iv in tl.intervals:
+        evs.append({
+            "name": iv.label, "cat": iv.kind, "ph": "X",
+            "ts": iv.t0_us, "dur": max(iv.t1_us - iv.t0_us, EPS_US),
+            "pid": iv.rank, "tid": lanes[iv.lane],
+        })
+    for i, (wire, t_end, dst) in enumerate(tl.flows):
+        common = {"cat": "stall", "name": "starved-for", "id": i + 1}
+        evs.append({**common, "ph": "s", "ts": wire.t1_us,
+                    "pid": wire.rank, "tid": lanes["wire"]})
+        evs.append({**common, "ph": "f", "bp": "e", "ts": t_end,
+                    "pid": dst, "tid": lanes["protocol"]})
+    return evs
+
+
+def check_balanced(tl: Timeline, *, tol: float = 1e-6) -> list[str]:
+    """Symmetry checks for a ring kernel's reconstruction (the
+    ``tdt_lint --timeline`` smoke): every rank of a symmetric ring must
+    reconstruct identical exposed-wait totals, every recv attribution
+    must name its (semaphore, chunk, peer) triple, and the replay must
+    complete.  Returns human-readable problems (empty = balanced)."""
+    problems = []
+    if tl.stalled:
+        problems.extend(f"stalled: {p}" for p in tl.pending)
+    exposed = [rw.exposed_us for rw in tl.rows]
+    if exposed and max(exposed) - min(exposed) > tol:
+        problems.append(
+            f"exposed-wait imbalance across ranks: {exposed} "
+            f"(symmetric ring must reconstruct symmetrically)")
+    for w in tl.waits:
+        if w.kind == "wait_recv" and (w.sem is None or w.chunk is None
+                                      or w.source is None):
+            problems.append(
+                f"unattributed recv stall: {w.describe()} — the flight "
+                f"stream lost the (sem, chunk, peer) identity")
+    return problems

@@ -179,12 +179,18 @@ def _build_ag_gemm(
     n = team.size
     compilation.verify_protocol("ag_gemm", n)
 
+    from ..obs import costs
+
     kern = _ag_gemm_bidir_kernel if bidir else _ag_gemm_kernel
     kernel = functools.partial(
         kern, team, m_loc, k_dim, n_loc, cfg, out_dtype
     )
     call = pl.pallas_call(
         kernel,
+        # kernel cost attribution (reference launch_metadata): the same
+        # flop/byte source the SOL model and flight timeline read
+        cost_estimate=costs.pallas_cost(
+            costs.ag_gemm(m_loc, k_dim, n_loc, n, dtype, out_dtype)),
         out_shape=(
             jax.ShapeDtypeStruct((n * m_loc, k_dim), dtype),       # gathered A
             jax.ShapeDtypeStruct((n * m_loc, n_loc), out_dtype),   # C

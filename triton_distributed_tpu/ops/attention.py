@@ -185,12 +185,18 @@ def _build_flash_attention(
             pl.BlockSpec((1, bq), lambda bh, iq: (bh // h, iq)),
             pl.BlockSpec((1, seq_kv), lambda bh, iq: (bh // h, 0)),
         ]
+    from ..obs import costs
+
     call = pl.pallas_call(
         kernel,
         grid=(b * h, seq_q // bq),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, iq: (bh, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, seq_q, d), dtype),
+        # kernel cost attribution sourced from obs.costs (the VPU-bound
+        # exp count rides in transcendentals — docs/perf.md roofline)
+        cost_estimate=costs.pallas_cost(
+            costs.flash_attention(b, h, seq_q, seq_kv, d, causal, dtype)),
         compiler_params=compilation.compiler_params(
             collective=False,
             dimension_semantics=("parallel", "arbitrary"),
@@ -438,6 +444,8 @@ def _build_attn_chunk(b, h, hk, seq_q, seq_c, d, bq, bk, causal, has_segs,
             pl.BlockSpec((1, seq_c), lambda bh, iq: (bh // h, 0)),
         ]
     in_specs += [state2_spec, state2_spec, state3_spec]
+    from ..obs import costs
+
     call = pl.pallas_call(
         kernel,
         grid=(b * h, seq_q // bq),
@@ -448,6 +456,10 @@ def _build_attn_chunk(b, h, hk, seq_q, seq_c, d, bq, bk, causal, has_segs,
             jax.ShapeDtypeStruct((b * h, seq_q), jnp.float32),
             jax.ShapeDtypeStruct((b * h, seq_q, d), jnp.float32),
         ],
+        # the ring (sp_attention) chunk fold: one attention tile's cost
+        cost_estimate=costs.pallas_cost(
+            costs.flash_attention(b, h, seq_q, seq_c, d, causal,
+                                  jnp.float32)),
         compiler_params=compilation.compiler_params(
             collective=False,
             dimension_semantics=("parallel", "arbitrary"),
@@ -606,6 +618,8 @@ def _build_decode(b, h, hk, seq_kv, d, n_split, bk, sm_scale, soft_cap, dtype):
     group = h // hk
     sp = seq_kv // n_split
     kernel = functools.partial(_decode_kernel, hk, bk, sm_scale, soft_cap)
+    from ..obs import costs
+
     call = pl.pallas_call(
         kernel,
         grid=(b * hk, n_split),
@@ -625,6 +639,10 @@ def _build_decode(b, h, hk, seq_kv, d, n_split, bk, sm_scale, soft_cap, dtype):
             jax.ShapeDtypeStruct((b * hk, n_split, group, 128), jnp.float32),
             jax.ShapeDtypeStruct((b * hk, n_split, group, 128), jnp.float32),
         ],
+        # KV-bandwidth-bound decode: cost = streaming the cache once
+        # (flash_decode's per-rank stage reuses this builder)
+        cost_estimate=costs.pallas_cost(
+            costs.decode_attention(b, h, hk, seq_kv, d, dtype)),
         compiler_params=compilation.compiler_params(
             collective=False,
             dimension_semantics=("parallel", "arbitrary"),
@@ -969,6 +987,8 @@ def _build_decode_fused(b, h, hk, seq_kv, d, n_split, bk, sm_scale,
     kernel = functools.partial(
         _decode_fused_kernel, hk, n_split, bk, sm_scale, soft_cap
     )
+    from ..obs import costs
+
     call = pl.pallas_call(
         kernel,
         grid=(b * hk, n_split),
@@ -980,6 +1000,8 @@ def _build_decode_fused(b, h, hk, seq_kv, d, n_split, bk, sm_scale,
         ],
         out_specs=pl.BlockSpec((1, group, d), lambda bh, s: (bh, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b * hk, group, d), dtype),
+        cost_estimate=costs.pallas_cost(
+            costs.decode_attention(b, h, hk, seq_kv, d, dtype)),
         scratch_shapes=[
             pltpu.VMEM((group, 1), jnp.float32),
             pltpu.VMEM((group, 1), jnp.float32),
@@ -1120,6 +1142,8 @@ def _build_paged_decode(b, h, hk, num_pages, page_size, max_pages, d,
             pl.BlockSpec((1, 1, group, 128), lambda bh, j, *_: (bh, j, 0, 0)),
         ],
     )
+    from ..obs import costs
+
     call = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -1128,6 +1152,10 @@ def _build_paged_decode(b, h, hk, num_pages, page_size, max_pages, d,
             jax.ShapeDtypeStruct((b * hk, max_pages, group, 128), jnp.float32),
             jax.ShapeDtypeStruct((b * hk, max_pages, group, 128), jnp.float32),
         ],
+        # paged decode streams max_pages * page_size rows of cache
+        cost_estimate=costs.pallas_cost(
+            costs.decode_attention(b, h, hk, max_pages * page_size, d,
+                                   dtype)),
         compiler_params=compilation.compiler_params(
             collective=False,
             dimension_semantics=("parallel", "arbitrary"),

@@ -33,6 +33,11 @@ def _protocol_stub(kind: str):
             raise RuntimeError(
                 "protocol-stub pipeline called outside record mode"
             )
+        fl = dl._flight()
+        if fl is not None:
+            # flight cost attribution: the flop/byte counts of this
+            # pipeline invocation, derived from the recorded regions
+            fl.on_compute(kind, refs)
         rec.on_compute(kind, refs[:-1], refs[-1])
 
     return stub

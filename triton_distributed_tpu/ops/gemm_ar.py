@@ -152,11 +152,18 @@ def _build_gemm_ar(
     team = Team.of(mesh, axis)
     n = team.size
     compilation.verify_protocol("gemm_ar", n)
+
+    from ..obs import costs
+
     kernel = functools.partial(
         _gemm_ar_kernel, team, m_loc, k_loc, n_dim, cfg, out_dtype
     )
     call = pl.pallas_call(
         kernel,
+        # kernel cost attribution sourced from obs.costs (one flop/byte
+        # truth for Mosaic, the SOL model, and the flight timeline)
+        cost_estimate=costs.pallas_cost(
+            costs.gemm_ar(m_loc, k_loc, n_dim, n, dtype, out_dtype)),
         out_shape=jax.ShapeDtypeStruct((n * m_loc, n_dim), out_dtype),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),

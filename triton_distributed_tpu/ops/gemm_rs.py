@@ -151,11 +151,18 @@ def _build_gemm_rs(
     team = Team.of(mesh, axis)
     n = team.size
     compilation.verify_protocol("gemm_rs", n)
+
+    from ..obs import costs
+
     kernel = functools.partial(
         _gemm_rs_kernel, team, m_loc, k_loc, n_dim, cfg, out_dtype
     )
     call = pl.pallas_call(
         kernel,
+        # kernel cost attribution sourced from obs.costs (one flop/byte
+        # truth for Mosaic, the SOL model, and the flight timeline)
+        cost_estimate=costs.pallas_cost(
+            costs.gemm_rs(m_loc, k_loc, n_dim, n, dtype, out_dtype)),
         out_shape=jax.ShapeDtypeStruct((m_loc, n_dim), out_dtype),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),

@@ -44,10 +44,14 @@ def _build_matmul(m, n, k, bm, bn, bk, dtype, out_dtype, vmem_limit=None):
     # default for big-accumulator tiles (the v5e has 128 MiB of VMEM; a
     # >=4 MB f32 accumulator plus double-buffered operands fails to
     # compile under the default budget).
+    from ..obs import costs
+
     nk = k // bk
     call = pl.pallas_call(
         functools.partial(blocks.matmul_body, nk, out_dtype),
         grid=(m // bm, n // bn, nk),
+        cost_estimate=costs.pallas_cost(
+            costs.matmul(m, n, k, dtype, out_dtype)),
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
             pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),

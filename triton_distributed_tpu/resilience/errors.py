@@ -48,7 +48,10 @@ class TimeoutDiagnosis:
     permanent stall.  ``static`` marks a diagnosis derived from the
     protocol's recorded structure (the live device state is not
     introspectable from the host once a kernel hangs) rather than from a
-    simulated execution.
+    simulated execution.  ``flight`` carries the flight recorder's recent
+    event lines when the ring was armed (``TDT_FLIGHT=1``,
+    docs/observability.md) — what the protocol was doing just before the
+    deadline fired.
     """
 
     kernel: str
@@ -58,6 +61,7 @@ class TimeoutDiagnosis:
     aborted: tuple[int, ...] = ()
     note: str = ""
     static: bool = False
+    flight: tuple[str, ...] = ()
 
     def describe(self) -> str:
         lines = []
@@ -70,6 +74,9 @@ class TimeoutDiagnosis:
         if self.aborted:
             lines.append("aborted rank(s): " +
                          ", ".join(str(r) for r in self.aborted))
+        if self.flight:
+            lines.append("recent flight events: " +
+                         " | ".join(self.flight))
         return "; ".join(lines) if lines else "no protocol state recorded"
 
     def semaphores(self) -> tuple[str, ...]:

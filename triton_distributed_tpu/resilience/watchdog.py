@@ -181,6 +181,19 @@ def call_with_deadline(op: str, thunk, deadline_ms: float | None, *,
             obs.counter("resilience_timeouts", op=op).inc()
         diag = protocol_pending(family, int(ranks)) \
             if family and ranks else None
+        if obs.flight.enabled():
+            # attach the flight ring's recent history: what the protocol
+            # was doing just before the deadline fired (TDT_FLIGHT=1;
+            # docs/observability.md "Flight recorder")
+            import dataclasses as _dc
+
+            lines = obs.flight.recent_lines()
+            if diag is None:
+                diag = TimeoutDiagnosis(
+                    family or op, int(ranks or 0), flight=lines,
+                    note="no static protocol diagnosis available")
+            else:
+                diag = _dc.replace(diag, flight=lines)
         err = CollectiveTimeoutError(op, deadline_ms, diag)
         # callers with mutable state the abandoned thread might still
         # touch (Engine._mark_failed) need its identity to fence writes

@@ -68,14 +68,14 @@ def _dtype_bytes(dtype) -> int:
 def gemm_sol_ms(m: int, n: int, k: int, dtype=jnp.bfloat16,
                 device_kind: str | None = None) -> float:
     """Roofline GEMM time: max(FLOPs / MXU peak, bytes / HBM peak)
-    (reference ``get_tensorcore_tflops`` + ``estimate_gemm_sol_time_ms``)."""
-    spec = chip_spec(device_kind)
-    flops = 2.0 * m * n * k
-    t_flops = flops / (spec.bf16_tflops * 1e12)
-    b = _dtype_bytes(dtype)
-    bytes_moved = b * (m * k + k * n + m * n)
-    t_mem = bytes_moved / (spec.hbm_gbps * 1e9)
-    return max(t_flops, t_mem) * 1e3
+    (reference ``get_tensorcore_tflops`` + ``estimate_gemm_sol_time_ms``).
+    Flop/byte counts come from ``obs.costs`` — the same source the fused
+    kernels' ``cost_estimate`` and the flight timeline read, so the
+    watchdog deadline, the profiler label, and the %-of-SOL report can
+    never disagree on the arithmetic."""
+    from ..obs import costs
+
+    return costs.sol_ms(costs.matmul(m, n, k, dtype, dtype), device_kind)
 
 
 def allgather_sol_ms(nbytes_per_rank: int, num_ranks: int,
@@ -99,6 +99,17 @@ def allreduce_sol_ms(nbytes: int, num_ranks: int,
     spec = chip_spec(device_kind)
     wire = 2.0 * nbytes * (num_ranks - 1) / num_ranks
     return wire / (spec.ici_gbps * 1e9) * 1e3
+
+
+def fused_sol_ms(family: str, device_kind: str | None = None,
+                 **shape_kw) -> float:
+    """Roofline time of a fused kernel family via its ``obs.costs``
+    calculator (``costs.FAMILY_COSTS``) — the achieved-vs-SOL denominator
+    of ``scripts/obs_report.py --timeline``."""
+    from ..obs import costs
+
+    calc = costs.FAMILY_COSTS[family]
+    return costs.sol_ms(calc(**shape_kw), device_kind)
 
 
 def overlap_efficiency(t_measured_ms: float, t_gemm_ms: float,

@@ -39,18 +39,22 @@ def _load_native():
 
 
 def _merge_python(inputs: Sequence[str], ranks: Sequence[int],
-                  out_path: str, gzip_out: bool) -> None:
+                  out_path: str, gzip_out: bool,
+                  ts_offsets: Sequence[float] | None = None) -> None:
     envelope = None
     events = []
-    for path, rank in zip(inputs, ranks):
+    for i, (path, rank) in enumerate(zip(inputs, ranks)):
         with open(path) as f:
             trace = json.load(f)
         if envelope is None:
             # keep the first input's non-event keys (displayTimeUnit, ...)
             envelope = {k: v for k, v in trace.items() if k != "traceEvents"}
+        off = ts_offsets[i] if ts_offsets is not None else 0
         for ev in trace.get("traceEvents", []):
             if isinstance(ev.get("pid"), int):
                 ev["pid"] += rank * _PID_OFFSET
+            if off and isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] += off
             events.append(ev)
     envelope = dict(envelope or {})
     envelope["traceEvents"] = events
@@ -72,9 +76,17 @@ def merge_traces(
     *,
     gzip_out: bool | None = None,
     native: bool = True,
+    ts_offsets: Sequence[float] | None = None,
 ) -> str:
     """Merge per-rank chrome traces into one file, offsetting each rank's
     pids by ``rank * 1e6`` so process lanes stay disjoint in the viewer.
+
+    ``ts_offsets`` (us per input, e.g. from
+    ``obs.timeline.align_clocks`` over flight barrier events) shifts each
+    input's event timestamps before merging — cross-process clock
+    alignment so one global timeline lines up at the barriers.  Offsets
+    force the Python merge path: the native merger splices input text
+    verbatim and cannot rewrite ``ts``.
 
     Returns ``out_path``.  ``gzip_out`` defaults to the ``.gz`` suffix.
     """
@@ -82,9 +94,14 @@ def merge_traces(
         ranks = list(range(len(inputs)))
     if len(ranks) != len(inputs):
         raise ValueError(f"{len(inputs)} inputs but {len(ranks)} ranks")
+    if ts_offsets is not None and len(ts_offsets) != len(inputs):
+        raise ValueError(
+            f"{len(inputs)} inputs but {len(ts_offsets)} ts_offsets")
     if gzip_out is None:
         gzip_out = out_path.endswith(".gz")
 
+    if ts_offsets is not None and any(ts_offsets):
+        native = False
     lib = _load_native() if native else False
     if lib:
         arr = (ctypes.c_char_p * len(inputs))(
@@ -96,5 +113,5 @@ def merge_traces(
         if rc == 0:
             return out_path
         # fall through to the Python path on any native error
-    _merge_python(inputs, ranks, out_path, gzip_out)
+    _merge_python(inputs, ranks, out_path, gzip_out, ts_offsets)
     return out_path

@@ -418,6 +418,29 @@ _EMITTED: list = []
 # and the claims gate prefers it over the envelope tail when committed
 _LOCAL_SINK = None
 
+# the round this capture will become (newest committed BENCH_r*.json +
+# 1): stamped into every emitted record line so the perf-trajectory
+# sentinel (scripts/bench_history.py) can place a stray/renamed record
+# file without trusting its filename
+_ROUND = None
+
+
+def _next_round() -> int:
+    """Round numbering by plain glob over the committed envelopes —
+    deliberately NOT via the claims module, whose bugs must not break a
+    capture (same rationale as _open_local_record)."""
+    import glob
+    import os
+    import re
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    for p in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if m:
+            rounds.append(int(m.group(1)))
+    return max(rounds) + 1 if rounds else 1
+
 
 def _record_line(line: str) -> None:
     """Emit one JSONL record line to stdout (the driver captures its
@@ -436,14 +459,13 @@ def _open_local_record() -> None:
     ``TDT_BENCH_LOCAL`` overrides the path; ``0``/``off`` disables the
     tee.  Any failure here is non-fatal — stdout (the envelope path)
     still carries the stream."""
-    import glob
     import os
-    import re
     import sys
     import traceback
 
-    global _LOCAL_SINK
+    global _LOCAL_SINK, _ROUND
     try:
+        _ROUND = _next_round()
         env = os.environ.get("TDT_BENCH_LOCAL", "")
         if env.lower() in ("0", "off", "false", "no"):
             return
@@ -451,13 +473,7 @@ def _open_local_record() -> None:
         if env:
             path = env
         else:
-            rounds = []
-            for p in glob.glob(os.path.join(root, "BENCH_r*.json")):
-                m = re.search(r"BENCH_r(\d+)\.json$", p)
-                if m:
-                    rounds.append(int(m.group(1)))
-            rnd = max(rounds) + 1 if rounds else 1
-            path = os.path.join(root, f"BENCH_LOCAL_r{rnd:02d}.jsonl")
+            path = os.path.join(root, f"BENCH_LOCAL_r{_ROUND:02d}.jsonl")
         _LOCAL_SINK = open(path, "w")
     except Exception:
         traceback.print_exc(file=sys.stderr)
@@ -535,6 +551,8 @@ def _emit(fn, *args, **kw):
                 rec["retry_crashed"] = True
                 if rec.get("metric"):
                     _EMITTED.append(rec["metric"])
+                if _ROUND is not None:
+                    rec.setdefault("round", _ROUND)
                 _record_line(json.dumps(rec))
                 raise
             # SYMMETRIC retry (ADVICE r5 low #3): the published value is
@@ -548,6 +566,10 @@ def _emit(fn, *args, **kw):
             rec["retry_value"] = retry.get("value")
         if rec.get("metric"):
             _EMITTED.append(rec["metric"])
+        if _ROUND is not None:
+            # round-id stamp: the trajectory sentinel can place this
+            # line without trusting the record file's name
+            rec.setdefault("round", _ROUND)
         _record_line(json.dumps(rec))
     except Exception:  # keep the remaining modes alive, but fail the run
         _EMIT_FAILED = True
@@ -997,6 +1019,9 @@ def main():
             # the completeness gate requires slice-gated claims only on
             # sweeps that actually ran on a slice
             "devices": jax.device_count(),
+            # round-id stamp (see _next_round): lets the trajectory
+            # sentinel place the stream without trusting the filename
+            "round": _ROUND,
         }))
         if _LOCAL_SINK is not None:
             _LOCAL_SINK.close()

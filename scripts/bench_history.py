@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Round-over-round bench trajectory report and trend sentinel.
+
+Reads every committed bench round (``BENCH_rNN.json`` driver envelopes
+plus ``BENCH_LOCAL_rNN.jsonl`` complete streams) through
+``triton_distributed_tpu.obs.history`` and prints the per-metric
+trajectory table: draws across rounds, the prior rounds' healthy band,
+and WARN annotations for
+
+- an N=3-round monotonic decline in the worse direction (> 5% total),
+- a newest draw outside the prior rounds' healthy band even when it is
+  above its claims-registry floor (a dip whose symmetric ``retry_value``
+  is back inside the band reports as transient).
+
+Usage:
+    python scripts/bench_history.py [root]            # trajectory table
+    python scripts/bench_history.py --markdown        # docs-pasteable
+    python scripts/bench_history.py --json report.json  ('-' = stdout)
+    python scripts/bench_history.py --metric flash    # substring filter
+    python scripts/bench_history.py --check           # CI mode
+    python scripts/bench_history.py --check --strict  # WARN -> exit 1
+
+``--check`` is the loud half (wired into ``scripts/tdt_lint.py
+--history`` and the tier-1 smoke test): exit 1 when a committed round is
+**internally inconsistent** — a local stream disagreeing with its
+same-round envelope on a shared value, a local record missing a metric
+its own sentinel lists as emitted, a crashed sweep (rc != 0 or
+sentinel=0), or a record with no parseable metric lines.  Trend findings
+stay warnings (the chip's round noise is real) unless ``--strict``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("root", nargs="?",
+                    default=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    help="repo root holding the BENCH_r* records")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the full report as JSON ('-' = stdout)")
+    ap.add_argument("--markdown", action="store_true",
+                    help="emit the trajectory table as markdown")
+    ap.add_argument("--metric", default=None,
+                    help="only metrics whose name contains this")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: exit 1 on internal inconsistency "
+                         "(trend findings warn)")
+    ap.add_argument("--strict", action="store_true",
+                    help="with --check: trend warnings also fail")
+    ap.add_argument("--decline-rounds", type=int, default=None,
+                    help="consecutive worse rounds that flag a decline "
+                         "(default 3)")
+    args = ap.parse_args(argv)
+
+    from triton_distributed_tpu.obs import history
+
+    rounds = history.load_rounds(args.root)
+    if not rounds:
+        machine = bool(args.json or args.markdown)
+        print(f"{args.root}: no BENCH_r*.json / BENCH_LOCAL_r*.jsonl "
+              f"records found",
+              file=sys.stderr if machine else sys.stdout)
+        if args.json:
+            # stdout/target stays machine-readable: an empty report
+            payload = json.dumps(history.to_json({}, []), indent=1,
+                                 sort_keys=True)
+            if args.json == "-":
+                print(payload)
+            else:
+                with open(args.json, "w") as f:
+                    f.write(payload + "\n")
+        return 1 if args.check else 0
+    kw = {}
+    if args.decline_rounds is not None:
+        kw["decline_rounds"] = args.decline_rounds
+    trs = history.analyze(rounds, **kw)
+    if args.metric:
+        trs = {k: v for k, v in trs.items() if args.metric in k}
+    problems = history.consistency_problems(rounds)
+    warnings = history.all_warnings(trs)
+
+    if args.json:
+        payload = json.dumps(history.to_json(trs, problems), indent=1,
+                             sort_keys=True)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload + "\n")
+    elif args.markdown:
+        sys.stdout.write(history.format_markdown(trs))
+    else:
+        print(f"{len(rounds)} committed round(s): "
+              f"{', '.join(f'r{r.round:02d}({r.source})' for r in rounds)}")
+        sys.stdout.write(history.format_table(trs))
+
+    # machine-readable modes keep stdout clean (the JSON payload already
+    # embeds "problems"/"warnings"); diagnostics go to stderr there
+    diag = sys.stderr if (args.json or args.markdown) else sys.stdout
+    for p in problems:
+        print(f"PROBLEM {p}", file=diag)
+    if args.json or args.markdown:
+        for w in warnings:
+            print(f"WARN {w}", file=sys.stderr)
+
+    if args.check:
+        if problems:
+            print(f"bench history check: {len(problems)} internal "
+                  f"inconsistency problem(s)", file=diag)
+            return 1
+        if args.strict and warnings:
+            print(f"bench history check (--strict): {len(warnings)} "
+                  f"trend warning(s)", file=diag)
+            return 1
+        print(f"bench history check OK: {len(rounds)} rounds consistent, "
+              f"{len(warnings)} trend warning(s)", file=diag)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

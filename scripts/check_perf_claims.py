@@ -27,9 +27,14 @@ Round-5 restructure (VERDICT r4 next #1):
   against an unstable baseline is evidence, not a claim.  Deterministic
   ratios (byte accounting) remain hard failures: they have no noise.
 
-Usage: python scripts/check_perf_claims.py [repo_root]
+Usage: python scripts/check_perf_claims.py [repo_root] [--trend]
 Exit 0 = every recorded metric with a claim satisfies its primary
 claims.  Ratio-spread drift warns on stdout but does not fail.
+``--trend`` additionally prints the round-over-round trajectory
+warnings (``triton_distributed_tpu.obs.history`` via
+``scripts/bench_history.py``) next to the floor verdicts — monotonic
+declines and below-band draws are visible in the same gate output
+before a floor ever breaks; they never change the exit code.
 """
 
 from __future__ import annotations
@@ -93,9 +98,17 @@ CLAIMS = {
     # the prefill flash kernel is VPU(softmax)-bound at ~95 TF/s in fast
     # states, ~65 in degraded ones (docs/perf.md roofline); the unfused
     # baseline does 2x the counted useful flops, so its useful-work
-    # ceiling is ~half the MXU peak
+    # ceiling is ~half the MXU peak.  Floor ratcheted 42 -> 60 in round 6
+    # with decode's dip-margin methodology (VERDICT r5 weak #3): the
+    # committed-round trajectory (r03 71.5, r04 67.4, r05 88.5 —
+    # `scripts/bench_history.py --metric flash`) bottoms at 67.4, and the
+    # 44-50 TF/s draws in docs/perf.md's observed range were pre-round-4
+    # session sweeps of the NaN-guard-era kernel plus whole-chip throttle
+    # dips the symmetric retry now catches; 60 sits ~11% under the
+    # committed minimum while failing any regression toward the old
+    # 44-50 band (docs/perf.md "Flash floor ratchet")
     "flash_attn_b1_h32_s4096_d128": {
-        "floor": 42.0, "value_ceiling": 115.0, "baseline_ceiling": 110.0,
+        "floor": 60.0, "value_ceiling": 115.0, "baseline_ceiling": 110.0,
         "ratio_spread": (2.5, 13.0), "since": 4,
     },
     # both engines are KV-bandwidth bound: absolutes are GB/s of cache
@@ -463,6 +476,34 @@ def check(root: str) -> int:
     return 0
 
 
+def print_trend(root: str) -> None:
+    """The ``--trend`` hook: round-over-round trajectory warnings from
+    ``obs.history`` printed next to the floor verdicts.  Informational —
+    never changes the gate's exit code (run ``scripts/bench_history.py
+    --check`` for the loud consistency half)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        from triton_distributed_tpu.obs import history
+    except Exception as e:  # the gate must not die on the trend add-on
+        print(f"trend: unavailable ({type(e).__name__}: {e})")
+        return
+    rounds = history.load_rounds(root)
+    warnings = history.all_warnings(history.analyze(rounds))
+    for w in warnings:
+        print(f"trend: WARNING {w}")
+    if not warnings:
+        print(f"trend: {len(rounds)} committed round(s), no trajectory "
+              f"warnings")
+
+
 if __name__ == "__main__":
-    sys.exit(check(sys.argv[1] if len(sys.argv) > 1 else
-                   os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    argv = sys.argv[1:]
+    trend = "--trend" in argv
+    argv = [a for a in argv if a != "--trend"]
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    rc = check(root)
+    if trend:
+        print_trend(root)
+    sys.exit(rc)

@@ -17,6 +17,7 @@ Usage:
     python scripts/tdt_lint.py --faults          # fault-injection matrix
     python scripts/tdt_lint.py --faults --seed 7 # reseed the injection
     python scripts/tdt_lint.py --timeline        # flight-timeline smoke
+    python scripts/tdt_lint.py --history         # bench-record trend gate
     python scripts/tdt_lint.py --json report.json
 
 ``--faults`` runs the ``tdt.resilience`` fault-injection matrix
@@ -33,6 +34,13 @@ under deterministic record mode, reconstruct the cross-rank timeline
 BALANCED attribution — symmetric per-rank exposed-wait totals and every
 recv stall named with its (semaphore, chunk, peer) triple.  Headless
 and CPU-only, like the rest of the lint.
+
+``--history`` runs the bench-record trend sentinel
+(``scripts/bench_history.py --check``): exit 1 when a committed
+``BENCH_rNN`` round is internally inconsistent (local/envelope value
+disagreement, sentinel-listed metric missing from a complete local
+stream, crashed sweep); round-over-round decline / below-band findings
+print as warnings (docs/observability.md "Live telemetry").
 
 Exit status: 0 = every kernel clean (or selftest/fault matrix passed);
 1 = violations (each printed with the violating semaphore/chunk named).
@@ -66,6 +74,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--timeline", action="store_true",
                     help="flight-timeline smoke: record a 2-rank AG, "
                          "reconstruct, assert balanced attribution")
+    ap.add_argument("--history", action="store_true",
+                    help="bench-record trend gate: committed rounds must "
+                         "be internally consistent; trends warn")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection target sampling seed (--faults)")
     ap.add_argument("--json", metavar="PATH",
@@ -76,6 +87,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_faults(args)
     if args.timeline:
         return _run_timeline(args)
+    if args.history:
+        return _run_history(args)
 
     from triton_distributed_tpu import analysis
 
@@ -182,6 +195,22 @@ def _run_timeline(args) -> int:
     print("timeline OK: reconstruction complete, attribution balanced, "
           "every stall named with its (semaphore, chunk, peer)")
     return 0
+
+
+def _run_history(args) -> int:
+    """Delegate to ``scripts/bench_history.py --check`` (one
+    implementation of the sentinel; this is just the lint entry)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_history.py")
+    spec = importlib.util.spec_from_file_location("_bench_history", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    argv = ["--check"]
+    if args.json:
+        argv += ["--json", args.json]
+    return mod.main(argv)
 
 
 if __name__ == "__main__":

@@ -23,14 +23,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.fixture()
 def obs_on():
-    """Enabled obs with a clean registry/trace buffer, restored after."""
+    """Enabled obs with a clean registry/trace buffer/serve-stats
+    collector, restored after."""
     prev = obs.enabled()
     obs.enable(True)
     obs.REGISTRY.reset()
     obs.tracing.clear()
+    obs.serve_stats.STATS.reset()
     yield obs
     obs.REGISTRY.reset()
     obs.tracing.clear()
+    obs.serve_stats.STATS.reset()
     obs.enable(prev)
 
 
@@ -796,3 +799,459 @@ def test_tdt_lint_timeline_smoke():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "timeline OK" in proc.stdout
     assert "allgather/ring_1d" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# live telemetry plane (ISSUE 5): quantile sketches, windowed rates,
+# HTTP endpoints
+
+
+def test_sketch_quantile_error_bound():
+    """DDSketch-style log buckets guarantee RELATIVE quantile error <=
+    alpha — pinned against a heavy-tailed known distribution."""
+    import random
+
+    from triton_distributed_tpu.obs.serve_stats import QuantileSketch
+
+    rng = random.Random(0)
+    values = [rng.lognormvariate(1.0, 1.5) for _ in range(20_000)]
+    sk = QuantileSketch(alpha=0.01)
+    for v in values:
+        sk.observe(v)
+    values.sort()
+    for q in (0.5, 0.9, 0.99):
+        true = values[int(q * (len(values) - 1))]
+        est = sk.quantile(q)
+        assert abs(est - true) / true <= 0.0101, (q, est, true)
+    assert sk.count == 20_000
+    assert sk.quantile(0.0) <= sk.quantile(1.0) == pytest.approx(
+        values[-1])
+
+
+def test_sketch_zero_and_empty_and_merge():
+    from triton_distributed_tpu.obs.serve_stats import QuantileSketch
+
+    sk = QuantileSketch()
+    assert sk.quantile(0.5) == 0.0          # empty
+    sk.observe(0.0)
+    sk.observe(-1.0)
+    assert sk.quantile(0.5) <= 0.0          # zero bucket dominates
+    a, b = QuantileSketch(), QuantileSketch()
+    for v in (1.0, 2.0, 4.0):
+        a.observe(v)
+    for v in (8.0, 16.0):
+        b.observe(v)
+    a.merge(b)
+    assert a.count == 5
+    assert a.quantile(1.0) == pytest.approx(16.0)
+    with pytest.raises(ValueError):
+        a.merge(QuantileSketch(alpha=0.05))
+
+
+def test_windowed_rate_slides():
+    from triton_distributed_tpu.obs.serve_stats import WindowedRate
+
+    r = WindowedRate(window_s=10.0)
+    r.add(5.0, now=100.2)
+    r.add(5.0, now=101.7)
+    assert r.rate(now=102.0) == pytest.approx(1.0)   # 10 units / 10 s
+    assert r.rate(now=120.0) == 0.0                  # burst decayed out
+    assert r.total == 10.0                           # lifetime counter
+
+
+def test_serve_stats_request_flow(obs_on):
+    """The collector's request lifecycle: queue depth, latency sketches,
+    windowed token rate, prometheus rendering."""
+    st = obs.serve_stats.STATS
+    st.request_begin()
+    assert st.queue_depth == 1
+    st.observe_request(prompt_len=8, gen_len=17,
+                       stats={"prefill_ms": 10.0,
+                              "decode_ms_per_token": 2.0})
+    st.request_end()
+    assert st.queue_depth == 0
+    snap = st.snapshot()
+    assert snap["request_ms"]["count"] == 1
+    # request = prefill + per-token * decode_steps = 10 + 2*16 = 42 ms
+    assert snap["request_ms"]["quantiles"]["p50"] == pytest.approx(
+        42.0, rel=0.02)
+    assert snap["tokens_total"] == 17.0
+    assert snap["requests_total"] == 1.0
+    text = st.to_prometheus()
+    assert 'serve_request_ms{quantile="0.5"}' in text
+    assert "serve_queue_depth 0.0" in text
+    assert "serve_request_ms_count 1" in text
+
+
+def test_record_collective_feeds_wire_window(obs_on):
+    obs.record_collective("all_gather", payload_bytes=1 << 20,
+                          wire_bytes=3 << 20, chunks=3, method="ring")
+    snap = obs.serve_stats.STATS.snapshot()
+    assert snap["wire_bytes_per_s_window"]["all_gather"] > 0
+    # suppressed traffic must not land in the live window either
+    obs.serve_stats.STATS.reset()
+    with obs.suppress():
+        obs.record_collective("all_gather", payload_bytes=1, wire_bytes=1,
+                              chunks=1, method="ring")
+    assert obs.serve_stats.STATS.snapshot()["wire_bytes_per_s_window"] \
+        == {}
+
+
+def test_engine_serve_metrics_feed_serve_stats(obs_on):
+    """The engine recorder feeds the live plane alongside the registry
+    (same stub-engine harness as test_engine_serve_metrics_recorded)."""
+    from triton_distributed_tpu.models.engine import Engine
+
+    eng = types.SimpleNamespace(
+        batch=2,
+        model=types.SimpleNamespace(
+            config=types.SimpleNamespace(max_length=64)),
+    )
+    stats = {"prefill_ms": 12.0, "decode_ms_per_token": 3.0,
+             "decode_tokens_per_s": 666.0}
+    Engine._record_serve_metrics(eng, 8, 16, stats)
+    snap = obs.serve_stats.STATS.snapshot()
+    assert snap["prefill_ms"]["count"] == 1
+    assert snap["decode_ms_per_token"]["quantiles"]["p50"] == \
+        pytest.approx(3.0, rel=0.02)
+    # the token window carries the BATCH factor, matching the registry's
+    # engine_tokens_generated accounting (2 sequences x 16 tokens)
+    assert snap["tokens_total"] == 2 * 16
+    assert snap["gauges"]["kv_cache_seq_occupancy"] == \
+        pytest.approx(24 / 64)
+
+
+def _get(url: str):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_telemetry_server_endpoints(obs_on):
+    """Scrape /metrics, /healthz (incl. the 503-on-tripped-breaker
+    contract), /debug/flight, /debug/timeline, and 404 handling against
+    a live server."""
+    from triton_distributed_tpu.obs import server as obs_server
+    from triton_distributed_tpu.resilience import policy
+
+    obs.serve_stats.STATS.observe_request(
+        prompt_len=4, gen_len=8,
+        stats={"prefill_ms": 5.0, "decode_ms_per_token": 1.0})
+    obs.counter("comm_calls", op="ag", method="ring").inc()
+    srv = obs_server.start(port=0)
+    try:
+        code, body = _get(srv.url + "/metrics")
+        assert code == 200
+        assert "comm_calls_total" in body          # registry exposition
+        assert 'serve_request_ms{quantile="0.5"}' in body  # live plane
+        code, body = _get(srv.url + "/healthz")
+        assert code == 200
+        snap = json.loads(body)
+        assert snap["status"] == "ok" and snap["degraded_ops"] == []
+        assert "serve_stats" not in snap           # no engine registered
+        # a tripped breaker flips the load-balancer contract to 503
+        policy.breaker("unit_op", threshold=1).record_failure()
+        code, body = _get(srv.url + "/healthz")
+        assert code == 503
+        snap = json.loads(body)
+        assert snap["status"] == "degraded"
+        assert snap["degraded_ops"] == ["unit_op"]
+        code, body = _get(srv.url + "/debug/flight")
+        assert code == 200
+        assert "events" in json.loads(body)
+        code, body = _get(srv.url + "/debug/timeline")
+        assert code == 200
+        assert "error" not in json.loads(body)
+        code, body = _get(srv.url + "/nope")
+        assert code == 404
+        assert "/metrics" in body                  # endpoint listing
+    finally:
+        obs_server.stop()
+        policy._reset_state_for_tests()
+
+
+def test_telemetry_server_env_gate_and_engine_release(monkeypatch):
+    """TDT_OBS_HTTP unset -> maybe_start is a no-op (the PR-4-identical
+    path); set -> the engine-registered server backs /healthz and
+    Engine-owned release stops it."""
+    from triton_distributed_tpu.obs import server as obs_server
+
+    monkeypatch.delenv("TDT_OBS_HTTP", raising=False)
+    assert obs_server.port_from_env() is None
+    assert obs_server.maybe_start() is None
+    assert obs_server.running() is None
+
+    class _FakeEngine:
+        def health(self):
+            return {"status": "degraded", "engine": {"fake": True}}
+
+    eng = _FakeEngine()
+    monkeypatch.setenv("TDT_OBS_HTTP", "0")   # 0 = ephemeral port
+    srv = obs_server.maybe_start(eng)
+    try:
+        assert srv is not None and obs_server.running() is srv
+        code, body = _get(srv.url + "/healthz")
+        assert code == 503                    # the ENGINE's health payload
+        assert json.loads(body)["engine"]["fake"] is True
+        # another engine's close() must not stop this engine's plane
+        obs_server.release(object())
+        assert obs_server.running() is srv
+        obs_server.release(eng)
+        assert obs_server.running() is None
+    finally:
+        obs_server.stop()
+
+
+class _TinyServeModel:
+    """A model-shaped stub so the REAL ``Engine`` (cache init, jitted
+    prefill/decode, serve loop, telemetry, health) runs on any jax build
+    — the full Qwen layers need Pallas/shard_map APIs this container's
+    jax may lack, and those paths are capability-gated elsewhere."""
+
+    def __init__(self, mesh, config):
+        self.mesh = mesh
+        self.axis = "tp"
+        self.decode_mode = "psum"
+        self.config = config
+
+    def prefill(self, params, cache, ids, true_len=None):
+        logits = jax.nn.one_hot(
+            (ids + 1) % self.config.vocab, self.config.vocab, dtype=jnp.float32
+        ) + params["w"]
+        return logits, jax.tree.map(lambda x: x + 0, cache)
+
+    def decode(self, params, cache, tok):
+        logits = jax.nn.one_hot(
+            (tok + 1) % self.config.vocab, self.config.vocab,
+            dtype=jnp.float32) + params["w"]
+        return logits, jax.tree.map(lambda x: x + 0, cache)
+
+
+def test_telemetry_endpoints_during_live_decode(obs_on):
+    """The acceptance shape: with the plane up, a SERVING engine answers
+    /metrics, /healthz, and /debug/flight while a request is mid-decode
+    — verified deterministically by scraping from inside the decode
+    step (the serve loop is blocked in engine code at that instant; the
+    daemon-threaded server answers concurrently)."""
+    from triton_distributed_tpu.core import mesh as mesh_lib
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.obs import server as obs_server
+
+    cfg = ModelConfig(
+        num_layers=1, hidden=8, intermediate=16, num_heads=1,
+        num_kv_heads=1, head_dim=8, vocab=32, max_length=32,
+        dtype=jnp.float32,
+    )
+    model = _TinyServeModel(mesh_lib.tp_mesh(1), cfg)
+    eng = Engine(model, {"w": jnp.zeros((), jnp.float32)}, batch=1)
+    srv = obs_server.start(port=0, engine=eng)
+    seen: dict = {}
+    orig = eng.decode_step
+
+    def hooked(tok):
+        # obs.enabled() is False during the suppressed warmup: the scrape
+        # below therefore happens inside the TIMED decode loop
+        if obs.enabled() and not seen:
+            seen["metrics"] = _get(srv.url + "/metrics")
+            seen["healthz"] = _get(srv.url + "/healthz")
+            seen["flight"] = _get(srv.url + "/debug/flight")
+        return orig(tok)
+
+    eng.decode_step = hooked
+    try:
+        ids = jnp.zeros((1, 4), jnp.int32)
+        _, stats = eng.serve(ids, gen_len=6)
+        assert seen, "decode loop never ran with telemetry enabled"
+        code, body = seen["metrics"]
+        assert code == 200 and "serve_queue_depth 1.0" in body
+        code, body = seen["healthz"]
+        assert code == 200
+        snap = json.loads(body)
+        assert snap["status"] == "ok"
+        assert snap["serve_stats"]["queue_depth"] == 1
+        assert seen["flight"][0] == 200
+        # after the request: the latency sketches hold it
+        code, body = _get(srv.url + "/metrics")
+        assert code == 200 and "serve_request_ms_count 1" in body
+        assert eng.health()["serve_stats"]["request_ms"]["count"] == 1
+    finally:
+        eng.close()                            # engine-owned stop
+        assert obs_server.running() is None
+
+
+# ---------------------------------------------------------------------------
+# perf-trajectory regression sentinel (obs.history / bench_history CLI)
+
+
+def _hist_round(tmp_path, rnd: int, lines: list[dict], *, local=False,
+                envelope_tail=None):
+    recs = "\n".join(json.dumps(r) for r in lines) + "\n"
+    if local:
+        (tmp_path / f"BENCH_LOCAL_r{rnd:02d}.jsonl").write_text(recs)
+        if envelope_tail is not None:
+            (tmp_path / f"BENCH_r{rnd:02d}.json").write_text(
+                json.dumps({"n": rnd, "rc": 0, "tail": envelope_tail}))
+    else:
+        (tmp_path / f"BENCH_r{rnd:02d}.json").write_text(recs)
+
+
+def _toy(value, **kw):
+    return {"metric": "toy_tflops", "value": value, "unit": "TFLOP/s",
+            **kw}
+
+
+def test_history_flags_three_round_monotonic_decline(tmp_path):
+    from triton_distributed_tpu.obs import history
+
+    for rnd, v in enumerate((100.0, 97.0, 90.0, 80.0), start=1):
+        _hist_round(tmp_path, rnd, [_toy(v)])
+    trs = history.analyze(history.load_rounds(str(tmp_path)))
+    warns = history.all_warnings(trs)
+    assert any("3-round monotonic decline" in w for w in warns), warns
+    assert any("below" in w or "outside" in w for w in warns)
+    # the same magnitudes RISING never warn (direction-aware)
+    for p in tmp_path.glob("BENCH_r*.json"):
+        p.unlink()
+    for rnd, v in enumerate((80.0, 90.0, 97.0, 100.0), start=1):
+        _hist_round(tmp_path, rnd, [_toy(v)])
+    trs = history.analyze(history.load_rounds(str(tmp_path)))
+    assert history.all_warnings(trs) == []
+
+
+def test_history_lower_is_better_direction(tmp_path):
+    """ms-unit metrics decline UPWARD: a rising latency trajectory warns,
+    a falling one does not."""
+    from triton_distributed_tpu.obs import history
+
+    for rnd, v in enumerate((5.0, 5.5, 6.2, 7.0), start=1):
+        _hist_round(tmp_path, rnd, [{
+            "metric": "toy_step", "value": v, "unit": "ms/step (ar mode)",
+        }])
+    trs = history.analyze(history.load_rounds(str(tmp_path)))
+    assert any("monotonic decline" in w
+               for w in history.all_warnings(trs))
+
+
+def test_history_below_band_retry_reports_transient(tmp_path):
+    from triton_distributed_tpu.obs import history
+
+    values = (100.0, 102.0, 101.0)
+    for rnd, v in enumerate(values, start=1):
+        _hist_round(tmp_path, rnd, [_toy(v)])
+    _hist_round(tmp_path, 4, [_toy(85.0, retry_value=101.0)])
+    trs = history.analyze(history.load_rounds(str(tmp_path)))
+    warns = history.all_warnings(trs)
+    assert any("transient throttle" in w for w in warns), warns
+    # without the passing retry the same draw is a regression finding
+    _hist_round(tmp_path, 4, [_toy(85.0)])
+    trs = history.analyze(history.load_rounds(str(tmp_path)))
+    warns = history.all_warnings(trs)
+    assert any("healthy band" in w for w in warns), warns
+    # interpret-mode captures never enter the trajectory
+    _hist_round(tmp_path, 4, [_toy(1.0, interpret=True)])
+    trs = history.analyze(history.load_rounds(str(tmp_path)))
+    assert [d.round for d in trs["toy_tflops"].draws] == [1, 2, 3]
+
+
+def test_history_consistency_problems(tmp_path):
+    from triton_distributed_tpu.obs import history
+
+    # (a) local stream disagreeing with its same-round envelope
+    _hist_round(tmp_path, 1, [_toy(100.0)], local=True,
+                envelope_tail=json.dumps(_toy(150.0)) + "\n")
+    problems = history.consistency_problems(
+        history.load_rounds(str(tmp_path)))
+    assert any("disagrees" in p for p in problems), problems
+    # (b) local sentinel lists an emitted metric whose line is missing
+    (tmp_path / "BENCH_r01.json").unlink()
+    _hist_round(tmp_path, 1, [
+        _toy(100.0),
+        {"metric": "bench_sweep_complete", "value": 1, "unit": "bool",
+         "emitted": ["toy_tflops", "ghost_metric"]},
+    ], local=True)
+    problems = history.consistency_problems(
+        history.load_rounds(str(tmp_path)))
+    assert any("ghost_metric" in p for p in problems), problems
+    # (c) a round-id stamp contradicting the committed filename
+    (tmp_path / "BENCH_LOCAL_r01.jsonl").unlink()
+    _hist_round(tmp_path, 2, [_toy(100.0, round=7)])
+    problems = history.consistency_problems(
+        history.load_rounds(str(tmp_path)))
+    assert any("renamed or mixed" in p for p in problems), problems
+    # (d) a crashed sweep sentinel
+    _hist_round(tmp_path, 3, [
+        _toy(90.0),
+        {"metric": "bench_sweep_complete", "value": 0, "unit": "bool"},
+    ])
+    problems = history.consistency_problems(
+        history.load_rounds(str(tmp_path)))
+    assert any("crashed mid-sweep" in p for p in problems)
+
+
+def test_bench_history_check_repo_green():
+    """Tier-1 smoke (the CI satellite): the committed r01-r05 records
+    are internally consistent and the sentinel exits green."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_history.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "bench history check OK" in proc.stdout
+    assert "PROBLEM" not in proc.stdout
+
+
+def test_bench_history_cli_flags_synthetic_decline(tmp_path):
+    """The acceptance fixture: a synthetic 3-round decline is flagged
+    (WARN, exit 0) and --strict turns it into a failure."""
+    for rnd, v in enumerate((100.0, 97.0, 90.0, 80.0), start=1):
+        _hist_round(tmp_path, rnd, [_toy(v)])
+    cmd = [sys.executable, os.path.join(REPO, "scripts",
+                                        "bench_history.py"),
+           str(tmp_path), "--check"]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "monotonic decline" in proc.stdout
+    proc = subprocess.run(cmd + ["--strict"], capture_output=True,
+                          text=True, timeout=120,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1
+    # an internally inconsistent round fails --check without --strict
+    _hist_round(tmp_path, 5, [_toy(100.0)], local=True,
+                envelope_tail=json.dumps(_toy(50.0)) + "\n")
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1
+    assert "PROBLEM" in proc.stdout
+
+
+def test_tdt_lint_history_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tdt_lint.py"),
+         "--history"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "bench history check OK" in proc.stdout
+
+
+def test_check_perf_claims_trend_hook():
+    """--trend rides along the claims gate: trajectory output appears
+    next to the floor verdicts without changing the verdict."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_perf_claims.py"), "--trend"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "trend:" in proc.stdout
+    assert "satisfy their primary claims" in proc.stdout

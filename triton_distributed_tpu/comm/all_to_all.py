@@ -309,6 +309,23 @@ def _ep_combine_bwd(mesh, axis, cfg, token_dim, res, dback):
 _ep_combine_diff.defvjp(_ep_combine_fwd, _ep_combine_bwd)
 
 
+def _resolve_a2a_config(name: str, t: int, h: int, dtype, n: int,
+                        tracing: bool, make_thunk) -> AllToAllConfig:
+    """``config=None`` hook of the EP all-to-all entries: the chunk
+    sweep (``tune.autotuner.a2a_chunk_candidates``) resolved through the
+    shared machinery — cached winner if one exists (jit'd layer calls
+    included), measured when transparent tuning may run, the
+    interpret-pinned 128-row default otherwise."""
+    from ..core import platform
+    from ..tune.autotuner import a2a_chunk_candidates, resolve_config
+
+    cands = a2a_chunk_candidates(AllToAllConfig, t)
+    return resolve_config(
+        name, (t, h, str(dtype), n, platform.device_kind()),
+        cands, cands[0], make_thunk, tracing=tracing,
+    )
+
+
 def ep_dispatch(
     x: jax.Array,
     splits: jax.Array,
@@ -332,15 +349,23 @@ def ep_dispatch(
     rank, the counts for each of r's own experts.  Differentiable in
     ``x`` (the adjoint is :func:`ep_combine`).
     """
-    cfg = config or AllToAllConfig()
     from .. import obs, resilience
     from ..tune.autotuner import is_tracer
 
     n = mesh.shape[axis]
     t = x.shape[0] // max(n, 1)
+    eager = not (is_tracer(x) or is_tracer(splits))
+    if config is None and n > 1:
+        # chunk size through the contextual tuner (VERDICT r5 next #5):
+        # cached winner / measured / interpret-pinned default — the
+        # config=None path consults the same winner cache the GEMM ops do
+        config = _resolve_a2a_config("ep_dispatch_cfg", t, x.shape[1],
+                                     x.dtype, n, not eager,
+                                     lambda c: (lambda: ep_dispatch(
+                                         x, splits, mesh, axis, config=c)))
+    cfg = config or AllToAllConfig()
     payload = t * x.shape[1] * jnp.dtype(x.dtype).itemsize
     core = lambda: _ep_dispatch_diff(mesh, axis, cfg, x, splits)  # noqa: E731
-    eager = not (is_tracer(x) or is_tracer(splits))
     if eager and resilience.enabled():
         # watchdog-only: the ragged zone layout has no one-line jax.lax
         # equivalent, so a stall is DETECTED (named) rather than degraded
@@ -408,15 +433,22 @@ def ep_combine(
     global (n*T, H) over ``axis``.  Differentiable in ``y`` (the adjoint
     is :func:`ep_dispatch`).
     """
-    cfg = config or AllToAllConfig()
     from .. import obs, resilience
     from ..tune.autotuner import is_tracer
 
     n = mesh.shape[axis]
+    eager = not (is_tracer(y) or is_tracer(splits))
+    if config is None and n > 1:
+        # see ep_dispatch: the chunk sweep shares the tuner machinery
+        config = _resolve_a2a_config("ep_combine_cfg", token_dim,
+                                     y.shape[-1], y.dtype, n, not eager,
+                                     lambda c: (lambda: ep_combine(
+                                         y, splits, mesh, axis,
+                                         token_dim=token_dim, config=c)))
+    cfg = config or AllToAllConfig()
     payload = token_dim * y.shape[-1] * jnp.dtype(y.dtype).itemsize
     core = lambda: _ep_combine_diff(mesh, axis, cfg, token_dim, y,  # noqa: E731
                                     splits)
-    eager = not (is_tracer(y) or is_tracer(splits))
     if eager and resilience.enabled():
         # watchdog-only, like ep_dispatch
         core = resilience.guarded(

@@ -396,12 +396,17 @@ def all_reduce(
             from ..tune.autotuner import is_tracer, resolve_config
 
             cands = [AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT]
+            # the A/B thunks PIN the default tiles: with config=None
+            # each method candidate would recursively trigger its own
+            # ar_cfg tile sweep below — tiles are tuned only for the
+            # method that wins
+            probe_cfg = config if config is not None else AllReduceConfig()
             method = resolve_config(
                 "ar_method",
                 (m, x.shape[1], str(x.dtype), n, platform.device_kind()),
                 cands, default,
                 lambda mth: (lambda: all_reduce(x, mesh, axis, method=mth,
-                                                config=config,
+                                                config=probe_cfg,
                                                 out_dtype=out_dtype)),
                 tracing=is_tracer(x),
             )
@@ -409,12 +414,33 @@ def all_reduce(
         # two-shot chunks rows n ways; fall back rather than pad
         method = AllReduceMethod.ONE_SHOT
 
-    cfg = (config or AllReduceConfig()).clip(
-        m // n if method == AllReduceMethod.TWO_SHOT else m, x.shape[1]
-    )
     from .. import obs, resilience
     from ..tune.autotuner import is_tracer
 
+    rows = m // n if method == AllReduceMethod.TWO_SHOT else m
+    if config is None:
+        # the reduction-pipeline tiles ride the same contextual tuner as
+        # the GEMM ops (VERDICT r5 next #5): a cached winner when one
+        # exists (jit'd layers pick up what an eager/tuned run learned),
+        # measured when transparent tuning may run, and the
+        # interpret-pinned default otherwise (interpret-mode timings are
+        # simulation artifacts — resolve_config already refuses them)
+        from ..core import platform
+        from ..tune.autotuner import (
+            collective_tile_candidates, resolve_config,
+        )
+
+        config = resolve_config(
+            "ar_cfg",
+            (m, x.shape[1], str(x.dtype), n, method.value,
+             platform.device_kind()),
+            collective_tile_candidates(AllReduceConfig, rows, x.shape[1]),
+            AllReduceConfig().clip(rows, x.shape[1]),
+            lambda c: (lambda: all_reduce(x, mesh, axis, method=method,
+                                          config=c, out_dtype=out_dtype)),
+            tracing=is_tracer(x),
+        )
+    cfg = config.clip(rows, x.shape[1])
     partial = m * x.shape[1] * jnp.dtype(x.dtype).itemsize
     core = lambda: _all_reduce_core(mesh, axis, method, out_dtype,  # noqa: E731
                                     cfg, x)

@@ -284,10 +284,29 @@ def reduce_scatter(
             f"partial rows {m_partial} not divisible by {axis}={n}"
         )
     m_loc = m_partial // n            # output rows per device
-    cfg = (config or ReduceScatterConfig()).clip(m_loc, x.shape[1])
     from .. import obs, resilience
     from ..tune.autotuner import is_tracer
 
+    if config is None:
+        # add-pipeline tiles through the contextual tuner (VERDICT r5
+        # next #5) — cached winner / measured / interpret-pinned
+        # default, exactly like the GEMM ops' config=None path
+        from ..core import platform
+        from ..tune.autotuner import (
+            collective_tile_candidates, resolve_config,
+        )
+
+        config = resolve_config(
+            "rs_cfg",
+            (m_partial, x.shape[1], str(x.dtype), n,
+             platform.device_kind()),
+            collective_tile_candidates(ReduceScatterConfig, m_loc,
+                                       x.shape[1]),
+            ReduceScatterConfig().clip(m_loc, x.shape[1]),
+            lambda c: (lambda: reduce_scatter(x, mesh, axis, config=c)),
+            tracing=is_tracer(x),
+        )
+    cfg = config.clip(m_loc, x.shape[1])
     chunk_bytes = m_loc * x.shape[1] * jnp.dtype(x.dtype).itemsize
     core = lambda: _reduce_scatter_core(mesh, axis, cfg, x)  # noqa: E731
     eager = not is_tracer(x)  # eager calls only (see all_gather)

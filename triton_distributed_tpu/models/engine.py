@@ -160,6 +160,15 @@ class Engine:
         self._prefill_exec: dict[int, Any] = {}
         self._decode_exec = None
         self._exec_params_put: dict = {}
+        # live telemetry plane (TDT_OBS_HTTP=<port>): /metrics, /healthz
+        # (this engine's health()), /debug/flight|timeline.  The env
+        # check here keeps the unset path to ONE dict lookup — touching
+        # obs.server would pay its lazy http.server import chain
+        # (docs/observability.md "Live telemetry")
+        import os
+
+        if os.environ.get("TDT_OBS_HTTP", "").strip():
+            obs.server.maybe_start(self)
 
     @classmethod
     def build(cls, config: ModelConfig, mesh: Mesh, *, key=None,
@@ -456,10 +465,31 @@ class Engine:
         unknown state after an abandoned dispatch) and lands in
         :meth:`health` before re-raising — the engine object stays
         serviceable for the next request."""
-        import time
-
         b, prompt_len = input_ids.shape
         self._check_length(prompt_len, gen_len)
+        # live telemetry: the queue-depth gauge spans the whole request
+        # (warmup included — the operator sees compile stalls as queued
+        # requests); the latency sketches get only the timed stats below.
+        # Balanced by the request_end in the finally below — ANY exit,
+        # including a failure in the metrics recording itself, must not
+        # leak the depth gauge.
+        live = obs.enabled()
+        if live:
+            obs.serve_stats.STATS.request_begin()
+        ok = False
+        try:
+            tokens, stats = self._serve_inner(input_ids, gen_len, key,
+                                              deadline_ms, b, prompt_len)
+            ok = True
+            return tokens, stats
+        finally:
+            if live:
+                obs.serve_stats.STATS.request_end(failed=not ok)
+
+    def _serve_inner(self, input_ids, gen_len, key, deadline_ms,
+                     b: int, prompt_len: int):
+        import time
+
         if deadline_ms is None:
             from .. import resilience
 
@@ -563,6 +593,9 @@ class Engine:
         from .. import resilience
 
         snap = resilience.health_snapshot()
+        # live-serving percentiles and windowed rates (obs.serve_stats):
+        # populated when TDT_OBS=1, zeroed sketches otherwise
+        snap["serve_stats"] = obs.serve_stats.STATS.snapshot()
         snap["engine"] = {
             "failed_requests": self._failed_requests,
             "last_failure": self._last_failure,
@@ -575,6 +608,12 @@ class Engine:
         }
         return snap
 
+    def close(self) -> None:
+        """Release engine-owned telemetry: stop the ``TDT_OBS_HTTP``
+        endpoint iff this engine is its registered health source
+        (another engine's plane is left running)."""
+        obs.server.release(self)
+
     def _record_serve_metrics(self, prompt_len: int, gen_len: int,
                               stats: dict) -> None:
         """Serve-loop telemetry (``TDT_OBS=1``): latency histograms,
@@ -586,11 +625,17 @@ class Engine:
         obs.gauge("engine_decode_tokens_per_s").set(
             stats["decode_tokens_per_s"])
         obs.counter("engine_tokens_generated").inc(self.batch * gen_len)
+        # live telemetry plane: latency sketches + windowed tokens/s
+        # (obs.serve_stats, scraped via /metrics and Engine.health())
+        obs.serve_stats.STATS.observe_request(
+            prompt_len=prompt_len, gen_len=gen_len, stats=stats,
+            batch=self.batch)
         c = self.model.config
         # sequence occupancy: how full the (contiguous or paged) cache's
         # length budget is after this request
-        obs.gauge("kv_cache_seq_occupancy").set(
-            (prompt_len + gen_len) / c.max_length)
+        occupancy = (prompt_len + gen_len) / c.max_length
+        obs.gauge("kv_cache_seq_occupancy").set(occupancy)
+        obs.serve_stats.STATS.set_gauge("kv_cache_seq_occupancy", occupancy)
         from ..tools.profile import memory_stats
 
         for dev, st in memory_stats().items():
@@ -601,6 +646,8 @@ class Engine:
             if in_use and limit:
                 obs.gauge("device_memory_occupancy", device=dev).set(
                     in_use / limit)
+                obs.serve_stats.STATS.set_gauge(
+                    f"device_memory_occupancy_{dev}", in_use / limit)
 
     def generate_from_logits(self, logits: jax.Array, gen_len: int,
                              key: jax.Array | None = None) -> jax.Array:

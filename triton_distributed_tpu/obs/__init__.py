@@ -14,6 +14,13 @@ This package is that layer:
 - ``obs.export``    JSONL append, Prometheus text format, summary table.
 - ``obs.report``    the derived overlap-efficiency report
   (``scripts/obs_report.py``): per-step comm-exposed vs compute time.
+- ``obs.serve_stats``  live serving telemetry: streaming quantile
+  sketches (1% relative error) + windowed rates, fed by the engine and
+  the comm entry points.
+- ``obs.server``    the ``TDT_OBS_HTTP`` endpoint: ``/metrics``,
+  ``/healthz``, ``/debug/flight``, ``/debug/timeline``.
+- ``obs.history``   the perf-trajectory sentinel over the committed
+  ``BENCH_r*`` rounds (``scripts/bench_history.py``).
 
 Everything is OFF by default and gated by ``TDT_OBS=1`` (or
 :func:`enable`); a disabled call site costs one cached-bool check, so the
@@ -27,7 +34,24 @@ from __future__ import annotations
 import contextlib
 import threading
 
-from . import costs, export, flight, registry, report, timeline, tracing
+from . import (
+    costs, export, flight, history, registry, report, serve_stats,
+    timeline, tracing,
+)
+
+
+def __getattr__(name: str):
+    # obs.server pulls the http.server/socketserver import chain —
+    # loaded lazily so every `from .. import obs` in the comm hot paths
+    # keeps the advertised near-zero cost-when-off.  importlib (NOT
+    # `from . import server`, whose fromlist handling getattrs the
+    # package first and would recurse here) imports the submodule and
+    # binds the package attribute, so __getattr__ runs at most once.
+    if name == "server":
+        import importlib
+
+        return importlib.import_module(".server", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 from .export import (
     parse_prometheus,
     read_jsonl,
@@ -47,9 +71,10 @@ __all__ = [
     "DEFAULT_BYTES_BUCKETS", "DEFAULT_LATENCY_BUCKETS_MS", "REGISTRY",
     "Registry", "comm_call", "costs", "counter", "dump_jsonl",
     "dump_prometheus", "enable", "enabled", "flight", "gauge", "histogram",
-    "instant", "observe_timer", "parse_prometheus", "read_jsonl",
-    "record_collective", "span", "summary", "summary_table", "suppress",
-    "suppressed_thunk", "timeline", "to_prometheus", "write_jsonl",
+    "history", "instant", "observe_timer", "parse_prometheus", "read_jsonl",
+    "record_collective", "serve_stats", "server", "span", "summary",
+    "summary_table", "suppress", "suppressed_thunk", "timeline",
+    "to_prometheus", "write_jsonl",
 ]
 
 
@@ -170,6 +195,10 @@ def record_collective(op: str, *, payload_bytes: int, wire_bytes: int,
     REGISTRY.counter("comm_chunks", op=op, method=method).inc(chunks)
     REGISTRY.histogram("comm_payload_bytes_hist", DEFAULT_BYTES_BUCKETS,
                        op=op).observe(payload_bytes)
+    # live telemetry plane: per-collective windowed wire-byte rate
+    # (obs.serve_stats, scraped via /metrics — docs/observability.md
+    # "Live telemetry")
+    serve_stats.STATS.observe_collective(op, wire_bytes=wire_bytes)
 
 
 def comm_call(op: str, thunk, *, payload_bytes: int, wire_bytes: int,

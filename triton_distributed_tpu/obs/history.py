@@ -1,0 +1,412 @@
+"""Perf-trajectory analysis over the committed bench rounds.
+
+``scripts/check_perf_claims.py`` gates the NEWEST record against
+absolute floors; this module reads **all** committed rounds
+(``BENCH_rNN.json`` driver envelopes and ``BENCH_LOCAL_rNN.jsonl``
+complete streams) as a time series and surfaces what a single-round
+floor cannot: a metric sliding toward its floor across rounds, or a
+draw that cleared its floor but fell out of the healthy band the prior
+rounds established.  T3's argument for continuous fine-grained overlap
+tracking (arXiv 2401.16677) applied to the bench loop — drift should be
+flagged *before* a floor breaks.
+
+Per metric the trajectory sentinel reports:
+
+- **decline** — ``decline_rounds`` (default 3) consecutive round-over-
+  round moves in the worse direction whose total drift exceeds
+  ``decline_pct`` (default 5% — below the chip's documented round noise
+  nothing is signal).
+- **below band** — the newest draw worse than every prior passing draw
+  by more than ``band_slack`` (5%), where the band is the prior rounds'
+  [min, max] around their median.  A draw whose symmetric retry
+  (``retry_value``) is back inside the band is reported as transient,
+  matching the claims gate's dip semantics.
+
+Interpret-mode captures (functional smoke) and the sweep sentinel are
+excluded from trajectories.  Direction (higher- vs lower-is-better) is
+derived from the record's unit: latency-class units (``ms``/``us``)
+are lower-better, throughput units higher-better, byte-accounting
+units exact (no band).
+
+Consumers: ``scripts/bench_history.py`` (the CLI, ``--json`` /
+``--markdown`` / ``--check``), ``scripts/check_perf_claims.py --trend``
+(trend warnings next to floor verdicts), ``scripts/tdt_lint.py
+--history`` (the CI hook), and ``tests/test_obs.py`` fixtures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import math
+import os
+import re
+
+SENTINEL = "bench_sweep_complete"
+DECLINE_ROUNDS = 3
+DECLINE_PCT = 0.05
+BAND_SLACK = 0.05
+# bench.py persists the complete local stream from round 6 on (same
+# constant as scripts/check_perf_claims.py): a detectably truncated
+# envelope WITHOUT a local record is an inconsistent commit from there
+LOCAL_RECORD_SINCE = 6
+
+_ENVELOPE_RE = re.compile(r"BENCH_r(\d+)\.json$")
+_LOCAL_RE = re.compile(r"BENCH_LOCAL_r(\d+)\.jsonl$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Draw:
+    """One metric capture in one round (interpret-mode captures are
+    filtered out before Draw construction)."""
+
+    round: int
+    value: float
+    unit: str
+    retry_value: float | None
+    source: str                # "local" | "envelope"
+
+
+@dataclasses.dataclass
+class Trajectory:
+    metric: str
+    unit: str
+    direction: str             # "higher" | "lower" | "exact"
+    draws: list[Draw]
+    band: tuple[float, float] | None = None   # prior-round [lo, hi]
+    warnings: list[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def values(self) -> list[float]:
+        return [d.value for d in self.draws]
+
+
+def parse_record_text(text: str) -> tuple[list[dict], int | None, bool]:
+    """(metric lines, envelope rc, truncation detected) — the same
+    envelope-or-raw-JSONL shape ``scripts/check_perf_claims.py`` parses
+    (reimplemented here because the package must not import scripts)."""
+    metrics: list[dict] = []
+    rc = None
+    truncated = False
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, dict) and "tail" in obj:
+            rc = obj.get("rc")
+            text = obj["tail"]
+            nonempty = [ln for ln in text.splitlines() if ln.strip()]
+            truncated = bool(nonempty) and \
+                not nonempty[0].lstrip().startswith("{")
+    except ValueError:
+        pass
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            metrics.append(rec)
+    return metrics, rc, truncated
+
+
+@dataclasses.dataclass
+class Round:
+    """One committed round's parsed record(s)."""
+
+    round: int
+    metrics: list[dict]
+    source: str                # "local" | "envelope"
+    rc: int | None
+    truncated: bool
+    envelope_metrics: list[dict] | None = None  # when both exist
+
+
+def load_rounds(root: str) -> list[Round]:
+    """All committed rounds, ascending; a round with BOTH a local stream
+    and an envelope prefers the local record (complete by construction)
+    and keeps the envelope lines for the consistency check."""
+    env: dict[int, str] = {}
+    loc: dict[int, str] = {}
+    for pat, rx, sink in ((os.path.join(root, "BENCH_r*.json"),
+                           _ENVELOPE_RE, env),
+                          (os.path.join(root, "BENCH_LOCAL_r*.jsonl"),
+                           _LOCAL_RE, loc)):
+        for p in glob.glob(pat):
+            m = rx.search(p)
+            if m:
+                sink[int(m.group(1))] = p
+    rounds: list[Round] = []
+    for rnd in sorted(set(env) | set(loc)):
+        env_metrics = rc = None
+        truncated = False
+        if rnd in env:
+            with open(env[rnd]) as f:
+                env_metrics, rc, truncated = parse_record_text(f.read())
+        if rnd in loc:
+            with open(loc[rnd]) as f:
+                metrics, _, _ = parse_record_text(f.read())
+            rounds.append(Round(rnd, metrics, "local", rc, truncated,
+                                envelope_metrics=env_metrics))
+        else:
+            rounds.append(Round(rnd, env_metrics or [], "envelope", rc,
+                                truncated))
+    return rounds
+
+
+def direction_for(metric: str, unit: str) -> str:
+    u = (unit or "").lower()
+    if "bytes/token" in u or u == "bool":
+        return "exact"
+    if u.startswith("ms") or u.startswith("us") or "ms/" in u \
+            or metric.startswith("latency"):
+        return "lower"
+    return "higher"
+
+
+def trajectories(rounds: list[Round]) -> dict[str, Trajectory]:
+    """Per-metric draws across rounds, oldest first.  Sentinel lines,
+    interpret captures, and non-numeric values are excluded."""
+    out: dict[str, Trajectory] = {}
+    for rnd in rounds:
+        for rec in rnd.metrics:
+            name = rec.get("metric")
+            value = rec.get("value")
+            if (not name or name == SENTINEL
+                    or not isinstance(value, (int, float))
+                    or isinstance(value, bool)
+                    or not math.isfinite(float(value))
+                    or rec.get("interpret")):
+                continue
+            unit = str(rec.get("unit", ""))
+            tr = out.get(name)
+            if tr is None:
+                tr = out[name] = Trajectory(
+                    name, unit, direction_for(name, unit), [])
+            retry = rec.get("retry_value")
+            tr.draws.append(Draw(
+                rnd.round, float(value), unit,
+                float(retry) if isinstance(retry, (int, float)) else None,
+                rnd.source,
+            ))
+    return out
+
+
+def _worse(direction: str, a: float, b: float) -> bool:
+    """Whether ``a`` is worse than ``b``."""
+    return a < b if direction == "higher" else a > b
+
+
+def _drift_pct(direction: str, newest: float, ref: float) -> float:
+    if ref == 0:
+        return 0.0
+    d = (ref - newest) / abs(ref) if direction == "higher" \
+        else (newest - ref) / abs(ref)
+    return d
+
+
+def analyze(rounds: list[Round], *, decline_rounds: int = DECLINE_ROUNDS,
+            decline_pct: float = DECLINE_PCT,
+            band_slack: float = BAND_SLACK) -> dict[str, Trajectory]:
+    """Trajectories with healthy bands and WARN annotations attached."""
+    trs = trajectories(rounds)
+    for tr in trs.values():
+        if tr.direction == "exact" or len(tr.draws) < 2:
+            continue
+        vals = tr.values
+        newest = tr.draws[-1]
+        prior = vals[:-1]
+        med = sorted(prior)[len(prior) // 2]
+        tr.band = (min(prior), max(prior))
+        # -- N-round monotonic decline ---------------------------------
+        if len(vals) >= decline_rounds + 1:
+            tail = vals[-(decline_rounds + 1):]
+            monotone = all(_worse(tr.direction, tail[i + 1], tail[i])
+                           for i in range(len(tail) - 1))
+            drift = _drift_pct(tr.direction, tail[-1], tail[0])
+            if monotone and drift > decline_pct:
+                tr.warnings.append(
+                    f"{tr.metric}: {decline_rounds}-round monotonic "
+                    f"decline — {tail[0]:g} -> {tail[-1]:g} {tr.unit} "
+                    f"({100 * drift:.1f}% worse over rounds "
+                    f"r{tr.draws[-decline_rounds - 1].round:02d}.."
+                    f"r{newest.round:02d})")
+        # -- newest draw below the prior healthy band ------------------
+        # (two prior rounds minimum: one draw has no spread, and a
+        # "band" of one point would flag ordinary round noise)
+        if len(prior) < 2:
+            continue
+        lo, hi = tr.band
+        edge = lo if tr.direction == "higher" else hi
+        if _worse(tr.direction, newest.value, edge) and \
+                _drift_pct(tr.direction, newest.value, edge) > band_slack:
+            retry_ok = newest.retry_value is not None and not _worse(
+                tr.direction, newest.retry_value, edge)
+            if retry_ok:
+                tr.warnings.append(
+                    f"{tr.metric}: r{newest.round:02d} draw "
+                    f"{newest.value:g} {tr.unit} fell below the prior "
+                    f"band [{lo:g}, {hi:g}] but its retry "
+                    f"({newest.retry_value:g}) is back inside — "
+                    f"transient throttle, watch the next round")
+            else:
+                tr.warnings.append(
+                    f"{tr.metric}: r{newest.round:02d} draw "
+                    f"{newest.value:g} {tr.unit} is outside the prior "
+                    f"rounds' healthy band [{lo:g}, {hi:g}] (median "
+                    f"{med:g}) — above any floor, but the trajectory "
+                    f"regressed")
+    return trs
+
+
+def consistency_problems(rounds: list[Round]) -> list[str]:
+    """Hard internal-consistency failures of the committed records (the
+    ``--check`` teeth): a locally-teed round disagreeing with its
+    same-round envelope on a shared metric value, a local (complete by
+    construction) record missing a metric its own sentinel lists as
+    emitted, a crashed sweep (rc != 0 / sentinel value 0), or a record
+    with no parseable metric lines at all."""
+    problems: list[str] = []
+    for rnd in rounds:
+        tag = f"r{rnd.round:02d}"
+        if not rnd.metrics:
+            problems.append(f"{tag}: no metric lines parsed from the "
+                            f"committed record")
+            continue
+        if rnd.rc not in (None, 0):
+            problems.append(f"{tag}: driver envelope records bench exit "
+                            f"code {rnd.rc} — the sweep crashed")
+        if (rnd.truncated and rnd.source == "envelope"
+                and rnd.round >= LOCAL_RECORD_SINCE):
+            # pre-round-6 envelopes never had a local record to fall
+            # back on (the claims gate's legacy-warning class); from
+            # round 6 the complete stream provably existed on disk
+            problems.append(
+                f"{tag}: envelope tail is detectably truncated and no "
+                f"BENCH_LOCAL_r{rnd.round:02d}.jsonl is committed — "
+                f"trajectory draws for this round are incomplete")
+        sentinel = next((r for r in rnd.metrics
+                         if r.get("metric") == SENTINEL), None)
+        if sentinel is not None and not sentinel.get("value"):
+            problems.append(f"{tag}: {SENTINEL}=0 — a bench mode crashed "
+                            f"mid-sweep")
+        # round-id stamp (bench.py stamps every line since round 6): a
+        # record whose lines claim another round was renamed or mixed
+        # from a different capture
+        for rec in rnd.metrics:
+            stamp = rec.get("round")
+            if isinstance(stamp, int) and stamp != rnd.round:
+                problems.append(
+                    f"{tag}: metric {rec.get('metric')!r} is stamped "
+                    f"round={stamp} but committed as round {rnd.round} — "
+                    f"the record file was renamed or mixed from another "
+                    f"capture")
+                break
+        # a local stream is complete by construction: every name its own
+        # sentinel lists must be present as a line
+        if rnd.source == "local" and sentinel is not None:
+            have = {r.get("metric") for r in rnd.metrics}
+            for name in sentinel.get("emitted") or []:
+                if name not in have:
+                    problems.append(
+                        f"{tag}: local record's sentinel lists "
+                        f"{name!r} as emitted but the line is missing — "
+                        f"the stream is internally inconsistent")
+        # local vs same-round envelope: the tee and the stdout tail are
+        # the same bytes; a differing value means one record was edited
+        # or mixed from another run
+        if rnd.envelope_metrics:
+            env_by = {r["metric"]: r for r in rnd.envelope_metrics
+                      if "metric" in r}
+            for rec in rnd.metrics:
+                name = rec.get("metric")
+                other = env_by.get(name)
+                if other is None or name == SENTINEL:
+                    continue
+                if rec.get("value") != other.get("value"):
+                    problems.append(
+                        f"{tag}: metric {name!r} disagrees between the "
+                        f"local record ({rec.get('value')!r}) and the "
+                        f"driver envelope ({other.get('value')!r}) — "
+                        f"the committed records are not one capture")
+    return problems
+
+
+def all_warnings(trs: dict[str, Trajectory]) -> list[str]:
+    out: list[str] = []
+    for name in sorted(trs):
+        out.extend(trs[name].warnings)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rendering
+
+
+def _fmt_band(tr: Trajectory) -> str:
+    if tr.band is None:
+        return "-"
+    return f"[{tr.band[0]:g}, {tr.band[1]:g}]"
+
+
+def format_table(trs: dict[str, Trajectory]) -> str:
+    """Aligned per-metric trajectory table (the operator view)."""
+    if not trs:
+        return "(no committed bench rounds found)\n"
+    header = ("metric", "unit", "dir", "draws (oldest..newest)",
+              "prior band", "status")
+    rows = [header]
+    for name in sorted(trs):
+        tr = trs[name]
+        draws = " ".join(f"r{d.round:02d}:{d.value:g}" for d in tr.draws)
+        status = "WARN" if tr.warnings else "ok"
+        rows.append((tr.metric, tr.unit, tr.direction, draws,
+                     _fmt_band(tr), status))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(header))]
+    lines = []
+    for i, r in enumerate(rows):
+        lines.append("  ".join(c.ljust(w)
+                               for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    warns = all_warnings(trs)
+    if warns:
+        lines.append("")
+        for w in warns:
+            lines.append(f"WARN {w}")
+    return "\n".join(lines) + "\n"
+
+
+def format_markdown(trs: dict[str, Trajectory]) -> str:
+    lines = ["| metric | unit | dir | draws | prior band | status |",
+             "|---|---|---|---|---|---|"]
+    for name in sorted(trs):
+        tr = trs[name]
+        draws = ", ".join(f"r{d.round:02d}: {d.value:g}"
+                          for d in tr.draws)
+        status = "**WARN**" if tr.warnings else "ok"
+        lines.append(f"| `{tr.metric}` | {tr.unit} | {tr.direction} | "
+                     f"{draws} | {_fmt_band(tr)} | {status} |")
+    for w in all_warnings(trs):
+        lines.append(f"- WARN: {w}")
+    return "\n".join(lines) + "\n"
+
+
+def to_json(trs: dict[str, Trajectory],
+            problems: list[str] | None = None) -> dict:
+    return {
+        "metrics": {
+            name: {
+                "unit": tr.unit,
+                "direction": tr.direction,
+                "draws": [dataclasses.asdict(d) for d in tr.draws],
+                "band": list(tr.band) if tr.band else None,
+                "warnings": tr.warnings,
+            }
+            for name, tr in sorted(trs.items())
+        },
+        "warnings": all_warnings(trs),
+        "problems": problems or [],
+    }

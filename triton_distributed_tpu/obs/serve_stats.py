@@ -1,0 +1,347 @@
+"""Live serving telemetry: streaming quantile sketches and windowed rates.
+
+The registry's fixed-bucket histograms (``obs.registry``) are built for
+cross-process mergeability, which pins their boundaries at creation — a
+p99 read off them is quantized to the nearest bucket bound (up to 2.5x
+off between the coarse decade bounds).  A live serving plane needs
+**streaming percentiles with a bounded relative error** and **windowed
+rates** ("tokens/s over the last minute", not "since process start").
+This module is that layer, still zero-dep and thread-safe:
+
+- :class:`QuantileSketch` — a DDSketch-style log-bucket histogram with a
+  fixed gamma: bucket ``i`` covers ``(gamma^(i-1), gamma^i]``, so any
+  quantile estimate is within ``alpha`` RELATIVE error of the true value
+  (``gamma = (1+alpha)/(1-alpha)``; default alpha = 1%).  Unlike a real
+  DDSketch there is no bucket collapsing by default — serving latencies
+  span ~6 decades, which at 1% is < 700 live buckets; an explicit
+  ``max_buckets`` collapses the smallest keys if a pathological feed
+  grows past it.
+- :class:`WindowedRate` — per-second event/value buckets over a sliding
+  window (default 60 s): ``rate()`` is the windowed mean per second,
+  ``total`` the lifetime sum.
+- :class:`ServeStats` — the process-global collector the engine and the
+  comm entry points feed (request/prefill/decode latency sketches,
+  tokens/s and request/s windows, queue depth, KV/device-memory
+  occupancy, per-collective wire-byte rates).  Snapshotted into
+  ``Engine.health()`` and rendered by ``obs.server``'s ``/metrics``.
+
+Everything rides the same ``TDT_OBS=1`` gate as the registry: the feed
+helpers no-op when ``obs.enabled()`` is false, so the serve loop is
+unchanged with telemetry off.  Accuracy bound pinned by
+``tests/test_obs.py::test_sketch_quantile_error_bound``.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+DEFAULT_ALPHA = 0.01          # 1% relative quantile error
+DEFAULT_WINDOW_S = 60.0       # rate window
+SERVE_QUANTILES = (0.5, 0.9, 0.99)
+
+
+class QuantileSketch:
+    """Fixed-gamma log-bucket quantile sketch (DDSketch family).
+
+    ``observe(v)`` maps ``v > 0`` to key ``ceil(log_gamma(v))``;
+    ``quantile(q)`` walks the sorted keys to the q-rank bucket and
+    returns its midpoint ``2 * gamma^k / (gamma + 1)`` — within
+    ``alpha`` relative error of the true quantile by construction.
+    Non-positive observations land in a dedicated zero bucket (rank 0
+    side).  Thread-safe; ``merge`` adds another sketch of the SAME gamma.
+    """
+
+    __slots__ = ("alpha", "gamma", "_lg", "max_buckets", "_lock",
+                 "_buckets", "_zero", "_count", "_sum", "_min", "_max")
+
+    def __init__(self, alpha: float = DEFAULT_ALPHA,
+                 max_buckets: int = 4096):
+        if not 0.0 < alpha < 1.0:
+            raise ValueError(f"alpha {alpha} outside (0, 1)")
+        self.alpha = float(alpha)
+        self.gamma = (1.0 + alpha) / (1.0 - alpha)
+        self._lg = math.log(self.gamma)
+        self.max_buckets = int(max_buckets)
+        self._lock = threading.Lock()
+        self._buckets: dict[int, int] = {}
+        self._zero = 0
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def _key(self, v: float) -> int:
+        return math.ceil(math.log(v) / self._lg)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            if v <= 0.0:
+                self._zero += 1
+                return
+            k = self._key(v)
+            self._buckets[k] = self._buckets.get(k, 0) + 1
+            if len(self._buckets) > self.max_buckets:
+                # collapse the two smallest keys (lowest-latency tail):
+                # high quantiles — the serving signal — stay exact-bound
+                ks = sorted(self._buckets)
+                self._buckets[ks[1]] = (self._buckets.pop(ks[0])
+                                        + self._buckets[ks[1]])
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def quantile(self, q: float) -> float:
+        """q-quantile estimate (0.0 when empty); relative error <= alpha
+        for positive observations, with the extremes (q == 0 / q == 1)
+        reported EXACTLY from the tracked min/max."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        with self._lock:
+            return self.quantile_unlocked(q)
+
+    def merge(self, other: "QuantileSketch") -> None:
+        if abs(other.gamma - self.gamma) > 1e-12:
+            raise ValueError("cannot merge sketches with different gamma")
+        with other._lock:
+            buckets = dict(other._buckets)
+            zero, count, s = other._zero, other._count, other._sum
+            mn, mx = other._min, other._max
+        with self._lock:
+            for k, c in buckets.items():
+                self._buckets[k] = self._buckets.get(k, 0) + c
+            self._zero += zero
+            self._count += count
+            self._sum += s
+            self._min = min(self._min, mn)
+            self._max = max(self._max, mx)
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "alpha": self.alpha, "count": self._count,
+                "sum": self._sum,
+                "min": self._min if self._count else None,
+                "max": self._max if self._count else None,
+                "quantiles": {f"p{int(q * 100)}": self.quantile_unlocked(q)
+                              for q in SERVE_QUANTILES},
+            }
+
+    def quantile_unlocked(self, q: float) -> float:
+        # the walk itself, lock held by the caller (quantile / to_dict)
+        if not self._count:
+            return 0.0
+        if q <= 0.0:
+            return self._min
+        if q >= 1.0:
+            return self._max
+        rank = q * (self._count - 1)
+        seen = self._zero
+        if rank < seen:
+            return min(self._min, 0.0)
+        for k in sorted(self._buckets):
+            seen += self._buckets[k]
+            if rank < seen:
+                # bucket midpoint: within alpha of anything inside
+                return 2.0 * self.gamma ** k / (self.gamma + 1.0)
+        return self._max
+
+
+class WindowedRate:
+    """Sliding-window rate: per-second value buckets over ``window_s``.
+
+    ``add(v)`` accumulates into the current second's bucket; ``rate()``
+    is the window sum divided by the window length (units/s), so a burst
+    decays out of the reading within one window.  ``total`` is the
+    lifetime sum (a counter).  Thread-safe.
+    """
+
+    __slots__ = ("window_s", "_lock", "_buckets", "_total")
+
+    def __init__(self, window_s: float = DEFAULT_WINDOW_S):
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._buckets: dict[int, float] = {}
+        self._total = 0.0
+
+    def _prune(self, now: float) -> None:
+        floor = int(now - self.window_s)
+        if len(self._buckets) > self.window_s + 2:
+            for s in [s for s in self._buckets if s < floor]:
+                del self._buckets[s]
+
+    def add(self, v: float = 1.0, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        s = int(now)
+        with self._lock:
+            self._buckets[s] = self._buckets.get(s, 0.0) + v
+            self._total += v
+            self._prune(now)
+
+    @property
+    def total(self) -> float:
+        return self._total
+
+    def rate(self, now: float | None = None) -> float:
+        now = time.monotonic() if now is None else now
+        floor = now - self.window_s
+        with self._lock:
+            live = sum(v for s, v in self._buckets.items() if s >= floor)
+        return live / self.window_s
+
+
+class ServeStats:
+    """The live serving collector (one per process, ``STATS`` below).
+
+    Fed by ``models/engine.py`` (request begin/end, per-request latency
+    stats, occupancy) and ``obs.record_collective`` (wire bytes); every
+    feed helper is cheap and lock-scoped per metric.  ``snapshot()`` is
+    the JSON the engine's ``health()`` embeds; ``to_prometheus()`` the
+    text block ``obs.server`` appends to ``/metrics``.
+    """
+
+    def __init__(self, *, alpha: float = DEFAULT_ALPHA,
+                 window_s: float = DEFAULT_WINDOW_S):
+        self._lock = threading.Lock()
+        self._alpha = alpha
+        self._window_s = window_s
+        self.request_ms = QuantileSketch(alpha)
+        self.prefill_ms = QuantileSketch(alpha)
+        self.decode_ms_per_token = QuantileSketch(alpha)
+        self.tokens = WindowedRate(window_s)
+        self.requests = WindowedRate(window_s)
+        self.failed_requests = WindowedRate(window_s)
+        self._wire: dict[str, WindowedRate] = {}
+        self._queue_depth = 0
+        self._gauges: dict[str, float] = {}
+
+    # -- feed (call sites gate on obs.enabled()) ---------------------------
+
+    def request_begin(self) -> None:
+        with self._lock:
+            self._queue_depth += 1
+
+    def request_end(self, *, failed: bool = False) -> None:
+        with self._lock:
+            self._queue_depth = max(0, self._queue_depth - 1)
+        self.requests.add(1.0)
+        if failed:
+            self.failed_requests.add(1.0)
+
+    def observe_request(self, *, prompt_len: int, gen_len: int,
+                        stats: dict, batch: int = 1) -> None:
+        """One completed ``Engine.serve`` request (its stats dict).
+        ``batch`` scales the token window: a B=128 request produces
+        ``B * gen_len`` tokens, matching the registry's
+        ``engine_tokens_generated`` accounting."""
+        decode_steps = max(gen_len - 1, 1)
+        prefill = float(stats.get("prefill_ms", 0.0))
+        per_tok = float(stats.get("decode_ms_per_token", 0.0))
+        self.prefill_ms.observe(prefill)
+        self.decode_ms_per_token.observe(per_tok)
+        self.request_ms.observe(prefill + per_tok * decode_steps)
+        self.tokens.add(float(gen_len) * max(int(batch), 1))
+
+    def observe_collective(self, op: str, *, wire_bytes: float) -> None:
+        r = self._wire.get(op)
+        if r is None:
+            with self._lock:
+                r = self._wire.setdefault(op, WindowedRate(self._window_s))
+        r.add(float(wire_bytes))
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Occupancy-style last-write-wins values (kv_cache_seq_occupancy,
+        device_memory_occupancy)."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue_depth
+
+    # -- read --------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            gauges = dict(self._gauges)
+            depth = self._queue_depth
+            wire = dict(self._wire)
+        return {
+            "queue_depth": depth,
+            "request_ms": self.request_ms.to_dict(),
+            "prefill_ms": self.prefill_ms.to_dict(),
+            "decode_ms_per_token": self.decode_ms_per_token.to_dict(),
+            "tokens_per_s_window": self.tokens.rate(),
+            "requests_per_s_window": self.requests.rate(),
+            "failed_requests_per_s_window": self.failed_requests.rate(),
+            "tokens_total": self.tokens.total,
+            "requests_total": self.requests.total,
+            "wire_bytes_per_s_window": {
+                op: r.rate() for op, r in sorted(wire.items())
+            },
+            "gauges": gauges,
+        }
+
+    def to_prometheus(self) -> str:
+        """Prometheus text block for the live stats — summary-style
+        quantile series for the sketches, gauges for windows/occupancy.
+        Appended after the registry exposition by ``obs.server``."""
+        lines: list[str] = []
+
+        def sk(name: str, sketch: QuantileSketch) -> None:
+            lines.append(f"# TYPE {name} summary")
+            for q in SERVE_QUANTILES:
+                lines.append(
+                    f'{name}{{quantile="{q:g}"}} {sketch.quantile(q)!r}')
+            lines.append(f"{name}_sum {sketch.sum!r}")
+            lines.append(f"{name}_count {sketch.count}")
+
+        sk("serve_request_ms", self.request_ms)
+        sk("serve_prefill_ms", self.prefill_ms)
+        sk("serve_decode_ms_per_token", self.decode_ms_per_token)
+
+        def g(name: str, v: float) -> None:
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {float(v)!r}")
+
+        g("serve_queue_depth", self._queue_depth)
+        g("serve_tokens_per_s_window", self.tokens.rate())
+        g("serve_requests_per_s_window", self.requests.rate())
+        g("serve_failed_requests_per_s_window", self.failed_requests.rate())
+        with self._lock:
+            wire = dict(self._wire)
+            gauges = dict(self._gauges)
+        if wire:
+            lines.append("# TYPE serve_wire_bytes_per_s_window gauge")
+            for op, r in sorted(wire.items()):
+                lines.append(
+                    f'serve_wire_bytes_per_s_window{{op="{op}"}} '
+                    f"{r.rate()!r}")
+        for name, v in sorted(gauges.items()):
+            g(f"serve_{name}", v)
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        """Fresh collector state (tests)."""
+        self.__init__(alpha=self._alpha, window_s=self._window_s)
+
+
+# the process-global collector the engine and comm entry points feed
+STATS = ServeStats()

@@ -1,0 +1,274 @@
+"""The live telemetry endpoint: a stdlib HTTP plane over ``obs``.
+
+``TDT_OBS_HTTP=<port>`` makes the engine start one process-wide
+``ThreadingHTTPServer`` (daemon threads, port 0 = ephemeral) exposing:
+
+- ``GET /metrics``   — Prometheus text: the registry exposition
+  (``obs.to_prometheus``) followed by the live serving block
+  (``obs.serve_stats`` quantile summaries, windowed rates, queue depth).
+- ``GET /healthz``   — the serving-health snapshot as JSON
+  (``Engine.health()`` when an engine is registered, else
+  ``resilience.health_snapshot()``); **503** when the snapshot reports
+  ``status != "ok"`` (an open circuit breaker), 200 otherwise — the
+  load-balancer contract.
+- ``GET /debug/flight``   — the current flight-ring dump
+  (``obs.flight.recent``) as JSON: enabled state, step, event dicts and
+  their ``describe()`` lines.
+- ``GET /debug/timeline`` — the ring reconstructed through
+  ``obs.timeline`` (events grouped per recorded rank; live rank −1
+  events form one stream) rendered as the per-collective attribution
+  table, best-effort: a ring the credit replay cannot complete reports
+  ``pending`` instead of erroring.
+
+Everything is read-only and unauthenticated — bind is loopback-only by
+default (``TDT_OBS_HTTP_HOST`` overrides for pod networks).  With
+``TDT_OBS_HTTP`` unset nothing starts and the engine path costs one env
+read at construction.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_LOCK = threading.Lock()
+_SERVER: "TelemetryServer | None" = None
+
+
+def port_from_env() -> int | None:
+    """The configured port, or None when the plane is off.  ``0`` asks
+    for an ephemeral port (tests); unset/empty/off disables.  A value
+    that parses as neither is a MISCONFIGURATION, not a disable: the
+    operator asked for a plane and would get silence — warn loudly."""
+    raw = os.environ.get("TDT_OBS_HTTP", "").strip().lower()
+    if raw in ("", "off", "false", "no", "none"):
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"TDT_OBS_HTTP={raw!r} is not a port number; the telemetry "
+            f"endpoint will NOT start")
+        return None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tdt-obs/1"
+
+    # the handler reaches its TelemetryServer through the HTTPServer
+    def _telemetry(self) -> "TelemetryServer":
+        return self.server._telemetry  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # noqa: D102 — no stderr spam
+        pass
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(200, self._telemetry().metrics_text(),
+                           "text/plain; version=0.0.4")
+            elif path == "/healthz":
+                code, snap = self._telemetry().health()
+                self._send(code, json.dumps(snap, indent=1, sort_keys=True,
+                                            default=str),
+                           "application/json")
+            elif path == "/debug/flight":
+                self._send(200, json.dumps(self._telemetry().flight_dump(),
+                                           default=str),
+                           "application/json")
+            elif path == "/debug/timeline":
+                self._send(200, json.dumps(self._telemetry().timeline_dump(),
+                                           default=str),
+                           "application/json")
+            else:
+                self._send(404, json.dumps({
+                    "error": f"unknown path {path!r}",
+                    "endpoints": ["/metrics", "/healthz", "/debug/flight",
+                                  "/debug/timeline"],
+                }), "application/json")
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # a debug endpoint must never kill the plane
+            try:
+                self._send(500, json.dumps({"error": f"{type(e).__name__}: "
+                                                     f"{e}"}),
+                           "application/json")
+            except Exception:
+                pass
+
+
+class TelemetryServer:
+    """One bound HTTP server on a daemon thread; ``stop()`` joins it."""
+
+    def __init__(self, port: int, host: str | None = None,
+                 engine=None):
+        self.host = host or os.environ.get("TDT_OBS_HTTP_HOST",
+                                           "127.0.0.1")
+        self._httpd = ThreadingHTTPServer((self.host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd._telemetry = self  # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._engine_ref = (lambda: None)
+        if engine is not None:
+            self.register_engine(engine)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tdt-obs-http",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def register_engine(self, engine) -> None:
+        """Weakly attach the engine whose ``health()`` backs ``/healthz``
+        (the latest registered engine wins; the server must not keep a
+        dead engine's cache trees alive)."""
+        self._engine_ref = weakref.ref(engine)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    # -- endpoint bodies ---------------------------------------------------
+
+    def metrics_text(self) -> str:
+        from . import dump_prometheus, serve_stats
+
+        return dump_prometheus() + serve_stats.STATS.to_prometheus()
+
+    def health(self) -> tuple[int, dict]:
+        engine = self._engine_ref()
+        if engine is not None:
+            snap = engine.health()
+        else:
+            from .. import resilience
+
+            snap = resilience.health_snapshot()
+        code = 200 if snap.get("status") == "ok" else 503
+        return code, snap
+
+    def flight_dump(self, n: int = 256) -> dict:
+        from . import flight
+
+        evs = flight.recent(n)
+        return {
+            "enabled": flight.enabled(),
+            "keep_steps": flight.keep_steps(),
+            "events": [ev.to_dict() for ev in evs],
+            "lines": [ev.describe() for ev in evs],
+        }
+
+    def timeline_dump(self) -> dict:
+        """Reconstruct the current ring through ``obs.timeline``: events
+        grouped by recorded rank (a deterministic capture harness writes
+        rank >= 0; live ring events carry rank −1 and form one stream).
+        Partial rings reconstruct as far as credits allow (``pending``)."""
+        from . import flight, timeline
+
+        evs = flight.recent()
+        ranks = sorted({ev.rank for ev in evs if ev.rank >= 0})
+        if ranks:
+            streams = [[ev for ev in evs if ev.rank == r] for r in ranks]
+        else:
+            streams = [list(evs)]
+        try:
+            tl = timeline.reconstruct(streams, kernel="flight-ring")
+            return {
+                "enabled": flight.enabled(),
+                "ranks": tl.n,
+                "events": len(evs),
+                "critical_us": tl.critical_us,
+                "pct_sol": tl.pct_sol,
+                "stalled": tl.stalled,
+                "pending": list(tl.pending),
+                "waits": [w.describe() for w in tl.waits],
+                "table": timeline.format_table(tl),
+            }
+        except Exception as e:
+            return {
+                "enabled": flight.enabled(),
+                "events": len(evs),
+                "error": f"{type(e).__name__}: {e}",
+                "lines": [ev.describe() for ev in evs[-64:]],
+            }
+
+
+def start(port: int | None = None, engine=None) -> TelemetryServer:
+    """Start (or return) the process-wide telemetry server.  ``port``
+    defaults to ``TDT_OBS_HTTP``; raises when neither is set."""
+    global _SERVER
+    with _LOCK:
+        if _SERVER is not None:
+            if engine is not None:
+                _SERVER.register_engine(engine)
+            return _SERVER
+        if port is None:
+            port = port_from_env()
+        if port is None:
+            raise ValueError(
+                "no port: pass one or set TDT_OBS_HTTP=<port>")
+        _SERVER = TelemetryServer(port, engine=engine)
+        return _SERVER
+
+
+def maybe_start(engine=None) -> TelemetryServer | None:
+    """The engine-construction hook: start the plane iff ``TDT_OBS_HTTP``
+    is set (one env read when unset — PR-4 behavior is otherwise
+    untouched).  With the env UNSET this is a strict no-op even when a
+    server is already running: an explicitly-started plane (``start()``
+    with no engine) keeps its resilience-snapshot ``/healthz`` and must
+    not be silently adopted — and later torn down — by an engine the
+    operator never wired to it."""
+    if port_from_env() is None:
+        return None
+    try:
+        return start(engine=engine)
+    except OSError:
+        # the port being taken (another serving process on the box) must
+        # not stop the engine from serving; the operator sees it in the
+        # scrape gap, not as a dead engine
+        return None
+
+
+def running() -> TelemetryServer | None:
+    return _SERVER
+
+
+def stop() -> None:
+    """Stop the process-wide server (idempotent)."""
+    global _SERVER
+    with _LOCK:
+        srv, _SERVER = _SERVER, None
+    if srv is not None:
+        srv.stop()
+
+
+def release(engine) -> None:
+    """Engine-owned shutdown: stop the plane iff ``engine`` is the
+    registered health source (``Engine.close``); other engines keep it.
+    The check-and-detach happens under ``_LOCK`` so a concurrent
+    ``start()`` registering another engine cannot lose its plane to a
+    stale release."""
+    global _SERVER
+    with _LOCK:
+        srv = _SERVER
+        if srv is None or srv._engine_ref() is not engine:
+            return
+        _SERVER = None
+    srv.stop()

@@ -209,9 +209,14 @@ def health_snapshot() -> dict:
             op: {"open": b.open, "consecutive_failures": b.failures}
             for op, b in sorted(_BREAKERS.items())
         }
-    degraded = any(b["open"] for b in breakers.values())
+    degraded_ops = sorted(op for op, b in breakers.items() if b["open"])
     return {
-        "status": "degraded" if degraded else "ok",
+        "status": "degraded" if degraded_ops else "ok",
+        # the ops currently serving through their XLA fallback (open
+        # breakers) — what /healthz consumers alert on by name, without
+        # walking the breakers map (docs/observability.md "Live
+        # telemetry")
+        "degraded_ops": degraded_ops,
         "obs_enabled": obs.enabled(),
         "breakers": breakers,
         "last_errors": dict(sorted(_LAST_ERROR.items())),

@@ -620,6 +620,41 @@ def resolve_gemm_like(name: str, op, config_cls, cand_dims, default,
     )
 
 
+def collective_tile_candidates(config_cls, m: int, r: int) -> list:
+    """(bm, bn) reduction-pipeline tile sweep for the signal-shaped
+    collectives (VERDICT r5 next #5): the ``AllReduceConfig`` /
+    ``ReduceScatterConfig`` add/sum-pipeline tiles, clipped to the
+    problem through the config's own ``clip`` and deduped — at small
+    shapes most tilings collapse onto the default, and a one-candidate
+    sweep costs nothing (``Autotuner.tune`` short-circuits it).
+    The (256, 512) default leads: the baseline the margins protect."""
+    dims = [(256, 512), (512, 512), (256, 1024), (512, 1024),
+            (128, 512), (512, 256)]
+    out, seen = [], set()
+    for bm, bn in dims:
+        c = config_cls(bm=bm, bn=bn).clip(m, r)
+        if c not in seen:
+            seen.add(c)
+            out.append(c)
+    return out
+
+
+def a2a_chunk_candidates(config_cls, t: int) -> list:
+    """``AllToAllConfig.chunk`` sweep for the EP all-to-all: rows per DMA
+    descriptor — smaller chunks pipeline the wire at more descriptors,
+    larger ones amortize issue latency.  Values are pre-clamped to the
+    op's own ``min(chunk, round_up(t, 8))`` rule and deduped, so every
+    candidate launches a distinct kernel.  128 (the default) leads."""
+    cap = max(8, -(-t // 8) * 8)
+    out, seen = [], set()
+    for ch in (128, 64, 256, 512):
+        eff = min(ch, cap)
+        if eff not in seen:
+            seen.add(eff)
+            out.append(config_cls(chunk=eff))
+    return out
+
+
 AG_GEMM_CAND_DIMS = lambda m, n, k, r: (max(m // r, 1), max(n // r, 1), k)   # noqa: E731
 GEMM_RS_CAND_DIMS = lambda m, n, k, r: (max(m // r, 1), n, max(k // r, 1))   # noqa: E731
 GEMM_AR_CAND_DIMS = lambda m, n, k, r: (max(m // r, 1), n, max(k // r, 1))   # noqa: E731

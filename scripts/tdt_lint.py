@@ -18,6 +18,7 @@ Usage:
     python scripts/tdt_lint.py --faults --seed 7 # reseed the injection
     python scripts/tdt_lint.py --timeline        # flight-timeline smoke
     python scripts/tdt_lint.py --history         # bench-record trend gate
+    python scripts/tdt_lint.py --serve           # scheduler overload smoke
     python scripts/tdt_lint.py --json report.json
 
 ``--faults`` runs the ``tdt.resilience`` fault-injection matrix
@@ -34,6 +35,16 @@ under deterministic record mode, reconstruct the cross-rank timeline
 BALANCED attribution — symmetric per-rank exposed-wait totals and every
 recv stall named with its (semaphore, chunk, peer) triple.  Headless
 and CPU-only, like the rest of the lint.
+
+``--serve`` is the continuous-batching scheduler's overload smoke
+(docs/serving.md): a seeded 64-request open-loop trace overcommitting
+the KV-page budget runs through the REAL scheduler (deterministic
+SimBackend over the real paged-cache plumbing) WITH fault injection on
+(a rank abort mid-decode), asserting zero leaked pages, a monotone
+queue drain after arrivals stop, every request terminal, and
+per-request isolation; then the fault matrix's scheduler cells
+(``resilience.run_scheduler_matrix``) must each be detected-or-
+survived.  Headless and CPU-only.
 
 ``--history`` runs the bench-record trend sentinel
 (``scripts/bench_history.py --check``): exit 1 when a committed
@@ -77,6 +88,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--history", action="store_true",
                     help="bench-record trend gate: committed rounds must "
                          "be internally consistent; trends warn")
+    ap.add_argument("--serve", action="store_true",
+                    help="scheduler overload smoke: seeded 64-request "
+                         "trace with fault injection, zero leaked pages, "
+                         "monotone drain; plus the scheduler fault cells")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection target sampling seed (--faults)")
     ap.add_argument("--json", metavar="PATH",
@@ -89,6 +104,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_timeline(args)
     if args.history:
         return _run_history(args)
+    if args.serve:
+        return _run_serve(args)
 
     from triton_distributed_tpu import analysis
 
@@ -161,6 +178,86 @@ def _run_faults(args) -> int:
             _json.dump({"rows": rows, "problems": problems}, f,
                        indent=1, sort_keys=True)
     return 1 if problems else 0
+
+
+def _run_serve(args) -> int:
+    """The scheduler overload smoke (see module docstring): trace leg
+    then matrix leg; every problem printed with a SERVE FAIL prefix."""
+    from triton_distributed_tpu import resilience
+    from triton_distributed_tpu import serve
+    from triton_distributed_tpu.resilience.faults import RankAborted
+
+    problems: list[str] = []
+
+    # leg 1: seeded 64-request open-loop trace, ~2x page-budget
+    # overcommit, one rank abort injected mid-decode
+    class Inject:
+        fired = 0
+
+        def __call__(self, step):
+            if step == 9 and not self.fired:
+                self.fired = 1
+                raise RankAborted(1, step)
+
+    inj = Inject()
+    backend = serve.SimBackend(slots=4, page_size=4, pool_pages=33,
+                               max_length=64, step_hook=inj)
+    sched = serve.Scheduler(backend, serve.SchedulerConfig(
+        max_queue_depth=64))
+    arrivals = serve.synthetic_trace(args.seed, 64,
+                                     mean_interarrival_steps=0.5,
+                                     prompt_len=(2, 12), max_new=(2, 12))
+    report = serve.replay(sched, arrivals, max_steps=20_000)
+    print(f"serve trace: {len(report.requests)} requests -> "
+          f"{len(report.completed)} completed, {len(report.failed)} "
+          f"failed, {len(report.shed)} shed; {sched.preemptions} "
+          f"preemption(s), peak pool occupancy "
+          f"{report.peak_pool_occupancy:.2f}, {report.steps} steps, "
+          f"leaked pages {report.leaked_pages}, monotone drain "
+          f"{report.drain_monotone}")
+    problems += [f"trace: {p}" for p in report.problems()]
+    if not inj.fired:
+        problems.append("trace: the rank-abort injection never fired "
+                        "(decode never reached step 9?)")
+    elif len(report.failed) != 1:
+        problems.append(
+            f"trace: expected exactly the injected victim to fail, got "
+            f"{len(report.failed)} failure(s): "
+            f"{[(r.req_id, r.error) for r in report.failed]}")
+    elif "RankAborted" not in (report.failed[0].error or ""):
+        problems.append(f"trace: victim error does not name the fault: "
+                        f"{report.failed[0].error!r}")
+
+    # leg 2: the scheduler cells of the fault matrix
+    rows = resilience.run_scheduler_matrix(seed=args.seed)
+    for row in rows:
+        print(f"{row['kernel']:<20} {row['fault']:<12} {row['leg']:<8} "
+              f"{row['outcome'].upper():<10} {row['detail']}")
+    problems += resilience.verify_scheduler_matrix(rows)
+
+    for p in problems:
+        print(f"SERVE FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({
+                "trace": {
+                    "requests": len(report.requests),
+                    "completed": len(report.completed),
+                    "failed": len(report.failed),
+                    "shed": len(report.shed),
+                    "preemptions": sched.preemptions,
+                    "leaked_pages": report.leaked_pages,
+                    "drain_monotone": report.drain_monotone,
+                },
+                "cells": rows,
+                "problems": problems,
+            }, f, indent=1, sort_keys=True, default=str)
+    if problems:
+        return 1
+    print("serve OK: overload trace drained with zero leaked pages and "
+          "per-request isolation; scheduler fault cells all "
+          "detected-or-survived")
+    return 0
 
 
 def _run_timeline(args) -> int:

@@ -122,6 +122,9 @@ FAST_NODES = frozenset((
     "tests/test_obs.py::test_tdt_lint_timeline_smoke",
     "tests/test_obs.py::test_bench_history_check_repo_green",
     "tests/test_obs.py::test_telemetry_endpoints_during_live_decode",
+    "tests/test_serve.py::test_tdt_lint_serve_smoke",
+    "tests/test_serve.py::test_overcommit_2x_budget_completes_all_zero_leaks",
+    "tests/test_serve.py::test_healthz_flips_503_under_saturation_then_200",
 ))
 
 

@@ -146,6 +146,31 @@ def test_pool_write_helpers_round_trip():
     np.testing.assert_array_equal(got[2, :, s], toks[1][2])
 
 
+def test_append_paged_exhaustion_raises_typed():
+    """ISSUE 6 satellite: a sequence outgrowing its block table raises
+    PagePoolExhausted (naming the sequences) on the eager path instead
+    of silently scattering into a clamped — i.e. WRONG — page."""
+    import dataclasses
+
+    from triton_distributed_tpu.models import PagePoolExhausted
+
+    mesh = make_mesh({TP_AXIS: 1}, devices=jax.devices()[:1])
+    cache = init_paged_cache(mesh, 1, 2, 2, 16, 8, jnp.float32,
+                             page_size=4)
+    tok = jnp.ones((2, 2, 8), jnp.float32)
+    # at the limit: 16 positions of capacity, seq 1 already at 16
+    cache = dataclasses.replace(
+        cache, seq_lens=jnp.asarray([3, 16], jnp.int32))
+    with pytest.raises(PagePoolExhausted) as ei:
+        append_paged(cache, 0, tok, tok)
+    assert ei.value.sequences == (1,)
+    assert "outgrown" in str(ei.value)
+    # in range: both sequences write fine
+    cache = dataclasses.replace(
+        cache, seq_lens=jnp.asarray([3, 15], jnp.int32))
+    append_paged(cache, 0, tok, tok)
+
+
 @pytest.mark.parametrize("n", [1, 2])
 def test_paged_engine_matches_contiguous(n):
     """Greedy generation on the paged engine equals the contiguous engine

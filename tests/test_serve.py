@@ -1,0 +1,543 @@
+"""Continuous-batching scheduler on the paged KV cache (ISSUE 6).
+
+Everything here is headless and model-free: the scheduler runs over the
+deterministic ``serve.SimBackend``, which drives the REAL paged-cache
+plumbing (``write_chunk_paged`` / ``append_paged`` / block tables /
+the page free-list) with a seeded token automaton — so page
+bookkeeping, preemption, isolation and telemetry are exercised for
+real while the model's shard_map/Pallas paths (covered by the engine
+tests where the platform supports them) stay out of the loop.  The
+chunked-prefill model path (``Qwen3.prefill_chunk``) is plain jnp and
+IS tested here, via chunk-invariance.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_distributed_tpu import obs, resilience, serve
+from triton_distributed_tpu.core.mesh import TP_AXIS, make_mesh
+from triton_distributed_tpu.models import (
+    Engine,
+    ModelConfig,
+    PagePoolExhausted,
+    Qwen3,
+    init_serving_cache,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def obs_on():
+    prev = obs.enabled()
+    obs.enable(True)
+    obs.REGISTRY.reset()
+    obs.serve_stats.STATS.reset()
+    yield obs
+    obs.enable(prev)
+    obs.REGISTRY.reset()
+    obs.serve_stats.STATS.reset()
+
+
+def _expected_tokens(backend: serve.SimBackend, req: serve.Request):
+    """Replay the SimBackend's deterministic generation rule from the
+    prompt alone — the golden for completed requests AND for the
+    recompute-after-preemption contract."""
+    toks = [backend.next_token(req.prompt[-1], req.prompt_len)]
+    length = req.prompt_len
+    while len(toks) < req.max_new_tokens:
+        length += 1
+        toks.append(backend.next_token(toks[-1], length))
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# units: page pool + queue
+
+
+def test_page_pool_alloc_free_deterministic():
+    pool = serve.PagePool(8, page_size=4)     # pages 1..7 allocatable
+    assert pool.capacity == 7
+    a = pool.alloc(3)
+    assert a == [1, 2, 3]                     # lowest-id-first
+    b = pool.alloc(2)
+    assert b == [4, 5]
+    assert pool.free_pages == 2 and pool.occupancy() == 5 / 7
+    pool.free(a)
+    assert pool.alloc(3) == [1, 2, 3]         # returned pages re-sort
+    with pytest.raises(PagePoolExhausted) as ei:
+        pool.alloc(5)
+    assert ei.value.needed == 5 and ei.value.available == 2
+    assert pool.try_alloc(5) is None
+
+
+def test_page_pool_double_free_and_foreign_free_raise():
+    pool = serve.PagePool(6, page_size=4)
+    a = pool.alloc(2)
+    pool.free(a)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([a[0]])
+    with pytest.raises(ValueError, match="outside the allocatable"):
+        pool.free([serve.SCRAP_PAGE])
+    assert serve.pages_needed(0, 4) == 0
+    assert serve.pages_needed(1, 4) == 1
+    assert serve.pages_needed(9, 4) == 3
+
+
+def test_queue_bounds_shed_and_priority_order():
+    q = serve.RequestQueue(max_depth=3)
+    r_lo = serve.Request(prompt=(1,), max_new_tokens=1, priority=0)
+    r_hi = serve.Request(prompt=(2,), max_new_tokens=1, priority=2)
+    r_mid = serve.Request(prompt=(3,), max_new_tokens=1, priority=1)
+    assert all(q.submit(r) for r in (r_lo, r_hi, r_mid))
+    over = serve.Request(prompt=(4,), max_new_tokens=1)
+    assert not q.submit(over)                 # bounded: shed, not buffered
+    assert over.state is serve.RequestState.SHED
+    assert "queue full" in over.shed_reason
+    assert q.sheds == 1
+    # preempted re-admission beats same-priority fresh arrivals
+    r_pre = serve.Request(prompt=(5,), max_new_tokens=1, priority=1)
+    r_pre.submitted_s = time.monotonic()
+    q.requeue_preempted(r_pre)
+    assert [q.pop().req_id for _ in range(4)] == \
+        [r_hi.req_id, r_pre.req_id, r_mid.req_id, r_lo.req_id]
+
+
+def test_queue_deadline_expiry_sheds():
+    q = serve.RequestQueue(max_depth=4)
+    fast = serve.Request(prompt=(1,), max_new_tokens=1, deadline_ms=1.0)
+    slow = serve.Request(prompt=(2,), max_new_tokens=1)
+    q.submit(fast)
+    q.submit(slow)
+    expired = q.expire_deadlines(now=time.monotonic() + 1.0)
+    assert [r.req_id for r in expired] == [fast.req_id]
+    assert fast.state is serve.RequestState.SHED
+    assert "deadline" in fast.shed_reason
+    assert q.depth == 1
+
+
+# ---------------------------------------------------------------------------
+# scheduler: drain, determinism, overcommit, preemption
+
+
+def test_scheduler_drains_seeded_load_exactly():
+    backend = serve.SimBackend(slots=3, page_size=4, pool_pages=32,
+                               max_length=48)
+    sched = serve.Scheduler(backend)
+    arrivals = serve.synthetic_trace(3, 14, mean_interarrival_steps=0.5,
+                                     prompt_len=(2, 9), max_new=(2, 8))
+    report = serve.replay(sched, arrivals, max_steps=2000)
+    assert report.problems() == []
+    assert len(report.completed) == 14
+    assert report.leaked_pages == 0
+    assert sched.pool.occupancy() == 0.0      # pool returns to empty
+    for req in report.completed:
+        assert req.tokens == _expected_tokens(backend, req)
+
+
+def test_overcommit_2x_budget_completes_all_zero_leaks(obs_on):
+    """The ISSUE 6 acceptance core: total page demand ~2x (actually
+    >5x at peak concurrency 2x) the pool; every request completes via
+    preemption, pool occupancy returns to 0, preemptions observable in
+    serve_stats."""
+    backend = serve.SimBackend(slots=3, page_size=4, pool_pages=10,
+                               max_length=48)
+    sched = serve.Scheduler(backend)
+    arrivals = serve.synthetic_trace(7, 10, mean_interarrival_steps=0.0,
+                                     prompt_len=(6, 12), max_new=(8, 16))
+    demand = sum(serve.pages_needed(
+        a.request.prompt_len + a.request.max_new_tokens, 4)
+        for a in arrivals)
+    assert demand >= 2 * sched.pool.capacity
+    report = serve.replay(sched, arrivals, max_steps=5000)
+    assert report.problems() == []
+    assert len(report.completed) == 10 and not report.failed
+    assert sched.preemptions > 0
+    assert report.leaked_pages == 0 and sched.pool.occupancy() == 0.0
+    snap = obs.serve_stats.STATS.snapshot()
+    assert snap["preemptions_total"] == sched.preemptions
+    assert snap["evicted_pages_total"] > 0
+    # TTFT is once-per-REQUEST: preemption re-prefills must not add
+    # samples (they would skew the p99 exactly in the thrash regime)
+    assert snap["ttft_ms"]["count"] == 10
+    assert snap["gauges"]["kv_pool_occupancy"] == 0.0
+    # preempted requests recomputed deterministically from their prompts
+    for req in report.completed:
+        assert req.tokens == _expected_tokens(backend, req)
+    assert max(r.preemptions for r in report.completed) > 0
+
+
+def test_preemption_recompute_matches_unpressured_run():
+    """Same trace, ample pool vs tight pool: identical final tokens —
+    eviction + recompute is invisible in outputs."""
+    def run(pool_pages):
+        backend = serve.SimBackend(slots=3, page_size=4,
+                                   pool_pages=pool_pages, max_length=48)
+        sched = serve.Scheduler(backend)
+        arrivals = serve.synthetic_trace(
+            11, 8, mean_interarrival_steps=0.0, prompt_len=(4, 10),
+            max_new=(6, 12))
+        report = serve.replay(sched, arrivals, max_steps=5000)
+        assert report.problems() == []
+        return sched, {tuple(r.prompt): tuple(r.tokens)
+                       for r in report.completed}
+
+    ample_sched, ample = run(64)
+    tight_sched, tight = run(9)
+    assert ample_sched.preemptions == 0
+    assert tight_sched.preemptions > 0
+    assert ample == tight
+
+
+def test_impossible_demand_sheds_typed():
+    backend = serve.SimBackend(slots=2, page_size=4, pool_pages=6,
+                               max_length=48)
+    sched = serve.Scheduler(backend)
+    too_big = serve.Request(prompt=tuple(range(10)), max_new_tokens=30)
+    assert not sched.submit(too_big)          # 10 pages > capacity 5
+    assert too_big.state is serve.RequestState.SHED
+    assert "exceeds the pool capacity" in too_big.shed_reason
+    too_long = serve.Request(prompt=tuple(range(40)), max_new_tokens=20)
+    assert not sched.submit(too_long)
+    assert "exceeds max_length" in too_long.shed_reason
+    assert len(sched.shed) == 2
+
+
+# ---------------------------------------------------------------------------
+# robustness: isolation, deadlines, degradation
+
+
+def test_rank_abort_mid_decode_isolates_victim_and_cache(obs_on):
+    """A rank abort in a 3-request decode step fails exactly one
+    sequence; the cohabitants complete with correct tokens AND their
+    pool pages still hold exactly their token history — per-sequence
+    isolation down to the bytes."""
+    from triton_distributed_tpu.resilience.faults import RankAborted
+
+    fired = []
+
+    def hook(step):
+        if step == 4 and not fired:
+            fired.append(step)
+            raise RankAborted(0, step)
+
+    backend = serve.SimBackend(slots=3, page_size=4, pool_pages=32,
+                               max_length=48, step_hook=hook)
+    sched = serve.Scheduler(backend)
+    reqs = [serve.Request(prompt=(5 + i, 6 + i, 7 + i),
+                          max_new_tokens=8, priority=i)
+            for i in range(3)]
+    for r in reqs:
+        sched.submit(r)
+    # remember the victim-designate's slot pages before the fault: the
+    # lowest-priority request (priority 0) is the eviction policy's pick
+    sched.run_until_idle(max_steps=200)
+    assert fired
+    victim, s1, s2 = reqs
+    assert victim.state is serve.RequestState.FAILED
+    assert "RankAborted" in victim.error
+    for r in (s1, s2):
+        assert r.state is serve.RequestState.DONE
+        assert r.tokens == _expected_tokens(backend, r)
+    assert sched.pool.occupancy() == 0.0
+
+
+def test_survivor_cache_bytes_intact_after_abort():
+    """Freeze the scheduler right after an aborted step (before the
+    survivors finish) and materialize a survivor's pages: they must
+    hold exactly prompt + generated-so-far token values."""
+    from triton_distributed_tpu.resilience.faults import RankAborted
+
+    def hook(step):
+        if step == 3:
+            raise RankAborted(1, step)
+
+    backend = serve.SimBackend(slots=2, page_size=4, pool_pages=16,
+                               max_length=32, step_hook=hook)
+    sched = serve.Scheduler(backend)
+    low = serve.Request(prompt=(9, 8, 7), max_new_tokens=10, priority=0)
+    hi = serve.Request(prompt=(3, 4, 5, 6), max_new_tokens=10, priority=1)
+    sched.submit(low)
+    sched.submit(hi)
+    while not sched.failed:
+        sched.step()
+    assert sched.failed[0] is low
+    slot = next(s for s in sched.slots if s is not None)
+    assert slot.request is hi
+    pool = np.asarray(sched.cache.k[0])       # (P, Hk, ps, D)
+    flat = np.concatenate([pool[p] for p in slot.pages], axis=1)[0, :, 0]
+    want = list(hi.prompt) + hi.tokens[:-1]   # last token not yet written
+    np.testing.assert_array_equal(flat[:len(want)],
+                                  np.asarray(want, np.float32))
+    sched.run_until_idle(max_steps=200)
+    assert hi.state is serve.RequestState.DONE
+
+
+def test_deadline_overrun_fails_only_the_deadline_carrier():
+    """A straggling step past one request's deadline rides the PR-3
+    watchdog: CollectiveTimeoutError, victim failed, cohabitants
+    complete."""
+    delay_s = 0.3
+
+    def hook(step):
+        if step == 2:
+            time.sleep(delay_s)
+
+    backend = serve.SimBackend(slots=3, page_size=4, pool_pages=32,
+                               max_length=64, step_hook=hook)
+    sched = serve.Scheduler(backend, serve.SchedulerConfig(
+        step_deadline_floor_ms=25.0))
+    victim = serve.Request(prompt=(1, 2), max_new_tokens=20,
+                           deadline_ms=120.0)
+    others = [serve.Request(prompt=(3 + i, 4 + i), max_new_tokens=6)
+              for i in range(2)]
+    for r in (victim, *others):
+        sched.submit(r)
+    sched.run_until_idle(max_steps=400)
+    # let the abandoned straggler finish its discarded step while the
+    # runtime is alive (XLA teardown aborts on a zombie mid-op)
+    time.sleep(delay_s + 0.1)
+    assert victim.state is serve.RequestState.FAILED
+    assert ("CollectiveTimeoutError" in victim.error
+            or "deadline" in victim.error)
+    for r in others:
+        assert r.state is serve.RequestState.DONE
+        assert r.tokens == _expected_tokens(backend, r)
+    assert sched.pool.occupancy() == 0.0
+
+
+def test_scheduler_fault_matrix_cells():
+    """The ISSUE 6 fault-matrix satellite: every scheduler cell
+    detected-or-survived with per-request isolation."""
+    rows = resilience.run_scheduler_matrix(seed=0)
+    assert {r["leg"] for r in rows} == {"abort", "slack", "overrun"}
+    problems = resilience.verify_scheduler_matrix(rows)
+    assert problems == [], problems
+    outcomes = {r["leg"]: r["outcome"] for r in rows}
+    assert outcomes["abort"] == "detected"
+    assert outcomes["slack"] == "survived"
+    assert outcomes["overrun"] == "detected"
+
+
+def test_admission_governor_shrinks_and_recovers():
+    gov = resilience.AdmissionGovernor(window_steps=4, thrash_threshold=2,
+                                       recover_steps=2,
+                                       breaker_op="test_gov_op")
+    assert gov.slot_cap(8) == 8 and gov.headroom_pages() == 0
+    gov.note_preemption()
+    gov.note_step_ok()
+    gov.note_preemption()
+    gov.note_step_ok()                        # 2 preempts in window: level 1
+    assert gov.level == 1
+    assert gov.slot_cap(8) == 4 and gov.headroom_pages() == 1
+    for _ in range(4):                        # clean steps decay it
+        gov.note_step_ok()
+    assert gov.level == 0 and gov.slot_cap(8) == 8
+    # an open serve-step breaker forces max degradation regardless
+    br = resilience.breaker("test_gov_op", threshold=1)
+    br.record_failure()
+    assert gov.degraded() and gov.slot_cap(8) == 1
+    resilience.reset_breaker("test_gov_op")
+    assert not gov.degraded()
+
+
+def test_governor_thrash_shrinks_admission_live():
+    """Under engineered thrash the scheduler's concurrent-slot cap
+    drops below the slot count — degradation shrinks admission instead
+    of failing requests (the resilience satellite)."""
+    backend = serve.SimBackend(slots=4, page_size=4, pool_pages=9,
+                               max_length=64)
+    gov = resilience.AdmissionGovernor(window_steps=4, thrash_threshold=2,
+                                       recover_steps=50,
+                                       breaker_op="test_gov_live")
+    sched = serve.Scheduler(backend, governor=gov)
+    arrivals = serve.synthetic_trace(5, 12, mean_interarrival_steps=0.0,
+                                     prompt_len=(6, 10), max_new=(10, 16))
+    report = serve.replay(sched, arrivals, max_steps=8000)
+    assert report.problems() == []
+    assert sched.preemptions > 0
+    assert gov.level > 0                      # thrash raised the level
+    assert gov.slot_cap(4) < 4
+    assert len(report.completed) == 12        # ...without failing anyone
+
+
+# ---------------------------------------------------------------------------
+# telemetry: healthz 503 <-> 200, /debug/serve
+
+
+def _get(url: str):
+    import urllib.error
+
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_healthz_flips_503_under_saturation_then_200(obs_on):
+    """The acceptance shape: sustained pool saturation answers 503 on
+    /healthz (load-balancer backoff), flipping back to 200 as the
+    backlog drains; /debug/serve exposes the scheduler state."""
+    from triton_distributed_tpu.obs import server as obs_server
+
+    backend = serve.SimBackend(slots=2, page_size=4, pool_pages=7,
+                               max_length=48)
+    sched = serve.Scheduler(backend)
+    srv = obs_server.start(port=0, engine=sched)
+    try:
+        arrivals = serve.synthetic_trace(
+            13, 8, mean_interarrival_steps=0.0, prompt_len=(6, 10),
+            max_new=(6, 10))
+        for a in arrivals:
+            sched.submit(a.request)
+        saw_503 = False
+        for _ in range(2000):
+            res = sched.step()
+            if sched.saturated_s() > 0 and not saw_503:
+                code, body = _get(srv.url + "/healthz")
+                assert code == 503
+                assert json.loads(body)["status"] == "saturated"
+                saw_503 = True
+            if res.idle:
+                break
+        assert saw_503, "scheduler never reported saturation"
+        code, body = _get(srv.url + "/healthz")
+        assert code == 200
+        snap = json.loads(body)
+        assert snap["status"] == "ok"
+        assert snap["scheduler"]["completed"] == 8
+        assert snap["scheduler"]["pool"]["used_pages"] == 0
+        code, body = _get(srv.url + "/debug/serve")
+        assert code == 200
+        dbg = json.loads(body)
+        assert dbg["scheduler"]["queue"]["depth"] == 0
+        assert dbg["serve_stats"]["preemptions_total"] \
+            == sched.preemptions
+        code, body = _get(srv.url + "/metrics")
+        assert code == 200
+        assert "serve_ttft_ms" in body
+        assert "serve_preemptions_total" in body
+    finally:
+        obs_server.stop()
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill (the plain-jnp model path) + engine validation
+
+
+def _tiny_model():
+    cfg = ModelConfig(
+        num_layers=2, hidden=32, intermediate=64, num_heads=4,
+        num_kv_heads=2, head_dim=8, vocab=64, max_length=32,
+        dtype=jnp.float32,
+    )
+    mesh = make_mesh({TP_AXIS: 1}, devices=jax.devices()[:1])
+    model = Qwen3(cfg, mesh)
+    params = model.init(jax.random.key(0), scale=0.05)
+    return cfg, mesh, model, params
+
+
+def _slot_cache(cfg, mesh):
+    c = init_serving_cache(mesh, cfg.num_layers, 1, cfg.num_kv_heads,
+                           cfg.max_length, cfg.head_dim, cfg.dtype,
+                           page_size=4, pool_pages=12)
+    return dataclasses.replace(
+        c, block_table=c.block_table.at[0].set(
+            jnp.arange(1, 9, dtype=jnp.int32)))
+
+
+def test_prefill_chunk_is_chunking_invariant():
+    """Chunk boundaries must not change logits or the written K/V —
+    the correctness contract chunked admission rests on.  (The fused
+    whole-prompt prefill parity is covered by the engine tests on
+    platforms with shard_map.)"""
+    cfg, mesh, model, params = _tiny_model()
+    ids = jax.random.randint(jax.random.key(1), (1, 11), 0, cfg.vocab)
+
+    whole = _slot_cache(cfg, mesh)
+    logits_w, whole = model.prefill_chunk(params, whole, ids, 0)
+
+    chunked = _slot_cache(cfg, mesh)
+    _, chunked = model.prefill_chunk(params, chunked, ids[:, :5], 0)
+    # final partial chunk right-padded and masked via true_len — the
+    # one-executable contract the EngineBackend uses
+    pad = jnp.concatenate([ids[:, 5:], jnp.zeros((1, 2), ids.dtype)],
+                          axis=1)
+    logits_c, chunked = model.prefill_chunk(params, chunked, pad, 5, 6)
+
+    np.testing.assert_allclose(np.asarray(logits_w[0, 10]),
+                               np.asarray(logits_c[0, 5]),
+                               rtol=2e-5, atol=2e-5)
+    assert int(chunked.seq_lens[0]) == 11
+
+    def mat(c):
+        g = np.asarray(c.k)[:, np.asarray(c.block_table)[0]]
+        return g.transpose(0, 2, 1, 3, 4).reshape(
+            cfg.num_layers, cfg.num_kv_heads, 32, cfg.head_dim)
+
+    np.testing.assert_allclose(mat(whole)[:, :, :11],
+                               mat(chunked)[:, :, :11],
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_prefill_chunk_pads_spill_to_scrap_not_neighbors():
+    """Pad positions past the slot's mapped pages land in the scrap
+    page — never in another sequence's pages."""
+    cfg, mesh, model, params = _tiny_model()
+    c = init_serving_cache(mesh, cfg.num_layers, 2, cfg.num_kv_heads,
+                           cfg.max_length, cfg.head_dim, cfg.dtype,
+                           page_size=4, pool_pages=12)
+    # slot 0 maps ONE page (4 positions); slot 1 owns pages 2..9
+    table = c.block_table.at[0, 0].set(1)
+    table = table.at[1].set(jnp.arange(2, 10, dtype=jnp.int32))
+    c = dataclasses.replace(c, block_table=table)
+    neighbor = np.asarray(c.k[:, 2:10]).copy()
+    view = dataclasses.replace(c, block_table=c.block_table[0:1],
+                               seq_lens=c.seq_lens[0:1])
+    ids = jnp.zeros((1, 8), jnp.int32)        # 4 real slots + 4 spill
+    _, view = model.prefill_chunk(params, view, ids, 0, 4)
+    merged = dataclasses.replace(c, k=view.k, v=view.v)
+    np.testing.assert_array_equal(np.asarray(merged.k[:, 2:10]), neighbor)
+
+
+def test_engine_prefill_validates_batch_up_front():
+    """ISSUE 6 satellite: the batch mismatch fails BEFORE tracing with
+    both values named, instead of an opaque downstream shape error."""
+    cfg, mesh, _, _ = _tiny_model()
+    eng = Engine.build(cfg, mesh, key=jax.random.key(0), batch=2)
+    with pytest.raises(ValueError, match="batch 3 does not match engine "
+                                         "batch 2"):
+        eng.prefill(jnp.zeros((3, 4), jnp.int32))
+    with pytest.raises(ValueError, match="batch 1 does not match"):
+        eng.serve(jnp.zeros((1, 4), jnp.int32), gen_len=2)
+
+
+# ---------------------------------------------------------------------------
+# CI wiring
+
+
+def test_tdt_lint_serve_smoke():
+    """The tier-1 CI hook (like the --timeline / --faults smokes): the
+    seeded 64-request overload trace with fault injection, zero leaked
+    pages, monotone drain, scheduler fault cells all
+    detected-or-survived."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tdt_lint.py"),
+         "--serve"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "serve OK" in proc.stdout
+    assert "DETECTED" in proc.stdout and "SURVIVED" in proc.stdout

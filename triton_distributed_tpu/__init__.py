@@ -53,3 +53,4 @@ from .layers import TPAttn, TPAttnParams, TPMLP, TPMLPParams, rms_norm
 from . import obs
 from . import analysis
 from . import resilience
+from . import serve

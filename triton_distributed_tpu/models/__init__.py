@@ -8,12 +8,15 @@ from .engine import Engine, sample_token
 from .kv_cache import (
     KVCache,
     PagedKVCache,
+    PagePoolExhausted,
     advance,
     append_paged,
     init_cache,
     init_paged_cache,
+    init_serving_cache,
     reset,
     with_length,
+    write_chunk_paged,
     write_prefill,
     write_prefill_paged,
 )

@@ -101,7 +101,18 @@ class Engine:
 
     ``cache_layout``: "contiguous" (one shared length) or "paged" (page
     pool + block table + ragged per-sequence lengths — the reference's
-    production decode layout, ``sp_flash_decode_layer.py:83-108``)."""
+    production decode layout, ``sp_flash_decode_layer.py:83-108``).
+
+    Two serving shapes (ISSUE 6 split the engine into stateless step
+    functions x a Python loop):
+
+    - :meth:`serve` — ONE fixed-shape batch end to end (prefill, then
+      lockstep decode); the engine-internal loop, donated buffers.
+    - :meth:`scheduler` — continuous batching: the engine hands its
+      stateless jit step functions to a ``serve.Scheduler`` whose loop
+      re-decides batch membership every iteration against an explicit
+      KV-page budget (admission control, chunked prefill, preemption,
+      per-sequence isolation — ``docs/serving.md``)."""
 
     model: Qwen3
     params: QwenParams
@@ -181,6 +192,32 @@ class Engine:
         params = model.init(key if key is not None else jax.random.key(0))
         return cls(model, params, batch=batch, **kw)
 
+    def scheduler(self, *, pool_pages: int | None = None,
+                  chunk_tokens: int = 64, config=None, **cfg_kw):
+        """The continuous-batching serving loop over this engine
+        (ROADMAP item 1; ``docs/serving.md``): the engine contributes
+        STATELESS, non-donated jit step functions (``Qwen3.decode`` /
+        ``Qwen3.prefill_chunk`` — shapes fixed, so batch-membership
+        changes never retrace), the returned ``serve.Scheduler`` owns
+        everything stateful: the bounded request queue, the KV-page
+        free list sized by ``pool_pages`` (the serving memory budget —
+        may deliberately UNDERsize ``batch * max_length`` to overcommit,
+        relying on preemption), chunked prefill at ``chunk_tokens``
+        per step, per-request deadlines, per-sequence failure
+        isolation, and degradation.  Requires ``cache_layout='paged'``.
+
+        ``config``: a full ``serve.SchedulerConfig``; or pass its
+        fields as ``**cfg_kw``.  ``Engine.serve`` remains the
+        single-batch path (one fixed-shape request end to end)."""
+        from ..serve import EngineBackend, Scheduler, SchedulerConfig
+
+        backend = EngineBackend(self, pool_pages=pool_pages,
+                                chunk_tokens=chunk_tokens)
+        if config is None:
+            cfg_kw.setdefault("prefill_chunk_tokens", chunk_tokens)
+            config = SchedulerConfig(**cfg_kw)
+        return Scheduler(backend, config)
+
     def set_decode_mode(self, mode: str) -> None:
         """Swap the decode-step reduction implementation in place (the
         reference's ``set_fwd`` switch, ``models/qwen.py:85``).  Params and
@@ -204,6 +241,16 @@ class Engine:
         ``tools/compile_aot.py:61-130`` + ``link_all:470``)."""
         max_len = self.model.config.max_length
         b, plen = input_ids.shape
+        # fail loudly BEFORE tracing: a batch mismatch used to surface
+        # as an opaque shape error deep in the jitted step (or, on the
+        # AOT path, a bucket sharding rejection)
+        if b != self.batch:
+            raise ValueError(
+                f"input_ids batch {b} does not match engine batch "
+                f"{self.batch} — the cache and compiled steps are shaped "
+                f"for batch={self.batch}; rebuild the engine or reshape "
+                f"the prompt batch"
+            )
         if plen > max_len:
             raise ValueError(
                 f"prompt length {plen} exceeds max_length={max_len}"

@@ -35,6 +35,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.mesh import TP_AXIS
 
 
+class PagePoolExhausted(RuntimeError):
+    """A sequence needs a KV page the pool cannot provide.
+
+    Raised by :func:`append_paged` when a sequence's write position has
+    outgrown its allocated pages (the write would otherwise scatter out
+    of range silently — JAX drops out-of-bounds scatter indices under
+    jit, which corrupts nothing but LOSES the token), and by the serving
+    page allocator (``serve.budget.PagePool``) when a free-list
+    allocation fails.  The continuous-batching scheduler catches it as
+    its PREEMPTION trigger: evict the lowest-priority sequence's pages
+    and park that request instead of failing the step.
+    """
+
+    def __init__(self, msg: str, *, sequences: tuple[int, ...] = (),
+                 needed: int = 0, available: int = 0):
+        self.sequences = tuple(sequences)
+        self.needed = int(needed)
+        self.available = int(available)
+        super().__init__(msg)
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class KVCache:
@@ -185,9 +206,33 @@ def append_paged(cache: PagedKVCache, layer: int, k_tok: jax.Array,
     """Write one decode token per sequence at its own (ragged) position
     ``seq_lens[b]``.  ``k_tok``/``v_tok``: (B, Hkv, D).  Does NOT advance
     ``seq_lens`` (mirror of the contiguous path: the model advances once
-    per step, after all layers)."""
+    per step, after all layers).
+
+    Bounds: a sequence whose position has outgrown its block table
+    (``seq_lens[b] >= max_pages * page_size``) has nowhere to put the
+    token — ``take_along_axis`` would clamp the page lookup and the
+    scatter would land in the WRONG page silently.  On the eager path
+    (concrete ``seq_lens``) this raises :class:`PagePoolExhausted`
+    naming the offending sequences instead; under jit the caller (the
+    serving scheduler's page-budget admission) must guarantee capacity
+    before dispatching the step — that invariant is exactly what
+    ``serve.budget.PagePool`` + preemption exist to maintain.
+    """
     ps = cache.page_size
     pos = cache.seq_lens
+    if not isinstance(pos, jax.core.Tracer):
+        limit = cache.max_pages * ps
+        over = [int(b) for b in
+                jnp.nonzero(pos >= limit)[0].tolist()]
+        if over:
+            raise PagePoolExhausted(
+                f"append_paged: sequence(s) {over} at position(s) "
+                f"{[int(pos[b]) for b in over]} have outgrown their "
+                f"block table ({cache.max_pages} pages x page_size {ps} "
+                f"= {limit} positions); the scatter would silently land "
+                f"out of range — allocate pages (or preempt) first",
+                sequences=tuple(over), needed=1, available=0,
+            )
     pages = jnp.take_along_axis(
         cache.block_table, (pos // ps)[:, None], axis=1
     )[:, 0]                                            # (B,)
@@ -200,4 +245,72 @@ def append_paged(cache: PagedKVCache, layer: int, k_tok: jax.Array,
 
     return dataclasses.replace(
         cache, k=scatter(cache.k, k_tok), v=scatter(cache.v, v_tok)
+    )
+
+
+def write_chunk_paged(cache: PagedKVCache, layer: int, k_new: jax.Array,
+                      v_new: jax.Array, start: jax.Array | int
+                      ) -> PagedKVCache:
+    """Scatter a prefill CHUNK's (B, Hkv, S, D) into the page pool at
+    positions [start, start+S) of every sequence — the chunked-prefill
+    generalization of :func:`write_prefill_paged` (which is the
+    ``start == 0`` whole-prompt case but needs page-aligned geometry).
+    ``start`` may be traced (one jitted chunk executable serves every
+    chunk position).  Positions are looked up per token through the
+    block table, so chunk boundaries need NOT be page-aligned.  Writes
+    whose position lands at or beyond ``max_pages * page_size`` are
+    DROPPED (JAX scatter out-of-bounds semantics) — the scheduler pads
+    the final chunk and masks the pads via ``seq_lens``."""
+    b, hk, s, d = k_new.shape
+    ps = cache.page_size
+    pos = jnp.asarray(start, jnp.int32) + jnp.arange(s, dtype=jnp.int32)
+    pages = jnp.take(cache.block_table, pos // ps, axis=1)   # (B, S)
+    offs = jnp.broadcast_to(pos % ps, (b, s))                # (B, S)
+    # out-of-range positions: redirect the page index out of the pool so
+    # the scatter drops them instead of clamping into a wrong page
+    npages = cache.k.shape[1]
+    pages = jnp.where(pos[None, :] < cache.max_pages * ps, pages, npages)
+
+    def scatter(pool, vals):
+        # advanced indices (pages, offs) around the head slice: target
+        # slots (B, S, Hkv, D)
+        return pool.at[layer, pages, :, offs].set(
+            vals.transpose(0, 2, 1, 3).astype(pool.dtype), mode="drop"
+        )
+
+    return dataclasses.replace(
+        cache, k=scatter(cache.k, k_new), v=scatter(cache.v, v_new)
+    )
+
+
+def init_serving_cache(mesh: Mesh, num_layers: int, slots: int,
+                       kv_heads: int, max_length: int, head_dim: int,
+                       dtype=jnp.bfloat16, axis: str = TP_AXIS, *,
+                       page_size: int = 64, pool_pages: int | None = None
+                       ) -> PagedKVCache:
+    """A :class:`PagedKVCache` for the continuous-batching scheduler:
+    the physical pool holds ``pool_pages`` pages (the serving KV-page
+    BUDGET — decoupled from ``slots * max_pages``, so the scheduler can
+    overcommit logical capacity and preempt under pressure), and the
+    block table starts all-zero: page 0 is the scheduler's reserved
+    SCRAP page (inactive slots write their garbage token there and read
+    it back masked), pages [1, pool_pages) belong to the free list
+    (``serve.budget.PagePool``)."""
+    if max_length % page_size:
+        raise ValueError(
+            f"max_length {max_length} not divisible by page_size {page_size}"
+        )
+    mp = max_length // page_size
+    if pool_pages is None:
+        pool_pages = slots * mp + 1
+    if pool_pages < 2:
+        raise ValueError(f"pool_pages {pool_pages} < 2 (page 0 is the "
+                         f"reserved scrap page)")
+    pool_shape = (num_layers, pool_pages, kv_heads, page_size, head_dim)
+    sharding = NamedSharding(mesh, P(None, None, axis, None, None))
+    return PagedKVCache(
+        k=jax.device_put(jnp.zeros(pool_shape, dtype), sharding),
+        v=jax.device_put(jnp.zeros(pool_shape, dtype), sharding),
+        block_table=jnp.zeros((slots, mp), jnp.int32),
+        seq_lens=jnp.zeros((slots,), jnp.int32),
     )

@@ -56,6 +56,7 @@ from .kv_cache import (
     PagedKVCache,
     advance,
     with_length,
+    write_chunk_paged,
     write_prefill,
     write_prefill_paged,
 )
@@ -300,6 +301,122 @@ class Qwen3:
             logits.reshape(b, s, c.vocab),
             with_length(cache, s if true_len is None else true_len),
         )
+
+    # -- chunked prefill (serving scheduler path) --------------------------
+
+    def _heads_from_qkv(self, qkv: jax.Array, b: int, s: int):
+        """Split a (B, S, (H+2Hk)*D) qkv projection whose feature dim is
+        RANK-BLOCKED ``[q_r | k_r | v_r]`` per TP rank (the layout
+        ``ag_gemm`` produces and the head-sharded cache consumes) into
+        (B, S, H, D) / (B, S, Hk, D) / (B, S, Hk, D) with rank-major
+        global head order — the same order the cache's sharded head axis
+        holds, so chunk-written K/V and fused-prefill K/V interleave
+        correctly."""
+        c = self.config
+        n = self.tp
+        hl, hkl, d = c.num_heads // n, c.num_kv_heads // n, c.head_dim
+        t = qkv.reshape(b, s, n, (hl + 2 * hkl) * d)
+        q = t[..., :hl * d].reshape(b, s, n * hl, d)
+        k = t[..., hl * d:(hl + hkl) * d].reshape(b, s, n * hkl, d)
+        v = t[..., (hl + hkl) * d:].reshape(b, s, n * hkl, d)
+        return q, k, v
+
+    def prefill_chunk(self, params: QwenParams, cache: PagedKVCache,
+                      input_ids: jax.Array, start: jax.Array | int,
+                      true_len: jax.Array | int | None = None):
+        """Prefill ONE chunk of a prompt against the paged pool: write
+        this chunk's K/V at positions [start, start+S) through the block
+        table, attend each chunk query over the CACHED PREFIX plus the
+        chunk (causal), and return (logits (B, S, V), cache) with
+        ``seq_lens`` set to ``start + true_len``.
+
+        This is the serving scheduler's admission path
+        (``serve.EngineBackend``): a long prompt is fed in fixed-size
+        chunks interleaved with in-flight decode steps, so one arrival
+        cannot stall cohabitants for its whole prompt.  ``start`` and
+        ``true_len`` are traceable scalars — ONE jitted executable
+        serves every (chunk position, pad amount), the same
+        pad-and-mask contract bucketed AOT prefill uses.  Pad positions
+        write garbage K/V beyond ``start + true_len``; the next chunk
+        (or the first decode append) overwrites them and ``seq_lens``
+        masks them meanwhile — and any position past the mapped pages
+        lands in the slot view's scrap page, never in a neighbor.
+
+        Implementation note: plain jnp (GSPMD inserts the TP
+        reductions) rather than the fused AG-GEMM/flash path — the
+        chunk path optimizes for retrace-freedom and prefix attention
+        through the block table, not peak prefill flops; whole-prompt
+        admission still uses the fused :meth:`prefill`.  Dense MLP
+        only (MoE prompts prefill whole)."""
+        c = self.config
+        if c.is_moe:
+            raise NotImplementedError(
+                "prefill_chunk supports the dense MLP path; MoE prompts "
+                "prefill whole via Qwen3.prefill")
+        b, s = input_ids.shape
+        n = self.tp
+        d = c.head_dim
+        start = jnp.asarray(start, jnp.int32)
+        tl = jnp.asarray(s if true_len is None else true_len, jnp.int32)
+        pos = start + jnp.arange(s, dtype=jnp.int32)          # (S,)
+        x = params.embed[input_ids]                           # (B, S, K)
+        max_len = cache.max_pages * cache.page_size
+
+        for li, lp in enumerate(params.layers):
+            h = rms_norm(x, lp.ln1, c.rms_eps)
+            qkv = jnp.dot(h, lp.attn.wqkv,
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+            q, k, v = self._heads_from_qkv(qkv, b, s)
+            if c.qk_norm:
+                q = rms_norm(q, lp.attn.q_norm, c.rms_eps)
+                k = rms_norm(k, lp.attn.k_norm, c.rms_eps)
+            # (B, H, S, D) for rope-at-positions, then the pool write
+            q = apply_rope_at(q.transpose(0, 2, 1, 3), pos,
+                              theta=c.rope_theta)
+            k = apply_rope_at(k.transpose(0, 2, 1, 3), pos,
+                              theta=c.rope_theta)
+            v = v.transpose(0, 2, 1, 3)
+            cache = write_chunk_paged(cache, li, k, v, start)
+            # prefix attention through the block table: materialize the
+            # slot's logical [0, max_len) K/V (chunk included — it was
+            # just written) and mask causally at absolute positions
+            kc = cache.k[li][cache.block_table]     # (B, mp, Hk, ps, D)
+            vc = cache.v[li][cache.block_table]
+            kc = kc.transpose(0, 2, 1, 3, 4).reshape(
+                b, c.num_kv_heads, max_len, d)
+            vc = vc.transpose(0, 2, 1, 3, 4).reshape(
+                b, c.num_kv_heads, max_len, d)
+            rep = c.num_heads // c.num_kv_heads
+            kc = jnp.repeat(kc, rep, axis=1)
+            vc = jnp.repeat(vc, rep, axis=1)
+            scores = jnp.einsum(
+                "bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                kc.astype(jnp.float32)) * (d ** -0.5)
+            causal = (jnp.arange(max_len, dtype=jnp.int32)[None, :]
+                      <= pos[:, None])                       # (S, L)
+            scores = jnp.where(causal[None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            attn = jnp.einsum("bhqk,bhkd->bhqd", probs, vc)
+            attn = attn.transpose(0, 2, 1, 3).reshape(
+                b, s, c.num_heads * d)
+            x = x + jnp.dot(attn, lp.attn.wo,
+                            preferred_element_type=jnp.float32
+                            ).astype(x.dtype)
+            # dense MLP, rank-blocked [gate_r | up_r] feature layout
+            h2 = rms_norm(x, lp.ln2, c.rms_eps)
+            fused = jnp.dot(h2, lp.mlp.gate_up,
+                            preferred_element_type=jnp.float32
+                            ).astype(x.dtype)
+            t = fused.reshape(b, s, n, 2, c.intermediate // n)
+            act = (jax.nn.silu(t[..., 0, :]) * t[..., 1, :]).reshape(
+                b, s, c.intermediate)
+            x = x + jnp.dot(act, lp.mlp.down,
+                            preferred_element_type=jnp.float32
+                            ).astype(x.dtype)
+        x = rms_norm(x, params.final_norm, c.rms_eps)
+        logits = jnp.dot(x, params.lm_head,
+                         preferred_element_type=jnp.float32)
+        return logits, with_length(cache, start + tl)
 
     # -- decode -----------------------------------------------------------
 

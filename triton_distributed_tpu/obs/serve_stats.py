@@ -225,9 +225,18 @@ class ServeStats:
         self.request_ms = QuantileSketch(alpha)
         self.prefill_ms = QuantileSketch(alpha)
         self.decode_ms_per_token = QuantileSketch(alpha)
+        # scheduler-plane SLO sketches (ISSUE 6): time-to-first-token
+        # measured submit -> first sampled token (queue wait included —
+        # that IS the saturation signal)
+        self.ttft_ms = QuantileSketch(alpha)
         self.tokens = WindowedRate(window_s)
         self.requests = WindowedRate(window_s)
         self.failed_requests = WindowedRate(window_s)
+        # overload-behavior counters: sheds (admission rejected),
+        # preemptions (pages evicted, request parked + recomputed)
+        self.sheds = WindowedRate(window_s)
+        self.preemptions = WindowedRate(window_s)
+        self.evicted_pages = WindowedRate(window_s)
         self._wire: dict[str, WindowedRate] = {}
         self._queue_depth = 0
         self._gauges: dict[str, float] = {}
@@ -259,6 +268,31 @@ class ServeStats:
         self.request_ms.observe(prefill + per_tok * decode_steps)
         self.tokens.add(float(gen_len) * max(int(batch), 1))
 
+    # -- scheduler feeds (serve.Scheduler; gated on obs.enabled() there) ---
+
+    def observe_ttft(self, ms: float) -> None:
+        self.ttft_ms.observe(float(ms))
+
+    def request_completed(self, e2e_ms: float, *, tokens: int = 0) -> None:
+        """One scheduler-completed request: end-to-end latency (submit
+        -> last token) into the request sketch; the per-step token feed
+        happens at decode time, not here."""
+        self.request_ms.observe(float(e2e_ms))
+        self.requests.add(1.0)
+        del tokens   # tokens ride the per-step feed; kept for call shape
+
+    def request_failed(self) -> None:
+        self.requests.add(1.0)
+        self.failed_requests.add(1.0)
+
+    def request_shed(self) -> None:
+        self.sheds.add(1.0)
+
+    def request_preempted(self, *, pages: int = 0) -> None:
+        self.preemptions.add(1.0)
+        if pages:
+            self.evicted_pages.add(float(pages))
+
     def observe_collective(self, op: str, *, wire_bytes: float) -> None:
         r = self._wire.get(op)
         if r is None:
@@ -288,11 +322,17 @@ class ServeStats:
             "request_ms": self.request_ms.to_dict(),
             "prefill_ms": self.prefill_ms.to_dict(),
             "decode_ms_per_token": self.decode_ms_per_token.to_dict(),
+            "ttft_ms": self.ttft_ms.to_dict(),
             "tokens_per_s_window": self.tokens.rate(),
             "requests_per_s_window": self.requests.rate(),
             "failed_requests_per_s_window": self.failed_requests.rate(),
+            "sheds_per_s_window": self.sheds.rate(),
+            "preemptions_per_s_window": self.preemptions.rate(),
             "tokens_total": self.tokens.total,
             "requests_total": self.requests.total,
+            "sheds_total": self.sheds.total,
+            "preemptions_total": self.preemptions.total,
+            "evicted_pages_total": self.evicted_pages.total,
             "wire_bytes_per_s_window": {
                 op: r.rate() for op, r in sorted(wire.items())
             },
@@ -316,6 +356,7 @@ class ServeStats:
         sk("serve_request_ms", self.request_ms)
         sk("serve_prefill_ms", self.prefill_ms)
         sk("serve_decode_ms_per_token", self.decode_ms_per_token)
+        sk("serve_ttft_ms", self.ttft_ms)
 
         def g(name: str, v: float) -> None:
             lines.append(f"# TYPE {name} gauge")
@@ -325,6 +366,11 @@ class ServeStats:
         g("serve_tokens_per_s_window", self.tokens.rate())
         g("serve_requests_per_s_window", self.requests.rate())
         g("serve_failed_requests_per_s_window", self.failed_requests.rate())
+        g("serve_sheds_per_s_window", self.sheds.rate())
+        g("serve_preemptions_per_s_window", self.preemptions.rate())
+        g("serve_sheds_total", self.sheds.total)
+        g("serve_preemptions_total", self.preemptions.total)
+        g("serve_evicted_pages_total", self.evicted_pages.total)
         with self._lock:
             wire = dict(self._wire)
             gauges = dict(self._gauges)

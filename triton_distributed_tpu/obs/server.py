@@ -19,6 +19,16 @@
   events form one stream) rendered as the per-collective attribution
   table, best-effort: a ring the credit replay cannot complete reports
   ``pending`` instead of erroring.
+- ``GET /debug/serve``   — the live serve-stats snapshot plus, when the
+  registered health source is a continuous-batching scheduler
+  (``serve.Scheduler`` — it exposes ``debug_state()``), its queue /
+  page-pool / slot / degradation-governor state.
+
+The health source registered via ``maybe_start`` / ``register_engine``
+may be an :class:`~..models.engine.Engine` or a
+:class:`~..serve.Scheduler` — anything with ``health()`` whose snapshot
+carries ``status``; ``/healthz`` answers 503 whenever that status is
+not ``"ok"`` (open breaker, sustained scheduler saturation).
 
 Everything is read-only and unauthenticated — bind is loopback-only by
 default (``TDT_OBS_HTTP_HOST`` overrides for pod networks).  With
@@ -94,11 +104,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(self._telemetry().timeline_dump(),
                                            default=str),
                            "application/json")
+            elif path == "/debug/serve":
+                self._send(200, json.dumps(self._telemetry().serve_dump(),
+                                           default=str),
+                           "application/json")
             else:
                 self._send(404, json.dumps({
                     "error": f"unknown path {path!r}",
                     "endpoints": ["/metrics", "/healthz", "/debug/flight",
-                                  "/debug/timeline"],
+                                  "/debug/timeline", "/debug/serve"],
                 }), "application/json")
         except BrokenPipeError:
             pass
@@ -162,6 +176,20 @@ class TelemetryServer:
             snap = resilience.health_snapshot()
         code = 200 if snap.get("status") == "ok" else 503
         return code, snap
+
+    def serve_dump(self) -> dict:
+        """The scheduler inspection endpoint (``/debug/serve``): the
+        live serve-stats snapshot plus — when the registered health
+        source is a scheduler (or anything exposing ``debug_state()``)
+        — its queue / pool / slot / governor state."""
+        from . import serve_stats
+
+        out: dict = {"serve_stats": serve_stats.STATS.snapshot()}
+        src = self._engine_ref()
+        debug = getattr(src, "debug_state", None)
+        if callable(debug):
+            out["scheduler"] = debug()
+        return out
 
     def flight_dump(self, n: int = 256) -> dict:
         from . import flight

@@ -51,9 +51,15 @@ from .faults import (
     sample_spec,
     scoped,
 )
-from .matrix import run_matrix, verify_matrix
+from .matrix import (
+    run_matrix,
+    run_scheduler_matrix,
+    verify_matrix,
+    verify_scheduler_matrix,
+)
 from .policy import (
     DEFAULT_POLICY,
+    AdmissionGovernor,
     CircuitBreaker,
     RetryPolicy,
     breaker,
@@ -66,15 +72,17 @@ from .simulate import SimResult, check_hazards, clean_ticks, run_bounded
 from .watchdog import call_with_deadline, deadline_ms, protocol_pending
 
 __all__ = [
-    "CircuitBreaker", "CircuitOpenError", "CollectiveTimeoutError",
+    "AdmissionGovernor", "CircuitBreaker", "CircuitOpenError",
+    "CollectiveTimeoutError",
     "DEFAULT_POLICY", "FAULT_KINDS", "FaultKind", "FaultScope", "FaultSpec",
     "FaultyTraces", "PendingWait", "RankAborted", "RetryPolicy", "SimResult",
     "TimeoutDiagnosis", "breaker", "call_with_deadline", "check_hazards",
     "clean_ticks", "deadline_ms", "enable", "enabled", "fallbacks", "faults",
     "guarded", "health_snapshot", "matrix", "policy", "protocol_pending",
     "record_faulty_case", "reset_breaker", "resilient_call", "run_bounded",
-    "run_matrix", "sample_spec", "scoped", "simulate", "suppress",
-    "suppressed_thunk", "verify_matrix", "watchdog",
+    "run_matrix", "run_scheduler_matrix", "sample_spec", "scoped",
+    "simulate", "suppress", "suppressed_thunk", "verify_matrix",
+    "verify_scheduler_matrix", "watchdog",
 ]
 
 

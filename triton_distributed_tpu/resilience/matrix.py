@@ -113,6 +113,173 @@ def run_case(case, kind: FaultKind, rng) -> dict | None:
     return row
 
 
+# -- scheduler cells (ISSUE 6): the PR-3 whole-batch isolation story at
+# per-SEQUENCE granularity.  Each cell drives the REAL continuous-
+# batching scheduler (serve.Scheduler over the deterministic
+# SimBackend, which runs the real paged-cache plumbing headlessly) with
+# a fault injected mid-decode under a multi-request load, then
+# classifies:
+#
+#   detected  — the victim request FAILED with the fault named in its
+#               error, every cohabitant completed, and the page pool
+#               drained to zero (per-request isolation held);
+#   survived  — the fault was absorbed (straggler within deadline
+#               slack): everything completed, zero pages leaked.
+#
+# Anything else — a cohabitant failing, a leaked page, a hung drain —
+# is an isolation breach ``verify_scheduler_matrix`` turns into a CI
+# problem.
+
+SCHED_FAULTS = (FaultKind.RANK_ABORT, FaultKind.STRAGGLER)
+
+
+class _SchedInjector:
+    """One-shot decode-step fault hook for the SimBackend."""
+
+    def __init__(self, kind: FaultKind, at_step: int, *,
+                 delay_s: float = 0.0, rank: int = 0):
+        self.kind = kind
+        self.at_step = at_step
+        self.delay_s = delay_s
+        self.rank = rank
+        self.fired = False
+
+    def __call__(self, step: int) -> None:
+        if self.fired or step != self.at_step:
+            return
+        self.fired = True   # set BEFORE acting: an abandoned straggler
+        # thread must not re-fire on the retry dispatch
+        if self.kind is FaultKind.RANK_ABORT:
+            from .faults import RankAborted
+
+            raise RankAborted(self.rank, step)
+        if self.kind is FaultKind.STRAGGLER:
+            import time
+
+            time.sleep(self.delay_s)
+
+
+def _sched_cell(kind: FaultKind, leg: str, rng) -> dict:
+    """One scheduler matrix cell: seeded 12-request load on 3 slots
+    over a 24-page pool, fault injected at a sampled decode step."""
+    import time as _time
+
+    from ..serve import (
+        RequestState, Scheduler, SchedulerConfig, SimBackend, replay,
+        synthetic_trace,
+    )
+
+    at_step = rng.randint(2, 6)
+    # straggler legs: "slack" delays well inside the request deadline
+    # (absorbed); "overrun" delays past it (the watchdog converts the
+    # stall into a CollectiveTimeoutError naming the step)
+    delay_s = {"slack": 0.05, "overrun": 0.4}.get(leg, 0.0)
+    deadline_ms = 250.0 if leg == "overrun" else 10_000.0
+    inj = _SchedInjector(kind, at_step, delay_s=delay_s,
+                         rank=rng.randrange(4))
+    backend = SimBackend(slots=3, page_size=4, pool_pages=24,
+                         max_length=48, step_hook=inj)
+    sched = Scheduler(backend, SchedulerConfig(
+        max_queue_depth=32, step_deadline_floor_ms=25.0))
+    arrivals = synthetic_trace(rng.randrange(1 << 16), 12,
+                               mean_interarrival_steps=0.5,
+                               prompt_len=(2, 8), max_new=(3, 8))
+    if kind is FaultKind.STRAGGLER and leg == "overrun":
+        # exactly one deadline-carrying request: the designated victim —
+        # the watchdog budget binds to it, so the breach is attributable.
+        # Pinned LONG so it is still mid-decode when the injection step
+        # arrives (a short request finishing first would leave the step
+        # unbounded and the straggle absorbed)
+        arrivals[0].request.deadline_ms = deadline_ms
+        arrivals[0].request.max_new_tokens = 24
+    t0 = _time.monotonic()
+    report = replay(sched, arrivals, max_steps=4000)
+    if kind is FaultKind.STRAGGLER and leg == "overrun":
+        # the watchdog ABANDONED the straggling dispatch thread (by
+        # design); let it wake from its sleep and finish its discarded
+        # step while the runtime is alive — a zombie still inside an
+        # eager op at interpreter shutdown aborts the process in XLA
+        # teardown
+        _time.sleep(delay_s + 0.1)
+    row = {
+        "kernel": "serve/scheduler", "fault": kind.value, "leg": leg,
+        "at_step": at_step, "fired": inj.fired,
+        "requests": len(report.requests),
+        "completed": len(report.completed),
+        "failed": len(report.failed),
+        "shed": len(report.shed),
+        "pages_leaked": report.leaked_pages,
+        "drain_monotone": report.drain_monotone,
+        "wall_s": round(_time.monotonic() - t0, 3),
+    }
+    problems = report.problems()
+    victims = report.failed
+    cohab_ok = all(
+        r.state in (RequestState.DONE, RequestState.SHED)
+        for r in report.requests if r not in victims
+    )
+    if victims and cohab_ok and not problems:
+        row["outcome"] = "detected"
+        row["named"] = sorted({(r.error or "").split(":")[0]
+                               for r in victims})
+        row["detail"] = (f"victim(s) {[r.req_id for r in victims]} "
+                         f"failed isolated; "
+                         f"{row['completed']} cohabitants completed")
+    elif not victims and not problems and inj.fired:
+        row["outcome"] = "survived"
+        row["named"] = []
+        row["detail"] = (f"fault absorbed; all {row['completed']} "
+                         f"requests completed, zero pages leaked")
+    else:
+        row["outcome"] = "unisolated"
+        row["named"] = []
+        row["detail"] = "; ".join(problems) or \
+            "cohabitant failure alongside the victim"
+    return row
+
+
+def run_scheduler_matrix(seed: int = 0) -> list[dict]:
+    """The scheduler cells: rank_abort mid-decode, straggler within
+    slack, straggler past the victim's deadline."""
+    rng = random.Random(seed)
+    return [
+        _sched_cell(FaultKind.RANK_ABORT, "abort", rng),
+        _sched_cell(FaultKind.STRAGGLER, "slack", rng),
+        _sched_cell(FaultKind.STRAGGLER, "overrun", rng),
+    ]
+
+
+def verify_scheduler_matrix(rows: list[dict]) -> list[str]:
+    """CI problems in the scheduler cells (empty = pass): every
+    injection must land, per-request isolation must hold, rank aborts
+    and deadline overruns must be DETECTED (a silently-absorbed dead
+    rank would mean the victim's garbage tokens shipped)."""
+    problems = []
+    for row in rows:
+        key = f"{row['kernel']} x {row['fault']}/{row['leg']}"
+        if not row["fired"]:
+            problems.append(f"{key}: injection never reached its decode "
+                            f"step (at_step={row['at_step']})")
+            continue
+        if row["outcome"] == "unisolated":
+            problems.append(f"{key}: isolation breach — {row['detail']}")
+        if row["pages_leaked"]:
+            problems.append(f"{key}: {row['pages_leaked']} page(s) leaked")
+        if row["leg"] in ("abort", "overrun") and \
+                row["outcome"] != "detected":
+            problems.append(
+                f"{key}: expected a detected+isolated victim, got "
+                f"{row['outcome']!r} — the fault was absorbed silently")
+        if row["leg"] == "slack" and row["outcome"] != "survived":
+            problems.append(
+                f"{key}: an in-slack straggler should be absorbed, got "
+                f"{row['outcome']!r}")
+        if row["outcome"] == "detected" and not row["named"]:
+            problems.append(f"{key}: detected but the victim's error "
+                            f"names no fault class")
+    return problems
+
+
 def run_matrix(seed: int = 0, *, kernels=DEFAULT_KERNELS, ranks: int = 4
                ) -> list[dict]:
     """The full (kernel x fault class) sweep; rows sorted by kernel."""

@@ -191,6 +191,92 @@ def guarded(op: str, thunk, *, fallback=None, payload_bytes: int = 0,
     return run
 
 
+class AdmissionGovernor:
+    """Scheduler-aware graceful degradation: shrink ADMISSION instead
+    of failing requests.
+
+    The serving scheduler (``serve.scheduler``) consults this before
+    admitting: under preemption THRASH (a window where evictions keep
+    recurring — every preemption burns a full prompt recompute, so a
+    thrashing pool does negative work) or with the serve-step circuit
+    breaker OPEN, the governor raises its degradation level, which
+    (a) caps concurrent slots at ``slots >> level`` and (b) demands
+    ``2^level - 1`` extra free pages of admission headroom.  Clean
+    steps decay the level back to zero — admission RE-GROWS as pressure
+    clears, the inverse ramp of how it shrank.  Deterministic: levels
+    move on step counts, not wall time, so seeded load tests replay.
+    """
+
+    def __init__(self, *, window_steps: int = 16,
+                 thrash_threshold: int = 3, max_level: int = 3,
+                 recover_steps: int = 8, min_slots: int = 1,
+                 breaker_op: str = "serve_decode_step"):
+        self.window_steps = int(window_steps)
+        self.thrash_threshold = int(thrash_threshold)
+        self.max_level = int(max_level)
+        self.recover_steps = int(recover_steps)
+        self.min_slots = int(min_slots)
+        self.breaker_op = breaker_op
+        self.level = 0
+        self._window: list[int] = []     # preemptions per recent step
+        self._pending_preempts = 0
+        self._clean_steps = 0
+
+    def note_preemption(self) -> None:
+        self._pending_preempts += 1
+
+    def note_step_failure(self) -> None:
+        # a failed dispatch is pressure too: count it like a preemption
+        self._pending_preempts += 1
+
+    def note_step_ok(self) -> None:
+        self._window.append(self._pending_preempts)
+        self._pending_preempts = 0
+        del self._window[:-self.window_steps]
+        if sum(self._window) >= self.thrash_threshold:
+            if self.level < self.max_level:
+                self.level += 1
+                from .. import obs
+
+                if obs.enabled():
+                    obs.counter("serve_admission_degraded").inc()
+            self._window.clear()
+            self._clean_steps = 0
+        elif self._window and self._window[-1] == 0:
+            self._clean_steps += 1
+            if self._clean_steps >= self.recover_steps and self.level:
+                self.level -= 1
+                self._clean_steps = 0
+        else:
+            self._clean_steps = 0
+
+    def _effective_level(self) -> int:
+        if breaker(self.breaker_op).open:
+            return self.max_level
+        return self.level
+
+    def headroom_pages(self) -> int:
+        """Extra free pages admission must leave at the current level."""
+        return (1 << self._effective_level()) - 1
+
+    def slot_cap(self, slots: int) -> int:
+        """Concurrent-sequence cap at the current level."""
+        return max(self.min_slots, slots >> self._effective_level())
+
+    def degraded(self) -> bool:
+        return self._effective_level() > 0
+
+    def snapshot(self) -> dict:
+        return {
+            "level": self.level,
+            "effective_level": self._effective_level(),
+            "breaker_open": breaker(self.breaker_op).open,
+            "recent_preemptions": sum(self._window)
+            + self._pending_preempts,
+            "headroom_pages": self.headroom_pages(),
+        }
+
+
 def health_snapshot() -> dict:
     """Point-in-time serving-health view: breaker states, last errors,
     and the resilience counters — the engine's ``/health`` payload."""

@@ -1,0 +1,38 @@
+"""Overload-safe continuous-batching serving over the paged KV cache.
+
+The layer above the kernels: ROADMAP item 1.  The engine's jitted step
+functions stay STATELESS (shapes fixed, values per-step — membership
+changes never retrace); everything stateful — the bounded admission
+queue, the KV-page free list, chunked prefill, preemption, per-sequence
+failure isolation, deadline enforcement, degradation, telemetry — lives
+in the Python scheduler loop here.  ``docs/serving.md`` is the
+operator-facing spec (policies, env knobs, SLO metric names).
+
+Quick shape::
+
+    from triton_distributed_tpu import serve
+
+    sched = engine.scheduler(pool_pages=4096)   # or serve.Scheduler(
+    sched.submit(serve.Request(prompt=ids,      #   serve.SimBackend())
+                 max_new_tokens=128, priority=1,
+                 deadline_ms=30_000))
+    while not sched.step().idle:
+        pass
+"""
+
+from __future__ import annotations
+
+from ..models.kv_cache import PagePoolExhausted
+from .backends import EngineBackend, SimBackend
+from .budget import SCRAP_PAGE, PagePool, pages_needed
+from .queue import Request, RequestQueue, RequestState, TERMINAL_STATES
+from .scheduler import Scheduler, SchedulerConfig, SlotState, StepResult
+from .trace import Arrival, TraceReport, replay, synthetic_trace
+
+__all__ = [
+    "Arrival", "EngineBackend", "PagePool", "PagePoolExhausted",
+    "Request", "RequestQueue", "RequestState", "SCRAP_PAGE", "Scheduler",
+    "SchedulerConfig", "SimBackend", "SlotState", "StepResult",
+    "TERMINAL_STATES", "TraceReport", "pages_needed", "replay",
+    "synthetic_trace",
+]

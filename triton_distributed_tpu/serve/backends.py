@@ -1,0 +1,233 @@
+"""Execution backends the scheduler drives.
+
+The scheduler (``serve.scheduler``) is pure host logic over a
+:class:`~..models.kv_cache.PagedKVCache`: admission, page budgeting,
+preemption, isolation.  What actually computes a step is a backend with
+two entry points:
+
+- ``prefill_chunk(cache, pages_row, chunk, start, total_len)`` — write
+  one prompt chunk's K/V into the pages mapped for one slot; when the
+  chunk completes the prompt, also return the first generated token.
+- ``decode(cache, tokens)`` — one batched decode step over every slot
+  (inactive slots carry the scrap-page row and produce ignored tokens);
+  returns the updated cache and the per-slot next token.
+
+Two implementations:
+
+- :class:`EngineBackend` — the real model: jit-compiled STATELESS step
+  functions over ``Qwen3.decode`` / ``Qwen3.prefill_chunk`` with the
+  cache NOT donated.  Non-donation is deliberate: a failed step must
+  leave the pre-step cache intact so cohabitant sequences survive a
+  victim's fault (per-sequence failure isolation) — the scheduler pays
+  one pool copy per step for recoverability.  Membership changes only
+  change block-table/seq-lens VALUES, never shapes, so the step never
+  retraces.
+- :class:`SimBackend` — a deterministic token automaton over the SAME
+  real paged-cache plumbing (``write_chunk_paged`` / ``append_paged``),
+  no model, no Pallas, no shard_map: the headless backend the fault
+  matrix, ``tdt_lint --serve`` and the CI load tests run on any box.
+  K/V values are the token ids themselves, so a test can materialize a
+  sequence's pages and assert they hold exactly its token history —
+  the strongest cheap evidence that cohabitants were not corrupted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.kv_cache import (
+    PagedKVCache,
+    advance,
+    append_paged,
+    init_serving_cache,
+    write_chunk_paged,
+)
+
+
+def _slot_view(cache: PagedKVCache, pages_row: np.ndarray,
+               length: int) -> PagedKVCache:
+    """A batch-1 view of one slot over the SHARED pools: the slot's own
+    block-table row (host truth — the device table may be pointing
+    non-decode slots at the scrap page) and its current length."""
+    mp = cache.max_pages
+    row = np.zeros((1, mp), np.int32)
+    row[0, :len(pages_row)] = pages_row
+    return dataclasses.replace(
+        cache,
+        block_table=jnp.asarray(row),
+        seq_lens=jnp.asarray([length], jnp.int32),
+    )
+
+
+def _merge_pools(cache: PagedKVCache, view: PagedKVCache) -> PagedKVCache:
+    """Adopt the pools a slot view updated; table/lens stay the
+    scheduler's."""
+    return dataclasses.replace(cache, k=view.k, v=view.v)
+
+
+class SimBackend:
+    """Deterministic serving automaton over a real paged cache.
+
+    Token rule: the next token is a fixed hash of (input token, new
+    length) — a function of the prompt alone by induction, so a
+    preempted request deterministically recomputes the SAME tokens from
+    its prompt, which is exactly the recovery contract the scheduler
+    promises.  K/V writes carry the input token's value into every
+    (layer, head, dim) slot of its position.
+
+    ``step_hook(step_index)``: called at the top of every decode
+    dispatch — the fault matrix's injection point (raise
+    ``RankAborted`` to simulate a dead rank mid-step, ``time.sleep`` to
+    straggle past a deadline).
+    """
+
+    def __init__(self, *, slots: int = 4, page_size: int = 4,
+                 pool_pages: int = 32, max_length: int = 64,
+                 num_layers: int = 1, kv_heads: int = 1, head_dim: int = 8,
+                 vocab: int = 101, step_hook=None):
+        from ..core import mesh as mesh_lib
+        from ..core.mesh import TP_AXIS, make_mesh
+
+        self.slots = int(slots)
+        self.page_size = int(page_size)
+        self.pool_pages = int(pool_pages)
+        self.max_length = int(max_length)
+        self.num_layers = int(num_layers)
+        self.kv_heads = int(kv_heads)
+        self.head_dim = int(head_dim)
+        self.vocab = int(vocab)
+        self.step_hook = step_hook
+        self._mesh = make_mesh({TP_AXIS: 1}, devices=jax.devices()[:1])
+        self._step = 0
+        del mesh_lib
+
+    def make_cache(self) -> PagedKVCache:
+        return init_serving_cache(
+            self._mesh, self.num_layers, self.slots, self.kv_heads,
+            self.max_length, self.head_dim, jnp.float32,
+            page_size=self.page_size, pool_pages=self.pool_pages,
+        )
+
+    def next_token(self, tok: int, new_len: int) -> int:
+        """The deterministic generation rule (public: tests replay it)."""
+        return (int(tok) * 31 + int(new_len) * 7 + 13) % self.vocab
+
+    def prefill_chunk(self, cache: PagedKVCache, pages_row, chunk,
+                      start: int, total_len: int):
+        chunk = np.asarray(chunk, np.int32)
+        view = _slot_view(cache, pages_row, start)
+        vals = jnp.broadcast_to(
+            jnp.asarray(chunk, jnp.float32)[None, None, :, None],
+            (1, self.kv_heads, len(chunk), self.head_dim),
+        )
+        for layer in range(self.num_layers):
+            view = write_chunk_paged(view, layer, vals, vals, start)
+        cache = _merge_pools(cache, view)
+        first = None
+        if start + len(chunk) == total_len:
+            first = self.next_token(int(chunk[-1]), total_len)
+        return cache, first
+
+    def decode(self, cache: PagedKVCache, tokens):
+        # counter moves BEFORE the hook: a raising hook must not pin the
+        # step index and re-fire on the retry dispatch
+        step = self._step
+        self._step += 1
+        if self.step_hook is not None:
+            self.step_hook(step)
+        tokens = np.asarray(tokens, np.int32)
+        tok = jnp.asarray(tokens)
+        vals = jnp.broadcast_to(
+            tok.astype(jnp.float32)[:, None, None],
+            (self.slots, self.kv_heads, self.head_dim),
+        )
+        for layer in range(self.num_layers):
+            cache = append_paged(cache, layer, vals, vals)
+        cache = advance(cache, 1)
+        new_lens = np.asarray(cache.seq_lens)
+        nxt = np.asarray(
+            [self.next_token(t, int(l)) for t, l in zip(tokens, new_lens)],
+            np.int32,
+        )
+        return cache, nxt
+
+
+class EngineBackend:
+    """The real-model backend: stateless jitted step functions from the
+    engine's Qwen3 model, non-donated (see module docstring), one
+    executable per (chunk bucket) + one decode executable — membership
+    changes never retrace.
+
+    ``chunk_tokens`` fixes the prefill chunk bucket: every chunk is
+    right-padded to it and masked via ``true_len`` (the same
+    pad-and-mask contract ``Engine.precompile`` uses for prompt
+    buckets), so chunked prefill compiles exactly ONE executable.
+    Sampling is greedy — the deterministic-recompute contract
+    preemption relies on.
+    """
+
+    def __init__(self, engine, *, pool_pages: int | None = None,
+                 chunk_tokens: int = 64):
+        if engine.cache_layout != "paged":
+            raise ValueError(
+                "EngineBackend needs cache_layout='paged'; this engine "
+                f"has {engine.cache_layout!r}")
+        c = engine.model.config
+        if c.is_moe:
+            raise NotImplementedError(
+                "chunked prefill supports the dense MLP path; MoE "
+                "serving prefills whole prompts through Engine.serve")
+        self.engine = engine
+        self.model = engine.model
+        self.slots = int(engine.batch)
+        self.page_size = int(engine.page_size)
+        self.max_length = int(c.max_length)
+        self.num_layers = int(c.num_layers)
+        self.vocab = int(c.vocab)
+        self.chunk_tokens = int(chunk_tokens)
+        mp = self.max_length // self.page_size
+        self.pool_pages = int(pool_pages) if pool_pages is not None \
+            else self.slots * mp + 1
+        # stateless, NON-donated step executables (models/engine.py
+        # refactor): values of table/lens/tokens change per step, shapes
+        # never do — one trace each for the scheduler's whole lifetime
+        self._decode = jax.jit(self.model.decode)
+        self._prefill_chunk = jax.jit(self.model.prefill_chunk)
+
+    def make_cache(self) -> PagedKVCache:
+        c = self.model.config
+        return init_serving_cache(
+            self.model.mesh, c.num_layers, self.slots, c.num_kv_heads,
+            c.max_length, c.head_dim, c.dtype, self.model.axis,
+            page_size=self.page_size, pool_pages=self.pool_pages,
+        )
+
+    def prefill_chunk(self, cache: PagedKVCache, pages_row, chunk,
+                      start: int, total_len: int):
+        chunk = np.asarray(chunk, np.int32)
+        true = len(chunk)
+        pad = self.chunk_tokens - true
+        if pad < 0:
+            raise ValueError(
+                f"chunk of {true} tokens exceeds chunk_tokens="
+                f"{self.chunk_tokens}")
+        ids = jnp.asarray(
+            np.pad(chunk, (0, pad))[None, :], jnp.int32)
+        view = _slot_view(cache, pages_row, start)
+        logits, view = self._prefill_chunk(
+            self.engine.params, view, ids, jnp.int32(start),
+            jnp.int32(true))
+        cache = _merge_pools(cache, view)
+        first = None
+        if start + true == total_len:
+            first = int(jnp.argmax(logits[0, true - 1]))
+        return cache, first
+
+    def decode(self, cache: PagedKVCache, tokens):
+        tok = jnp.asarray(np.asarray(tokens, np.int32))
+        logits, cache = self._decode(self.engine.params, cache, tok)
+        return cache, np.asarray(jnp.argmax(logits, axis=-1), np.int32)

@@ -1,0 +1,125 @@
+"""The explicit KV-page budget: a real free-list over the physical pool.
+
+``init_serving_cache`` sizes the physical page pool independently of
+``slots * max_pages`` — the pool IS the serving memory budget
+(PagedAttention's central trick, PAPERS.md: logical capacity can
+overcommit physical pages because most requests never reach
+``max_length``).  :class:`PagePool` owns which physical page ids are
+free: admission reserves a prompt's pages up front, decode grows a
+sequence one page at a time, completion / preemption / failure return
+pages — and an allocation that cannot be satisfied raises the same
+typed :class:`~..models.kv_cache.PagePoolExhausted` the cache-level
+bounds check uses, which is the scheduler's cue to preempt rather than
+OOM.
+
+Deterministic: pages allocate lowest-id-first, so a seeded load test
+replays to identical block tables.  Page 0 is RESERVED as the scrap
+page (inactive batch slots scatter their garbage token there).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..models.kv_cache import PagePoolExhausted
+
+SCRAP_PAGE = 0
+
+
+def pages_needed(num_tokens: int, page_size: int) -> int:
+    """Pages to hold ``num_tokens`` KV positions."""
+    if num_tokens < 0:
+        raise ValueError(f"num_tokens {num_tokens} < 0")
+    return -(-num_tokens // page_size)
+
+
+class PagePool:
+    """Free-list allocator over physical page ids [1, total_pages).
+
+    ``alloc`` raises :class:`PagePoolExhausted`; ``try_alloc`` returns
+    None — the scheduler uses the latter on its preemption path (an
+    exception per probed allocation under sustained pressure would be
+    noise).  Double-free and foreign-page frees raise: a bookkeeping
+    bug here corrupts two sequences' caches silently, which is the one
+    failure mode a robustness PR must never paper over.
+    """
+
+    def __init__(self, total_pages: int, page_size: int):
+        if total_pages < 2:
+            raise ValueError(
+                f"total_pages {total_pages} < 2 (page {SCRAP_PAGE} is "
+                f"the reserved scrap page)")
+        if page_size < 1:
+            raise ValueError(f"page_size {page_size} < 1")
+        self.total_pages = int(total_pages)
+        self.page_size = int(page_size)
+        self._lock = threading.Lock()
+        # lowest-id-first for deterministic replay
+        self._free = list(range(1, total_pages))
+        self._free_set = set(self._free)
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (scrap page excluded)."""
+        return self.total_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.capacity - self.free_pages
+
+    def occupancy(self) -> float:
+        """Fraction of the allocatable pool in use (the serve gauge)."""
+        return self.used_pages / self.capacity
+
+    def try_alloc(self, n: int) -> list[int] | None:
+        if n < 0:
+            raise ValueError(f"alloc count {n} < 0")
+        with self._lock:
+            if n > len(self._free):
+                return None
+            pages, self._free = self._free[:n], self._free[n:]
+            self._free_set.difference_update(pages)
+            return pages
+
+    def alloc(self, n: int) -> list[int]:
+        pages = self.try_alloc(n)
+        if pages is None:
+            raise PagePoolExhausted(
+                f"page pool exhausted: need {n} page(s), "
+                f"{self.free_pages} free of {self.capacity}",
+                needed=n, available=self.free_pages,
+            )
+        return pages
+
+    def free(self, pages) -> None:
+        pages = list(pages)
+        with self._lock:
+            for p in pages:
+                p = int(p)
+                if p == SCRAP_PAGE or not 0 < p < self.total_pages:
+                    raise ValueError(
+                        f"free of page {p} outside the allocatable pool "
+                        f"[1, {self.total_pages})")
+                if p in self._free_set:
+                    raise ValueError(
+                        f"double free of page {p} — two sequences would "
+                        f"share it and corrupt each other's KV")
+                self._free_set.add(p)
+                self._free.append(p)
+            self._free.sort()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            free = len(self._free)
+        return {
+            "capacity": self.capacity,
+            "free_pages": free,
+            "used_pages": self.capacity - free,
+            "occupancy": (self.capacity - free) / self.capacity,
+            "page_size": self.page_size,
+        }

@@ -1,0 +1,225 @@
+"""Request lifecycle and the bounded admission queue.
+
+The serving layer's unit of work is a :class:`Request`: a prompt, a
+generation budget, a priority, and an optional wall-clock deadline.  The
+:class:`RequestQueue` in front of the scheduler is the ADMISSION CONTROL
+half of overload safety (Orca's iteration-level scheduling admits from
+exactly such a queue, PAPERS.md): depth is bounded, so a traffic burst
+beyond the drain rate SHEDS deterministically at submit time (the
+client sees backpressure immediately) instead of growing an unbounded
+backlog whose tail requests would all miss their deadlines anyway.
+
+States form a small machine::
+
+    QUEUED -> PREFILL -> DECODE -> DONE
+      |          \\________/  \\
+      v              |         -> FAILED   (fault / deadline, isolated)
+     SHED        PREEMPTED -> QUEUED       (pages evicted, deterministic
+                                            recompute from the prompt)
+
+Preempted requests re-enter the queue AHEAD of same-priority arrivals
+(they already paid admission once; starving them behind fresh traffic
+would be priority inversion).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import threading
+import time
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    PREEMPTED = "preempted"
+    DONE = "done"
+    FAILED = "failed"
+    SHED = "shed"
+
+
+TERMINAL_STATES = (RequestState.DONE, RequestState.FAILED,
+                   RequestState.SHED)
+
+_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``prompt``: token ids (any int sequence; stored as a tuple so a
+    preempted request can be deterministically recomputed from it).
+    ``max_new_tokens``: generation budget.  ``priority``: higher wins
+    admission and survives preemption longer.  ``deadline_ms``: wall
+    budget from ``submit`` time; breach fails (queued: sheds) the
+    request without poisoning batch cohabitants.
+    """
+
+    prompt: tuple
+    max_new_tokens: int
+    priority: int = 0
+    deadline_ms: float | None = None
+    req_id: int = dataclasses.field(default_factory=lambda: next(_ids))
+
+    # lifecycle (owned by the queue + scheduler)
+    state: RequestState = RequestState.QUEUED
+    tokens: list = dataclasses.field(default_factory=list)
+    error: str | None = None
+    shed_reason: str | None = None
+    preemptions: int = 0
+    submitted_s: float | None = None
+    first_token_s: float | None = None
+    finished_s: float | None = None
+
+    def __post_init__(self):
+        self.prompt = tuple(int(t) for t in self.prompt)
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens {self.max_new_tokens} < 1")
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def done(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    def remaining_ms(self, now: float | None = None) -> float | None:
+        """Wall budget left (None = unbounded); <= 0 means breached."""
+        if self.deadline_ms is None or self.submitted_s is None:
+            return None
+        now = time.monotonic() if now is None else now
+        return self.deadline_ms - (now - self.submitted_s) * 1e3
+
+    def ttft_ms(self) -> float | None:
+        if self.first_token_s is None or self.submitted_s is None:
+            return None
+        return (self.first_token_s - self.submitted_s) * 1e3
+
+
+class RequestQueue:
+    """Bounded priority queue with preempted-first re-admission.
+
+    ``submit`` returns False (and marks the request SHED) when the
+    queue is at ``max_depth`` — the backpressure contract: a full queue
+    is the load balancer's signal to route elsewhere, not a promise to
+    buffer forever.  Pop order: priority desc, then preempted before
+    fresh, then FIFO by submit order.  Thread-safe (a serving front-end
+    submits from request threads; the scheduler pops from its loop).
+    """
+
+    def __init__(self, max_depth: int = 64):
+        if max_depth < 1:
+            raise ValueError(f"max_depth {max_depth} < 1")
+        self.max_depth = int(max_depth)
+        self._lock = threading.Lock()
+        self._items: list[tuple] = []   # (-prio, fresh, seq, Request)
+        self._seq = itertools.count()
+        self.sheds = 0
+        self.submitted = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    @property
+    def depth(self) -> int:
+        return len(self)
+
+    def submit(self, req: Request, *, now: float | None = None) -> bool:
+        """Admit to the queue, or shed (False) when full."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self.submitted += 1
+            if len(self._items) >= self.max_depth:
+                self.sheds += 1
+                req.state = RequestState.SHED
+                req.shed_reason = (
+                    f"queue full (depth {len(self._items)} >= max_depth "
+                    f"{self.max_depth})")
+                req.finished_s = now
+                return False
+            req.submitted_s = now if req.submitted_s is None \
+                else req.submitted_s
+            req.state = RequestState.QUEUED
+            self._items.append((-req.priority, 1, next(self._seq), req))
+            self._items.sort()
+            return True
+
+    def requeue_preempted(self, req: Request) -> None:
+        """Park a preempted request: ahead of same-priority fresh
+        arrivals, never shed (it already passed admission — dropping it
+        now would convert pool pressure into a failed request, exactly
+        what preemption exists to avoid).  Its deadline keeps running
+        from the ORIGINAL submit."""
+        req.state = RequestState.PREEMPTED
+        req.preemptions += 1
+        req.tokens = []          # deterministic recompute from the prompt
+        # first_token_s is KEPT: TTFT is a once-per-request SLO sample
+        # from the first admission
+        with self._lock:
+            self._items.append((-req.priority, 0, next(self._seq), req))
+            self._items.sort()
+
+    def peek(self) -> Request | None:
+        with self._lock:
+            return self._items[0][3] if self._items else None
+
+    def pop(self) -> Request | None:
+        with self._lock:
+            if not self._items:
+                return None
+            return self._items.pop(0)[3]
+
+    def pop_if(self, req: Request) -> bool:
+        """Atomically pop the head IFF it is still ``req`` — the
+        admission loop peeks, sizes the page reservation, then commits
+        with this; a concurrent submit that changed the head between
+        peek and commit makes it return False (the loop re-peeks)
+        instead of silently discarding the newcomer."""
+        with self._lock:
+            if self._items and self._items[0][3] is req:
+                self._items.pop(0)
+                return True
+            return False
+
+    def expire_deadlines(self, now: float | None = None) -> list[Request]:
+        """Shed queued requests whose deadline has already passed —
+        admitting them would spend pool pages on work that cannot
+        finish in budget."""
+        now = time.monotonic() if now is None else now
+        expired = []
+        with self._lock:
+            keep = []
+            for item in self._items:
+                req = item[3]
+                rem = req.remaining_ms(now)
+                if rem is not None and rem <= 0:
+                    self.sheds += 1
+                    req.state = RequestState.SHED
+                    req.shed_reason = (
+                        f"deadline {req.deadline_ms:.0f} ms expired in "
+                        f"queue")
+                    req.finished_s = now
+                    expired.append(req)
+                else:
+                    keep.append(item)
+            self._items = keep
+        return expired
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "depth": len(self._items),
+                "max_depth": self.max_depth,
+                "submitted": self.submitted,
+                "sheds": self.sheds,
+                "queued_ids": [it[3].req_id for it in self._items],
+            }

@@ -1,0 +1,621 @@
+"""The continuous-batching scheduler: overload-safe serving over the
+paged KV cache.
+
+Iteration-level scheduling (Orca, PAPERS.md) over PagedAttention-style
+physical pages: every scheduler ``step()`` re-decides batch membership,
+then dispatches ONE jit-compiled stateless decode step whose shapes
+never change — membership edits only rewrite block-table / seq-lens /
+token VALUES, so the hot path never retraces.  Robustness is the
+headline; the mechanisms, in the order a step applies them:
+
+1. **Admission control** (``RequestQueue`` + ``PagePool``): bounded
+   queue depth sheds bursts at submit; admission reserves a prompt's
+   pages against the explicit KV-page budget and stops (backpressure)
+   when free pages dip under the headroom the degradation governor
+   demands.
+2. **Chunked prefill**: new sequences prefill ``prefill_chunk_tokens``
+   prompt tokens per step alongside in-flight decode, so a long prompt
+   cannot stall cohabitants' token cadence for its whole length.
+3. **Preemption, not OOM**: a sequence growing into an exhausted pool
+   (:class:`PagePoolExhausted` — the same typed error the cache-level
+   bounds check raises) evicts the LOWEST-priority sequence: its pages
+   return to the pool, the request parks back in the queue, and on
+   re-admission it deterministically recomputes from its prompt
+   (greedy/seeded sampling makes the replay exact).
+4. **Per-request deadlines** ride the PR-3 watchdog machinery: the
+   decode dispatch runs under ``resilience.call_with_deadline`` bounded
+   by the tightest remaining request budget, and a breach fails ONLY
+   the breached request(s).
+5. **Per-sequence failure isolation** (PR 3's whole-batch isolation at
+   sequence granularity): the step functions do NOT donate the cache,
+   so a fault mid-step leaves the pre-step pools intact — the victim is
+   failed, its pages reclaimed, its slot recycled, and cohabitants
+   retry the step unharmed.
+6. **Graceful degradation** (``resilience.AdmissionGovernor``): under
+   preemption thrash or an open breaker the scheduler SHRINKS admission
+   (fewer slots, more headroom) instead of failing requests.
+
+Telemetry rides PR 5's plane: TTFT and request-latency sketches,
+shed/preempt/evict counters and the pool-occupancy gauge land in
+``obs.serve_stats``; ``health()`` reports ``status="saturated"`` under
+sustained pool pressure, which ``obs.server`` turns into the
+``/healthz`` 503 the load balancer sheds on.  Everything is
+deterministic under a fixed seed — ``serve.trace`` replays an open-loop
+arrival trace for the CI smoke (``scripts/tdt_lint.py --serve``) and
+the fault matrix's scheduler cells (``resilience.matrix``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import obs
+from ..models.kv_cache import PagePoolExhausted
+from .budget import PagePool, pages_needed
+from .queue import Request, RequestQueue, RequestState
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    """Knobs of the serving loop (defaults suit the CI-scale tests;
+    production tunes via ``Engine.scheduler(**kw)``)."""
+
+    max_queue_depth: int = 64
+    # prompt tokens prefilled per scheduler step (None = whole prompt
+    # in one chunk); also the EngineBackend's compile bucket
+    prefill_chunk_tokens: int | None = None
+    # extra free pages admission must leave (the governor ADDS to this
+    # under degradation)
+    admission_headroom_pages: int = 0
+    # consecutive failed decode dispatches before the scheduler fails
+    # every active request (a poisoned step that survives this many
+    # victim evictions is not a single bad sequence)
+    max_step_failures: int = 8
+    # pool pressure must persist this long before health() flips to
+    # "saturated" (503); 0 = immediately
+    saturation_sustain_s: float = 0.0
+    # lower bound on the bounded decode dispatch budget, so one request
+    # with microseconds left cannot watchdog a healthy step
+    step_deadline_floor_ms: float = 25.0
+
+
+@dataclasses.dataclass
+class SlotState:
+    """One active batch slot: the request plus its page map."""
+
+    request: Request
+    pages: list[int]
+    length: int = 0          # valid KV positions (host truth)
+    prefill_pos: int = 0     # prompt tokens already written
+    next_token: int | None = None
+
+
+@dataclasses.dataclass
+class StepResult:
+    """What one ``step()`` did (tests and the lint smoke assert on
+    these)."""
+
+    admitted: int = 0
+    prefill_tokens: int = 0
+    decoded: int = 0
+    completed: int = 0
+    failed: int = 0
+    preempted: int = 0
+    shed: int = 0
+    queue_depth: int = 0
+    free_pages: int = 0
+    active: int = 0
+
+    @property
+    def idle(self) -> bool:
+        return (self.active == 0 and self.queue_depth == 0
+                and self.admitted == 0)
+
+
+class Scheduler:
+    """Continuous-batching loop over one backend (see module
+    docstring).  Single-threaded by design: ``submit`` is thread-safe
+    (the queue locks), everything else runs on the caller's loop."""
+
+    def __init__(self, backend, config: SchedulerConfig | None = None, *,
+                 governor=None):
+        from .. import resilience
+
+        self.backend = backend
+        self.cfg = config or SchedulerConfig()
+        self.queue = RequestQueue(self.cfg.max_queue_depth)
+        self.pool = PagePool(backend.pool_pages, backend.page_size)
+        self.cache = backend.make_cache()
+        self.slots: list[SlotState | None] = [None] * backend.slots
+        self.governor = governor if governor is not None \
+            else resilience.AdmissionGovernor()
+        self.steps = 0
+        self.admitted = 0
+        self.completed: list[Request] = []
+        self.failed: list[Request] = []
+        self.shed: list[Request] = []
+        self.preemptions = 0
+        self.evicted_pages = 0
+        self._consec_step_failures = 0
+        self._saturated_since: float | None = None
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, req: Request, *, now: float | None = None) -> bool:
+        """Admission control stage 1: reject-or-queue.  A request whose
+        TOTAL demand can never fit the pool (or ``max_length``) is shed
+        immediately with a typed reason — queueing it would waste its
+        deadline on an impossible promise."""
+        now = time.monotonic() if now is None else now
+        total = req.prompt_len + req.max_new_tokens
+        reason = None
+        if total > self.backend.max_length:
+            reason = (f"prompt {req.prompt_len} + max_new_tokens "
+                      f"{req.max_new_tokens} exceeds max_length "
+                      f"{self.backend.max_length}")
+        elif pages_needed(total, self.pool.page_size) > self.pool.capacity:
+            reason = (f"demand of {pages_needed(total, self.pool.page_size)}"
+                      f" pages exceeds the pool capacity "
+                      f"{self.pool.capacity} — can never be scheduled")
+        if reason is not None:
+            req.state = RequestState.SHED
+            req.shed_reason = reason
+            req.finished_s = now
+            self._note_shed(req)
+            return False
+        if not self.queue.submit(req, now=now):
+            self._note_shed(req)
+            return False
+        return True
+
+    # -- the scheduler step ------------------------------------------------
+
+    def step(self) -> StepResult:
+        """One scheduling iteration: expire -> admit -> prefill ->
+        decode -> account."""
+        now = time.monotonic()
+        res = StepResult()
+        self.steps += 1
+        # terminal-outcome counting by DELTA over the lifetime lists:
+        # every path that finishes/fails/sheds/preempts (decode faults,
+        # prefill faults, max_new==1 finishing inside prefill, deadline
+        # sweeps) lands in the step's result without per-path plumbing
+        c0, f0, s0, p0 = (len(self.completed), len(self.failed),
+                          len(self.shed), self.preemptions)
+
+        for req in self.queue.expire_deadlines(now):
+            self._note_shed(req)
+
+        # active-request deadline breaches fail in isolation, no step
+        # spent on them
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            rem = slot.request.remaining_ms(now)
+            if rem is not None and rem <= 0:
+                self._fail_slot(
+                    i, f"deadline {slot.request.deadline_ms:.0f} ms "
+                       f"exceeded mid-flight", now)
+
+        res.admitted = self._admit(now)
+        self.admitted += res.admitted
+        res.prefill_tokens = self._prefill_work(now)
+        res.decoded = self._decode_work(now)
+        res.completed = len(self.completed) - c0
+        res.failed = len(self.failed) - f0
+        res.shed = len(self.shed) - s0
+        res.preempted = self.preemptions - p0
+
+        # a step with no decode work and no failures is still a CLEAN
+        # step for the governor: degradation must decay while the loop
+        # idles, or a raised headroom could block the last queued
+        # request forever (the decode path feeds note_step_ok itself)
+        if res.decoded == 0 and res.failed == 0 and res.preempted == 0:
+            self.governor.note_step_ok()
+        res.queue_depth = self.queue.depth
+        res.free_pages = self.pool.free_pages
+        res.active = sum(s is not None for s in self.slots)
+        self._publish_gauges()
+        return res
+
+    def run_until_idle(self, *, max_steps: int = 100_000) -> int:
+        """Drive ``step()`` until no queued and no active work remains;
+        returns the step count.  ``max_steps`` guards a livelock bug
+        from hanging CI."""
+        for _ in range(max_steps):
+            if self.step().idle:
+                return self.steps
+        raise RuntimeError(
+            f"scheduler not idle after {max_steps} steps: "
+            f"{self.debug_state()}")
+
+    # -- admission ---------------------------------------------------------
+
+    def _admit(self, now: float) -> int:
+        cap = self.governor.slot_cap(len(self.slots))
+        headroom = (self.cfg.admission_headroom_pages
+                    + self.governor.headroom_pages())
+        admitted = 0
+        blocked_by_pages = False
+        while True:
+            if sum(s is not None for s in self.slots) >= cap:
+                break
+            req = self.queue.peek()
+            if req is None:
+                break
+            # reserve the prompt plus the first decode token's slot; the
+            # rest grows page-at-a-time under the preemption policy
+            need = pages_needed(req.prompt_len + 1, self.pool.page_size)
+            if self.pool.free_pages - need < headroom:
+                blocked_by_pages = True
+                break
+            pages = self.pool.try_alloc(need)
+            if pages is None:
+                blocked_by_pages = True
+                break
+            if not self.queue.pop_if(req):
+                # a concurrent submit changed the head between the peek
+                # and this commit: give the pages back and re-peek
+                self.pool.free(pages)
+                continue
+            slot_idx = next(
+                i for i, s in enumerate(self.slots) if s is None)
+            req.state = RequestState.PREFILL
+            self.slots[slot_idx] = SlotState(request=req, pages=pages)
+            admitted += 1
+            if obs.enabled():
+                obs.counter("serve_admitted").inc()
+        # saturation: pool pressure with a live backlog
+        if blocked_by_pages and self.queue.depth > 0:
+            if self._saturated_since is None:
+                self._saturated_since = now
+        else:
+            self._saturated_since = None
+        return admitted
+
+    # -- prefill -----------------------------------------------------------
+
+    def _prefill_work(self, now: float) -> int:
+        """One chunk per PREFILL slot per step: long prompts interleave
+        with in-flight decode instead of monopolizing the loop."""
+        budget = self.cfg.prefill_chunk_tokens
+        done_tokens = 0
+        for i, slot in enumerate(self.slots):
+            if slot is None or slot.request.state is not RequestState.PREFILL:
+                continue
+            req = slot.request
+            plen = req.prompt_len
+            take = plen - slot.prefill_pos if budget is None \
+                else min(budget, plen - slot.prefill_pos)
+            # never exceed the backend's compile bucket: with the
+            # default whole-prompt budget an EngineBackend would
+            # otherwise reject (and fail) every prompt longer than its
+            # one chunk executable
+            bucket = getattr(self.backend, "chunk_tokens", None)
+            if bucket is not None:
+                take = min(take, bucket)
+            chunk = req.prompt[slot.prefill_pos:slot.prefill_pos + take]
+            try:
+                self.cache, first = self.backend.prefill_chunk(
+                    self.cache, np.asarray(slot.pages, np.int32), chunk,
+                    slot.prefill_pos, plen)
+            except Exception as e:
+                # a prefill fault is single-sequence by construction
+                self._fail_slot(i, f"prefill failed: "
+                                   f"{type(e).__name__}: {e}", now)
+                continue
+            slot.prefill_pos += take
+            done_tokens += take
+            if slot.prefill_pos >= plen:
+                slot.length = plen
+                slot.next_token = int(first)
+                req.tokens = [int(first)]
+                req.state = RequestState.DECODE
+                # TTFT is a per-REQUEST SLO, observed once on the FIRST
+                # admission; a preempted request's re-prefill must not
+                # contribute a second sample (it would inflate the p99
+                # exactly in the thrash regime the sketch characterizes)
+                if req.first_token_s is None:
+                    req.first_token_s = time.monotonic()
+                    ttft = req.ttft_ms()
+                    if obs.enabled() and ttft is not None:
+                        obs.serve_stats.STATS.observe_ttft(ttft)
+                if req.max_new_tokens == 1:
+                    self._finish_slot(i)
+        return done_tokens
+
+    # -- decode ------------------------------------------------------------
+
+    def _active_decode(self) -> list[int]:
+        return [i for i, s in enumerate(self.slots)
+                if s is not None
+                and s.request.state is RequestState.DECODE]
+
+    def _decode_work(self, now: float) -> int:
+        """One batched decode step; returns the number of sequences
+        decoded (terminal outcomes are counted by the caller's deltas)."""
+        self._grow_pages()
+        active = self._active_decode()
+        if not active:
+            return 0
+        self._sync_cache()
+        tokens = np.zeros((len(self.slots),), np.int32)
+        for i in active:
+            tokens[i] = self.slots[i].next_token
+
+        from .. import resilience
+
+        try:
+            new_cache, nxt = self._dispatch(tokens, active, now)
+        except Exception as e:
+            # fresh clock: the breach typically happened DURING the
+            # dispatch, after the step-start timestamp
+            self._isolate_step_failure(e, active, time.monotonic())
+            return 0
+        self._consec_step_failures = 0
+        self.governor.note_step_ok()
+        # feed the step breaker (sticky-open = the governor's max
+        # degradation + a non-"ok" health status): consecutive step
+        # failures walk it open, any success resets the count
+        resilience.breaker(self.governor.breaker_op).record_success()
+        self.cache = new_cache
+
+        for i in active:
+            slot = self.slots[i]
+            req = slot.request
+            slot.length += 1
+            tok = int(nxt[i])
+            req.tokens.append(tok)
+            slot.next_token = tok
+            if len(req.tokens) >= req.max_new_tokens:
+                self._finish_slot(i)
+        if obs.enabled():
+            obs.serve_stats.STATS.tokens.add(float(len(active)))
+            obs.counter("serve_decode_steps").inc()
+        return len(active)
+
+    def _grow_pages(self) -> int:
+        """Allocate the next page for every sequence whose write
+        position has outgrown its map — preempting the lowest-priority
+        sequence under pool pressure instead of letting ``append_paged``
+        raise mid-step."""
+        preempted = 0
+        for i in list(self._active_decode()):
+            slot = self.slots[i]
+            if slot is None or \
+                    slot.request.state is not RequestState.DECODE:
+                continue   # may have been preempted as a victim below
+            if slot.length < len(slot.pages) * self.pool.page_size:
+                continue
+            while True:
+                page = self.pool.try_alloc(1)
+                if page is not None:
+                    slot.pages.extend(page)
+                    break
+                victim = self._choose_victim()
+                if victim is None:
+                    # nobody left to evict: admission guarantees a lone
+                    # request fits, so this is a bookkeeping bug — fail
+                    # the grower with the typed error rather than loop
+                    self._fail_slot(
+                        i, str(PagePoolExhausted(
+                            "no page and no victim", needed=1,
+                            available=0)), time.monotonic())
+                    break
+                self._preempt_slot(victim)
+                preempted += 1
+                if victim == i:
+                    break   # the grower evicted itself; it is parked
+        return preempted
+
+    def _choose_victim(self) -> int | None:
+        """Preemption policy: lowest priority; tie broken toward the
+        YOUNGEST admission (it has the least sunk prefill work to
+        recompute)."""
+        best = None
+        best_key = None
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            key = (slot.request.priority, -slot.request.req_id)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
+    def _dispatch(self, tokens: np.ndarray, active: list[int],
+                  now: float):
+        """The bounded decode dispatch: per-request deadlines ride the
+        PR-3 watchdog (``resilience.call_with_deadline``), budget = the
+        tightest remaining request deadline, floored so one nearly-dead
+        request cannot watchdog a healthy step."""
+        from .. import resilience
+
+        remaining = [
+            self.slots[i].request.remaining_ms(now) for i in active
+        ]
+        remaining = [r for r in remaining if r is not None]
+        thunk = lambda: self.backend.decode(self.cache, tokens)  # noqa: E731
+        if not remaining and not resilience.enabled():
+            return thunk()
+        budget = None
+        if remaining:
+            budget = max(min(remaining), self.cfg.step_deadline_floor_ms)
+        return resilience.call_with_deadline(
+            "serve_decode_step", thunk, budget)
+
+    def _isolate_step_failure(self, err: Exception, active: list[int],
+                              now: float) -> int:
+        """Per-sequence failure isolation: the pre-step cache was never
+        replaced (non-donated step), so cohabitants' pages are intact —
+        fail only the victim(s) and let the next step retry the rest.
+        Victims: every request whose deadline has expired (a
+        ``CollectiveTimeoutError`` step); otherwise the lowest-priority
+        active sequence (the fault's attribution is not per-row, so the
+        eviction policy picks, exactly as preemption does — but here the
+        request FAILS, because replaying it would replay the fault)."""
+        from .. import resilience
+
+        self._consec_step_failures += 1
+        self.governor.note_step_failure()
+        resilience.breaker(self.governor.breaker_op).record_failure()
+        if obs.enabled():
+            obs.counter("serve_step_failures",
+                        kind=type(err).__name__).inc()
+        victims: list[int] = []
+        if isinstance(err, resilience.CollectiveTimeoutError):
+            for i in active:
+                rem = self.slots[i].request.remaining_ms(now)
+                if rem is not None and rem <= 0:
+                    victims.append(i)
+        if not victims:
+            lowest = min(
+                active,
+                key=lambda i: (self.slots[i].request.priority,
+                               -self.slots[i].request.req_id))
+            victims = [lowest]
+        if self._consec_step_failures > self.cfg.max_step_failures:
+            victims = list(active)   # poisoned step, not a bad sequence
+        failed = 0
+        for i in victims:
+            self._fail_slot(i, f"{type(err).__name__}: {err}", now)
+            failed += 1
+        return failed
+
+    # -- slot lifecycle ----------------------------------------------------
+
+    def _release_slot(self, i: int) -> SlotState:
+        slot = self.slots[i]
+        assert slot is not None
+        self.slots[i] = None
+        if slot.pages:
+            self.pool.free(slot.pages)
+        return slot
+
+    def _finish_slot(self, i: int) -> None:
+        slot = self._release_slot(i)
+        req = slot.request
+        req.state = RequestState.DONE
+        req.finished_s = time.monotonic()
+        self.completed.append(req)
+        if obs.enabled():
+            e2e_ms = (req.finished_s - (req.submitted_s or req.finished_s)) \
+                * 1e3
+            obs.serve_stats.STATS.request_completed(
+                e2e_ms, tokens=len(req.tokens))
+            obs.counter("serve_completed").inc()
+
+    def _fail_slot(self, i: int, error: str, now: float) -> None:
+        slot = self._release_slot(i)
+        req = slot.request
+        req.state = RequestState.FAILED
+        req.error = error
+        req.finished_s = now
+        self.failed.append(req)
+        if obs.enabled():
+            obs.serve_stats.STATS.request_failed()
+            obs.counter("serve_failed").inc()
+
+    def _preempt_slot(self, i: int) -> None:
+        slot = self._release_slot(i)
+        npages = len(slot.pages)
+        self.preemptions += 1
+        self.evicted_pages += npages
+        self.governor.note_preemption()
+        self.queue.requeue_preempted(slot.request)
+        if obs.enabled():
+            obs.serve_stats.STATS.request_preempted(pages=npages)
+            obs.counter("serve_preemptions").inc()
+            obs.counter("serve_evicted_pages").inc(npages)
+
+    def _note_shed(self, req: Request) -> None:
+        self.shed.append(req)
+        if obs.enabled():
+            obs.serve_stats.STATS.request_shed()
+            obs.counter("serve_shed").inc()
+
+    # -- device-state reconciliation ---------------------------------------
+
+    def _sync_cache(self) -> None:
+        """Write the host truth into the device cache before a decode
+        dispatch.  Only DECODE slots expose their real page map; every
+        other row (empty, mid-prefill) points at the scrap page with
+        length 0, so the batched step's unavoidable per-row writes land
+        in garbage nobody reads instead of corrupting a prefilling
+        cohabitant."""
+        mp = self.cache.max_pages
+        table = np.zeros((len(self.slots), mp), np.int32)
+        lens = np.zeros((len(self.slots),), np.int32)
+        for i, slot in enumerate(self.slots):
+            if slot is not None and \
+                    slot.request.state is RequestState.DECODE:
+                table[i, :len(slot.pages)] = slot.pages
+                lens[i] = slot.length
+        self.cache = dataclasses.replace(
+            self.cache,
+            block_table=jnp.asarray(table),
+            seq_lens=jnp.asarray(lens),
+        )
+
+    # -- health / introspection --------------------------------------------
+
+    def saturated_s(self, now: float | None = None) -> float:
+        if self._saturated_since is None:
+            return 0.0
+        return (time.monotonic() if now is None else now) \
+            - self._saturated_since
+
+    def health(self) -> dict:
+        """The ``/healthz`` payload: resilience breaker state + live
+        serve stats + this scheduler's state; ``status`` leaves "ok"
+        under sustained pool saturation so ``obs.server`` answers 503 —
+        the load-balancer backoff contract — and flips back as the
+        backlog drains."""
+        from .. import resilience
+
+        snap = resilience.health_snapshot()
+        snap["serve_stats"] = obs.serve_stats.STATS.snapshot()
+        snap["scheduler"] = self.debug_state()
+        sat = self.saturated_s()
+        if snap["status"] == "ok" and self._saturated_since is not None \
+                and sat >= self.cfg.saturation_sustain_s:
+            snap["status"] = "saturated"
+        return snap
+
+    def debug_state(self) -> dict:
+        return {
+            "steps": self.steps,
+            "admitted": self.admitted,
+            "completed": len(self.completed),
+            "failed": len(self.failed),
+            "shed": len(self.shed),
+            "preemptions": self.preemptions,
+            "evicted_pages": self.evicted_pages,
+            "active_slots": sum(s is not None for s in self.slots),
+            "slot_cap": self.governor.slot_cap(len(self.slots)),
+            "governor": self.governor.snapshot(),
+            "saturated_s": self.saturated_s(),
+            "queue": self.queue.snapshot(),
+            "pool": self.pool.snapshot(),
+        }
+
+    def _publish_gauges(self) -> None:
+        if not obs.enabled():
+            return
+        stats = obs.serve_stats.STATS
+        occ = self.pool.occupancy()
+        # bare keys: ServeStats' prometheus rendering prefixes `serve_`.
+        # Each serve metric lives in exactly ONE exposition (the stats
+        # block) — a registry twin under the same rendered name would
+        # duplicate the metric family in /metrics and Prometheus rejects
+        # the whole scrape.  kv_pool_occupancy also lands in the
+        # registry (renders unprefixed, beside kv_cache_seq_occupancy —
+        # no collision with serve_kv_pool_occupancy).
+        stats.set_gauge("kv_pool_occupancy", occ)
+        stats.set_gauge("active_slots",
+                        float(sum(s is not None for s in self.slots)))
+        stats.set_gauge("sched_queue_depth", float(self.queue.depth))
+        obs.gauge("kv_pool_occupancy").set(occ)

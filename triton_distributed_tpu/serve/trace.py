@@ -1,0 +1,154 @@
+"""Seeded open-loop arrival traces and their replay harness.
+
+Open-loop means arrivals do NOT wait for completions — the generator
+schedules request arrivals against the scheduler's STEP COUNT (the
+deterministic clock every box shares), so a trace that admits 2x the
+KV-page budget reproduces the same admissions, preemptions and sheds on
+every replay with the same seed.  Consumers: the CI smoke
+(``scripts/tdt_lint.py --serve``), the fault matrix's scheduler cells
+(``resilience.matrix``), the load tests (``tests/test_serve.py``), and
+``bench.py serve`` (which adds wall-clock TTFT measurement on top).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+from .queue import Request, RequestState
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    """One trace entry: submit ``request`` when ``scheduler.steps``
+    reaches ``step``."""
+
+    step: int
+    request: Request
+
+
+def synthetic_trace(seed: int, n_requests: int, *,
+                    mean_interarrival_steps: float = 1.0,
+                    prompt_len: tuple[int, int] = (2, 12),
+                    max_new: tuple[int, int] = (2, 10),
+                    priorities: tuple[int, ...] = (0, 0, 0, 1, 2),
+                    vocab: int = 101,
+                    deadline_ms: float | None = None) -> list[Arrival]:
+    """A seeded open-loop trace: geometric interarrival steps, uniform
+    prompt/generation lengths, a priority mix skewed toward best-effort
+    (the realistic shape: most traffic default priority, a few premium
+    requests that must survive preemption)."""
+    rng = random.Random(seed)
+    arrivals = []
+    step = 0
+    for _ in range(n_requests):
+        plen = rng.randint(*prompt_len)
+        req = Request(
+            prompt=tuple(rng.randrange(vocab) for _ in range(plen)),
+            max_new_tokens=rng.randint(*max_new),
+            priority=rng.choice(priorities),
+            deadline_ms=deadline_ms,
+        )
+        arrivals.append(Arrival(step=step, request=req))
+        if mean_interarrival_steps > 0:
+            # geometric gap with the configured mean (0 gaps allowed:
+            # bursts are the point of an open-loop overload trace)
+            p = 1.0 / (1.0 + mean_interarrival_steps)
+            gap = 0
+            while rng.random() > p:
+                gap += 1
+            step += gap
+    return arrivals
+
+
+@dataclasses.dataclass
+class TraceReport:
+    """Replay outcome, with the two invariants the overload-safety
+    acceptance rides on precomputed: ``leaked_pages`` (pool occupancy
+    must return to zero once everything drains) and
+    ``drain_monotone`` (after the last arrival, the OUTSTANDING request
+    count — queued plus active, i.e. everything non-terminal — never
+    grows: the backlog drains, it does not oscillate.  Raw queue depth
+    is deliberately NOT the measure: a preemption legitimately moves a
+    request active -> queued without creating work)."""
+
+    requests: list[Request]
+    steps: int
+    leaked_pages: int
+    drain_monotone: bool
+    max_queue_depth: int
+    peak_pool_occupancy: float
+
+    @property
+    def completed(self) -> list[Request]:
+        return [r for r in self.requests
+                if r.state is RequestState.DONE]
+
+    @property
+    def failed(self) -> list[Request]:
+        return [r for r in self.requests
+                if r.state is RequestState.FAILED]
+
+    @property
+    def shed(self) -> list[Request]:
+        return [r for r in self.requests
+                if r.state is RequestState.SHED]
+
+    @property
+    def ttft_ms(self) -> list[float]:
+        return sorted(r.ttft_ms() for r in self.completed
+                      if r.ttft_ms() is not None)
+
+    def problems(self) -> list[str]:
+        """The invariant violations a CI gate fails on."""
+        out = []
+        if self.leaked_pages:
+            out.append(f"{self.leaked_pages} page(s) leaked after drain "
+                       f"— a free-list bookkeeping bug")
+        if not self.drain_monotone:
+            out.append("queue depth grew after the last arrival — the "
+                       "drain is not monotone")
+        pending = [r for r in self.requests if not r.done]
+        if pending:
+            out.append(f"{len(pending)} request(s) never reached a "
+                       f"terminal state: "
+                       f"{[r.req_id for r in pending]}")
+        return out
+
+
+def replay(scheduler, arrivals: list[Arrival], *,
+           max_steps: int = 100_000) -> TraceReport:
+    """Drive the scheduler through the trace until every request is
+    terminal (or ``max_steps`` fires — reported, not raised: a stuck
+    replay is a finding for the caller's gate, not a crash)."""
+    pending = sorted(arrivals, key=lambda a: (a.step, a.request.req_id))
+    requests = [a.request for a in pending]
+    idx = 0
+    last_arrival_step = pending[-1].step if pending else 0
+    max_depth = 0
+    peak_occ = 0.0
+    prev_outstanding = None
+    monotone = True
+    for _ in range(max_steps):
+        while idx < len(pending) and pending[idx].step <= scheduler.steps:
+            scheduler.submit(pending[idx].request)
+            idx += 1
+        res = scheduler.step()
+        max_depth = max(max_depth, res.queue_depth)
+        peak_occ = max(peak_occ, scheduler.pool.occupancy())
+        if idx >= len(pending) and scheduler.steps > last_arrival_step:
+            outstanding = sum(not r.done for r in requests)
+            if prev_outstanding is not None \
+                    and outstanding > prev_outstanding:
+                monotone = False
+            prev_outstanding = outstanding
+        if idx >= len(pending) and res.idle:
+            break
+    return TraceReport(
+        requests=requests,
+        steps=scheduler.steps,
+        leaked_pages=scheduler.pool.used_pages,
+        drain_monotone=monotone,
+        max_queue_depth=max_depth,
+        peak_pool_occupancy=peak_occ,
+    )

@@ -881,6 +881,96 @@ def bench_serve_throughput():
     }
 
 
+def bench_integrity_overhead():
+    """The TDT_INTEGRITY tax: checksummed vs plain AG/RS at the tuned
+    configs, as a percent of the plain eager op (ISSUE 7 satellite —
+    the trend sentinel guards it; the claims gate warns above 5%).
+
+    On a real slice (>= 2 devices, compiled kernels) both public eager
+    entries are timed with the verification layer off vs on and the
+    WORST of the two ratios is the record.  The CPU CI container cannot
+    run a collective kernel at all, so there the record is a HOST-
+    MODELED functional smoke, marked ``interpret`` (never hard-gated):
+    the measured consumer-side verification cost over the tuned payload
+    relative to one host copy of the same bytes — a machine-relative
+    number that stays comparable round over round on the same box."""
+    import time as _time
+
+    from triton_distributed_tpu.core import compilation, mesh as mesh_lib
+    from triton_distributed_tpu.resilience import integrity
+
+    mesh = mesh_lib.tp_mesh()
+    ntp = mesh.shape["tp"]
+    real = ntp >= 2 and not compilation.interpret_mode()
+    m, r = 4096, 7168
+    prev = integrity._ENABLED
+    details: dict = {}
+    try:
+        if real:
+            from triton_distributed_tpu.comm.allgather import all_gather
+            from triton_distributed_tpu.comm.reduce_scatter import (
+                reduce_scatter,
+            )
+
+            x = jax.random.normal(jax.random.key(0), (m, r), jnp.bfloat16)
+            worst = 0.0
+            for name, op in (
+                ("all_gather", lambda: all_gather(x, mesh)),
+                ("reduce_scatter", lambda: reduce_scatter(x, mesh)),
+            ):
+                def run_off(op=op):
+                    integrity.enable(False)
+                    return jax.block_until_ready(op())
+
+                def run_on(op=op):
+                    integrity.enable(True)
+                    return jax.block_until_ready(op())
+
+                times = _bench_interleaved(
+                    {"off": run_off, "on": run_on},
+                    iters=8, rounds=7, window_s=0.3)
+                t_off, t_on = _median(times["off"]), _median(times["on"])
+                pct = 100.0 * (t_on - t_off) / max(t_off, 1e-12)
+                details[f"{name}_plain_us"] = round(t_off * 1e6, 1)
+                details[f"{name}_checked_us"] = round(t_on * 1e6, 1)
+                worst = max(worst, pct)
+            value = worst
+        else:
+            # host-modeled: verify_gather over the tuned payload vs one
+            # copy of the gathered bytes (marked interpret below)
+            import numpy as np
+
+            rng = np.random.default_rng(0)
+            x = rng.standard_normal((m, r)).astype(np.float32)
+            reps = 3
+            t0 = _time.perf_counter()
+            for _ in range(reps):
+                diag = integrity.verify_gather("all_gather", x, x, 4)
+                if diag is not None:   # self-check, never timed away
+                    raise RuntimeError(f"clean payload flagged: {diag}")
+            t_verify = (_time.perf_counter() - t0) / reps
+            t0 = _time.perf_counter()
+            for _ in range(reps):
+                x.copy()
+            t_copy = (_time.perf_counter() - t0) / reps
+            value = 100.0 * t_verify / max(t_copy, 1e-12)
+            details["modeled"] = ("verify_gather vs one host copy of "
+                                  "the gathered payload")
+            details["verify_us"] = round(t_verify * 1e6, 1)
+            details["copy_us"] = round(t_copy * 1e6, 1)
+    finally:
+        integrity.enable(prev)
+    return {
+        "metric": "integrity_overhead_pct",
+        "value": round(value, 2),
+        "unit": "% over plain",
+        "shape": f"({m}, {r})",
+        "devices": jax.device_count(),
+        "interpret": (not real) or _interpret_capture(),
+        **details,
+    }
+
+
 def bench_overlap():
     """Measured DMA/MXU overlap of the tile pipeline (the compute core of
     the fused collective GEMMs) via the three-kernel decomposition in
@@ -1123,6 +1213,8 @@ def main():
         print(json.dumps(bench_overlap()))
     elif mode == "overlap_collective":
         print(json.dumps(bench_overlap_collective()))
+    elif mode == "integrity":
+        print(json.dumps(bench_integrity_overhead()))
     elif mode == "auto":
         # whole perf surface, one JSON line per mode; headline GEMM
         # first.  The complete stream also lands in BENCH_LOCAL_rNN.jsonl
@@ -1142,6 +1234,7 @@ def main():
         _emit(bench_overlap)
         _emit(bench_serve_ttft)
         _emit(bench_serve_throughput)
+        _emit(bench_integrity_overhead)
         if jax.device_count() > 1:
             _emit(bench_multi_chip)
             _emit(bench_overlap_collective)
@@ -1174,7 +1267,7 @@ def main():
         raise SystemExit(
             f"unknown bench mode {mode!r} "
             "(auto|gemm|attn|mlp|moe|decode|decode_modes|moe_ep|latency|"
-            "overlap|overlap_collective|serve)"
+            "overlap|overlap_collective|serve|integrity)"
         )
 
 

@@ -19,6 +19,8 @@ Usage:
     python scripts/tdt_lint.py --timeline        # flight-timeline smoke
     python scripts/tdt_lint.py --history         # bench-record trend gate
     python scripts/tdt_lint.py --serve           # scheduler overload smoke
+    python scripts/tdt_lint.py --integrity       # data-integrity gate
+    python scripts/tdt_lint.py --all             # every gate, one exit code
     python scripts/tdt_lint.py --json report.json
 
 ``--faults`` runs the ``tdt.resilience`` fault-injection matrix
@@ -45,6 +47,20 @@ queue drain after arrivals stop, every request terminal, and
 per-request isolation; then the fault matrix's scheduler cells
 (``resilience.run_scheduler_matrix``) must each be detected-or-
 survived.  Headless and CPU-only.
+
+``--integrity`` is the data-integrity gate (docs/robustness.md "Data
+integrity"): both corruption fault classes (``corrupt_payload`` — bytes
+flipped in flight; ``corrupt_kv_page`` — bytes flipped at rest) against
+every guarded kernel family through the record-mode checksum protocol,
+the scheduler KV-page-poison cell (audit detection + preemption-
+recompute recovery), and the live-verifier selftest battery (every
+``verify_*`` helper must catch a planted flip and pass the clean
+input; quarantine must open at its threshold).  Exit 1 on any
+undetected-unsurvived cell.  Headless and CPU-only.
+
+``--all`` runs every gate above — verify matrix, ``--faults``,
+``--timeline``, ``--serve``, ``--history``, ``--integrity`` — and
+summarizes them under a single exit code (the CI entry; see README).
 
 ``--history`` runs the bench-record trend sentinel
 (``scripts/bench_history.py --check``): exit 1 when a committed
@@ -92,12 +108,22 @@ def main(argv: list[str] | None = None) -> int:
                     help="scheduler overload smoke: seeded 64-request "
                          "trace with fault injection, zero leaked pages, "
                          "monotone drain; plus the scheduler fault cells")
+    ap.add_argument("--integrity", action="store_true",
+                    help="data-integrity gate: corruption fault classes "
+                         "over every kernel family, the scheduler "
+                         "KV-poison cell, and the verifier selftest")
+    ap.add_argument("--all", action="store_true", dest="all_gates",
+                    help="run every gate (verify matrix, --faults, "
+                         "--timeline, --serve, --history, --integrity) "
+                         "with one summarized exit code")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection target sampling seed (--faults)")
     ap.add_argument("--json", metavar="PATH",
                     help="also write the per-case results as JSON")
     args = ap.parse_args(argv)
 
+    if args.all_gates:
+        return _run_all(args)
     if args.faults:
         return _run_faults(args)
     if args.timeline:
@@ -106,6 +132,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_history(args)
     if args.serve:
         return _run_serve(args)
+    if args.integrity:
+        return _run_integrity(args)
 
     from triton_distributed_tpu import analysis
 
@@ -126,6 +154,14 @@ def main(argv: list[str] | None = None) -> int:
         print("selftest OK: every seeded-bad fixture flagged with the "
               "violating semaphore/chunk named; shipped kernels clean")
         return 0
+
+    return _run_verify(args)
+
+
+def _run_verify(args) -> int:
+    """The default leg: the static protocol verifier over every
+    registered kernel case."""
+    from triton_distributed_tpu import analysis
 
     ranks = tuple(int(r) for r in args.ranks.split(","))
     results = analysis.verify_all(ranks=ranks, kernel_filter=args.kernel)
@@ -154,6 +190,87 @@ def main(argv: list[str] | None = None) -> int:
             json.dump({"cases": rows, "violations": n_violations}, f,
                       indent=1, sort_keys=True)
     return 1 if n_violations else 0
+
+
+def _run_integrity(args) -> int:
+    """The data-integrity gate (see module docstring): record-mode
+    corruption matrix + scheduler poison cell + verifier selftest."""
+    from triton_distributed_tpu import resilience
+    from triton_distributed_tpu.resilience import integrity
+
+    rows, cells = resilience.run_integrity_cells(seed=args.seed)
+    for row in rows:
+        named = f"  [{', '.join(row['named'])}]" if row["named"] else ""
+        print(f"{row['kernel']:<24} {row['fault']:<16} "
+              f"{row['outcome'].upper():<9}{named}")
+    problems = resilience.verify_matrix(
+        rows, kinds=resilience.CORRUPTION_KINDS)
+
+    for cell in cells:
+        print(f"{cell['kernel']:<24} {cell['fault']:<16} "
+              f"{cell['outcome'].upper():<9} {cell['detail']}")
+    problems += resilience.verify_scheduler_matrix(cells)
+
+    selftest = integrity.run_selftest()
+    problems += [f"selftest: {p}" for p in selftest]
+    resilience.policy._reset_state_for_tests()   # the selftest's probe
+
+    for p in problems:
+        print(f"INTEGRITY FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"rows": rows, "scheduler_cells": cells,
+                       "problems": problems}, f, indent=1, sort_keys=True)
+    if problems:
+        return 1
+    print("integrity OK: every corruption cell detected with its "
+          "(semaphore, chunk, peer) named; poisoned KV page recovered "
+          "via preemption-recompute; verifier selftest clean")
+    return 0
+
+
+def _run_all(args) -> int:
+    """One aggregate CI entry: every gate, a summary table, one exit
+    code (the max of the legs; a crashed leg counts as 1)."""
+    import argparse as _ap
+    import traceback
+
+    def sub(**kw):
+        d = dict(vars(args))
+        d.update(kw, all_gates=False, json=None)
+        return _ap.Namespace(**d)
+
+    legs = [
+        ("verify", lambda: _run_verify(sub())),
+        ("faults", lambda: _run_faults(sub())),
+        ("timeline", lambda: _run_timeline(sub())),
+        ("serve", lambda: _run_serve(sub())),
+        ("history", lambda: _run_history(sub())),
+        # legs are deliberately self-contained: --faults and --serve
+        # overlap the integrity leg's corruption/poison cells (seconds
+        # of redundant work), but deduping would couple the legs' rng
+        # states so `--all`'s integrity leg no longer reproduced a
+        # standalone `--integrity` run
+        ("integrity", lambda: _run_integrity(sub())),
+    ]
+    results = []
+    for name, fn in legs:
+        print(f"\n=== tdt_lint --{name} " + "=" * max(0, 50 - len(name)))
+        try:
+            rc = int(fn())
+        except Exception:
+            traceback.print_exc()
+            rc = 1
+        results.append((name, rc))
+    print("\n=== summary " + "=" * 50)
+    for name, rc in results:
+        print(f"{name:<12} {'OK' if rc == 0 else f'FAIL (rc {rc})'}")
+    worst = max(rc for _, rc in results)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"legs": dict(results), "rc": worst}, f,
+                      indent=1, sort_keys=True)
+    return worst
 
 
 def _run_faults(args) -> int:

@@ -125,6 +125,8 @@ FAST_NODES = frozenset((
     "tests/test_serve.py::test_tdt_lint_serve_smoke",
     "tests/test_serve.py::test_overcommit_2x_budget_completes_all_zero_leaks",
     "tests/test_serve.py::test_healthz_flips_503_under_saturation_then_200",
+    "tests/test_integrity.py::test_matrix_corruption_cells_all_detected",
+    "tests/test_integrity.py::test_kv_poison_recovery_matches_unpressured_run",
 ))
 
 

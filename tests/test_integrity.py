@@ -1,0 +1,710 @@
+"""The end-to-end data-integrity plane (ISSUE 7): checksummed
+collective payloads, corruption fault classes, KV-page audit, and
+quarantine recovery.
+
+Everything here is headless (CPU-only, no kernels): the checksum
+protocol is exercised through record-mode traces, the live verifiers
+through host arrays, the ladder/quarantine through thunk doubles, and
+the KV audit through the deterministic SimBackend over the real
+paged-cache plumbing.
+"""
+
+import dataclasses
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from triton_distributed_tpu import obs, resilience as rz, serve
+from triton_distributed_tpu.resilience import integrity
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def integrity_on():
+    prev = integrity._ENABLED
+    integrity.enable(True)
+    rz.policy._reset_state_for_tests()
+    yield integrity
+    integrity.enable(prev)
+    rz.policy._reset_state_for_tests()
+
+
+@pytest.fixture()
+def obs_on():
+    prev = obs.enabled()
+    obs.enable(True)
+    obs.REGISTRY.reset()
+    yield obs
+    obs.enable(prev)
+    obs.REGISTRY.reset()
+
+
+# ---------------------------------------------------------------------------
+# the fold
+
+
+def test_fold32_sees_value_position_and_duplicates():
+    a = np.arange(32, dtype=np.float32)
+    assert integrity.fold32(a) == integrity.fold32(a.copy())
+    # value change
+    b = a.copy()
+    b[7] += 1
+    assert integrity.fold32(b) != integrity.fold32(a)
+    # position change (same multiset of words — an XOR/sum fold is
+    # blind to this)
+    assert integrity.fold32(a[::-1].copy()) != integrity.fold32(a)
+    # duplicated-word payloads (broadcast KV tiles): flipping one of N
+    # identical words must still move the fold
+    c = np.full((4, 8), 1000.0, np.float32)
+    d = c.copy()
+    d[2, 3] = 0.0
+    assert integrity.fold32(c) != integrity.fold32(d)
+    # dtype-agnostic byte exactness
+    assert integrity.fold32(a.view(np.int32)) == integrity.fold32(a)
+
+
+def test_fold32_sees_high_bit_flips_at_every_position():
+    # the position weight A*i+B (odd constants) is EVEN at every odd i,
+    # where a ±2^31 word delta (a float32 sign-bit flip — the canonical
+    # SDC) would cancel in the surviving low 32 bits; the `| 1` in the
+    # weight is what makes this pass
+    x = np.arange(64, dtype=np.float32) + 1.0
+    base = integrity.fold32(x)
+    for pos in range(x.size):
+        for bit in (31, 30):
+            r = x.copy().view(np.uint32)
+            r[pos] ^= np.uint32(1 << bit)
+            assert integrity.fold32(r.view(np.float32)) != base, (pos, bit)
+
+
+def test_verify_reduce_catches_small_magnitude_corruption():
+    # the per-element bound must scale with the ACCUMULATED magnitude
+    # (sum of |partials|), not the global max of the result: partials
+    # ~1 that cancel to ~0 leave an element a global-max bound would
+    # let be corrupted by ~rtol*max undetected
+    rng = np.random.default_rng(0)
+    n, m, r = 4, 8, 8
+    parts = rng.normal(size=(n * m, r)).astype(np.float32) * 2.0
+    for k in range(n):                       # partials ±1, sum ≈ 0
+        parts[k * m + 2, 3] = (-1.0) ** k * 1.0
+    parts[2, 3] += -1e-4
+    out = parts.reshape(n, m, r).sum(axis=0)
+    assert abs(out[2, 3]) < 1e-3
+    assert integrity.verify_reduce("ar", parts, out, n) is None
+    bad = out.copy()
+    bad[2, 3] = 0.074                        # far beyond rounding noise
+    d = integrity.verify_reduce("ar", parts, bad, n)
+    assert d is not None and d.chunk == "out[2]"
+
+
+# ---------------------------------------------------------------------------
+# record-mode checksum protocol
+
+
+def _case(name: str, n: int = 4):
+    from triton_distributed_tpu.analysis.registry import all_cases
+
+    return next(c for c in all_cases(ranks=(n,)) if c.name == name)
+
+
+def test_corrupt_payload_trace_names_sem_chunk_peer():
+    case = _case("allgather/push_1shot")
+    spec = rz.FaultSpec(rz.FaultKind.CORRUPT_PAYLOAD, rank=1, nth=0)
+    ft = rz.record_faulty_case(case, spec)
+    assert ft.fired and ft.corrupt
+    findings = integrity.check_traces(ft)
+    assert findings, "in-flight corruption must be caught at consumption"
+    d = findings[0]
+    assert d.kind == "payload"
+    assert d.sem and d.chunk
+    assert d.peer == 1            # the victim's own pushes carry its rank
+    # liveness is untouched: the protocol still completes cleanly
+    rz.run_bounded(ft)
+    assert rz.check_hazards(ft) == []
+
+
+def test_corrupt_kv_page_trace_names_poisoned_region():
+    case = _case("allgather/push_1shot")
+    spec = rz.FaultSpec(rz.FaultKind.CORRUPT_KV_PAGE, rank=2, nth=1)
+    ft = rz.record_faulty_case(case, spec)
+    assert ft.fired and ft.poisoned
+    findings = integrity.check_traces(ft)
+    assert findings
+    assert findings[0].kind == "kv_page"
+    assert findings[0].sem and findings[0].chunk
+
+
+def test_clean_traces_have_no_findings():
+    case = _case("reduce_scatter/ring")
+    # unreachable nth records clean traces (simulate.clean_ticks trick)
+    ft = rz.record_faulty_case(
+        case, rz.FaultSpec(rz.FaultKind.CORRUPT_PAYLOAD, rank=0,
+                           nth=10 ** 9))
+    assert integrity.check_traces(ft) == []
+
+
+def test_matrix_corruption_cells_all_detected():
+    rows = rz.run_matrix(seed=0, kinds=rz.CORRUPTION_KINDS)
+    # both classes x all 6 kernel families
+    assert len(rows) == 12
+    for row in rows:
+        assert row["outcome"] == "detected", row
+        assert row["named"], row
+    assert rz.verify_matrix(rows, kinds=rz.CORRUPTION_KINDS) == []
+
+
+# ---------------------------------------------------------------------------
+# the fault-matrix SHAPE golden (ISSUE 7 satellite): adding a FaultKind
+# without matrix coverage must fail LOUDLY here, not silently shrink
+# the guarantee
+
+
+# delay_notify applies only to kernels with a flat ``notify`` (the ring
+# pipelines); the pure-DMA protocols (push AG, A2A zones) have no
+# signal whose delivery can be delayed from the host side
+MATRIX_GOLDEN = {
+    ("allgather/push_1shot", "drop_notify"),
+    ("allgather/push_1shot", "stale_credit"),
+    ("allgather/push_1shot", "straggler"),
+    ("allgather/push_1shot", "rank_abort"),
+    ("allgather/push_1shot", "corrupt_payload"),
+    ("allgather/push_1shot", "corrupt_kv_page"),
+    ("reduce_scatter/ring", "drop_notify"),
+    ("reduce_scatter/ring", "delay_notify"),
+    ("reduce_scatter/ring", "stale_credit"),
+    ("reduce_scatter/ring", "straggler"),
+    ("reduce_scatter/ring", "rank_abort"),
+    ("reduce_scatter/ring", "corrupt_payload"),
+    ("reduce_scatter/ring", "corrupt_kv_page"),
+    ("allreduce/two_shot", "drop_notify"),
+    ("allreduce/two_shot", "delay_notify"),
+    ("allreduce/two_shot", "stale_credit"),
+    ("allreduce/two_shot", "straggler"),
+    ("allreduce/two_shot", "rank_abort"),
+    ("allreduce/two_shot", "corrupt_payload"),
+    ("allreduce/two_shot", "corrupt_kv_page"),
+    ("all_to_all/dispatch", "drop_notify"),
+    ("all_to_all/dispatch", "stale_credit"),
+    ("all_to_all/dispatch", "straggler"),
+    ("all_to_all/dispatch", "rank_abort"),
+    ("all_to_all/dispatch", "corrupt_payload"),
+    ("all_to_all/dispatch", "corrupt_kv_page"),
+    ("gemm_rs/ring", "drop_notify"),
+    ("gemm_rs/ring", "delay_notify"),
+    ("gemm_rs/ring", "stale_credit"),
+    ("gemm_rs/ring", "straggler"),
+    ("gemm_rs/ring", "rank_abort"),
+    ("gemm_rs/ring", "corrupt_payload"),
+    ("gemm_rs/ring", "corrupt_kv_page"),
+    ("gemm_ar/ring", "drop_notify"),
+    ("gemm_ar/ring", "delay_notify"),
+    ("gemm_ar/ring", "stale_credit"),
+    ("gemm_ar/ring", "straggler"),
+    ("gemm_ar/ring", "rank_abort"),
+    ("gemm_ar/ring", "corrupt_payload"),
+    ("gemm_ar/ring", "corrupt_kv_page"),
+}
+
+SCHEDULER_GOLDEN = {
+    ("rank_abort", "abort"),
+    ("straggler", "slack"),
+    ("straggler", "overrun"),
+    ("corrupt_kv_page", "poison"),
+}
+
+
+def test_fault_matrix_shape_pinned():
+    """A golden listing of every (kernel x fault-class) cell: a new
+    ``FaultKind`` that the matrix does not exercise shows up as a
+    missing golden entry; a silently-dropped cell shows up as a missing
+    run entry.  Either way the diff is the error message."""
+    rows = rz.run_matrix(seed=0)
+    cells = {(r["kernel"], r["fault"]) for r in rows}
+    assert cells == MATRIX_GOLDEN, (
+        f"matrix shape drifted: +{sorted(cells - MATRIX_GOLDEN)} "
+        f"-{sorted(MATRIX_GOLDEN - cells)}")
+    sched = {(r["fault"], r["leg"]) for r in rz.run_scheduler_matrix(0)}
+    assert sched == SCHEDULER_GOLDEN, (
+        f"scheduler cells drifted: +{sorted(sched - SCHEDULER_GOLDEN)} "
+        f"-{sorted(SCHEDULER_GOLDEN - sched)}")
+    # every declared fault class appears SOMEWHERE (kernel matrix or
+    # scheduler cells): this is the line that fails when a FaultKind is
+    # added without coverage
+    covered = {f for _, f in cells} | {f for f, _ in sched}
+    assert covered == {k.value for k in rz.FAULT_KINDS}, (
+        f"fault class(es) without any matrix cell: "
+        f"{sorted({k.value for k in rz.FAULT_KINDS} - covered)}")
+
+
+# ---------------------------------------------------------------------------
+# live verifiers + selftest
+
+
+def test_live_verifier_selftest_battery():
+    assert integrity.run_selftest() == []
+    rz.policy._reset_state_for_tests()   # the selftest's probe peer
+
+
+def test_verify_gather_attributes_the_peer():
+    x = np.arange(64, dtype=np.float32).reshape(16, 4)
+    bad = x.copy()
+    bad[9, 1] += 3.0                       # chunk 2 of 4 (rows 8..12)
+    d = integrity.verify_gather("all_gather", x, bad, 4)
+    assert d is not None and d.peer == 2
+    assert "recv_sems[2]" == d.sem
+
+
+# ---------------------------------------------------------------------------
+# the ladder: corruption -> retry -> fallback -> quarantine
+
+
+def test_corruption_rides_ladder_to_fallback(obs_on, integrity_on):
+    """A checked thunk that keeps returning corrupt data burns its
+    retry, then the ladder serves the XLA-fallback result; the
+    integrity counters reflect the checks."""
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    bad = x.copy()
+    bad.reshape(-1)[3] += 5.0
+
+    checked = integrity.checked(
+        "all_gather", lambda: bad, ranks=4,
+        verify=lambda out: integrity.verify_gather("all_gather", x, out, 4))
+    out = rz.resilient_call(
+        "all_gather", checked, fallback=lambda: x,
+        policy=rz.RetryPolicy(max_retries=1, backoff_ms=0.0))
+    np.testing.assert_array_equal(out, x)
+    counts = {(r["name"], r["labels"].get("kind")): r["value"]
+              for r in obs.REGISTRY.snapshot()}
+    assert counts.get(("integrity_checks", None)) == 2    # first + retry
+    assert counts.get(("integrity_failures", "payload")) == 2
+
+
+def test_repeated_corruption_quarantines_the_peer(obs_on, integrity_on):
+    """Attributable corruption from one peer walks its quarantine
+    breaker open; once open, every guarded call with a fallback routes
+    straight to the fallback and /healthz surfaces the peer."""
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    bad = x.copy()
+    bad[2, 1] += 9.0                        # chunk 1 -> peer 1
+
+    def one_call():
+        checked = integrity.checked(
+            "all_gather", lambda: bad, ranks=4,
+            verify=lambda out: integrity.verify_gather(
+                "all_gather", x, out, 4))
+        return rz.resilient_call(
+            "all_gather", checked, fallback=lambda: x,
+            policy=rz.RetryPolicy(max_retries=0, backoff_ms=0.0,
+                                  breaker_threshold=100))
+
+    for _ in range(integrity.quarantine_threshold()):
+        one_call()
+    assert integrity.quarantined_peers() == [1]
+    snap = rz.health_snapshot()
+    assert snap["quarantined_peers"] == [1]
+    assert snap["status"] == "degraded"     # open breaker => /healthz 503
+
+    # the quarantine rung: calls now route straight to the fallback
+    # WITHOUT running the corrupt thunk
+    ran = []
+    out = rz.resilient_call(
+        "all_gather", lambda: ran.append(1) or bad,
+        fallback=lambda: x, ranks=4)
+    np.testing.assert_array_equal(out, x)
+    assert not ran
+    degraded = [r for r in obs.REGISTRY.snapshot()
+                if r["name"] == "resilience_degraded_calls"
+                and r["labels"].get("reason") == "quarantined_peer"]
+    assert degraded and degraded[0]["value"] >= 1
+
+    integrity.reset_quarantine(1)
+    assert integrity.quarantined_peers() == []
+
+
+def test_verify_budget_widens_guarded_deadline(integrity_on,
+                                               monkeypatch):
+    """The consumer-side check runs INSIDE the watchdog deadline; a
+    wire-SOL budget alone would time out every verified call on a fast
+    slice, so guarded() must add the verification-cost term when
+    integrity is armed — and exactly zero when it is not."""
+    payload = 64 << 20
+    budget = integrity.verify_budget_ms(payload, 4)
+    assert budget > 100.0
+    seen = {}
+
+    def spy(op, thunk, deadline_ms, **kw):
+        seen[op] = deadline_ms
+        return thunk()
+
+    monkeypatch.setattr(rz.watchdog, "call_with_deadline", spy)
+    rz.guarded("all_gather", lambda: 1, payload_bytes=payload, ranks=4)()
+    base = rz.deadline_ms("all_gather", payload_bytes=payload,
+                          num_ranks=4)
+    assert seen["all_gather"] == pytest.approx(base + budget)
+    integrity.enable(False)
+    assert integrity.verify_budget_ms(payload, 4) == 0.0
+    rz.guarded("all_gather", lambda: 1, payload_bytes=payload, ranks=4)()
+    assert seen["all_gather"] == pytest.approx(base)
+
+
+def test_verify_reduce_tolerates_wire_dtype_accumulation():
+    """Legitimate bf16 ring-accumulation rounding ((n-1) steps in the
+    wire dtype) must NOT read as corruption — a deterministic false
+    positive would permanently degrade a healthy op — while a real flip
+    still lands orders of magnitude outside the scaled bound."""
+    import jax.numpy as jnp
+
+    n, m, r = 8, 16, 32
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((n * m, r)).astype(np.float32)
+    import ml_dtypes
+
+    bf16 = np.asarray(jnp.zeros((), jnp.bfloat16)).dtype
+    # worst-case legitimate drift: every element off by (n-1) half-ulps
+    exact = x.reshape(n, m, r).sum(0)
+    eps = float(ml_dtypes.finfo(bf16).eps)
+    drifted = (exact * (1.0 + (n - 1) * eps / 2)).astype(bf16)
+    assert integrity.verify_reduce("all_reduce", x, drifted, n) is None
+    flipped = exact.astype(bf16).copy()
+    flipped[3, 4] = -flipped[3, 4] + 2 ** 4   # sign/exponent-scale flip
+    assert integrity.verify_reduce("all_reduce", x, flipped, n) \
+        is not None
+
+
+def test_unattributable_corruption_never_quarantines(integrity_on):
+    xs = np.ones((16, 4), np.float32)
+    out = xs.reshape(4, 4, 4).sum(0)
+    bad = out.copy()
+    bad[0, 0] += 100.0
+    d = integrity.verify_reduce("all_reduce", xs, bad, 4)
+    assert d is not None and d.peer is None
+    assert not integrity.note_corruption("all_reduce", d.peer)
+    assert integrity.quarantined_peers() == []
+
+
+def test_corrupt_result_acts_even_after_trace_time_firing():
+    """Through a REAL kernel the trace-time hooks find the nth target
+    first (fired=True, live_unsupported noted — they cannot act); the
+    entry-level flip must still happen, exactly once."""
+    scope = rz.FaultScope(
+        rz.FaultSpec(rz.FaultKind.CORRUPT_PAYLOAD, rank=0, nth=0))
+    assert scope.on_remote_copy(None, None, None, None, 0) == "corrupt"
+    assert scope.fired
+    out = scope.corrupt_result(np.zeros(8, np.float32))
+    assert out.any(), "the live flip must act despite fired=True"
+    out2 = scope.corrupt_result(out.copy())
+    np.testing.assert_array_equal(out2, out)   # flips exactly once
+
+
+def test_selftest_survives_zero_quarantine_threshold(monkeypatch):
+    monkeypatch.setenv("TDT_QUARANTINE_THRESHOLD", "0")
+    assert integrity.run_selftest() == []
+    rz.policy._reset_state_for_tests()
+
+
+def test_fold_pages_matches_fold_page():
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.models.kv_cache import PagedKVCache
+
+    rng = np.random.default_rng(5)
+    k = jnp.asarray(rng.standard_normal((2, 6, 1, 4, 8)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((2, 6, 1, 4, 8)).astype(np.float32))
+    cache = PagedKVCache(k=k, v=v,
+                         block_table=jnp.zeros((1, 6), jnp.int32),
+                         seq_lens=jnp.zeros((1,), jnp.int32))
+    batched = integrity.fold_pages(cache, [1, 3, 4])
+    assert batched == {p: integrity.fold_page(cache, p) for p in (1, 3, 4)}
+    assert integrity.fold_pages(cache, []) == {}
+
+
+def test_live_fault_scope_injects_through_checked(integrity_on):
+    """The LIVE corrupt_payload lever: a clean thunk inside a fault
+    scope comes out flipped, and the consumer-side check catches it."""
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    scope = rz.FaultScope(
+        rz.FaultSpec(rz.FaultKind.CORRUPT_PAYLOAD, rank=0, nth=5))
+    checked = integrity.checked(
+        "all_gather", lambda: x.copy(), ranks=4,
+        verify=lambda out: integrity.verify_gather("all_gather", x, out, 4))
+    with rz.scoped(scope):
+        with pytest.raises(rz.PayloadCorruption) as ei:
+            checked()
+    assert scope.fired
+    assert ei.value.diagnosis is not None
+    assert ei.value.diagnosis.chunk
+
+
+# ---------------------------------------------------------------------------
+# EP fallbacks (ISSUE 7 satellite: the full ladder on all 8 entries)
+
+
+def _ep_case(n=4, t=16, h=8, seed=0):
+    rng = np.random.default_rng(seed)
+    e_tot = 2 * n
+    xs, sps = [], []
+    for r in range(n):
+        w = rng.random(e_tot)
+        split = np.floor(w / w.sum() * t).astype(np.int32)
+        split[0] += t - split.sum()
+        tag = (r * 1000 + np.arange(t)).astype(np.float32)
+        xs.append(np.broadcast_to(tag[:, None], (t, h)).copy())
+        sps.append(split)
+    return np.concatenate(xs), np.concatenate(sps)
+
+
+class _MeshLike:
+    def __init__(self, n, axis="ep"):
+        self.shape = {axis: n}
+
+
+def test_xla_ep_fallbacks_round_trip_and_zone_golden():
+    from triton_distributed_tpu.comm.all_to_all import AllToAllConfig
+    from triton_distributed_tpu.resilience.fallbacks import (
+        xla_ep_combine, xla_ep_dispatch,
+    )
+
+    n, t, h = 4, 16, 8
+    x, splits = _ep_case(n, t, h)
+    mesh = _MeshLike(n)
+    cfg = AllToAllConfig(chunk=8)
+    recv, recv_splits = xla_ep_dispatch(x, splits, mesh, "ep", config=cfg)
+    epr = splits.shape[0] // n // n
+    sp = splits.reshape(n, n * epr)
+    for dst in range(n):
+        for src in range(n):
+            cnt = sp[src, dst * epr:(dst + 1) * epr].sum()
+            start = sp[src, :dst * epr].sum()
+            want = src * 1000 + np.arange(start, start + cnt)
+            np.testing.assert_array_equal(
+                np.asarray(recv)[dst * n + src, :cnt, 0], want)
+            np.testing.assert_array_equal(
+                np.asarray(recv_splits)[dst * n + src],
+                sp[src, dst * epr:(dst + 1) * epr])
+    back = xla_ep_combine(recv, splits, mesh, "ep", token_dim=t,
+                          config=cfg)
+    np.testing.assert_allclose(np.asarray(back), x)
+    # the zone verifiers pass the fallback's own layout (clean path)
+    assert integrity.verify_ep_dispatch(
+        "ep_dispatch", x, splits, (recv, recv_splits), n) is None
+    assert integrity.verify_ep_combine(
+        "ep_combine", recv, splits, back, n, t) is None
+
+
+def test_ep_ladder_degrades_to_zone_fallback():
+    """The satellite contract: a stalled EP dispatch now has a rung
+    below the watchdog — the ladder serves the zone-layout fallback
+    instead of propagating the timeout."""
+    from triton_distributed_tpu.comm.all_to_all import AllToAllConfig
+    from triton_distributed_tpu.resilience.fallbacks import xla_ep_dispatch
+
+    n, t, h = 4, 16, 8
+    x, splits = _ep_case(n, t, h, seed=3)
+    mesh = _MeshLike(n)
+    cfg = AllToAllConfig(chunk=8)
+    rz.policy._reset_state_for_tests()
+
+    def stuck():
+        raise rz.CollectiveTimeoutError("ep_dispatch", 1.0)
+
+    recv, recv_splits = rz.resilient_call(
+        "ep_dispatch", stuck,
+        fallback=lambda: xla_ep_dispatch(x, splits, mesh, "ep",
+                                         config=cfg),
+        policy=rz.RetryPolicy(max_retries=0, backoff_ms=0.0))
+    assert np.asarray(recv).shape[0] == n * n
+    assert np.asarray(recv_splits).shape == (n * n,
+                                             splits.shape[0] // n // n)
+    rz.policy._reset_state_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# KV-pool audit: poison -> detect -> preemption-recompute recovery
+
+
+def _expected_tokens(backend, req):
+    return backend.expected_tokens(req)
+
+
+def _run_poisoned(poison: bool, *, pool_pages=32):
+    backend = serve.SimBackend(slots=3, page_size=4, pool_pages=pool_pages,
+                               max_length=64)
+    sched = serve.Scheduler(backend, serve.SchedulerConfig(
+        kv_audit_interval_steps=2))
+    reqs = [serve.Request(prompt=(11 + i, 12 + i, 13 + i, 14 + i, 15 + i),
+                          max_new_tokens=9, priority=i)
+            for i in range(3)]
+    for r in reqs:
+        sched.submit(r)
+    fired = False
+    for _ in range(400):
+        res = sched.step()
+        if poison and not fired:
+            cand = next(
+                (s for s in sched.slots
+                 if s is not None and s.page_stamps
+                 and s.request.state is serve.RequestState.DECODE), None)
+            if cand is not None:
+                page = int(cand.pages[max(cand.page_stamps)])
+                sched.cache = dataclasses.replace(
+                    sched.cache,
+                    k=sched.cache.k.at[:, page].add(1000.0))
+                fired = True
+        if res.idle and (fired or not poison):
+            break
+    return backend, sched, reqs, fired
+
+
+def test_kv_poison_recovery_matches_unpressured_run(integrity_on):
+    """The acceptance pin: a poisoned-and-recovered run produces
+    byte-identical tokens to an unpoisoned run — recovery through the
+    preemption-recompute path is invisible in outputs."""
+    b0, s0, clean_reqs, _ = _run_poisoned(False)
+    b1, s1, poisoned_reqs, fired = _run_poisoned(True)
+    assert fired
+    assert s0.kv_corruptions == [] and s0.preemptions == 0
+    assert s1.kv_corruptions, "the audit must name the poisoned page"
+    assert s1.preemptions >= 1
+    assert {"req_id", "page", "logical", "step"} <= \
+        set(s1.kv_corruptions[0])
+    for r in poisoned_reqs:
+        assert r.state is serve.RequestState.DONE
+        assert r.tokens == _expected_tokens(b1, r)
+    assert {tuple(r.prompt): tuple(r.tokens) for r in clean_reqs} == \
+        {tuple(r.prompt): tuple(r.tokens) for r in poisoned_reqs}
+    assert s1.pool.used_pages == 0
+
+
+def test_kv_audit_off_is_byte_identical_bookkeeping():
+    """TDT_INTEGRITY unset: no stamps, no audits, no corruption
+    entries, no kv_stamps carried — the scheduler path is untouched."""
+    assert not integrity.enabled()
+    backend = serve.SimBackend(slots=2, page_size=4, pool_pages=9,
+                               max_length=48)
+    sched = serve.Scheduler(backend)
+    reqs = [serve.Request(prompt=(3, 4, 5, 6, 7, 8), max_new_tokens=8)
+            for _ in range(3)]
+    for r in reqs:
+        sched.submit(r)
+    sched.run_until_idle(max_steps=400)
+    assert sched.kv_corruptions == []
+    assert all(s is None or not s.page_stamps for s in sched.slots)
+    assert all(r.kv_stamps is None for r in reqs)
+
+
+def test_verify_on_preempt_restore_fails_divergent_recompute(
+        integrity_on):
+    """A preempted request whose carried stamp does not match the
+    recomputed page must FAIL with the corruption named — shipping
+    either copy would ship bytes nobody can vouch for."""
+    backend = serve.SimBackend(slots=2, page_size=4, pool_pages=7,
+                               max_length=48)
+    sched = serve.Scheduler(backend, serve.SchedulerConfig(
+        kv_audit_interval_steps=1))
+    victim = serve.Request(prompt=(5, 6, 7, 8, 9), max_new_tokens=12,
+                           priority=0)
+    other = serve.Request(prompt=(1, 2, 3, 4, 5), max_new_tokens=12,
+                          priority=1)
+    sched.submit(victim)
+    sched.submit(other)
+    tampered = False
+    for _ in range(400):
+        sched.step()
+        if victim.state is serve.RequestState.PREEMPTED and \
+                victim.kv_stamps and not tampered:
+            victim.kv_stamps = {j: s ^ 0xDEADBEEF
+                                for j, s in victim.kv_stamps.items()}
+            tampered = True
+        if victim.done and other.done:
+            break
+    assert tampered, "the tight pool must have preempted the victim"
+    assert victim.state is serve.RequestState.FAILED
+    assert "PayloadCorruption" in victim.error
+    assert other.state is serve.RequestState.DONE
+    assert sched.pool.used_pages == 0
+
+
+def test_repreemption_preserves_original_restore_stamps(integrity_on):
+    """A second preemption DURING a restore prefill must not replace
+    the original-write carry with stamps of the still-unverified
+    recompute — every restore verifies against the original write."""
+    backend = serve.SimBackend(slots=2, page_size=4, pool_pages=16,
+                               max_length=48)
+    sched = serve.Scheduler(backend)
+    req = serve.Request(prompt=(1, 2, 3, 4, 5), max_new_tokens=4)
+    sched.submit(req)
+    sched.step()    # admit + prefill
+    i = next(k for k, s in enumerate(sched.slots) if s is not None)
+    slot = sched.slots[i]
+    assert slot.page_stamps      # audit stamped the full prompt page
+    original_carry = {0: 123456}     # a pending, unverified carry
+    req.kv_stamps = dict(original_carry)
+    sched._preempt_slot(i)
+    assert req.kv_stamps == original_carry
+
+
+# ---------------------------------------------------------------------------
+# eager queue-deadline sweep (ISSUE 7 satellite)
+
+
+def test_submit_sweeps_expired_queue_entries():
+    """Dead queued requests must not occupy depth against a live
+    submit: without the eager sweep, a queue 'full' of expired entries
+    sheds viable work and inflates the saturation gauges."""
+    backend = serve.SimBackend(slots=1, page_size=4, pool_pages=16,
+                               max_length=48)
+    sched = serve.Scheduler(backend, serve.SchedulerConfig(
+        max_queue_depth=2))
+    # two requests whose deadline is already blown at submit time
+    dead = [serve.Request(prompt=(1, 2), max_new_tokens=2,
+                          deadline_ms=0.001) for _ in range(2)]
+    now = 100.0
+    for r in dead:
+        assert sched.submit(r, now=now)
+    assert sched.queue.depth == 2
+    # a later live submit sweeps them instead of shedding itself
+    live = serve.Request(prompt=(3, 4), max_new_tokens=2)
+    assert sched.submit(live, now=now + 10.0)
+    assert live.state is serve.RequestState.QUEUED
+    assert sched.queue.depth == 1
+    for r in dead:
+        assert r.state is serve.RequestState.SHED
+        assert "expired in queue" in r.shed_reason
+    assert len(sched.shed) == 2
+    sched.run_until_idle(max_steps=100)
+    assert live.state is serve.RequestState.DONE
+
+
+# ---------------------------------------------------------------------------
+# CLI gate
+
+
+def test_tdt_lint_integrity_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tdt_lint.py"),
+         "--integrity"],
+        capture_output=True, text=True, timeout=560,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "integrity OK" in proc.stdout
+    assert proc.stdout.count("DETECTED") >= 13   # 12 kernel + 1 sched
+
+
+def test_entry_points_unwrapped_without_env(monkeypatch):
+    """TDT_INTEGRITY unset => integrity.enabled() is False and the
+    entry points never construct the checked wrapper (the byte-identity
+    discipline all the env gates share)."""
+    monkeypatch.delenv("TDT_INTEGRITY", raising=False)
+    assert integrity.enable(None) is False
+    assert not integrity.enabled()
+    called = []
+    monkeypatch.setattr(integrity, "checked",
+                        lambda *a, **k: called.append(a) or a[1])
+    # the entries guard with integrity.enabled() BEFORE touching
+    # checked(); quarantine_blocks is inert too
+    assert not integrity.quarantine_blocks(8)
+    assert called == []

@@ -50,15 +50,10 @@ def obs_on():
 
 
 def _expected_tokens(backend: serve.SimBackend, req: serve.Request):
-    """Replay the SimBackend's deterministic generation rule from the
-    prompt alone — the golden for completed requests AND for the
-    recompute-after-preemption contract."""
-    toks = [backend.next_token(req.prompt[-1], req.prompt_len)]
-    length = req.prompt_len
-    while len(toks) < req.max_new_tokens:
-        length += 1
-        toks.append(backend.next_token(toks[-1], length))
-    return toks
+    """The golden for completed requests AND for the
+    recompute-after-preemption contract (one home:
+    ``SimBackend.expected_tokens``)."""
+    return backend.expected_tokens(req)
 
 
 # ---------------------------------------------------------------------------
@@ -320,13 +315,15 @@ def test_scheduler_fault_matrix_cells():
     """The ISSUE 6 fault-matrix satellite: every scheduler cell
     detected-or-survived with per-request isolation."""
     rows = resilience.run_scheduler_matrix(seed=0)
-    assert {r["leg"] for r in rows} == {"abort", "slack", "overrun"}
+    assert {r["leg"] for r in rows} == {"abort", "slack", "overrun",
+                                        "poison"}
     problems = resilience.verify_scheduler_matrix(rows)
     assert problems == [], problems
     outcomes = {r["leg"]: r["outcome"] for r in rows}
     assert outcomes["abort"] == "detected"
     assert outcomes["slack"] == "survived"
     assert outcomes["overrun"] == "detected"
+    assert outcomes["poison"] == "detected"
 
 
 def test_admission_governor_shrinks_and_recovers():

@@ -366,13 +366,23 @@ def ep_dispatch(
     cfg = config or AllToAllConfig()
     payload = t * x.shape[1] * jnp.dtype(x.dtype).itemsize
     core = lambda: _ep_dispatch_diff(mesh, axis, cfg, x, splits)  # noqa: E731
+    if eager and resilience.integrity.enabled():
+        # consumer-side checksum verification (TDT_INTEGRITY=1): zones
+        # land row blocks verbatim — fold-exact, peer-attributable
+        core = resilience.integrity.checked(
+            "ep_dispatch", core, ranks=n,
+            verify=lambda out: resilience.integrity.verify_ep_dispatch(
+                "ep_dispatch", x, splits, out, n))
     if eager and resilience.enabled():
-        # watchdog-only: the ragged zone layout has no one-line jax.lax
-        # equivalent, so a stall is DETECTED (named) rather than degraded
-        # (docs/robustness.md "degradation ladder")
+        # the FULL ladder (ISSUE 7 satellite; PR 3 left these
+        # watchdog-only): retry -> degraded zone-layout gather
+        # (fallbacks.xla_ep_dispatch) -> breaker, uniform with the
+        # other 6 entry points
         core = resilience.guarded(
             "ep_dispatch", core, family="all_to_all", ranks=n,
             payload_bytes=payload,
+            fallback=lambda: resilience.fallbacks.xla_ep_dispatch(
+                x, splits, mesh, axis, config=cfg),
         )
     if eager and (obs.enabled() or obs.flight.enabled()):
         chunk = min(cfg.chunk, _round_up(max(t, 1), 8))
@@ -449,11 +459,19 @@ def ep_combine(
     payload = token_dim * y.shape[-1] * jnp.dtype(y.dtype).itemsize
     core = lambda: _ep_combine_diff(mesh, axis, cfg, token_dim, y,  # noqa: E731
                                     splits)
+    if eager and resilience.integrity.enabled():
+        # consumer-side checksum verification (see ep_dispatch)
+        core = resilience.integrity.checked(
+            "ep_combine", core, ranks=n,
+            verify=lambda out: resilience.integrity.verify_ep_combine(
+                "ep_combine", y, splits, out, n, token_dim))
     if eager and resilience.enabled():
-        # watchdog-only, like ep_dispatch
+        # the FULL ladder, uniform with ep_dispatch (ISSUE 7 satellite)
         core = resilience.guarded(
             "ep_combine", core, family="all_to_all", ranks=n,
             payload_bytes=payload,
+            fallback=lambda: resilience.fallbacks.xla_ep_combine(
+                y, splits, mesh, axis, token_dim=token_dim, config=cfg),
         )
     if eager and (obs.enabled() or obs.flight.enabled()):
         chunk = min(cfg.chunk, _round_up(max(token_dim, 1), 8))

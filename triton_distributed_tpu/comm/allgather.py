@@ -363,6 +363,15 @@ def all_gather(
     # once, at trace time — obs would record one phantom sample per
     # compile, and a host-side watchdog cannot bound a traced subcall
     eager = not _is_tracer(x)
+    if eager and resilience.integrity.enabled():
+        # consumer-side checksum verification (TDT_INTEGRITY=1,
+        # docs/robustness.md "Data integrity"): AG delivers shards
+        # verbatim, so the per-chunk fold is byte-exact and a mismatch
+        # names its producing peer (quarantine-attributable)
+        core = resilience.integrity.checked(
+            "all_gather", core, ranks=n,
+            verify=lambda out: resilience.integrity.verify_gather(
+                "all_gather", x, out, n))
     if eager and resilience.enabled():
         core = resilience.guarded(
             "all_gather", core, family="allgather", ranks=n,

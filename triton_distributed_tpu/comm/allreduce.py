@@ -445,6 +445,13 @@ def all_reduce(
     core = lambda: _all_reduce_core(mesh, axis, method, out_dtype,  # noqa: E731
                                     cfg, x)
     eager = not is_tracer(x)  # eager calls only (see all_gather)
+    if eager and resilience.integrity.enabled():
+        # consumer-side re-reduction check (TDT_INTEGRITY=1; see
+        # reduce_scatter — detected-but-unattributable)
+        core = resilience.integrity.checked(
+            "all_reduce", core, ranks=n,
+            verify=lambda out: resilience.integrity.verify_reduce(
+                "all_reduce", x, out, n))
     if eager and resilience.enabled():
         core = resilience.guarded(
             "all_reduce", core, family="allreduce", ranks=n,

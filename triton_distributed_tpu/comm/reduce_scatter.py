@@ -310,6 +310,14 @@ def reduce_scatter(
     chunk_bytes = m_loc * x.shape[1] * jnp.dtype(x.dtype).itemsize
     core = lambda: _reduce_scatter_core(mesh, axis, cfg, x)  # noqa: E731
     eager = not is_tracer(x)  # eager calls only (see all_gather)
+    if eager and resilience.integrity.enabled():
+        # consumer-side re-reduction check (TDT_INTEGRITY=1): reductions
+        # mix every peer's bytes, so a mismatch is detected-but-
+        # unattributable (ladder yes, quarantine no)
+        core = resilience.integrity.checked(
+            "reduce_scatter", core, ranks=n,
+            verify=lambda out: resilience.integrity.verify_reduce(
+                "reduce_scatter", x, out, n))
     if eager and resilience.enabled():
         core = resilience.guarded(
             "reduce_scatter", core, family="reduce_scatter", ranks=n,

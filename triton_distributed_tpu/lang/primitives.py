@@ -385,11 +385,21 @@ def remote_copy(
                                   start=start)
         if action == "drop_recv":
             scope.mark_dropped_recv(len(rec.events) - 1)
+        elif action == "corrupt":
+            # the copy executes and credits normally; the PAYLOAD is
+            # marked flipped in flight — only the checksum protocol
+            # (resilience.integrity) can see it
+            scope.mark_corrupt(len(rec.events) - 1)
         return desc
     if action == "drop_recv":
         # losing only the DMA completion signal (data landed, signal
         # didn't) is not expressible through the Pallas DMA API
         scope.mark_live_unsupported("drop_recv")
+    elif action == "corrupt":
+        # in-kernel payload bytes are not host-reachable at trace time;
+        # live corruption injects through the consumer-side verification
+        # layer instead (FaultScope.corrupt_result via integrity.checked)
+        scope.mark_live_unsupported("corrupt_payload")
     copy = pltpu.make_async_remote_copy(
         src_ref=src,
         dst_ref=dst,
@@ -434,15 +444,23 @@ def wait_recv(dst_ref, sem) -> None:
     flags / ``signal_wait_until``).
     """
     scope = active_fault_scope()
-    if scope is not None:
-        scope.on_wait_recv(dst_ref, sem)
+    action = scope.on_wait_recv(dst_ref, sem) if scope is not None else None
     fl = _flight()
     if fl is not None:
         fl.on_wait_recv(dst_ref, sem)
     rec = active_recorder()
     if rec is not None:
         rec.on_wait_recv(dst_ref, sem)
+        if action == "poison":
+            # the guarded landing region is marked poisoned at rest
+            # (settled DMA, bytes flipped before consumption)
+            scope.mark_poisoned(len(rec.events) - 1)
         return
+    if action == "poison":
+        # at-rest flips of device memory are not host-reachable from a
+        # traced kernel; live injection rides the entry-level hook
+        # (FaultScope.corrupt_result) and the serve KV-audit cells
+        scope.mark_live_unsupported("corrupt_kv_page")
     pltpu.make_async_copy(dst_ref, dst_ref, sem).wait()
 
 

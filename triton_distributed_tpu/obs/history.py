@@ -163,6 +163,10 @@ def direction_for(metric: str, unit: str) -> str:
     if u.startswith("ms") or u.startswith("us") or "ms/" in u \
             or metric.startswith("latency"):
         return "lower"
+    # cost/tax metrics (e.g. integrity_overhead_pct, "% over plain"):
+    # growth is the regression the sentinel must warn on
+    if "overhead" in metric or "over plain" in u:
+        return "lower"
     return "higher"
 
 

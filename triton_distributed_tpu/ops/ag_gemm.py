@@ -340,17 +340,25 @@ def ag_gemm(
     from .. import resilience
     from ..tune.autotuner import is_tracer
 
-    if resilience.enabled() and not is_tracer(a):
+    core = lambda: _ag_gemm_core(mesh, axis, cfg, bool(bidir),  # noqa: E731
+                                 out_dtype, a, b)
+    eager = not is_tracer(a)
+    if eager and resilience.integrity.enabled():
+        # consumer-side Freivalds verification (TDT_INTEGRITY=1): a
+        # corrupt chunk raises PayloadCorruption and rides the ladder
+        core = resilience.integrity.checked(
+            "ag_gemm", core, ranks=n,
+            verify=lambda out: resilience.integrity.verify_gemm(
+                "ag_gemm", a, b, out))
+    if eager and resilience.enabled():
         # eager calls only (see comm/allgather.py): ride the failure
         # ladder — watchdog deadline from the AG wire estimate, degraded
         # fallback = unfused XLA AllGather + local GEMM
         return resilience.guarded(
-            "ag_gemm",
-            lambda: _ag_gemm_core(mesh, axis, cfg, bool(bidir), out_dtype,
-                                  a, b),
+            "ag_gemm", core,
             family="ag_gemm", ranks=n,
             payload_bytes=(m_tot // n) * k_dim * jnp.dtype(a.dtype).itemsize,
             fallback=lambda: resilience.fallbacks.xla_ag_gemm(
                 a, b, mesh, axis, out_dtype),
         )()
-    return _ag_gemm_core(mesh, axis, cfg, bool(bidir), out_dtype, a, b)
+    return core()

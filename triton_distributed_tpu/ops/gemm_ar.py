@@ -277,15 +277,22 @@ def gemm_ar(
     from .. import resilience
     from ..tune.autotuner import is_tracer
 
-    if resilience.enabled() and not is_tracer(a):
+    core = lambda: _gemm_ar_core(mesh, axis, cfg, out_dtype, a, b)  # noqa: E731
+    eager = not is_tracer(a)
+    if eager and resilience.integrity.enabled():
+        # consumer-side Freivalds verification (TDT_INTEGRITY=1)
+        core = resilience.integrity.checked(
+            "gemm_ar", core, ranks=n,
+            verify=lambda out: resilience.integrity.verify_gemm(
+                "gemm_ar", a, b, out))
+    if eager and resilience.enabled():
         # eager calls only (see comm/allgather.py): watchdog + ladder,
         # degraded fallback = local partial GEMM + XLA AllReduce
         return resilience.guarded(
-            "gemm_ar",
-            lambda: _gemm_ar_core(mesh, axis, cfg, out_dtype, a, b),
+            "gemm_ar", core,
             family="gemm_ar", ranks=n,
             payload_bytes=m_tot * n_dim * jnp.dtype(out_dtype).itemsize,
             fallback=lambda: resilience.fallbacks.xla_gemm_ar(
                 a, b, mesh, axis, out_dtype),
         )()
-    return _gemm_ar_core(mesh, axis, cfg, out_dtype, a, b)
+    return core()

@@ -280,15 +280,22 @@ def gemm_rs(
     from .. import resilience
     from ..tune.autotuner import is_tracer
 
-    if resilience.enabled() and not is_tracer(a):
+    core = lambda: _gemm_rs_core(mesh, axis, cfg, out_dtype, a, b)  # noqa: E731
+    eager = not is_tracer(a)
+    if eager and resilience.integrity.enabled():
+        # consumer-side Freivalds verification (TDT_INTEGRITY=1)
+        core = resilience.integrity.checked(
+            "gemm_rs", core, ranks=n,
+            verify=lambda out: resilience.integrity.verify_gemm(
+                "gemm_rs", a, b, out))
+    if eager and resilience.enabled():
         # eager calls only (see comm/allgather.py): watchdog + ladder,
         # degraded fallback = local partial GEMM + XLA ReduceScatter
         return resilience.guarded(
-            "gemm_rs",
-            lambda: _gemm_rs_core(mesh, axis, cfg, out_dtype, a, b),
+            "gemm_rs", core,
             family="gemm_rs", ranks=n,
             payload_bytes=m_loc * n_dim * jnp.dtype(out_dtype).itemsize * n,
             fallback=lambda: resilience.fallbacks.xla_gemm_rs(
                 a, b, mesh, axis, out_dtype),
         )()
-    return _gemm_rs_core(mesh, axis, cfg, out_dtype, a, b)
+    return core()

@@ -33,14 +33,17 @@ the collective entry points behave exactly as before.
 
 from __future__ import annotations
 
-from . import fallbacks, faults, matrix, policy, simulate, watchdog
+from . import fallbacks, faults, integrity, matrix, policy, simulate, watchdog
 from .errors import (
     CircuitOpenError,
     CollectiveTimeoutError,
+    CorruptionDiagnosis,
+    PayloadCorruption,
     PendingWait,
     TimeoutDiagnosis,
 )
 from .faults import (
+    CORRUPTION_KINDS,
     FAULT_KINDS,
     FaultKind,
     FaultScope,
@@ -52,6 +55,7 @@ from .faults import (
     scoped,
 )
 from .matrix import (
+    run_integrity_cells,
     run_matrix,
     run_scheduler_matrix,
     verify_matrix,
@@ -72,15 +76,18 @@ from .simulate import SimResult, check_hazards, clean_ticks, run_bounded
 from .watchdog import call_with_deadline, deadline_ms, protocol_pending
 
 __all__ = [
-    "AdmissionGovernor", "CircuitBreaker", "CircuitOpenError",
-    "CollectiveTimeoutError",
+    "AdmissionGovernor", "CORRUPTION_KINDS", "CircuitBreaker",
+    "CircuitOpenError", "CollectiveTimeoutError", "CorruptionDiagnosis",
     "DEFAULT_POLICY", "FAULT_KINDS", "FaultKind", "FaultScope", "FaultSpec",
-    "FaultyTraces", "PendingWait", "RankAborted", "RetryPolicy", "SimResult",
+    "FaultyTraces", "PayloadCorruption", "PendingWait", "RankAborted",
+    "RetryPolicy", "SimResult",
     "TimeoutDiagnosis", "breaker", "call_with_deadline", "check_hazards",
     "clean_ticks", "deadline_ms", "enable", "enabled", "fallbacks", "faults",
-    "guarded", "health_snapshot", "matrix", "policy", "protocol_pending",
+    "guarded", "health_snapshot", "integrity", "matrix", "policy",
+    "protocol_pending",
     "record_faulty_case", "reset_breaker", "resilient_call", "run_bounded",
-    "run_matrix", "run_scheduler_matrix", "sample_spec", "scoped",
+    "run_integrity_cells", "run_matrix", "run_scheduler_matrix",
+    "sample_spec", "scoped",
     "simulate", "suppress", "suppressed_thunk", "verify_matrix",
     "verify_scheduler_matrix", "watchdog",
 ]

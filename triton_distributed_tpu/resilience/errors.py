@@ -105,6 +105,61 @@ class CollectiveTimeoutError(RuntimeError):
         super().__init__(f"{head}: {body}")
 
 
+@dataclasses.dataclass(frozen=True)
+class CorruptionDiagnosis:
+    """Protocol-state snapshot attached to a data-integrity failure —
+    the corruption analogue of :class:`TimeoutDiagnosis`.
+
+    ``sem``/``chunk``/``peer`` name the semaphore whose credit gated the
+    corrupt transfer, the destination region whose bytes differ from
+    what the producer stamped, and the producing rank (``None`` when the
+    op is a reduction whose output mixes every peer's contribution —
+    unattributable corruption rides the ladder but cannot quarantine).
+    ``kind``: ``"payload"`` (bytes changed in flight — the checksum that
+    arrived beside the credit does not match the data) or ``"kv_page"``
+    (bytes changed at rest — the region verified clean at arrival but
+    differs at consumption / audit time).
+    """
+
+    op: str
+    kind: str                  # "payload" | "kv_page"
+    sem: str | None = None     # semaphore label guarding the transfer
+    chunk: str | None = None   # destination region label
+    peer: int | None = None    # producing rank, when attributable
+    note: str = ""
+
+    def describe(self) -> str:
+        s = f"{self.kind} corruption in {self.op!r}"
+        if self.chunk is not None:
+            s += f": region {self.chunk}"
+        if self.sem is not None:
+            s += f" gated by semaphore {self.sem}"
+        if self.peer is not None:
+            s += f", produced by rank {self.peer}"
+        if self.note:
+            s += f" ({self.note})"
+        return s
+
+
+class PayloadCorruption(RuntimeError):
+    """A consumer-side integrity check failed: the bytes that arrived
+    are NOT the bytes that were sent (or the bytes at rest are no longer
+    the bytes that were written).  Carries a
+    :class:`CorruptionDiagnosis` naming (semaphore, chunk, peer) exactly
+    as :class:`CollectiveTimeoutError` names a stall; the policy layer
+    retries (a transient flip), degrades to the XLA fallback, and
+    QUARANTINES a peer that corrupts repeatedly
+    (``resilience.integrity``, docs/robustness.md "Data integrity")."""
+
+    def __init__(self, op: str, diagnosis: CorruptionDiagnosis | None = None):
+        self.op = op
+        self.diagnosis = diagnosis
+        body = diagnosis.describe() if diagnosis is not None else \
+            "no diagnosis available"
+        super().__init__(f"collective {op!r} payload failed verification: "
+                         f"{body}")
+
+
 class CircuitOpenError(RuntimeError):
     """The sticky circuit breaker for an op is open and no degraded
     fallback exists — the caller must shed or reroute this op."""

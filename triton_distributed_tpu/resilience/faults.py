@@ -23,6 +23,15 @@ symmetric-memory communication ("Demystifying NVSHMEM", PAPERS.md):
 ``STRAGGLER``       one rank enters the kernel late by ``delay`` ticks.
 ``RANK_ABORT``      one rank dies mid-kernel: its nth primitive call
                     raises and nothing after it executes.
+``CORRUPT_PAYLOAD`` the nth ``remote_copy``'s payload is flipped IN
+                    FLIGHT: the credit arrives, the bytes are wrong —
+                    the silent-data-corruption class host-side checks
+                    never see on device-initiated transfers (ISSUE 7).
+``CORRUPT_KV_PAGE`` bytes are flipped AT REST: the landing region the
+                    nth ``wait_recv`` guards is poisoned after the DMA
+                    settled but before consumption — the kernel-level
+                    analogue of a poisoned paged-KV page between
+                    scheduler steps (``resilience.integrity``).
 ==================  ======================================================
 
 Injection composes with record mode: ``record_faulty_case`` records every
@@ -54,9 +63,16 @@ class FaultKind(enum.Enum):
     STALE_CREDIT = "stale_credit"
     STRAGGLER = "straggler"
     RANK_ABORT = "rank_abort"
+    CORRUPT_PAYLOAD = "corrupt_payload"
+    CORRUPT_KV_PAGE = "corrupt_kv_page"
 
 
 FAULT_KINDS = tuple(FaultKind)
+
+# the silent-data-corruption classes: liveness is unaffected (credits
+# balance, the protocol completes on time) — only the checksum protocol
+# (``resilience.integrity``) can see them
+CORRUPTION_KINDS = (FaultKind.CORRUPT_PAYLOAD, FaultKind.CORRUPT_KV_PAGE)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,7 +121,10 @@ class FaultScope:
         self.delayed_events: list[tuple[int, int]] = []  # (event pos, ticks)
         self.dropped_recv_events: list[int] = []         # event positions
         self.stale: list[tuple[tuple, int]] = []         # (sem key, amount)
+        self.corrupt_events: list[int] = []      # in-flight corrupt copies
+        self.poisoned_events: list[int] = []     # at-rest poisoned wait_recvs
         self.live_unsupported: list[str] = []
+        self._result_corrupted = False           # corrupt_result ran
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -162,6 +181,10 @@ class FaultScope:
             # DMA-only protocol: lose this copy's completion signal
             self.fired = True
             return "drop_recv"
+        if self._matches(FaultKind.CORRUPT_PAYLOAD, ordinal):
+            # the credit arrives intact; the bytes do not
+            self.fired = True
+            return "corrupt"
         return None
 
     def on_local_copy(self, src, dst, sem):
@@ -178,6 +201,11 @@ class FaultScope:
                 region = getattr(dst_ref, "region", None)
                 amount = region().elements() if region is not None else 1
             self.stale.append((self._sem_key(sem), amount))
+        if self._matches(FaultKind.CORRUPT_KV_PAGE, ordinal):
+            # poison the landing region AFTER the DMA settled, BEFORE
+            # this wait's consumer reads it (at-rest corruption)
+            self.fired = True
+            return "poison"
         return None
 
     def on_wait_send(self, src_ref, sem):
@@ -192,8 +220,48 @@ class FaultScope:
     def mark_dropped_recv(self, event_pos: int) -> None:
         self.dropped_recv_events.append(event_pos)
 
+    def mark_corrupt(self, event_pos: int) -> None:
+        self.corrupt_events.append(event_pos)
+
+    def mark_poisoned(self, event_pos: int) -> None:
+        self.poisoned_events.append(event_pos)
+
     def mark_live_unsupported(self, what: str) -> None:
         self.live_unsupported.append(what)
+
+    def corrupt_result(self, out):
+        """LIVE injection lever for the corruption classes: in-kernel
+        payload bytes are not host-reachable once a kernel is traced
+        (the same limitation as ``drop_recv``), but the consumer-side
+        verification layer (``resilience.integrity.checked``) IS host
+        code — it consults this hook after the collective returns and
+        before verification, so a live ``corrupt_payload`` /
+        ``corrupt_kv_page`` spec flips one byte of the arrived result
+        exactly where wire/at-rest corruption would land it.
+
+        Gated on its OWN flag, not ``fired``: through a real kernel the
+        trace-time hooks find the nth target first (setting ``fired``
+        and noting ``live_unsupported`` — they cannot act), and the
+        flip here is the act itself; keying on ``fired`` would turn
+        live injection into a silent no-op exactly when a kernel
+        traced."""
+        if self._result_corrupted or self.spec.kind not in (
+                FaultKind.CORRUPT_PAYLOAD, FaultKind.CORRUPT_KV_PAGE):
+            return out
+        import numpy as np
+
+        self._result_corrupted = True
+        self.fired = True
+
+        def flip(a):
+            arr = np.array(a)   # host copy; dtype/shape preserved
+            flat = arr.reshape(-1).view(np.uint8)
+            flat[self.spec.nth % max(flat.size, 1)] ^= 0x42
+            return arr
+
+        if isinstance(out, tuple):
+            return (flip(out[0]), *out[1:])
+        return flip(out)
 
 
 # modules whose @lru_cache'd builders close over pallas_call kernels: a
@@ -275,6 +343,11 @@ class FaultyTraces:
     drop_recv: set[tuple[int, int]]     # (rank, event pos) of lost signals
     aborted: set[int]
     fired: bool                         # the fault found its target
+    # (rank, event pos) of CopyEvs whose payload was flipped in flight
+    corrupt: set = dataclasses.field(default_factory=set)
+    # (rank, event pos) of WaitEvs whose guarded region was poisoned at
+    # rest before consumption
+    poisoned: set = dataclasses.field(default_factory=set)
 
 
 def record_faulty_case(case, spec: FaultSpec) -> FaultyTraces:
@@ -291,6 +364,8 @@ def record_faulty_case(case, spec: FaultSpec) -> FaultyTraces:
     start_delay: dict[int, int] = {}
     notify_delay: dict[tuple[int, int], int] = {}
     drop_recv: set[tuple[int, int]] = set()
+    corrupt: set[tuple[int, int]] = set()
+    poisoned: set[tuple[int, int]] = set()
     aborted: set[int] = set()
     fired = False
     for rank in range(case.n):
@@ -312,6 +387,8 @@ def record_faulty_case(case, spec: FaultSpec) -> FaultyTraces:
             for pos, ticks in scope.delayed_events:
                 notify_delay[(rank, pos)] = ticks
             drop_recv.update((rank, p) for p in scope.dropped_recv_events)
+            corrupt.update((rank, p) for p in scope.corrupt_events)
+            poisoned.update((rank, p) for p in scope.poisoned_events)
             # a stale credit pre-exists the kernel: it lands as a credit
             # event BEFORE the rank's first real event
             for sem_key, amount in scope.stale:
@@ -320,7 +397,8 @@ def record_faulty_case(case, spec: FaultSpec) -> FaultyTraces:
     # self-credit above targets the victim's own instance
         traces.append(events)
     return FaultyTraces(case.name, case.n, spec, traces, start_delay,
-                        notify_delay, drop_recv, aborted, fired)
+                        notify_delay, drop_recv, aborted, fired,
+                        corrupt=corrupt, poisoned=poisoned)
 
 
 def _case_has_wait_recv(case) -> bool:
@@ -353,6 +431,17 @@ def sample_spec(case, kind: FaultKind, rng) -> FaultSpec:
                                        "wait_send"))
         nth = rng.randrange(max(total, 1))
         return FaultSpec(kind, rank, nth=nth)
+    if kind is FaultKind.CORRUPT_PAYLOAD:
+        n_copy = count("remote_copy")
+        if n_copy == 0:
+            raise ValueError(f"{case.name}: no remote_copy to corrupt")
+        return FaultSpec(kind, rank, nth=rng.randrange(n_copy))
+    if kind is FaultKind.CORRUPT_KV_PAGE:
+        n_recv = count("wait_recv")
+        if n_recv == 0:
+            raise ValueError(f"{case.name}: no wait_recv landing region "
+                             f"to poison")
+        return FaultSpec(kind, rank, nth=rng.randrange(n_recv))
     if kind in (FaultKind.DROP_NOTIFY, FaultKind.DELAY_NOTIFY):
         n_not = count("notify")
         if n_not == 0 and kind is FaultKind.DROP_NOTIFY:

@@ -1,0 +1,672 @@
+"""End-to-end data integrity: checksummed collective payloads, KV-page
+audit, and quarantine recovery (``TDT_INTEGRITY=1``).
+
+The resilience stack so far detects *liveness* failures — a lost signal
+stalls, a straggler overruns, an abort truncates.  A flipped bit in a
+DMA'd chunk is invisible to all of it: device-initiated transfers
+bypass every host-side check (the blind spot the NVSHMEM system
+analysis documents for symmetric-memory ops, PAPERS.md), the credits
+balance, the protocol completes on time, and the garbage ships.  This
+module makes corruption a first-class, detected, recoverable fault:
+
+**The checksum protocol.**  The producer stamps a cheap reduction of
+each tile (a position-weighted 32-bit fold of the byte view,
+:func:`fold32`) into a
+sideband slot alongside the semaphore credit it already sends; the
+consumer verifies the stamp against the arrived bytes BEFORE the
+``consume_token``-equivalent use.  Two failure kinds fall out:
+
+- ``payload``  — the stamp does not match at arrival: the bytes changed
+  IN FLIGHT (wire corruption).  Attributable to the producing peer.
+- ``kv_page``  — the stamp matched at arrival but the region differs at
+  consumption / audit time: the bytes changed AT REST (memory
+  corruption; the paged-KV pool between scheduler steps is the serving
+  instance of this class).
+
+Three layers implement it, mirroring how the fault injector spans
+record mode and live execution (docs/robustness.md):
+
+1. **Record mode** (:func:`check_traces`): the protocol runs
+   symbolically over composed per-rank traces — every ``CopyEv``
+   carries its stamp, every credit-consuming wait verifies what it
+   consumed — so the fault matrix's ``corrupt_payload`` /
+   ``corrupt_kv_page`` cells are classified headlessly, with the
+   (semaphore, chunk, peer) triple named, on a box that cannot build a
+   single kernel.
+2. **Live eager entries** (:func:`checked` + the ``verify_*``
+   helpers): the comm/ops entry points wrap their eager call with a
+   consumer-side verification pass over the host-visible global
+   arrays — byte-exact fold comparison for copy-type collectives
+   (AG, A2A zones land payloads verbatim), a float32 re-reduction with
+   tolerance for RS/AR, and a Freivalds random-projection check for the
+   fused GEMMs (O(n^2) verification of an O(n^3) product).  A mismatch
+   raises :class:`~.errors.PayloadCorruption` naming (semaphore, chunk,
+   peer) and rides the SAME retry -> XLA-fallback -> breaker ladder a
+   timeout does (``PayloadCorruption`` is in the default retry set).
+3. **The KV-pool audit** (``serve.scheduler``): full pages are stamped
+   when they fill, re-verified on a periodic cadence and at
+   preempt-restore; a mismatch recovers the victim through the
+   preemption-recompute path — pages evicted, request re-queued,
+   deterministically recomputed from its prompt — while cohabitants'
+   caches stay byte-intact.
+
+**Quarantine.**  Repeated corruption attributed to ONE peer is a sick
+link/chip, not noise: :func:`note_corruption` walks a per-peer sticky
+breaker (``peer:<k>`` in the shared breaker registry) toward open;
+once quarantined, every guarded collective whose team includes that
+peer routes straight to its XLA fallback (``policy.resilient_call``),
+and the peer surfaces in ``health_snapshot()["quarantined_peers"]`` /
+``/healthz``.  ``reset_breaker("peer:<k>")`` readmits after
+remediation.
+
+Everything is OFF by default: with ``TDT_INTEGRITY`` unset every guard
+site costs one cached-bool check and behavior is byte-identical (the
+same discipline as ``TDT_OBS`` / ``TDT_RESILIENCE`` / ``TDT_FLIGHT``).
+Limits are documented, not hidden: a 32-bit fold can collide, but only
+under adversarial cancellation (any single-word flip always moves it —
+see :func:`fold32`); the float checks catch sign/exponent/high-mantissa
+flips but not last-ulp noise; reductions mix every peer's bytes, so
+their corruption is detected-but-unattributable (no quarantine).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+
+import numpy as np
+
+from .errors import CorruptionDiagnosis, PayloadCorruption
+
+
+def _env_enabled() -> bool:
+    from ..core.utils import env_flag
+
+    return env_flag("TDT_INTEGRITY")
+
+
+# cached like obs/resilience: a disabled guard site pays one global load
+_ENABLED = _env_enabled()
+
+
+def enabled() -> bool:
+    """Whether integrity verification is armed (``TDT_INTEGRITY=1`` or
+    :func:`enable`, and not inside a measurement-suppression block —
+    autotune sweeps must not pay or trip the checks)."""
+    if not _ENABLED:
+        return False
+    from .. import resilience
+
+    return not resilience._suppressed()
+
+
+def enable(on: bool | None = True) -> bool:
+    """Turn integrity verification on/off; ``None`` re-reads
+    ``TDT_INTEGRITY``.  Returns the new state."""
+    global _ENABLED
+    _ENABLED = _env_enabled() if on is None else bool(on)
+    return _ENABLED
+
+
+# ---------------------------------------------------------------------------
+# the checksum primitive
+
+
+# odd multiplier pair (Knuth/Fibonacci hashing constants): each word is
+# weighted by an odd per-POSITION constant before summing, so the fold
+# sees position, not just value
+_FOLD_MULT = np.uint64(2654435761)
+_FOLD_ADD = np.uint64(2654435769)
+
+
+def fold32(*arrays) -> int:
+    """The sideband stamp: a position-weighted 32-bit sum fold over the
+    little-endian byte view of the arrays — word ``i`` contributes
+    ``w_i * ((A*i + B) | 1)`` mod 2^64, folded to 32 bits and mixed
+    with the total length.  Dtype-agnostic and byte-exact (a copy-type
+    collective must deliver the SAME fold); cheap enough to stamp per
+    tile.  Position weighting matters: a plain XOR/sum fold is blind to
+    duplicated-word payloads (broadcast KV tiles are exactly that),
+    where flipping one of N identical words — or permuting chunks —
+    cancels.  The ``| 1`` is equally load-bearing: ``A*i + B`` with odd
+    constants is EVEN at every odd ``i``, and an even weight annihilates
+    a ±2^31 word delta (a float32 sign-bit flip — the canonical SDC)
+    in the surviving low 32 bits of the accumulator; forcing the weight
+    odd makes every single-word change move the fold by
+    ``delta * odd`` != 0 mod 2^32."""
+    acc = np.uint64(0)
+    offset = 0
+    old = np.seterr(over="ignore")   # uint64 wraparound IS the fold
+    try:
+        for a in arrays:
+            b = np.ascontiguousarray(np.asarray(a))
+            if b.nbytes % 4 == 0 and b.nbytes:
+                w = b.reshape(-1).view(np.uint32)   # zero-copy reword
+            else:
+                raw = b.tobytes()
+                raw += b"\0" * ((-len(raw)) % 4)
+                w = np.frombuffer(raw, np.uint32)
+            if w.size:
+                ix = np.arange(offset, offset + w.size, dtype=np.uint64)
+                wt = (_FOLD_MULT * ix + _FOLD_ADD) | np.uint64(1)
+                acc += (w.astype(np.uint64) * wt).sum()
+                offset += int(w.size)
+    finally:
+        np.seterr(**old)
+    return int((acc ^ np.uint64(offset)) & np.uint64(0xFFFFFFFF))
+
+
+def fold_page(cache, page: int) -> int:
+    """Stamp one physical KV page: the fold over its k and v slices
+    across every layer (the unit the serve-loop audit verifies)."""
+    p = int(page)
+    return fold32(np.asarray(cache.k[:, p]), np.asarray(cache.v[:, p]))
+
+
+def fold_pages(cache, pages) -> dict[int, int]:
+    """:func:`fold_page` for a batch, with TWO device-to-host transfers
+    total (one gather each for k and v) instead of two per page — the
+    shape the scheduler's periodic audit calls on the decode loop,
+    where per-page transfers would serialize hundreds of small copies
+    against the step."""
+    ids = sorted({int(p) for p in pages})
+    if not ids:
+        return {}
+    k = np.asarray(cache.k[:, ids])
+    v = np.asarray(cache.v[:, ids])
+    return {p: fold32(k[:, i], v[:, i]) for i, p in enumerate(ids)}
+
+
+# ---------------------------------------------------------------------------
+# record-mode checksum protocol (the fault matrix's corruption detector)
+
+
+def check_traces(ft) -> list[CorruptionDiagnosis]:
+    """Run the checksum protocol over composed (possibly corrupt)
+    per-rank traces: every ``CopyEv`` carries its producer stamp; every
+    credit-consuming wait verifies the batches it consumes before use.
+    Returns one finding per corrupt/poisoned transfer, naming the
+    (semaphore, chunk, peer) triple — or an empty list when every byte
+    that arrived is a byte that was sent.
+
+    ``ft``: a :class:`~.faults.FaultyTraces` whose ``corrupt`` set marks
+    in-flight-flipped copies and whose ``poisoned`` set marks waits
+    whose guarded region was flipped at rest before consumption.
+    """
+    from ..analysis.events import CopyEv, NotifyEv, WaitEv, sem_label
+
+    n, traces = ft.n, ft.traces
+    # per (rank, sem) FIFO of credit batches:
+    # [amount, src_rank, chunk_label, corrupt_flag_box]
+    queues: dict[tuple[int, tuple], deque] = {}
+    pcs = [0] * n
+    findings: list[CorruptionDiagnosis] = []
+    poisoned_reported: set[tuple[int, int]] = set()
+
+    def push(rank, sem, amount, src, chunk, corrupt):
+        queues.setdefault((rank, sem), deque()).append(
+            [amount, src, chunk, [corrupt]])
+
+    def avail(rank, sem) -> int:
+        return sum(b[0] for b in queues.get((rank, sem), ()))
+
+    def consume(r, ev, pos) -> bool:
+        if avail(r, ev.sem) < ev.amount:
+            return False
+        need = ev.amount
+        q = queues[(r, ev.sem)]
+        at_rest = (r, pos) in ft.poisoned and (r, pos) not in \
+            poisoned_reported
+        while need > 0:
+            batch = q[0]
+            take = min(need, batch[0])
+            batch[0] -= take
+            need -= take
+            if batch[3][0]:
+                # the consumer's verify: the stamp that rode the credit
+                # does not match the bytes in the region
+                batch[3][0] = False    # one finding per corrupt transfer
+                findings.append(CorruptionDiagnosis(
+                    op=ft.kernel, kind="payload",
+                    sem=sem_label(ev.sem), chunk=batch[2], peer=batch[1],
+                    note="checksum mismatch at arrival: bytes flipped "
+                         "in flight",
+                ))
+            if at_rest and batch[2] is not None:
+                poisoned_reported.add((r, pos))
+                at_rest = False
+                findings.append(CorruptionDiagnosis(
+                    op=ft.kernel, kind="kv_page",
+                    sem=sem_label(ev.sem), chunk=batch[2], peer=batch[1],
+                    note="stamp verified at arrival but the region "
+                         "differs at consumption: bytes flipped at rest",
+                ))
+            if batch[0] == 0:
+                q.popleft()
+        if at_rest:
+            # the poisoned wait consumed only non-copy credits: still a
+            # detection, without a region to name
+            poisoned_reported.add((r, pos))
+            findings.append(CorruptionDiagnosis(
+                op=ft.kernel, kind="kv_page", sem=sem_label(ev.sem),
+                note="guarded region poisoned at rest before consumption",
+            ))
+        return True
+
+    def step(r) -> bool:
+        if pcs[r] >= len(traces[r]):
+            return False
+        ev = traces[r][pcs[r]]
+        if isinstance(ev, WaitEv):
+            if not consume(r, ev, pcs[r]):
+                return False
+        elif isinstance(ev, NotifyEv):
+            push(ev.target, ev.sem, ev.amount, r, None, False)
+        elif isinstance(ev, CopyEv):
+            if ev.send_sem is not None:
+                push(r, ev.send_sem, ev.src.elements(), r, None, False)
+            if (r, pcs[r]) not in ft.drop_recv:
+                push(ev.dst_rank, ev.recv_sem, ev.dst.elements(), r,
+                     ev.dst.label(), (r, pcs[r]) in ft.corrupt)
+        pcs[r] += 1
+        return True
+
+    progress = True
+    while progress:
+        progress = False
+        for r in range(n):
+            while step(r):
+                progress = True
+
+    # a corrupt transfer whose credits were never consumed was never
+    # verified — that is ITSELF a protocol hole worth naming
+    for (rank, sem), q in sorted(queues.items()):
+        for batch in q:
+            if batch[3][0]:
+                findings.append(CorruptionDiagnosis(
+                    op=ft.kernel, kind="payload", sem=sem_label(sem),
+                    chunk=batch[2], peer=batch[1],
+                    note="corrupt transfer never consumed: no verify "
+                         "point guards this region",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# live consumer-side verification (the eager comm/ops entry points)
+
+# float checks: catches sign/exponent/high-mantissa flips; rtol leaves
+# room for accumulation-order differences between the device reduction
+# and the host float32 re-reduction
+_RTOL = 1e-2
+
+
+def _rademacher(shape_key: tuple, n: int) -> np.ndarray:
+    """Deterministic ±1 projection vector (seeded by the shape class, so
+    repeated calls at one config verify the same projection — ACROSS
+    processes too: ``hash()`` is PYTHONHASHSEED-randomized, which would
+    make a marginal Freivalds verdict unreproducible in a debug run)."""
+    import zlib
+
+    rng = np.random.default_rng(
+        zlib.crc32(repr(("tdt-integrity", shape_key, n)).encode()))
+    return rng.integers(0, 2, size=n).astype(np.float32) * 2.0 - 1.0
+
+
+def verify_gather(op: str, x, out, n: int) -> CorruptionDiagnosis | None:
+    """AllGather delivers every shard verbatim: the fold of input chunk
+    ``k`` must equal the fold of output chunk ``k`` EXACTLY.  A mismatch
+    is attributable: chunk ``k``'s producer is rank ``k``."""
+    xa, oa = np.asarray(x), np.asarray(out)
+    m = xa.shape[0] // n
+    for k in range(n):
+        if fold32(xa[k * m:(k + 1) * m]) != fold32(oa[k * m:(k + 1) * m]):
+            return CorruptionDiagnosis(
+                op=op, kind="payload", sem=f"recv_sems[{k}]",
+                chunk=f"out[{k * m}:{(k + 1) * m}]", peer=k,
+                note="fold32 mismatch between the shard sent and the "
+                     "chunk received")
+    return None
+
+
+def _verify_float(op: str, got: np.ndarray, want: np.ndarray,
+                  chunk_of, mag: np.ndarray | None = None,
+                  rtol: float = _RTOL) -> CorruptionDiagnosis | None:
+    """``mag`` is the per-element ACCUMULATED magnitude (sum of the
+    |partials| that met at that element) — the same bound
+    :func:`verify_gemm` uses.  Bounding against it, not the global max
+    of the (possibly cancelling) result, keeps small-magnitude elements
+    checkable: under a global-max bound any element below ~rtol*max
+    could be corrupted arbitrarily within that window undetected."""
+    if mag is None:
+        mag = np.abs(want.astype(np.float64))
+    err = np.abs(got.astype(np.float64) - want.astype(np.float64))
+    bad = np.argwhere(err > rtol * np.maximum(mag, 1.0))
+    if bad.size == 0:
+        return None
+    idx = tuple(int(i) for i in bad[0])
+    return CorruptionDiagnosis(
+        op=op, kind="payload", chunk=chunk_of(idx), peer=None,
+        note=f"re-reduction mismatch at {idx}: |err| "
+             f"{float(err[idx]):.3g} > tol (reductions mix every "
+             f"peer's bytes — unattributable)")
+
+
+def verify_reduce(op: str, x, out, n: int) -> CorruptionDiagnosis | None:
+    """RS/AR: re-reduce the stacked partials in float32 and compare
+    within tolerance.  ``x``: (n*M, R) stacked partials; ``out``:
+    (M, R) — ONE signature for both ops: in global semantics RS's
+    stacked row-chunks and AR's replicated output are the same full
+    sum.
+
+    Tolerance scales with the rank count and the OUTPUT dtype's ulp: a
+    ring reduction accumulating in the wire dtype legitimately rounds
+    each of its n-1 steps (worst case ~(n-1)·eps/2 relative for bf16
+    two-shot), and a fixed 1% bound would flag healthy bf16 AR — a
+    deterministic false positive the retry reproduces, permanently
+    degrading the op.  Real SDC (sign/exponent/high-mantissa flips)
+    lands orders of magnitude outside either bound."""
+    xa = np.asarray(x).astype(np.float32)
+    oa = np.asarray(out)
+    m = oa.shape[0]
+    want = xa.reshape(n, m, *xa.shape[1:]).sum(axis=0).astype(oa.dtype)
+    mag = np.abs(xa).reshape(n, m, *xa.shape[1:]).sum(axis=0)
+    try:
+        # ml_dtypes.finfo covers bf16/fp8 AND the standard floats;
+        # numpy's own finfo rejects the extension dtypes
+        import ml_dtypes
+
+        eps = float(ml_dtypes.finfo(oa.dtype).eps)
+    except (ImportError, ValueError):
+        try:
+            eps = float(np.finfo(oa.dtype).eps)
+        except ValueError:
+            # non-float payloads keep the generic bound (the f32
+            # re-reduction itself is inexact above 2^24, so this check
+            # is tolerance-based for every dtype)
+            eps = 0.0
+    rtol = max(_RTOL, 2.0 * max(n - 1, 1) * eps)
+    return _verify_float(op, np.asarray(oa), np.asarray(want),
+                         lambda idx: f"out[{idx[0]}]", mag=mag, rtol=rtol)
+
+
+def verify_gemm(op: str, a, b, out) -> CorruptionDiagnosis | None:
+    """Freivalds check for the fused GEMM+collective ops: with a seeded
+    ±1 vector ``v``, ``out @ v`` must match ``A @ (B @ v)`` — O(n^2)
+    verification of the O(n^3) product, catching any corruption that
+    perturbs a row of the result beyond float noise."""
+    aa = np.asarray(a).astype(np.float32)
+    ba = np.asarray(b).astype(np.float32)
+    oa = np.asarray(out).astype(np.float32)
+    v = _rademacher((aa.shape, ba.shape), ba.shape[1])
+    got = oa @ v
+    want = aa @ (ba @ v)
+    # tolerance against the magnitude actually accumulated, not the
+    # (possibly cancelling) result
+    mag = np.abs(aa) @ (np.abs(ba) @ np.abs(v))
+    err = np.abs(got - want)
+    bad = np.argwhere(err > _RTOL * np.maximum(mag, 1.0))
+    if bad.size == 0:
+        return None
+    row = int(bad[0][0])
+    return CorruptionDiagnosis(
+        op=op, kind="payload", chunk=f"out[{row}, :]", peer=None,
+        note=f"Freivalds projection mismatch on row {row}: |err| "
+             f"{float(err[row]):.3g}")
+
+
+def _a2a_meta(splits, n: int):
+    """The zone geometry, from its ONE home (``fallbacks._a2a_geometry``
+    — the same math the degraded path gathers by), as host arrays."""
+    from .fallbacks import _a2a_geometry
+
+    sp, per_peer, offs = _a2a_geometry(np.asarray(splits), n)
+    return np.asarray(sp), np.asarray(per_peer), np.asarray(offs)
+
+
+def verify_ep_dispatch(op: str, x, splits, result,
+                       n: int) -> CorruptionDiagnosis | None:
+    """Dispatch lands each (src, dst) row block verbatim at the head of
+    zone ``dst*n + src``: fold-exact per block, peer-attributable."""
+    recv, _ = result
+    xa, ra = np.asarray(x), np.asarray(recv)
+    t = xa.shape[0] // n
+    _, per_peer, offs = _a2a_meta(splits, n)
+    for r in range(n):
+        for p in range(n):
+            cnt = int(per_peer[p, r])
+            if cnt == 0:
+                continue
+            o = int(offs[p, r])
+            if fold32(xa[p * t + o:p * t + o + cnt]) != \
+                    fold32(ra[r * n + p, :cnt]):
+                return CorruptionDiagnosis(
+                    op=op, kind="payload", sem=f"recv_sems[{p}]",
+                    chunk=f"recv[{r * n + p}][0:{cnt}]", peer=p,
+                    note="fold32 mismatch on the dispatched row block")
+    return None
+
+
+def verify_ep_combine(op: str, y, splits, out, n: int,
+                      token_dim: int) -> CorruptionDiagnosis | None:
+    """Combine returns zone ``dst*n + src``'s head rows verbatim into
+    src's sorted row block [offs, offs+cnt): fold-exact per block."""
+    ya, oa = np.asarray(y), np.asarray(out)
+    t = token_dim
+    _, per_peer, offs = _a2a_meta(splits, n)
+    for p in range(n):          # owner rank receiving its rows back
+        for r in range(n):      # peer that processed them
+            cnt = int(per_peer[p, r])
+            if cnt == 0:
+                continue
+            o = int(offs[p, r])
+            if fold32(ya[r * n + p, :cnt]) != \
+                    fold32(oa[p * t + o:p * t + o + cnt]):
+                return CorruptionDiagnosis(
+                    op=op, kind="payload", sem=f"recv_sems[{r}]",
+                    chunk=f"out[{p * t + o}:{p * t + o + cnt}]", peer=r,
+                    note="fold32 mismatch on the returned row block")
+    return None
+
+
+# conservative host verification throughput: device->host transfer of
+# the result plus the numpy fold/re-reduction — far below the wire SOL
+# the watchdog prices collectives at
+_VERIFY_GBPS = 0.5
+
+
+def verify_budget_ms(payload_bytes: int, ranks: int | None = None) -> float:
+    """Extra watchdog budget for the consumer-side verification that
+    runs INSIDE the guarded thunk (``policy.guarded`` adds this to the
+    wire-SOL deadline).  Without it, arming integrity on a fast slice
+    would make every healthy call breach a deadline priced for the wire
+    alone — the verify materializes the full gathered result on the
+    host, orders of magnitude slower than ICI.  Zero when integrity is
+    off (the deadline is byte-identical)."""
+    if not enabled():
+        return 0.0
+    n = max(int(ranks or 1), 1)
+    # the checks touch the inputs plus the (up to n x payload) result
+    total = max(int(payload_bytes), 0) * (n + 1)
+    return total / (_VERIFY_GBPS * 1e9) * 1e3 + 50.0
+
+
+# ---------------------------------------------------------------------------
+# quarantine: per-peer sticky breakers over repeated attributable
+# corruption
+
+_QUARANTINE_PREFIX = "peer:"
+
+
+def quarantine_threshold() -> int:
+    try:
+        return int(os.environ.get("TDT_QUARANTINE_THRESHOLD", "") or 3)
+    except ValueError:
+        return 3
+
+
+def note_corruption(op: str, peer: int | None) -> bool:
+    """Record one corruption attributed to ``peer`` (None = reduction
+    output, unattributable — rides the ladder, never quarantines).
+    Returns True when this corruption OPENED the peer's quarantine."""
+    if peer is None:
+        return False
+    from . import policy
+
+    opened = policy.breaker(f"{_QUARANTINE_PREFIX}{int(peer)}",
+                            quarantine_threshold()).record_failure()
+    _publish_gauge()
+    return opened
+
+
+def note_clean(ranks: int | None) -> None:
+    """A verified-clean collective resets the consecutive-corruption
+    count of every participating peer (open quarantines stay open —
+    sticky, like every breaker: readmission is an operator decision)."""
+    if not ranks:
+        return
+    from .policy import _BREAKERS, _BREAKERS_LOCK
+
+    with _BREAKERS_LOCK:
+        peers = [b for op, b in _BREAKERS.items()
+                 if op.startswith(_QUARANTINE_PREFIX)
+                 and int(op[len(_QUARANTINE_PREFIX):]) < int(ranks)]
+    for b in peers:
+        b.record_success()
+
+
+def quarantined_peers() -> list[int]:
+    """Logical peer ids whose quarantine breaker is open."""
+    from .policy import _BREAKERS, _BREAKERS_LOCK
+
+    with _BREAKERS_LOCK:
+        return sorted(
+            int(op[len(_QUARANTINE_PREFIX):])
+            for op, b in _BREAKERS.items()
+            if op.startswith(_QUARANTINE_PREFIX) and b.open)
+
+
+def quarantine_blocks(ranks: int | None) -> bool:
+    """Whether a guarded collective over ``ranks`` peers should route
+    straight to its XLA fallback: integrity armed and some team member
+    quarantined (``policy.resilient_call`` consults this)."""
+    if ranks is None or not enabled():
+        return False
+    return any(p < int(ranks) for p in quarantined_peers())
+
+
+def reset_quarantine(peer: int | None = None) -> None:
+    """Readmit ``peer`` (None = all) after remediation."""
+    from . import policy
+
+    if peer is not None:
+        policy.reset_breaker(f"{_QUARANTINE_PREFIX}{int(peer)}")
+    else:
+        for p in quarantined_peers():
+            policy.reset_breaker(f"{_QUARANTINE_PREFIX}{p}")
+    _publish_gauge()
+
+
+def _publish_gauge() -> None:
+    from .. import obs
+
+    if obs.enabled():
+        obs.gauge("quarantined_peers").set(float(len(quarantined_peers())))
+
+
+# ---------------------------------------------------------------------------
+# the entry-point wrapper
+
+
+def checked(op: str, thunk, verify, *, ranks: int | None = None):
+    """Wrap an eager collective thunk with consumer-side verification:
+    run it, consult the live fault scope's corruption lever (so
+    ``corrupt_payload``/``corrupt_kv_page`` specs inject through real
+    entry points), verify the result, and on mismatch bump the
+    ``integrity_failures`` counter, feed the peer's quarantine, and
+    raise :class:`PayloadCorruption` — which rides the resilience
+    ladder (retry -> XLA fallback -> breaker) exactly like a timeout.
+    ``verify(result) -> CorruptionDiagnosis | None``."""
+    from .. import obs
+    from ..lang import primitives as dl
+
+    def run():
+        out = thunk()
+        scope = dl.active_fault_scope()
+        if scope is not None:
+            out = scope.corrupt_result(out)
+        if obs.enabled():
+            obs.counter("integrity_checks", op=op).inc()
+        diag = verify(out)
+        if diag is None:
+            note_clean(ranks)
+            return out
+        if obs.enabled():
+            obs.counter("integrity_failures", op=op, kind=diag.kind).inc()
+        note_corruption(op, diag.peer)
+        raise PayloadCorruption(op, diag)
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# selftest battery (scripts/tdt_lint.py --integrity)
+
+
+def run_selftest() -> list[str]:
+    """Seeded-bad verification battery: every live verifier must catch a
+    planted flip AND pass the clean input; quarantine must open at the
+    threshold.  Returns problems (empty = pass)."""
+    problems: list[str] = []
+    rng = np.random.default_rng(7)
+
+    def flip_one(a, byte=5):
+        b = np.array(a)
+        b.reshape(-1).view(np.uint8)[byte] ^= 0x42
+        return b
+
+    # gather: exact fold per chunk, peer named
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    if verify_gather("ag", x, x.copy(), 4) is not None:
+        problems.append("verify_gather flagged a clean gather")
+    bad = flip_one(x.copy().reshape(-1)).reshape(8, 16)
+    d = verify_gather("ag", x, bad, 4)
+    if d is None or d.peer != 0 or not d.chunk:
+        problems.append(f"verify_gather missed a flipped byte or lost "
+                        f"attribution: {d}")
+
+    # reduce: float re-reduction with tolerance
+    xs = rng.standard_normal((16, 8)).astype(np.float32)
+    out = xs.reshape(4, 4, 8).sum(0)
+    if verify_reduce("ar", xs, out, 4) is not None:
+        problems.append("verify_reduce flagged a clean reduction")
+    bad = out.copy()
+    bad[2, 3] += 10.0 * max(1.0, abs(float(bad[2, 3])))
+    if verify_reduce("ar", xs, bad, 4) is None:
+        problems.append("verify_reduce missed a large perturbation")
+
+    # Freivalds
+    a = rng.standard_normal((12, 6)).astype(np.float32)
+    b = rng.standard_normal((6, 10)).astype(np.float32)
+    good = a @ b
+    if verify_gemm("ag_gemm", a, b, good) is not None:
+        problems.append("verify_gemm flagged a clean product")
+    bad = good.copy()
+    bad[3, 4] += 50.0
+    if verify_gemm("ag_gemm", a, b, bad) is None:
+        problems.append("verify_gemm missed a perturbed row")
+
+    # quarantine walk + readmission
+    from . import policy
+
+    probe = 97   # a peer id no real mesh reaches
+    policy.reset_breaker(f"{_QUARANTINE_PREFIX}{probe}")
+    opened = False
+    for _ in range(max(quarantine_threshold(), 1)):
+        opened = note_corruption("selftest", probe)
+    if not opened or probe not in quarantined_peers():
+        problems.append("quarantine did not open at the threshold")
+    reset_quarantine(probe)
+    if probe in quarantined_peers():
+        problems.append("reset_quarantine did not readmit the peer")
+    return problems

@@ -30,6 +30,7 @@ import random
 
 from .errors import CollectiveTimeoutError
 from .faults import (
+    CORRUPTION_KINDS,
     FAULT_KINDS,
     FaultKind,
     record_faulty_case,
@@ -55,7 +56,8 @@ DEFAULT_KERNELS = (
 
 # classes whose injection MUST be caught: they stall or corrupt
 MUST_DETECT = (FaultKind.DROP_NOTIFY, FaultKind.STALE_CREDIT,
-               FaultKind.RANK_ABORT)
+               FaultKind.RANK_ABORT, FaultKind.CORRUPT_PAYLOAD,
+               FaultKind.CORRUPT_KV_PAGE)
 
 
 def _cases(kernels, n: int):
@@ -99,6 +101,28 @@ def run_case(case, kind: FaultKind, rng) -> dict | None:
             if e.diagnosis is not None else []
         if obs.enabled():
             obs.counter("resilience_timeouts", op=case.name).inc()
+        return row
+    if kind in CORRUPTION_KINDS:
+        # liveness is untouched (credits balance, completion on time):
+        # only the checksum protocol can see these classes
+        from . import integrity
+
+        findings = integrity.check_traces(ft)
+        if findings:
+            row["outcome"] = "detected"
+            row["detail"] = "; ".join(f.describe() for f in findings)
+            row["named"] = sorted({s for f in findings
+                                   for s in (f.sem, f.chunk,
+                                             None if f.peer is None
+                                             else f"rank {f.peer}")
+                                   if s})
+        else:
+            # completed, balanced, and silent: the exact SDC blind spot
+            # verify_matrix fails the build on
+            row["outcome"] = "undetected"
+            row["detail"] = (f"completed at tick {res.ticks} with "
+                             f"balanced credits and NO checksum finding")
+            row["named"] = []
         return row
     hazards = check_hazards(ft)
     if hazards:
@@ -238,15 +262,121 @@ def _sched_cell(kind: FaultKind, leg: str, rng) -> dict:
     return row
 
 
+def _sched_poison_cell(rng) -> dict:
+    """corrupt_kv_page at serving granularity: one full KV page of an
+    active sequence is flipped BETWEEN scheduler steps (at-rest
+    corruption the decode path would silently attend over).  With
+    ``TDT_INTEGRITY=1`` the periodic pool audit catches the stamp
+    mismatch and RECOVERS the victim through the preemption-recompute
+    path — pages evicted, request re-queued, prompt deterministically
+    recomputed — so the victim still completes with CORRECT tokens
+    while cohabitants' caches stay byte-intact and zero pages leak.
+    (The SimBackend's token rule does not read KV, so the cell proves
+    the detection+recovery machinery, and the byte-intactness of
+    cohabitant pages is pinned by the serve tests.)"""
+    import dataclasses as _dc
+
+    from . import integrity
+    from ..serve import (
+        Request, RequestState, Scheduler, SchedulerConfig, SimBackend,
+    )
+
+    prev = integrity._ENABLED
+    integrity.enable(True)
+    try:
+        backend = SimBackend(slots=3, page_size=4, pool_pages=32,
+                             max_length=64)
+        sched = Scheduler(backend, SchedulerConfig(
+            kv_audit_interval_steps=2))
+        reqs = [
+            Request(prompt=tuple(rng.randrange(1, 90) for _ in range(6)),
+                    max_new_tokens=rng.randint(8, 12), priority=i)
+            for i in range(3)
+        ]
+        for r in reqs:
+            sched.submit(r)
+        fired = False
+        victim = None
+        page = None
+        for _ in range(400):
+            res = sched.step()
+            if not fired:
+                cand = next(
+                    (s for s in sched.slots
+                     if s is not None and s.page_stamps
+                     and s.request.state is RequestState.DECODE), None)
+                if cand is not None:
+                    j = max(cand.page_stamps)
+                    page = int(cand.pages[j])
+                    victim = cand.request
+                    sched.cache = _dc.replace(
+                        sched.cache,
+                        k=sched.cache.k.at[:, page].add(1000.0))
+                    fired = True
+            if res.idle and fired:
+                break
+    finally:
+        integrity.enable(prev)
+
+    detections = [c for c in sched.kv_corruptions
+                  if c["page"] == page]
+    recovered = (victim is not None
+                 and victim.state is RequestState.DONE
+                 and victim.tokens == backend.expected_tokens(victim))
+    cohab_ok = all(
+        r.state is RequestState.DONE
+        and r.tokens == backend.expected_tokens(r)
+        for r in reqs if r is not victim)
+    leaked = sched.pool.used_pages
+    row = {
+        "kernel": "serve/scheduler", "fault": "corrupt_kv_page",
+        "leg": "poison", "fired": fired,
+        "requests": len(reqs),
+        "completed": sum(r.state is RequestState.DONE for r in reqs),
+        "failed": sum(r.state is RequestState.FAILED for r in reqs),
+        "shed": 0,
+        "pages_leaked": leaked,
+        "drain_monotone": True,
+        "preemptions": sched.preemptions,
+    }
+    if fired and detections and recovered and cohab_ok and not leaked:
+        row["outcome"] = "detected"
+        row["named"] = ["corrupt_kv_page", f"page {page}"]
+        row["detail"] = (
+            f"audit named page {page} at step {detections[0]['step']}; "
+            f"victim {victim.req_id} recovered via preemption-recompute "
+            f"({sched.preemptions} preemption(s)); cohabitants intact")
+    else:
+        row["outcome"] = "unisolated"
+        row["named"] = []
+        row["detail"] = (
+            f"fired={fired} detections={len(detections)} "
+            f"recovered={recovered} cohab_ok={cohab_ok} leaked={leaked}")
+    return row
+
+
 def run_scheduler_matrix(seed: int = 0) -> list[dict]:
     """The scheduler cells: rank_abort mid-decode, straggler within
-    slack, straggler past the victim's deadline."""
+    slack, straggler past the victim's deadline, and a KV page poisoned
+    between steps (recovered via preemption-recompute)."""
     rng = random.Random(seed)
     return [
         _sched_cell(FaultKind.RANK_ABORT, "abort", rng),
         _sched_cell(FaultKind.STRAGGLER, "slack", rng),
         _sched_cell(FaultKind.STRAGGLER, "overrun", rng),
+        _sched_poison_cell(rng),
     ]
+
+
+def run_integrity_cells(seed: int = 0) -> tuple[list[dict], list[dict]]:
+    """The ``tdt_lint --integrity`` slice: (kernel rows, scheduler
+    cells) — both corruption classes over every kernel family through
+    the record-mode checksum protocol, plus the KV-page poison cell.
+    Verify the halves with :func:`verify_matrix` (``kinds=
+    CORRUPTION_KINDS``) and :func:`verify_scheduler_matrix`."""
+    rows = run_matrix(seed=seed, kinds=CORRUPTION_KINDS)
+    cells = [_sched_poison_cell(random.Random(seed))]
+    return rows, cells
 
 
 def verify_scheduler_matrix(rows: list[dict]) -> list[str]:
@@ -270,6 +400,12 @@ def verify_scheduler_matrix(rows: list[dict]) -> list[str]:
             problems.append(
                 f"{key}: expected a detected+isolated victim, got "
                 f"{row['outcome']!r} — the fault was absorbed silently")
+        if row["leg"] == "poison" and row["outcome"] != "detected":
+            problems.append(
+                f"{key}: a poisoned KV page must be detected by the "
+                f"audit and recovered via preemption-recompute, got "
+                f"{row['outcome']!r} — garbage KV would be attended "
+                f"over silently")
         if row["leg"] == "slack" and row["outcome"] != "survived":
             problems.append(
                 f"{key}: an in-slack straggler should be absorbed, got "
@@ -280,21 +416,23 @@ def verify_scheduler_matrix(rows: list[dict]) -> list[str]:
     return problems
 
 
-def run_matrix(seed: int = 0, *, kernels=DEFAULT_KERNELS, ranks: int = 4
-               ) -> list[dict]:
-    """The full (kernel x fault class) sweep; rows sorted by kernel."""
+def run_matrix(seed: int = 0, *, kernels=DEFAULT_KERNELS, ranks: int = 4,
+               kinds=FAULT_KINDS) -> list[dict]:
+    """The full (kernel x fault class) sweep; rows sorted by kernel.
+    ``kinds`` restricts the fault-class axis (``tdt_lint --integrity``
+    runs the corruption slice alone)."""
     rng = random.Random(seed)
     rows = []
     for case in _cases(kernels, ranks):
-        for kind in FAULT_KINDS:
+        for kind in kinds:
             row = run_case(case, kind, rng)
             if row is not None:
                 rows.append(row)
     return rows
 
 
-def verify_matrix(rows: list[dict], *, min_kernels_per_class: int = 3
-                  ) -> list[str]:
+def verify_matrix(rows: list[dict], *, min_kernels_per_class: int = 3,
+                  kinds=FAULT_KINDS) -> list[str]:
     """CI problems in a matrix run (empty = pass):
 
     - a fired fault whose outcome is neither detected nor survived
@@ -326,7 +464,7 @@ def verify_matrix(rows: list[dict], *, min_kernels_per_class: int = 3
                 f"{key}: detected but no semaphore/chunk named — the "
                 f"diagnosis lost its protocol state"
             )
-    for kind in FAULT_KINDS:
+    for kind in kinds:
         if per_class.get(kind.value, 0) < min_kernels_per_class:
             problems.append(
                 f"fault class {kind.value!r} exercised on only "

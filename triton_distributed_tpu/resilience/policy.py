@@ -31,19 +31,29 @@ import dataclasses
 import threading
 import time
 
-from .errors import CircuitOpenError, CollectiveTimeoutError
+from .errors import (
+    CircuitOpenError,
+    CollectiveTimeoutError,
+    PayloadCorruption,
+)
 from . import watchdog
 
 
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
-    """Ladder knobs for one op class."""
+    """Ladder knobs for one op class.
+
+    ``PayloadCorruption`` rides the same ladder as a timeout: a single
+    flipped bit may be transient (retry), a sick link is not (fallback,
+    breaker, and — via ``resilience.integrity`` — per-peer quarantine).
+    It is only ever raised with ``TDT_INTEGRITY=1``, so its presence in
+    the default retry set costs nothing when integrity is off."""
 
     max_retries: int = 1
     backoff_ms: float = 25.0
     backoff_factor: float = 2.0
     breaker_threshold: int = 3
-    retry_on: tuple[type, ...] = (CollectiveTimeoutError,)
+    retry_on: tuple[type, ...] = (CollectiveTimeoutError, PayloadCorruption)
 
 
 DEFAULT_POLICY = RetryPolicy()
@@ -135,6 +145,16 @@ def resilient_call(op: str, thunk, *, fallback=None,
     open breaker raises :class:`CircuitOpenError` immediately.
     """
     from .. import obs
+    from . import integrity
+
+    # the quarantine rung (docs/robustness.md "Data integrity"): a team
+    # containing a quarantined peer routes straight to the XLA fallback
+    # — the code path the sick link cannot corrupt
+    if fallback is not None and integrity.quarantine_blocks(ranks):
+        if obs.enabled():
+            obs.counter("resilience_degraded_calls", op=op,
+                        reason="quarantined_peer").inc()
+        return fallback()
 
     br = breaker(op, policy.breaker_threshold)
     if br.open:
@@ -182,8 +202,14 @@ def guarded(op: str, thunk, *, fallback=None, payload_bytes: int = 0,
     watchdog deadline and the failure ladder.  Composes under
     ``obs.comm_call`` so the recorded span covers retries and the
     degraded path too."""
+    from . import integrity
+
     dl = watchdog.deadline_ms(op, payload_bytes=payload_bytes,
                               num_ranks=ranks)
+    # the consumer-side integrity check runs INSIDE this deadline; a
+    # wire-SOL budget alone would time out every verified call on a
+    # fast slice (0 when integrity is off)
+    dl += integrity.verify_budget_ms(payload_bytes, ranks)
 
     def run():
         return resilient_call(op, thunk, fallback=fallback, deadline_ms=dl,
@@ -283,9 +309,11 @@ def health_snapshot() -> dict:
     from .. import obs
     from ..obs.registry import REGISTRY
 
+    from . import integrity
+
     counters = {}
     for row in REGISTRY.snapshot():
-        if row["name"].startswith("resilience_") and \
+        if row["name"].startswith(("resilience_", "integrity_")) and \
                 row["kind"] == "counter":
             label = ",".join(f"{k}={v}" for k, v in
                              sorted(row["labels"].items()))
@@ -303,6 +331,10 @@ def health_snapshot() -> dict:
         # walking the breakers map (docs/observability.md "Live
         # telemetry")
         "degraded_ops": degraded_ops,
+        # peers whose quarantine breaker is open (repeated attributable
+        # corruption, resilience.integrity — /healthz flips 503 because
+        # an open peer breaker lands in degraded_ops too)
+        "quarantined_peers": integrity.quarantined_peers(),
         "obs_enabled": obs.enabled(),
         "breakers": breakers,
         "last_errors": dict(sorted(_LAST_ERROR.items())),

@@ -116,6 +116,17 @@ class SimBackend:
         """The deterministic generation rule (public: tests replay it)."""
         return (int(tok) * 31 + int(new_len) * 7 + 13) % self.vocab
 
+    def expected_tokens(self, req) -> list[int]:
+        """Replay the rule from the prompt alone — the ONE golden both
+        the fault-matrix cells and the acceptance tests judge recovery
+        and cohabitant integrity against."""
+        toks = [self.next_token(req.prompt[-1], req.prompt_len)]
+        length = req.prompt_len
+        while len(toks) < req.max_new_tokens:
+            length += 1
+            toks.append(self.next_token(toks[-1], length))
+        return toks
+
     def prefill_chunk(self, cache: PagedKVCache, pages_row, chunk,
                       start: int, total_len: int):
         chunk = np.asarray(chunk, np.int32)

@@ -74,6 +74,11 @@ class Request:
     submitted_s: float | None = None
     first_token_s: float | None = None
     finished_s: float | None = None
+    # checksum-on-evict carry (TDT_INTEGRITY=1, docs/robustness.md "Data
+    # integrity"): logical-page -> fold32 stamps of the full prompt
+    # pages, taken at preemption and verified when the recompute's
+    # prefill completes; None on every path with integrity off
+    kv_stamps: dict | None = None
 
     def __post_init__(self):
         self.prompt = tuple(int(t) for t in self.prompt)
@@ -193,7 +198,10 @@ class RequestQueue:
     def expire_deadlines(self, now: float | None = None) -> list[Request]:
         """Shed queued requests whose deadline has already passed —
         admitting them would spend pool pages on work that cannot
-        finish in budget."""
+        finish in budget.  The scheduler sweeps this EAGERLY: on every
+        tick AND on every submit, so the depth gauge, the full-queue
+        backpressure check, and the saturation-based ``/healthz`` 503
+        never count requests that can never run."""
         now = time.monotonic() if now is None else now
         expired = []
         with self._lock:

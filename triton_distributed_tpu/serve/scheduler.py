@@ -81,6 +81,11 @@ class SchedulerConfig:
     # lower bound on the bounded decode dispatch budget, so one request
     # with microseconds left cannot watchdog a healthy step
     step_deadline_floor_ms: float = 25.0
+    # KV-pool audit cadence (steps) when TDT_INTEGRITY=1: full pages are
+    # stamped (fold32) as they fill and re-verified every this-many
+    # steps; a mismatch recovers the victim through the preemption-
+    # recompute path.  Ignored (zero cost) with integrity off.
+    kv_audit_interval_steps: int = 8
 
 
 @dataclasses.dataclass
@@ -92,6 +97,9 @@ class SlotState:
     length: int = 0          # valid KV positions (host truth)
     prefill_pos: int = 0     # prompt tokens already written
     next_token: int | None = None
+    # TDT_INTEGRITY=1 only: logical page index -> fold32 stamp, taken
+    # when the page FILLED (its bytes never legally change afterwards)
+    page_stamps: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -142,6 +150,8 @@ class Scheduler:
         self.evicted_pages = 0
         self._consec_step_failures = 0
         self._saturated_since: float | None = None
+        # TDT_INTEGRITY=1 KV-pool audit findings (req_id, page, step)
+        self.kv_corruptions: list[dict] = []
 
     # -- submission --------------------------------------------------------
 
@@ -151,6 +161,13 @@ class Scheduler:
         immediately with a typed reason — queueing it would waste its
         deadline on an impossible promise."""
         now = time.monotonic() if now is None else now
+        # eager deadline sweep (ISSUE 7 satellite): expired entries must
+        # not occupy depth against THIS submit — between ticks a burst
+        # would otherwise shed viable work because the queue is "full"
+        # of requests that can never run, and the depth gauge / the
+        # saturation 503 would count them
+        for dead in self.queue.expire_deadlines(now):
+            self._note_shed(dead)
         total = req.prompt_len + req.max_new_tokens
         reason = None
         if total > self.backend.max_length:
@@ -205,6 +222,11 @@ class Scheduler:
         self.admitted += res.admitted
         res.prefill_tokens = self._prefill_work(now)
         res.decoded = self._decode_work(now)
+
+        from ..resilience import integrity
+
+        if self.cfg.kv_audit_interval_steps > 0 and integrity.enabled():
+            self._kv_audit(now)
         res.completed = len(self.completed) - c0
         res.failed = len(self.failed) - f0
         res.shed = len(self.shed) - s0
@@ -311,6 +333,9 @@ class Scheduler:
             slot.prefill_pos += take
             done_tokens += take
             if slot.prefill_pos >= plen:
+                if req.kv_stamps and self._verify_restore(i, slot) \
+                        is not None:
+                    continue
                 slot.length = plen
                 slot.next_token = int(first)
                 req.tokens = [int(first)]
@@ -485,6 +510,90 @@ class Scheduler:
             failed += 1
         return failed
 
+    # -- KV-pool audit (TDT_INTEGRITY=1) -----------------------------------
+
+    def _kv_audit(self, now: float) -> None:
+        """Checksum the paged-KV pool (docs/robustness.md "Data
+        integrity"): a page is STAMPED (``integrity.fold_page``) the
+        step it fills — its bytes never legally change afterwards — and
+        every ``kv_audit_interval_steps`` every stamped page is
+        re-folded.  A mismatch is at-rest corruption
+        (``corrupt_kv_page``): the victim is recovered through the
+        preemption-recompute path (pages evicted, request re-queued,
+        prompt deterministically recomputed) instead of shipping tokens
+        attended over poisoned KV; cohabitants' caches are untouched."""
+        from ..resilience import integrity
+
+        ps = self.pool.page_size
+        audit = self.steps % self.cfg.kv_audit_interval_steps == 0
+        # collect every page this pass needs folded — newly-full pages
+        # to stamp plus (on audit ticks) every stamped page to
+        # re-verify — and fold them in ONE batched device read
+        to_stamp: list[tuple[SlotState, int]] = []
+        pages: set[int] = set()
+        for slot in self.slots:
+            if slot is None:
+                continue
+            written = max(slot.length, slot.prefill_pos)
+            for j in range(written // ps):
+                if j not in slot.page_stamps:
+                    to_stamp.append((slot, j))
+                    pages.add(int(slot.pages[j]))
+            if audit:
+                pages.update(int(slot.pages[j])
+                             for j in slot.page_stamps)
+        folds = integrity.fold_pages(self.cache, pages)
+        for slot, j in to_stamp:
+            slot.page_stamps[j] = folds[int(slot.pages[j])]
+        if not audit:
+            return
+        for i, slot in enumerate(self.slots):
+            if slot is None or not slot.page_stamps:
+                continue
+            if obs.enabled():
+                obs.counter("integrity_checks", op="kv_audit").inc()
+            bad = next(
+                (j for j, want in sorted(slot.page_stamps.items())
+                 if folds[int(slot.pages[j])] != want),
+                None)
+            if bad is None:
+                continue
+            page = int(slot.pages[bad])
+            self.kv_corruptions.append({
+                "req_id": slot.request.req_id, "page": page,
+                "logical": int(bad), "step": self.steps,
+            })
+            if obs.enabled():
+                obs.counter("integrity_failures", op="kv_audit",
+                            kind="kv_page").inc()
+            self._preempt_slot(i)
+
+    def _verify_restore(self, i: int, slot: SlotState) -> int | None:
+        """The verify-on-preempt-restore half of checksum-on-evict: the
+        stamps carried through preemption pin the deterministic
+        recompute.  A mismatch means the original write OR the recompute
+        is corrupt — neither copy can be trusted, so the victim FAILS
+        with the corruption named rather than shipping silently-
+        divergent tokens.  Returns the bad logical page, or None."""
+        from ..resilience import integrity
+
+        req = slot.request
+        folds = integrity.fold_pages(
+            self.cache, [slot.pages[j] for j in req.kv_stamps])
+        for j, want in sorted(req.kv_stamps.items()):
+            if folds[int(slot.pages[j])] != want:
+                if obs.enabled():
+                    obs.counter("integrity_failures", op="kv_restore",
+                                kind="kv_page").inc()
+                self._fail_slot(
+                    i, f"PayloadCorruption: recomputed KV page "
+                       f"{int(slot.pages[j])} (logical {j}) of request "
+                       f"{req.req_id} does not match its pre-eviction "
+                       f"stamp", time.monotonic())
+                return j
+        req.kv_stamps = None
+        return None
+
     # -- slot lifecycle ----------------------------------------------------
 
     def _release_slot(self, i: int) -> SlotState:
@@ -525,6 +634,19 @@ class Scheduler:
         self.preemptions += 1
         self.evicted_pages += npages
         self.governor.note_preemption()
+        if slot.page_stamps and slot.request.kv_stamps is None:
+            # checksum-on-evict (TDT_INTEGRITY=1; stamps only exist when
+            # the audit armed them): carry the full-prompt-page stamps so
+            # the recompute can be verified against the original write.
+            # Only when NO carry is pending: a re-preemption during a
+            # restore prefill must not replace the original-write stamps
+            # with stamps of the still-UNVERIFIED recompute — the carry
+            # survives until _verify_restore consumes it, so every
+            # restore compares against the original write
+            full_prompt = slot.request.prompt_len // self.pool.page_size
+            carry = {j: s for j, s in slot.page_stamps.items()
+                     if j < full_prompt}
+            slot.request.kv_stamps = carry or None
         self.queue.requeue_preempted(slot.request)
         if obs.enabled():
             obs.serve_stats.STATS.request_preempted(pages=npages)
@@ -594,6 +716,7 @@ class Scheduler:
             "shed": len(self.shed),
             "preemptions": self.preemptions,
             "evicted_pages": self.evicted_pages,
+            "kv_corruptions": len(self.kv_corruptions),
             "active_slots": sum(s is not None for s in self.slots),
             "slot_cap": self.governor.slot_cap(len(self.slots)),
             "governor": self.governor.snapshot(),

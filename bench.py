@@ -627,6 +627,90 @@ def bench_decode_modes(batch: int = 128):
     }
 
 
+def bench_fused_decode(batch: int = 128):
+    """Full-model decode step, ``decode_mode="fused"`` (the ISSUE-8
+    decode megakernel: per-layer attention fused into one kernel on the
+    paged cache, MLP/o-proj reductions semaphore-chained) vs the psum
+    per-kernel baseline.  ``vs_baseline`` = psum-mode time / fused-mode
+    time (>1 means the megakernel wins); ``value`` = ms/step fused.  The
+    exposed-wait proof rides the flight timeline
+    (``scripts/obs_report.py --timeline fused_mlp_ar``), not this
+    record."""
+    import numpy as np
+
+    from triton_distributed_tpu.core import mesh as mesh_lib
+    from triton_distributed_tpu.models import Engine, ModelConfig
+
+    mesh = mesh_lib.tp_mesh()
+    ntp = mesh.shape["tp"]
+    cfg = ModelConfig(
+        num_layers=4, hidden=2048, intermediate=4096, num_heads=16,
+        num_kv_heads=8, head_dim=128, vocab=8192, max_length=256,
+        dtype=jnp.bfloat16,
+    )
+    steps = {}
+    for mode in ("psum", "fused"):
+        eng = Engine.build(cfg, mesh, key=jax.random.key(0), batch=batch,
+                           decode_mode=mode, cache_layout="paged")
+        ids = jnp.asarray(
+            np.random.default_rng(1).integers(0, cfg.vocab, (batch, 64)),
+            jnp.int32,
+        )
+        eng.prefill(ids)
+        tok = jnp.zeros((batch,), jnp.int32)
+        steps[mode] = lambda eng=eng, tok=tok: eng.decode_step(tok)
+    times = _bench_interleaved(steps, iters=16, rounds=9)
+    ms = _median(times["fused"]) * 1e3
+    return {
+        "metric": f"decode_ms_per_token_fused_b{batch}_tp{ntp}",
+        "value": round(ms, 3),
+        "unit": "ms/step (fused mode)",
+        "vs_baseline": round(_median_ratio(times, "psum", "fused"), 4),
+        "devices": jax.device_count(),
+        "interpret": _interpret_capture(),
+    }
+
+
+def bench_decode_dispatches(batch: int = 8):
+    """Static per-decode-step kernel-dispatch count, fused vs the
+    per-kernel chain (``ops.fused_decode.count_decode_dispatches``):
+    pallas launches, MXU GEMMs, cache scatters and cross-rank
+    reductions in one traced step.  Deterministic in (shapes, tp) — the
+    ISSUE-8 acceptance number (>= 2x reduction on a slice, where the
+    per-kernel chain also pays its two reductions per layer), and the
+    completeness anchor for the fused family in every round."""
+    from triton_distributed_tpu.core import mesh as mesh_lib
+    from triton_distributed_tpu.models import (
+        Engine, ModelConfig, Qwen3,
+    )
+    from triton_distributed_tpu.ops import count_decode_dispatches
+
+    mesh = mesh_lib.tp_mesh()
+    ntp = mesh.shape["tp"]
+    cfg = ModelConfig(
+        num_layers=4, hidden=2048, intermediate=4096, num_heads=16,
+        num_kv_heads=8, head_dim=128, vocab=8192, max_length=256,
+        dtype=jnp.bfloat16,
+    )
+    eng = Engine.build(cfg, mesh, key=jax.random.key(0), batch=batch,
+                       cache_layout="paged")
+    tok = jnp.zeros((batch,), jnp.int32)
+    counts = {}
+    for mode in ("psum", "fused"):
+        model = Qwen3(cfg, mesh, decode_mode=mode)
+        counts[mode] = count_decode_dispatches(
+            model, eng.params, eng.cache, tok)
+    return {
+        "metric": f"decode_step_dispatches_b{batch}_L{cfg.num_layers}"
+                  f"_tp{ntp}",
+        "value": round(counts["psum"] / max(counts["fused"], 1), 3),
+        "unit": "x fewer dispatches (psum chain / fused)",
+        "dispatches_fused": counts["fused"],
+        "dispatches_unfused": counts["psum"],
+        "devices": jax.device_count(),
+    }
+
+
 def _decode_mode_wire_bytes(cfg, batch: int, ntp: int) -> dict:
     """Per-chip wire bytes one decode step moves through its row-parallel
     reductions (o-proj + MLP down-proj per layer) in each ``decode_mode``,
@@ -1197,7 +1281,11 @@ def main():
     elif mode == "moe":
         print(json.dumps(bench_group_gemm()))
     elif mode == "decode":
+        # the decode surface: split-KV attention kernel, the ISSUE-8
+        # megakernel dispatch accounting, and the fused-mode step time
         print(json.dumps(bench_decode()))
+        print(json.dumps(bench_decode_dispatches()))
+        print(json.dumps(bench_fused_decode()))
     elif mode == "decode_modes":
         print(json.dumps(bench_decode_modes()))
     elif mode == "moe_ep":
@@ -1229,6 +1317,8 @@ def main():
         _emit(bench_tp_mlp)
         _emit(bench_group_gemm)
         _emit(bench_decode_modes)
+        _emit(bench_decode_dispatches)
+        _emit(bench_fused_decode)
         _emit(bench_moe_ep_wire)
         _emit(bench_latency)
         _emit(bench_overlap)

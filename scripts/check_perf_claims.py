@@ -193,6 +193,26 @@ CLAIMS = {
     # (never hard-gated)
     "integrity_overhead_pct": {"warn_max": 5.0, "value_max": 100.0,
                                "since": 7},
+    # decode megakernel (ISSUE 8; `bench.py decode` / `auto`).  The
+    # dispatch count is STATIC (traced-jaxpr accounting,
+    # ops.fused_decode.count_decode_dispatches): on a slice the fused
+    # chain must issue <= half the per-kernel chain's dispatches — the
+    # acceptance number.  At tp=1 the per-kernel chain has no collective
+    # launches to elide (the ratio is ~1.9 there), so the hard floor is
+    # slice-gated like overlap_hidden_pct; single-chip draws are
+    # trended by obs.history.
+    "decode_step_dispatches": {
+        "floor": 2.0, "min_devices": 2, "since": 8,
+    },
+    # fused-mode ms/step: value_max is a gross-regression tripwire (the
+    # same bound qwen_decode_step uses); on a real slice the megakernel
+    # must at least hold parity with the psum chain it replaces — a
+    # fused path SLOWER than per-kernel dispatch means the fusion is
+    # broken, not merely unprofitable
+    "decode_ms_per_token_fused": {
+        "value_max": 20.0, "ratio_spread": (0.90, 3.0),
+        "slice_ratio_floor": 0.95, "since": 8,
+    },
     # measured DMA/MXU overlap of the tile pipeline (tools/overlap.py
     # three-kernel decomposition): a serialized pipeline reads ~0, the
     # r05 capture read 0.76; the clamp makes 1.0 the hard maximum

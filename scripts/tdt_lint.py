@@ -26,9 +26,10 @@ Usage:
 ``--faults`` runs the ``tdt.resilience`` fault-injection matrix
 headlessly (docs/robustness.md): every fault class (dropped/delayed
 notify, stale credit, straggler, rank abort) against every guarded
-kernel family, asserting each injection is either DETECTED (timeout /
-hazard naming the pending semaphore or chunk) or SURVIVED (completed in
-budget with balanced credits).
+kernel family — the decode megakernel's semaphore-chained
+``fused_mlp_ar`` included — asserting each injection is either
+DETECTED (timeout / hazard naming the pending semaphore or chunk) or
+SURVIVED (completed in budget with balanced credits).
 
 ``--timeline`` is the flight-recorder regression smoke
 (docs/observability.md "Flight recorder"): record a 2-rank AllGather

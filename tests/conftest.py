@@ -127,6 +127,10 @@ FAST_NODES = frozenset((
     "tests/test_serve.py::test_healthz_flips_503_under_saturation_then_200",
     "tests/test_integrity.py::test_matrix_corruption_cells_all_detected",
     "tests/test_integrity.py::test_kv_poison_recovery_matches_unpressured_run",
+    "tests/test_fused_decode.py::"
+    "test_fused_mlp_ar_protocol_clean[swiglu-4]",
+    "tests/test_fused_decode.py::test_fused_fault_cells_detected_or_survived",
+    "tests/test_fused_decode.py::test_decode_writeback_copy_count",
 ))
 
 

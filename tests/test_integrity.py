@@ -149,8 +149,8 @@ def test_clean_traces_have_no_findings():
 
 def test_matrix_corruption_cells_all_detected():
     rows = rz.run_matrix(seed=0, kinds=rz.CORRUPTION_KINDS)
-    # both classes x all 6 kernel families
-    assert len(rows) == 12
+    # both classes x all 7 kernel families (fused_mlp_ar since ISSUE 8)
+    assert len(rows) == 14
     for row in rows:
         assert row["outcome"] == "detected", row
         assert row["named"], row
@@ -207,6 +207,14 @@ MATRIX_GOLDEN = {
     ("gemm_ar/ring", "rank_abort"),
     ("gemm_ar/ring", "corrupt_payload"),
     ("gemm_ar/ring", "corrupt_kv_page"),
+    # the decode megakernel's semaphore-chained MLP+AllReduce (ISSUE 8)
+    ("fused_mlp_ar/swiglu", "drop_notify"),
+    ("fused_mlp_ar/swiglu", "delay_notify"),
+    ("fused_mlp_ar/swiglu", "stale_credit"),
+    ("fused_mlp_ar/swiglu", "straggler"),
+    ("fused_mlp_ar/swiglu", "rank_abort"),
+    ("fused_mlp_ar/swiglu", "corrupt_payload"),
+    ("fused_mlp_ar/swiglu", "corrupt_kv_page"),
 }
 
 SCHEDULER_GOLDEN = {

@@ -740,6 +740,7 @@ def test_fused_builders_carry_cost_estimates():
     a2a_mod = importlib.import_module("triton_distributed_tpu.comm.all_to_all")
     ag_mod = importlib.import_module("triton_distributed_tpu.ops.ag_gemm")
     attn_mod = importlib.import_module("triton_distributed_tpu.ops.attention")
+    fd_mod = importlib.import_module("triton_distributed_tpu.ops.fused_decode")
     gar_mod = importlib.import_module("triton_distributed_tpu.ops.gemm_ar")
     grs_mod = importlib.import_module("triton_distributed_tpu.ops.gemm_rs")
     mm_mod = importlib.import_module("triton_distributed_tpu.ops.matmul")
@@ -753,6 +754,7 @@ def test_fused_builders_carry_cost_estimates():
         (attn_mod, ["_build_flash_attention", "_build_attn_chunk",
                     "_build_decode", "_build_decode_fused",
                     "_build_paged_decode"]),
+        (fd_mod, ["_build_fused_attn", "_build_fused_mlp_ar"]),
     ):
         for name in builders:
             fn = getattr(mod, name)

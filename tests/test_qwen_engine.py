@@ -180,6 +180,11 @@ def test_engine_decode_mode_switch():
     eng.set_decode_mode("gemm_ar")
     toks_gar = np.asarray(eng.generate(ids, 4))
     np.testing.assert_array_equal(toks_psum, toks_gar)
+    # the decode megakernel mode rides the same switch (contiguous
+    # cache here: fused reductions, per-kernel attention)
+    eng.set_decode_mode("fused")
+    toks_fused = np.asarray(eng.generate(ids, 4))
+    np.testing.assert_array_equal(toks_psum, toks_fused)
 
 
 def test_engine_generate_greedy_deterministic():

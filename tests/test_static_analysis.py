@@ -40,6 +40,7 @@ def test_registry_covers_required_families():
         "reduce_scatter/ring", "allreduce/one_shot", "allreduce/two_shot",
         "all_to_all/dispatch", "all_to_all/combine",
         "ag_gemm/unidir", "ag_gemm/bidir", "gemm_rs/ring", "gemm_ar/ring",
+        "fused_mlp_ar/swiglu", "fused_mlp_ar/linear",
     }
     assert required <= names, required - names
 
@@ -252,7 +253,8 @@ def _run_lint(*args):
 def test_cli_full_matrix_clean():
     res = _run_lint()
     assert res.returncode == 0, res.stdout + res.stderr
-    assert "36 kernel cases" in res.stdout
+    # 42 = the pre-ISSUE-8 36 plus fused_mlp_ar/{swiglu,linear} x {2,4,8}
+    assert "42 kernel cases" in res.stdout
     assert "0 violation(s)" in res.stdout
 
 

@@ -32,7 +32,7 @@ DEFAULT_RANKS = (2, 4, 8)
 # a2a builders map onto the one shared kernel body
 FAMILIES = (
     "allgather", "reduce_scatter", "allreduce", "all_to_all",
-    "ag_gemm", "gemm_rs", "gemm_ar",
+    "ag_gemm", "gemm_rs", "gemm_ar", "fused_mlp_ar",
 )
 
 _FAMILY_ALIASES = {"ep_dispatch": "all_to_all", "ep_combine": "all_to_all"}
@@ -290,6 +290,51 @@ def _gemm_ar_cases(n: int) -> list[KernelCase]:
     return [KernelCase("gemm_ar/ring", "gemm_ar", n, make)]
 
 
+def _fused_mlp_ar_cases(n: int) -> list[KernelCase]:
+    import jax.numpy as jnp
+
+    from ..ops.fused_decode import FusedMlpConfig, _fused_mlp_ar_kernel
+
+    b, k_in, k_loc = 2, 8, 8
+    n_dim = 4 * n            # cn = 4 per chunk
+    team = _team(n)
+    cfg = FusedMlpConfig()
+
+    def make_common(rank, swiglu: bool):
+        args = [FakeRef("x", (b, k_in))]
+        if swiglu:
+            args.append(FakeRef("gate_up", (k_in, 2 * k_loc)))
+        args.append(FakeRef("w_dn", (k_loc, n_dim)))
+        args.append(FakeRef("out", (n * b, n_dim // n)))
+        if swiglu:
+            args += [FakeRef("g_buf", (b, k_loc)),
+                     FakeRef("u_buf", (b, k_loc)),
+                     FakeRef("act_buf", (b, k_loc))]
+        cn = n_dim // n
+        args += [
+            FakeRef("mm_buf", (2, b, cn)),
+            FakeRef("recv_buf", (2, b, cn)),
+            FakeRef("send_buf", (2, b, cn)),
+            FakeSem("send_sems"), FakeSem("recv_sems"),
+            FakeSem("ack_sems", kind="regular"),
+            FakeSem("ag_send_sem"), FakeSem("ag_recv_sems"),
+        ]
+        if swiglu:
+            args.append(FakeRef("acc_up", (1, 1)))
+        args.append(FakeRef("acc", (1, 1)))
+        label = "swiglu" if swiglu else "linear"
+        return label, lambda: _fused_mlp_ar_kernel(
+            team, b, k_in, k_loc, n_dim, cfg, swiglu, jnp.float32, *args,
+        )
+
+    return [
+        KernelCase("fused_mlp_ar/swiglu", "fused_mlp_ar", n,
+                   lambda rank: make_common(rank, True)),
+        KernelCase("fused_mlp_ar/linear", "fused_mlp_ar", n,
+                   lambda rank: make_common(rank, False)),
+    ]
+
+
 _FAMILY_CASES = {
     "allgather": _ag_cases,
     "reduce_scatter": _rs_cases,
@@ -298,6 +343,7 @@ _FAMILY_CASES = {
     "ag_gemm": _ag_gemm_cases,
     "gemm_rs": _gemm_rs_cases,
     "gemm_ar": _gemm_ar_cases,
+    "fused_mlp_ar": _fused_mlp_ar_cases,
 }
 
 

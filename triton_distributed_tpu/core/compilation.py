@@ -132,6 +132,7 @@ _COLLECTIVE_IDS: dict[str, int] = {
     "barrier": 13,
     "gemm_ar": 14,
     "tutorial": 15,   # user-authored kernels in tutorials/ share one family
+    "fused_mlp_ar": 16,   # decode megakernel reductions (ops/fused_decode)
 }
 
 

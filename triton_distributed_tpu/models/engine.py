@@ -185,9 +185,10 @@ class Engine:
     def build(cls, config: ModelConfig, mesh: Mesh, *, key=None,
               batch: int = 1, axis: str = TP_AXIS,
               decode_mode: str = "psum", **kw) -> "Engine":
-        """``decode_mode``: "psum" | "ar" | "gemm_ar" — the decode-step
-        reduction implementation (reference ``set_fwd``); see
-        :class:`Qwen3`."""
+        """``decode_mode``: "psum" | "ar" | "gemm_ar" | "fused" — the
+        decode-step kernel chain (reference ``set_fwd``); "fused" is the
+        decode megakernel (``ops.fused_decode``, docs/perf.md "Decode
+        megakernel"); see :class:`Qwen3`."""
         model = Qwen3(config, mesh, axis, decode_mode=decode_mode)
         params = model.init(key if key is not None else jax.random.key(0))
         return cls(model, params, batch=batch, **kw)

@@ -283,6 +283,32 @@ def write_chunk_paged(cache: PagedKVCache, layer: int, k_new: jax.Array,
     )
 
 
+def replace_layer_slices(cache, ks: list, vs: list):
+    """Rebuild the stacked (L, ...) pools from per-layer slices in ONE
+    materialization per pool.
+
+    The decode loop used to fold each layer's updated slice back with
+    ``dynamic_update_slice(cache.k, k_l[None], (layer, 0, ...))`` — L
+    sequential writes against the FULL stacked pool, each of which is a
+    whole-pool copy on any path where XLA does not prove in-place
+    fusion (eager dispatch, a donation-less jit boundary, the AOT
+    executables' input resharding).  Decode updates EVERY layer's slice
+    exactly once per step, so the loop threads the per-layer slices and
+    this helper stacks them once: 2 pool materializations per step (k
+    and v) instead of 2·L.  Pinned by
+    ``tests/test_fused_decode.py::test_decode_writeback_copy_count``.
+    """
+    if len(ks) != cache.k.shape[0] or len(vs) != cache.v.shape[0]:
+        raise ValueError(
+            f"need one slice per layer: got {len(ks)}/{len(vs)} for "
+            f"{cache.k.shape[0]} layers")
+    return dataclasses.replace(
+        cache,
+        k=jnp.stack(ks).astype(cache.k.dtype),
+        v=jnp.stack(vs).astype(cache.v.dtype),
+    )
+
+
 def init_serving_cache(mesh: Mesh, num_layers: int, slots: int,
                        kv_heads: int, max_length: int, head_dim: int,
                        dtype=jnp.bfloat16, axis: str = TP_AXIS, *,

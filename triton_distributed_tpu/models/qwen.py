@@ -49,12 +49,18 @@ from ..ops.attention import (
     flash_attention,
     paged_decode_attention,
 )
+from ..ops.fused_decode import (
+    fused_attn_decode,
+    fused_linear_ar,
+    fused_mlp_ar,
+)
 from ..ops.rope import apply_rope_at
 from .config import ModelConfig
 from .kv_cache import (
     KVCache,
     PagedKVCache,
     advance,
+    replace_layer_slices,
     with_length,
     write_chunk_paged,
     write_prefill,
@@ -80,7 +86,7 @@ class QwenParams:
     lm_head: jax.Array        # (K, V) replicated
 
 
-DECODE_MODES = ("psum", "ar", "gemm_ar")
+DECODE_MODES = ("psum", "ar", "gemm_ar", "fused")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -91,6 +97,17 @@ class Qwen3:
     (o-proj and MLP down-proj) between ``lax.psum`` and the Pallas
     AllReduce kernels — the reference's ``set_fwd`` mode switch
     (``models/qwen.py:85,143``).  Static: changing it retriggers jit.
+
+    ``"fused"`` is the decode MEGAKERNEL mode (``ops.fused_decode``,
+    docs/perf.md "Decode megakernel"): on a paged cache each layer's
+    attention side (qkv + qk-norm + rope + ragged KV-append + block-table
+    flash decode) collapses into one kernel with the pool updated in
+    place, and both row-parallel reductions run the semaphore-chained
+    SwiGLU/linear + two-shot-AllReduce column-ring kernel instead of
+    returning to the host between the GEMM and the reduction.  Shapes
+    the fused kernels cannot serve (hidden or intermediate not divisible
+    by tp) fall back per-site to the ``psum`` path — the per-kernel
+    paths stay the parity reference.
     """
 
     config: ModelConfig
@@ -113,6 +130,13 @@ class Qwen3:
         over ``axis``, ``w`` (F, H) row-parallel, result (B, H) replicated.
         Dispatches on ``decode_mode`` (see class docstring)."""
         n = self.tp
+        if (self.decode_mode == "fused"
+                and h.shape[1] % n == 0 and w.shape[1] % n == 0):
+            # megakernel mode: semaphore-chained GEMM + two-shot AR ring
+            # over output-column chunks — any B rides (ops.fused_decode);
+            # n == 1 degenerates to the plain local GEMM without the
+            # shard_map/psum wrappers
+            return fused_linear_ar(h, w, self.mesh, self.axis)
         if (self.decode_mode == "gemm_ar" and n > 1
                 and h.shape[0] % n == 0 and h.shape[1] % n == 0):
             # fused ring kernel: chunks M and the K dim n ways in-kernel
@@ -465,19 +489,14 @@ class Qwen3:
                        P(None, self.axis, None, None)),
             check_vma=False,
         )(x, p.wqkv, p.q_norm, p.k_norm, cache.k[layer], cache.v[layer], pos)
-        cache = dataclasses.replace(
-            cache,
-            k=jax.lax.dynamic_update_slice(
-                cache.k, k_l[None], (layer, 0, 0, 0, 0)
-            ),
-            v=jax.lax.dynamic_update_slice(
-                cache.v, v_l[None], (layer, 0, 0, 0, 0)
-            ),
-        )
 
         # out-projection: row-parallel reduce by decode_mode (psum at B=1
-        # sub-tile payloads; fast-AR kernels at batch)
-        return self._row_parallel_reduce(out, p.wo), cache
+        # sub-tile payloads; fast-AR kernels at batch).  The layer's
+        # updated K/V slices travel back to the decode loop, which
+        # rebuilds the stacked pool ONCE after all layers
+        # (kv_cache.replace_layer_slices) instead of rewriting the whole
+        # (L, ...) pool per layer.
+        return self._row_parallel_reduce(out, p.wo), k_l, v_l
 
     def _attn_decode_paged(self, p: TPAttnParams, x: jax.Array,
                            cache: PagedKVCache, layer: int):
@@ -534,19 +553,49 @@ class Qwen3:
             check_vma=False,
         )(x, p.wqkv, p.q_norm, p.k_norm, cache.k[layer], cache.v[layer],
           cache.block_table, cache.seq_lens)
-        cache = dataclasses.replace(
-            cache,
-            k=jax.lax.dynamic_update_slice(
-                cache.k, k_l[None], (layer, 0, 0, 0, 0)
-            ),
-            v=jax.lax.dynamic_update_slice(
-                cache.v, v_l[None], (layer, 0, 0, 0, 0)
-            ),
-        )
-        return self._row_parallel_reduce(out, p.wo), cache
+        return self._row_parallel_reduce(out, p.wo), k_l, v_l
+
+    def _attn_decode_paged_fused(self, p: TPAttnParams, x: jax.Array,
+                                 cache: PagedKVCache, layer: int):
+        """The attention megakernel step (``decode_mode="fused"``): qkv
+        GEMM, qk-norm, rope, the ragged paged append and the block-table
+        flash decode run as ONE ``pallas_call`` per rank
+        (``ops.fused_decode.fused_attn_decode``), with the page pool
+        updated in place through ``input_output_aliases`` — the four
+        dispatches plus the ``.at[].set`` pool scatter of
+        :meth:`_attn_decode_paged` collapse into a single launch."""
+        c = self.config
+
+        def local(x_rep, wqkv_loc, qn, kn, pool_k_l, pool_v_l, table, lens):
+            return fused_attn_decode(
+                x_rep, wqkv_loc, qn, kn, pool_k_l, pool_v_l, table, lens,
+                rope_theta=c.rope_theta,
+                qk_eps=c.rms_eps if c.qk_norm else None,
+            )
+
+        out, k_l, v_l = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(None, None), P(None, self.axis), P(None), P(None),
+                      P(None, self.axis, None, None),
+                      P(None, self.axis, None, None),
+                      P(None, None), P(None)),
+            out_specs=(P(None, self.axis),
+                       P(None, self.axis, None, None),
+                       P(None, self.axis, None, None)),
+            check_vma=False,
+        )(x, p.wqkv, p.q_norm, p.k_norm, cache.k[layer], cache.v[layer],
+          cache.block_table, cache.seq_lens)
+        return self._row_parallel_reduce(out, p.wo), k_l, v_l
 
     def _mlp_decode(self, p: TPMLPParams, x: jax.Array) -> jax.Array:
-        if self.decode_mode == "psum" or self.tp == 1:
+        n = self.tp
+        if (self.decode_mode == "fused"
+                and p.down.shape[0] % n == 0 and p.down.shape[1] % n == 0):
+            # megakernel mode: gate/up GEMM + SwiGLU + down-proj chained
+            # into the AR ring inside ONE kernel (ops.fused_decode) —
+            # the host never sits between the GEMM and the reduction
+            return fused_mlp_ar(x, p.gate_up, p.down, self.mesh, self.axis)
+        if self.decode_mode in ("psum", "fused") or self.tp == 1:
             def local(x_rep, gu_loc, dn_loc):
                 fused = jnp.dot(x_rep, gu_loc,
                                 preferred_element_type=jnp.float32).astype(x_rep.dtype)
@@ -581,19 +630,37 @@ class Qwen3:
     def decode(self, params: QwenParams, cache: KVCache,
                tokens: jax.Array):
         """One decode step.  ``tokens``: (B,) int32.  Returns
-        (logits (B, V), cache)."""
+        (logits (B, V), cache).
+
+        Each layer's attention step returns its updated K/V slices; the
+        stacked (L, ...) pool is rebuilt ONCE after the layer loop
+        (``kv_cache.replace_layer_slices``) — the old per-layer
+        ``dynamic_update_slice`` against the full pool was a whole-pool
+        copy per layer on any path XLA does not fuse in place.
+        ``decode_mode="fused"`` additionally runs the paged attention
+        side as one megakernel per layer (``_attn_decode_paged_fused``);
+        on a contiguous cache the fused mode keeps the per-kernel
+        attention and fuses the reductions only."""
         c = self.config
         x = params.embed[tokens]
-        attn_step = (self._attn_decode_paged if isinstance(cache, PagedKVCache)
-                     else self._attn_decode)
+        if isinstance(cache, PagedKVCache):
+            attn_step = (self._attn_decode_paged_fused
+                         if self.decode_mode == "fused"
+                         else self._attn_decode_paged)
+        else:
+            attn_step = self._attn_decode
+        ks, vs = [], []
         for li, lp in enumerate(params.layers):
-            attn_out, cache = attn_step(
+            attn_out, k_l, v_l = attn_step(
                 lp.attn, rms_norm(x, lp.ln1, c.rms_eps), cache, li
             )
+            ks.append(k_l)
+            vs.append(v_l)
             x = x + attn_out
             x = x + self._mlp_decode_step(
                 lp.mlp, rms_norm(x, lp.ln2, c.rms_eps)
             )
+        cache = replace_layer_slices(cache, ks, vs)
         x = rms_norm(x, params.final_norm, c.rms_eps)
         logits = jnp.dot(x, params.lm_head,
                          preferred_element_type=jnp.float32)

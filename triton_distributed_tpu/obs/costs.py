@@ -182,6 +182,55 @@ def decode_attention(b: int, h: int, hk: int, seq_kv: int, d: int,
     )
 
 
+def fused_attn_decode(b: int, k_dim: int, h: int, hk: int, seq_kv: int,
+                      d: int, kv_dtype) -> KernelCost:
+    """The attention-side decode megakernel (``ops.fused_decode``): the
+    per-head qkv projection GEMMs plus streaming the paged cache once —
+    the fused form of (qkv GEMM + rope/norm + append + paged decode).
+    The qkv weight is read once per kv-head GROUP (the head-outer grid
+    keeps each head's columns resident across the batch loop)."""
+    ib = _itemsize(kv_dtype)
+    qkv_cols = (h + 2 * hk) * d
+    att = decode_attention(b, h, hk, seq_kv, d, kv_dtype)
+    return KernelCost(
+        flops=att.flops + 2 * b * k_dim * qkv_cols,
+        bytes_accessed=att.bytes_accessed
+        + ib * (k_dim * qkv_cols          # weight columns, once per head
+                + b * k_dim               # activation rows
+                + 2 * b * hk * d),        # the appended K/V token slots
+        # rope adds 2 transcendentals per rotated (q + k) element
+        transcendentals=att.transcendentals + 2 * b * (h + hk) * d,
+    )
+
+
+def fused_mlp_ar(b: int, k_in: int, k_loc: int, n_dim: int,
+                 num_ranks: int, dtype, out_dtype=None, *,
+                 swiglu: bool = True) -> KernelCost:
+    """The semaphore-chained MLP/o-proj + two-shot AllReduce megakernel
+    per device: [gate/up GEMM + SwiGLU when ``swiglu``] + the down-proj
+    chunk GEMMs + travelling-partial adds, with 2(n-1)/n of the (B,
+    n_dim) output crossing ICI (ring RS + AG phases)."""
+    n = num_ranks
+    ib = _itemsize(dtype)
+    ob = _itemsize(out_dtype if out_dtype is not None else dtype)
+    dn = matmul(b, n_dim, k_loc, dtype, out_dtype)
+    flops = dn.flops + (n - 1) * b * (n_dim // max(n, 1))
+    nbytes = dn.bytes_accessed
+    transc = 0
+    if swiglu:
+        up = matmul(b, 2 * k_loc, k_in, dtype, out_dtype)
+        flops += up.flops + 3 * b * k_loc        # silu mul fold
+        nbytes += up.bytes_accessed + 3 * b * k_loc * ob
+        transc = b * k_loc                       # one exp per silu entry
+    wire = 2 * (n - 1) * b * (n_dim // max(n, 1)) * ob
+    return KernelCost(
+        flops=flops,
+        bytes_accessed=nbytes + 2 * wire,        # recv/send staging + wire
+        transcendentals=transc,
+        wire_bytes=wire,
+    )
+
+
 def all_to_all(rows: int, h: int, num_ranks: int, dtype) -> KernelCost:
     """EP A2A push kernel per device: every local row is read once and
     pushed to its destination zone; peers' rows land in our zones.
@@ -209,4 +258,9 @@ FAMILY_COSTS = {
     "decode_attention": decode_attention,
     "flash_decode": decode_attention,
     "all_to_all": all_to_all,
+    # the decode megakernels (ops/fused_decode): one flop/byte truth for
+    # their pallas cost estimates, the watchdog deadline model, and the
+    # timeline reconstructor — like every other family here
+    "fused_attn_decode": fused_attn_decode,
+    "fused_mlp_ar": fused_mlp_ar,
 }

@@ -15,6 +15,14 @@ from .attention import (
     paged_decode_attention_state,
 )
 from .flash_decode import sp_flash_decode, sp_paged_flash_decode
+from .fused_decode import (
+    FusedAttnConfig,
+    FusedMlpConfig,
+    count_decode_dispatches,
+    fused_attn_decode,
+    fused_linear_ar,
+    fused_mlp_ar,
+)
 from .gemm_ar import GemmArConfig, gemm_ar
 from .gemm_rs import GemmRsConfig, gemm_rs
 from .group_gemm import (

@@ -116,6 +116,31 @@ def make_sum_pipeline(num_in: int, m: int, n: int, bm: int, bn: int, out_dtype):
     )
 
 
+def swiglu_body(out_dtype, g_ref, u_ref, o_ref):
+    g = g_ref[...].astype(jnp.float32)
+    u = u_ref[...].astype(jnp.float32)
+    o_ref[...] = (jax.nn.silu(g) * u).astype(out_dtype)
+
+
+def make_swiglu_pipeline(m: int, n: int, bm: int, bn: int, out_dtype):
+    """An ``emit_pipeline`` computing O[m,n] = silu(G) * U blockwise in f32
+    (the gate activation between the up- and down-projections of the fused
+    decode MLP megakernel, ``ops.fused_decode``).
+
+    Call as ``pipe(g_ref, u_ref, o_ref)``.
+    """
+    stub = _protocol_stub("swiglu")
+    if stub is not None:
+        return stub
+    spec = pl.BlockSpec((bm, bn), lambda i, j: (i, j))
+    return pltpu.emit_pipeline(
+        functools.partial(swiglu_body, out_dtype),
+        grid=(m // bm, n // bn),
+        in_specs=[spec, spec],
+        out_specs=[spec],
+    )
+
+
 def make_add_pipeline(m: int, n: int, bm: int, bn: int):
     """An ``emit_pipeline`` computing O[m,n] = A + B blockwise."""
     stub = _protocol_stub("add")

@@ -1,0 +1,846 @@
+"""Decode megakernel: the per-layer decode chain collapsed into persistent,
+semaphore-chained Pallas kernels (ROADMAP item 2).
+
+A decode step through the per-kernel path issues, per layer, a long chain
+of separate dispatches — qkv projection, qk-norm, rope, the ragged
+KV-append scatter, ``paged_decode_attention``, a row-parallel o-proj
+reduce, the MLP up-projection, and another reduce (``models/qwen.py``) —
+and every kernel boundary is a host-visible launch where communication
+cannot overlap the next kernel's compute.  That is exactly the "hidden
+serialization" megakernel communication compilation (arXiv:2605.00686)
+and T3's fused transmit-on-produce (arXiv:2401.16677) eliminate.  This
+module is the TPU answer, in two fusions wired as ``decode_mode="fused"``
+(:class:`~..models.qwen.Qwen3`):
+
+- **Stage 1 — :func:`fused_attn_decode`** (local, one kernel per layer):
+  qkv GEMM + qk-norm + rope + the ragged paged KV-append + block-table
+  flash decode in ONE ``pallas_call``.  The page pool rides through
+  ``input_output_aliases`` so the token append is an in-place DMA into
+  the aliased pool instead of an XLA scatter materializing a new pool;
+  pages stream through a double-buffered in-kernel DMA pipeline, and the
+  freshly projected token's K/V are folded into the online softmax from
+  registers — the append and the attention share one launch.
+
+- **Stage 2 — :func:`fused_mlp_ar` / :func:`fused_linear_ar`**
+  (collective, family ``fused_mlp_ar``): the MLP block (gate/up GEMM +
+  SwiGLU + down-projection) chained straight into a two-shot AllReduce
+  ring through device-side semaphores (``lang/primitives``) — the
+  down-proj partial of ring step s computes while step s-1's chunk is on
+  the wire, and control never returns to the host between the GEMM and
+  the reduction.  Unlike ``ops.gemm_ar`` (which chunks M over ranks and
+  therefore needs ``B % tp == 0``), the ring here chunks the OUTPUT
+  column axis, so any decode batch size rides the fused path.  The
+  ``linear`` variant (no SwiGLU prologue) serves the attention o-proj.
+
+The per-kernel paths remain as the other ``decode_mode``s — the parity
+reference (``tests/test_fused_decode.py``) and the fallback where the
+fused constraints do not hold.  Protocol coverage: the collective kernel
+is registered in ``analysis.registry`` (family ``fused_mlp_ar``,
+verified at ranks {2, 4, 8} and covered by the fault matrix); tile/block
+configs resolve through the contextual autotuner like the other fused
+ops; ``obs.costs`` carries both families' flop/byte models so watchdog
+deadlines, Mosaic cost estimates and the flight timeline agree.  See
+docs/perf.md "Decode megakernel".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..comm import ring
+from ..comm.ring import chunk as _chunk
+from ..core import compilation
+from ..core.mesh import TP_AXIS
+from ..core.utils import clip_block
+from ..lang import primitives as dl
+from ..lang.primitives import Team
+from . import blocks
+from .attention import _init_carry, _tile_update, safe_normalize_decode
+
+# ---------------------------------------------------------------------------
+# Stage 1: fused attention-side decode (local per rank, one kernel per layer)
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedAttnConfig:
+    """Knobs of the attention megakernel.  ``vmem_limit``: scoped VMEM
+    budget (None = Mosaic default) — the per-cell working set is the
+    head's qkv weight columns plus two KV page buffers, which can exceed
+    the 16 MiB default at large hidden sizes."""
+
+    vmem_limit: int | None = None
+
+
+_FUSED_ATTN_VL = 100 * 2**20
+
+
+def fused_attn_candidates() -> list:
+    return [FusedAttnConfig(None), FusedAttnConfig(_FUSED_ATTN_VL)]
+
+
+def _rms(x, w, eps: float):
+    """In-kernel RMSNorm over the last axis, mirroring
+    ``layers.norm.rms_norm`` (f32 math, scale in f32, cast back)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps)
+    return (out * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope1(x, pos, theta: float):
+    """In-kernel rotate-half RoPE of ``x`` (rows, d) at one absolute
+    position, mirroring ``ops.rope.apply_rope_at`` numerics."""
+    d = x.shape[-1]
+    half = d // 2
+    inv = 1.0 / (theta ** (
+        jax.lax.broadcasted_iota(jnp.float32, (1, half), 1) / half))
+    ang = pos.astype(jnp.float32) * inv            # (1, half)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1 = x[:, :half].astype(jnp.float32)
+    x2 = x[:, half:].astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _fused_attn_kernel(
+    hk: int,
+    g: int,
+    d: int,
+    ps: int,
+    mp: int,
+    theta: float,
+    qk_eps,        # float | None — qk-norm epsilon (None = no norm)
+    sm_scale: float,
+    soft_cap: float,
+    *refs,
+    # inputs: table (B*mp,) SMEM (flattened row-major); lens (B,) SMEM;
+    # x (1, K) blocked per
+    # batch row; wq (K, g*d) / wk (K, d) / wv (K, d) blocked per kv head
+    # (three column views of the SAME wqkv array); [qn (1, d), kn (1, d)
+    # when qk_eps]; pool_k/pool_v (rows, ps, d) ANY (aliased outputs).
+    # outputs: out (1, 1, g, d) blocked; pool_k/pool_v aliased ANY.
+    # scratch: kbuf/vbuf (2, ps, d); ktok/vtok (1, d) pool-dtype;
+    # pg_sems DMA (2, 2); tok_sems DMA (2,)
+):
+    if qk_eps is not None:
+        (table_ref, lens_ref, x_ref, wq_ref, wk_ref, wv_ref, qn_ref,
+         kn_ref, _pk_in, _pv_in, out_ref, pool_k, pool_v,
+         kbuf, vbuf, ktok, vtok, pg_sems, tok_sems) = refs
+    else:
+        (table_ref, lens_ref, x_ref, wq_ref, wk_ref, wv_ref,
+         _pk_in, _pv_in, out_ref, pool_k, pool_v,
+         kbuf, vbuf, ktok, vtok, pg_sems, tok_sems) = refs
+        qn_ref = kn_ref = None
+    h_i = pl.program_id(0)          # local kv head (outer: weight blocks
+    b_i = pl.program_id(1)          # stay resident across the batch loop)
+    pos = lens_ref[b_i]
+    x = x_ref[...]                                   # (1, K) storage dtype
+
+    # --- qkv projection for this (sequence, kv head) cell ---------------
+    q = jax.lax.dot(x, wq_ref[...],
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+    k_new = jax.lax.dot(x, wk_ref[...],
+                        preferred_element_type=jnp.float32).astype(x.dtype)
+    v_new = jax.lax.dot(x, wv_ref[...],
+                        preferred_element_type=jnp.float32).astype(x.dtype)
+    q = q.reshape(g, d)
+    if qk_eps is not None:
+        q = _rms(q, qn_ref[...], qk_eps)
+        k_new = _rms(k_new, kn_ref[...], qk_eps)
+    q = _rope1(q, pos, theta)
+    k_new = _rope1(k_new, pos, theta)
+
+    # --- ragged append: DMA the token into its page slot in place -------
+    # (the pool is ALIASED in/out, so only this (1, d) slot moves — the
+    # per-kernel path's XLA scatter rewrites pool rows instead).  The
+    # write is drained before the page reads below so the read DMAs can
+    # never race it; the slot itself is masked out of the attention
+    # (kpos < pos), matching append-then-attend-at-pos+1 numerics.
+    pg = jnp.minimum(pos // ps, mp - 1)   # clamped like the jit scatter
+    row = table_ref[b_i * mp + pg] * hk + h_i
+    off = pos % ps
+    ktok[...] = k_new.astype(ktok.dtype)
+    vtok[...] = v_new.astype(vtok.dtype)
+    wk_copy = pltpu.make_async_copy(
+        ktok, pool_k.at[row, pl.ds(off, 1)], tok_sems.at[0])
+    wv_copy = pltpu.make_async_copy(
+        vtok, pool_v.at[row, pl.ds(off, 1)], tok_sems.at[1])
+    wk_copy.start()
+    wv_copy.start()
+    wk_copy.wait()
+    wv_copy.wait()
+
+    # --- block-table flash decode over the cached prefix [0, pos) -------
+    q_s = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
+    npages = jnp.minimum((pos + ps - 1) // ps, mp)
+
+    def page_dma(slot, j):
+        r = table_ref[b_i * mp + j] * hk + h_i
+        return (
+            pltpu.make_async_copy(pool_k.at[r], kbuf.at[slot],
+                                  pg_sems.at[slot, 0]),
+            pltpu.make_async_copy(pool_v.at[r], vbuf.at[slot],
+                                  pg_sems.at[slot, 1]),
+        )
+
+    @pl.when(npages > 0)
+    def _():
+        ck, cv = page_dma(0, 0)
+        ck.start()
+        cv.start()
+
+    def body(j, carry):
+        @pl.when(j + 1 < npages)
+        def _():
+            ck, cv = page_dma((j + 1) % 2, j + 1)
+            ck.start()
+            cv.start()
+
+        ck, cv = page_dma(j % 2, j)
+        ck.wait()
+        cv.wait()
+        k_t = kbuf[j % 2]
+        v_t = vbuf[j % 2]
+        kpos = j * ps + jax.lax.broadcasted_iota(jnp.int32, (g, ps), 1)
+        return _tile_update(q_s, k_t, v_t, kpos < pos, soft_cap, carry)
+
+    carry = jax.lax.fori_loop(0, npages, body, _init_carry(g, d))
+
+    # --- fold the just-projected token from registers -------------------
+    # (an 8-row tile keeps the score matmul sublane-aligned; rows past
+    # the first are masked)
+    kt8 = jnp.concatenate([k_new, jnp.zeros((7, d), k_new.dtype)], axis=0)
+    vt8 = jnp.concatenate([v_new, jnp.zeros((7, d), v_new.dtype)], axis=0)
+    tok_mask = jax.lax.broadcasted_iota(jnp.int32, (g, 8), 1) == 0
+    m1, l1, acc1 = _tile_update(q_s, kt8, vt8, tok_mask, soft_cap, carry)
+    out_ref[0, 0] = safe_normalize_decode(acc1, l1, out_ref.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_fused_attn(b, k_dim, hk, g, d, pool_rows, ps, mp, theta, qk_eps,
+                      sm_scale, soft_cap, dtype, pool_dtype, cfg):
+    kernel = functools.partial(
+        _fused_attn_kernel, hk, g, d, ps, mp, theta, qk_eps, sm_scale,
+        soft_cap,
+    )
+    # three column views of the ONE (K, qkv_cols) wqkv array: q columns
+    # [h*g*d, (h+1)*g*d), k at (h_loc + h)*d, v at (h_loc + hk + h)*d —
+    # block indices address multiples of the block width, so the k/v maps
+    # offset by whole q-section widths expressed in d-wide blocks
+    h_loc = hk * g
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),            # table
+        pl.BlockSpec(memory_space=pltpu.SMEM),            # lens
+        pl.BlockSpec((1, k_dim), lambda h, bi: (bi, 0)),  # x row
+        pl.BlockSpec((k_dim, g * d), lambda h, bi: (0, h)),
+        pl.BlockSpec((k_dim, d), lambda h, bi: (0, h_loc + h)),
+        pl.BlockSpec((k_dim, d), lambda h, bi: (0, h_loc + hk + h)),
+    ]
+    if qk_eps is not None:
+        in_specs += [
+            pl.BlockSpec((1, d), lambda h, bi: (0, 0)),   # q_norm
+            pl.BlockSpec((1, d), lambda h, bi: (0, 0)),   # k_norm
+        ]
+    pool_spec = pl.BlockSpec(memory_space=pl.ANY)
+    in_specs += [pool_spec, pool_spec]
+    n_in = len(in_specs)
+    from ..obs import costs
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(hk, b),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda h, bi: (h, bi, 0, 0)),
+            pool_spec,
+            pool_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((hk, b, g, d), dtype),
+            jax.ShapeDtypeStruct((pool_rows, ps, d), pool_dtype),
+            jax.ShapeDtypeStruct((pool_rows, ps, d), pool_dtype),
+        ],
+        # the pool travels in place: the token append touches one (1, d)
+        # slot of the aliased buffer instead of rewriting the pool
+        input_output_aliases={n_in - 2: 1, n_in - 1: 2},
+        scratch_shapes=[
+            pltpu.VMEM((2, ps, d), pool_dtype),
+            pltpu.VMEM((2, ps, d), pool_dtype),
+            pltpu.VMEM((1, d), pool_dtype),
+            pltpu.VMEM((1, d), pool_dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        cost_estimate=costs.pallas_cost(
+            costs.fused_attn_decode(b, k_dim, h_loc, hk, mp * ps, d,
+                                    pool_dtype)),
+        compiler_params=compilation.compiler_params(
+            collective=False,
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=cfg.vmem_limit,
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+    return jax.jit(call)
+
+
+def fused_attn_decode(
+    x: jax.Array,
+    wqkv: jax.Array,
+    q_norm: jax.Array | None,
+    k_norm: jax.Array | None,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_table: jax.Array,
+    seq_lens: jax.Array,
+    *,
+    rope_theta: float = 10_000.0,
+    qk_eps: float | None = None,
+    sm_scale: float | None = None,
+    soft_cap: float = 0.0,
+    config: FusedAttnConfig | None = None,
+):
+    """One layer's fused attention-side decode step (LOCAL per rank — call
+    inside the TP ``shard_map`` like ``paged_decode_attention``).
+
+    ``x``: (B, K) replicated activations; ``wqkv``: (K, (Hq+2Hkv)·D) this
+    rank's column shard (layout ``[q | k | v]``); ``pool_k``/``pool_v``:
+    (P, Hkv, page_size, D) page pools; ``block_table``: (B, max_pages);
+    ``seq_lens``: (B,) ragged lengths.  Returns ``(out, pool_k, pool_v)``
+    with ``out``: (B, Hq·D) attention outputs (pre o-proj) and the pools
+    updated IN PLACE (aliased) with the new token at each sequence's
+    position.  Golden: the per-kernel chain in
+    ``Qwen3._attn_decode_paged`` (qkv → norm → rope → ``append_paged``
+    scatter → ``paged_decode_attention``).
+    """
+    b, k_dim = x.shape
+    p, hk, ps, d = pool_k.shape
+    if pool_v.shape != pool_k.shape:
+        raise ValueError(
+            f"pool shape mismatch: {pool_k.shape} vs {pool_v.shape}")
+    qkv_cols = wqkv.shape[1]
+    if wqkv.shape[0] != k_dim or qkv_cols % d:
+        raise ValueError(f"wqkv {wqkv.shape} inconsistent with x {x.shape} "
+                         f"/ head_dim {d}")
+    h_loc = qkv_cols // d - 2 * hk
+    if h_loc < hk or h_loc % hk:
+        raise ValueError(
+            f"wqkv {wqkv.shape} does not hold [q|k|v] for {hk} kv heads "
+            f"at head_dim {d}")
+    mp = block_table.shape[1]
+    if block_table.shape[0] != b or seq_lens.shape != (b,):
+        raise ValueError(
+            f"block_table {block_table.shape} / seq_lens {seq_lens.shape} "
+            f"inconsistent with B={b}")
+    sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
+    eps = None if qk_eps is None else float(qk_eps)
+    if config is None:
+        from ..tune import autotuner as _tune
+
+        from ..core import platform
+
+        def thunk(c):
+            return lambda: fused_attn_decode(
+                x, wqkv, q_norm, k_norm, pool_k, pool_v, block_table,
+                seq_lens, rope_theta=rope_theta, qk_eps=qk_eps,
+                sm_scale=sm_scale, soft_cap=soft_cap, config=c)
+
+        config = _tune.resolve_config(
+            "fused_attn_decode",
+            (b, k_dim, h_loc, hk, ps, mp, d, str(x.dtype),
+             str(pool_k.dtype), platform.device_kind()),
+            fused_attn_candidates(), FusedAttnConfig(), thunk,
+            tracing=any(map(_tune.is_tracer, (x, pool_k, seq_lens))),
+        )
+    fn = _build_fused_attn(
+        b, k_dim, hk, h_loc // hk, d, p * hk, ps, mp, float(rope_theta),
+        eps, sm_scale, float(soft_cap), jnp.dtype(x.dtype),
+        jnp.dtype(pool_k.dtype), config,
+    )
+    args = [
+        block_table.astype(jnp.int32).reshape(b * mp),
+        seq_lens.astype(jnp.int32),
+        x,
+        wqkv, wqkv, wqkv,
+    ]
+    if eps is not None:
+        args += [q_norm.reshape(1, d), k_norm.reshape(1, d)]
+    args += [
+        pool_k.reshape(p * hk, ps, d),
+        pool_v.reshape(p * hk, ps, d),
+    ]
+    out, pk, pv = fn(*args)
+    out = out.transpose(1, 0, 2, 3).reshape(b, h_loc * d)
+    return out, pk.reshape(p, hk, ps, d), pv.reshape(p, hk, ps, d)
+
+
+# ---------------------------------------------------------------------------
+# Stage 2: fused MLP / linear + two-shot AllReduce (collective)
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedMlpConfig:
+    """Tile config of the semaphore-chained MLP/o-proj AllReduce kernel:
+    ``bm`` rows (clipped to B — decode batches are small), ``bn`` output
+    columns per matmul block, ``bk`` contraction depth, ``bf`` the
+    up-projection/SwiGLU feature tile."""
+
+    bm: int = 1024
+    bn: int = 512
+    bk: int = 512
+    bf: int = 512
+
+    def clip(self, b: int, k_loc: int, cn: int) -> "FusedMlpConfig":
+        return FusedMlpConfig(
+            bm=clip_block(self.bm, b), bn=clip_block(self.bn, cn),
+            bk=clip_block(self.bk, k_loc), bf=clip_block(self.bf, k_loc),
+        )
+
+
+def fused_mlp_candidates(b: int, k_loc: int, cn: int) -> list:
+    """(bm, bn, bk, bf) sweep for the ``config=None`` path, default-first
+    (the baseline the autotuner margins protect), clipped to the problem
+    and deduped — at decode shapes most tilings collapse onto the
+    default and the one-candidate sweep short-circuits."""
+    dims = [(1024, 512, 512, 512), (1024, 1024, 512, 512),
+            (1024, 512, 1024, 1024), (1024, 256, 512, 512)]
+    out, seen = [], set()
+    for bm, bn, bk, bf in dims:
+        c = FusedMlpConfig(bm, bn, bk, bf).clip(b, k_loc, cn)
+        if c not in seen:
+            seen.add(c)
+            out.append(c)
+    return out
+
+
+def _fused_mlp_ar_kernel(
+    team: Team,
+    b: int,
+    k_in: int,
+    k_loc: int,
+    n_dim: int,
+    cfg: FusedMlpConfig,
+    swiglu: bool,
+    out_dtype,
+    *refs,
+    # inputs: x (B, k_in) [ANY]; [gate_up (k_in, 2*k_loc) ANY when
+    # swiglu]; w_dn (k_loc, n_dim) [ANY].
+    # output: out (n*B, cn) [ANY] — column chunk c of AllReduce(act@w_dn)
+    # lands at rows [c*B, (c+1)*B).
+    # scratch: [g_buf/u_buf/act_buf (B, k_loc) HBM when swiglu];
+    # mm/recv/send (2, B, cn) HBM; send/recv/ack sems (2,);
+    # ag_send_sem; ag_recv_sems (n,); [acc_up (bm, bf) when swiglu];
+    # acc (bm, bn) f32 VMEM
+):
+    if swiglu:
+        (x_ref, gu_ref, dn_ref, out_ref, g_buf, u_buf, act_buf,
+         mm_buf, recv_buf, send_buf, send_sems, recv_sems, ack_sems,
+         ag_send_sem, ag_recv_sems, acc_up, acc_ref) = refs
+    else:
+        (x_ref, dn_ref, out_ref,
+         mm_buf, recv_buf, send_buf, send_sems, recv_sems, ack_sems,
+         ag_send_sem, ag_recv_sems, acc_ref) = refs
+    me, n = team.rank(), team.size
+    left, right = team.neighbor_ranks()
+    left_id, right_id = team.device_id(left), team.device_id(right)
+    cn = n_dim // n
+
+    # --- prologue: gate/up GEMM + SwiGLU, chained in-kernel -------------
+    if swiglu:
+        mmu = blocks.make_matmul_pipeline(
+            b, k_loc, k_in, cfg.bm, cfg.bf, cfg.bk, out_dtype)
+        mmu(x_ref, gu_ref.at[:, pl.ds(0, k_loc)], g_buf,
+            scratches=[acc_up])
+        mmu(x_ref, gu_ref.at[:, pl.ds(k_loc, k_loc)], u_buf,
+            scratches=[acc_up])
+        sw = blocks.make_swiglu_pipeline(b, k_loc, cfg.bm, cfg.bf,
+                                         out_dtype)
+        sw(g_buf, u_buf, act_buf)
+        a_ref = act_buf
+    else:
+        a_ref = x_ref
+
+    mm = blocks.make_matmul_pipeline(
+        b, cn, k_loc, cfg.bm, cfg.bn, cfg.bk, out_dtype)
+    add = blocks.make_add_pipeline(b, cn, cfg.bm, cfg.bn)
+
+    def dn_chunk(c):
+        return dn_ref.at[:, pl.ds(c * cn, cn)]
+
+    dl.collective_prologue(team, neighbors_only=True)
+
+    # --- phase 1: down-proj GEMM + ring ReduceScatter over OUTPUT column
+    # chunks (the ops/gemm_ar.py flow with N-chunking, so any B rides) —
+    # the partial of ring step s computes while step s-1's chunk is on
+    # the wire, chained through the DMA/ack semaphores, never the host
+    j0 = jax.lax.rem(me + n - 1, n)
+    mm(a_ref, dn_chunk(j0), mm_buf.at[0], scratches=[acc_ref])
+    dl.remote_copy(mm_buf.at[0], recv_buf.at[0], send_sems.at[0],
+                   recv_sems.at[0], right_id)
+
+    for s in range(1, n):
+        j = jax.lax.rem(me + n - s - 1, n)
+        slot_in = (s - 1) % 2
+        slot_out = s % 2
+        if s == 2:
+            dl.wait_send(mm_buf.at[0], send_sems.at[0])
+        mm(a_ref, dn_chunk(j), mm_buf.at[slot_out], scratches=[acc_ref])
+        dl.wait_recv(recv_buf.at[slot_in], recv_sems.at[slot_in])
+        last = s == n - 1
+        if last:
+            # chunk ``me`` fully reduced: land at its replicated offset
+            add(recv_buf.at[slot_in], mm_buf.at[slot_out],
+                _chunk(out_ref, me, b))
+        else:
+            if s >= 3:
+                dl.wait_send(send_buf.at[slot_out], send_sems.at[slot_out])
+            if s >= 2:
+                dl.wait(ack_sems.at[slot_out], 1)
+            add(recv_buf.at[slot_in], mm_buf.at[slot_out],
+                send_buf.at[slot_out])
+            dl.remote_copy(send_buf.at[slot_out], recv_buf.at[slot_out],
+                           send_sems.at[slot_out], recv_sems.at[slot_out],
+                           right_id)
+        dl.notify(ack_sems.at[slot_in], left_id)
+
+    # --- phase 2: AG ring of reduced chunks + drains (gemm_ar accounting)
+    ring.ag_ring_phase(team, out_ref, b, ag_send_sem, ag_recv_sems,
+                       right_id)
+    if n == 2:
+        dl.wait_send(send_buf.at[0], send_sems.at[0])
+    elif n == 3:
+        dl.wait_send(send_buf.at[1], send_sems.at[1])
+    else:
+        dl.wait_send(send_buf.at[0], send_sems.at[0])
+        dl.wait_send(send_buf.at[1], send_sems.at[1])
+    ring.rs_ack_drain(ack_sems, n)
+    ring.ag_ring_drain(team, out_ref, b, ag_send_sem)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_fused_mlp_ar(
+    mesh: Mesh,
+    axis: str,
+    b: int,
+    k_in: int,
+    k_loc: int,
+    n_dim: int,
+    swiglu: bool,
+    dtype: jnp.dtype,
+    out_dtype: jnp.dtype,
+    cfg: FusedMlpConfig,
+):
+    team = Team.of(mesh, axis)
+    n = team.size
+    compilation.verify_protocol("fused_mlp_ar", n)
+    cn = n_dim // n
+
+    from ..obs import costs
+
+    kernel = functools.partial(
+        _fused_mlp_ar_kernel, team, b, k_in, k_loc, n_dim, cfg, swiglu,
+        out_dtype,
+    )
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * (3 if swiglu else 2)
+    scratch = []
+    if swiglu:
+        scratch += [pltpu.HBM((b, k_loc), out_dtype)] * 3
+    scratch += [
+        pltpu.HBM((2, b, cn), out_dtype),
+        pltpu.HBM((2, b, cn), out_dtype),
+        pltpu.HBM((2, b, cn), out_dtype),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.REGULAR((2,)),
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.SemaphoreType.DMA((n,)),
+    ]
+    if swiglu:
+        scratch += [pltpu.VMEM((cfg.bm, cfg.bf), jnp.float32)]
+    scratch += [pltpu.VMEM((cfg.bm, cfg.bn), jnp.float32)]
+    call = pl.pallas_call(
+        kernel,
+        cost_estimate=costs.pallas_cost(
+            costs.fused_mlp_ar(b, k_in, k_loc, n_dim, n, dtype, out_dtype,
+                               swiglu=swiglu)),
+        out_shape=jax.ShapeDtypeStruct((n * b, cn), out_dtype),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=scratch,
+        compiler_params=compilation.compiler_params(
+            collective=True,
+            collective_id=compilation.collective_id("fused_mlp_ar"),
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+    if swiglu:
+        in_p = (P(None, None), P(None, axis), P(axis, None))
+    else:
+        in_p = (P(None, axis), P(axis, None))
+    return compilation.jit_shard_map(
+        call, mesh, in_specs=in_p, out_specs=P(None, None),
+    )
+
+
+def _ar_chunks_to_rows(out: jax.Array, n: int, b: int) -> jax.Array:
+    """(n*B, cn) chunk-major kernel output -> (B, n_dim) replicated."""
+    cn = out.shape[1]
+    return out.reshape(n, b, cn).transpose(1, 0, 2).reshape(b, n * cn)
+
+
+def _resolve_fused_mlp(name, b, k_in, k_loc, n_dim, n, dtype, run, *,
+                       tracing: bool):
+    from ..core import platform
+    from ..tune import autotuner as _tune
+
+    return _tune.resolve_config(
+        name,
+        (b, k_in, k_loc, n_dim, n, str(dtype), platform.device_kind()),
+        fused_mlp_candidates(b, k_loc, n_dim // n),
+        FusedMlpConfig().clip(b, k_loc, n_dim // n),
+        lambda c: (lambda: run(c)),
+        tracing=tracing,
+    )
+
+
+def _mlp_act_host(x: jax.Array, gate_up: jax.Array, n: int,
+                  out_dtype) -> jax.Array:
+    """The (B, F) SwiGLU activation the kernel feeds its down-proj,
+    recomputed on the host for the integrity check: per-rank
+    ``[gate_r | up_r]`` column blocks of the global (K, 2F) weight, with
+    the same quantization points as the in-kernel pipelines (g/u GEMMs
+    f32-accumulated then cast to ``out_dtype``, silu·mul in f32, the act
+    cast back) — so a clean kernel run sits well inside the Freivalds
+    tolerance even at bf16.  Columns land rank-major, matching ``down``'s
+    row-parallel layout, so ``act @ down`` is the verified product."""
+    f = gate_up.shape[1] // (2 * n)
+    acts = []
+    for r in range(n):
+        blk = gate_up[:, r * 2 * f:(r + 1) * 2 * f]
+        g = jnp.dot(x, blk[:, :f],
+                    preferred_element_type=jnp.float32).astype(out_dtype)
+        u = jnp.dot(x, blk[:, f:],
+                    preferred_element_type=jnp.float32).astype(out_dtype)
+        acts.append((jax.nn.silu(g.astype(jnp.float32))
+                     * u.astype(jnp.float32)).astype(out_dtype))
+    return jnp.concatenate(acts, axis=1)
+
+
+def fused_mlp_ar(
+    x: jax.Array,
+    gate_up: jax.Array,
+    down: jax.Array,
+    mesh: Mesh,
+    axis: str = TP_AXIS,
+    *,
+    config: FusedMlpConfig | None = None,
+    out_dtype=None,
+) -> jax.Array:
+    """Fused decode-MLP block: ``AllReduce(swiglu(x @ gate_up) @ down)``
+    in ONE semaphore-chained kernel per rank.
+
+    ``x``: (B, K) replicated; ``gate_up``: (K, 2F) sharded on dim 1 in
+    the rank-blocked ``[gate_r | up_r]`` layout (``layers.tp_mlp``);
+    ``down``: (F, K) row-parallel.  Returns (B, K) replicated.  Requires
+    ``F % tp == 0`` (the weight sharding) and ``K % tp == 0`` (the output
+    column chunking); B is unconstrained — the ring chunks columns, not
+    rows (cf. ``ops.gemm_ar``).  Golden: ``Qwen3._mlp_decode``'s psum
+    path.
+    """
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(x.dtype)
+    n = mesh.shape[axis]
+    b, k_in = x.shape
+    f_dim = down.shape[0]
+    n_dim = down.shape[1]
+    if gate_up.shape != (k_in, 2 * f_dim):
+        raise ValueError(
+            f"gate_up {gate_up.shape} inconsistent with x {x.shape} / "
+            f"down {down.shape}")
+    if n == 1:
+        fused = jnp.dot(x, gate_up, preferred_element_type=jnp.float32
+                        ).astype(x.dtype)
+        wg, w1 = jnp.split(fused, 2, axis=-1)
+        act = jax.nn.silu(wg) * w1
+        return jnp.dot(act, down,
+                       preferred_element_type=jnp.float32).astype(out_dtype)
+    if f_dim % n or n_dim % n:
+        raise ValueError(
+            f"F={f_dim} and N={n_dim} must be divisible by {axis}={n}")
+    k_loc = f_dim // n
+
+    def run(cfg):
+        fn = _build_fused_mlp_ar(
+            mesh, axis, b, k_in, k_loc, n_dim, True, jnp.dtype(x.dtype),
+            out_dtype, cfg.clip(b, k_loc, n_dim // n),
+        )
+        return _ar_chunks_to_rows(fn(x, gate_up, down), n, b)
+
+    from .. import resilience
+    from ..tune.autotuner import is_tracer
+
+    eager = not is_tracer(x)
+    if config is None:
+        # resolve under tracing too: the jitted decode step consults the
+        # winner cache (resolve_config's contract) so a bench/warmup
+        # crown reaches the serving path — measurement stays eager-only
+        config = _resolve_fused_mlp(
+            "fused_mlp_ar", b, k_in, k_loc, n_dim, n, x.dtype, run,
+            tracing=not eager)
+    cfg = config
+    core = lambda: run(cfg)  # noqa: E731
+    if eager and resilience.integrity.enabled():
+        # consumer-side verification (TDT_INTEGRITY=1): mirror the
+        # in-kernel act quantization on the host, then Freivalds-check
+        # the down-proj + AllReduce like the other fused GEMM entries
+        core = resilience.integrity.checked(
+            "fused_mlp_ar", core, ranks=n,
+            verify=lambda out: resilience.integrity.verify_gemm(
+                "fused_mlp_ar",
+                _mlp_act_host(x, gate_up, n, out_dtype), down, out))
+    if eager and resilience.enabled():
+        return resilience.guarded(
+            "fused_mlp_ar", core,
+            family="fused_mlp_ar", ranks=n,
+            payload_bytes=b * n_dim * jnp.dtype(out_dtype).itemsize,
+            fallback=lambda: resilience.fallbacks.xla_fused_mlp_ar(
+                x, gate_up, down, mesh, axis, out_dtype),
+        )()
+    return core()
+
+
+def fused_linear_ar(
+    h: jax.Array,
+    w: jax.Array,
+    mesh: Mesh,
+    axis: str = TP_AXIS,
+    *,
+    config: FusedMlpConfig | None = None,
+    out_dtype=None,
+) -> jax.Array:
+    """Fused row-parallel projection: ``AllReduce(h @ w)`` through the
+    same semaphore-chained column-ring kernel, without the SwiGLU
+    prologue — the decode o-proj reduction.
+
+    ``h``: (B, F) sharded on dim 1; ``w``: (F, N) row-parallel.  Returns
+    (B, N) replicated.  Unlike ``ops.gemm_ar`` this needs no ``B % tp``
+    (columns are chunked), only ``N % tp == 0``.
+    """
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(h.dtype)
+    n = mesh.shape[axis]
+    b, f_dim = h.shape
+    if w.shape[0] != f_dim:
+        raise ValueError(f"inner dims mismatch: {h.shape} @ {w.shape}")
+    n_dim = w.shape[1]
+    if n == 1:
+        return jnp.dot(h, w,
+                       preferred_element_type=jnp.float32).astype(out_dtype)
+    if f_dim % n or n_dim % n:
+        raise ValueError(
+            f"F={f_dim} and N={n_dim} must be divisible by {axis}={n}")
+    k_loc = f_dim // n
+
+    def run(cfg):
+        fn = _build_fused_mlp_ar(
+            mesh, axis, b, k_loc, k_loc, n_dim, False, jnp.dtype(h.dtype),
+            out_dtype, cfg.clip(b, k_loc, n_dim // n),
+        )
+        return _ar_chunks_to_rows(fn(h, w), n, b)
+
+    from .. import resilience
+    from ..tune.autotuner import is_tracer
+
+    eager = not is_tracer(h)
+    if config is None:
+        # winner-cache consult under tracing, like fused_mlp_ar above
+        config = _resolve_fused_mlp(
+            "fused_linear_ar", b, k_loc, k_loc, n_dim, n, h.dtype, run,
+            tracing=not eager)
+    cfg = config
+    core = lambda: run(cfg)  # noqa: E731
+    if eager and resilience.integrity.enabled():
+        # plain AllReduce(h @ w): the gemm_ar Freivalds check applies as-is
+        core = resilience.integrity.checked(
+            "fused_linear_ar", core, ranks=n,
+            verify=lambda out: resilience.integrity.verify_gemm(
+                "fused_linear_ar", h, w, out))
+    if eager and resilience.enabled():
+        return resilience.guarded(
+            "fused_linear_ar", core,
+            family="fused_mlp_ar", ranks=n,
+            payload_bytes=b * n_dim * jnp.dtype(out_dtype).itemsize,
+            fallback=lambda: resilience.fallbacks.xla_gemm_ar(
+                h, w, mesh, axis, out_dtype),
+        )()
+    return core()
+
+
+# ---------------------------------------------------------------------------
+# dispatch accounting: the number the megakernel exists to shrink
+
+
+# primitives that survive XLA fusion as separate dispatches (or fusion
+# barriers) on the decode path: Pallas launches, MXU GEMMs, cache
+# scatters/updates, and cross-rank reductions.  Elementwise chains
+# (norms, rope, residuals) fuse into their neighbours and are not
+# counted — this is a conservative static proxy, identical for both
+# modes, so the fused/unfused RATIO is meaningful wherever tracing runs.
+DISPATCH_PRIMS = frozenset((
+    "pallas_call",
+    "dot_general",
+    "scatter",
+    "scatter-add",
+    "dynamic_update_slice",
+    "psum",
+    "psum_invariant",
+    "all_reduce",
+    "all_gather",
+    "reduce_scatter",
+    "ppermute",
+))
+
+
+def count_jaxpr_dispatches(fn, *args, **kw) -> int:
+    """Count kernel-dispatch-shaped equations in ``fn``'s jaxpr,
+    descending into pjit/shard_map/loop/custom-vjp sub-jaxprs (a loop
+    body's dispatches count once — the decode layer loop is unrolled in
+    the model, so per-layer work is fully visible)."""
+    jaxpr = jax.make_jaxpr(fn)(*args, **kw)
+
+    def walk(jx) -> int:
+        total = 0
+        for eqn in jx.eqns:
+            if eqn.primitive.name in DISPATCH_PRIMS:
+                total += 1
+            for v in eqn.params.values():
+                for sub in _sub_jaxprs(v):
+                    total += walk(sub)
+        return total
+
+    return walk(jaxpr.jaxpr)
+
+
+def _sub_jaxprs(v):
+    import jax.core as jcore
+
+    if isinstance(v, jcore.ClosedJaxpr):
+        yield v.jaxpr
+    elif isinstance(v, jcore.Jaxpr):
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for item in v:
+            yield from _sub_jaxprs(item)
+
+
+def count_decode_dispatches(model, params, cache, tokens) -> int:
+    """Static dispatch count of one ``model.decode`` step (the metric
+    ``bench.py decode`` records as ``decode_step_dispatches``)."""
+    return count_jaxpr_dispatches(
+        lambda p, c, t: model.decode(p, c, t), params, cache, tokens)

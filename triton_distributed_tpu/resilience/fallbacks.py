@@ -139,6 +139,35 @@ def xla_gemm_ar(a: jax.Array, b: jax.Array, mesh, axis: str,
     return _build_gemm_ar(mesh, axis, out_dtype)(a, b)
 
 
+@functools.lru_cache(maxsize=None)
+def _build_fused_mlp_ar(mesh, axis: str, out_dtype):
+    def local(x_rep, gu_shard, dn_shard):
+        fused = jnp.dot(x_rep, gu_shard,
+                        preferred_element_type=jnp.float32
+                        ).astype(x_rep.dtype)
+        wg, w1 = jnp.split(fused, 2, axis=-1)
+        act = jax.nn.silu(wg) * w1
+        part = jnp.dot(act, dn_shard, preferred_element_type=jnp.float32)
+        return jax.lax.psum(part, axis).astype(out_dtype)
+
+    return compilation.jit_shard_map(
+        local, mesh,
+        in_specs=(P(None, None), P(None, axis), P(axis, None)),
+        out_specs=P(None, None),
+    )
+
+
+def xla_fused_mlp_ar(x: jax.Array, gate_up: jax.Array, down: jax.Array,
+                     mesh, axis: str, out_dtype=None) -> jax.Array:
+    """Degraded ``ops.fused_decode.fused_mlp_ar``: the unfused decode-MLP
+    psum path (local gate/up GEMM + SwiGLU + partial down GEMM + XLA
+    AllReduce) — no Pallas kernel, no semaphore, the code path a stuck
+    link cannot reach.  The ``fused_linear_ar`` variant degrades to
+    :func:`xla_gemm_ar` (same math)."""
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(x.dtype)
+    return _build_fused_mlp_ar(mesh, axis, out_dtype)(x, gate_up, down)
+
+
 # ---------------------------------------------------------------------------
 # EP all-to-all (ISSUE 7 satellite: the two entries PR 3 left
 # watchdog-only).  The zone layout is a SELECTION of rows — no

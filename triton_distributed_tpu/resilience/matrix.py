@@ -52,6 +52,10 @@ DEFAULT_KERNELS = (
     "all_to_all/dispatch",
     "gemm_rs/ring",
     "gemm_ar/ring",
+    # the decode megakernel's semaphore-chained MLP+AR (ISSUE 8): the
+    # fused reduction must stay covered by injection like every other
+    # signal-shaped kernel
+    "fused_mlp_ar/swiglu",
 )
 
 # classes whose injection MUST be caught: they stall or corrupt

@@ -72,7 +72,10 @@ def _estimate_ms(op: str, payload_bytes: int, num_ranks: int) -> float:
         return perf_model.allgather_sol_ms(b, n)
     if op in ("reduce_scatter", "gemm_rs"):
         return perf_model.reduce_scatter_sol_ms(b, n)
-    if op in ("all_reduce", "gemm_ar"):
+    if op in ("all_reduce", "gemm_ar", "fused_mlp_ar", "fused_linear_ar"):
+        # the decode megakernel reductions wire 2(n-1)/n of the payload
+        # like any two-shot AllReduce; the chained GEMM/SwiGLU time is
+        # bounded by the same payload heuristic under the slack
         return perf_model.allreduce_sol_ms(b, n)
     if op in ("ep_dispatch", "ep_combine"):
         # worst case: the whole local payload crosses the wire once

@@ -179,6 +179,14 @@ class EngineBackend:
     buckets), so chunked prefill compiles exactly ONE executable.
     Sampling is greedy — the deterministic-recompute contract
     preemption relies on.
+
+    The engine's ``decode_mode`` (including the ``"fused"`` decode
+    megakernel, ``ops.fused_decode``) flows through unchanged: the
+    scheduler drives the same stateless ``Qwen3.decode`` signature
+    whichever kernel chain implements it, so flipping an engine to
+    ``decode_mode="fused"`` swaps the whole serving decode hot path
+    without touching scheduler state (``decode_mode`` property below
+    surfaces the active mode for health/debug endpoints).
     """
 
     def __init__(self, engine, *, pool_pages: int | None = None,
@@ -208,6 +216,12 @@ class EngineBackend:
         # never do — one trace each for the scheduler's whole lifetime
         self._decode = jax.jit(self.model.decode)
         self._prefill_chunk = jax.jit(self.model.prefill_chunk)
+
+    @property
+    def decode_mode(self) -> str:
+        """The decode kernel chain this backend's step executes
+        (``"psum"`` | ``"ar"`` | ``"gemm_ar"`` | ``"fused"``)."""
+        return self.model.decode_mode
 
     def make_cache(self) -> PagedKVCache:
         c = self.model.config

@@ -781,6 +781,36 @@ def fresh_tune_decode(q, k, v, kv_len, *, sm_scale=None,
                            fresh=True)
 
 
+def fresh_tune_fused_mlp(x, gate_up, down, mesh, axis: str = "tp") -> Any:
+    """Fresh re-tune of the decode megakernel's fused MLP+AllReduce tile
+    sweep (``ops.fused_decode.fused_mlp_candidates``) for this shape,
+    NOW, in this process — same cache entry the transparent
+    ``config=None`` path consults, so a bench/warmup crown teaches every
+    later jitted decode step."""
+    from ..core import platform
+    from ..ops.fused_decode import (
+        FusedMlpConfig,
+        fused_mlp_ar,
+        fused_mlp_candidates,
+    )
+
+    n = mesh.shape[axis]
+    b, k_in = x.shape
+    k_loc, n_dim = down.shape[0] // max(n, 1), down.shape[1]
+    cn = n_dim // max(n, 1)
+    return resolve_config(
+        "fused_mlp_ar",
+        (b, k_in, k_loc, n_dim, n, str(x.dtype), platform.device_kind()),
+        fused_mlp_candidates(b, k_loc, cn),
+        FusedMlpConfig().clip(b, k_loc, cn),
+        lambda c: (lambda: fused_mlp_ar(x, gate_up, down, mesh, axis,
+                                        config=c)),
+        tracing=is_tracer(x),
+        force_measure=True,
+        fresh=True,
+    )
+
+
 def fresh_tune_flash_attention(q, k, v, *, causal: bool = True,
                                sm_scale=None,
                                soft_cap: float = 0.0) -> Any:

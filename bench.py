@@ -831,6 +831,196 @@ def bench_moe_ep_wire(tokens: int = 4096):
     }
 
 
+# -- low-precision wire and KV (ISSUE 9) ------------------------------------
+
+
+def _obs_wire_total(op: str) -> float:
+    """Sum of the ``comm_wire_bytes`` counters for ``op`` across method
+    labels (the live obs accounting the quantized entries feed)."""
+    from triton_distributed_tpu import obs
+
+    return sum(
+        c["value"] for c in obs.REGISTRY.snapshot()
+        if c.get("name") == "comm_wire_bytes"
+        and c.get("labels", {}).get("op") == op)
+
+
+def _codec_err_ratios(x) -> dict:
+    """Worst PER-ROW round-trip error over the quantized wire dtypes as
+    a fraction of each row's documented envelope
+    (``lang.quant.abs_error_bound`` at the ROW absmax — the bound the
+    property tests pin).  Normalizing by the global absmax would let a
+    small-absmax row bust its own envelope unnoticed, so the parity
+    sentinel measures the per-row quantity.  One home: both wire benches
+    record this."""
+    from triton_distributed_tpu.lang import quant
+
+    xf = x.astype(jnp.float32)
+    row_absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    out = {}
+    for wd in quant.QUANTIZED_WIRE_DTYPES:
+        back = quant.roundtrip_rows(x, wd, out_dtype=jnp.float32)
+        bound = quant.abs_error_bound(row_absmax, wd)
+        out[wd] = float(jnp.max(jnp.abs(back - xf) / bound))
+    return out
+
+
+def bench_wire_bytes(m: int = 1024, h: int = 7168):
+    """Wire bytes of the quantized collective payloads vs bf16 (ISSUE 9
+    tentpole): ``value`` = bf16 bytes / quantized bytes per row ("x
+    fewer"), hard-floored at 1.82 (<= 0.55x) by the claims gate.
+
+    Measured TWO ways and both recorded: the static packed-message
+    accounting (payload byte per element + the 128-lane scale sidecar —
+    deterministic, like the MoE fp8 line), and — when a live mesh can
+    run the collectives — the ``comm_wire_bytes`` obs counters around a
+    real bf16 vs fp8 ``all_gather`` pair, so the recorded ratio is what
+    the wire actually moved (slice captures gate on it; the CPU
+    container marks records ``interpret``).  Dequant parity at the same
+    shape rides along as ``codec_err_vs_envelope_*`` (measured max
+    error / the documented envelope — advisory ``warn_max`` 1.0)."""
+    import numpy as np
+
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.core import mesh as mesh_lib
+    from triton_distributed_tpu.lang import quant
+
+    static_ratio = (2.0 * h) / quant.packed_width(h, "fp8")
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((m, h)) * 0.3,
+        jnp.bfloat16,
+    )
+    # parity: measured per-row round-trip error vs the documented
+    # envelope (shared with bench_wire_parity — one home)
+    err_ratio = _codec_err_ratios(x)
+
+    measured_ratio = None
+    interpret = _interpret_capture()
+    mesh = None
+    try:
+        mesh = mesh_lib.tp_mesh()
+    except Exception:
+        pass
+    if mesh is not None and mesh.shape["tp"] > 1:
+        from triton_distributed_tpu import comm
+
+        prev = obs.enabled()
+        obs.enable(True)
+        try:
+            base = _obs_wire_total("all_gather")
+            comm.all_gather(x, mesh, "tp")
+            bf16_bytes = _obs_wire_total("all_gather") - base
+            base = _obs_wire_total("all_gather")
+            comm.all_gather(x, mesh, "tp", wire_dtype="fp8")
+            q_bytes = _obs_wire_total("all_gather") - base
+            if q_bytes > 0:
+                measured_ratio = bf16_bytes / q_bytes
+        except Exception:
+            import sys
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            interpret = True
+        finally:
+            obs.enable(prev)
+    else:
+        interpret = True
+    value = measured_ratio if measured_ratio is not None else static_ratio
+    return {
+        "metric": f"wire_bytes_ratio_bf16_over_quant_h{h}",
+        "value": round(value, 4),
+        "unit": "x fewer wire bytes (bf16 / quantized)",
+        "static_ratio": round(static_ratio, 4),
+        "measured_from_counters": measured_ratio is not None,
+        "codec_err_vs_envelope_fp8": round(err_ratio["fp8"], 4),
+        "codec_err_vs_envelope_int8": round(err_ratio["int8"], 4),
+        "devices": jax.device_count(),
+        "interpret": interpret,
+    }
+
+
+def bench_wire_parity(m: int = 1024, h: int = 7168):
+    """Dequant parity of the wire codecs at the serving shape: ``value``
+    = the worst measured round-trip error over {fp8, int8} as a FRACTION
+    of the documented envelope (``lang.quant.abs_error_bound`` — the
+    dtype-scaled tolerance the parity gates use).  1.0 = exactly at the
+    envelope; the claims gate warns (advisory) above 1.05 — codec drift
+    is a trend finding for obs.history, the hard guarantees live in the
+    checksum plane and the round-trip property tests."""
+    import numpy as np
+
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((m, h)) * 0.3,
+        jnp.bfloat16,
+    )
+    ratios = _codec_err_ratios(x)
+    return {
+        "metric": "wire_dequant_parity_err_ratio",
+        "value": round(max(ratios.values()), 4),
+        "unit": "x of the documented codec error envelope",
+        "fp8": round(ratios["fp8"], 4),
+        "int8": round(ratios["int8"], 4),
+        "devices": jax.device_count(),
+    }
+
+
+def bench_serve_kv_quant():
+    """Max concurrent sequences at the SAME pool byte budget, int8 KV vs
+    bf16 (the ISSUE 9 acceptance number: >= 1.8x — halved page bytes
+    double the page count, which the continuous-batching scheduler
+    converts directly into admitted sequences).  Deterministic: two
+    seeded scheduler replays over the real paged-cache plumbing
+    (SimBackend) whose pools are sized from one byte budget via
+    ``kv_cache.kv_page_bytes`` (scale-sidecar overhead included — the
+    honest capacity math), peak concurrency read off the step results."""
+    from triton_distributed_tpu import serve
+    from triton_distributed_tpu.models.kv_cache import kv_page_bytes
+
+    layers, kv_heads, head_dim, page_size = 1, 1, 64, 16
+    bf16_page = kv_page_bytes(layers, kv_heads, page_size, head_dim,
+                              jnp.bfloat16, None)
+    int8_page = kv_page_bytes(layers, kv_heads, page_size, head_dim,
+                              jnp.bfloat16, "int8")
+    budget = 32 * bf16_page                 # the shared pool byte budget
+    pools = {"bf16": (None, 1 + budget // bf16_page),
+             "int8": ("int8", 1 + budget // int8_page)}
+    slots = 40
+    peak = {}
+    for name, (kvd, pages) in pools.items():
+        backend = serve.SimBackend(
+            slots=slots, page_size=page_size, pool_pages=int(pages),
+            max_length=64, head_dim=head_dim, kv_dtype=kvd)
+        sched = serve.Scheduler(backend, serve.SchedulerConfig(
+            max_queue_depth=2 * slots))
+        for i in range(slots):
+            sched.submit(serve.Request(
+                prompt=tuple((7 * i + j) % 97 + 1 for j in range(17)),
+                max_new_tokens=12))
+        hi = 0
+        for _ in range(10_000):
+            res = sched.step()
+            hi = max(hi, res.active)
+            if res.idle:
+                break
+        if sched.pool.used_pages != 0:      # not assert: survives -O
+            raise RuntimeError(
+                f"leaked pages in the {name} replay: "
+                f"{sched.pool.used_pages}")
+        peak[name] = hi
+    return {
+        "metric": "serve_kv_quant_concurrency",
+        "value": round(peak["int8"] / max(peak["bf16"], 1), 4),
+        "unit": "x concurrent sequences (int8 pool / bf16 pool, equal bytes)",
+        "peak_active_bf16": peak["bf16"],
+        "peak_active_int8": peak["int8"],
+        "pool_pages_bf16": int(pools["bf16"][1]),
+        "pool_pages_int8": int(pools["int8"][1]),
+        "page_bytes_bf16": bf16_page,
+        "page_bytes_int8": int8_page,
+        "devices": jax.device_count(),
+    }
+
+
 # -- continuous-batching serving (ISSUE 6) ----------------------------------
 
 _SERVE_RUN: dict | None = None
@@ -1294,9 +1484,16 @@ def main():
         print(json.dumps(bench_latency()))
     elif mode == "serve":
         # the continuous-batching scheduler under a seeded open-loop
-        # overload trace: two record lines off one shared replay
+        # overload trace (two record lines off one shared replay), plus
+        # the int8-KV capacity ratio at equal pool bytes (ISSUE 9)
         print(json.dumps(bench_serve_ttft()))
         print(json.dumps(bench_serve_throughput()))
+        print(json.dumps(bench_serve_kv_quant()))
+    elif mode == "wire":
+        # quantized collective payload byte accounting + dequant parity
+        # (ISSUE 9)
+        print(json.dumps(bench_wire_bytes()))
+        print(json.dumps(bench_wire_parity()))
     elif mode == "overlap":
         print(json.dumps(bench_overlap()))
     elif mode == "overlap_collective":
@@ -1324,6 +1521,9 @@ def main():
         _emit(bench_overlap)
         _emit(bench_serve_ttft)
         _emit(bench_serve_throughput)
+        _emit(bench_serve_kv_quant)
+        _emit(bench_wire_bytes)
+        _emit(bench_wire_parity)
         _emit(bench_integrity_overhead)
         if jax.device_count() > 1:
             _emit(bench_multi_chip)
@@ -1357,7 +1557,7 @@ def main():
         raise SystemExit(
             f"unknown bench mode {mode!r} "
             "(auto|gemm|attn|mlp|moe|decode|decode_modes|moe_ep|latency|"
-            "overlap|overlap_collective|serve|integrity)"
+            "overlap|overlap_collective|serve|wire|integrity)"
         )
 
 

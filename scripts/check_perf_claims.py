@@ -219,6 +219,35 @@ CLAIMS = {
     "overlap_hidden_pct_m4096": {
         "floor": 0.5, "value_max": 1.0, "since": 5,
     },
+    # -- low-precision wire and KV (ISSUE 9; `bench.py wire` / `serve`) --
+    # quantized collective payload bytes vs bf16: the packed message is
+    # one payload byte per element + the 128-lane scale sidecar, so at
+    # h=7168 the ratio is deterministic 1.965x ("quantized moves
+    # <= 0.55x the bf16 bytes" = floor 1.82).  On a real slice the value
+    # comes from the live comm_wire_bytes counters around a bf16/fp8
+    # collective pair (the hard gate binds there — CPU captures are
+    # interpret-marked accounting smoke, like the slice-gated
+    # decode_step_dispatches discipline); value_max rejects impossible
+    # accounting (the ratio cannot exceed 2x + sidecar math)
+    "wire_bytes_ratio_bf16_over_quant": {
+        "floor": 1.82, "value_max": 2.0, "since": 9,
+    },
+    # dequant parity as a fraction of the documented codec envelope
+    # (`bench.py wire`): ADVISORY — the hard guarantees are the checksum
+    # plane and the round-trip property tests; a drift past the envelope
+    # is a trend finding.  value_max is the gross tripwire (5x the
+    # envelope means the codec, not the chip, regressed)
+    "wire_dequant_parity_err_ratio": {
+        "warn_max": 1.05, "value_max": 5.0, "since": 9,
+    },
+    # int8 KV capacity at equal pool bytes: deterministic scheduler
+    # replay (SimBackend over the real paged plumbing, pools sized from
+    # ONE byte budget via kv_page_bytes — scale sidecars included), so
+    # the >= 1.8x concurrency floor is HARD everywhere; 2.0 is the
+    # arithmetic ceiling of halved page bytes
+    "serve_kv_quant_concurrency": {
+        "floor": 1.8, "value_max": 2.05, "since": 9,
+    },
 }
 
 def parse_record(path: str) -> tuple[list[dict], int | None, bool]:

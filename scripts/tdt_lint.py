@@ -113,10 +113,17 @@ def main(argv: list[str] | None = None) -> int:
                     help="data-integrity gate: corruption fault classes "
                          "over every kernel family, the scheduler "
                          "KV-poison cell, and the verifier selftest")
+    ap.add_argument("--quant", action="store_true",
+                    help="low-precision wire gate (ISSUE 9): codec "
+                         "round-trip selftest battery (error envelopes, "
+                         "edge rows, poisoned-scale-sidecar cell), the "
+                         "quantized-variant protocol matrix at ranks "
+                         "{2,4,8}, and the corruption fault cells over "
+                         "the quantized kernels")
     ap.add_argument("--all", action="store_true", dest="all_gates",
                     help="run every gate (verify matrix, --faults, "
-                         "--timeline, --serve, --history, --integrity) "
-                         "with one summarized exit code")
+                         "--timeline, --serve, --history, --integrity, "
+                         "--quant) with one summarized exit code")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection target sampling seed (--faults)")
     ap.add_argument("--json", metavar="PATH",
@@ -135,6 +142,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_serve(args)
     if args.integrity:
         return _run_integrity(args)
+    if args.quant:
+        return _run_quant(args)
 
     from triton_distributed_tpu import analysis
 
@@ -230,6 +239,63 @@ def _run_integrity(args) -> int:
     return 0
 
 
+def _run_quant(args) -> int:
+    """The low-precision wire gate (ISSUE 9): (1) the codec selftest
+    battery — round-trip error envelopes per wire dtype including the
+    all-negative / denormal / absmax-zero edge rows, pack/unpack
+    equivalence, the poisoned-scale-sidecar cell (a flipped sidecar byte
+    must be checksum-caught, never parity-absorbed), and the
+    quantized-reduce verifier's clean/caught pair; (2) the quantized
+    collective variants through the static protocol verifier at ranks
+    {2,4,8}; (3) both corruption fault classes against every quantized
+    kernel case through the record-mode checksum protocol."""
+    from triton_distributed_tpu import analysis, resilience
+    from triton_distributed_tpu.resilience import integrity
+
+    problems: list[str] = []
+
+    selftest = integrity.run_quant_selftest()
+    problems += [f"codec selftest: {p}" for p in selftest]
+    print(f"codec selftest: {len(selftest)} problem(s)")
+
+    ranks = tuple(int(r) for r in args.ranks.split(","))
+    results = analysis.verify_all(ranks=ranks, kernel_filter="quant")
+    rows = []
+    for case, violations in results:
+        status = "OK" if not violations else "VIOLATION"
+        print(f"{case.name:<28} ranks={case.n:<2} {status}")
+        for v in violations:
+            print(f"    [{v.check}] {v.message}")
+            problems.append(f"{case.name}: [{v.check}] {v.message}")
+        rows.append({"kernel": case.name, "ranks": case.n,
+                     "violations": len(violations)})
+    if not results:
+        problems.append("no quantized kernel cases registered")
+
+    cells = resilience.run_quant_cells(seed=args.seed)
+    for row in cells:
+        named = f"  [{', '.join(row['named'])}]" if row["named"] else ""
+        print(f"{row['kernel']:<28} {row['fault']:<16} "
+              f"{row['outcome'].upper():<9}{named}")
+    problems += resilience.verify_matrix(
+        cells, kinds=resilience.CORRUPTION_KINDS)
+
+    for p in problems:
+        print(f"QUANT FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"selftest_problems": selftest, "verify": rows,
+                       "cells": cells, "problems": problems}, f,
+                      indent=1, sort_keys=True)
+    if problems:
+        return 1
+    print("quant OK: codec envelopes hold (edge rows included), a "
+          "poisoned scale sidecar is checksum-caught, every quantized "
+          "variant verifies at ranks {2,4,8} and detects both "
+          "corruption classes")
+    return 0
+
+
 def _run_all(args) -> int:
     """One aggregate CI entry: every gate, a summary table, one exit
     code (the max of the legs; a crashed leg counts as 1)."""
@@ -253,6 +319,7 @@ def _run_all(args) -> int:
         # states so `--all`'s integrity leg no longer reproduced a
         # standalone `--integrity` run
         ("integrity", lambda: _run_integrity(sub())),
+        ("quant", lambda: _run_quant(sub())),
     ]
     results = []
     for name, fn in legs:

@@ -149,8 +149,9 @@ def test_clean_traces_have_no_findings():
 
 def test_matrix_corruption_cells_all_detected():
     rows = rz.run_matrix(seed=0, kinds=rz.CORRUPTION_KINDS)
-    # both classes x all 7 kernel families (fused_mlp_ar since ISSUE 8)
-    assert len(rows) == 14
+    # both classes x all 9 kernel cases (fused_mlp_ar since ISSUE 8;
+    # quant_allgather/push_1shot + quant_exchange/oneshot since ISSUE 9)
+    assert len(rows) == 18
     for row in rows:
         assert row["outcome"] == "detected", row
         assert row["named"], row
@@ -215,6 +216,19 @@ MATRIX_GOLDEN = {
     ("fused_mlp_ar/swiglu", "rank_abort"),
     ("fused_mlp_ar/swiglu", "corrupt_payload"),
     ("fused_mlp_ar/swiglu", "corrupt_kv_page"),
+    # the ISSUE-9 quantized wire variants at their packed-u8 shapes
+    ("quant_allgather/push_1shot", "drop_notify"),
+    ("quant_allgather/push_1shot", "stale_credit"),
+    ("quant_allgather/push_1shot", "straggler"),
+    ("quant_allgather/push_1shot", "rank_abort"),
+    ("quant_allgather/push_1shot", "corrupt_payload"),
+    ("quant_allgather/push_1shot", "corrupt_kv_page"),
+    ("quant_exchange/oneshot", "drop_notify"),
+    ("quant_exchange/oneshot", "stale_credit"),
+    ("quant_exchange/oneshot", "straggler"),
+    ("quant_exchange/oneshot", "rank_abort"),
+    ("quant_exchange/oneshot", "corrupt_payload"),
+    ("quant_exchange/oneshot", "corrupt_kv_page"),
 }
 
 SCHEDULER_GOLDEN = {

@@ -1,0 +1,701 @@
+"""Low-precision wire and KV (ISSUE 9): codec round-trip envelopes,
+error-feedback convergence, the quantized KV-cache layout, capacity
+math, the quantized-variant protocol/fault coverage, and the
+calibrate-driven wire policy.
+
+Everything here is CPU-safe (no shard_map, no compiled Pallas): the
+codec and cache paths are pure jnp, the protocol/fault legs run the
+record-mode verifier, and the multi-rank wire paths are covered by the
+static verifier at ranks {2,4,8} (kernel parity itself is pinned by the
+capability-gated mesh tests like every collective)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.core.mesh import make_mesh
+from triton_distributed_tpu.lang import quant
+
+
+def _mesh1():
+    return make_mesh({"tp": 1}, devices=jax.devices()[:1])
+
+
+# ---------------------------------------------------------------------------
+# codec round-trip property tests
+
+
+def _edge_rows(h: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return np.stack([
+        rng.standard_normal(h) * 3.0,                 # generic
+        rng.standard_normal(h) * 40.0,                # large dynamic range
+        -np.abs(rng.standard_normal(h)) - 0.5,        # all-negative
+        rng.standard_normal(h) * 1e-30,               # denormal-range
+        np.zeros(h),                                  # absmax-zero
+        np.where(np.arange(h) == 3, 7.0, 1e-6),       # one dominant spike
+    ]).astype(np.float32)
+
+
+@pytest.mark.parametrize("wire_dtype", ["fp8", "int8"])
+@pytest.mark.parametrize("h", [16, 128, 1000])
+def test_codec_roundtrip_error_envelope(wire_dtype, h):
+    """Every row class round-trips inside the documented envelope
+    (``abs_error_bound`` = rel bound x row absmax + the SCALE_EPS
+    floor), including all-negative, denormal, and absmax-zero rows."""
+    rows = _edge_rows(h)
+    back = np.asarray(quant.roundtrip_rows(
+        jnp.asarray(rows), wire_dtype, out_dtype=jnp.float32))
+    absmax = np.abs(rows).max(axis=-1, keepdims=True)
+    tol = np.asarray(quant.abs_error_bound(absmax, wire_dtype))
+    err = np.abs(back - rows)
+    assert (err <= tol * (1 + 1e-5)).all(), (
+        f"{wire_dtype}: max err {err.max():.3g} vs tol {tol.max():.3g}")
+    # the absmax-zero row must round-trip exactly
+    np.testing.assert_array_equal(back[4], 0.0)
+
+
+@pytest.mark.parametrize("wire_dtype", ["fp8", "int8"])
+def test_pack_unpack_wire_message(wire_dtype):
+    """The one-message wire layout: H payload bytes + a 128-lane sidecar
+    whose first 4 bytes are the row's f32 scale; unpack == the bare
+    codec round-trip."""
+    h = 96
+    rows = _edge_rows(h, seed=1)
+    x = jnp.asarray(rows)
+    packed = quant.pack_rows(x, wire_dtype)
+    assert packed.shape == (rows.shape[0], h + quant.SIDECAR)
+    assert packed.dtype == jnp.uint8
+    pk = np.asarray(packed)
+    # sidecar bytes past the scale are zero padding
+    assert (pk[:, h + 4:] == 0).all()
+    # the embedded scale is the quantizer's scale, byte-exact
+    _, scale = quant.quantize_rows(x, wire_dtype)
+    embedded = pk[:, h:h + 4].copy().view(np.float32)[:, 0]
+    np.testing.assert_array_equal(embedded,
+                                  np.asarray(scale, np.float32)[:, 0])
+    # decoded equivalence with the bare round-trip
+    back = np.asarray(quant.unpack_rows(packed, h, wire_dtype,
+                                        jnp.float32))
+    want = np.asarray(quant.roundtrip_rows(x, wire_dtype,
+                                           out_dtype=jnp.float32))
+    np.testing.assert_allclose(back, want, atol=1e-7)
+
+
+def test_packed_width_and_wire_ratio():
+    assert quant.packed_width(7168, "fp8") == 7168 + 128
+    assert quant.packed_width(7168, "bf16") == 2 * 7168
+    # the claims-gate floor: quantized moves <= 0.55x the bf16 bytes at
+    # serving widths
+    assert quant.wire_ratio(7168, "fp8") <= 0.55
+    assert quant.wire_ratio(1024, "int8") <= 0.57
+
+
+# ---------------------------------------------------------------------------
+# error feedback: repeated quantized reductions must not drift
+
+
+@pytest.mark.parametrize("wire_dtype", ["fp8", "int8"])
+def test_ar_error_feedback_convergence(wire_dtype):
+    """Chained quantized reductions WITH error feedback keep the running
+    mean of outputs converging to the exact sum (the EF residual cancels
+    the codec's bias), while the per-call error never exceeds one codec
+    envelope — over N calls the EF mean error must shrink well below
+    the no-EF mean error."""
+    rng = np.random.default_rng(3)
+    n, m, r = 4, 8, 32
+    parts = jnp.asarray(rng.standard_normal((n, m, r)) * 2.0, jnp.float32)
+    exact = np.asarray(parts, np.float64).sum(axis=0)
+
+    def reduce_once(p, residuals):
+        q, scale, new_res = quant.ef_quantize_rows(p, wire_dtype,
+                                                   residuals)
+        deq = quant.dequantize_rows(q, scale, jnp.float32)
+        return np.asarray(deq, np.float64).sum(axis=0), new_res
+
+    n_iter = 64
+    res = jnp.zeros((n, m, r), jnp.float32)
+    acc_ef = np.zeros((m, r))
+    acc_plain = np.zeros((m, r))
+    for _ in range(n_iter):
+        out_ef, res = reduce_once(parts, res)
+        out_plain, _ = reduce_once(parts, None)
+        acc_ef += out_ef
+        acc_plain += out_plain
+        # bounded drift per call: n partials, each inside one envelope
+        bound = n * float(quant.abs_error_bound(
+            float(jnp.max(jnp.abs(parts))), wire_dtype))
+        # EF folds the residual in, so the instantaneous error can reach
+        # ~2x the envelope; it must stay bounded, not grow with t
+        assert np.abs(out_ef - exact).max() <= 2.5 * bound
+    err_ef = np.abs(acc_ef / n_iter - exact).max()
+    err_plain = np.abs(acc_plain / n_iter - exact).max()
+    # the plain codec's bias is deterministic (same inputs -> same
+    # rounding); EF's time-average converges toward exact
+    assert err_ef <= max(0.25 * err_plain, 1e-4), (
+        f"EF mean err {err_ef:.2e} vs plain {err_plain:.2e}")
+
+
+def test_quantized_all_reduce_error_feedback_api():
+    """The EF option on the quantized AR entry: residual in, (out,
+    residual) out; repeated calls stay bounded (the n==1 path runs the
+    same codec semantics the mesh path ships)."""
+    from triton_distributed_tpu.comm import quantized_all_reduce
+
+    mesh = _mesh1()
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((8, 32)), jnp.float32)
+    res = jnp.zeros_like(x)
+    outs = []
+    for _ in range(16):
+        out, res = quantized_all_reduce(x, mesh, "tp", wire_dtype="int8",
+                                        residual=res)
+        outs.append(np.asarray(out, np.float64))
+    exact = np.asarray(x, np.float64)
+    mean_err = np.abs(np.mean(outs, axis=0) - exact).max()
+    one_err = np.abs(outs[0] - exact).max()
+    assert mean_err <= max(0.25 * one_err, 1e-5)
+    # without residual: plain value return
+    out = quantized_all_reduce(x, mesh, "tp", wire_dtype="int8")
+    assert out.shape == x.shape
+
+
+# ---------------------------------------------------------------------------
+# the eager entries' wire_dtype plumbing (degenerate mesh)
+
+
+def test_quantized_entries_degenerate_mesh():
+    from triton_distributed_tpu.comm import (
+        quantized_all_gather,
+        quantized_reduce_scatter,
+    )
+
+    mesh = _mesh1()
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((16, 64)),
+                    jnp.float32)
+    for wd in ("fp8", "int8"):
+        got = quantized_all_gather(x, mesh, "tp", wire_dtype=wd)
+        want = quant.roundtrip_rows(x, wd)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-6)
+        got = quantized_reduce_scatter(x, mesh, "tp", wire_dtype=wd)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-6)
+    # bf16 wire_dtype on the public entries is the identity path
+    from triton_distributed_tpu.comm import all_gather, all_reduce
+
+    np.testing.assert_array_equal(
+        np.asarray(all_gather(x, mesh, "tp", wire_dtype="fp8")),
+        np.asarray(x))   # n == 1: no wire, no codec
+    np.testing.assert_array_equal(
+        np.asarray(all_reduce(x, mesh, "tp", wire_dtype="int8")),
+        np.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# quantized KV cache
+
+
+def _mk_cache(kv_dtype, *, layers=2, batch=3, heads=4, max_len=64,
+              page_size=8, head_dim=16):
+    from triton_distributed_tpu.models import kv_cache as kvc
+
+    return kvc.init_paged_cache(
+        _mesh1(), layers, batch, heads, max_len, head_dim, jnp.float32,
+        page_size=page_size, kv_dtype=kv_dtype,
+        key=jax.random.key(7),   # fragmented page map, like a real pool
+    )
+
+
+def _dense(cache, layer):
+    from triton_distributed_tpu.models import kv_cache as kvc
+
+    k, v = kvc.layer_pool(cache, layer, jnp.float32)
+    b, mp = cache.block_table.shape
+    hk, ps, d = k.shape[1], cache.page_size, k.shape[-1]
+    kd = k[cache.block_table].transpose(0, 2, 1, 3, 4).reshape(
+        b, hk, mp * ps, d)
+    vd = v[cache.block_table].transpose(0, 2, 1, 3, 4).reshape(
+        b, hk, mp * ps, d)
+    return np.asarray(kd), np.asarray(vd)
+
+
+def test_quantized_cache_init_layout():
+    c = _mk_cache("int8")
+    assert c.quantized
+    assert c.k.dtype == jnp.int8 and c.v.dtype == jnp.int8
+    assert c.k_scale.shape == (2, 3 * 8, 4)
+    assert c.k_scale.dtype == jnp.float32
+    bf = _mk_cache(None)
+    assert not bf.quantized and bf.k_scale is None
+
+
+def test_quantized_prefill_append_chunk_roundtrip():
+    """write_prefill_paged + append_paged + write_chunk_paged on the
+    int8 layout land within the int8 page envelope of the bf16 truth."""
+    from triton_distributed_tpu.models import kv_cache as kvc
+
+    rng = np.random.default_rng(2)
+    b, hk, d = 3, 4, 16
+    k0 = jnp.asarray(rng.standard_normal((b, hk, 20, d)), jnp.float32)
+    v0 = jnp.asarray(rng.standard_normal((b, hk, 20, d)), jnp.float32)
+    kt = jnp.asarray(rng.standard_normal((b, hk, d)) * 3.0, jnp.float32)
+    kch = jnp.asarray(rng.standard_normal((b, hk, 5, d)), jnp.float32)
+
+    c = _mk_cache("int8")
+    c = kvc.write_prefill_paged(c, 0, k0, v0)
+    c = kvc.with_length(c, 20)
+    c = kvc.append_paged(c, 0, kt, kt)           # unaligned position 20
+    c = kvc.write_chunk_paged(c, 0, kch, kch, 21)  # unaligned chunk
+    kd, vd = _dense(c, 0)
+
+    def tol(n_trips):
+        # per-(page, head) scales: each write to a partially-filled page
+        # dequant-merge-requants it (the documented layout), so a row
+        # written then requantized n-1 times carries n half-step errors,
+        # each bounded by the envelope at the page's absmax <= the
+        # global absmax of everything ever merged onto it.
+        am = float(max(np.abs(k0).max(), np.abs(kt).max(),
+                       np.abs(kch).max()))
+        return n_trips * float(quant.abs_error_bound(am, "int8")) * (
+            1 + 1e-5)
+
+    # page_size=8: positions 16-19 share page 2 with the append (pos 20)
+    # and the chunk head (21-23) -> 3 round-trips; pos 20 is requantized
+    # once by the chunk -> 2; positions 0-15 are never touched again.
+    assert np.abs(kd[:, :, :16] - np.asarray(k0)[:, :, :16]).max() <= tol(1)
+    assert np.abs(kd[:, :, 16:20] - np.asarray(k0)[:, :, 16:]).max() <= tol(3)
+    assert np.abs(kd[:, :, 20] - np.asarray(kt)).max() <= tol(2)
+    assert np.abs(kd[:, :, 21:26] - np.asarray(kch)).max() <= tol(1)
+
+
+def test_quantized_chunk_write_traced_start():
+    """One jitted executable serves every chunk position (the serving
+    scheduler's retrace-freedom contract) on the quantized layout."""
+    from triton_distributed_tpu.models import kv_cache as kvc
+
+    rng = np.random.default_rng(4)
+    b, hk, d, s = 2, 2, 8, 6
+    c = _mk_cache("int8", batch=b, heads=hk, head_dim=d)
+    ch1 = jnp.asarray(rng.standard_normal((b, hk, s, d)), jnp.float32)
+    ch2 = jnp.asarray(rng.standard_normal((b, hk, s, d)), jnp.float32)
+
+    write = jax.jit(lambda cache, k, v, start: kvc.write_chunk_paged(
+        cache, 0, k, v, start))
+    c = write(c, ch1, ch1, jnp.int32(0))
+    c = write(c, ch2, ch2, jnp.int32(s))
+    kd, _ = _dense(c, 0)
+    want = np.concatenate([np.asarray(ch1), np.asarray(ch2)], axis=2)
+    bound = float(quant.abs_error_bound(float(np.abs(want).max()),
+                                        "int8"))
+    assert np.abs(kd[:, :, :2 * s] - want).max() <= bound * (1 + 1e-5)
+
+
+def test_append_layer_quantized_matches_append_paged():
+    """The layer-slice quantized append (the decode shard_map body and
+    the megakernel's post-kernel scatter) matches the stacked-cache
+    append exactly."""
+    from triton_distributed_tpu.models import kv_cache as kvc
+
+    rng = np.random.default_rng(6)
+    b, hk, d = 3, 4, 16
+    k0 = jnp.asarray(rng.standard_normal((b, hk, 16, d)), jnp.float32)
+    tok = jnp.asarray(rng.standard_normal((b, hk, d)), jnp.float32)
+    c = _mk_cache("int8")
+    c = kvc.write_prefill_paged(c, 0, k0, k0)
+    c = kvc.with_length(c, 16)
+
+    via_cache = kvc.append_paged(c, 0, tok, tok)
+    pk, pv, ksc, vsc = kvc.append_layer_quantized(
+        c.k[0], c.v[0], c.k_scale[0], c.v_scale[0],
+        c.block_table, c.seq_lens, tok, tok)
+    np.testing.assert_array_equal(np.asarray(via_cache.k[0]),
+                                  np.asarray(pk))
+    np.testing.assert_array_equal(np.asarray(via_cache.k_scale[0]),
+                                  np.asarray(ksc))
+
+
+def test_kv_page_bytes_capacity_math():
+    """The ISSUE-9 capacity claim at serving geometry: int8 pages cost
+    <= 0.55x the bf16 bytes, so one byte budget holds >= 1.8x pages."""
+    from triton_distributed_tpu.models.kv_cache import kv_page_bytes
+
+    bf16 = kv_page_bytes(4, 8, 64, 128, jnp.bfloat16, None)
+    int8 = kv_page_bytes(4, 8, 64, 128, jnp.bfloat16, "int8")
+    assert int8 / bf16 <= 0.55
+    assert bf16 // int8 >= 1 and (10 * bf16) // int8 >= 18  # >= 1.8x pages
+
+
+def test_dequantize_pool_and_serving_cache():
+    from triton_distributed_tpu.models import kv_cache as kvc
+
+    c = kvc.init_serving_cache(_mesh1(), 2, 4, 2, 64, 8, jnp.float32,
+                               page_size=8, kv_dtype="int8")
+    assert c.quantized and c.k.dtype == jnp.int8
+    deq = kvc.dequantize_pool(c, jnp.float32)
+    assert not deq.quantized and deq.k.dtype == jnp.float32
+    # scrap-page layout preserved
+    assert int(c.block_table.max()) == 0
+
+
+def test_engine_rejects_contiguous_kv_dtype():
+    from triton_distributed_tpu.models import Engine, ModelConfig
+
+    cfg = ModelConfig(num_layers=1, hidden=64, intermediate=128,
+                      num_heads=4, num_kv_heads=2, head_dim=16, vocab=64,
+                      max_length=32)
+    with pytest.raises(ValueError, match="paged"):
+        Engine.build(cfg, _mesh1(), key=jax.random.key(0),
+                     cache_layout="contiguous", kv_dtype="int8")
+
+
+# ---------------------------------------------------------------------------
+# serving over the int8 cache (the real scheduler, headless)
+
+
+def test_scheduler_int8_cache_tokens_and_pages():
+    """The continuous-batching scheduler over an int8 pool: tokens are
+    IDENTICAL to the bf16 run (the Sim rule is KV-independent — this
+    pins the cache plumbing, not the model), pages dequantize to the
+    token history within the int8 envelope, zero pages leak."""
+    from triton_distributed_tpu import serve
+    from triton_distributed_tpu.models import kv_cache as kvc
+
+    def run(kv_dtype):
+        backend = serve.SimBackend(slots=3, page_size=4, pool_pages=32,
+                                   max_length=64, kv_dtype=kv_dtype)
+        sched = serve.Scheduler(backend, serve.SchedulerConfig())
+        reqs = [serve.Request(prompt=tuple(range(1, 7 + i)),
+                              max_new_tokens=6) for i in range(3)]
+        for r in reqs:
+            sched.submit(r)
+        sched.run_until_idle()
+        assert sched.pool.used_pages == 0
+        return sched, reqs
+
+    sched_q, reqs_q = run("int8")
+    _, reqs_f = run(None)
+    for rq, rf in zip(reqs_q, reqs_f):
+        assert rq.state is serve.RequestState.DONE
+        assert rq.tokens == rf.tokens
+    assert sched_q.cache.quantized
+
+
+# ---------------------------------------------------------------------------
+# protocol / fault / lint coverage
+
+
+def test_quant_registry_cases_verify_clean():
+    from triton_distributed_tpu import analysis
+
+    results = analysis.verify_all(ranks=(2, 4, 8), kernel_filter="quant")
+    assert len(results) == 9          # 3 variants x 3 rank counts
+    for case, violations in results:
+        assert not violations, f"{case.name}: {violations}"
+
+
+def test_quant_corruption_cells_detected_and_named():
+    from triton_distributed_tpu import resilience
+
+    rows = resilience.run_quant_cells(seed=0)
+    assert len(rows) == 6             # 3 kernels x 2 corruption classes
+    for row in rows:
+        assert row["outcome"] == "detected", row
+        assert row["named"], row
+    assert not resilience.verify_matrix(
+        rows, kinds=resilience.CORRUPTION_KINDS)
+
+
+def test_quant_selftest_battery_clean():
+    from triton_distributed_tpu.resilience import integrity
+
+    assert integrity.run_quant_selftest() == []
+
+
+def test_scale_sidecar_poison_is_checksum_caught():
+    """A flipped scale-sidecar byte: fold32 moves (wire checksum catches
+    it) AND the dequant error explodes past the codec envelope (parity
+    tolerance could never absorb it — the checksum is the guard)."""
+    from triton_distributed_tpu.resilience.integrity import fold32
+
+    h = 64
+    x = jnp.asarray(_edge_rows(h)[0][None], jnp.float32)
+    packed = np.asarray(quant.pack_rows(x, "fp8"))
+    poisoned = packed.copy()
+    poisoned[0, h + 3] ^= 0x14       # exponent bits of the f32 scale
+    assert fold32(packed) != fold32(poisoned)
+    good = np.asarray(quant.unpack_rows(jnp.asarray(packed), h, "fp8",
+                                        jnp.float32))
+    bad = np.asarray(quant.unpack_rows(jnp.asarray(poisoned), h, "fp8",
+                                       jnp.float32))
+    delta = np.abs(bad - good).max()
+    envelope = float(quant.abs_error_bound(float(np.abs(good).max()),
+                                           "fp8"))
+    assert not np.isfinite(delta) or delta > 10 * envelope
+
+
+def test_integrity_fold_page_covers_scales():
+    """The KV-pool audit stamp must move when ONLY a scale flips (at-rest
+    scale corruption poisons a whole (page, head) block on dequant)."""
+    from triton_distributed_tpu.resilience import integrity
+
+    c = _mk_cache("int8")
+    before = integrity.fold_page(c, 1)
+    poisoned = dataclasses.replace(
+        c, k_scale=c.k_scale.at[0, 1, 0].multiply(4.0))
+    assert integrity.fold_page(poisoned, 1) != before
+
+
+def test_verify_reduce_q_clean_and_catches():
+    from triton_distributed_tpu.resilience import integrity
+
+    rng = np.random.default_rng(9)
+    n, m_loc, r = 4, 4, 16
+    parts = rng.standard_normal((n, n * m_loc, r)).astype(np.float32)
+    golden = np.asarray(quant.reduce_roundtrip(
+        jnp.asarray(parts.reshape(n, n, m_loc, r)), "fp8",
+        out_dtype=jnp.float32)).reshape(n * m_loc, r)
+    x = parts.reshape(n * n * m_loc, r)
+    assert integrity.verify_reduce_q("rs_fp8", x, golden, n, "fp8") is None
+    bad = golden.copy()
+    bad[0, 0] += 50.0
+    diag = integrity.verify_reduce_q("rs_fp8", x, bad, n, "fp8")
+    assert diag is not None and diag.kind == "payload"
+
+
+# ---------------------------------------------------------------------------
+# MoE wire policy (satellites): shared codec + calibrate-driven auto
+
+
+def test_moe_consumes_shared_codec():
+    """The MoE layer's historic names are aliases of the shared module —
+    no duplicate pack/unpack body remains."""
+    import inspect
+
+    from triton_distributed_tpu.layers import moe
+
+    assert moe._FP8_SIDECAR == quant.SIDECAR
+    src = inspect.getsource(moe)
+    assert "bitcast_convert_type" not in src     # the duplicate is gone
+    x = jnp.asarray(_edge_rows(64)[:2], jnp.bfloat16)
+    np.testing.assert_array_equal(
+        np.asarray(moe._pack_fp8(x)), np.asarray(quant.pack_rows(x, "fp8")))
+
+
+def test_fp8_wire_auto_fast_wire_stays_off():
+    """The missing fast-wire case (satellite): on an ICI-class axis the
+    "auto" codec resolves OFF — through the calibrate-threshold policy,
+    not a hard-coded class rule."""
+    from triton_distributed_tpu.layers.moe import MoEMLP
+
+    mesh = _mesh1()
+    layer = MoEMLP(mesh=mesh, num_experts=4, top_k=2, axis="tp",
+                   fp8_wire="auto")
+    assert layer.fp8_wire_enabled() is False
+    # a DCN-named axis resolves ON with the cold-start economics
+    dcn_mesh = make_mesh({"dcn_ep": 1}, devices=jax.devices()[:1])
+    dcn_layer = MoEMLP(mesh=dcn_mesh, num_experts=4, top_k=2,
+                       axis="dcn_ep", fp8_wire="auto")
+    assert dcn_layer.fp8_wire_enabled() is True
+
+
+def test_codec_pays_reads_calibration(tmp_path, monkeypatch):
+    """The wire-class decision reads tools/calibrate thresholds: a
+    persisted calibration that makes the ICI wire SLOW flips the ICI
+    decision on, and a fast-DCN calibration flips DCN off — the policy
+    follows the measurement, not the axis name."""
+    import json
+
+    from triton_distributed_tpu.tools import calibrate
+
+    path = tmp_path / "linkcal.json"
+    monkeypatch.setenv("TDT_LINKCAL_CACHE", str(path))
+    calibrate.invalidate_cache()
+    try:
+        assert calibrate.codec_pays("ici") is False   # cold start
+        assert calibrate.codec_pays("dcn") is True
+        path.write_text(json.dumps({
+            "ici_gbps": 2.0, "ici_hop_us": 1.0,
+            "dcn_gbps": 500.0, "dcn_hop_us": 5.0,
+            "device_kind": "test", "n_devices": 8}))
+        calibrate.invalidate_cache()
+        assert calibrate.codec_pays("ici") is True    # slow wire: pays
+        assert calibrate.codec_pays("dcn") is False   # fast wire: off
+    finally:
+        calibrate.invalidate_cache()
+
+
+# ---------------------------------------------------------------------------
+# bench records (deterministic legs)
+
+
+def test_bench_wire_and_kv_quant_records():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "_bench_quant", os.path.join(os.path.dirname(__file__), "..",
+                                     "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    rec = bench.bench_wire_bytes()
+    assert rec["value"] >= 1.82                   # the claims-gate floor
+    assert rec["static_ratio"] == pytest.approx(14336 / 7296, rel=1e-4)
+    par = bench.bench_wire_parity()
+    assert par["value"] <= 1.05                   # inside the envelope
+    kvq = bench.bench_serve_kv_quant()
+    assert kvq["value"] >= 1.8                    # the acceptance number
+    assert kvq["page_bytes_int8"] / kvq["page_bytes_bf16"] <= 0.55
+
+
+# ---------------------------------------------------------------------------
+# capability-gated mesh tests: quantized KV decode token-parity against
+# the bf16 pool (the acceptance gate — these pin the KERNEL side the
+# CPU-safe cache tests above cannot reach; skipped where the jax build
+# lacks the shard_map/Pallas-interpret APIs, like every mesh test)
+
+from triton_distributed_tpu.core import compilation  # noqa: E402
+
+needs_interpret = pytest.mark.skipif(
+    not compilation.interpret_supported(),
+    reason="jax build lacks shard_map/Pallas-interpret APIs",
+)
+
+
+@needs_interpret
+def test_quantized_paged_decode_kernel_parity():
+    """The int8 page-streaming decode == attention over the MATERIALIZED
+    dequantized pool (tight: same values, fusion only), and stays within
+    the derived envelope of the original full-precision pool."""
+    from triton_distributed_tpu.models import kv_cache as kvc
+    from triton_distributed_tpu.ops.attention import paged_decode_attention
+
+    rng = np.random.default_rng(7)
+    b, h, hk, d, ps, mp = 2, 8, 4, 64, 16, 4
+    p = b * mp
+    lens = jnp.asarray([37, 61], jnp.int32)
+    table = jnp.asarray(
+        rng.permutation(p).reshape(b, mp).astype(np.int32))
+    pool_k = jnp.asarray(rng.standard_normal((p, hk, ps, d)), jnp.float32)
+    pool_v = jnp.asarray(rng.standard_normal((p, hk, ps, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+
+    qk, sk = kvc._quantize_pages(pool_k)
+    qv, sv = kvc._quantize_pages(pool_v)
+    out_q = np.asarray(paged_decode_attention(
+        q, qk, qv, table, lens, k_scale=sk, v_scale=sv))
+
+    # (1) kernel parity: fused in-loop dequant vs the dequantized pool
+    want = np.asarray(paged_decode_attention(
+        q, kvc._dequantize_pages(qk, sk), kvc._dequantize_pages(qv, sv),
+        table, lens))
+    np.testing.assert_allclose(out_q, want, rtol=2e-5, atol=2e-5)
+
+    # (2) envelope parity vs the ORIGINAL pool: V-side error is a convex
+    # combination of per-element codec errors (<= env_v); K-side error
+    # perturbs each score by <= sm_scale * sum|q| * env_k, and softmax
+    # weight L1 sensitivity is <= 2*max|dS|, scaled by max|V|
+    env_k = float(quant.abs_error_bound(
+        float(jnp.abs(pool_k).max()), "int8"))
+    env_v = float(quant.abs_error_bound(
+        float(jnp.abs(pool_v).max()), "int8"))
+    sm_scale = d ** -0.5
+    ds = sm_scale * float(jnp.abs(q).sum(-1).max()) * env_k
+    bound = env_v + 2.0 * ds * float(jnp.abs(pool_v).max())
+    base = np.asarray(paged_decode_attention(q, pool_k, pool_v, table,
+                                             lens))
+    assert np.abs(out_q - base).max() <= bound
+
+
+@needs_interpret
+def test_qwen_paged_decode_int8_token_parity():
+    """End-to-end decode on the int8 cache vs the SAME model on the bf16
+    pool: the logits stay inside an envelope-scaled band, and where the
+    full-precision greedy choice is decisive (top-2 gap beyond the
+    band), the quantized pool picks the SAME token."""
+    import dataclasses as _dc
+
+    from triton_distributed_tpu.core.mesh import TP_AXIS, make_mesh
+    from triton_distributed_tpu.models import (ModelConfig, Qwen3,
+                                               init_paged_cache)
+
+    cfg = ModelConfig(
+        num_layers=2, hidden=64, intermediate=128, num_heads=4,
+        num_kv_heads=2, head_dim=32, vocab=128, max_length=64,
+        dtype=jnp.float32,
+    )
+    mesh = make_mesh({TP_AXIS: 2}, devices=jax.devices()[:2])
+    model = Qwen3(cfg, mesh)
+    params = model.init(jax.random.key(40), scale=0.05)
+    ids = jax.random.randint(jax.random.key(41), (2, 20), 0, cfg.vocab)
+    step = jax.random.randint(jax.random.key(42), (2,), 0, cfg.vocab)
+
+    def run(kv_dtype):
+        cache = init_paged_cache(
+            mesh, cfg.num_layers, 2, cfg.num_kv_heads, cfg.max_length,
+            cfg.head_dim, cfg.dtype, page_size=8,
+            key=jax.random.key(43), kv_dtype=kv_dtype)
+        _, cache = jax.jit(model.prefill)(params, cache, ids)
+        logits, cache = jax.jit(model.decode)(params, cache, step)
+        return np.asarray(logits), cache
+
+    logits_b, _ = run(None)
+    logits_q, cache_q = run("int8")
+    assert cache_q.quantized and cache_q.k.dtype == jnp.int8
+
+    # dtype-scaled band: two layers of int8 KV noise through the model;
+    # 64x the bare codec envelope of the logit magnitude is a loose
+    # sanity band that still catches a dropped/misapplied scale (those
+    # move logits by the 127/absmax encoding factor, orders of magnitude
+    # outside it)
+    band = 64.0 * quant.rel_error_bound("int8") * (
+        float(np.abs(logits_b).max()) + 1.0)
+    assert np.abs(logits_q - logits_b).max() <= band
+
+    top2 = np.sort(logits_b, axis=-1)[:, -2:]
+    decisive = (top2[:, 1] - top2[:, 0]) > 2.0 * band
+    tok_b = logits_b.argmax(-1)
+    tok_q = logits_q.argmax(-1)
+    assert np.array_equal(tok_b[decisive], tok_q[decisive])
+
+
+def test_quantized_writes_ignore_stale_recycled_page_bytes():
+    """A recycled page carries the previous tenant's bytes
+    (``serve.budget.PagePool.free`` does not scrub): the quantized
+    merge must NOT fold those into the (page, head) absmax — a stale
+    large value would inflate the scale and crush the new tenant's
+    precision.  Covers append_paged, append_layer_quantized (via the
+    exact-match contract), and write_chunk_paged."""
+    from triton_distributed_tpu.models import kv_cache as kvc
+
+    # simulate recycling: every pool page holds a large-magnitude
+    # tenant's bytes (|K| ~ 127 at scale 1.0)
+    def poison(c):
+        return dataclasses.replace(
+            c,
+            k=jnp.full_like(c.k, 127), v=jnp.full_like(c.v, 127),
+            k_scale=jnp.full_like(c.k_scale, 1.0),
+            v_scale=jnp.full_like(c.v_scale, 1.0))
+
+    b, hk, d = 3, 4, 16
+    small = 0.01
+    tol = float(quant.abs_error_bound(small, "int8")) * (1 + 1e-5)
+
+    # append into a FRESH (stale) page: pos 8 = page 1 slot 0
+    c = poison(_mk_cache("int8"))
+    c = kvc.with_length(c, 8)
+    tok = jnp.full((b, hk, d), small, jnp.float32)
+    c = kvc.append_paged(c, 0, tok, tok)
+    kd, _ = _dense(c, 0)
+    assert np.abs(kd[:, :, 8] - small).max() <= tol
+
+    # chunk write into stale pages: positions [9, 21) span pages 1-2
+    ch = jnp.full((b, hk, 12, d), small, jnp.float32)
+    c = kvc.write_chunk_paged(c, 0, ch, ch, 9)
+    kd, _ = _dense(c, 0)
+    # the earlier appended token requantized once more (page 1 touched)
+    assert np.abs(kd[:, :, 8] - small).max() <= 2 * tol
+    assert np.abs(kd[:, :, 9:21] - small).max() <= tol

@@ -253,8 +253,10 @@ def _run_lint(*args):
 def test_cli_full_matrix_clean():
     res = _run_lint()
     assert res.returncode == 0, res.stdout + res.stderr
-    # 42 = the pre-ISSUE-8 36 plus fused_mlp_ar/{swiglu,linear} x {2,4,8}
-    assert "42 kernel cases" in res.stdout
+    # 51 = the pre-ISSUE-8 36 plus fused_mlp_ar/{swiglu,linear} x {2,4,8}
+    # plus the ISSUE-9 quantized wire variants (quant_allgather x 2 +
+    # quant_exchange) x {2,4,8}
+    assert "51 kernel cases" in res.stdout
     assert "0 violation(s)" in res.stdout
 
 

@@ -33,6 +33,7 @@ DEFAULT_RANKS = (2, 4, 8)
 FAMILIES = (
     "allgather", "reduce_scatter", "allreduce", "all_to_all",
     "ag_gemm", "gemm_rs", "gemm_ar", "fused_mlp_ar",
+    "quantized_wire",
 )
 
 _FAMILY_ALIASES = {"ep_dispatch": "all_to_all", "ep_combine": "all_to_all"}
@@ -335,6 +336,71 @@ def _fused_mlp_ar_cases(n: int) -> list[KernelCase]:
     ]
 
 
+def _quant_cases(n: int) -> list[KernelCase]:
+    """The quantized collective variants (ISSUE 9) at their WIRE shapes:
+    a quantized payload rides the same kernel protocols on the packed u8
+    message (H payload bytes + the 128-lane scale sidecar in ONE chunk),
+    so the verifiable object is each protocol at the packed geometry —
+    the scale sidecar travelling with its payload rows is exactly what
+    these shapes encode.
+
+    - ``quant_allgather/*``: the u8 AG the quantized gather ships
+      (``comm.quantized.quantized_all_gather`` routes the packed array
+      through the real Pallas AG entries).
+    - ``quant_exchange/oneshot``: the one-shot packed chunk exchange of
+      the quantized RS/AR (every rank sends chunk j to rank j) —
+      expressed on the A2A push kernel body with the equal-split count
+      matrix that exchange induces.
+    """
+    from ..comm.allgather import _KERNELS as _AG_KERNELS, AllGatherMethod
+    from ..comm.all_to_all import _a2a_push_kernel
+    from ..lang.quant import SIDECAR
+
+    h = 8
+    w = h + SIDECAR                 # packed row width (u8 bytes)
+    m = 4                           # rows per shard/chunk
+    team = _team(n)
+
+    def make_ag(kern, two_send):
+        def _make(rank, kern=kern, two_send=two_send):
+            x = FakeRef("x_u8", (m, w))
+            out = FakeRef("out_u8", (n * m, w))
+            local_sem = FakeSem("local_sem")
+            send = FakeSem("send_sems") if two_send else FakeSem("send_sem")
+            recv = FakeSem("recv_sems")
+            return "packed_u8", lambda: kern(
+                team, m, x, out, local_sem, send, recv
+            )
+        return _make
+
+    cases = [
+        KernelCase(f"quant_allgather/{meth.value}", "quantized_wire", n,
+                   make_ag(kern, two_send))
+        for meth, (kern, two_send) in _AG_KERNELS.items()
+        if meth in (AllGatherMethod.PUSH_1SHOT, AllGatherMethod.RING_BIDIR)
+    ]
+
+    chunk, z = 2, m + 2             # zone rows (chunk multiple + slack)
+
+    def make_exchange(rank):
+        # equal splits: m rows to every peer (the one-shot RS exchange)
+        counts = [m] * n
+        offs = [p * m for p in range(n)]
+        expected = [m] * n
+        x = FakeRef("packed_chunks", (n * m + chunk, w))
+        out = FakeRef("zones_u8", (n, z, w))
+        return "oneshot", lambda: _a2a_push_kernel(
+            team, chunk, z, w,
+            FakeSmem("counts", counts), FakeSmem("offs", offs),
+            FakeSmem("expected", expected), x, out,
+            FakeSem("send_sem"), FakeSem("recv_sems"),
+        )
+
+    cases.append(KernelCase("quant_exchange/oneshot", "quantized_wire", n,
+                            make_exchange))
+    return cases
+
+
 _FAMILY_CASES = {
     "allgather": _ag_cases,
     "reduce_scatter": _rs_cases,
@@ -344,6 +410,7 @@ _FAMILY_CASES = {
     "gemm_rs": _gemm_rs_cases,
     "gemm_ar": _gemm_ar_cases,
     "fused_mlp_ar": _fused_mlp_ar_cases,
+    "quantized_wire": _quant_cases,
 }
 
 

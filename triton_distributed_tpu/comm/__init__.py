@@ -15,6 +15,13 @@ from .allreduce import (
     all_reduce,
     hierarchical_all_reduce,
 )
+from .quantized import (
+    quantized_all_gather,
+    quantized_all_reduce,
+    quantized_ep_combine,
+    quantized_ep_dispatch,
+    quantized_reduce_scatter,
+)
 from .reduce_scatter import (
     ReduceScatterConfig,
     hierarchical_reduce_scatter,

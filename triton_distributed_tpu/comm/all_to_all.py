@@ -333,10 +333,19 @@ def ep_dispatch(
     axis: str = EP_AXIS,
     *,
     config: AllToAllConfig | None = None,
+    wire_dtype: str = "bf16",
 ):
     """Dispatch sorted tokens to their expert-owner ranks (reference
     ``all_to_all_single`` host entry ``low_latency_all_to_all.py:183-198``,
     ``ep_a2a.py:37-150``).
+
+    ``wire_dtype``: "bf16" ships the model dtype; "int8"/"fp8" pack each
+    row into the shared quantized wire message (payload + scale sidecar,
+    ``lang.quant``) and dequantize on arrival — the reference's
+    production fp8 A2A configuration; "auto" resolves through the
+    contextual tuner per shape/ranks/wire class.  (The differentiable
+    straight-through transports live in ``comm.quantized``; this entry's
+    quantized path is forward-only.)
 
     ``x``: global (n*T, H) over ``axis`` — each rank's (T, H) shard holds
     its tokens sorted by expert id (T = static worst case, rows beyond the
@@ -355,6 +364,25 @@ def ep_dispatch(
     n = mesh.shape[axis]
     t = x.shape[0] // max(n, 1)
     eager = not (is_tracer(x) or is_tracer(splits))
+    if wire_dtype != "bf16" and n > 1:
+        from ..lang import quant
+        from . import quantized as _q
+
+        if wire_dtype == "auto":
+            wire_dtype = _q.resolve_wire_dtype(
+                "a2a_wire", (tuple(x.shape), str(x.dtype)), mesh, axis,
+                lambda wd: (lambda: ep_dispatch(x, splits, mesh, axis,
+                                                config=config,
+                                                wire_dtype=wd)),
+                tracing=not eager,
+            )
+        if wire_dtype != "bf16":
+            h = x.shape[-1]
+            recv_u8, recv_splits = ep_dispatch(
+                quant.pack_rows(x, wire_dtype), splits, mesh, axis,
+                config=config)
+            return (quant.unpack_rows(recv_u8, h, wire_dtype, x.dtype),
+                    recv_splits)
     if config is None and n > 1:
         # chunk size through the contextual tuner (VERDICT r5 next #5):
         # cached winner / measured / interpret-pinned default — the
@@ -433,6 +461,7 @@ def ep_combine(
     *,
     token_dim: int,
     config: AllToAllConfig | None = None,
+    wire_dtype: str = "bf16",
 ) -> jax.Array:
     """Return processed tokens to their owner ranks, restoring the original
     sorted-by-expert order (reference combine path ``ep_a2a.py:244-310``).
@@ -441,13 +470,34 @@ def ep_combine(
     (rows processed in place).  ``splits``: the SAME global (n*E,) given to
     dispatch.  ``token_dim``: T, the per-rank token row count.  Returns
     global (n*T, H) over ``axis``.  Differentiable in ``y`` (the adjoint
-    is :func:`ep_dispatch`).
+    is :func:`ep_dispatch`).  ``wire_dtype``: see :func:`ep_dispatch`
+    (quantized path forward-only here; STE transports in
+    ``comm.quantized``).
     """
     from .. import obs, resilience
     from ..tune.autotuner import is_tracer
 
     n = mesh.shape[axis]
     eager = not (is_tracer(y) or is_tracer(splits))
+    if wire_dtype != "bf16" and n > 1:
+        from ..lang import quant
+        from . import quantized as _q
+
+        if wire_dtype == "auto":
+            wire_dtype = _q.resolve_wire_dtype(
+                "a2a_wire", (tuple(y.shape), str(y.dtype)), mesh, axis,
+                lambda wd: (lambda: ep_combine(y, splits, mesh, axis,
+                                               token_dim=token_dim,
+                                               config=config,
+                                               wire_dtype=wd)),
+                tracing=not eager,
+            )
+        if wire_dtype != "bf16":
+            h = y.shape[-1]
+            back_u8 = ep_combine(
+                quant.pack_rows(y, wire_dtype), splits, mesh, axis,
+                token_dim=token_dim, config=config)
+            return quant.unpack_rows(back_u8, h, wire_dtype, y.dtype)
     if config is None and n > 1:
         # see ep_dispatch: the chunk sweep shares the tuner machinery
         config = _resolve_a2a_config("ep_combine_cfg", token_dim,

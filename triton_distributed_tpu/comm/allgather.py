@@ -315,6 +315,7 @@ def all_gather(
     axis: str = TP_AXIS,
     *,
     method: AllGatherMethod = AllGatherMethod.AUTO,
+    wire_dtype: str = "bf16",
 ) -> jax.Array:
     """Gather dim 0 of ``x`` (sharded over ``axis``) on every device.
 
@@ -324,10 +325,30 @@ def all_gather(
     Differentiable: in global semantics the gather only changes sharding,
     so the adjoint is the identity (the ring-RS adjoints live inside the
     fused ops' VJPs).
+
+    ``wire_dtype``: "bf16" (ship the payload as-is), "int8"/"fp8" (pack
+    per-row quantized payload + scale sidecar into one u8 message —
+    ``comm.quantized``), or "auto" (the contextual tuner picks per
+    shape/ranks/WIRE CLASS; bf16 is the never-lose baseline).
     """
     n = mesh.shape[axis]
     if n == 1:
         return x
+    if wire_dtype != "bf16":
+        from ..tune.autotuner import is_tracer as _q_is_tracer
+        from . import quantized as _q
+
+        if wire_dtype == "auto":
+            wire_dtype = _q.resolve_wire_dtype(
+                "ag_wire", (tuple(x.shape), str(x.dtype)), mesh, axis,
+                lambda wd: (lambda: all_gather(x, mesh, axis,
+                                               method=method,
+                                               wire_dtype=wd)),
+                tracing=_q_is_tracer(x),
+            )
+        if wire_dtype != "bf16":
+            return _q.quantized_all_gather(
+                x, mesh, axis, wire_dtype=wire_dtype, method=method)
 
     m_total = x.shape[0]
     if m_total % n:

@@ -358,6 +358,7 @@ def all_reduce(
     method: AllReduceMethod = AllReduceMethod.AUTO,
     config: AllReduceConfig | None = None,
     out_dtype=None,
+    wire_dtype: str = "bf16",
 ) -> jax.Array:
     """Sum-AllReduce over ``axis`` (reference host entry ``all_reduce``,
     ``kernels/nvidia/allreduce.py:1054-1078``).
@@ -372,6 +373,11 @@ def all_reduce(
     trade (NCCL rings and the reference's two-shot behave the same; carrying
     f32 partials would double the wire bytes for bf16).  Under AUTO, results
     for bf16 inputs therefore differ slightly across the size threshold.
+
+    ``wire_dtype``: "bf16" (these kernels), "int8"/"fp8" (the quantized
+    two-hop exchange — ``comm.quantized.quantized_all_reduce``; its
+    error-feedback option lives on that entry), or "auto"
+    (tuner-resolved per shape/ranks/wire class).
     """
     n = mesh.shape[axis]
     m_stack = x.shape[0]
@@ -381,6 +387,22 @@ def all_reduce(
     out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(x.dtype)
     if n == 1:
         return x.astype(out_dtype)
+    if wire_dtype != "bf16":
+        from ..tune.autotuner import is_tracer as _q_is_tracer
+        from . import quantized as _q
+
+        if wire_dtype == "auto":
+            wire_dtype = _q.resolve_wire_dtype(
+                "ar_wire", (tuple(x.shape), str(x.dtype)), mesh, axis,
+                lambda wd: (lambda: all_reduce(x, mesh, axis,
+                                               method=method, config=config,
+                                               out_dtype=out_dtype,
+                                               wire_dtype=wd)),
+                tracing=_q_is_tracer(x),
+            )
+        if wire_dtype != "bf16":
+            return _q.quantized_all_reduce(
+                x, mesh, axis, wire_dtype=wire_dtype, out_dtype=out_dtype)
 
     if method == AllReduceMethod.AUTO:
         nbytes = int(jnp.dtype(x.dtype).itemsize) * m * x.shape[1]

@@ -1,0 +1,520 @@
+"""Quantized collective payloads: int8/fp8 wire variants of AG/RS/AR/A2A.
+
+The low-precision plane of ISSUE 9 (ROADMAP item 4): bytes on the wire
+are the congestion currency of large-scale collectives (the
+lightweight-NoC-collective payload-size argument, arXiv:2603.26438), and
+the reference ships fp8 A2A payloads as a production optimization
+(SURVEY section 7).  This module generalizes the MoE layer's one-off
+codec into first-class collective variants:
+
+- **Quantize at the producer, dequantize at the consumer**: every
+  variant packs rows into the shared one-message wire format
+  (``lang.quant.pack_rows`` — payload bytes + f32 scale sidecar riding
+  the SAME chunk) on the sending rank and dequantizes on arrival.  No
+  full-precision payload ever crosses the wire.
+- **AG / A2A** ride the existing Pallas collective entries on the
+  PACKED u8 array — so the integrity plane folds the *quantized* wire
+  bytes, the resilience ladder guards the real transfer, and the obs
+  wire-byte counters record what actually moved (a flipped sidecar byte
+  is a checksum mismatch like any payload byte).
+- **RS / AR** cannot reduce quantized payloads in the ring (int8 sums
+  overflow; e4m3 sums round) — they use the ONE-SHOT exchange shape
+  instead: each rank packs its n chunk-contributions, an equal-split
+  all-to-all lands every rank's chunk ``j`` on rank ``j``, and the
+  consumer dequantizes and reduces the n partials in f32.  AR appends a
+  quantized AG of the reduced chunk (the two-shot shape with both hops
+  quantized).  Each chunk crosses the wire once per direction — the
+  same 2(n-1)/n wire volume class as the bf16 two-shot, at half the
+  bytes per element.
+- **Error feedback** (the AR option): the quantization residual of each
+  rank's contribution is returned to the caller and folded into the
+  NEXT call's input, so chained quantized reductions do not drift
+  (``lang.quant.ef_quantize_rows``).
+
+Gradients: the packed u8 wire is an integer path whose cotangent would
+be float0 — every entry here is custom-vjp'd with the straight-through
+estimator (backward = the transport adjoint at full precision, ignoring
+quantization error), the treatment ``layers.moe`` pioneered and now
+consumes from here (one home for the STE custom-vjp machinery).
+
+The ``wire_dtype`` axis is autotuner-selectable: the eager comm entries
+accept ``wire_dtype="auto"`` and resolve {bf16, int8, fp8} per
+(shape, ranks, WIRE CLASS) through :func:`resolve_wire_dtype` — the
+winner is measured per topology, so an ICI torus (where the codec's
+compute rarely pays) and a DCN edge (where it clearly does) crown
+independently.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core import compilation
+from ..core.mesh import TP_AXIS
+from ..lang import quant
+
+WIRE_DTYPES = quant.WIRE_DTYPES
+
+
+def resolve_wire_dtype(name: str, shape_key: tuple, mesh: Mesh, axis: str,
+                       make_thunk, *, tracing: bool) -> str:
+    """The ``wire_dtype="auto"`` hook of the comm entries: {bf16, int8,
+    fp8} through the contextual autotuner, keyed on shape AND the axis's
+    wire class — a winner crowned on the ICI torus must never leak onto
+    a DCN edge (ROADMAP item 3's contextual-key extension).  bf16 is
+    the never-lose baseline the margins protect."""
+    from ..core import mesh as mesh_lib, platform
+    from ..tune.autotuner import resolve_config
+
+    return resolve_config(
+        name,
+        (*shape_key, mesh.shape[axis], mesh_lib.wire_class(mesh, axis),
+         platform.device_kind()),
+        list(WIRE_DTYPES), "bf16", make_thunk, tracing=tracing,
+    )
+
+
+# ---------------------------------------------------------------------------
+# quantized AllGather: pack -> u8 AG (Pallas ring/push) -> unpack
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _q_all_gather(mesh, axis, wire_dtype, method, x):
+    from .allgather import all_gather
+
+    h = x.shape[-1]
+    packed = quant.pack_rows(x, wire_dtype)
+    # the inner entry is the REAL wire: integrity folds the quantized
+    # bytes, resilience guards the u8 transfer, obs counts u8 wire bytes
+    gathered = all_gather(packed, mesh, axis, method=method)
+    return quant.unpack_rows(gathered, h, wire_dtype, x.dtype)
+
+
+def _q_ag_fwd(mesh, axis, wire_dtype, method, x):
+    return _q_all_gather(mesh, axis, wire_dtype, method, x), \
+        jnp.zeros((0,), x.dtype)
+
+
+def _q_ag_bwd(mesh, axis, wire_dtype, method, wit, dout):
+    # straight-through: in global semantics the gather is the identity
+    # (sharding change only), and STE ignores the quantization error
+    return (dout.astype(wit.dtype),)
+
+
+_q_all_gather.defvjp(_q_ag_fwd, _q_ag_bwd)
+
+
+def quantized_all_gather(
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = TP_AXIS,
+    *,
+    wire_dtype: str = "fp8",
+    method=None,
+) -> jax.Array:
+    """AllGather with a quantized wire: each rank's shard is packed
+    (payload + scale sidecar in one u8 message), gathered through the
+    Pallas collective, and dequantized on arrival.  Golden:
+    ``quant.roundtrip_rows`` of each shard, gathered.  Differentiable
+    (straight-through)."""
+    if not quant.is_quantized(wire_dtype):
+        from .allgather import AllGatherMethod, all_gather
+
+        return all_gather(x, mesh, axis,
+                          method=method or AllGatherMethod.AUTO)
+    if mesh.shape[axis] == 1:
+        return quant.roundtrip_rows(x, wire_dtype)
+    from .allgather import AllGatherMethod
+
+    return _q_all_gather(mesh, axis, wire_dtype,
+                         method or AllGatherMethod.AUTO, x)
+
+
+# ---------------------------------------------------------------------------
+# quantized ReduceScatter / AllReduce: one-shot packed exchange +
+# f32 consumer reduce (+ quantized AG return hop for AR)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_q_rs(mesh: Mesh, axis: str, m_loc: int, r: int,
+                wire_dtype: str, in_dtype, out_dtype):
+    n = mesh.shape[axis]
+
+    def local(x_loc):                       # (n*m_loc, r) local partial
+        chunks = x_loc.reshape(n, m_loc, r)
+        packed = quant.pack_rows(chunks, wire_dtype)   # (n, m_loc, w) u8
+        # equal-split exchange: chunk j of every rank lands on rank j —
+        # scale sidecars ride the same message as their payload rows
+        recv = jax.lax.all_to_all(packed, axis, 0, 0)
+        deq = quant.unpack_rows(recv, r, wire_dtype, jnp.float32)
+        return deq.sum(axis=0).astype(out_dtype)       # (m_loc, r)
+
+    return compilation.jit_shard_map(
+        local, mesh, in_specs=P(axis, None), out_specs=P(axis, None))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_q_ar(mesh: Mesh, axis: str, m_loc: int, r: int,
+                wire_dtype: str, in_dtype, out_dtype, with_residual: bool):
+    n = mesh.shape[axis]
+
+    def exchange(q, scale):
+        # ship EXACTLY the (q, scale) the residual was accounted
+        # against (lang.quant.pack_quantized — the one sidecar home),
+        # reduce the dequantized partials, then the quantized AG return
+        # hop reassembles the full (n*m_loc, r) result on every rank
+        recv = jax.lax.all_to_all(quant.pack_quantized(q, scale),
+                                  axis, 0, 0)
+        red = quant.unpack_rows(recv, r, wire_dtype, jnp.float32)
+        red = red.sum(axis=0).astype(out_dtype)        # (m_loc, r)
+        back = quant.pack_rows(red, wire_dtype)
+        gathered = jax.lax.all_gather(back, axis, tiled=True)
+        return quant.unpack_rows(gathered, r, wire_dtype, out_dtype)
+
+    if with_residual:
+        def local(x_loc, res_loc):
+            q, scale, new_res = quant.ef_quantize_rows(
+                x_loc.reshape(n, m_loc, r), wire_dtype,
+                res_loc.reshape(n, m_loc, r))
+            return exchange(q, scale), new_res.reshape(n * m_loc, r)
+
+        return compilation.jit_shard_map(
+            local, mesh,
+            in_specs=(P(axis, None), P(axis, None)),
+            out_specs=(P(None, None), P(axis, None)))
+
+    # the hot non-EF path (gemm_ar / fused_mlp_ar decode): no residual
+    # input, no residual materialized
+    def local_plain(x_loc):
+        q, scale = quant.quantize_rows(
+            x_loc.reshape(n, m_loc, r).astype(jnp.float32), wire_dtype)
+        return exchange(q, scale)
+
+    return compilation.jit_shard_map(
+        local_plain, mesh,
+        in_specs=P(axis, None), out_specs=P(None, None))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _q_reduce_scatter(mesh, axis, wire_dtype, out_dtype, x):
+    n = mesh.shape[axis]
+    m_loc = x.shape[0] // (n * n)
+    fn = _build_q_rs(mesh, axis, m_loc, x.shape[1], wire_dtype,
+                     jnp.dtype(x.dtype), out_dtype)
+    return fn(x)
+
+
+def _q_rs_fwd(mesh, axis, wire_dtype, out_dtype, x):
+    return _q_reduce_scatter(mesh, axis, wire_dtype, out_dtype, x), \
+        jnp.zeros((0,), x.dtype)
+
+
+def _q_rs_bwd(mesh, axis, wire_dtype, out_dtype, wit, dout):
+    # straight-through: out = sum of stacked partials -> broadcast back
+    n = mesh.shape[axis]
+    return (jnp.tile(dout, (n, 1)).astype(wit.dtype),)
+
+
+_q_reduce_scatter.defvjp(_q_rs_fwd, _q_rs_bwd)
+
+
+def quantized_reduce_scatter(
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = TP_AXIS,
+    *,
+    wire_dtype: str = "fp8",
+    out_dtype=None,
+) -> jax.Array:
+    """ReduceScatter with a quantized wire (one-shot packed exchange;
+    see module docstring).  Same contract as ``comm.reduce_scatter``:
+    ``x`` global (n*M, R) stacked partials, returns (M, R) sharded.
+    Golden: ``quant.reduce_roundtrip`` of the stacked chunk partials,
+    scattered.  Differentiable (straight-through)."""
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(x.dtype)
+    n = mesh.shape[axis]
+    if not quant.is_quantized(wire_dtype):
+        from .reduce_scatter import reduce_scatter
+
+        return reduce_scatter(x, mesh, axis).astype(out_dtype)
+    m_stack = x.shape[0]
+    if m_stack % n or (m_stack // n) % n:
+        raise ValueError(
+            f"dim0 {m_stack} must be divisible by {axis}^2 = {n * n}")
+    if n == 1:
+        return quant.roundtrip_rows(x, wire_dtype, out_dtype=out_dtype)
+
+    def make_verify(integrity):
+        return lambda out: integrity.verify_reduce_q(
+            f"reduce_scatter_{wire_dtype}", x, out, n, wire_dtype)
+
+    return _wrapped(
+        "reduce_scatter", mesh, axis, wire_dtype, x,
+        lambda: _q_reduce_scatter(mesh, axis, wire_dtype, out_dtype, x),
+        make_verify=make_verify,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _q_all_reduce(mesh, axis, wire_dtype, out_dtype, x, res):
+    n = mesh.shape[axis]
+    m_loc = x.shape[0] // (n * n)
+    fn = _build_q_ar(mesh, axis, m_loc, x.shape[1], wire_dtype,
+                     jnp.dtype(x.dtype), out_dtype, True)
+    return fn(x, res)
+
+
+def _q_ar_fwd(mesh, axis, wire_dtype, out_dtype, x, res):
+    out = _q_all_reduce(mesh, axis, wire_dtype, out_dtype, x, res)
+    return out, jnp.zeros((0,), x.dtype)
+
+
+def _q_ar_bwd(mesh, axis, wire_dtype, out_dtype, wit, cots):
+    dout, _ = cots          # residual cotangent is dropped (carried state)
+    n = mesh.shape[axis]
+    dx = jnp.tile(dout, (n, 1)).astype(wit.dtype)
+    return dx, jnp.zeros_like(dx, dtype=jnp.float32)
+
+
+_q_all_reduce.defvjp(_q_ar_fwd, _q_ar_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _q_all_reduce_plain(mesh, axis, wire_dtype, out_dtype, x):
+    # the hot non-EF path: no residual input or output rides shard_map
+    n = mesh.shape[axis]
+    m_loc = x.shape[0] // (n * n)
+    fn = _build_q_ar(mesh, axis, m_loc, x.shape[1], wire_dtype,
+                     jnp.dtype(x.dtype), out_dtype, False)
+    return fn(x)
+
+
+def _q_arp_fwd(mesh, axis, wire_dtype, out_dtype, x):
+    return _q_all_reduce_plain(mesh, axis, wire_dtype, out_dtype, x), \
+        jnp.zeros((0,), x.dtype)
+
+
+def _q_arp_bwd(mesh, axis, wire_dtype, out_dtype, wit, dout):
+    n = mesh.shape[axis]
+    return (jnp.tile(dout, (n, 1)).astype(wit.dtype),)
+
+
+_q_all_reduce_plain.defvjp(_q_arp_fwd, _q_arp_bwd)
+
+
+def quantized_all_reduce(
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = TP_AXIS,
+    *,
+    wire_dtype: str = "fp8",
+    out_dtype=None,
+    residual: jax.Array | None = None,
+):
+    """AllReduce with both hops quantized (packed exchange + packed AG
+    return), and the ERROR-FEEDBACK option: pass ``residual`` (zeros,
+    or the residual a previous call returned) and the call returns
+    ``(out, new_residual)`` — folding the residual into the next call's
+    input bounds the drift of repeated quantized reductions
+    (``lang.quant.ef_quantize_rows``; pinned by the convergence test).
+    Without ``residual`` the call returns ``out`` alone.
+
+    Contract matches ``comm.all_reduce``: ``x`` global (n*M, R) stacked
+    partials, out (M, R) replicated."""
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(x.dtype)
+    n = mesh.shape[axis]
+    with_res = residual is not None
+    if not quant.is_quantized(wire_dtype):
+        from .allreduce import all_reduce
+
+        if with_res:
+            # the exact wire still owes the carry accumulated by earlier
+            # quantized calls: fold it in; what the input-dtype cast
+            # cannot represent stays in the residual (the EF invariant)
+            xf = x.astype(jnp.float32) + residual.astype(jnp.float32)
+            xr = xf.astype(x.dtype)
+            return (all_reduce(xr, mesh, axis, out_dtype=out_dtype),
+                    xf - xr.astype(jnp.float32))
+        return all_reduce(x, mesh, axis, out_dtype=out_dtype)
+    if n == 1:
+        if with_res:
+            xc = x.astype(jnp.float32) + residual.astype(jnp.float32)
+            out = quant.roundtrip_rows(xc, wire_dtype, out_dtype=out_dtype)
+            return out, xc - out.astype(jnp.float32)
+        return quant.roundtrip_rows(x, wire_dtype, out_dtype=out_dtype)
+    m_stack = x.shape[0]
+    if m_stack % n or (m_stack // n) % n:
+        raise ValueError(
+            f"dim0 {m_stack} must be divisible by {axis}^2 = {n * n}")
+
+    def make_verify(integrity):
+        return lambda out: integrity.verify_reduce_q(
+            f"all_reduce_{wire_dtype}", x,
+            out[0] if with_res else out, n, wire_dtype,
+            residual=residual if with_res else None, two_hop=True)
+
+    if with_res:
+        return _wrapped(
+            "all_reduce", mesh, axis, wire_dtype, x,
+            lambda: _q_all_reduce(mesh, axis, wire_dtype, out_dtype,
+                                  x, residual),
+            make_verify=make_verify,
+        )
+    return _wrapped(
+        "all_reduce", mesh, axis, wire_dtype, x,
+        lambda: _q_all_reduce_plain(mesh, axis, wire_dtype, out_dtype, x),
+        make_verify=make_verify,
+    )
+
+
+def _wrapped(op: str, mesh, axis, wire_dtype, x, core, *, make_verify):
+    """The shared eager instrumentation of the XLA-exchange quantized
+    variants (RS/AR — whose wire is ``lax.all_to_all`` inside the
+    shard_map, invisible to the Pallas entries' wrappers): obs wire-byte
+    accounting of the PACKED bytes, and consumer-side integrity
+    verification against the codec-aware golden
+    (``integrity.verify_reduce_q``).  ``make_verify(integrity)`` builds
+    the verifier lazily so the disabled path never imports it."""
+    from .. import obs, resilience
+    from ..tune.autotuner import is_tracer
+
+    n = mesh.shape[axis]
+    m_stack, r = x.shape
+    m_loc = m_stack // (n * n)
+    w = quant.packed_width(r, wire_dtype)
+    chunk_bytes = m_loc * w
+    eager = not is_tracer(x)
+    if eager and resilience.integrity.enabled():
+        core = resilience.integrity.checked(
+            f"{op}_{wire_dtype}", core, ranks=n,
+            verify=make_verify(resilience.integrity))
+    if eager and (obs.enabled() or obs.flight.enabled()):
+        wire = (n - 1) * chunk_bytes
+        if op == "all_reduce":
+            wire *= 2          # packed exchange + packed AG return hop
+        return obs.comm_call(
+            op, core,
+            payload_bytes=m_loc * n * r * jnp.dtype(x.dtype).itemsize,
+            wire_bytes=wire, chunks=2 * (n - 1) if op == "all_reduce"
+            else n - 1,
+            method=f"oneshot_{wire_dtype}", ranks=n,
+        )
+    return core()
+
+
+# ---------------------------------------------------------------------------
+# stacked partial GEMM: the producer half the quantized fused-GEMM
+# compositions share (gemm_rs / gemm_ar / fused_mlp_ar with a quantized
+# wire compute their local partial, then reduce through the quantized
+# exchange above — the tuner decides per shape whether the halved wire
+# beats the bf16 ring's compute overlap)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_partial_gemm(mesh: Mesh, axis: str, m: int, k_loc: int,
+                        n_dim: int, dtype, out_dtype):
+    def local(a_loc, b_loc):
+        return jnp.dot(a_loc, b_loc,
+                       preferred_element_type=jnp.float32).astype(out_dtype)
+
+    return compilation.jit_shard_map(
+        local, mesh,
+        in_specs=(P(None, axis), P(axis, None)),
+        out_specs=P(axis, None))
+
+
+def stacked_partial_gemm(a: jax.Array, b: jax.Array, mesh: Mesh,
+                         axis: str, out_dtype=None) -> jax.Array:
+    """Per-rank partial of a K-parallel GEMM, stacked: ``a`` (M, K)
+    sharded dim 1, ``b`` (K, N) sharded dim 0 -> global (n*M, N) where
+    rank r's block is its partial addend — exactly the input contract of
+    :func:`quantized_reduce_scatter` / :func:`quantized_all_reduce`."""
+    n = mesh.shape[axis]
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
+    fn = _build_partial_gemm(mesh, axis, a.shape[0], a.shape[1] // n,
+                             b.shape[1], jnp.dtype(a.dtype), out_dtype)
+    return fn(a, b)
+
+
+# ---------------------------------------------------------------------------
+# quantized EP all-to-all transports (the STE custom-vjp home — moved
+# from layers/moe.py, generalized over wire dtypes)
+
+# The u8 wire is an integer path — its cotangent is float0, which would
+# silently FREEZE every gradient crossing the A2A.  The transports are
+# therefore custom-vjp'd with a straight-through estimator: forward
+# ships the quantized message, backward pulls the cotangent through the
+# exact (padding-masked) permutation adjoint at FULL precision,
+# ignoring the quantization error — the standard STE treatment of
+# fake-quant wires.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def quantized_ep_dispatch(mesh, axis, cfg, h, wire_dtype, x, splits):
+    """EP dispatch with a quantized wire: pack -> ``ep_dispatch`` (the
+    real Pallas A2A on the u8 message — integrity/obs see the quantized
+    bytes) -> dequantize into the model dtype.  Straight-through
+    backward (the padding-masked combine adjoint)."""
+    from .all_to_all import ep_dispatch
+
+    recv_u8, recv_splits = ep_dispatch(
+        quant.pack_rows(x, wire_dtype), splits, mesh, axis, config=cfg
+    )
+    return quant.unpack_rows(recv_u8, h, wire_dtype, x.dtype), recv_splits
+
+
+def _q_dispatch_fwd(mesh, axis, cfg, h, wire_dtype, x, splits):
+    out = quantized_ep_dispatch(mesh, axis, cfg, h, wire_dtype, x, splits)
+    return out, (splits, x.shape[0] // mesh.shape[axis],
+                 jnp.zeros((0,), x.dtype))
+
+
+def _q_dispatch_bwd(mesh, axis, cfg, h, wire_dtype, res, cots):
+    import numpy as np
+
+    from .all_to_all import ep_dispatch_adjoint
+
+    splits, t_loc, wit = res
+    d_recv, _ = cots
+    dx = ep_dispatch_adjoint(d_recv.astype(wit.dtype), splits, mesh, axis,
+                             token_dim=t_loc, config=cfg)
+    return dx, np.zeros(splits.shape, dtype=jax.dtypes.float0)
+
+
+quantized_ep_dispatch.defvjp(_q_dispatch_fwd, _q_dispatch_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5))
+def quantized_ep_combine(mesh, axis, cfg, h, wire_dtype, token_dim, y,
+                         splits):
+    """EP combine with a quantized wire (see
+    :func:`quantized_ep_dispatch`)."""
+    from .all_to_all import ep_combine
+
+    back_u8 = ep_combine(quant.pack_rows(y, wire_dtype), splits, mesh,
+                         axis, token_dim=token_dim, config=cfg)
+    return quant.unpack_rows(back_u8, h, wire_dtype, y.dtype)
+
+
+def _q_combine_fwd(mesh, axis, cfg, h, wire_dtype, token_dim, y, splits):
+    return quantized_ep_combine(
+        mesh, axis, cfg, h, wire_dtype, token_dim, y, splits
+    ), (splits, jnp.zeros((0,), y.dtype))
+
+
+def _q_combine_bwd(mesh, axis, cfg, h, wire_dtype, token_dim, res, dback):
+    import numpy as np
+
+    from .all_to_all import ep_combine_adjoint
+
+    splits, wit = res
+    dy = ep_combine_adjoint(dback.astype(wit.dtype), splits, mesh, axis,
+                            config=cfg)
+    return dy, np.zeros(splits.shape, dtype=jax.dtypes.float0)
+
+
+quantized_ep_combine.defvjp(_q_combine_fwd, _q_combine_bwd)

@@ -264,6 +264,7 @@ def reduce_scatter(
     axis: str = TP_AXIS,
     *,
     config: ReduceScatterConfig | None = None,
+    wire_dtype: str = "bf16",
 ) -> jax.Array:
     """Ring reduce-scatter over ``axis`` (reference host entry
     ``reduce_scatter.py:688-882``).
@@ -271,6 +272,11 @@ def reduce_scatter(
     ``x``: global ``(n*M, R)``, device r's shard = its (M, R) partial addend.
     Returns global ``(M, R)`` sharded over ``axis``: the element-wise sum,
     row-chunk r on device r.  Golden: ``x.reshape(n, M, R).sum(0)``.
+
+    ``wire_dtype``: "bf16" (this ring), "int8"/"fp8" (the quantized
+    one-shot exchange — ``comm.quantized.quantized_reduce_scatter``:
+    quantize at the producer chunk, dequantize + f32-reduce at the
+    consumer), or "auto" (tuner-resolved per shape/ranks/wire class).
     """
     n = mesh.shape[axis]
     m_stack = x.shape[0]
@@ -279,6 +285,21 @@ def reduce_scatter(
     m_partial = m_stack // n          # per-device partial row count
     if n == 1:
         return x
+    if wire_dtype != "bf16":
+        from ..tune.autotuner import is_tracer as _q_is_tracer
+        from . import quantized as _q
+
+        if wire_dtype == "auto":
+            wire_dtype = _q.resolve_wire_dtype(
+                "rs_wire", (tuple(x.shape), str(x.dtype)), mesh, axis,
+                lambda wd: (lambda: reduce_scatter(x, mesh, axis,
+                                                   config=config,
+                                                   wire_dtype=wd)),
+                tracing=_q_is_tracer(x),
+            )
+        if wire_dtype != "bf16":
+            return _q.quantized_reduce_scatter(
+                x, mesh, axis, wire_dtype=wire_dtype)
     if m_partial % n:
         raise ValueError(
             f"partial rows {m_partial} not divisible by {axis}={n}"

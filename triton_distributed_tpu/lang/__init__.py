@@ -1,5 +1,6 @@
 """Distributed device-language primitives (see primitives.py for the full
 contract vs the reference's dl.* / libshmem_device)."""
+from . import quant  # noqa: F401  (shared low-precision wire codecs)
 from .primitives import (
     Team,
     rank, num_ranks, symm_at, notify, wait, peek, consume_token,

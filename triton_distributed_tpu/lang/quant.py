@@ -1,0 +1,281 @@
+"""Shared low-precision wire codecs: the quantize/pack machinery every
+quantized payload in the framework rides.
+
+Promoted out of ``ops/moe_utils.py`` / ``layers/moe.py`` (ISSUE 9): the
+fp8 A2A payload codec the MoE layer prototyped — e4m3 payload + f32
+scale sidecar in ONE uint8 wire message (the reference's production
+low-latency A2A configuration, ``low_latency_all_to_all.py:36-120``) —
+generalized to a registry of wire dtypes and shared by:
+
+- the quantized collective entries (``comm.quantized`` — AG/RS/AR/A2A
+  with ``wire_dtype``), which pack at the producer, ship u8, and
+  dequantize at the consumer;
+- the MoE EP wire (``layers.moe``), which keeps its straight-through
+  custom-vjp transports but consumes THIS codec;
+- the int8 KV-cache layout (``models.kv_cache``), which uses the same
+  per-row quantization math at (page, head) granularity;
+- the integrity plane (``resilience.integrity``), whose quantized
+  verifiers re-run :func:`reduce_roundtrip` as the golden.
+
+Wire message layout (identical for every quantized dtype, so one unpack
+serves all): ``(..., H + SIDECAR)`` uint8 — H payload bytes (the
+quantized row, bitcast to u8) followed by a ``SIDECAR``-lane block whose
+first 4 bytes carry the row's f32 scale little-endian (the remaining
+lanes are zero padding that keeps the message lane-aligned for DMA).
+One byte per element + the sidecar ≈ halves the wire bytes of a bf16
+payload at serving widths (H >= 1024).
+
+Error envelopes (relative to the ROW absmax — the bound the property
+tests pin and the parity gates scale their tolerances from):
+
+- ``fp8`` (e4m3): worst-case half-ulp at 3 mantissa bits = 2^-4 of the
+  row absmax for near-max elements; smaller elements keep ~relative
+  precision down to the scaled denormal floor.
+- ``int8``: uniform grid — half a step = 0.5/127 of the row absmax,
+  everywhere.  Tighter than fp8 near the max, looser for tiny elements.
+
+All-zero rows quantize to scale ``SCALE_EPS`` (0/0 -> 0, round-trip
+exact); all-negative and denormal rows ride the same absmax math.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# e4m3 quantization recipe, shared by the XLA path, the fused Pallas
+# pack kernel, and the KV-cache quantizer — wire producers must stay
+# provably identical, so the constants live in exactly one place
+E4M3_MAX = 448.0     # largest finite float8_e4m3fn value
+INT8_MAX = 127.0     # symmetric int8 grid (|-128| excluded)
+SCALE_EPS = 1e-12    # keeps all-zero rows at a finite scale (0/0 -> 0)
+
+# u8 lanes appended per row: the first 4 carry the f32 scale.  128 keeps
+# the message lane-aligned (the TPU wire moves 128-lane vectors).
+SIDECAR = 128
+
+WIRE_DTYPES = ("bf16", "int8", "fp8")
+QUANTIZED_WIRE_DTYPES = ("int8", "fp8")
+
+_PACK_BM = 128       # fused pack-kernel row block (see layers/moe.py note)
+
+
+def is_quantized(wire_dtype: str) -> bool:
+    if wire_dtype not in WIRE_DTYPES:
+        raise ValueError(
+            f"wire_dtype {wire_dtype!r} not in {WIRE_DTYPES}")
+    return wire_dtype != "bf16"
+
+
+def rel_error_bound(wire_dtype: str) -> float:
+    """Worst-case |dequant - x| / row_absmax of one codec round-trip
+    (the envelope the property tests pin; parity gates scale their
+    ``assert_allclose`` tolerance from this — the ``verify_reduce``
+    discipline of dtype-scaled bounds)."""
+    return {"fp8": 2.0 ** -4, "int8": 0.5 / INT8_MAX, "bf16": 2.0 ** -8}[
+        wire_dtype]
+
+
+def abs_error_bound(absmax, wire_dtype: str):
+    """The full ABSOLUTE per-element error envelope of one round-trip:
+    ``rel_error_bound * row_absmax`` plus the ``SCALE_EPS`` additive
+    floor — rows whose absmax sinks toward the epsilon (denormal-range
+    or all-zero rows) have an eps-dominated scale, so their elements
+    flush to zero with |err| = |x| <= SCALE_EPS-order, which the
+    relative term alone does not cover.  The single source the property
+    tests, the lint selftest, and the parity gates share."""
+    return rel_error_bound(wire_dtype) * absmax + SCALE_EPS
+
+
+def wire_itemsize(wire_dtype: str) -> int:
+    """Bytes per element on the wire (payload only, sidecar excluded)."""
+    return 1 if is_quantized(wire_dtype) else 2
+
+
+def packed_width(h: int, wire_dtype: str) -> int:
+    """Wire-message feature width in BYTES for an H-wide row."""
+    if not is_quantized(wire_dtype):
+        return 2 * h
+    return h + SIDECAR
+
+
+def wire_ratio(h: int, wire_dtype: str) -> float:
+    """Quantized wire bytes / bf16 wire bytes for an H-wide row — the
+    byte accounting ``bench.py wire`` gates (<= 0.55x at serving
+    widths)."""
+    return packed_width(h, wire_dtype) / (2.0 * h)
+
+
+def _scale_for(absmax: jax.Array, wire_dtype: str) -> jax.Array:
+    qmax = E4M3_MAX if wire_dtype == "fp8" else INT8_MAX
+    return absmax / qmax + SCALE_EPS
+
+
+def quantize_rows(x: jax.Array, wire_dtype: str = "fp8", *,
+                  axis: int = -1):
+    """Per-row quantization: returns ``(q, scale)`` with ``scale`` f32
+    keeping the reduced ``axis`` at size 1, chosen so the row absmax
+    maps to the dtype's max (448 for e4m3, 127 for int8).  ``q`` is
+    ``float8_e4m3fn`` or ``int8``."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=axis, keepdims=True)
+    scale = _scale_for(absmax, wire_dtype)
+    y = xf / scale
+    if wire_dtype == "fp8":
+        return y.astype(jnp.float8_e4m3fn), scale
+    q = jnp.clip(jnp.round(y), -INT8_MAX, INT8_MAX)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_rows(q: jax.Array, scale: jax.Array, dtype=jnp.bfloat16):
+    """Inverse of :func:`quantize_rows` (both payload dtypes)."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# one-message wire pack: payload bytes + f32 scale sidecar
+
+
+def _payload_dtype(wire_dtype: str):
+    return jnp.float8_e4m3fn if wire_dtype == "fp8" else jnp.int8
+
+
+def _pack_kernel(wire_dtype, x_ref, o_ref):
+    """One-pass quantize + wire pack: absmax -> scale -> payload bitcast
+    to u8, with the f32 scale's 4 bytes spread onto the sidecar lanes by
+    iota-select — one HBM read of the bf16 rows and one write of the u8
+    message, vs the XLA path's materialized quantize + concat (measured
+    100-166 GB/s XLA vs ~255 GB/s for this kernel at the bench shape;
+    the number that pins the codec's wire economics in BENCH r04)."""
+    xf = x_ref[...].astype(jnp.float32)                    # (bm, h)
+    absmax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
+    scale = _scale_for(absmax, wire_dtype)                 # (bm, 1)
+    y = xf / scale
+    if wire_dtype == "fp8":
+        q = y.astype(jnp.float8_e4m3fn)
+    else:
+        q = jnp.clip(jnp.round(y), -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    payload = jax.lax.bitcast_convert_type(q, jnp.uint8)   # (bm, h)
+    si = jax.lax.bitcast_convert_type(scale, jnp.uint32)   # (bm, 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (q.shape[0], SIDECAR), 1)
+    byte = jnp.right_shift(si, (jnp.minimum(lane, 3) * 8).astype(jnp.uint32))
+    sidecar = jnp.where(lane < 4, byte & 0xFF, 0).astype(jnp.uint8)
+    o_ref[...] = jnp.concatenate([payload, sidecar], axis=1)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_pack(t: int, h: int, wire_dtype: str):
+    from jax.experimental import pallas as pl
+
+    from ..core import compilation
+
+    return pl.pallas_call(
+        functools.partial(_pack_kernel, wire_dtype),
+        grid=(t // _PACK_BM,),
+        in_specs=[pl.BlockSpec((_PACK_BM, h), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((_PACK_BM, h + SIDECAR), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((t, h + SIDECAR), jnp.uint8),
+        compiler_params=compilation.compiler_params(
+            collective=False, dimension_semantics=("parallel",),
+            # the f32 working tile exceeds the 16 MiB scoped default
+            vmem_limit_bytes=64 * 2**20,
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+
+
+def pack_quantized(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Assemble the wire message from ALREADY-quantized rows: payload
+    bytes + the f32 scale's 4 bytes + zero padding to the ``SIDECAR``
+    lanes.  The one home of the sidecar byte layout — shared by
+    :func:`pack_rows`'s XLA path and callers that must ship exactly the
+    ``(q, scale)`` a residual was accounted against (the AR error-
+    feedback wire, ``comm.quantized._build_q_ar``)."""
+    payload = jax.lax.bitcast_convert_type(q, jnp.uint8)
+    sc = jax.lax.bitcast_convert_type(
+        scale.astype(jnp.float32), jnp.uint8
+    ).reshape(*q.shape[:-1], 4)
+    pad = jnp.zeros((*q.shape[:-1], SIDECAR - 4), jnp.uint8)
+    return jnp.concatenate([payload, sc, pad], axis=-1)
+
+
+def _pack_rows_xla(x: jax.Array, wire_dtype: str) -> jax.Array:
+    q, scale = quantize_rows(x, wire_dtype)            # (..., H), (..., 1)
+    return pack_quantized(q, scale)
+
+
+def pack_rows(x: jax.Array, wire_dtype: str = "fp8") -> jax.Array:
+    """Quantize rows and pack payload + f32 scale sidecar into ONE uint8
+    wire message ``(..., H + SIDECAR)``.  Runs the fused one-pass Pallas
+    kernel when the shape tiles cleanly; odd shapes and the CPU backend
+    take the XLA path (decoded-value equivalent; the fusion can shift
+    the last payload/scale ulp under interpret mode — the CI tests
+    assert decoded equivalence, not byte equality)."""
+    if not is_quantized(wire_dtype):
+        raise ValueError("pack_rows packs quantized wire dtypes only; "
+                         "bf16 payloads ship unpacked")
+    from ..core import platform
+
+    if (x.ndim == 2 and x.shape[0] % _PACK_BM == 0
+            and x.shape[1] % 128 == 0 and not platform.on_cpu()):
+        return _build_pack(*x.shape, wire_dtype)(x)
+    return _pack_rows_xla(x, wire_dtype)
+
+
+def unpack_rows(u8: jax.Array, h: int, wire_dtype: str = "fp8",
+                out_dtype=jnp.bfloat16) -> jax.Array:
+    """Inverse of :func:`pack_rows`: split payload/scale, dequantize."""
+    q = jax.lax.bitcast_convert_type(u8[..., :h],
+                                     _payload_dtype(wire_dtype))
+    scale = jax.lax.bitcast_convert_type(
+        u8[..., h:h + 4], jnp.float32
+    )[..., None]
+    return dequantize_rows(q, scale, out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# error feedback (the AR option) and the reduction golden
+
+
+def ef_quantize_rows(x: jax.Array, wire_dtype: str,
+                     residual: jax.Array | None = None):
+    """Error-feedback quantization step: fold the carried residual into
+    the input BEFORE quantizing, return ``(q, scale, new_residual)``
+    with ``new_residual = (x + residual) - dequant(q)`` in f32.  Carried
+    across repeated quantized reductions, the residual cancels the
+    codec's bias so the time-average converges to the exact sum instead
+    of drifting (the standard EF-SGD treatment of compressed
+    gradients)."""
+    xc = x.astype(jnp.float32)
+    if residual is not None:
+        xc = xc + residual.astype(jnp.float32)
+    q, scale = quantize_rows(xc, wire_dtype)
+    new_res = xc - dequantize_rows(q, scale, jnp.float32)
+    return q, scale, new_res
+
+
+def roundtrip_rows(x: jax.Array, wire_dtype: str, *,
+                   out_dtype=None) -> jax.Array:
+    """One codec round-trip (quantize -> dequantize) — the value the
+    consumer of a quantized wire actually sees.  The golden for parity
+    gates and the integrity plane's quantized verifiers."""
+    if not is_quantized(wire_dtype):
+        return x if out_dtype is None else x.astype(out_dtype)
+    q, scale = quantize_rows(x, wire_dtype)
+    return dequantize_rows(q, scale,
+                           out_dtype if out_dtype is not None else x.dtype)
+
+
+def reduce_roundtrip(parts: jax.Array, wire_dtype: str,
+                     out_dtype=None) -> jax.Array:
+    """The exact value a quantized reduction delivers: per-partial codec
+    round-trip, then an f32 sum.  ``parts``: (n, M, R) stacked partial
+    addends.  This is the golden ``integrity.verify_reduce_q`` re-runs
+    on the host (the quantized analogue of ``verify_reduce``'s f32
+    re-reduction) and the local simulator the error-feedback
+    convergence test drives."""
+    deq = roundtrip_rows(parts, wire_dtype, out_dtype=jnp.float32)
+    out = deq.sum(axis=0)
+    return out if out_dtype is None else out.astype(out_dtype)

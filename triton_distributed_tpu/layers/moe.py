@@ -21,173 +21,41 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..comm.all_to_all import (
-    AllToAllConfig,
-    ep_combine,
-    ep_combine_adjoint,
-    ep_dispatch,
-    ep_dispatch_adjoint,
-)
+from ..comm.all_to_all import AllToAllConfig, ep_combine, ep_dispatch
+from ..comm.quantized import quantized_ep_combine, quantized_ep_dispatch
 from ..core import mesh as mesh_lib
 from ..core.mesh import TP_AXIS
+from ..lang import quant
 from ..ops.group_gemm import ag_group_gemm, moe_reduce_rs
 from ..ops.moe_utils import (
-    dequantize,
     flatten_topk,
     global_presort_index,
-    quantize_e4m3,
     sort_by_expert,
     topk_route,
     unsort_combine,
 )
 
-_FP8_SIDECAR = 128   # u8 lanes appended per row: 4 carry the f32 scale
-_PACK_BM = 128       # pack-kernel row block (in 3.7 MB of VMEM at h=7168)
-
-
-def _pack_fp8_kernel(x_ref, o_ref):
-    """One-pass quantize + wire pack (see :func:`_pack_fp8`): absmax ->
-    scale -> e4m3 payload bitcast to u8, with the f32 scale's 4 bytes
-    spread onto the sidecar lanes by iota-select — one HBM read of the
-    bf16 rows and one write of the u8 message, vs the XLA path's
-    materialized quantize + concat (measured 100-166 GB/s XLA vs
-    ~255 GB/s for this kernel at the bench shape)."""
-    from ..ops.moe_utils import E4M3_MAX, SCALE_EPS
-
-    xf = x_ref[...].astype(jnp.float32)                    # (bm, h)
-    absmax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
-    scale = absmax / E4M3_MAX + SCALE_EPS                  # (bm, 1)
-    q = (xf / scale).astype(jnp.float8_e4m3fn)
-    payload = jax.lax.bitcast_convert_type(q, jnp.uint8)   # (bm, h)
-    si = jax.lax.bitcast_convert_type(scale, jnp.uint32)   # (bm, 1)
-    lane = jax.lax.broadcasted_iota(jnp.int32, (q.shape[0], _FP8_SIDECAR), 1)
-    byte = jnp.right_shift(si, (jnp.minimum(lane, 3) * 8).astype(jnp.uint32))
-    sidecar = jnp.where(lane < 4, byte & 0xFF, 0).astype(jnp.uint8)
-    o_ref[...] = jnp.concatenate([payload, sidecar], axis=1)
-
-
-@functools.lru_cache(maxsize=None)
-def _build_pack_fp8(t: int, h: int):
-    from jax.experimental import pallas as pl
-
-    from ..core import compilation
-
-    call = pl.pallas_call(
-        _pack_fp8_kernel,
-        grid=(t // _PACK_BM,),
-        in_specs=[pl.BlockSpec((_PACK_BM, h), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((_PACK_BM, h + _FP8_SIDECAR),
-                               lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((t, h + _FP8_SIDECAR), jnp.uint8),
-        compiler_params=compilation.compiler_params(
-            collective=False, dimension_semantics=("parallel",),
-            # the f32 working tile exceeds the 16 MiB scoped default
-            vmem_limit_bytes=64 * 2**20,
-        ),
-        interpret=compilation.interpret_mode(),
-    )
-    return call
-
-
-def _pack_fp8_xla(x: jax.Array) -> jax.Array:
-    x8, scale = quantize_e4m3(x)                       # (..., H), (..., 1)
-    payload = jax.lax.bitcast_convert_type(x8, jnp.uint8)
-    sc = jax.lax.bitcast_convert_type(
-        scale.astype(jnp.float32), jnp.uint8
-    ).reshape(*x.shape[:-1], 4)
-    pad = jnp.zeros((*x.shape[:-1], _FP8_SIDECAR - 4), jnp.uint8)
-    return jnp.concatenate([payload, sc, pad], axis=-1)
+# The fp8 pack/unpack machinery this layer pioneered (one-pass Pallas
+# pack at ~255 GB/s vs 100-166 GB/s for the materialized XLA path,
+# measured at the bench shape — BENCH r04) was promoted into the SHARED
+# quant module (``lang.quant``, ISSUE 9), together with the
+# straight-through custom-vjp transports (now ``comm.quantized``) — one
+# home for every quantized wire.  The aliases below keep the historic
+# names importable (bench.py, tests).
+_FP8_SIDECAR = quant.SIDECAR
+_build_pack_fp8 = functools.partial(quant._build_pack, wire_dtype="fp8")
 
 
 def _pack_fp8(x: jax.Array) -> jax.Array:
-    """Quantize rows to e4m3 and pack payload + f32 scale sidecar into ONE
-    uint8 wire message (..., H + 128): the reference's production A2A
-    configuration ships fp8 tokens with scales in the same message
-    (``low_latency_all_to_all.py:36-120``, the 137 us README case).  One
-    u8 byte per element + a 128-lane sidecar ≈ halves the wire bytes of a
-    bf16 payload.
+    return quant.pack_rows(x, "fp8")
 
-    Runs the fused one-pass Pallas kernel when the shape tiles cleanly;
-    odd shapes and the CPU backend take the XLA path.  The two paths
-    were measured bit-identical on real TPU; under CPU interpret mode
-    fusion differences can shift the last f8/scale ulp, so the CI test
-    (``tests/test_moe_layer.py``) asserts decoded-value equivalence,
-    not byte equality.  The unpack stays XLA: measured competitive."""
-    from ..core import platform
 
-    if (x.ndim == 2 and x.shape[0] % _PACK_BM == 0
-            and x.shape[1] % 128 == 0 and not platform.on_cpu()):
-        return _build_pack_fp8(*x.shape)(x)
-    return _pack_fp8_xla(x)
+def _pack_fp8_xla(x: jax.Array) -> jax.Array:
+    return quant._pack_rows_xla(x, "fp8")
 
 
 def _unpack_fp8(u8: jax.Array, h: int, out_dtype) -> jax.Array:
-    """Inverse of :func:`_pack_fp8`: split payload/scale, dequantize."""
-    x8 = jax.lax.bitcast_convert_type(u8[..., :h], jnp.float8_e4m3fn)
-    scale = jax.lax.bitcast_convert_type(
-        u8[..., h:h + 4], jnp.float32
-    )[..., None]
-    return dequantize(x8, scale, out_dtype)
-
-
-# The u8 wire is an integer path — its cotangent is float0, which would
-# silently FREEZE every gradient crossing the A2A.  The transports are
-# therefore custom-vjp'd with a straight-through estimator: forward ships
-# the quantized message, backward pulls the cotangent through the exact
-# (padding-masked) permutation adjoint at FULL precision, ignoring the
-# quantization error — the standard STE treatment of fake-quant wires.
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
-def _fp8_dispatch(mesh, axis, cfg, h, x, splits):
-    recv_u8, recv_splits = ep_dispatch(
-        _pack_fp8(x), splits, mesh, axis, config=cfg
-    )
-    return _unpack_fp8(recv_u8, h, x.dtype), recv_splits
-
-
-def _fp8_dispatch_fwd(mesh, axis, cfg, h, x, splits):
-    out = _fp8_dispatch(mesh, axis, cfg, h, x, splits)
-    return out, (splits, x.shape[0] // mesh.shape[axis],
-                 jnp.zeros((0,), x.dtype))
-
-
-def _fp8_dispatch_bwd(mesh, axis, cfg, h, res, cots):
-    import numpy as np
-
-    splits, t_loc, wit = res
-    d_recv, _ = cots
-    dx = ep_dispatch_adjoint(d_recv.astype(wit.dtype), splits, mesh, axis,
-                             token_dim=t_loc, config=cfg)
-    return dx, np.zeros(splits.shape, dtype=jax.dtypes.float0)
-
-
-_fp8_dispatch.defvjp(_fp8_dispatch_fwd, _fp8_dispatch_bwd)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
-def _fp8_combine(mesh, axis, cfg, h, token_dim, y, splits):
-    back_u8 = ep_combine(_pack_fp8(y), splits, mesh, axis,
-                         token_dim=token_dim, config=cfg)
-    return _unpack_fp8(back_u8, h, y.dtype)
-
-
-def _fp8_combine_fwd(mesh, axis, cfg, h, token_dim, y, splits):
-    return _fp8_combine(mesh, axis, cfg, h, token_dim, y, splits), (
-        splits, jnp.zeros((0,), y.dtype)
-    )
-
-
-def _fp8_combine_bwd(mesh, axis, cfg, h, token_dim, res, dback):
-    import numpy as np
-
-    splits, wit = res
-    dy = ep_combine_adjoint(dback.astype(wit.dtype), splits, mesh, axis,
-                            config=cfg)
-    return dy, np.zeros(splits.shape, dtype=jax.dtypes.float0)
-
-
-_fp8_combine.defvjp(_fp8_combine_fwd, _fp8_combine_bwd)
+    return quant.unpack_rows(u8, h, "fp8", out_dtype)
 
 
 @jax.tree_util.register_dataclass
@@ -238,10 +106,21 @@ class MoEMLP:
                 f"got {self.fp8_wire!r}"
             )
 
-    def fp8_wire_enabled(self) -> bool:
-        """The resolved wire-codec decision for THIS layer's A2A axis."""
+    def fp8_wire_enabled(self, hdim: int | None = None) -> bool:
+        """The resolved wire-codec decision for THIS layer's A2A axis:
+        the codec ships when its NET time win is positive on the axis's
+        wire class at the layer's ROW WIDTH (``tools.calibrate
+        .codec_pays`` — measured link calibration when one exists, the
+        documented cold-start numbers otherwise; with cold-start values
+        this reproduces the old DCN-only rule exactly).  ``hdim``: the
+        activation width the wire actually ships — narrow rows amortize
+        the scale sidecar worse and can flip the economics."""
         if self.fp8_wire == "auto":
-            return mesh_lib.wire_class(self.mesh, self.axis) == "dcn"
+            from ..tools import calibrate
+
+            kwargs = {} if hdim is None else {"h": int(hdim)}
+            return calibrate.codec_pays(
+                mesh_lib.wire_class(self.mesh, self.axis), **kwargs)
         return bool(self.fp8_wire)
 
     @property
@@ -450,13 +329,14 @@ class MoEMLP:
         x_sorted, splits, wflat, unsort = self._route_and_sort(
             x, params.router
         )
-        fp8 = self.fp8_wire_enabled() and n > 1
+        fp8 = self.fp8_wire_enabled(hdim) and n > 1
         cfg = a2a_config or AllToAllConfig()
         if fp8:
-            # quantized wire with a straight-through backward (see
-            # _fp8_dispatch); zones come back dequantized to the model dtype
-            recv, recv_splits = _fp8_dispatch(
-                self.mesh, self.axis, cfg, hdim, x_sorted, splits
+            # quantized wire with a straight-through backward
+            # (comm.quantized); zones come back dequantized to the model
+            # dtype
+            recv, recv_splits = quantized_ep_dispatch(
+                self.mesh, self.axis, cfg, hdim, "fp8", x_sorted, splits
             )
         else:
             recv, recv_splits = ep_dispatch(
@@ -504,8 +384,8 @@ class MoEMLP:
         t_loc = x_sorted.shape[0] // n
         if fp8:
             # quantized return hop, straight-through backward
-            back = _fp8_combine(self.mesh, self.axis, cfg, hdim, t_loc,
-                                processed, splits)
+            back = quantized_ep_combine(self.mesh, self.axis, cfg, hdim,
+                                        "fp8", t_loc, processed, splits)
         else:
             back = ep_combine(
                 processed, splits, self.mesh, self.axis,

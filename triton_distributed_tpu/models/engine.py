@@ -121,6 +121,12 @@ class Engine:
     top_p: float = 1.0
     cache_layout: str = "contiguous"
     page_size: int = 64
+    # KV storage dtype knob (ISSUE 9): None keeps the model dtype;
+    # "int8" selects the quantized paged layout (per-(page, head) scale
+    # sidecars, dequant fused into the decode kernels) — halved pool
+    # bytes the scheduler converts into concurrent sequences.  Paged
+    # layout only.
+    kv_dtype: str | None = None
     # default per-request wall budget for :meth:`serve` when resilience
     # is enabled (TDT_RESILIENCE=1); None = unbounded unless the call
     # passes ``deadline_ms`` explicitly
@@ -149,9 +155,13 @@ class Engine:
             self.cache = init_paged_cache(
                 self.model.mesh, c.num_layers, self.batch, c.num_kv_heads,
                 c.max_length, c.head_dim, c.dtype, self.model.axis,
-                page_size=self.page_size,
+                page_size=self.page_size, kv_dtype=self.kv_dtype,
             )
         elif self.cache_layout == "contiguous":
+            if self.kv_dtype is not None:
+                raise ValueError(
+                    "kv_dtype quantization needs cache_layout='paged' "
+                    "(the per-(page, head) scale layout)")
             self.cache = init_cache(
                 self.model.mesh, c.num_layers, self.batch, c.num_kv_heads,
                 c.max_length, c.head_dim, c.dtype, self.model.axis,

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.mesh import TP_AXIS
+from ..lang import quant as _quant
 
 
 class PagePoolExhausted(RuntimeError):
@@ -134,12 +135,29 @@ class PagedKVCache:
     lengths.  The table is a device array (it travels through jit), but its
     values are expected to be stable across a generation — the engine
     allocates the static worst case up front like the reference's
-    preallocated cache."""
+    preallocated cache.
+
+    **Quantized layout** (``kv_dtype="int8"``, ISSUE 9): the pools store
+    int8 with a PER-(page, head) f32 scale sidecar ``k_scale``/``v_scale``
+    of shape (L, P, Hkv) — one scale per (layer, physical page, kv head),
+    chosen so the page-head's absmax maps to 127 (``lang.quant``'s
+    recipe at page granularity).  Writes quantize fused into the scatter
+    (:func:`append_paged` / :func:`write_chunk_paged` dequant-merge-
+    requant the touched pages only); reads dequantize fused into the
+    decode kernels' page-streaming loops (``ops.attention`` /
+    ``ops.fused_decode`` take the scales) — no full-precision pool is
+    ever materialized on the decode path.  Halved page bytes double the
+    pool's sequence capacity at the same byte budget, which the
+    continuous-batching scheduler converts directly into concurrent
+    sequences.  ``k_scale``/``v_scale`` are None for full-precision
+    pools (the layout is byte-identical to the pre-ISSUE-9 cache)."""
 
     k: jax.Array
     v: jax.Array
     block_table: jax.Array
     seq_lens: jax.Array
+    k_scale: jax.Array | None = None
+    v_scale: jax.Array | None = None
 
     @property
     def page_size(self) -> int:
@@ -149,51 +167,181 @@ class PagedKVCache:
     def max_pages(self) -> int:
         return self.block_table.shape[1]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+def _resolve_kv_dtype(dtype, kv_dtype):
+    """(pool dtype, quantized?) from the ``kv_dtype`` knob: ``None``
+    keeps ``dtype`` (full precision); ``"int8"`` selects the quantized
+    per-(page, head)-scale layout; any other jnp dtype stores as-is."""
+    if kv_dtype is None:
+        return jnp.dtype(dtype), False
+    if kv_dtype == "int8" or jnp.dtype(kv_dtype) == jnp.int8:
+        return jnp.dtype(jnp.int8), True
+    return jnp.dtype(kv_dtype), False
+
+
+def kv_page_bytes(num_layers: int, kv_heads: int, page_size: int,
+                  head_dim: int, dtype=jnp.bfloat16,
+                  kv_dtype=None) -> int:
+    """Bytes ONE physical page costs across all layers, k + v, scale
+    sidecars included — the capacity-math unit ``bench.py serve`` and
+    the docs use (int8 halves the pool bytes per page, so the same byte
+    budget holds ~2x the pages -> ~2x the concurrent sequences)."""
+    pd, quantized = _resolve_kv_dtype(dtype, kv_dtype)
+    per = 2 * num_layers * kv_heads * page_size * head_dim * pd.itemsize
+    if quantized:
+        per += 2 * num_layers * kv_heads * 4          # f32 scale sidecars
+    return per
+
+
+def _init_scales(num_layers: int, pool_pages: int, kv_heads: int,
+                 mesh: Mesh, axis: str):
+    sharding = NamedSharding(mesh, P(None, None, axis))
+    z = jnp.full((num_layers, pool_pages, kv_heads), _quant.SCALE_EPS,
+                 jnp.float32)
+    return jax.device_put(z, sharding)
+
 
 def init_paged_cache(mesh: Mesh, num_layers: int, batch: int, kv_heads: int,
                      max_length: int, head_dim: int, dtype=jnp.bfloat16,
                      axis: str = TP_AXIS, *, page_size: int = 64,
-                     key: jax.Array | None = None) -> PagedKVCache:
+                     key: jax.Array | None = None,
+                     kv_dtype=None) -> PagedKVCache:
     """Preallocate ``batch * (max_length // page_size)`` pages and a full
     block table.  ``key``: when given, the (sequence, logical page) ->
     physical page map is a random bijection instead of the identity — the
     fragmented layout a real page allocator produces, useful for tests and
-    as honest serving behavior."""
+    as honest serving behavior.  ``kv_dtype="int8"`` selects the
+    quantized layout (see :class:`PagedKVCache`)."""
     if max_length % page_size:
         raise ValueError(
             f"max_length {max_length} not divisible by page_size {page_size}"
         )
     mp = max_length // page_size
     p = batch * mp
+    pool_dtype, quantized = _resolve_kv_dtype(dtype, kv_dtype)
     pool_shape = (num_layers, p, kv_heads, page_size, head_dim)
     sharding = NamedSharding(mesh, P(None, None, axis, None, None))
     ids = jnp.arange(p, dtype=jnp.int32)
     if key is not None:
         ids = jax.random.permutation(key, ids)
     return PagedKVCache(
-        k=jax.device_put(jnp.zeros(pool_shape, dtype), sharding),
-        v=jax.device_put(jnp.zeros(pool_shape, dtype), sharding),
+        k=jax.device_put(jnp.zeros(pool_shape, pool_dtype), sharding),
+        v=jax.device_put(jnp.zeros(pool_shape, pool_dtype), sharding),
         block_table=ids.reshape(batch, mp),
         seq_lens=jnp.zeros((batch,), jnp.int32),
+        k_scale=_init_scales(num_layers, p, kv_heads, mesh, axis)
+        if quantized else None,
+        v_scale=_init_scales(num_layers, p, kv_heads, mesh, axis)
+        if quantized else None,
     )
+
+
+def _quantize_pages(vals: jax.Array):
+    """Quantize page-major values ``(..., Hkv, ps, D)`` to int8 with one
+    f32 scale per leading-(page, head) cell — ``lang.quant``'s recipe at
+    (page, head) granularity.  Returns ``(q, scale)`` with ``scale``
+    shaped like ``vals`` minus the last two axes."""
+    xf = vals.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=(-2, -1))
+    scale = absmax / _quant.INT8_MAX + _quant.SCALE_EPS
+    q = jnp.clip(jnp.round(xf / scale[..., None, None]),
+                 -_quant.INT8_MAX, _quant.INT8_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def _dequantize_pages(q: jax.Array, scale: jax.Array,
+                      dtype=jnp.float32) -> jax.Array:
+    """Inverse of :func:`_quantize_pages`."""
+    return (q.astype(jnp.float32) * scale[..., None, None]).astype(dtype)
+
+
+def _merge_token_page(q_pages: jax.Array, scales: jax.Array,
+                      tok: jax.Array, offs: jax.Array):
+    """The quantized token-append merge core, shared by
+    :func:`append_paged` and :func:`append_layer_quantized` (one home —
+    the two must stay bit-identical): dequantize each sequence's ONE
+    touched page, set the token at its in-page offset, zero slots PAST
+    it (a recycled page carries the previous tenant's bytes —
+    ``PagePool.free`` does not scrub — and a stale large value would
+    inflate the absmax; zeroing also keeps the page bytes a
+    deterministic function of the sequence's own content, the
+    checksum-on-evict restore contract), and requantize.  ``q_pages``:
+    (B, Hkv, ps, D) int8; ``scales``: (B, Hkv); ``tok``: (B, Hkv, D);
+    ``offs``: (B,) in-page slots.  Returns ``(q, scale)``."""
+    ps = q_pages.shape[-2]
+    rows = jnp.arange(offs.shape[0])
+    old = _dequantize_pages(q_pages, scales)
+    keep = (jnp.arange(ps)[None, None, :, None]
+            <= offs[:, None, None, None])              # (B, 1, ps, 1)
+    merged = jnp.where(
+        keep, old.at[rows, :, offs].set(tok.astype(jnp.float32)), 0.0)
+    return _quantize_pages(merged)
+
+
+def dequantize_pool(cache: PagedKVCache, dtype=jnp.bfloat16) -> PagedKVCache:
+    """A full-precision copy of a quantized cache (golden/test path and
+    the XLA fallbacks; the decode kernels stream-dequantize instead —
+    this MATERIALIZES the pool and must stay off hot paths)."""
+    if not cache.quantized:
+        return cache
+    return dataclasses.replace(
+        cache,
+        k=_dequantize_pages(cache.k, cache.k_scale, dtype),
+        v=_dequantize_pages(cache.v, cache.v_scale, dtype),
+        k_scale=None, v_scale=None,
+    )
+
+
+def layer_pool(cache: PagedKVCache, layer: int, dtype=None) -> tuple:
+    """One layer's (k, v) pools in compute precision: the pools
+    themselves for a full-precision cache, dequantized views for int8
+    (the chunk-prefill prefix-attention path; decode uses the
+    scale-aware kernels instead)."""
+    k_l, v_l = cache.k[layer], cache.v[layer]
+    if not cache.quantized:
+        return (k_l, v_l) if dtype is None \
+            else (k_l.astype(dtype), v_l.astype(dtype))
+    dt = dtype if dtype is not None else jnp.bfloat16
+    return (_dequantize_pages(k_l, cache.k_scale[layer], dt),
+            _dequantize_pages(v_l, cache.v_scale[layer], dt))
 
 
 def write_prefill_paged(cache: PagedKVCache, layer: int, k_new: jax.Array,
                         v_new: jax.Array) -> PagedKVCache:
     """Scatter a full prefill's (B, Hkv, S, D) into the page pool at
     positions [0, S).  A partial trailing page is zero-padded; those slots
-    are masked by ``seq_lens`` and overwritten by later appends."""
+    are masked by ``seq_lens`` and overwritten by later appends.  On a
+    quantized cache the quantization is FUSED into the scatter: pages
+    are written int8 with their (page, head) scales in one pass."""
     b, hk, s, d = k_new.shape
     ps = cache.page_size
     npg = (s + ps - 1) // ps
     pad = npg * ps - s
 
-    def scatter(pool, vals):
+    def paged_vals(vals):
         vals = jnp.pad(vals, ((0, 0), (0, 0), (0, pad), (0, 0)))
         # (B, Hkv, npg*ps, D) -> (B, npg, Hkv, ps, D) page-major updates
-        vals = vals.reshape(b, hk, npg, ps, d).transpose(0, 2, 1, 3, 4)
+        return vals.reshape(b, hk, npg, ps, d).transpose(0, 2, 1, 3, 4)
+
+    if cache.quantized:
+        qk, sk = _quantize_pages(paged_vals(k_new))
+        qv, sv = _quantize_pages(paged_vals(v_new))
+        pages = cache.block_table[:, :npg]
+        return dataclasses.replace(
+            cache,
+            k=cache.k.at[layer, pages].set(qk),
+            v=cache.v.at[layer, pages].set(qv),
+            k_scale=cache.k_scale.at[layer, pages].set(sk),
+            v_scale=cache.v_scale.at[layer, pages].set(sv),
+        )
+
+    def scatter(pool, vals):
         return pool.at[layer, cache.block_table[:, :npg]].set(
-            vals.astype(pool.dtype)
+            paged_vals(vals).astype(pool.dtype)
         )
 
     return dataclasses.replace(
@@ -238,6 +386,28 @@ def append_paged(cache: PagedKVCache, layer: int, k_tok: jax.Array,
     )[:, 0]                                            # (B,)
     offs = pos % ps
 
+    if cache.quantized:
+        # dequant-merge-requant of the ONE touched page per sequence
+        # (:func:`_merge_token_page`): the (page, head) scale may grow
+        # with the new token, so the page's residents re-quantize
+        # against the merged absmax — bounded at one int8 ulp per
+        # scale-growth event, and a no-growth append round-trips
+        # bit-exact (int grid points are fixed points of the codec).
+        # Touches B pages, not the pool.
+        qk, sk = _merge_token_page(cache.k[layer, pages],
+                                   cache.k_scale[layer, pages],
+                                   k_tok, offs)
+        qv, sv = _merge_token_page(cache.v[layer, pages],
+                                   cache.v_scale[layer, pages],
+                                   v_tok, offs)
+        return dataclasses.replace(
+            cache,
+            k=cache.k.at[layer, pages].set(qk),
+            v=cache.v.at[layer, pages].set(qv),
+            k_scale=cache.k_scale.at[layer, pages].set(sk),
+            v_scale=cache.v_scale.at[layer, pages].set(sv),
+        )
+
     def scatter(pool, tok):
         # advanced indices (pages, offs) separated by the head slice put
         # the batch axis first: target slots (B, Hkv, D)
@@ -246,6 +416,32 @@ def append_paged(cache: PagedKVCache, layer: int, k_tok: jax.Array,
     return dataclasses.replace(
         cache, k=scatter(cache.k, k_tok), v=scatter(cache.v, v_tok)
     )
+
+
+def append_layer_quantized(pool_k_l: jax.Array, pool_v_l: jax.Array,
+                           ksc_l: jax.Array, vsc_l: jax.Array,
+                           block_table: jax.Array, seq_lens: jax.Array,
+                           k_tok: jax.Array, v_tok: jax.Array):
+    """The quantized ragged append on ONE layer's pool slices (the form
+    the decode step's shard_map locals need — :func:`append_paged` works
+    on the stacked cache).  ``pool_*_l``: (P, Hkv, ps, D) int8;
+    ``*sc_l``: (P, Hkv) f32; ``k_tok``/``v_tok``: (B, Hkv, D) the new
+    token per sequence at position ``seq_lens[b]``.  Returns the four
+    updated arrays; same dequant-merge-requant semantics as
+    :func:`append_paged` (one touched page per sequence)."""
+    ps = pool_k_l.shape[2]
+    pos = seq_lens
+    pages = jnp.take_along_axis(
+        block_table, (pos // ps)[:, None], axis=1)[:, 0]
+    offs = pos % ps
+
+    def merge(pool, scale, tok):
+        q, sc = _merge_token_page(pool[pages], scale[pages], tok, offs)
+        return pool.at[pages].set(q), scale.at[pages].set(sc)
+
+    pk, ksc = merge(pool_k_l, ksc_l, k_tok)
+    pv, vsc = merge(pool_v_l, vsc_l, v_tok)
+    return pk, pv, ksc, vsc
 
 
 def write_chunk_paged(cache: PagedKVCache, layer: int, k_new: jax.Array,
@@ -271,6 +467,10 @@ def write_chunk_paged(cache: PagedKVCache, layer: int, k_new: jax.Array,
     npages = cache.k.shape[1]
     pages = jnp.where(pos[None, :] < cache.max_pages * ps, pages, npages)
 
+    if cache.quantized:
+        return _write_chunk_quantized(cache, layer, k_new, v_new,
+                                      jnp.asarray(start, jnp.int32))
+
     def scatter(pool, vals):
         # advanced indices (pages, offs) around the head slice: target
         # slots (B, S, Hkv, D)
@@ -283,7 +483,64 @@ def write_chunk_paged(cache: PagedKVCache, layer: int, k_new: jax.Array,
     )
 
 
-def replace_layer_slices(cache, ks: list, vs: list):
+def _write_chunk_quantized(cache: PagedKVCache, layer: int,
+                           k_new: jax.Array, v_new: jax.Array,
+                           start: jax.Array) -> PagedKVCache:
+    """The quantized body of :func:`write_chunk_paged`: gather the
+    pages the chunk touches (a STATIC count — ceil(S/ps) + 1 covers any
+    alignment of a traced ``start``), dequantize, overlay the chunk's
+    values at their in-page offsets, requantize the merged pages, and
+    scatter pages + scales back.  Out-of-range logical pages redirect to
+    the out-of-pool sentinel so their scatter drops, matching the
+    full-precision path's pad semantics; only the touched pages move —
+    never the pool."""
+    b, hk, s, d = k_new.shape
+    ps = cache.page_size
+    mp = cache.max_pages
+    npages = cache.k.shape[1]
+    npg_t = s // ps + (2 if s % ps else 1)   # worst-case touched pages
+    npg_t = min(npg_t, mp)
+    lo = start // ps                          # first touched logical page
+    logical = lo + jnp.arange(npg_t, dtype=jnp.int32)           # (npg_t,)
+    in_range = logical < mp
+    gather_idx = jnp.clip(logical, 0, mp - 1)
+    pages = jnp.take(cache.block_table, gather_idx, axis=1)     # (B, npg_t)
+    # positions of the chunk rows RELATIVE to the gathered window
+    rel = (start % ps) + jnp.arange(s, dtype=jnp.int32)         # (S,)
+    rel = jnp.where(rel < npg_t * ps, rel, npg_t * ps)  # oob rows -> drop
+    scatter_pages = jnp.where(in_range[None, :], pages, npages)
+
+    # window slots past the chunk's end hold either zero-init or a
+    # recycled page's stale tenant bytes (PagePool.free does not scrub)
+    # — zero them before the absmax so a stale large value cannot
+    # inflate the (page, head) scale; slots BEFORE the chunk are the
+    # sequence's own earlier chunks and stay.  Also keeps the page
+    # bytes a deterministic function of the sequence's content (the
+    # checksum-on-evict restore contract).
+    keep = (jnp.arange(npg_t * ps, dtype=jnp.int32)
+            < (start % ps) + s)[None, None, :, None]
+
+    def merge(pool, scale, vals):
+        old = _dequantize_pages(pool[layer, pages], scale[layer, pages])
+        # (B, npg_t, Hkv, ps, D) -> (B, Hkv, npg_t*ps, D) window view
+        win = old.transpose(0, 2, 1, 3, 4).reshape(b, hk, npg_t * ps, d)
+        win = win.at[:, :, rel, :].set(vals.astype(jnp.float32),
+                                       mode="drop")
+        win = jnp.where(keep, win, 0.0)
+        merged = win.reshape(b, hk, npg_t, ps, d).transpose(0, 2, 1, 3, 4)
+        q, sc = _quantize_pages(merged)
+        return (pool.at[layer, scatter_pages].set(q, mode="drop"),
+                scale.at[layer, scatter_pages].set(sc, mode="drop"))
+
+    k_pool, k_sc = merge(cache.k, cache.k_scale, k_new)
+    v_pool, v_sc = merge(cache.v, cache.v_scale, v_new)
+    return dataclasses.replace(cache, k=k_pool, v=v_pool,
+                               k_scale=k_sc, v_scale=v_sc)
+
+
+def replace_layer_slices(cache, ks: list, vs: list,
+                         ks_scale: list | None = None,
+                         vs_scale: list | None = None):
     """Rebuild the stacked (L, ...) pools from per-layer slices in ONE
     materialization per pool.
 
@@ -302,18 +559,23 @@ def replace_layer_slices(cache, ks: list, vs: list):
         raise ValueError(
             f"need one slice per layer: got {len(ks)}/{len(vs)} for "
             f"{cache.k.shape[0]} layers")
+    kw = {}
+    if ks_scale is not None:
+        kw = dict(k_scale=jnp.stack(ks_scale).astype(jnp.float32),
+                  v_scale=jnp.stack(vs_scale).astype(jnp.float32))
     return dataclasses.replace(
         cache,
         k=jnp.stack(ks).astype(cache.k.dtype),
         v=jnp.stack(vs).astype(cache.v.dtype),
+        **kw,
     )
 
 
 def init_serving_cache(mesh: Mesh, num_layers: int, slots: int,
                        kv_heads: int, max_length: int, head_dim: int,
                        dtype=jnp.bfloat16, axis: str = TP_AXIS, *,
-                       page_size: int = 64, pool_pages: int | None = None
-                       ) -> PagedKVCache:
+                       page_size: int = 64, pool_pages: int | None = None,
+                       kv_dtype=None) -> PagedKVCache:
     """A :class:`PagedKVCache` for the continuous-batching scheduler:
     the physical pool holds ``pool_pages`` pages (the serving KV-page
     BUDGET — decoupled from ``slots * max_pages``, so the scheduler can
@@ -321,7 +583,12 @@ def init_serving_cache(mesh: Mesh, num_layers: int, slots: int,
     block table starts all-zero: page 0 is the scheduler's reserved
     SCRAP page (inactive slots write their garbage token there and read
     it back masked), pages [1, pool_pages) belong to the free list
-    (``serve.budget.PagePool``)."""
+    (``serve.budget.PagePool``).
+
+    ``kv_dtype="int8"`` selects the quantized page layout
+    (:class:`PagedKVCache`): at the same POOL BYTES a budget holds ~2x
+    the pages (:func:`kv_page_bytes`), which the scheduler converts
+    directly into concurrent sequences (``bench.py serve``)."""
     if max_length % page_size:
         raise ValueError(
             f"max_length {max_length} not divisible by page_size {page_size}"
@@ -332,11 +599,16 @@ def init_serving_cache(mesh: Mesh, num_layers: int, slots: int,
     if pool_pages < 2:
         raise ValueError(f"pool_pages {pool_pages} < 2 (page 0 is the "
                          f"reserved scrap page)")
+    pool_dtype, quantized = _resolve_kv_dtype(dtype, kv_dtype)
     pool_shape = (num_layers, pool_pages, kv_heads, page_size, head_dim)
     sharding = NamedSharding(mesh, P(None, None, axis, None, None))
     return PagedKVCache(
-        k=jax.device_put(jnp.zeros(pool_shape, dtype), sharding),
-        v=jax.device_put(jnp.zeros(pool_shape, dtype), sharding),
+        k=jax.device_put(jnp.zeros(pool_shape, pool_dtype), sharding),
+        v=jax.device_put(jnp.zeros(pool_shape, pool_dtype), sharding),
         block_table=jnp.zeros((slots, mp), jnp.int32),
         seq_lens=jnp.zeros((slots,), jnp.int32),
+        k_scale=_init_scales(num_layers, pool_pages, kv_heads, mesh, axis)
+        if quantized else None,
+        v_scale=_init_scales(num_layers, pool_pages, kv_heads, mesh, axis)
+        if quantized else None,
     )

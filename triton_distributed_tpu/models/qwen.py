@@ -60,6 +60,8 @@ from .kv_cache import (
     KVCache,
     PagedKVCache,
     advance,
+    append_layer_quantized,
+    layer_pool,
     replace_layer_slices,
     with_length,
     write_chunk_paged,
@@ -403,9 +405,12 @@ class Qwen3:
             cache = write_chunk_paged(cache, li, k, v, start)
             # prefix attention through the block table: materialize the
             # slot's logical [0, max_len) K/V (chunk included — it was
-            # just written) and mask causally at absolute positions
-            kc = cache.k[li][cache.block_table]     # (B, mp, Hk, ps, D)
-            vc = cache.v[li][cache.block_table]
+            # just written; an int8 cache dequantizes here — the chunk
+            # path trades pool materialization for retrace-freedom, see
+            # kv_cache.layer_pool) and mask causally at absolute positions
+            k_pool_l, v_pool_l = layer_pool(cache, li, x.dtype)
+            kc = k_pool_l[cache.block_table]        # (B, mp, Hk, ps, D)
+            vc = v_pool_l[cache.block_table]
             kc = kc.transpose(0, 2, 1, 3, 4).reshape(
                 b, c.num_kv_heads, max_len, d)
             vc = vc.transpose(0, 2, 1, 3, 4).reshape(
@@ -503,13 +508,20 @@ class Qwen3:
         """Decode step against the paged pool: per-sequence RAGGED
         positions, token append as a pool scatter, attention through the
         block-table kernel (reference ``gqa_fwd_batch_decode`` +
-        ``block_table``, ``flash_decode.py:587-720``)."""
+        ``block_table``, ``flash_decode.py:587-720``).
+
+        On an int8-quantized cache (ISSUE 9) the append goes through the
+        exact dequant-merge-requant scatter
+        (``kv_cache.append_layer_quantized``) and the attention kernel
+        dequantizes in its page-streaming loop (``k_scale``/``v_scale``)
+        — the pool stays int8 end to end."""
         c = self.config
         n = self.tp
         h_loc, hk_loc, d = c.num_heads // n, c.num_kv_heads // n, c.head_dim
         b = x.shape[0]
+        quantized = cache.quantized
 
-        def local(x_rep, wqkv_loc, qn, kn, pool_k_l, pool_v_l, table, lens):
+        def project(x_rep, wqkv_loc, qn, kn, lens):
             qkv = jnp.dot(x_rep, wqkv_loc,
                           preferred_element_type=jnp.float32).astype(x_rep.dtype)
             q, k, v = jnp.split(
@@ -524,6 +536,42 @@ class Qwen3:
             pos = lens[:, None, None]        # (B, 1, 1): per-seq positions
             q = apply_rope_at(q, pos, theta=c.rope_theta)
             k = apply_rope_at(k, pos, theta=c.rope_theta)
+            return q, k, v
+
+        if quantized:
+            def local_q(x_rep, wqkv_loc, qn, kn, pool_k_l, pool_v_l,
+                        ksc_l, vsc_l, table, lens):
+                q, k, v = project(x_rep, wqkv_loc, qn, kn, lens)
+                pk, pv, ksc, vsc = append_layer_quantized(
+                    pool_k_l, pool_v_l, ksc_l, vsc_l, table, lens,
+                    k[:, :, 0], v[:, :, 0])
+                out = paged_decode_attention(
+                    q[:, :, 0], pk, pv, table, lens + 1,
+                    k_scale=ksc, v_scale=vsc,
+                )  # (b, h_loc, d)
+                return out.reshape(b, h_loc * d), pk, pv, ksc, vsc
+
+            out, k_l, v_l, ksc_l, vsc_l = jax.shard_map(
+                local_q, mesh=self.mesh,
+                in_specs=(P(None, None), P(None, self.axis), P(None),
+                          P(None),
+                          P(None, self.axis, None, None),
+                          P(None, self.axis, None, None),
+                          P(None, self.axis), P(None, self.axis),
+                          P(None, None), P(None)),
+                out_specs=(P(None, self.axis),
+                           P(None, self.axis, None, None),
+                           P(None, self.axis, None, None),
+                           P(None, self.axis), P(None, self.axis)),
+                check_vma=False,
+            )(x, p.wqkv, p.q_norm, p.k_norm, cache.k[layer],
+              cache.v[layer], cache.k_scale[layer], cache.v_scale[layer],
+              cache.block_table, cache.seq_lens)
+            return (self._row_parallel_reduce(out, p.wo), k_l, v_l,
+                    ksc_l, vsc_l)
+
+        def local(x_rep, wqkv_loc, qn, kn, pool_k_l, pool_v_l, table, lens):
+            q, k, v = project(x_rep, wqkv_loc, qn, kn, lens)
             # ragged append: each sequence's token into its own page slot
             ps = pool_k_l.shape[2]
             pages = jnp.take_along_axis(
@@ -565,6 +613,41 @@ class Qwen3:
         dispatches plus the ``.at[].set`` pool scatter of
         :meth:`_attn_decode_paged` collapse into a single launch."""
         c = self.config
+
+        if cache.quantized:
+            # megakernel with fused page-stream dequant; the projected
+            # token comes back full-precision and appends through the
+            # exact quantized scatter (see ops.fused_decode)
+            def local_q(x_rep, wqkv_loc, qn, kn, pool_k_l, pool_v_l,
+                        ksc_l, vsc_l, table, lens):
+                out, pk, pv, ktok, vtok = fused_attn_decode(
+                    x_rep, wqkv_loc, qn, kn, pool_k_l, pool_v_l, table,
+                    lens, rope_theta=c.rope_theta,
+                    qk_eps=c.rms_eps if c.qk_norm else None,
+                    k_scale=ksc_l, v_scale=vsc_l,
+                )
+                pk, pv, ksc, vsc = append_layer_quantized(
+                    pk, pv, ksc_l, vsc_l, table, lens, ktok, vtok)
+                return out, pk, pv, ksc, vsc
+
+            out, k_l, v_l, ksc_l, vsc_l = jax.shard_map(
+                local_q, mesh=self.mesh,
+                in_specs=(P(None, None), P(None, self.axis), P(None),
+                          P(None),
+                          P(None, self.axis, None, None),
+                          P(None, self.axis, None, None),
+                          P(None, self.axis), P(None, self.axis),
+                          P(None, None), P(None)),
+                out_specs=(P(None, self.axis),
+                           P(None, self.axis, None, None),
+                           P(None, self.axis, None, None),
+                           P(None, self.axis), P(None, self.axis)),
+                check_vma=False,
+            )(x, p.wqkv, p.q_norm, p.k_norm, cache.k[layer],
+              cache.v[layer], cache.k_scale[layer], cache.v_scale[layer],
+              cache.block_table, cache.seq_lens)
+            return (self._row_parallel_reduce(out, p.wo), k_l, v_l,
+                    ksc_l, vsc_l)
 
         def local(x_rep, wqkv_loc, qn, kn, pool_k_l, pool_v_l, table, lens):
             return fused_attn_decode(
@@ -649,18 +732,24 @@ class Qwen3:
                          else self._attn_decode_paged)
         else:
             attn_step = self._attn_decode
-        ks, vs = [], []
+        ks, vs, ksc, vsc = [], [], [], []
         for li, lp in enumerate(params.layers):
-            attn_out, k_l, v_l = attn_step(
+            res = attn_step(
                 lp.attn, rms_norm(x, lp.ln1, c.rms_eps), cache, li
             )
+            attn_out, k_l, v_l = res[:3]
             ks.append(k_l)
             vs.append(v_l)
+            if len(res) == 5:      # quantized paged cache: scale slices
+                ksc.append(res[3])
+                vsc.append(res[4])
             x = x + attn_out
             x = x + self._mlp_decode_step(
                 lp.mlp, rms_norm(x, lp.ln2, c.rms_eps)
             )
-        cache = replace_layer_slices(cache, ks, vs)
+        cache = replace_layer_slices(cache, ks, vs,
+                                     ks_scale=ksc or None,
+                                     vs_scale=vsc or None)
         x = rms_norm(x, params.final_norm, c.rms_eps)
         logits = jnp.dot(x, params.lm_head,
                          preferred_element_type=jnp.float32)

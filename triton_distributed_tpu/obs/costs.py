@@ -231,6 +231,18 @@ def fused_mlp_ar(b: int, k_in: int, k_loc: int, n_dim: int,
     )
 
 
+def packed_wire_bytes(rows: int, h: int, wire_dtype: str) -> int:
+    """Bytes ``rows`` H-wide rows occupy on a QUANTIZED wire (payload
+    byte per element + the 128-lane scale sidecar per row —
+    ``lang.quant.packed_width``): the accounting the quantized
+    collective entries report to ``comm_wire_bytes`` and ``bench.py
+    wire`` gates against the bf16 baseline (<= 0.55x at serving
+    widths)."""
+    from ..lang import quant
+
+    return rows * quant.packed_width(h, wire_dtype)
+
+
 def all_to_all(rows: int, h: int, num_ranks: int, dtype) -> KernelCost:
     """EP A2A push kernel per device: every local row is read once and
     pushed to its destination zone; peers' rows land in our zones.

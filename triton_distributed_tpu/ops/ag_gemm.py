@@ -280,6 +280,7 @@ def ag_gemm(
     out_dtype=None,
     return_gathered: bool = False,
     bidir: bool | None = None,
+    wire_dtype: str = "bf16",
 ):
     """Overlapped ``AllGather(a) @ b`` (reference host entry ``ag_gemm:534``).
 
@@ -292,6 +293,13 @@ def ag_gemm(
     ``bidir`` selects the two-direction ring (default for n >= 3: both ICI
     directions carry chunks, halving the longest path; at n == 2 the single
     transfer makes the streams identical).
+
+    ``wire_dtype``: "int8"/"fp8" ships the A shards quantized
+    (``comm.quantized.quantized_all_gather`` — producer-packed payload +
+    scale sidecar, consumer dequant) feeding the local GEMM: half the
+    wire bytes against the fused ring's compute overlap, a trade the
+    "auto" setting resolves through the contextual tuner per
+    shape/ranks/wire class.
     """
     out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
     n = mesh.shape[axis]
@@ -308,6 +316,27 @@ def ag_gemm(
     if n == 1:
         c = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
         return (c, a) if return_gathered else c
+
+    if wire_dtype != "bf16":
+        from ..comm import quantized as _q
+        from ..tune.autotuner import is_tracer as _q_is_tracer
+
+        if wire_dtype == "auto":
+            wire_dtype = _q.resolve_wire_dtype(
+                "ag_gemm_wire", (m_tot, k_dim, n_tot, str(a.dtype)),
+                mesh, axis,
+                lambda wd: (lambda: ag_gemm(
+                    a, b, mesh, axis, config=config, out_dtype=out_dtype,
+                    return_gathered=return_gathered, bidir=bidir,
+                    wire_dtype=wd)),
+                tracing=_q_is_tracer(a),
+            )
+        if wire_dtype != "bf16":
+            gathered = _q.quantized_all_gather(
+                a, mesh, axis, wire_dtype=wire_dtype)
+            c = jnp.dot(gathered, b,
+                        preferred_element_type=jnp.float32).astype(out_dtype)
+            return (c, gathered) if return_gathered else c
 
     if config is None:
         # transparent contextual tuning: cached per-shape winner, measured

@@ -50,7 +50,8 @@ def _init_carry(bq: int, d: int):
     )
 
 
-def _tile_update(q, k, v, mask, soft_cap, carry):
+def _tile_update(q, k, v, mask, soft_cap, carry, k_scale=None,
+                 v_scale=None):
     """One online-softmax tile step, shared by every attention kernel here.
 
     ``q``: (bq, d) pre-scaled queries in their STORAGE dtype; ``k``/``v``:
@@ -61,12 +62,25 @@ def _tile_update(q, k, v, mask, soft_cap, carry):
     probability tile is cast back to the storage dtype for the p·V dot
     while (m, l, acc) stay f32.  A fully-masked row keeps p = 0 so it
     contributes a zero denominator instead of silently averaging V.
+
+    ``k_scale``/``v_scale``: scalar f32 dequantization factors of an
+    int8 K/V tile (the quantized KV cache's per-(page, head) scales,
+    ISSUE 9).  The dequant FUSES into the existing math: int8 tiles cast
+    to the q dtype exactly (|q| <= 127 is exact in bf16's 8-bit
+    mantissa), the K scale folds into the score tile as ONE scalar
+    multiply after the MXU dot, and the V scale folds into the p·V
+    accumulation — two scalar ops per tile, no dequantized tile ever
+    materialized in HBM.
     """
     m_prev, l_prev, acc = carry
+    if k_scale is not None:
+        k = k.astype(q.dtype)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # (bq, bk) f32
+    if k_scale is not None:
+        s = s * k_scale
     if soft_cap:
         s = jnp.tanh(s / soft_cap) * soft_cap
     if mask is not None:
@@ -83,9 +97,13 @@ def _tile_update(q, k, v, mask, soft_cap, carry):
         p = jnp.exp(s - m_cur)
     alpha = jnp.exp(m_prev - m_cur)
     l_cur = l_prev * alpha + p.sum(axis=1, keepdims=True)
-    acc = acc * alpha + jax.lax.dot(
-        p.astype(v.dtype), v, preferred_element_type=jnp.float32
-    )
+    if v_scale is not None:
+        pv = jax.lax.dot(p.astype(q.dtype), v.astype(q.dtype),
+                         preferred_element_type=jnp.float32) * v_scale
+    else:
+        pv = jax.lax.dot(p.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32)
+    acc = acc * alpha + pv
     return m_cur, l_cur, acc
 
 
@@ -1080,14 +1098,14 @@ def _paged_decode_kernel(
     page_size: int,
     sm_scale: float,
     soft_cap: float,
-    table_ref,   # (B, max_pages) int32 physical page per logical page [SMEM]
-    lens_ref,    # (B,) int32 per-sequence valid lengths (ragged)      [SMEM]
-    q_ref,    # (1, g, d)         VMEM — one kv-head's query group
-    k_ref,    # (1, page_size, d) VMEM — the gathered physical page
-    v_ref,    # (1, page_size, d)
-    o_ref,    # (1, 1, g, d)   partial numerator
-    m_ref,    # (1, 1, g, 128) f32 running max
-    l_ref,    # (1, 1, g, 128) f32 denominator
+    quantized: bool,
+    *refs,
+    # scalar-prefetch: table (B, max_pages) int32, lens (B,) int32, and
+    # when ``quantized``: kscale/vscale (P*hk,) f32 — per-(page, head)
+    # dequant factors flattened to the pool's row order [SMEM].
+    # then: q (1, g, d) VMEM; k/v (1, page_size, d) VMEM (int8 when
+    # quantized — the gathered physical page streams in storage form);
+    # outputs o (1, 1, g, d), m/l (1, 1, g, 128) f32.
 ):
     """One grid cell = (batch*kv_head, logical page): the split-KV decode
     body (``_decode_kernel``) with the KV slice GATHERED through the block
@@ -1096,7 +1114,19 @@ def _paged_decode_kernel(
     splits (reference paged decode ``flash_decode.py:587-720``:
     ``gqa_fwd_batch_decode`` walking ``block_table``).  Pages at or past a
     sequence's length mask to l = 0 and drop out of the merge, which is how
-    RAGGED per-sequence lengths ride an identical grid."""
+    RAGGED per-sequence lengths ride an identical grid.
+
+    ``quantized``: the int8 KV-cache path (ISSUE 9) — pages stream from
+    HBM in int8 (HALF the cache bandwidth of bf16, the whole point) and
+    the per-(page, head) scale dequantizes INSIDE the tile update (two
+    scalar multiplies; see ``_tile_update``) — no full-precision pool is
+    ever materialized."""
+    if quantized:
+        (table_ref, lens_ref, kscale_ref, vscale_ref,
+         q_ref, k_ref, v_ref, o_ref, m_ref, l_ref) = refs
+    else:
+        table_ref, lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref = refs
+        kscale_ref = vscale_ref = None
     bh, j = pl.program_id(0), pl.program_id(1)
     g, d = q_ref.shape[1], q_ref.shape[2]
     kv_len = lens_ref[bh // hk]
@@ -1104,11 +1134,17 @@ def _paged_decode_kernel(
 
     k = k_ref[0]                                 # (page_size, d)
     v = v_ref[0]
+    ks = vs = None
+    if quantized:
+        srow = table_ref[bh // hk, j] * hk + jax.lax.rem(bh, hk)
+        ks = kscale_ref[srow]
+        vs = vscale_ref[srow]
     kpos = j * page_size + jax.lax.broadcasted_iota(
         jnp.int32, (g, page_size), 1
     )
     m1, l1, acc1 = _tile_update(
-        q, k, v, kpos < kv_len, soft_cap, _init_carry(g, d)
+        q, k, v, kpos < kv_len, soft_cap, _init_carry(g, d),
+        k_scale=ks, v_scale=vs,
     )
     o_ref[0, 0] = acc1.astype(o_ref.dtype)
     m_ref[0, 0] = jnp.broadcast_to(m1, (g, 128))
@@ -1117,19 +1153,23 @@ def _paged_decode_kernel(
 
 @functools.lru_cache(maxsize=None)
 def _build_paged_decode(b, h, hk, num_pages, page_size, max_pages, d,
-                        sm_scale, soft_cap, dtype):
+                        sm_scale, soft_cap, dtype, quantized=False,
+                        pool_dtype=None):
     group = h // hk
     kernel = functools.partial(
-        _paged_decode_kernel, hk, page_size, sm_scale, soft_cap
+        _paged_decode_kernel, hk, page_size, sm_scale, soft_cap, quantized
     )
+    n_prefetch = 4 if quantized else 2
     # pool arrives reshaped (num_pages * hk, page_size, d); the physical row
-    # for grid cell (bh, j) is table[bh // hk, j] * hk + bh % hk
+    # for grid cell (bh, j) is table[bh // hk, j] * hk + bh % hk (the
+    # prefetch tail — scales, when quantized — is unused by index maps)
     kv_spec = pl.BlockSpec(
         (1, page_size, d),
-        lambda bh, j, table, lens: (table[bh // hk, j] * hk + bh % hk, 0, 0),
+        lambda bh, j, table, lens, *_: (
+            table[bh // hk, j] * hk + bh % hk, 0, 0),
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=n_prefetch,
         grid=(b * hk, max_pages),
         in_specs=[
             pl.BlockSpec((1, group, d), lambda bh, j, *_: (bh, 0, 0)),
@@ -1152,10 +1192,11 @@ def _build_paged_decode(b, h, hk, num_pages, page_size, max_pages, d,
             jax.ShapeDtypeStruct((b * hk, max_pages, group, 128), jnp.float32),
             jax.ShapeDtypeStruct((b * hk, max_pages, group, 128), jnp.float32),
         ],
-        # paged decode streams max_pages * page_size rows of cache
+        # paged decode streams max_pages * page_size rows of cache (at
+        # the POOL dtype's bandwidth — int8 halves it)
         cost_estimate=costs.pallas_cost(
             costs.decode_attention(b, h, hk, max_pages * page_size, d,
-                                   dtype)),
+                                   pool_dtype or dtype)),
         compiler_params=compilation.compiler_params(
             collective=False,
             dimension_semantics=("parallel", "arbitrary"),
@@ -1174,6 +1215,8 @@ def paged_decode_attention_state(
     *,
     sm_scale: float | None = None,
     soft_cap: float = 0.0,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
 ):
     """Split-KV decode over a PAGED cache, returning the mergeable state.
 
@@ -1187,6 +1230,11 @@ def paged_decode_attention_state(
     :func:`merge_decode_states`.  Reference:
     ``flash_decode.py:587-720`` (``gqa_fwd_batch_decode*`` with
     ``block_table``), ``sp_flash_decode_layer.py:83-108``.
+
+    ``k_scale``/``v_scale``: (P, Hkv) f32 per-(page, head) scales of an
+    int8-quantized pool (``models.kv_cache`` ``kv_dtype="int8"``) —
+    dequantization fuses into the page-streaming loop (see
+    ``_paged_decode_kernel``); pass both or neither.
     """
     b, h, d = q.shape
     p, hk, page_size, dk = pool_k.shape
@@ -1202,16 +1250,26 @@ def paged_decode_attention_state(
             f"block_table {block_table.shape} / seq_lens {seq_lens.shape} "
             f"inconsistent with B={b}"
         )
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    if quantized and (k_scale.shape != (p, hk) or v_scale.shape != (p, hk)):
+        raise ValueError(
+            f"scales {k_scale.shape}/{v_scale.shape} != (P, Hkv) = "
+            f"({p}, {hk})")
     group = h // hk
     max_pages = block_table.shape[1]
     sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
     fn = _build_paged_decode(
         b, h, hk, p, page_size, max_pages, d, sm_scale, float(soft_cap),
-        jnp.dtype(q.dtype),
+        jnp.dtype(q.dtype), quantized, jnp.dtype(pool_k.dtype),
     )
+    args = [block_table.astype(jnp.int32), seq_lens.astype(jnp.int32)]
+    if quantized:
+        args += [k_scale.reshape(p * hk).astype(jnp.float32),
+                 v_scale.reshape(p * hk).astype(jnp.float32)]
     num, m, l = fn(
-        block_table.astype(jnp.int32),
-        seq_lens.astype(jnp.int32),
+        *args,
         q.reshape(b * hk, group, d),
         pool_k.reshape(p * hk, page_size, d),
         pool_v.reshape(p * hk, page_size, d),
@@ -1235,13 +1293,17 @@ def paged_decode_attention(
     *,
     sm_scale: float | None = None,
     soft_cap: float = 0.0,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Single-token decode attention over a paged cache; returns (B, H, D).
     Golden: :func:`decode_attention` on the contiguously-materialized cache
-    with per-sequence masking."""
+    with per-sequence masking (DEQUANTIZED first for an int8 pool —
+    ``k_scale``/``v_scale`` as in :func:`paged_decode_attention_state`)."""
     num, m, l = paged_decode_attention_state(
         q, pool_k, pool_v, block_table, seq_lens,
         sm_scale=sm_scale, soft_cap=soft_cap,
+        k_scale=k_scale, v_scale=v_scale,
     )
     num, _, l = merge_decode_states(num, m, l)
     return safe_normalize_decode(

@@ -142,12 +142,17 @@ def sp_flash_decode(
 def _build_sp_paged_flash_decode(
     mesh: Mesh,
     axis: str,
-    shapes_key,   # (b, h, hk, ps, mp_loc, d, sm_scale, soft_cap, dtype)
+    shapes_key,   # (b, h, hk, ps, mp_loc, d, sm_scale, soft_cap, dtype,
+                  #  quantized)
 ):
-    b, h, hk, ps, mp_loc, d, sm_scale, soft_cap, dtype = shapes_key
+    (b, h, hk, ps, mp_loc, d, sm_scale, soft_cap, dtype,
+     quantized) = shapes_key
     s_loc = mp_loc * ps
 
-    def local_fn(q, pool_k_loc, pool_v_loc, table_loc, seq_lens):
+    def local_fn(q, pool_k_loc, pool_v_loc, table_loc, seq_lens,
+                 *scales):
+        # ``scales``: (kscale_loc, vscale_loc) on the quantized build
+        # only — the bf16 hot path ships no scale operands at all
         r = jax.lax.axis_index(axis)
         # this rank's pages cover absolute positions [r*s_loc, (r+1)*s_loc);
         # seq_lens is RAGGED per sequence — clip per rank per sequence
@@ -155,6 +160,8 @@ def _build_sp_paged_flash_decode(
         num, m, l = paged_decode_attention_state(
             q, pool_k_loc, pool_v_loc, table_loc[0], len_loc,
             sm_scale=sm_scale, soft_cap=soft_cap,
+            k_scale=scales[0] if quantized else None,
+            v_scale=scales[1] if quantized else None,
         )
         num, m, l = merge_decode_states(num, m, l)     # pages -> one state
         nums = jax.lax.all_gather(num[..., 0, :], axis)
@@ -168,15 +175,17 @@ def _build_sp_paged_flash_decode(
             num[..., 0, :], l[..., 0][..., None], dtype
         )
 
+    in_specs = [
+        P(None, None, None),                  # q replicated
+        P(axis, None, None, None),            # page pool: rank-owned pages
+        P(axis, None, None, None),
+        P(axis, None, None),                  # per-rank local block tables
+        P(None),                              # global ragged lengths
+    ]
+    if quantized:
+        in_specs += [P(axis, None), P(axis, None)]  # (page, head) scales
     return compilation.jit_shard_map(
-        local_fn, mesh,
-        in_specs=(
-            P(None, None, None),              # q replicated
-            P(axis, None, None, None),        # page pool: rank-owned pages
-            P(axis, None, None, None),
-            P(axis, None, None),              # per-rank local block tables
-            P(None),                          # global ragged lengths
-        ),
+        local_fn, mesh, in_specs=tuple(in_specs),
         out_specs=P(None, None, None),
     )
 
@@ -192,10 +201,17 @@ def sp_paged_flash_decode(
     *,
     sm_scale: float | None = None,
     soft_cap: float = 0.0,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Decode attention over a sequence-sharded PAGED cache (the reference's
     production decode layer: ``sp_flash_decode_layer.py:83-108`` threads
     ``block_table`` into ``gqa_fwd_batch_decode``).
+
+    ``k_scale``/``v_scale``: (P_total, Hkv) f32 per-(page, head) scales
+    of an int8 pool — the fused-dequant path of the quantized KV cache;
+    the cross-rank state merge is unchanged (softmax states are f32
+    regardless of the pool dtype).
 
     Each rank owns a page pool holding its slice of the sequence axis and a
     LOCAL block table; the cross-rank softmax-state merge is identical to
@@ -222,6 +238,7 @@ def sp_paged_flash_decode(
         return paged_decode_attention(
             q, pool_k, pool_v, table, seq_lens,
             sm_scale=sm_scale, soft_cap=soft_cap,
+            k_scale=k_scale, v_scale=v_scale,
         )
     if block_table.shape[0] != n or block_table.shape[1] != b:
         raise ValueError(
@@ -232,10 +249,16 @@ def sp_paged_flash_decode(
         raise ValueError(f"page pool {p_tot} not divisible by {axis}={n}")
     mp_loc = block_table.shape[2]
     sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale or neither")
     fn = _build_sp_paged_flash_decode(
         mesh, axis,
         (b, h, hk, ps, mp_loc, d, sm_scale, float(soft_cap),
-         jnp.dtype(q.dtype)),
+         jnp.dtype(q.dtype), quantized),
     )
-    return fn(q, pool_k, pool_v, block_table.astype(jnp.int32),
-              seq_lens.astype(jnp.int32))
+    args = [q, pool_k, pool_v, block_table.astype(jnp.int32),
+            seq_lens.astype(jnp.int32)]
+    if quantized:
+        args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+    return fn(*args)

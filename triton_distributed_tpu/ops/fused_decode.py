@@ -120,25 +120,44 @@ def _fused_attn_kernel(
     qk_eps,        # float | None — qk-norm epsilon (None = no norm)
     sm_scale: float,
     soft_cap: float,
+    quantized: bool,
     *refs,
     # inputs: table (B*mp,) SMEM (flattened row-major); lens (B,) SMEM;
-    # x (1, K) blocked per
+    # [kscale/vscale (rows,) f32 SMEM when quantized — per-(page, head)
+    # dequant factors in pool-row order]; x (1, K) blocked per
     # batch row; wq (K, g*d) / wk (K, d) / wv (K, d) blocked per kv head
     # (three column views of the SAME wqkv array); [qn (1, d), kn (1, d)
     # when qk_eps]; pool_k/pool_v (rows, ps, d) ANY (aliased outputs).
-    # outputs: out (1, 1, g, d) blocked; pool_k/pool_v aliased ANY.
+    # outputs: out (1, 1, g, d) blocked; pool_k/pool_v aliased ANY;
+    # [ktok_out/vtok_out (1, 1, d) blocked when quantized — the
+    # projected token per (head, sequence), appended by the caller's
+    # exact quantized scatter].
     # scratch: kbuf/vbuf (2, ps, d); ktok/vtok (1, d) pool-dtype;
     # pg_sems DMA (2, 2); tok_sems DMA (2,)
 ):
-    if qk_eps is not None:
-        (table_ref, lens_ref, x_ref, wq_ref, wk_ref, wv_ref, qn_ref,
-         kn_ref, _pk_in, _pv_in, out_ref, pool_k, pool_v,
-         kbuf, vbuf, ktok, vtok, pg_sems, tok_sems) = refs
+    refs = list(refs)
+    table_ref, lens_ref = refs[:2]
+    del refs[:2]
+    if quantized:
+        kscale_ref, vscale_ref = refs[:2]
+        del refs[:2]
     else:
-        (table_ref, lens_ref, x_ref, wq_ref, wk_ref, wv_ref,
-         _pk_in, _pv_in, out_ref, pool_k, pool_v,
-         kbuf, vbuf, ktok, vtok, pg_sems, tok_sems) = refs
+        kscale_ref = vscale_ref = None
+    x_ref, wq_ref, wk_ref, wv_ref = refs[:4]
+    del refs[:4]
+    if qk_eps is not None:
+        qn_ref, kn_ref = refs[:2]
+        del refs[:2]
+    else:
         qn_ref = kn_ref = None
+    _pk_in, _pv_in, out_ref, pool_k, pool_v = refs[:5]
+    del refs[:5]
+    if quantized:
+        ktok_out, vtok_out = refs[:2]
+        del refs[:2]
+    else:
+        ktok_out = vtok_out = None
+    kbuf, vbuf, ktok, vtok, pg_sems, tok_sems = refs
     h_i = pl.program_id(0)          # local kv head (outer: weight blocks
     b_i = pl.program_id(1)          # stay resident across the batch loop)
     pos = lens_ref[b_i]
@@ -158,27 +177,42 @@ def _fused_attn_kernel(
     q = _rope1(q, pos, theta)
     k_new = _rope1(k_new, pos, theta)
 
-    # --- ragged append: DMA the token into its page slot in place -------
-    # (the pool is ALIASED in/out, so only this (1, d) slot moves — the
-    # per-kernel path's XLA scatter rewrites pool rows instead).  The
-    # write is drained before the page reads below so the read DMAs can
-    # never race it; the slot itself is masked out of the attention
-    # (kpos < pos), matching append-then-attend-at-pos+1 numerics.
-    pg = jnp.minimum(pos // ps, mp - 1)   # clamped like the jit scatter
-    row = table_ref[b_i * mp + pg] * hk + h_i
-    off = pos % ps
-    ktok[...] = k_new.astype(ktok.dtype)
-    vtok[...] = v_new.astype(vtok.dtype)
-    wk_copy = pltpu.make_async_copy(
-        ktok, pool_k.at[row, pl.ds(off, 1)], tok_sems.at[0])
-    wv_copy = pltpu.make_async_copy(
-        vtok, pool_v.at[row, pl.ds(off, 1)], tok_sems.at[1])
-    wk_copy.start()
-    wv_copy.start()
-    wk_copy.wait()
-    wv_copy.wait()
+    if quantized:
+        # int8 pool: the kernel cannot grow the target page's (page,
+        # head) scale in place without re-encoding its residents, so the
+        # token travels OUT full-precision and the caller's exact
+        # dequant-merge-requant scatter appends it (one page per
+        # sequence; ``kv_cache.append_layer_quantized``).  THIS step's
+        # attention still folds the token from registers below —
+        # numerics identical to append-then-attend.
+        ktok_out[0] = k_new
+        vtok_out[0] = v_new
+    else:
+        # --- ragged append: DMA the token into its page slot in place ---
+        # (the pool is ALIASED in/out, so only this (1, d) slot moves —
+        # the per-kernel path's XLA scatter rewrites pool rows instead).
+        # The write is drained before the page reads below so the read
+        # DMAs can never race it; the slot itself is masked out of the
+        # attention (kpos < pos), matching append-then-attend-at-pos+1
+        # numerics.
+        pg = jnp.minimum(pos // ps, mp - 1)   # clamped like the jit scatter
+        row = table_ref[b_i * mp + pg] * hk + h_i
+        off = pos % ps
+        ktok[...] = k_new.astype(ktok.dtype)
+        vtok[...] = v_new.astype(vtok.dtype)
+        wk_copy = pltpu.make_async_copy(
+            ktok, pool_k.at[row, pl.ds(off, 1)], tok_sems.at[0])
+        wv_copy = pltpu.make_async_copy(
+            vtok, pool_v.at[row, pl.ds(off, 1)], tok_sems.at[1])
+        wk_copy.start()
+        wv_copy.start()
+        wk_copy.wait()
+        wv_copy.wait()
 
     # --- block-table flash decode over the cached prefix [0, pos) -------
+    # (int8 pages stream at HALF the HBM bytes; their per-(page, head)
+    # scale dequantizes inside the tile update — two scalar multiplies,
+    # no full-precision pool ever materialized)
     q_s = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
     npages = jnp.minimum((pos + ps - 1) // ps, mp)
 
@@ -209,8 +243,14 @@ def _fused_attn_kernel(
         cv.wait()
         k_t = kbuf[j % 2]
         v_t = vbuf[j % 2]
+        ks = vs = None
+        if quantized:
+            r_j = table_ref[b_i * mp + j] * hk + h_i
+            ks = kscale_ref[r_j]
+            vs = vscale_ref[r_j]
         kpos = j * ps + jax.lax.broadcasted_iota(jnp.int32, (g, ps), 1)
-        return _tile_update(q_s, k_t, v_t, kpos < pos, soft_cap, carry)
+        return _tile_update(q_s, k_t, v_t, kpos < pos, soft_cap, carry,
+                            k_scale=ks, v_scale=vs)
 
     carry = jax.lax.fori_loop(0, npages, body, _init_carry(g, d))
 
@@ -226,10 +266,11 @@ def _fused_attn_kernel(
 
 @functools.lru_cache(maxsize=None)
 def _build_fused_attn(b, k_dim, hk, g, d, pool_rows, ps, mp, theta, qk_eps,
-                      sm_scale, soft_cap, dtype, pool_dtype, cfg):
+                      sm_scale, soft_cap, dtype, pool_dtype, cfg,
+                      quantized=False):
     kernel = functools.partial(
         _fused_attn_kernel, hk, g, d, ps, mp, theta, qk_eps, sm_scale,
-        soft_cap,
+        soft_cap, quantized,
     )
     # three column views of the ONE (K, qkv_cols) wqkv array: q columns
     # [h*g*d, (h+1)*g*d), k at (h_loc + h)*d, v at (h_loc + hk + h)*d —
@@ -239,6 +280,13 @@ def _build_fused_attn(b, k_dim, hk, g, d, pool_rows, ps, mp, theta, qk_eps,
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),            # table
         pl.BlockSpec(memory_space=pltpu.SMEM),            # lens
+    ]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec(memory_space=pltpu.SMEM),        # k_scale rows
+            pl.BlockSpec(memory_space=pltpu.SMEM),        # v_scale rows
+        ]
+    in_specs += [
         pl.BlockSpec((1, k_dim), lambda h, bi: (bi, 0)),  # x row
         pl.BlockSpec((k_dim, g * d), lambda h, bi: (0, h)),
         pl.BlockSpec((k_dim, d), lambda h, bi: (0, h_loc + h)),
@@ -252,24 +300,34 @@ def _build_fused_attn(b, k_dim, hk, g, d, pool_rows, ps, mp, theta, qk_eps,
     pool_spec = pl.BlockSpec(memory_space=pl.ANY)
     in_specs += [pool_spec, pool_spec]
     n_in = len(in_specs)
+    out_specs = [
+        pl.BlockSpec((1, 1, g, d), lambda h, bi: (h, bi, 0, 0)),
+        pool_spec,
+        pool_spec,
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((hk, b, g, d), dtype),
+        jax.ShapeDtypeStruct((pool_rows, ps, d), pool_dtype),
+        jax.ShapeDtypeStruct((pool_rows, ps, d), pool_dtype),
+    ]
+    if quantized:
+        # the projected token per (head, sequence) — the caller appends
+        # it through the exact quantized scatter (see kernel docstring)
+        tok_spec = pl.BlockSpec((1, 1, d), lambda h, bi: (h, bi, 0))
+        out_specs += [tok_spec, tok_spec]
+        out_shape += [jax.ShapeDtypeStruct((hk, b, d), dtype),
+                      jax.ShapeDtypeStruct((hk, b, d), dtype)]
     from ..obs import costs
 
     call = pl.pallas_call(
         kernel,
         grid=(hk, b),
         in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, 1, g, d), lambda h, bi: (h, bi, 0, 0)),
-            pool_spec,
-            pool_spec,
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((hk, b, g, d), dtype),
-            jax.ShapeDtypeStruct((pool_rows, ps, d), pool_dtype),
-            jax.ShapeDtypeStruct((pool_rows, ps, d), pool_dtype),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         # the pool travels in place: the token append touches one (1, d)
         # slot of the aliased buffer instead of rewriting the pool
+        # (quantized: the aliased pools pass through untouched)
         input_output_aliases={n_in - 2: 1, n_in - 1: 2},
         scratch_shapes=[
             pltpu.VMEM((2, ps, d), pool_dtype),
@@ -307,6 +365,8 @@ def fused_attn_decode(
     sm_scale: float | None = None,
     soft_cap: float = 0.0,
     config: FusedAttnConfig | None = None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
 ):
     """One layer's fused attention-side decode step (LOCAL per rank — call
     inside the TP ``shard_map`` like ``paged_decode_attention``).
@@ -320,6 +380,16 @@ def fused_attn_decode(
     position.  Golden: the per-kernel chain in
     ``Qwen3._attn_decode_paged`` (qkv → norm → rope → ``append_paged``
     scatter → ``paged_decode_attention``).
+
+    **Quantized pools** (``k_scale``/``v_scale`` (P, Hkv) f32, int8
+    pools): pages stream with dequantization fused into the flash loop
+    (half the cache bandwidth), the in-kernel append is SKIPPED (the
+    kernel cannot re-encode a page whose scale grows), and the return
+    becomes ``(out, pool_k, pool_v, k_tok, v_tok)`` with the projected
+    token (B, Hkv, D) full-precision — append it with
+    ``kv_cache.append_layer_quantized`` after the kernel (one page per
+    sequence; this step's attention already folded the token from
+    registers, so numerics match append-then-attend).
     """
     b, k_dim = x.shape
     p, hk, ps, d = pool_k.shape
@@ -342,6 +412,9 @@ def fused_attn_decode(
             f"inconsistent with B={b}")
     sm_scale = float(sm_scale) if sm_scale is not None else d ** -0.5
     eps = None if qk_eps is None else float(qk_eps)
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale or neither")
     if config is None:
         from ..tune import autotuner as _tune
 
@@ -351,7 +424,8 @@ def fused_attn_decode(
             return lambda: fused_attn_decode(
                 x, wqkv, q_norm, k_norm, pool_k, pool_v, block_table,
                 seq_lens, rope_theta=rope_theta, qk_eps=qk_eps,
-                sm_scale=sm_scale, soft_cap=soft_cap, config=c)
+                sm_scale=sm_scale, soft_cap=soft_cap, config=c,
+                k_scale=k_scale, v_scale=v_scale)
 
         config = _tune.resolve_config(
             "fused_attn_decode",
@@ -363,11 +437,16 @@ def fused_attn_decode(
     fn = _build_fused_attn(
         b, k_dim, hk, h_loc // hk, d, p * hk, ps, mp, float(rope_theta),
         eps, sm_scale, float(soft_cap), jnp.dtype(x.dtype),
-        jnp.dtype(pool_k.dtype), config,
+        jnp.dtype(pool_k.dtype), config, quantized,
     )
     args = [
         block_table.astype(jnp.int32).reshape(b * mp),
         seq_lens.astype(jnp.int32),
+    ]
+    if quantized:
+        args += [k_scale.reshape(p * hk).astype(jnp.float32),
+                 v_scale.reshape(p * hk).astype(jnp.float32)]
+    args += [
         x,
         wqkv, wqkv, wqkv,
     ]
@@ -377,6 +456,11 @@ def fused_attn_decode(
         pool_k.reshape(p * hk, ps, d),
         pool_v.reshape(p * hk, ps, d),
     ]
+    if quantized:
+        out, pk, pv, ktok, vtok = fn(*args)
+        out = out.transpose(1, 0, 2, 3).reshape(b, h_loc * d)
+        return (out, pk.reshape(p, hk, ps, d), pv.reshape(p, hk, ps, d),
+                ktok.transpose(1, 0, 2), vtok.transpose(1, 0, 2))
     out, pk, pv = fn(*args)
     out = out.transpose(1, 0, 2, 3).reshape(b, h_loc * d)
     return out, pk.reshape(p, hk, ps, d), pv.reshape(p, hk, ps, d)
@@ -634,6 +718,28 @@ def _mlp_act_host(x: jax.Array, gate_up: jax.Array, n: int,
     return jnp.concatenate(acts, axis=1)
 
 
+@functools.lru_cache(maxsize=None)
+def _build_mlp_partials(mesh: Mesh, axis: str, b: int, k_in: int,
+                        f_loc: int, n_dim: int, dtype, out_dtype):
+    """Per-rank SwiGLU-MLP down-proj partials, stacked (n*B, N): the
+    producer half of the quantized-wire composition (the consumer is
+    ``comm.quantized.quantized_all_reduce``)."""
+    from jax.sharding import PartitionSpec as P
+
+    def local(x_rep, gu_loc, dn_loc):
+        fused = jnp.dot(x_rep, gu_loc,
+                        preferred_element_type=jnp.float32).astype(x_rep.dtype)
+        wg, w1 = jnp.split(fused, 2, axis=-1)
+        act = jax.nn.silu(wg) * w1
+        return jnp.dot(act, dn_loc,
+                       preferred_element_type=jnp.float32).astype(out_dtype)
+
+    return compilation.jit_shard_map(
+        local, mesh,
+        in_specs=(P(None, None), P(None, axis), P(axis, None)),
+        out_specs=P(axis, None))
+
+
 def fused_mlp_ar(
     x: jax.Array,
     gate_up: jax.Array,
@@ -643,6 +749,7 @@ def fused_mlp_ar(
     *,
     config: FusedMlpConfig | None = None,
     out_dtype=None,
+    wire_dtype: str = "bf16",
 ) -> jax.Array:
     """Fused decode-MLP block: ``AllReduce(swiglu(x @ gate_up) @ down)``
     in ONE semaphore-chained kernel per rank.
@@ -654,6 +761,14 @@ def fused_mlp_ar(
     column chunking); B is unconstrained — the ring chunks columns, not
     rows (cf. ``ops.gemm_ar``).  Golden: ``Qwen3._mlp_decode``'s psum
     path.
+
+    ``wire_dtype``: "int8"/"fp8" keeps the MLP local and reduces the
+    down-proj partial through the quantized two-hop exchange
+    (``comm.quantized`` — half the reduction's wire bytes, traded
+    against this kernel's semaphore-chained overlap; "auto" lets the
+    contextual tuner decide per shape/ranks/wire class).  Needs
+    ``B % tp == 0`` (the exchange chunks rows) — other shapes keep the
+    bf16 kernel.
     """
     out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(x.dtype)
     n = mesh.shape[axis]
@@ -674,6 +789,26 @@ def fused_mlp_ar(
     if f_dim % n or n_dim % n:
         raise ValueError(
             f"F={f_dim} and N={n_dim} must be divisible by {axis}={n}")
+    if wire_dtype != "bf16" and b % n == 0:
+        from ..comm import quantized as _q
+        from ..tune.autotuner import is_tracer as _q_is_tracer
+
+        if wire_dtype == "auto":
+            wire_dtype = _q.resolve_wire_dtype(
+                "fused_mlp_ar_wire", (b, k_in, f_dim, n_dim, str(x.dtype)),
+                mesh, axis,
+                lambda wd: (lambda: fused_mlp_ar(
+                    x, gate_up, down, mesh, axis, config=config,
+                    out_dtype=out_dtype, wire_dtype=wd)),
+                tracing=_q_is_tracer(x),
+            )
+        if wire_dtype != "bf16":
+            parts = _build_mlp_partials(
+                mesh, axis, b, k_in, f_dim // n, n_dim,
+                jnp.dtype(x.dtype), out_dtype)(x, gate_up, down)
+            return _q.quantized_all_reduce(
+                parts, mesh, axis, wire_dtype=wire_dtype,
+                out_dtype=out_dtype)
     k_loc = f_dim // n
 
     def run(cfg):

@@ -240,6 +240,7 @@ def gemm_ar(
     *,
     config: GemmArConfig | None = None,
     out_dtype=None,
+    wire_dtype: str = "bf16",
 ) -> jax.Array:
     """Overlapped ``AllReduce(a @ b)`` (reference: ``tp_mlp.py:177`` GEMM+AR
     dispatch; ``kernels/nvidia/allreduce.py:695-780``).
@@ -247,6 +248,11 @@ def gemm_ar(
     ``a``: (M, K) sharded on dim 1 over ``axis`` (activations, K-parallel).
     ``b``: (K, N) sharded on dim 0 over ``axis`` (row-parallel weight).
     Returns (M, N) replicated on every rank: the full sum.
+
+    ``wire_dtype``: "int8"/"fp8" reduces the local partial through the
+    quantized two-hop exchange (``comm.quantized`` — both hops packed;
+    the error-feedback option lives on ``quantized_all_reduce``); "auto"
+    resolves through the contextual tuner per shape/ranks/wire class.
     """
     out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
     n = mesh.shape[axis]
@@ -261,6 +267,24 @@ def gemm_ar(
         raise ValueError(
             f"M={m_tot} and K={k_dim} must be divisible by {axis}={n}"
         )
+    if wire_dtype != "bf16":
+        from ..comm import quantized as _q
+        from ..tune.autotuner import is_tracer as _q_is_tracer
+
+        if wire_dtype == "auto":
+            wire_dtype = _q.resolve_wire_dtype(
+                "gemm_ar_wire", (m_tot, k_dim, n_dim, str(a.dtype)),
+                mesh, axis,
+                lambda wd: (lambda: gemm_ar(
+                    a, b, mesh, axis, config=config, out_dtype=out_dtype,
+                    wire_dtype=wd)),
+                tracing=_q_is_tracer(a),
+            )
+        if wire_dtype != "bf16":
+            parts = _q.stacked_partial_gemm(a, b, mesh, axis, out_dtype)
+            return _q.quantized_all_reduce(
+                parts, mesh, axis, wire_dtype=wire_dtype,
+                out_dtype=out_dtype)
 
     if config is None:
         # transparent contextual tuning (see ops/ag_gemm.py)

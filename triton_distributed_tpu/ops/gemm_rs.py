@@ -243,6 +243,7 @@ def gemm_rs(
     *,
     config: GemmRsConfig | None = None,
     out_dtype=None,
+    wire_dtype: str = "bf16",
 ) -> jax.Array:
     """Overlapped ``ReduceScatter(a @ b)`` (reference host entry
     ``gemm_rs:576``).
@@ -250,6 +251,12 @@ def gemm_rs(
     ``a``: (M, K) sharded on dim 1 over ``axis`` (activations, K-parallel).
     ``b``: (K, N) sharded on dim 0 over ``axis`` (row-parallel weight).
     Returns (M, N) sharded on dim 0: the reduced sum, row-chunk r on rank r.
+
+    ``wire_dtype``: "int8"/"fp8" computes the local partial and reduces
+    it through the quantized exchange (``comm.quantized`` — packed
+    payload + scale sidecar, f32 consumer reduce) at half the wire
+    bytes; "auto" lets the contextual tuner pick per shape/ranks/wire
+    class.
     """
     out_dtype = jnp.dtype(out_dtype) if out_dtype else jnp.dtype(a.dtype)
     n = mesh.shape[axis]
@@ -264,6 +271,24 @@ def gemm_rs(
         raise ValueError(
             f"M={m_tot} and K={k_dim} must be divisible by {axis}={n}"
         )
+    if wire_dtype != "bf16":
+        from ..comm import quantized as _q
+        from ..tune.autotuner import is_tracer as _q_is_tracer
+
+        if wire_dtype == "auto":
+            wire_dtype = _q.resolve_wire_dtype(
+                "gemm_rs_wire", (m_tot, k_dim, n_dim, str(a.dtype)),
+                mesh, axis,
+                lambda wd: (lambda: gemm_rs(
+                    a, b, mesh, axis, config=config, out_dtype=out_dtype,
+                    wire_dtype=wd)),
+                tracing=_q_is_tracer(a),
+            )
+        if wire_dtype != "bf16":
+            parts = _q.stacked_partial_gemm(a, b, mesh, axis, out_dtype)
+            return _q.quantized_reduce_scatter(
+                parts, mesh, axis, wire_dtype=wire_dtype,
+                out_dtype=out_dtype)
 
     if config is None:
         # transparent contextual tuning (see ops/ag_gemm.py)

@@ -20,33 +20,25 @@ import jax
 import jax.numpy as jnp
 
 
-# e4m3 quantization recipe, shared by this XLA path and the fused
-# Pallas pack kernel (layers/moe.py) — the two wire producers must stay
-# provably identical, so the constants live in exactly one place
-E4M3_MAX = 448.0     # largest finite float8_e4m3fn value
-SCALE_EPS = 1e-12    # keeps all-zero rows at a finite scale (0/0 -> 0)
+# The e4m3 quantization machinery this module pioneered now lives in the
+# SHARED quant module (``lang.quant``, ISSUE 9) — one home for every
+# wire producer (quantized collectives, MoE EP wire, int8 KV cache).
+# The names below stay as thin aliases so existing callers keep working.
+from ..lang.quant import E4M3_MAX, SCALE_EPS  # noqa: F401 (re-export)
+from ..lang import quant as _quant
 
 
 def quantize_e4m3(x: jax.Array, *, axis: int = -1):
     """Per-row fp8 quantization for the low-latency A2A payload
     (reference: the fp8 + scale-sidecar configuration of
     ``low_latency_all_to_all.py:36-120``, its headline 137 us case).
-
-    Returns ``(x8, scale)``: ``x8 = x / scale`` in ``float8_e4m3fn`` and
-    ``scale`` f32 with the reduced ``axis`` kept at size 1, chosen so the
-    row's absmax maps to the e4m3 max (448).  Dispatch ``x8`` and
-    ``scale`` through the same A2A (the scale rides as a feature column)
-    and :func:`dequantize` on arrival.
-    """
-    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
-                     keepdims=True)
-    scale = absmax / E4M3_MAX + SCALE_EPS
-    return (x.astype(jnp.float32) / scale).astype(jnp.float8_e4m3fn), scale
+    Alias of ``lang.quant.quantize_rows(x, "fp8")`` — see there."""
+    return _quant.quantize_rows(x, "fp8", axis=axis)
 
 
 def dequantize(x8: jax.Array, scale: jax.Array, dtype=jnp.bfloat16):
-    """Inverse of :func:`quantize_e4m3`."""
-    return (x8.astype(jnp.float32) * scale).astype(dtype)
+    """Inverse of :func:`quantize_e4m3` (``lang.quant.dequantize_rows``)."""
+    return _quant.dequantize_rows(x8, scale, dtype)
 
 
 def topk_route(logits: jax.Array, k: int, *, renormalize: bool = True):

@@ -57,6 +57,7 @@ from .faults import (
 from .matrix import (
     run_integrity_cells,
     run_matrix,
+    run_quant_cells,
     run_scheduler_matrix,
     verify_matrix,
     verify_scheduler_matrix,
@@ -86,7 +87,8 @@ __all__ = [
     "guarded", "health_snapshot", "integrity", "matrix", "policy",
     "protocol_pending",
     "record_faulty_case", "reset_breaker", "resilient_call", "run_bounded",
-    "run_integrity_cells", "run_matrix", "run_scheduler_matrix",
+    "run_integrity_cells", "run_matrix", "run_quant_cells",
+    "run_scheduler_matrix",
     "sample_spec", "scoped",
     "simulate", "suppress", "suppressed_thunk", "verify_matrix",
     "verify_scheduler_matrix", "watchdog",

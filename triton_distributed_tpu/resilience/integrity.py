@@ -158,9 +158,16 @@ def fold32(*arrays) -> int:
 
 def fold_page(cache, page: int) -> int:
     """Stamp one physical KV page: the fold over its k and v slices
-    across every layer (the unit the serve-loop audit verifies)."""
+    across every layer (the unit the serve-loop audit verifies).  A
+    QUANTIZED cache's scale sidecars fold in too — a flipped scale byte
+    corrupts every element of its (page, head) block on dequant, so the
+    stamp must cover it (the poisoned-scale-sidecar fault cell)."""
     p = int(page)
-    return fold32(np.asarray(cache.k[:, p]), np.asarray(cache.v[:, p]))
+    parts = [np.asarray(cache.k[:, p]), np.asarray(cache.v[:, p])]
+    if getattr(cache, "k_scale", None) is not None:
+        parts += [np.asarray(cache.k_scale[:, p]),
+                  np.asarray(cache.v_scale[:, p])]
+    return fold32(*parts)
 
 
 def fold_pages(cache, pages) -> dict[int, int]:
@@ -174,6 +181,11 @@ def fold_pages(cache, pages) -> dict[int, int]:
         return {}
     k = np.asarray(cache.k[:, ids])
     v = np.asarray(cache.v[:, ids])
+    if getattr(cache, "k_scale", None) is not None:
+        ks = np.asarray(cache.k_scale[:, ids])
+        vs = np.asarray(cache.v_scale[:, ids])
+        return {p: fold32(k[:, i], v[:, i], ks[:, i], vs[:, i])
+                for i, p in enumerate(ids)}
     return {p: fold32(k[:, i], v[:, i]) for i, p in enumerate(ids)}
 
 
@@ -388,6 +400,51 @@ def verify_reduce(op: str, x, out, n: int) -> CorruptionDiagnosis | None:
     rtol = max(_RTOL, 2.0 * max(n - 1, 1) * eps)
     return _verify_float(op, np.asarray(oa), np.asarray(want),
                          lambda idx: f"out[{idx[0]}]", mag=mag, rtol=rtol)
+
+
+def verify_reduce_q(op: str, x, out, n: int, wire_dtype: str, *,
+                    residual=None,
+                    two_hop: bool = False) -> CorruptionDiagnosis | None:
+    """The quantized analogue of :func:`verify_reduce`: the golden is
+    the CODEC-AWARE reduction (``lang.quant.reduce_roundtrip`` — each
+    chunk partial round-trips through the wire codec, then an f32 sum;
+    ``two_hop`` adds the AR return hop's second round-trip, and
+    ``residual`` folds an error-feedback carry into the inputs), so the
+    tolerance stays TIGHT — the codec's own error is in the golden, not
+    the error budget, and a flipped payload or scale-sidecar byte lands
+    far outside it."""
+    import jax.numpy as jnp
+
+    from ..lang import quant
+
+    xa = np.asarray(x).astype(np.float32)
+    oa = np.asarray(out)
+    m = xa.shape[0] // n            # per-rank partial rows
+    m_loc = m // n                  # chunk rows
+    r = xa.shape[1]
+    chunks = xa.reshape(n, n, m_loc, r)      # [rank, chunk, rows, r]
+    if residual is not None:
+        chunks = chunks + np.asarray(residual, np.float32).reshape(
+            n, n, m_loc, r)
+    rt = np.asarray(quant.roundtrip_rows(
+        jnp.asarray(chunks), wire_dtype, out_dtype=jnp.float32))
+    want = rt.sum(axis=0)                    # [chunk, rows, r]
+    if two_hop:
+        # the device casts the reduced chunk to the OUT dtype before
+        # re-packing it (``red.astype(out_dtype)`` in ``_build_q_ar``);
+        # requantizing from uncast f32 can land one codec ulp away
+        # wherever the cast crosses a rounding boundary — a false
+        # PayloadCorruption, so the golden must take the same cast
+        want = np.asarray(quant.roundtrip_rows(
+            jnp.asarray(want).astype(oa.dtype), wire_dtype,
+            out_dtype=jnp.float32))
+    want = want.reshape(n * m_loc, r).astype(oa.dtype)
+    # accumulated-magnitude bound, like verify_reduce; floor the rtol at
+    # one output-dtype ulp class (the device reduce may reorder)
+    mag = np.abs(rt).sum(axis=0).reshape(n * m_loc, r)
+    return _verify_float(op, np.asarray(oa), want,
+                         lambda idx: f"out[{idx[0]}]", mag=mag,
+                         rtol=_RTOL)
 
 
 def verify_gemm(op: str, a, b, out) -> CorruptionDiagnosis | None:
@@ -607,6 +664,106 @@ def checked(op: str, thunk, verify, *, ranks: int | None = None):
         raise PayloadCorruption(op, diag)
 
     return run
+
+
+# ---------------------------------------------------------------------------
+# quantized-wire selftest battery (scripts/tdt_lint.py --quant)
+
+
+def run_quant_selftest() -> list[str]:
+    """The codec-integrity battery behind ``tdt_lint --quant``: every
+    wire codec round-trips inside its documented error envelope
+    (including the all-negative / denormal / absmax-zero edge rows), the
+    quantized-reduce verifier passes clean and catches a perturbation,
+    and a POISONED SCALE SIDECAR — the quantized wire's new failure
+    surface: 4 bytes that corrupt a whole row on dequant — is (a) caught
+    byte-exactly by the wire checksum and (b) catastrophic enough that
+    the dequant-parity tolerance could never absorb it.  Returns
+    problems (empty = pass)."""
+    import jax.numpy as jnp
+
+    from ..lang import quant
+
+    problems: list[str] = []
+    rng = np.random.default_rng(11)
+    h = 64
+    rows = np.stack([
+        rng.standard_normal(h) * 3.0,            # generic
+        -np.abs(rng.standard_normal(h)) - 0.5,   # all-negative
+        rng.standard_normal(h) * 1e-30,          # denormal-range values
+        np.zeros(h),                             # absmax-zero row
+    ]).astype(np.float32)
+    for wd in quant.QUANTIZED_WIRE_DTYPES:
+        x = jnp.asarray(rows)
+        back = np.asarray(quant.roundtrip_rows(x, wd,
+                                               out_dtype=jnp.float32))
+        bound = quant.rel_error_bound(wd)
+        absmax = np.abs(rows).max(axis=-1, keepdims=True)
+        err = np.abs(back - rows)
+        tol = np.asarray(quant.abs_error_bound(absmax, wd)) * (1 + 1e-5)
+        if (err > tol).any():
+            problems.append(
+                f"{wd}: round-trip error {err.max():.3g} outside the "
+                f"documented envelope (bound {bound})")
+        # the packed wire message round-trips equivalently
+        packed = np.asarray(quant.pack_rows(x, wd))
+        if packed.shape != (rows.shape[0], h + quant.SIDECAR):
+            problems.append(f"{wd}: packed shape {packed.shape} wrong")
+        unpacked = np.asarray(quant.unpack_rows(
+            jnp.asarray(packed), h, wd, jnp.float32))
+        if not np.allclose(unpacked, back, atol=1e-6):
+            problems.append(f"{wd}: pack/unpack disagrees with the bare "
+                            f"codec round-trip")
+
+        # poisoned scale sidecar: flip EXPONENT bits of the f32 scale
+        # riding row 0's message (the canonical SDC class — a sign/
+        # exponent flip moves the scale by binades, corrupting every
+        # element of the row on dequant)
+        poisoned = packed.copy()
+        poisoned[0, h + 3] ^= 0x14
+        if fold32(packed) == fold32(poisoned):
+            problems.append(f"{wd}: fold32 missed a flipped scale-"
+                            f"sidecar byte")
+        bad = np.asarray(quant.unpack_rows(
+            jnp.asarray(poisoned), h, wd, jnp.float32))
+        delta = np.abs(bad[0] - back[0]).max()
+        ref = max(float(np.abs(back[0]).max()), 1e-30)
+        if not (delta > 10 * bound * ref or not np.isfinite(delta)):
+            problems.append(
+                f"{wd}: a poisoned scale sidecar moved dequant by only "
+                f"{delta:.3g} — inside what parity tolerance could "
+                f"absorb; the wire checksum must be the guard")
+
+        # quantized-reduce verifier: clean pass, perturbation caught
+        n = 4
+        m_loc, r = 4, 16
+        parts = rng.standard_normal((n, n * m_loc, r)).astype(np.float32)
+        golden = np.asarray(quant.reduce_roundtrip(
+            jnp.asarray(parts.reshape(n, n, m_loc, r)), wd,
+            out_dtype=jnp.float32)).reshape(n * m_loc, r)
+        if verify_reduce_q("q_rs", parts.reshape(n * n * m_loc, r),
+                           golden, n, wd) is not None:
+            problems.append(f"{wd}: verify_reduce_q flagged a clean "
+                            f"quantized reduction")
+        bad_out = golden.copy()
+        bad_out[1, 2] += 10.0 * max(1.0, abs(float(bad_out[1, 2])))
+        if verify_reduce_q("q_rs", parts.reshape(n * n * m_loc, r),
+                           bad_out, n, wd) is None:
+            problems.append(f"{wd}: verify_reduce_q missed a large "
+                            f"perturbation")
+        # the AR two-hop shape with the device's bf16 out-dtype cast
+        # BEFORE the return-hop requantization (``_build_q_ar``): a
+        # healthy device output must verify clean — the golden takes
+        # the same cast, else elements near a codec rounding boundary
+        # are a false PayloadCorruption
+        dev = np.asarray(quant.roundtrip_rows(
+            jnp.asarray(golden).astype(jnp.bfloat16), wd,
+            out_dtype=jnp.bfloat16))
+        if verify_reduce_q("q_ar", parts.reshape(n * n * m_loc, r),
+                           dev, n, wd, two_hop=True) is not None:
+            problems.append(f"{wd}: verify_reduce_q(two_hop) flagged a "
+                            f"clean quantized AllReduce")
+    return problems
 
 
 # ---------------------------------------------------------------------------

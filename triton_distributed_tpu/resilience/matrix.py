@@ -56,7 +56,17 @@ DEFAULT_KERNELS = (
     # fused reduction must stay covered by injection like every other
     # signal-shaped kernel
     "fused_mlp_ar/swiglu",
+    # the quantized wire variants (ISSUE 9) at their packed-u8 shapes:
+    # same protocols, different payload geometry — a flipped byte
+    # anywhere in the message (scale sidecar included) must be caught
+    "quant_allgather/push_1shot",
+    "quant_exchange/oneshot",
 )
+
+# the `tdt_lint --quant` slice of the kernel axis
+QUANT_KERNELS = ("quant_allgather/push_1shot",
+                 "quant_allgather/ring_bidir",
+                 "quant_exchange/oneshot")
 
 # classes whose injection MUST be caught: they stall or corrupt
 MUST_DETECT = (FaultKind.DROP_NOTIFY, FaultKind.STALE_CREDIT,
@@ -370,6 +380,17 @@ def run_scheduler_matrix(seed: int = 0) -> list[dict]:
         _sched_cell(FaultKind.STRAGGLER, "overrun", rng),
         _sched_poison_cell(rng),
     ]
+
+
+def run_quant_cells(seed: int = 0) -> list[dict]:
+    """The ``tdt_lint --quant`` protocol slice: BOTH corruption classes
+    (in-flight payload flips and at-rest poisons — a flipped scale-
+    sidecar byte is just a payload byte to the checksum protocol, which
+    is the point) against every quantized kernel variant, through the
+    record-mode checksum protocol.  Verify with :func:`verify_matrix`
+    (``kinds=CORRUPTION_KINDS``)."""
+    return run_matrix(seed=seed, kernels=QUANT_KERNELS,
+                      kinds=CORRUPTION_KINDS)
 
 
 def run_integrity_cells(seed: int = 0) -> tuple[list[dict], list[dict]]:

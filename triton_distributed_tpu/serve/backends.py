@@ -64,9 +64,10 @@ def _slot_view(cache: PagedKVCache, pages_row: np.ndarray,
 
 
 def _merge_pools(cache: PagedKVCache, view: PagedKVCache) -> PagedKVCache:
-    """Adopt the pools a slot view updated; table/lens stay the
-    scheduler's."""
-    return dataclasses.replace(cache, k=view.k, v=view.v)
+    """Adopt the pools a slot view updated (scale sidecars included for
+    a quantized cache); table/lens stay the scheduler's."""
+    return dataclasses.replace(cache, k=view.k, v=view.v,
+                               k_scale=view.k_scale, v_scale=view.v_scale)
 
 
 class SimBackend:
@@ -88,7 +89,7 @@ class SimBackend:
     def __init__(self, *, slots: int = 4, page_size: int = 4,
                  pool_pages: int = 32, max_length: int = 64,
                  num_layers: int = 1, kv_heads: int = 1, head_dim: int = 8,
-                 vocab: int = 101, step_hook=None):
+                 vocab: int = 101, step_hook=None, kv_dtype=None):
         from ..core import mesh as mesh_lib
         from ..core.mesh import TP_AXIS, make_mesh
 
@@ -101,6 +102,11 @@ class SimBackend:
         self.head_dim = int(head_dim)
         self.vocab = int(vocab)
         self.step_hook = step_hook
+        # kv_dtype="int8": the quantized page layout — the SAME real
+        # paged-cache plumbing (dequant-merge-requant writes, scale
+        # sidecars), headlessly; tests materialize pages via
+        # kv_cache.layer_pool and still see the token history
+        self.kv_dtype = kv_dtype
         self._mesh = make_mesh({TP_AXIS: 1}, devices=jax.devices()[:1])
         self._step = 0
         del mesh_lib
@@ -110,6 +116,7 @@ class SimBackend:
             self._mesh, self.num_layers, self.slots, self.kv_heads,
             self.max_length, self.head_dim, jnp.float32,
             page_size=self.page_size, pool_pages=self.pool_pages,
+            kv_dtype=self.kv_dtype,
         )
 
     def next_token(self, tok: int, new_len: int) -> int:
@@ -229,6 +236,7 @@ class EngineBackend:
             self.model.mesh, c.num_layers, self.slots, c.num_kv_heads,
             c.max_length, c.head_dim, c.dtype, self.model.axis,
             page_size=self.page_size, pool_pages=self.pool_pages,
+            kv_dtype=getattr(self.engine, "kv_dtype", None),
         )
 
     def prefill_chunk(self, cache: PagedKVCache, pages_row, chunk,

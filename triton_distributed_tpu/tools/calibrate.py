@@ -327,6 +327,52 @@ def _thresholds() -> tuple[int, int]:
     return _agreed
 
 
+# ---------------------------------------------------------------------------
+# wire-codec economics (the fp8/int8 payload "auto" policy)
+
+# The codec's measured pack+unpack throughput at the serving bench shape
+# (BENCH r04 ``codec_gbps`` — input GB/s through the fused Pallas pack +
+# XLA unpack).  Conservative: the one-pass pack alone measured ~255.
+DEFAULT_CODEC_GBPS = 100.0
+
+
+def wire_gbps(wire_class: str) -> float:
+    """Per-chip bandwidth of a wire class: the MEASURED calibration when
+    one exists, else the perf-model defaults (the documented v5e numbers
+    — cold-start behavior identical to the pre-calibration policy)."""
+    from . import perf_model
+
+    cal = load_calibration()
+    if wire_class == "dcn":
+        if cal is not None and cal.dcn_gbps:
+            return float(cal.dcn_gbps)
+        return float(perf_model.DCN_GBPS_PER_CHIP)
+    if cal is not None and cal.ici_gbps:
+        return float(cal.ici_gbps)
+    return float(perf_model.chip_spec().ici_gbps)
+
+
+def codec_pays(wire_class: str, h: int = 7168, *,
+               codec_gbps: float | None = None) -> bool:
+    """Whether a quantized wire payload wins NET time on ``wire_class``
+    at row width ``h``: the wire time the halved payload saves must
+    exceed what the codec costs (pack send-side + unpack recv-side).
+    This is the measured-threshold form of the old hard-coded
+    "codec on DCN only" rule (``layers.moe.fp8_wire='auto'``): with the
+    cold-start numbers it reproduces exactly that policy (BENCH r04
+    ``net_us_per_token_hop_ici`` < 0 < ``_dcn``), and a calibration run
+    on a live topology moves the crossover with the real link speeds."""
+    from ..lang import quant
+
+    saved_bytes = 2 * h - quant.packed_width(h, "fp8")
+    if saved_bytes <= 0:
+        return False
+    codec = codec_gbps if codec_gbps is not None else DEFAULT_CODEC_GBPS
+    codec_s = (2 * h) / (codec * 1e9)          # bf16 input through codec
+    wire_s = saved_bytes / (wire_gbps(wire_class) * 1e9)
+    return wire_s > codec_s
+
+
 def push_bytes_threshold() -> int:
     """AllGather one-shot-push vs ring crossover (bytes per shard): the
     measured bandwidth-delay product, else the 256 KiB cold default;

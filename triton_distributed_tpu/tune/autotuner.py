@@ -811,6 +811,35 @@ def fresh_tune_fused_mlp(x, gate_up, down, mesh, axis: str = "tp") -> Any:
     )
 
 
+def fresh_tune_wire_dtype(op: str, x, mesh, axis: str = "tp") -> Any:
+    """Fresh re-measure of a collective's ``wire_dtype`` axis (ISSUE 9:
+    {bf16, int8, fp8} as a tuner dimension, keyed on shape AND wire
+    class) for THIS shape, NOW, in this process — the same cache entry
+    the entries' ``wire_dtype="auto"`` path consults, so a bench/warmup
+    crown teaches later jitted calls.  ``op``: "all_gather" |
+    "reduce_scatter" | "all_reduce"."""
+    from .. import comm
+    from ..comm.quantized import WIRE_DTYPES
+    from ..core import mesh as mesh_lib
+
+    fns = {"all_gather": comm.all_gather,
+           "reduce_scatter": comm.reduce_scatter,
+           "all_reduce": comm.all_reduce}
+    entry = fns[op]
+    name = {"all_gather": "ag_wire", "reduce_scatter": "rs_wire",
+            "all_reduce": "ar_wire"}[op]
+    return resolve_config(
+        name,
+        (tuple(x.shape), str(x.dtype), mesh.shape[axis],
+         mesh_lib.wire_class(mesh, axis), platform.device_kind()),
+        list(WIRE_DTYPES), "bf16",
+        lambda wd: (lambda: entry(x, mesh, axis, wire_dtype=wd)),
+        tracing=is_tracer(x),
+        force_measure=True,
+        fresh=True,
+    )
+
+
 def fresh_tune_flash_attention(q, k, v, *, causal: bool = True,
                                sm_scale=None,
                                soft_cap: float = 0.0) -> Any:

@@ -248,6 +248,18 @@ CLAIMS = {
     "serve_kv_quant_concurrency": {
         "floor": 1.8, "value_max": 2.05, "since": 9,
     },
+    # -- hierarchical multi-slice collectives (ISSUE 10; `bench.py hier`) --
+    # per-chip DCN bytes of the hierarchical AllReduce as a fraction of
+    # the RS∘AG bound (1/slice_ranks of the payload): value_max 1.02 is
+    # the bound + tolerance (bf16 psum sits exactly at 1.0 for n_out=2;
+    # the quantized-DCN default ~0.51); the floor rejects impossible
+    # under-accounting.  Deterministic byte math from the same source
+    # the obs counters and watchdog pricing read
+    # (comm.hierarchical.hier_ar_wire_bytes) — CPU captures are
+    # interpret-marked (no wire ran), slice captures hard-gate
+    "hier_ar_dcn_bytes_ratio": {
+        "floor": 0.4, "value_max": 1.02, "since": 10,
+    },
 }
 
 def parse_record(path: str) -> tuple[list[dict], int | None, bool]:

@@ -20,6 +20,7 @@ Usage:
     python scripts/tdt_lint.py --history         # bench-record trend gate
     python scripts/tdt_lint.py --serve           # scheduler overload smoke
     python scripts/tdt_lint.py --integrity       # data-integrity gate
+    python scripts/tdt_lint.py --hier            # hierarchical (ICIxDCN) gate
     python scripts/tdt_lint.py --all             # every gate, one exit code
     python scripts/tdt_lint.py --json report.json
 
@@ -59,9 +60,22 @@ recompute recovery), and the live-verifier selftest battery (every
 input; quarantine must open at its threshold).  Exit 1 on any
 undetected-unsurvived cell.  Headless and CPU-only.
 
+``--hier`` is the hierarchical multi-slice gate (ISSUE 10,
+docs/perf.md "Hierarchical collectives"): the two-level (ICI x DCN)
+protocol matrix at the {2x2, 2x4, 4x2} slice layouts plus the
+scheduled-emission A2A variant at ranks {2,4,8} through the static
+verifier; the fault-injection cells over every hierarchical kernel
+(the dropped-inter-slice-credit class included — drop_notify /
+stale_credit landing on the dcn semaphores must be DETECTED); and the
+schedule-order selftest on a synthetic 2x4 topology (every DCN-bound
+chunk group must precede every ICI-bound one, farthest-first within
+each class, self last — and the ordering must FLIP when the synthetic
+calibration says the ICI is the slower wire).  Headless and CPU-only.
+
 ``--all`` runs every gate above — verify matrix, ``--faults``,
-``--timeline``, ``--serve``, ``--history``, ``--integrity`` — and
-summarizes them under a single exit code (the CI entry; see README).
+``--timeline``, ``--serve``, ``--history``, ``--integrity``,
+``--quant``, ``--hier`` — and summarizes them under a single exit code
+(the CI entry; see README).
 
 ``--history`` runs the bench-record trend sentinel
 (``scripts/bench_history.py --check``): exit 1 when a committed
@@ -120,10 +134,16 @@ def main(argv: list[str] | None = None) -> int:
                          "quantized-variant protocol matrix at ranks "
                          "{2,4,8}, and the corruption fault cells over "
                          "the quantized kernels")
+    ap.add_argument("--hier", action="store_true",
+                    help="hierarchical (ICI x DCN) gate (ISSUE 10): "
+                         "two-level protocol matrix at slice layouts "
+                         "{2x2,2x4,4x2}, fault cells incl. the dropped "
+                         "inter-slice credit, and the schedule-order "
+                         "selftest on a synthetic 2x4 topology")
     ap.add_argument("--all", action="store_true", dest="all_gates",
                     help="run every gate (verify matrix, --faults, "
                          "--timeline, --serve, --history, --integrity, "
-                         "--quant) with one summarized exit code")
+                         "--quant, --hier) with one summarized exit code")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection target sampling seed (--faults)")
     ap.add_argument("--json", metavar="PATH",
@@ -144,6 +164,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_integrity(args)
     if args.quant:
         return _run_quant(args)
+    if args.hier:
+        return _run_hier(args)
 
     from triton_distributed_tpu import analysis
 
@@ -296,6 +318,93 @@ def _run_quant(args) -> int:
     return 0
 
 
+def _run_hier(args) -> int:
+    """The hierarchical multi-slice gate (ISSUE 10; see module
+    docstring): protocol matrix at the slice layouts, fault cells, and
+    the schedule-order selftest on a synthetic 2x4 topology."""
+    from triton_distributed_tpu import analysis, resilience
+    from triton_distributed_tpu.comm.hierarchical import (
+        chunk_schedule, ici_schedule,
+    )
+    from triton_distributed_tpu.tools.calibrate import LinkCalibration
+
+    problems: list[str] = []
+
+    # 1: the two-level protocol matrix at {2x2, 2x4, 4x2} plus the
+    # scheduled-emission flat A2A variant at ranks {2,4,8}
+    for filt in ("hier", "scheduled"):
+        results = analysis.verify_all(ranks=(2, 4, 8), kernel_filter=filt)
+        if not results:
+            problems.append(f"no kernel cases match filter {filt!r}")
+        for case, violations in results:
+            status = "OK" if not violations else "VIOLATION"
+            print(f"{case.name:<28} ranks={case.n:<2} {status}")
+            for v in violations:
+                print(f"    [{v.check}] {v.message}")
+                problems.append(f"{case.name}: [{v.check}] {v.message}")
+
+    # 2: the fault cells over every hierarchical kernel case — the
+    # dropped-inter-slice-credit class rides drop_notify/stale_credit
+    # landing on the dcn semaphores and must be DETECTED
+    cells = resilience.run_hier_cells(seed=args.seed)
+    for row in cells:
+        named = f"  [{', '.join(row['named'])}]" if row["named"] else ""
+        print(f"{row['kernel']:<26} {row['fault']:<16} "
+              f"{row['outcome'].upper():<9}{named}")
+    problems += resilience.verify_matrix(cells)
+    dcn_detected = [r for r in cells
+                    if r["outcome"] == "detected"
+                    and any("dcn" in s for s in r["named"])]
+    if not dcn_detected:
+        problems.append(
+            "no fault cell detection named an inter-slice (dcn) "
+            "semaphore — the dropped-inter-slice-credit class is not "
+            "being exercised")
+
+    # 3: schedule-order selftest on a synthetic 2x4 topology
+    cal = LinkCalibration(ici_gbps=186.0, ici_hop_us=1.4, dcn_gbps=6.25,
+                          dcn_hop_us=20.0, device_kind="TPU v5e",
+                          n_devices=8, num_slices=2, chips_per_slice=4)
+    sched = chunk_schedule(2, 4, cal)
+    print(f"schedule(2x4, dcn-slow): {sched}")
+    k = len([g for g in sched if g[0] != 0])
+    if not all(g[0] != 0 for g in sched[:k]):
+        problems.append(f"schedule {sched}: a DCN-bound group is not "
+                        f"ahead of every ICI-bound group")
+    if sched[-1] != (0, 0):
+        problems.append(f"schedule {sched}: the self group must be last")
+    ici_part = [g[1] for g in sched if g[0] == 0 and g[1] != 0]
+    if ici_part != list(ici_schedule(4))[:-1]:
+        problems.append(f"schedule {sched}: ICI groups not farthest-first "
+                        f"({ici_part} != {list(ici_schedule(4))[:-1]})")
+    flipped = chunk_schedule(2, 4, LinkCalibration(
+        ici_gbps=6.25, ici_hop_us=20.0, dcn_gbps=186.0, dcn_hop_us=1.4,
+        num_slices=2, chips_per_slice=4))
+    k2 = len([g for g in flipped if g[0] == 0 and g != (0, 0)])
+    if not all(g[0] == 0 for g in flipped[:k2]):
+        problems.append(
+            f"schedule {flipped}: with the ICI measured slower, "
+            f"ICI-bound groups must launch first — the order must track "
+            f"the CALIBRATION, not a hard-coded class")
+
+    for p in problems:
+        print(f"HIER FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"cells": cells, "schedule_2x4": sched,
+                       "problems": problems}, f, indent=1, sort_keys=True,
+                      default=str)
+    if problems:
+        return 1
+    detected = sum(r["outcome"] == "detected" for r in cells)
+    survived = sum(r["outcome"] == "survived" for r in cells)
+    print(f"hier OK: two-level protocols verify clean at slice layouts "
+          f"{{2x2, 2x4, 4x2}}; {len(cells)} fault cells ({detected} "
+          f"detected / {survived} survived) incl. inter-slice credit "
+          f"drops named; schedule order tracks the calibrated topology")
+    return 0
+
+
 def _run_all(args) -> int:
     """One aggregate CI entry: every gate, a summary table, one exit
     code (the max of the legs; a crashed leg counts as 1)."""
@@ -320,6 +429,7 @@ def _run_all(args) -> int:
         # standalone `--integrity` run
         ("integrity", lambda: _run_integrity(sub())),
         ("quant", lambda: _run_quant(sub())),
+        ("hier", lambda: _run_hier(sub())),
     ]
     results = []
     for name, fn in legs:

@@ -494,7 +494,10 @@ def test_all_reduce_config_consults_planted_winner(monkeypatch):
     mesh = mesh_lib.tp_mesh(2)
     x = jnp.ones((512, 512), jnp.float32)   # 512 KiB partial -> one_shot
     winner = ar.AllReduceConfig(128, 512)
-    key = (256, 512, "float32", 2, "one_shot", platform.device_kind())
+    # the contextual key carries the axis's wire class (ISSUE 10): a
+    # winner crowned on the ICI torus must not be found for a DCN edge
+    key = (256, 512, "float32", 2, "one_shot",
+           mesh_lib.wire_class(mesh, "tp"), platform.device_kind())
     rk = ("ar_cfg", tuple(map(str, key)))
     monkeypatch.setitem(autotuner._GLOBAL._resolved, rk, winner)
     # pin the method so the planted key is the one consulted

@@ -149,9 +149,10 @@ def test_clean_traces_have_no_findings():
 
 def test_matrix_corruption_cells_all_detected():
     rows = rz.run_matrix(seed=0, kinds=rz.CORRUPTION_KINDS)
-    # both classes x all 9 kernel cases (fused_mlp_ar since ISSUE 8;
-    # quant_allgather/push_1shot + quant_exchange/oneshot since ISSUE 9)
-    assert len(rows) == 18
+    # both classes x all 11 kernel cases (fused_mlp_ar since ISSUE 8;
+    # quant_allgather/push_1shot + quant_exchange/oneshot since ISSUE 9;
+    # hier_allreduce/2x2 + hier_a2a/2x2 since ISSUE 10)
+    assert len(rows) == 22
     for row in rows:
         assert row["outcome"] == "detected", row
         assert row["named"], row
@@ -229,6 +230,24 @@ MATRIX_GOLDEN = {
     ("quant_exchange/oneshot", "rank_abort"),
     ("quant_exchange/oneshot", "corrupt_payload"),
     ("quant_exchange/oneshot", "corrupt_kv_page"),
+    # the ISSUE-10 two-level (ICI x DCN) families at the 2x2 layout —
+    # the inter-slice credit protocol in the injection loop (the other
+    # layouts ride `tdt_lint --hier`); the AR composition's ring RS
+    # carries notifies, so delay_notify applies there but not to the
+    # pure-DMA scheduled A2A
+    ("hier_allreduce/2x2", "drop_notify"),
+    ("hier_allreduce/2x2", "delay_notify"),
+    ("hier_allreduce/2x2", "stale_credit"),
+    ("hier_allreduce/2x2", "straggler"),
+    ("hier_allreduce/2x2", "rank_abort"),
+    ("hier_allreduce/2x2", "corrupt_payload"),
+    ("hier_allreduce/2x2", "corrupt_kv_page"),
+    ("hier_a2a/2x2", "drop_notify"),
+    ("hier_a2a/2x2", "stale_credit"),
+    ("hier_a2a/2x2", "straggler"),
+    ("hier_a2a/2x2", "rank_abort"),
+    ("hier_a2a/2x2", "corrupt_payload"),
+    ("hier_a2a/2x2", "corrupt_kv_page"),
 }
 
 SCHEDULER_GOLDEN = {

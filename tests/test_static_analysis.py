@@ -38,11 +38,18 @@ def test_registry_covers_required_families():
     required = {
         "allgather/push_1shot", "allgather/ring_1d", "allgather/ring_bidir",
         "reduce_scatter/ring", "allreduce/one_shot", "allreduce/two_shot",
-        "all_to_all/dispatch", "all_to_all/combine",
+        "all_to_all/dispatch", "all_to_all/combine", "all_to_all/scheduled",
         "ag_gemm/unidir", "ag_gemm/bidir", "gemm_rs/ring", "gemm_ar/ring",
         "fused_mlp_ar/swiglu", "fused_mlp_ar/linear",
+        # the two-level (ICI x DCN) family at the 2x2 layout (ISSUE 10);
+        # 2x4 and 4x2 enumerate at n=8
+        "hier_allgather/2x2", "hier_reduce_scatter/2x2",
+        "hier_allreduce/2x2", "hier_a2a/2x2",
     }
     assert required <= names, required - names
+    names8 = {c.name for c in analysis.all_cases(ranks=(8,))}
+    assert {"hier_allreduce/2x4", "hier_allreduce/4x2",
+            "hier_a2a/2x4", "hier_a2a/4x2"} <= names8
 
 
 def test_fori_loop_patch_is_thread_scoped():
@@ -144,6 +151,18 @@ def test_method_divergence_flagged():
 
 def test_fixture_selftest_battery():
     assert fixtures.run_selftest() == []
+
+
+def test_hier_dropped_dcn_credit_flagged():
+    """The ISSUE-10 two-level defect class: a DCN broadcast that consumes
+    one fewer inter-slice arrival credit than the slices deliver must be
+    flagged as a signal imbalance NAMING the dcn semaphore (the surplus
+    credit would satisfy a future wait before its block landed)."""
+    vs = _violations("fixture/hier_dropped_dcn_credit")
+    hits = [v for v in vs if v.check == "signal_balance"]
+    assert hits, [str(v) for v in vs]
+    assert any("dcn_recv_sems" in v.message for v in hits), \
+        [v.message for v in hits]
 
 
 def test_unacked_slot_reuse_flagged():
@@ -253,10 +272,12 @@ def _run_lint(*args):
 def test_cli_full_matrix_clean():
     res = _run_lint()
     assert res.returncode == 0, res.stdout + res.stderr
-    # 51 = the pre-ISSUE-8 36 plus fused_mlp_ar/{swiglu,linear} x {2,4,8}
-    # plus the ISSUE-9 quantized wire variants (quant_allgather x 2 +
-    # quant_exchange) x {2,4,8}
-    assert "51 kernel cases" in res.stdout
+    # 66 = the ISSUE-9-era 51 (pre-ISSUE-8 36 + fused_mlp_ar x {2,4,8} +
+    # quantized wire variants x {2,4,8}) plus the ISSUE-10
+    # all_to_all/scheduled variant x {2,4,8} and the hierarchical
+    # two-level cases (4 families x the {2x2} layout at n=4 + 4 x the
+    # {2x4, 4x2} layouts at n=8 = 12)
+    assert "66 kernel cases" in res.stdout
     assert "0 violation(s)" in res.stdout
 
 

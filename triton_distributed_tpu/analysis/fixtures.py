@@ -61,6 +61,28 @@ def bad_overlapping_writes_kernel(team, m, x_ref, out_ref, send_sem,
     dl.wait_send(x_ref, send_sem)
 
 
+def bad_hier_dropped_dcn_credit_kernel(n_out, n_in, src, zones, send_sem,
+                                       recv_sems):
+    """Dropped inter-slice credit (the ISSUE-10 two-level defect class):
+    the DCN broadcast pushes one block per peer slice but consumes one
+    FEWER arrival credit than the slices deliver — the surplus credit on
+    ``dcn_recv_sems`` leaks into the next invocation and satisfies a
+    future wait before its block has landed (stale-data consumption on
+    hardware).  Signal balance must flag the inter-slice semaphore."""
+    o = dl.rank("dcn")
+    i = dl.rank("tp")
+    for off in range(1, n_out):
+        dst_o = (o + off) % n_out
+        dl.remote_copy(src, zones.at[o], send_sem, recv_sems.at[o],
+                       dst_o * n_in + i)
+    # BUG: one source slice's arrival is never consumed
+    for off in range(1, n_out - 1):
+        src_o = (o + n_out - off) % n_out
+        dl.wait_recv(zones.at[src_o], recv_sems.at[src_o])
+    for _ in range(n_out - 1):
+        dl.wait_send(src, send_sem)
+
+
 def diverged_method_kernel(team, sem, *, one_shot: bool):
     """Collective divergence: the op sequence depends on which method this
     HOST resolved (the ``tools/calibrate.py`` per-host-threshold hazard) —
@@ -101,12 +123,27 @@ def fixture_cases(n: int = 4) -> list[KernelCase]:
             team, FakeSem("sem", kind="regular"), one_shot=(rank == 0)
         )
 
+    # the two-level fixture runs on a (dcn x tp) harness mesh: n ranks as
+    # 2 slices of n//2 chips (n must be even — the selftest's n=4 gives
+    # the 2x2 layout)
+    n_out, n_in = 2, max(n // 2, 1)
+
+    def make_hier_dropped(rank):
+        return "dcn_bcast", lambda: bad_hier_dropped_dcn_credit_kernel(
+            n_out, n_in, FakeRef("block", (m, r)),
+            FakeRef("dcn_zones", (n_out, m, r)),
+            FakeSem("dcn_send_sem"), FakeSem("dcn_recv_sems"),
+        )
+
     return [
         KernelCase("fixture/missing_notify", "fixture", n,
                    make_missing_notify),
         KernelCase("fixture/crossed_wait", "fixture", n, make_crossed_wait),
         KernelCase("fixture/overlapping_writes", "fixture", n, make_overlap),
         KernelCase("fixture/diverged_method", "fixture", n, make_diverged),
+        KernelCase("fixture/hier_dropped_dcn_credit", "fixture", n,
+                   make_hier_dropped,
+                   axes=(("dcn", n_out), ("tp", n_in))),
     ]
 
 
@@ -117,6 +154,7 @@ EXPECTED = {
     "fixture/crossed_wait": "deadlock",
     "fixture/overlapping_writes": "write_overlap",
     "fixture/diverged_method": "collective_divergence",
+    "fixture/hier_dropped_dcn_credit": "signal_balance",
 }
 
 
@@ -129,6 +167,7 @@ def run_selftest(n: int = 4) -> list[str]:
         "fixture/missing_notify": "ready",
         "fixture/crossed_wait": "flag",
         "fixture/overlapping_writes": "out[0:4",
+        "fixture/hier_dropped_dcn_credit": "dcn_recv_sems",
     }
     for case in fixture_cases(n):
         violations = verify_case(case)

@@ -259,9 +259,30 @@ def recording(axes: tuple[tuple[str, int], ...], coords: dict[str, int]):
                 _FORI_PATCH["orig"] = None
 
 
-def record_kernel(thunk, *, n: int, rank: int, axis: str = "tp"):
-    """Record one rank of a single-axis collective kernel.  ``thunk`` runs
-    the kernel body (fakes already bound); returns the recorder."""
-    with recording(((axis, n),), {axis: rank}) as rec:
+def coords_of(axes: tuple[tuple[str, int], ...], rank: int) -> dict[str, int]:
+    """Row-major (outermost-first) decomposition of a flat rank index into
+    per-axis coordinates — the convention under which the linearized
+    logical device id of rank ``r`` equals ``r`` itself, which the
+    composed-trace checks and the bounded simulator rely on (they index
+    traces by rank and compare against recorded device ids)."""
+    coords: dict[str, int] = {}
+    rem = int(rank)
+    for name, size in reversed(axes):
+        coords[name] = rem % size
+        rem //= size
+    return coords
+
+
+def record_kernel(thunk, *, n: int, rank: int, axis: str = "tp",
+                  axes: tuple[tuple[str, int], ...] | None = None):
+    """Record one rank of a collective kernel.  ``thunk`` runs the kernel
+    body (fakes already bound); returns the recorder.  ``axes`` selects a
+    multi-axis harness mesh (outermost first; e.g. the hierarchical
+    two-level cases record over ``(("dcn", n_out), ("tp", n_in))``) with
+    ``rank`` decomposed row-major (``coords_of``); the default is the
+    single-axis ``(("tp", n),)`` mesh."""
+    if axes is None:
+        axes = ((axis, n),)
+    with recording(axes, coords_of(axes, rank)) as rec:
         thunk()
     return rec

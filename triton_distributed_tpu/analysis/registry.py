@@ -33,22 +33,49 @@ DEFAULT_RANKS = (2, 4, 8)
 FAMILIES = (
     "allgather", "reduce_scatter", "allreduce", "all_to_all",
     "ag_gemm", "gemm_rs", "gemm_ar", "fused_mlp_ar",
-    "quantized_wire",
+    "quantized_wire", "hierarchical",
 )
 
-_FAMILY_ALIASES = {"ep_dispatch": "all_to_all", "ep_combine": "all_to_all"}
+_FAMILY_ALIASES = {"ep_dispatch": "all_to_all", "ep_combine": "all_to_all",
+                   "sched_ep_dispatch": "all_to_all",
+                   "sched_ep_combine": "all_to_all"}
+
+# slice layouts the hierarchical family's ACCEPTANCE matrix pins
+# (ISSUE 10): (num_slices, chips_per_slice).  The DEFAULT_RANKS sweep
+# covers all three — n=4 verifies 2x2 and n=8 verifies 2x4 AND 4x2.
+HIER_LAYOUTS = ((2, 2), (2, 4), (4, 2))
+
+
+def hier_layouts_for(n: int) -> list[tuple[int, int]]:
+    """EVERY (n_out >= 2, n_in >= 2) factorization of ``n`` — not just
+    the pinned acceptance layouts: the build-time verify gate
+    (``verify_protocol("hierarchical", n)``) must exercise whatever rank
+    count a live 2D mesh presents (a 2x8 mesh verifies at (2,8), (4,4),
+    (8,2)), never memoize an empty run as verified.  Rank counts with no
+    such factorization (primes; or n_in==1 meshes, where the inner ring
+    is degenerate and the DCN hop is a bare XLA collective) have no
+    two-level protocol to check."""
+    out = []
+    for o in range(2, n // 2 + 1):
+        if n % o == 0 and n // o >= 2:
+            out.append((o, n // o))
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
 class KernelCase:
     """One verifiable (kernel variant, rank count): ``make(rank)`` returns
     ``(variant_label, thunk)`` where the thunk runs the kernel body for
-    that rank with fresh symbolic args."""
+    that rank with fresh symbolic args.  ``axes`` selects a multi-axis
+    harness mesh (outermost first; the hierarchical two-level cases run on
+    ``(("dcn", n_out), ("tp", n_in))``) with ranks enumerated row-major so
+    device id == rank index; None = the single-axis ``(("tp", n),)``."""
 
     name: str
     family: str
     n: int
     make: Callable[[int], tuple[str, Callable[[], None]]]
+    axes: tuple[tuple[str, int], ...] | None = None
 
 
 def _team(n: int):
@@ -198,9 +225,30 @@ def _a2a_cases(n: int) -> list[KernelCase]:
             FakeSem("send_sem"), FakeSem("recv_sems"),
         )
 
+    def make_scheduled(rank):
+        # the topology-scheduled emission order (ISSUE 10): same push
+        # protocol, peer offsets emitted farthest-first (the FAST-style
+        # order the hierarchical A2A launches ICI chunks in); the
+        # verifier proves reordering the static loop preserves the
+        # protocol at every rank count
+        from ..comm.hierarchical import ici_schedule
+
+        row = counts[rank]
+        expected = [counts[p][rank] for p in range(n)]
+        x = FakeRef("x", (4 * n + chunk, h))
+        out = FakeRef("zones", (n, z, h))
+        return "scheduled", lambda: _a2a_push_kernel(
+            team, chunk, z, h,
+            FakeSmem("counts", row), FakeSmem("offs", _offsets(row)),
+            FakeSmem("expected", expected), x, out,
+            FakeSem("send_sem"), FakeSem("recv_sems"),
+            schedule=ici_schedule(n),
+        )
+
     return [
         KernelCase("all_to_all/dispatch", "all_to_all", n, make_dispatch),
         KernelCase("all_to_all/combine", "all_to_all", n, make_combine),
+        KernelCase("all_to_all/scheduled", "all_to_all", n, make_scheduled),
     ]
 
 
@@ -401,6 +449,149 @@ def _quant_cases(n: int) -> list[KernelCase]:
     return cases
 
 
+def _hier_cases(n: int) -> list[KernelCase]:
+    """The two-level (ICI x DCN) collective protocols (ISSUE 10) at every
+    slice layout whose total rank count is ``n`` (``hier_layouts_for`` —
+    the {2x2, 2x4, 4x2} acceptance matrix).  Each case composes the REAL
+    shipped inner kernel body (per-slice Pallas ring, addressed through a
+    two-axis ``Team`` so peer ids resolve within the slice) with the
+    record-mode protocol model of the DCN hop
+    (``comm.hierarchical.dcn_broadcast_model`` / ``dcn_reduce_model`` —
+    in production that hop is an XLA collective, SURVEY.md section 7; the
+    model pins the credit/ordering contract the composition relies on,
+    which is what the dropped-inter-slice-credit fault class injects
+    against)."""
+    import jax.numpy as jnp
+
+    from ..lang.primitives import Team
+
+    cases: list[KernelCase] = []
+    m, r = 4, 8
+    for n_out, n_in in hier_layouts_for(n):
+        axes = (("dcn", n_out), ("tp", n_in))
+        team = Team(axes, "tp")
+        label = f"{n_out}x{n_in}"
+
+        def make_ag(rank, team=team, n_out=n_out, n_in=n_in):
+            from ..comm.allgather import _ag_ring_kernel
+            from ..comm.hierarchical import dcn_broadcast_model
+
+            x = FakeRef("x", (m, r))
+            inner = FakeRef("inner_gather", (n_in * m, r))
+            zones = FakeRef("dcn_zones", (n_out, n_in * m, r))
+
+            def body():
+                _ag_ring_kernel(team, m, x, inner, FakeSem("local_sem"),
+                                FakeSem("send_sem"), FakeSem("recv_sems"))
+                dcn_broadcast_model(n_out, n_in, inner, zones,
+                                    FakeSem("dcn_send_sem"),
+                                    FakeSem("dcn_recv_sems"))
+            return "ring+dcn_bcast", body
+
+        def make_rs(rank, team=team, n_out=n_out, n_in=n_in):
+            from ..comm.hierarchical import dcn_reduce_model
+            from ..comm.reduce_scatter import (
+                ReduceScatterConfig, _rs_ring_kernel,
+            )
+
+            cfg = ReduceScatterConfig()
+            x = FakeRef("x", (n_in * m, r))
+            part = FakeRef("part", (m, r))
+            zones = FakeRef("dcn_zones", (n_out, m, r))
+            out = FakeRef("out", (m, r))
+
+            def body():
+                _rs_ring_kernel(team, m, r, cfg, x, part,
+                                FakeRef("recv_buf", (2, m, r)),
+                                FakeRef("send_buf", (2, m, r)),
+                                FakeSem("send_sems"), FakeSem("recv_sems"),
+                                FakeSem("ack_sems", kind="regular"))
+                dcn_reduce_model(n_out, n_in, part, zones, out,
+                                 FakeSem("dcn_send_sem"),
+                                 FakeSem("dcn_recv_sems"),
+                                 jnp.float32, m, r)
+            return "ring+dcn_reduce", body
+
+        def make_ar(rank, team=team, n_out=n_out, n_in=n_in):
+            from ..comm.allgather import _ag_ring_kernel
+            from ..comm.hierarchical import dcn_reduce_model
+            from ..comm.reduce_scatter import (
+                ReduceScatterConfig, _rs_ring_kernel,
+            )
+
+            cfg = ReduceScatterConfig()
+            x = FakeRef("x", (n_in * m, r))
+            part = FakeRef("part", (m, r))
+            zones = FakeRef("dcn_zones", (n_out, m, r))
+            red = FakeRef("reduced", (m, r))
+            out = FakeRef("out", (n_in * m, r))
+
+            def body():
+                # RS ring on ICI, reduce across DCN, AG ring on ICI — the
+                # RS∘AG composition whose DCN hop carries 1/n_in of the
+                # payload (the bench.py hier claims-gate bound)
+                _rs_ring_kernel(team, m, r, cfg, x, part,
+                                FakeRef("rs_recv_buf", (2, m, r)),
+                                FakeRef("rs_send_buf", (2, m, r)),
+                                FakeSem("rs_send_sems"),
+                                FakeSem("rs_recv_sems"),
+                                FakeSem("rs_ack_sems", kind="regular"))
+                dcn_reduce_model(n_out, n_in, part, zones, red,
+                                 FakeSem("dcn_send_sem"),
+                                 FakeSem("dcn_recv_sems"),
+                                 jnp.float32, m, r)
+                _ag_ring_kernel(team, m, red, out, FakeSem("ag_local_sem"),
+                                FakeSem("ag_send_sem"),
+                                FakeSem("ag_recv_sems"))
+            return "rs+dcn_reduce+ag", body
+
+        def make_a2a(rank, team=team, n_out=n_out, n_in=n_in):
+            from ..comm.all_to_all import _a2a_push_kernel
+            from ..comm.hierarchical import dcn_broadcast_model, ici_schedule
+
+            chunk, h, z = 2, 4, 8
+            i = rank % n_in
+            counts = _a2a_counts(n_in)
+            row = counts[i]
+            expected = [counts[p][i] for p in range(n_in)]
+            offs, acc = [], 0
+            for c in row:
+                offs.append(acc)
+                acc += c
+            tokens = FakeRef("tokens", (n_out, 4 * n_in + chunk, h))
+            zones = FakeRef("dcn_zones", (n_out, 4 * n_in + chunk, h))
+            x = FakeRef("merged", (4 * n_in + chunk, h))
+            out = FakeRef("ici_zones", (n_in, z, h))
+
+            def body():
+                # phase 1 FIRST: the DCN-bound token blocks launch onto
+                # the slow wire, then the ICI kernel pipelines underneath
+                # with the farthest-first schedule (FAST, arXiv:2505.09764)
+                dcn_broadcast_model(n_out, n_in, tokens.at[0], zones,
+                                    FakeSem("dcn_send_sem"),
+                                    FakeSem("dcn_recv_sems"))
+                _a2a_push_kernel(
+                    team, chunk, z, h,
+                    FakeSmem("counts", row), FakeSmem("offs", offs),
+                    FakeSmem("expected", expected), x, out,
+                    FakeSem("send_sem"), FakeSem("recv_sems"),
+                    schedule=ici_schedule(n_in),
+                )
+            return "dcn+sched_push", body
+
+        cases += [
+            KernelCase(f"hier_allgather/{label}", "hierarchical", n,
+                       make_ag, axes=axes),
+            KernelCase(f"hier_reduce_scatter/{label}", "hierarchical", n,
+                       make_rs, axes=axes),
+            KernelCase(f"hier_allreduce/{label}", "hierarchical", n,
+                       make_ar, axes=axes),
+            KernelCase(f"hier_a2a/{label}", "hierarchical", n,
+                       make_a2a, axes=axes),
+        ]
+    return cases
+
+
 _FAMILY_CASES = {
     "allgather": _ag_cases,
     "reduce_scatter": _rs_cases,
@@ -411,6 +602,7 @@ _FAMILY_CASES = {
     "gemm_ar": _gemm_ar_cases,
     "fused_mlp_ar": _fused_mlp_ar_cases,
     "quantized_wire": _quant_cases,
+    "hierarchical": _hier_cases,
 }
 
 
@@ -444,7 +636,7 @@ def verify_case(case: KernelCase) -> list[Violation]:
     traces, sigs, variants = [], [], []
     for rank in range(case.n):
         label, thunk = case.make(rank)
-        rec = record_kernel(thunk, n=case.n, rank=rank)
+        rec = record_kernel(thunk, n=case.n, rank=rank, axes=case.axes)
         traces.append(rec.events)
         sigs.append(rec.collapsed_signature())
         variants.append(label)

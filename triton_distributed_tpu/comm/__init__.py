@@ -1,19 +1,29 @@
 """Collectives as fused Pallas TPU kernels (reference: the kernel library's
 communication half — allgather/reduce_scatter/allreduce/all-to-all files in
-``python/triton_dist/kernels/nvidia/``)."""
+``python/triton_dist/kernels/nvidia/``).  Single-slice (ICI) kernels live in
+their per-family modules; the multi-slice (ICI x DCN) layer — two-level
+AG/RS/AR and the topology-scheduled EP all-to-all — is ``hierarchical``."""
 
 from .all_to_all import AllToAllConfig, ep_combine, ep_dispatch
 from .allgather import (
     AllGatherMethod,
     all_gather,
     choose_method,
-    hierarchical_all_gather,
 )
 from .allreduce import (
     AllReduceConfig,
     AllReduceMethod,
     all_reduce,
+)
+from .hierarchical import (
+    chunk_schedule,
+    hierarchical_all_gather,
     hierarchical_all_reduce,
+    hierarchical_reduce_scatter,
+    ici_schedule,
+    scheduled_ep_combine,
+    scheduled_ep_dispatch,
+    slice_axes,
 )
 from .quantized import (
     quantized_all_gather,
@@ -24,6 +34,5 @@ from .quantized import (
 )
 from .reduce_scatter import (
     ReduceScatterConfig,
-    hierarchical_reduce_scatter,
     reduce_scatter,
 )

@@ -54,6 +54,12 @@ from ..lang.primitives import Team
 @dataclasses.dataclass(frozen=True)
 class AllToAllConfig:
     chunk: int = 128   # rows per DMA descriptor (the static payload shape)
+    # static peer-offset emission order (a permutation of range(n)); None
+    # = the default stagger (offset p at step p).  The hierarchical
+    # scheduled A2A (comm.hierarchical) passes the topology-derived
+    # farthest-first order so long-path chunks launch before short-path
+    # ones (the FAST chunk-schedule shape, arXiv:2505.09764).
+    schedule: tuple[int, ...] | None = None
 
 
 def _cdiv(a, b):
@@ -72,20 +78,29 @@ def _a2a_push_kernel(
     out_ref,      # (n, z, h) landing zones by source rank         [ANY]
     send_sem,
     recv_sems,    # (n,) per-source arrival
+    *,
+    schedule: tuple[int, ...] | None = None,
 ):
     """Push ``counts[p]`` rows (as ceil/chunk fixed-shape DMAs) to every
     peer ``p``'s zone ``me`` and wait for ``expected[p]`` rows from each —
     the shared body of dispatch and combine (combine swaps the count
     roles).  Zones are per-SOURCE, so the chunk round-up of one sender can
     never spill into another sender's rows — the reason both directions
-    land in zones and exact packing is a local gather afterwards."""
+    land in zones and exact packing is a local gather afterwards.
+
+    ``schedule``: static peer-offset emission order (see
+    ``AllToAllConfig.schedule``); waits are unordered by emission, so any
+    permutation preserves the protocol (the registry's
+    ``all_to_all/scheduled`` case proves it per rank count)."""
     me, n = team.rank(), team.size
 
     dl.collective_prologue(team)
 
+    offsets = schedule if schedule is not None else tuple(range(n))
     total_sent = jnp.int32(0)
-    for p in range(n):
-        # stagger destinations so the ring isn't hot-spotted
+    for p in offsets:
+        # stagger destinations so the ring isn't hot-spotted; a schedule
+        # reorders the offsets, keeping the per-rank rotation
         dst = jax.lax.rem(me + jnp.int32(p), jnp.int32(n))
         cnt = counts_ref[dst]
         nch = _cdiv(cnt, chunk)
@@ -119,11 +134,13 @@ def _a2a_push_kernel(
 
 
 def _make_push_call(team: Team, chunk: int, z: int, h: int, n: int,
-                    family: str, dtype: jnp.dtype):
+                    family: str, dtype: jnp.dtype,
+                    schedule: tuple[int, ...] | None = None):
     compilation.verify_protocol(family, n)   # aliases to all_to_all
     from ..obs import costs
 
-    kernel = functools.partial(_a2a_push_kernel, team, chunk, z, h)
+    kernel = functools.partial(_a2a_push_kernel, team, chunk, z, h,
+                               schedule=schedule)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n, z, h), dtype),
@@ -161,10 +178,12 @@ def _per_peer_meta(splits_loc, n: int, epr: int):
 
 @functools.lru_cache(maxsize=None)
 def _build_dispatch(mesh: Mesh, axis: str, t_pad: int, h: int, epr: int,
-                    chunk: int, z: int, dtype: jnp.dtype):
+                    chunk: int, z: int, dtype: jnp.dtype,
+                    schedule: tuple[int, ...] | None = None):
     team = Team.of(mesh, axis)
     n = team.size
-    call = _make_push_call(team, chunk, z, h, n, "ep_dispatch", dtype)
+    call = _make_push_call(team, chunk, z, h, n, "ep_dispatch", dtype,
+                           schedule)
 
     def local_fn(x_loc, splits_loc):
         per_peer, offs = _per_peer_meta(splits_loc, n, epr)
@@ -185,10 +204,12 @@ def _build_dispatch(mesh: Mesh, axis: str, t_pad: int, h: int, epr: int,
 
 @functools.lru_cache(maxsize=None)
 def _build_combine(mesh: Mesh, axis: str, h: int, epr: int,
-                   chunk: int, z: int, t: int, dtype: jnp.dtype):
+                   chunk: int, z: int, t: int, dtype: jnp.dtype,
+                   schedule: tuple[int, ...] | None = None):
     team = Team.of(mesh, axis)
     n = team.size
-    call = _make_push_call(team, chunk, z, h, n, "ep_combine", dtype)
+    call = _make_push_call(team, chunk, z, h, n, "ep_combine", dtype,
+                           schedule)
 
     def local_fn(y_loc, splits_loc):
         # roles reversed: I send zone p's rows (expected[p] of them) back
@@ -309,19 +330,25 @@ def _ep_combine_bwd(mesh, axis, cfg, token_dim, res, dback):
 _ep_combine_diff.defvjp(_ep_combine_fwd, _ep_combine_bwd)
 
 
-def _resolve_a2a_config(name: str, t: int, h: int, dtype, n: int,
+def _resolve_a2a_config(name: str, t: int, h: int, dtype, mesh, axis: str,
                         tracing: bool, make_thunk) -> AllToAllConfig:
     """``config=None`` hook of the EP all-to-all entries: the chunk
     sweep (``tune.autotuner.a2a_chunk_candidates``) resolved through the
     shared machinery — cached winner if one exists (jit'd layer calls
     included), measured when transparent tuning may run, the
-    interpret-pinned 128-row default otherwise."""
-    from ..core import platform
+    interpret-pinned 128-row default otherwise.  The contextual key
+    carries the axis's WIRE CLASS (ISSUE 10): a chunk size crowned on the
+    ICI torus must never leak onto a DCN edge, whose latency/bandwidth
+    point favors different descriptor granularity."""
+    from ..core import mesh as mesh_lib, platform
     from ..tune.autotuner import a2a_chunk_candidates, resolve_config
 
+    n = mesh.shape[axis]
     cands = a2a_chunk_candidates(AllToAllConfig, t)
     return resolve_config(
-        name, (t, h, str(dtype), n, platform.device_kind()),
+        name,
+        (t, h, str(dtype), n, mesh_lib.wire_class(mesh, axis),
+         platform.device_kind()),
         cands, cands[0], make_thunk, tracing=tracing,
     )
 
@@ -361,6 +388,17 @@ def ep_dispatch(
     from .. import obs, resilience
     from ..tune.autotuner import is_tracer
 
+    if isinstance(axis, (tuple, list)):
+        # 2D-mesh routing (ISSUE 10): axis=(outer, inner) — outermost
+        # first, matching the mesh axis order — runs the topology-
+        # scheduled two-level A2A (DCN phase first, scheduled ICI phase
+        # pipelining underneath)
+        from . import hierarchical
+
+        outer_axis, inner_axis = axis
+        return hierarchical.scheduled_ep_dispatch(
+            x, splits, mesh, inner_axis, outer_axis, config=config,
+            wire_dtype=wire_dtype)
     n = mesh.shape[axis]
     t = x.shape[0] // max(n, 1)
     eager = not (is_tracer(x) or is_tracer(splits))
@@ -388,7 +426,7 @@ def ep_dispatch(
         # cached winner / measured / interpret-pinned default — the
         # config=None path consults the same winner cache the GEMM ops do
         config = _resolve_a2a_config("ep_dispatch_cfg", t, x.shape[1],
-                                     x.dtype, n, not eager,
+                                     x.dtype, mesh, axis, not eager,
                                      lambda c: (lambda: ep_dispatch(
                                          x, splits, mesh, axis, config=c)))
     cfg = config or AllToAllConfig()
@@ -448,7 +486,7 @@ def _ep_dispatch_run(mesh, axis, cfg, x, splits):
     x_p = jnp.pad(x.reshape(n, t, h), ((0, 0), (0, t_pad - t), (0, 0)))
     x_p = x_p.reshape(n * t_pad, h)
     fn = _build_dispatch(mesh, axis, t_pad, h, epr, chunk, z,
-                         jnp.dtype(x.dtype))
+                         jnp.dtype(x.dtype), cfg.schedule)
     recv, recv_splits = fn(x_p, splits.astype(jnp.int32))
     return recv.reshape(n * n, z, h), recv_splits.reshape(n * n, epr)
 
@@ -477,6 +515,14 @@ def ep_combine(
     from .. import obs, resilience
     from ..tune.autotuner import is_tracer
 
+    if isinstance(axis, (tuple, list)):
+        # 2D-mesh routing (ISSUE 10): see ep_dispatch
+        from . import hierarchical
+
+        outer_axis, inner_axis = axis
+        return hierarchical.scheduled_ep_combine(
+            y, splits, mesh, inner_axis, outer_axis, token_dim=token_dim,
+            config=config, wire_dtype=wire_dtype)
     n = mesh.shape[axis]
     eager = not (is_tracer(y) or is_tracer(splits))
     if wire_dtype != "bf16" and n > 1:
@@ -501,7 +547,8 @@ def ep_combine(
     if config is None and n > 1:
         # see ep_dispatch: the chunk sweep shares the tuner machinery
         config = _resolve_a2a_config("ep_combine_cfg", token_dim,
-                                     y.shape[-1], y.dtype, n, not eager,
+                                     y.shape[-1], y.dtype, mesh, axis,
+                                     not eager,
                                      lambda c: (lambda: ep_combine(
                                          y, splits, mesh, axis,
                                          token_dim=token_dim, config=c)))
@@ -545,5 +592,6 @@ def _ep_combine_run(mesh, axis, cfg, token_dim, y, splits):
     epr = e_tot // n
     t = token_dim
     chunk = min(cfg.chunk, _round_up(t, 8))
-    fn = _build_combine(mesh, axis, h, epr, chunk, z, t, jnp.dtype(y.dtype))
+    fn = _build_combine(mesh, axis, h, epr, chunk, z, t, jnp.dtype(y.dtype),
+                        cfg.schedule)
     return fn(y, splits.astype(jnp.int32))

@@ -218,33 +218,6 @@ def _build_all_gather(
     )
 
 
-@functools.lru_cache(maxsize=None)
-def _build_hierarchical(
-    mesh: Mesh,
-    inner_axis: str,
-    outer_axis: str,
-    method: AllGatherMethod,
-    shard_shape: tuple[int, ...],
-    dtype: jnp.dtype,
-):
-    n_in = mesh.shape[inner_axis]
-    n_out = mesh.shape[outer_axis]
-    call = _build_ag_call(mesh, inner_axis, method, shard_shape, dtype)
-    m_in = n_in * shard_shape[0]
-
-    def local(x_loc):
-        inner_g = call(x_loc)                            # ICI Pallas ring
-        outer_g = jax.lax.all_gather(inner_g, outer_axis)   # DCN via XLA
-        return outer_g.reshape(n_out * m_in, *shard_shape[1:])
-
-    ndim = len(shard_shape)
-    return compilation.jit_shard_map(
-        local, mesh,
-        in_specs=P((outer_axis, inner_axis), *([None] * (ndim - 1))),
-        out_specs=P(*([None] * ndim)),
-    )
-
-
 def hierarchical_all_gather(
     x: jax.Array,
     mesh: Mesh,
@@ -252,37 +225,16 @@ def hierarchical_all_gather(
     outer_axis: str,
     *,
     method: AllGatherMethod = AllGatherMethod.AUTO,
+    wire_dtype: str = "bf16",
 ) -> jax.Array:
-    """Two-level AllGather over an (outer x inner) mesh — the reference's
-    2D inter-node AG (``allgather.py:442-601``: intra-node copy-engine ring
-    + cross-node staging).
+    """Two-level AllGather (ICI Pallas ring per slice + DCN XLA gather).
+    Canonical implementation: ``comm.hierarchical`` (ISSUE 10 — the
+    observe/survive-wrapped, DCN-wire-codec-composing entry); this name
+    stays importable here for the historic call sites."""
+    from .hierarchical import hierarchical_all_gather as _hier
 
-    TPU mapping: the ``inner_axis`` (ICI — within a slice) level is this
-    module's Pallas ring/push kernel; the ``outer_axis`` (DCN — across
-    slices) level is ``lax.all_gather``, because TPU remote DMA is
-    device-initiated only over ICI — cross-slice traffic must ride XLA's
-    DCN collectives (SURVEY.md section 7).  Rows come back in GLOBAL rank
-    order (outer-major), matching a flat AG over a combined axis.
-
-    ``x``: (n_out * n_in * M, R) sharded over both axes on dim 0.
-    """
-    n_in = mesh.shape[inner_axis]
-    n_out = mesh.shape[outer_axis]
-    if n_out == 1:
-        return all_gather(x, mesh, inner_axis, method=method)
-    m_total = x.shape[0]
-    if m_total % (n_in * n_out):
-        raise ValueError(
-            f"dim0 {m_total} not divisible by "
-            f"{outer_axis}*{inner_axis} = {n_out * n_in}"
-        )
-    m_local = m_total // (n_in * n_out)
-    shard_shape = (m_local, *x.shape[1:])
-    method = resolve_method(method, shard_shape, x.dtype, n_in)
-    fn = _build_hierarchical(
-        mesh, inner_axis, outer_axis, method, shard_shape, jnp.dtype(x.dtype)
-    )
-    return fn(x)
+    return _hier(x, mesh, inner_axis, outer_axis, method=method,
+                 wire_dtype=wire_dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
@@ -330,7 +282,18 @@ def all_gather(
     per-row quantized payload + scale sidecar into one u8 message —
     ``comm.quantized``), or "auto" (the contextual tuner picks per
     shape/ranks/WIRE CLASS; bf16 is the never-lose baseline).
+
+    ``axis`` may be a 2-tuple ``(outer, inner)`` (outermost first) on a
+    2D multi-slice mesh: the call routes to the hierarchical entry
+    (``comm.hierarchical`` — ICI ring per slice, DCN gather across).
     """
+    if isinstance(axis, (tuple, list)):
+        from . import hierarchical
+
+        outer_axis, inner_axis = axis
+        return hierarchical.hierarchical_all_gather(
+            x, mesh, inner_axis, outer_axis, method=method,
+            wire_dtype=wire_dtype)
     n = mesh.shape[axis]
     if n == 1:
         return x
@@ -360,15 +323,18 @@ def all_gather(
         # the size threshold is only a default: when the contextual tuner
         # may measure (eager, real hardware), the method choice itself is
         # tuner-resolved per shape class (VERDICT weak #7: thresholds are
-        # MTU-ish constants a measurement should replace)
-        from ..core import platform
+        # MTU-ish constants a measurement should replace).  The key
+        # carries the axis's WIRE CLASS (ISSUE 10): a method crowned on
+        # the ICI torus must never leak onto a DCN edge.
+        from ..core import mesh as mesh_lib, platform
         from ..tune.autotuner import is_tracer, resolve_config
 
         cands = [AllGatherMethod.PUSH_1SHOT, AllGatherMethod.RING_BIDIR,
                  AllGatherMethod.RING_1D]
         method = resolve_config(
             "ag_method",
-            (shard_shape, str(x.dtype), n, platform.device_kind()),
+            (shard_shape, str(x.dtype), n, mesh_lib.wire_class(mesh, axis),
+             platform.device_kind()),
             cands, resolve_method(method, shard_shape, x.dtype, n),
             lambda mth: (lambda: all_gather(x, mesh, axis, method=mth)),
             tracing=is_tracer(x),

@@ -254,41 +254,6 @@ def _build_all_reduce(
     )
 
 
-@functools.lru_cache(maxsize=None)
-def _build_hierarchical(
-    mesh: Mesh,
-    inner_axis: str,
-    outer_axis: str,
-    m: int,
-    r_dim: int,
-    dtype: jnp.dtype,
-    cfg: AllReduceConfig,
-):
-    from .allgather import AllGatherMethod, _build_ag_call, resolve_method
-    from .reduce_scatter import ReduceScatterConfig, _build_rs_call
-
-    n_in = mesh.shape[inner_axis]
-    m_loc = m // n_in
-    rs_cfg = ReduceScatterConfig(bm=cfg.bm, bn=cfg.bn).clip(m_loc, r_dim)
-    rs_call = _build_rs_call(mesh, inner_axis, m_loc, r_dim, dtype, rs_cfg)
-    ag_method = resolve_method(
-        AllGatherMethod.AUTO, (m_loc, r_dim), dtype, n_in
-    )
-    ag_call = _build_ag_call(mesh, inner_axis, ag_method, (m_loc, r_dim),
-                             dtype)
-
-    def local(x_loc):
-        part = rs_call(x_loc)                 # ICI ring ReduceScatter
-        part = jax.lax.psum(part, outer_axis)  # DCN via XLA
-        return ag_call(part)                  # ICI ring AllGather
-
-    return compilation.jit_shard_map(
-        local, mesh,
-        in_specs=P((outer_axis, inner_axis), None),
-        out_specs=P(None, None),
-    )
-
-
 def hierarchical_all_reduce(
     x: jax.Array,
     mesh: Mesh,
@@ -296,34 +261,16 @@ def hierarchical_all_reduce(
     outer_axis: str,
     *,
     config: AllReduceConfig | None = None,
+    wire_dtype: str = "bf16",
 ) -> jax.Array:
-    """Two-level AllReduce over an (outer x inner) mesh: RS ring on ICI,
-    ``psum`` across slices on DCN, AG ring on ICI — the ring-tree shape of
-    the reference's hierarchical AR (its DoubleTree/2D variants,
-    ``allreduce.py:224``, and the 2D RS hierarchy it composes with).
+    """Two-level AllReduce (ICI RS ring -> DCN reduce of the 1/n_in
+    partial -> ICI AG ring).  Canonical implementation:
+    ``comm.hierarchical`` (ISSUE 10); this name stays importable here
+    for the historic call sites."""
+    from .hierarchical import hierarchical_all_reduce as _hier
 
-    ``x``: global ``(N*M, R)`` over both axes (outer-major), each device's
-    (M, R) shard its partial addend; returns (M, R) replicated.  Golden:
-    ``x.reshape(N, M, R).sum(0)``.
-    """
-    n_in = mesh.shape[inner_axis]
-    n_out = mesh.shape[outer_axis]
-    if n_out == 1:
-        return all_reduce(x, mesh, inner_axis, config=config)
-    n = n_in * n_out
-    m_stack = x.shape[0]
-    if m_stack % n:
-        raise ValueError(f"dim0 {m_stack} not divisible by N={n}")
-    m = m_stack // n
-    if m % n_in:
-        raise ValueError(
-            f"partial rows {m} not divisible by {inner_axis}={n_in}"
-        )
-    cfg = (config or AllReduceConfig()).clip(m // n_in, x.shape[1])
-    fn = _build_hierarchical(
-        mesh, inner_axis, outer_axis, m, x.shape[1], jnp.dtype(x.dtype), cfg
-    )
-    return fn(x)
+    return _hier(x, mesh, inner_axis, outer_axis, config=config,
+                 wire_dtype=wire_dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
@@ -378,7 +325,18 @@ def all_reduce(
     two-hop exchange — ``comm.quantized.quantized_all_reduce``; its
     error-feedback option lives on that entry), or "auto"
     (tuner-resolved per shape/ranks/wire class).
+
+    ``axis`` may be a 2-tuple ``(outer, inner)`` on a 2D multi-slice
+    mesh: routes to ``comm.hierarchical`` (RS ∘ AG, the DCN hop carrying
+    1/n_in of the payload).
     """
+    if isinstance(axis, (tuple, list)):
+        from . import hierarchical
+
+        outer_axis, inner_axis = axis
+        return hierarchical.hierarchical_all_reduce(
+            x, mesh, inner_axis, outer_axis, config=config,
+            wire_dtype=wire_dtype)
     n = mesh.shape[axis]
     m_stack = x.shape[0]
     if m_stack % n:
@@ -413,8 +371,9 @@ def all_reduce(
         else:
             # size threshold is only the default; the contextual tuner
             # resolves the one-shot/two-shot choice per shape class when
-            # it may measure (VERDICT weak #7)
-            from ..core import platform
+            # it may measure (VERDICT weak #7); wire class in the key
+            # (ISSUE 10) so winners cannot leak across topologies
+            from ..core import mesh as mesh_lib, platform
             from ..tune.autotuner import is_tracer, resolve_config
 
             cands = [AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT]
@@ -425,7 +384,8 @@ def all_reduce(
             probe_cfg = config if config is not None else AllReduceConfig()
             method = resolve_config(
                 "ar_method",
-                (m, x.shape[1], str(x.dtype), n, platform.device_kind()),
+                (m, x.shape[1], str(x.dtype), n,
+                 mesh_lib.wire_class(mesh, axis), platform.device_kind()),
                 cands, default,
                 lambda mth: (lambda: all_reduce(x, mesh, axis, method=mth,
                                                 config=probe_cfg,
@@ -447,7 +407,7 @@ def all_reduce(
         # measured when transparent tuning may run, and the
         # interpret-pinned default otherwise (interpret-mode timings are
         # simulation artifacts — resolve_config already refuses them)
-        from ..core import platform
+        from ..core import mesh as mesh_lib, platform
         from ..tune.autotuner import (
             collective_tile_candidates, resolve_config,
         )
@@ -455,7 +415,7 @@ def all_reduce(
         config = resolve_config(
             "ar_cfg",
             (m, x.shape[1], str(x.dtype), n, method.value,
-             platform.device_kind()),
+             mesh_lib.wire_class(mesh, axis), platform.device_kind()),
             collective_tile_candidates(AllReduceConfig, rows, x.shape[1]),
             AllReduceConfig().clip(rows, x.shape[1]),
             lambda c: (lambda: all_reduce(x, mesh, axis, method=method,

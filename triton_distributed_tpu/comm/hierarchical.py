@@ -1,0 +1,979 @@
+"""Hierarchical multi-slice collectives: ICI x DCN (ISSUE 10).
+
+Everything in ``comm/`` below this module assumes a single ICI slice.
+This module is the multi-slice layer — the TPU rendering of the
+reference's ``CommScope`` intra/inter-node split (``DistributedAttrDefs
+.td:45``; SURVEY.md section 7 "Inter-slice (DCN)"): collectives on a 2D
+``(outer x inner)`` mesh run the existing Pallas ring kernels WITHIN each
+slice (ICI — device-initiated remote DMA) and XLA collectives ACROSS
+slices (DCN — remote DMA is ICI-only, so cross-slice traffic must ride
+XLA's wire), composed so the slow wire carries the minimum payload:
+
+- **AllGather**   = intra-slice ring, then inter-slice broadcast of the
+  slice blocks (``lax.all_gather`` over the outer axis).
+- **ReduceScatter** = intra-slice ring reduce, then inter-slice reduce of
+  the 1/n_in partials (``psum_scatter`` over the outer axis).
+- **AllReduce**   = RS ∘ AG: intra RS ring -> inter-slice reduce of the
+  1/n_in partial -> intra AG ring.  The DCN hop carries **1/n_in of the
+  payload per chip** — the bound ``bench.py hier`` claims-gates.
+- **EP all-to-all** = a two-phase scheduled exchange: the DCN phase
+  (tokens bound for other slices, ``lax.all_to_all`` over the outer
+  axis) launches FIRST so the slow wire saturates early, then the
+  intra-slice Pallas push kernel runs with a topology-derived
+  farthest-first chunk emission order pipelining underneath — the FAST
+  chunk-schedule shape (arXiv:2505.09764), with the congestion argument
+  of the lightweight-NoC-collective line (arXiv:2603.26438): keep the
+  bottleneck wire busy, order the fast wire's chunks longest-path-first.
+
+The schedule's topology model is the measured ``tools.calibrate
+.LinkCalibration`` (per-wire-class bandwidth/latency + persisted slice
+topology); cold start falls back to the documented chip-table numbers,
+so behavior without a calibration run is deterministic.
+
+DCN payloads compose with the PR-9 ``wire_dtype`` codecs
+(``lang.quant``): ``wire_dtype="auto"`` quantizes the INTER-SLICE hop
+(and only it — the ICI level keeps the model dtype) exactly when
+``tools.calibrate.codec_pays("dcn")`` says the halved payload beats the
+codec cost, which with cold-start numbers reproduces the measured
+BENCH-r04 policy (codec pays on DCN, not on the ICI torus).
+
+Record-mode protocol models: the DCN hop is an XLA collective in
+production, but its ordering/credit contract — every slice block landed
+before phase 2 consumes it — is part of the two-level protocol.
+``dcn_broadcast_model`` / ``dcn_reduce_model`` express that contract in
+the ``lang.primitives`` vocabulary so the static verifier, the fault
+matrix (including the dropped-inter-slice-credit class), and the
+watchdog's pending-wait diagnosis cover the composition at the
+{2x2, 2x4, 4x2} slice layouts (``analysis.registry._hier_cases``).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core import compilation
+from ..lang import primitives as dl
+
+# ---------------------------------------------------------------------------
+# topology + schedule policy
+
+
+def slice_axes(mesh: Mesh) -> tuple[str, str] | None:
+    """(inner_axis, outer_axis) of a hierarchical mesh: the outermost
+    DCN-class axis (size > 1) and the innermost ICI-class axis; None when
+    the mesh has no multi-slice axis (single-slice — use the flat
+    entries)."""
+    from ..core import mesh as mesh_lib
+
+    outer = None
+    for name in mesh.axis_names:
+        if mesh.shape[name] > 1 and mesh_lib.wire_class(mesh, name) == "dcn":
+            outer = name
+            break
+    if outer is None:
+        return None
+    for name in reversed(mesh.axis_names):
+        if name != outer and mesh_lib.wire_class(mesh, name) == "ici":
+            return name, outer
+    return None
+
+
+def ici_schedule(n: int) -> tuple[int, ...]:
+    """Intra-slice peer-offset emission order: farthest-first on the ring
+    (longest-path chunks launch first and pipeline under near-neighbor
+    traffic), self (offset 0 — no wire) last.  Deterministic, identical
+    on every rank (each rank applies it to its own rotation), so ranks
+    never diverge on the schedule."""
+    if n <= 1:
+        return (0,) * n
+    offs = sorted(range(1, n), key=lambda o: (-min(o, n - o), -o))
+    return (*offs, 0)
+
+
+def chunk_schedule(n_out: int, n_in: int, cal=None) -> tuple[tuple[int, int], ...]:
+    """Global chunk-group emission order on an (n_out x n_in) topology:
+    ``(slice_offset, inner_offset)`` pairs, every group on the SLOWER
+    wire class before any on the faster one (the FAST rule: saturate the
+    bottleneck wire first), farthest-first within each class, the
+    self-group (0, 0) last.  The wire ordering comes from the calibrated
+    ``LinkCalibration`` when one exists; the cold-start chip table says
+    DCN << ICI, so cold behavior is DCN-first."""
+    from ..tools import calibrate, perf_model
+
+    if cal is None:
+        cal = calibrate.load_calibration()
+    ici_bw = (cal.ici_gbps if cal is not None and cal.ici_gbps
+              else perf_model.chip_spec().ici_gbps)
+    dcn_bw = (cal.dcn_gbps if cal is not None and cal.dcn_gbps
+              else perf_model.DCN_GBPS_PER_CHIP)
+    dcn = [(o, i) for o in range(1, n_out) for i in range(n_in)]
+    dcn.sort(key=lambda t: (-min(t[0], n_out - t[0]),
+                            -min(t[1], n_in - t[1] if t[1] else 0), -t[0],
+                            -t[1]))
+    ici = [(0, off) for off in ici_schedule(n_in) if off != 0]
+    first, second = (dcn, ici) if dcn_bw <= ici_bw else (ici, dcn)
+    return (*first, *second, (0, 0))
+
+
+def resolve_dcn_wire(wire_dtype: str, h: int) -> str:
+    """The DCN-hop payload dtype: explicit dtypes pass through; ``auto``
+    resolves by the measured codec economics on the slow wire
+    (``tools.calibrate.codec_pays`` at the row width the hop actually
+    ships) — the PR-9 policy, applied to the one hop where it pays."""
+    if wire_dtype != "auto":
+        return wire_dtype
+    from ..tools import calibrate
+
+    return "fp8" if calibrate.codec_pays("dcn", int(h)) else "bf16"
+
+
+# ---------------------------------------------------------------------------
+# record-mode protocol models of the DCN hop (see module docstring)
+
+
+def dcn_broadcast_model(n_out: int, n_in: int, src_ref, zones_ref, send_sem,
+                        recv_sems) -> None:
+    """Protocol model of the inter-slice broadcast (production:
+    ``lax.all_gather``/``lax.all_to_all`` over the outer axis): rank
+    (o, i) pushes its slice block to the same-i rank of every other
+    slice — landing in the per-SOURCE-slice zone ``zones[o]``, so no two
+    slices' blocks can overlap — then consumes one arrival credit per
+    source slice and drains its sends.  The credit consumption is the
+    contract phase 2 relies on (a dropped inter-slice credit is the
+    seeded-bad fixture and a fault-matrix class)."""
+    o = dl.rank("dcn")
+    i = dl.rank("tp")
+    for off in range(1, n_out):
+        dst_o = (o + off) % n_out
+        dl.remote_copy(src_ref, zones_ref.at[o], send_sem, recv_sems.at[o],
+                       dst_o * n_in + i)
+    for off in range(1, n_out):
+        src_o = (o + n_out - off) % n_out
+        dl.wait_recv(zones_ref.at[src_o], recv_sems.at[src_o])
+    for _ in range(n_out - 1):
+        dl.wait_send(src_ref, send_sem)
+
+
+def dcn_reduce_model(n_out: int, n_in: int, part_ref, zones_ref, out_ref,
+                     send_sem, recv_sems, out_dtype, m: int, r: int) -> None:
+    """Protocol model of the inter-slice reduction (production:
+    ``lax.psum`` / ``psum_scatter`` over the outer axis): the broadcast
+    exchange of 1/n_in partials, then the local n_out-way sum — the same
+    one-shot exchange shape the quantized DCN-AR option ships for real."""
+    from ..ops import blocks
+
+    dcn_broadcast_model(n_out, n_in, part_ref, zones_ref, send_sem,
+                        recv_sems)
+    reduce = blocks.make_sum_pipeline(n_out, m, r, min(m, 256), min(r, 512),
+                                      out_dtype)
+    o = dl.rank("dcn")
+    ins = [zones_ref.at[src_o] for src_o in range(n_out) if src_o != o]
+    reduce(part_ref, *ins, out_ref)
+
+
+# ---------------------------------------------------------------------------
+# byte accounting (per chip) — shared by the obs counters, the watchdog
+# pricing (tools.perf_model), and `bench.py hier`
+
+
+def _packed_bytes(rows: int, r: int, dtype, wire: str) -> int:
+    from ..lang import quant
+
+    if wire == "bf16":
+        return rows * r * int(jnp.dtype(dtype).itemsize)
+    return rows * quant.packed_width(r, wire)
+
+
+def hier_ag_wire_bytes(m_local: int, r: int, dtype, n_in: int, n_out: int,
+                       dcn_wire: str = "bf16") -> tuple[int, int]:
+    """(ici_bytes, dcn_bytes) one hierarchical AllGather moves per chip:
+    the inner ring forwards (n_in-1) shards; the outer broadcast lands
+    (n_out-1) slice blocks of n_in shards each."""
+    ib = int(jnp.dtype(dtype).itemsize)
+    ici = (n_in - 1) * m_local * r * ib
+    dcn = (n_out - 1) * _packed_bytes(n_in * m_local, r, dtype, dcn_wire)
+    return ici, dcn
+
+
+def hier_rs_wire_bytes(m_partial: int, r: int, dtype, n_in: int,
+                       n_out: int) -> tuple[int, int]:
+    """(ici_bytes, dcn_bytes) per chip for the hierarchical RS: inner
+    ring reduce of the m_partial rows (n_in-1 chunk hops), then
+    ``psum_scatter`` of the (m_partial/n_in)-row partial across slices
+    ((n_out-1)/n_out of it on the wire)."""
+    ib = int(jnp.dtype(dtype).itemsize)
+    chunk = m_partial // n_in
+    ici = (n_in - 1) * chunk * r * ib
+    dcn = (n_out - 1) * chunk * r * ib // n_out
+    return ici, dcn
+
+
+def hier_ar_wire_bytes(m: int, r: int, dtype, n_in: int, n_out: int,
+                       dcn_wire: str = "bf16") -> tuple[int, int]:
+    """(ici_bytes, dcn_bytes) per chip for the hierarchical AllReduce
+    (RS ∘ AG): the two inner rings move 2(n_in-1)/n_in of the partial;
+    the DCN hop reduces only the (m/n_in)-row partial — ring ``psum`` =
+    2(n_out-1)/n_out of it, quantized one-shot = (n_out-1) packed
+    copies.  At n_out=2 both forms sit exactly at the RS∘AG bound of
+    1/n_in of the payload per chip."""
+    ib = int(jnp.dtype(dtype).itemsize)
+    partial = m * r * ib
+    ici = 2 * (n_in - 1) * partial // n_in
+    part_rows = m // n_in
+    if dcn_wire == "bf16":
+        dcn = 2 * (n_out - 1) * part_rows * r * ib // n_out
+    else:
+        dcn = (n_out - 1) * _packed_bytes(part_rows, r, dtype, dcn_wire)
+    return ici, dcn
+
+
+def hier_a2a_wire_bytes(t: int, h: int, dtype, n_in: int, n_out: int,
+                        dcn_wire: str = "bf16") -> tuple[int, int]:
+    """(ici_bytes, dcn_bytes) per chip for the scheduled EP A2A.  The
+    DCN phase ships FIXED zero-padded t-row blocks (static shapes are
+    the XLA collective's contract), one per foreign slice — so
+    (n_out-1) full blocks cross the slow wire regardless of routing;
+    the ICI phase redistributes up to the n_out·t merged rows within
+    the slice."""
+    ici = n_out * t * h * int(jnp.dtype(dtype).itemsize)
+    dcn = (n_out - 1) * _packed_bytes(t, h, dtype, dcn_wire)
+    return ici, dcn
+
+
+# ---------------------------------------------------------------------------
+# shared entry plumbing
+
+
+def _validate_2d(mesh: Mesh, inner_axis: str, outer_axis: str):
+    n_in = mesh.shape[inner_axis]
+    n_out = mesh.shape[outer_axis]
+    return n_in, n_out
+
+
+def _wrap(op: str, core, *, mesh, n_in: int, n_out: int, payload: int,
+          ici_bytes: int, dcn_bytes: int, method: str, chunks: int,
+          fallback, eager: bool):
+    """The uniform observe/survive wrapper of the hierarchical entries:
+    watchdog deadline priced per wire class per level (the two-level
+    ``tools.perf_model`` terms), retry->XLA-fallback->breaker ladder, and
+    obs accounting that splits the wire bytes by class (``comm_wire_bytes``
+    carries the total; ``comm_dcn_bytes`` the slow-wire share the bench
+    claims-gate reads)."""
+    from .. import obs, resilience
+
+    n = n_in * n_out
+    if eager and resilience.enabled():
+        core = resilience.guarded(
+            op, core, family="hierarchical", ranks=n,
+            payload_bytes=payload, fallback=fallback,
+            topology=(n_out, n_in),
+        )
+    if eager and (obs.enabled() or obs.flight.enabled()):
+        inner_core = core
+
+        def counted():
+            if obs.enabled():
+                obs.counter("comm_dcn_bytes", op=op, method=method).inc(
+                    dcn_bytes)
+            return inner_core()
+
+        return lambda: obs.comm_call(
+            op, counted, payload_bytes=payload,
+            wire_bytes=ici_bytes + dcn_bytes, chunks=chunks,
+            method=method, ranks=n,
+        )
+    return core
+
+
+# ---------------------------------------------------------------------------
+# AllGather
+
+
+@functools.lru_cache(maxsize=None)
+def _build_hier_ag(mesh: Mesh, inner_axis: str, outer_axis: str, method,
+                   shard_shape: tuple[int, ...], dtype: jnp.dtype,
+                   dcn_wire: str):
+    from .allgather import _build_ag_call
+
+    n_in = mesh.shape[inner_axis]
+    n_out = mesh.shape[outer_axis]
+    call = _build_ag_call(mesh, inner_axis, method, shard_shape, dtype)
+    m_in = n_in * shard_shape[0]
+
+    def local(x_loc):
+        inner_g = call(x_loc)                            # ICI Pallas ring
+        if dcn_wire == "bf16":
+            outer_g = jax.lax.all_gather(inner_g, outer_axis)  # DCN via XLA
+        else:
+            # quantize ONLY the inter-slice payload (codec_pays("dcn")):
+            # pack rows at the producer slice, u8 message on the DCN,
+            # dequantize on arrival — the ICI level stays model-dtype
+            from ..lang import quant
+
+            packed = quant.pack_rows(inner_g, dcn_wire)
+            gathered = jax.lax.all_gather(packed, outer_axis)
+            outer_g = quant.unpack_rows(
+                gathered.reshape(n_out * m_in, -1), shard_shape[-1],
+                dcn_wire, dtype,
+            )
+        return outer_g.reshape(n_out * m_in, *shard_shape[1:])
+
+    ndim = len(shard_shape)
+    return compilation.jit_shard_map(
+        local, mesh,
+        in_specs=P((outer_axis, inner_axis), *([None] * (ndim - 1))),
+        out_specs=P(*([None] * ndim)),
+    )
+
+
+def hierarchical_all_gather(
+    x: jax.Array,
+    mesh: Mesh,
+    inner_axis: str,
+    outer_axis: str,
+    *,
+    method=None,
+    wire_dtype: str = "bf16",
+) -> jax.Array:
+    """Two-level AllGather over an (outer x inner) mesh — the reference's
+    2D inter-node AG (``allgather.py:442-601``: intra-node copy-engine
+    ring + cross-node staging).
+
+    The ``inner_axis`` (ICI) level is the Pallas ring/push kernel of
+    ``comm.allgather``; the ``outer_axis`` (DCN) level is
+    ``lax.all_gather`` (remote DMA is device-initiated over ICI only —
+    SURVEY.md section 7).  Rows come back in GLOBAL rank order
+    (outer-major), matching a flat AG over a combined axis.
+
+    ``wire_dtype``: "bf16" ships as-is; "int8"/"fp8" quantize the DCN
+    payload (packed u8 message, ``lang.quant``); "auto" quantizes when
+    ``codec_pays("dcn")`` (the measured policy).  The ICI level always
+    ships the model dtype — the codec does not pay on the fast wire.
+
+    ``x``: (n_out * n_in * M, R) sharded over both axes on dim 0.
+    """
+    from .allgather import AllGatherMethod, all_gather, resolve_method
+    from ..tune.autotuner import is_tracer
+
+    if method is None:
+        method = AllGatherMethod.AUTO
+    n_in, n_out = _validate_2d(mesh, inner_axis, outer_axis)
+    if n_out == 1:
+        # numerically pinned to the flat single-level collective on a
+        # 1-slice mesh (the ISSUE-10 equivalence anchor)
+        return all_gather(x, mesh, inner_axis, method=method)
+    m_total = x.shape[0]
+    if m_total % (n_in * n_out):
+        raise ValueError(
+            f"dim0 {m_total} not divisible by "
+            f"{outer_axis}*{inner_axis} = {n_out * n_in}"
+        )
+    m_local = m_total // (n_in * n_out)
+    shard_shape = (m_local, *x.shape[1:])
+    method = resolve_method(method, shard_shape, x.dtype, n_in)
+    if x.ndim == 2:
+        dcn_wire = resolve_dcn_wire(wire_dtype, x.shape[-1])
+    elif wire_dtype in ("bf16", "auto"):
+        # "auto" resolves to the only honorable choice; an EXPLICIT
+        # quantized request on a non-row-shaped payload must fail loudly
+        # rather than silently ship full-width bytes
+        dcn_wire = "bf16"
+    else:
+        raise ValueError(
+            f"wire_dtype={wire_dtype!r} quantizes H-wide rows; a "
+            f"{x.ndim}-D payload has no row codec — reshape to (rows, H) "
+            f"or pass wire_dtype='bf16'"
+        )
+    compilation.verify_protocol("hierarchical", n_in * n_out)
+    fn = _build_hier_ag(mesh, inner_axis, outer_axis, method, shard_shape,
+                        jnp.dtype(x.dtype), dcn_wire)
+    eager = not is_tracer(x)
+    shard_bytes = math.prod(shard_shape) * jnp.dtype(x.dtype).itemsize
+    ici, dcn = hier_ag_wire_bytes(m_local, x.shape[-1] if x.ndim == 2 else 1,
+                                  x.dtype, n_in, n_out, dcn_wire) \
+        if x.ndim == 2 else (
+            (n_in - 1) * shard_bytes, (n_out - 1) * n_in * shard_bytes)
+
+    def fallback():
+        ndim = x.ndim
+        return compilation.jit_shard_map(
+            lambda v: jax.lax.all_gather(
+                v, (outer_axis, inner_axis), tiled=True),
+            mesh,
+            in_specs=P((outer_axis, inner_axis), *([None] * (ndim - 1))),
+            out_specs=P(*([None] * ndim)),
+        )(x)
+
+    core = _wrap(
+        "hier_all_gather", lambda: fn(x), mesh=mesh, n_in=n_in, n_out=n_out,
+        payload=shard_bytes, ici_bytes=ici, dcn_bytes=dcn,
+        method=f"{method.value}+dcn_{dcn_wire}",
+        chunks=(n_in - 1) + (n_out - 1), fallback=fallback, eager=eager,
+    )
+    return core()
+
+
+# ---------------------------------------------------------------------------
+# ReduceScatter
+
+
+@functools.lru_cache(maxsize=None)
+def _build_hier_rs(mesh: Mesh, inner_axis: str, outer_axis: str,
+                   m_partial: int, r_dim: int, dtype: jnp.dtype, cfg):
+    from .reduce_scatter import _build_rs_call
+
+    n_in = mesh.shape[inner_axis]
+    n_out = mesh.shape[outer_axis]
+    blk = m_partial // (n_in * n_out)
+    call = _build_rs_call(mesh, inner_axis, m_partial // n_in, r_dim, dtype,
+                          cfg)
+
+    def local(x_loc):
+        # Row blocks arrive in flat (outer-major global rank) order; the
+        # inner scatter picks by inner rank first, so transpose the block
+        # grid to inner-major — then chunk i / sub-block o is exactly
+        # global block o*n_in + i.
+        xp = (x_loc.reshape(n_out, n_in, blk, r_dim)
+              .transpose(1, 0, 2, 3).reshape(m_partial, r_dim))
+        part = call(xp)                               # ICI Pallas ring
+        return jax.lax.psum_scatter(                  # DCN via XLA
+            part, outer_axis, scatter_dimension=0, tiled=True
+        )
+
+    return compilation.jit_shard_map(
+        local, mesh,
+        in_specs=P((outer_axis, inner_axis), None),
+        out_specs=P((outer_axis, inner_axis), None),
+    )
+
+
+def hierarchical_reduce_scatter(
+    x: jax.Array,
+    mesh: Mesh,
+    inner_axis: str,
+    outer_axis: str,
+    *,
+    config=None,
+) -> jax.Array:
+    """Two-level ReduceScatter over an (outer x inner) mesh — the
+    reference's 2D intra+inter hierarchy (``reduce_scatter.py:688-882``,
+    ``ReduceScatter2DContext:46``): the inner ring of
+    ``comm.reduce_scatter`` per slice, ``psum_scatter`` across slices.
+    Semantics match a flat :func:`comm.reduce_scatter` over the combined
+    outer-major axis: golden ``x.reshape(N, M, R).sum(0)`` scattered in
+    global rank order.
+    """
+    from .reduce_scatter import ReduceScatterConfig, reduce_scatter
+    from ..tune.autotuner import is_tracer
+
+    n_in, n_out = _validate_2d(mesh, inner_axis, outer_axis)
+    if n_out == 1:
+        return reduce_scatter(x, mesh, inner_axis, config=config)
+    n = n_in * n_out
+    m_stack = x.shape[0]
+    if m_stack % n:
+        raise ValueError(f"dim0 {m_stack} not divisible by N={n}")
+    m_partial = m_stack // n
+    if m_partial % n:
+        raise ValueError(f"partial rows {m_partial} not divisible by N={n}")
+    cfg = (config or ReduceScatterConfig()).clip(m_partial // n_in,
+                                                 x.shape[1])
+    compilation.verify_protocol("hierarchical", n)
+    fn = _build_hier_rs(mesh, inner_axis, outer_axis, m_partial, x.shape[1],
+                        jnp.dtype(x.dtype), cfg)
+    eager = not is_tracer(x)
+    payload = m_partial * x.shape[1] * jnp.dtype(x.dtype).itemsize
+    ici, dcn = hier_rs_wire_bytes(m_partial, x.shape[1], x.dtype, n_in,
+                                  n_out)
+
+    def fallback():
+        return compilation.jit_shard_map(
+            lambda v: jax.lax.psum_scatter(
+                v, (outer_axis, inner_axis), scatter_dimension=0,
+                tiled=True),
+            mesh,
+            in_specs=P((outer_axis, inner_axis), None),
+            out_specs=P((outer_axis, inner_axis), None),
+        )(x)
+
+    core = _wrap(
+        "hier_reduce_scatter", lambda: fn(x), mesh=mesh, n_in=n_in,
+        n_out=n_out, payload=payload, ici_bytes=ici, dcn_bytes=dcn,
+        method="ring+dcn_scatter", chunks=(n_in - 1) + (n_out - 1),
+        fallback=fallback, eager=eager,
+    )
+    return core()
+
+
+# ---------------------------------------------------------------------------
+# AllReduce
+
+
+@functools.lru_cache(maxsize=None)
+def _build_hier_ar(mesh: Mesh, inner_axis: str, outer_axis: str, m: int,
+                   r_dim: int, dtype: jnp.dtype, cfg, dcn_wire: str):
+    from .allgather import AllGatherMethod, _build_ag_call, resolve_method
+    from .reduce_scatter import ReduceScatterConfig, _build_rs_call
+
+    n_in = mesh.shape[inner_axis]
+    n_out = mesh.shape[outer_axis]
+    m_loc = m // n_in
+    rs_cfg = ReduceScatterConfig(bm=cfg.bm, bn=cfg.bn).clip(m_loc, r_dim)
+    rs_call = _build_rs_call(mesh, inner_axis, m_loc, r_dim, dtype, rs_cfg)
+    ag_method = resolve_method(
+        AllGatherMethod.AUTO, (m_loc, r_dim), dtype, n_in
+    )
+    ag_call = _build_ag_call(mesh, inner_axis, ag_method, (m_loc, r_dim),
+                             dtype)
+
+    def local(x_loc):
+        part = rs_call(x_loc)                 # ICI ring ReduceScatter
+        if dcn_wire == "bf16":
+            part = jax.lax.psum(part, outer_axis)      # DCN via XLA
+        else:
+            # quantized one-shot DCN reduce: pack the 1/n_in partial,
+            # gather the n_out packed copies, dequantize + f32-sum
+            # locally (the comm.quantized exchange shape, on the hop
+            # where the codec pays)
+            from ..lang import quant
+
+            packed = quant.pack_rows(part, dcn_wire)
+            gathered = jax.lax.all_gather(packed, outer_axis)  # (n_out,...)
+            unpacked = quant.unpack_rows(gathered, r_dim, dcn_wire,
+                                         jnp.float32)
+            part = unpacked.sum(axis=0).astype(dtype)
+        return ag_call(part)                  # ICI ring AllGather
+
+    return compilation.jit_shard_map(
+        local, mesh,
+        in_specs=P((outer_axis, inner_axis), None),
+        out_specs=P(None, None),
+    )
+
+
+def dcn_ar_wire(wire_dtype: str, r_dim: int, n_out: int) -> str:
+    """The AllReduce DCN hop's payload dtype: the quantized one-shot
+    exchange ships (n_out-1) packed copies where ``psum``'s ring ships
+    2(n_out-1)/n_out bf16 — the codec wins only while
+    ``packed < 2*bf16/n_out``, i.e. on few-slice topologies (n_out <= 3
+    at the ~0.51x packing ratio).  ``auto`` applies that arithmetic on
+    top of :func:`resolve_dcn_wire`'s codec economics."""
+    wire = resolve_dcn_wire(wire_dtype, r_dim)
+    if wire == "bf16":
+        return wire
+    from ..lang import quant
+
+    if (n_out - 1) * quant.packed_width(r_dim, wire) \
+            >= 2 * (n_out - 1) * 2 * r_dim // n_out:
+        return "bf16"
+    return wire
+
+
+def hierarchical_all_reduce(
+    x: jax.Array,
+    mesh: Mesh,
+    inner_axis: str,
+    outer_axis: str,
+    *,
+    config=None,
+    wire_dtype: str = "bf16",
+) -> jax.Array:
+    """Two-level AllReduce over an (outer x inner) mesh: RS ring on ICI,
+    reduce across slices on DCN, AG ring on ICI — RS ∘ AG composed so the
+    DCN hop carries **1/n_in of the payload per chip** (the ring-tree
+    shape of the reference's hierarchical AR, ``allreduce.py:224``).
+
+    ``x``: global ``(N*M, R)`` over both axes (outer-major), each
+    device's (M, R) shard its partial addend; returns (M, R) replicated.
+    Golden: ``x.reshape(N, M, R).sum(0)``.
+
+    ``wire_dtype``: the DCN hop's payload — "auto" takes the quantized
+    one-shot exchange when the codec pays on the slow wire AND the
+    few-slice byte arithmetic favors it (:func:`dcn_ar_wire`); the ICI
+    rings always carry the model dtype.
+    """
+    from .allreduce import AllReduceConfig, all_reduce
+    from ..tune.autotuner import is_tracer
+
+    n_in, n_out = _validate_2d(mesh, inner_axis, outer_axis)
+    if n_out == 1:
+        return all_reduce(x, mesh, inner_axis, config=config)
+    n = n_in * n_out
+    m_stack = x.shape[0]
+    if m_stack % n:
+        raise ValueError(f"dim0 {m_stack} not divisible by N={n}")
+    m = m_stack // n
+    if m % n_in:
+        raise ValueError(
+            f"partial rows {m} not divisible by {inner_axis}={n_in}"
+        )
+    cfg = (config or AllReduceConfig()).clip(m // n_in, x.shape[1])
+    dcn_wire = dcn_ar_wire(wire_dtype, x.shape[1], n_out)
+    compilation.verify_protocol("hierarchical", n)
+    fn = _build_hier_ar(mesh, inner_axis, outer_axis, m, x.shape[1],
+                        jnp.dtype(x.dtype), cfg, dcn_wire)
+    eager = not is_tracer(x)
+    payload = m * x.shape[1] * jnp.dtype(x.dtype).itemsize
+    ici, dcn = hier_ar_wire_bytes(m, x.shape[1], x.dtype, n_in, n_out,
+                                  dcn_wire)
+
+    def fallback():
+        def local(v):
+            return jax.lax.psum(
+                v.reshape(n_in, m, x.shape[1]).sum(0),
+                (outer_axis, inner_axis))
+
+        return compilation.jit_shard_map(
+            local, mesh,
+            in_specs=P((outer_axis, inner_axis), None),
+            out_specs=P(None, None),
+        )(x)
+
+    core = _wrap(
+        "hier_all_reduce", lambda: fn(x), mesh=mesh, n_in=n_in, n_out=n_out,
+        payload=payload, ici_bytes=ici, dcn_bytes=dcn,
+        method=f"rs_ag+dcn_{dcn_wire}",
+        chunks=2 * (n_in - 1) + (n_out - 1), fallback=fallback, eager=eager,
+    )
+    return core()
+
+
+# ---------------------------------------------------------------------------
+# scheduled EP all-to-all (two-phase, DCN first)
+
+
+def _cdiv(a, b):
+    return (a + b - 1) // b
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def per_slice_meta(splits_loc, n_out: int, e_slice: int):
+    """(rows to each destination slice, row offset of each slice's block)
+    from one rank's expert-sorted splits — destination-slice blocks are
+    contiguous because rows are sorted by (globally slice-major) expert
+    id.  Pure index math, unit-tested headlessly."""
+    per_slice = splits_loc.reshape(n_out, e_slice).sum(axis=1)
+    offs = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(per_slice)[:-1].astype(jnp.int32)]
+    )
+    return per_slice.astype(jnp.int32), offs.astype(jnp.int32)
+
+
+def merge_order(group_splits, t_rows: int):
+    """Stable merge permutation over ``g`` groups of ``t_rows`` rows,
+    each group sorted by the same ``e`` expert ids with per-group counts
+    ``group_splits[g, e]`` and padding at its tail: ``flat[order]`` is
+    globally expert-sorted (stable across groups) with every padding row
+    at the global tail.  Pure index math, unit-tested headlessly."""
+    g, e = group_splits.shape
+    j = jnp.arange(t_rows)
+    cum = jnp.cumsum(group_splits, axis=1)
+    eid = jax.vmap(lambda c: jnp.searchsorted(c, j, side="right"))(cum)
+    eid = jnp.minimum(eid, e)            # padding rows -> sentinel e
+    return jnp.argsort(eid.reshape(g * t_rows), stable=True).astype(
+        jnp.int32)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sched_dispatch(mesh: Mesh, inner_axis: str, outer_axis: str,
+                          t: int, h: int, epr: int, chunk: int, z: int,
+                          dtype: jnp.dtype, schedule: tuple[int, ...],
+                          dcn_wire: str):
+    from .all_to_all import _make_push_call
+    from ..lang.primitives import Team
+
+    n_in = mesh.shape[inner_axis]
+    n_out = mesh.shape[outer_axis]
+    e_slice = n_in * epr
+    team = Team.of(mesh, inner_axis)
+    call = _make_push_call(team, chunk, z, h, n_in, "sched_ep_dispatch",
+                           dtype, schedule)
+    t_in = n_out * t                       # merged row count (incl padding)
+    t_in_pad = _round_up(t_in, chunk) + chunk
+
+    def local(x_loc, splits_loc):
+        # ---- phase 1 (DCN, launched first): slice-grouped token blocks
+        # to the same-i partner of every slice ----
+        per_slice, s_offs = per_slice_meta(splits_loc, n_out, e_slice)
+        j = jnp.arange(t)
+        gidx = jnp.minimum(s_offs[:, None] + j[None, :], t - 1)
+        blocks = jnp.take(x_loc, gidx.reshape(-1), axis=0) \
+            .reshape(n_out, t, h)
+        mask = j[None, :] < per_slice[:, None]
+        blocks = jnp.where(mask[..., None], blocks, 0)
+        if dcn_wire != "bf16":
+            from ..lang import quant
+
+            wire_blocks = quant.pack_rows(blocks, dcn_wire)
+        else:
+            wire_blocks = blocks
+        moved = jax.lax.all_to_all(wire_blocks, outer_axis, 0, 0)
+        if dcn_wire != "bf16":
+            from ..lang import quant
+
+            moved = quant.unpack_rows(moved, h, dcn_wire, dtype)
+        # per-partner splits of MY slice's experts (tiny int exchange)
+        recv_sl = jax.lax.all_to_all(
+            splits_loc.reshape(n_out, e_slice), outer_axis, 0, 0)
+        # ---- merge the n_out groups into one expert-sorted run ----
+        order = merge_order(recv_sl, t)
+        merged = jnp.take(moved.reshape(t_in, h), order, axis=0)
+        merged = jnp.pad(merged, ((0, t_in_pad - t_in), (0, 0)))
+        merged_splits = recv_sl.sum(axis=0).astype(jnp.int32)
+        # ---- phase 2 (ICI, scheduled): intra-slice push kernel ----
+        per_peer = merged_splits.reshape(n_in, epr).sum(axis=1) \
+            .astype(jnp.int32)
+        offs = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             jnp.cumsum(per_peer)[:-1].astype(jnp.int32)])
+        expected = jax.lax.all_to_all(per_peer, inner_axis, 0, 0)
+        recv_splits = jax.lax.all_to_all(
+            merged_splits.reshape(n_in, epr), inner_axis, 0, 0)
+        recv = call(per_peer, offs.astype(jnp.int32),
+                    expected.astype(jnp.int32), merged)
+        return recv, recv_splits.astype(jnp.int32)
+
+    return compilation.jit_shard_map(
+        local, mesh,
+        in_specs=(P((outer_axis, inner_axis), None),
+                  P((outer_axis, inner_axis))),
+        out_specs=(P((outer_axis, inner_axis), None, None),
+                   P((outer_axis, inner_axis), None)),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sched_combine(mesh: Mesh, inner_axis: str, outer_axis: str,
+                         t: int, h: int, epr: int, chunk: int, z: int,
+                         dtype: jnp.dtype, schedule: tuple[int, ...],
+                         dcn_wire: str):
+    from .all_to_all import _make_push_call
+    from ..lang.primitives import Team
+
+    n_in = mesh.shape[inner_axis]
+    n_out = mesh.shape[outer_axis]
+    e_slice = n_in * epr
+    team = Team.of(mesh, inner_axis)
+    call = _make_push_call(team, chunk, z, h, n_in, "sched_ep_combine",
+                           dtype, schedule)
+    t_in = n_out * t
+
+    def local(y_loc, splits_loc):
+        # recompute dispatch's metadata deterministically from the same
+        # splits (the flat combine's contract, two-level form)
+        per_slice, s_offs = per_slice_meta(splits_loc, n_out, e_slice)
+        recv_sl = jax.lax.all_to_all(
+            splits_loc.reshape(n_out, e_slice), outer_axis, 0, 0)
+        order = merge_order(recv_sl, t)
+        merged_splits = recv_sl.sum(axis=0).astype(jnp.int32)
+        per_peer = merged_splits.reshape(n_in, epr).sum(axis=1) \
+            .astype(jnp.int32)
+        offs = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             jnp.cumsum(per_peer)[:-1].astype(jnp.int32)])
+        expected = jax.lax.all_to_all(per_peer, inner_axis, 0, 0)
+        # ---- ICI return hop: zones back to their inner sources ----
+        zone_offs = (jnp.arange(n_in, dtype=jnp.int32) * z)
+        back = call(expected.astype(jnp.int32), zone_offs, per_peer,
+                    y_loc.reshape(n_in * z, h))
+        # exact repack to the merged-sorted order (flat combine's gather)
+        ridx = jnp.arange(t_in)
+        cum = jnp.cumsum(per_peer)
+        p_of = jnp.clip(jnp.searchsorted(cum, ridx, side="right"), 0,
+                        n_in - 1)
+        within = ridx - jnp.take(offs, p_of)
+        merged_back = jnp.take(back.reshape(n_in * z, h),
+                               p_of * z + within, axis=0)
+        # un-merge to the phase-1 (group, row) layout
+        inv = jnp.argsort(order)
+        flat = jnp.take(merged_back, inv, axis=0).reshape(n_out, t, h)
+        # ---- DCN return hop ----
+        if dcn_wire != "bf16":
+            from ..lang import quant
+
+            flat = quant.pack_rows(flat, dcn_wire)
+        ret = jax.lax.all_to_all(flat, outer_axis, 0, 0)
+        if dcn_wire != "bf16":
+            from ..lang import quant
+
+            ret = quant.unpack_rows(ret, h, dcn_wire, dtype)
+        # un-group back to the original expert-sorted order
+        tt = jnp.arange(t)
+        cum_s = jnp.cumsum(per_slice)
+        s_of = jnp.clip(jnp.searchsorted(cum_s, tt, side="right"), 0,
+                        n_out - 1)
+        within_s = tt - jnp.take(s_offs, s_of)
+        return jnp.take(ret.reshape(t_in, h), s_of * t + within_s, axis=0)
+
+    return compilation.jit_shard_map(
+        local, mesh,
+        in_specs=(P((outer_axis, inner_axis), None, None),
+                  P((outer_axis, inner_axis))),
+        out_specs=P((outer_axis, inner_axis), None),
+    )
+
+
+def _sched_geometry(t: int, n_out: int, chunk: int) -> tuple[int, int]:
+    """(chunk, zone rows) of the inner scheduled push: worst case every
+    merged row (n_out slices' worth) lands on one inner peer."""
+    chunk = min(chunk, _round_up(max(t, 1), 8))
+    z = _round_up(n_out * t, chunk) + chunk
+    return chunk, z
+
+
+def scheduled_ep_dispatch(
+    x: jax.Array,
+    splits: jax.Array,
+    mesh: Mesh,
+    inner_axis: str,
+    outer_axis: str,
+    *,
+    config=None,
+    wire_dtype: str = "auto",
+):
+    """Topology-scheduled two-level EP dispatch over an (outer x inner)
+    mesh (ISSUE 10 tentpole).  Phase 1 (launched FIRST — program order
+    puts the slow wire's traffic in flight before any ICI work): rows
+    grouped by destination SLICE ride ``lax.all_to_all`` over the DCN
+    axis between same-inner-rank partners, quantized per
+    :func:`resolve_dcn_wire`.  Phase 2: the arriving groups are merged
+    back into expert order (``merge_order``) and the intra-slice Pallas
+    push kernel redistributes them with the farthest-first
+    :func:`ici_schedule` emission order, pipelining under the DCN phase.
+
+    Layout contract (global, outer-major rank order g = o*n_in + i):
+    ``x`` (n*T, H) expert-sorted per rank; ``splits`` (n*E,) with E
+    divisible by n.  Returns ``(recv, recv_splits)``: rank g's slab of
+    ``recv`` is its n_in ICI landing zones (rows of its slice's experts
+    by MERGED inner source), ``recv_splits`` (n*n_in, epr) the per-inner-
+    source per-owned-expert counts.  :func:`scheduled_ep_combine`
+    inverts it exactly (same splits).
+    """
+    from .. import obs, resilience
+    from ..tune.autotuner import is_tracer
+    from .all_to_all import AllToAllConfig, ep_dispatch
+
+    n_in, n_out = _validate_2d(mesh, inner_axis, outer_axis)
+    if n_out == 1:
+        return ep_dispatch(x, splits, mesh, inner_axis, config=config,
+                           wire_dtype="bf16" if wire_dtype == "auto"
+                           else wire_dtype)
+    n = n_in * n_out
+    tn, h = x.shape
+    if tn % n:
+        raise ValueError(f"token dim {tn} not divisible by n={n}")
+    t = tn // n
+    e_tot = splits.shape[0] // n
+    if splits.shape[0] % n or e_tot % n:
+        raise ValueError(
+            f"splits {splits.shape} must be (n*E,) with E divisible by n"
+        )
+    epr = e_tot // n
+    cfg = config or AllToAllConfig()
+    chunk, z = _sched_geometry(t, n_out, cfg.chunk)
+    schedule = cfg.schedule or ici_schedule(n_in)
+    dcn_wire = resolve_dcn_wire(wire_dtype, h)
+    compilation.verify_protocol("hierarchical", n)
+    fn = _build_sched_dispatch(mesh, inner_axis, outer_axis, t, h, epr,
+                               chunk, z, jnp.dtype(x.dtype), schedule,
+                               dcn_wire)
+    eager = not (is_tracer(x) or is_tracer(splits))
+    payload = t * h * jnp.dtype(x.dtype).itemsize
+    ici, dcn = hier_a2a_wire_bytes(t, h, x.dtype, n_in, n_out, dcn_wire)
+    core = lambda: fn(x, splits.astype(jnp.int32))  # noqa: E731
+    if eager and resilience.enabled():
+        core = resilience.guarded(
+            "sched_ep_dispatch", core, family="hierarchical", ranks=n,
+            payload_bytes=payload, topology=(n_out, n_in),
+        )
+    if eager and (obs.enabled() or obs.flight.enabled()):
+        def counted(inner_core=core):
+            if obs.enabled():
+                obs.counter("comm_dcn_bytes", op="sched_ep_dispatch",
+                            method=f"sched+dcn_{dcn_wire}").inc(dcn)
+            return inner_core()
+
+        return obs.comm_call(
+            "sched_ep_dispatch", counted, payload_bytes=payload,
+            wire_bytes=ici + dcn, chunks=_cdiv(max(n_out * t, 1), chunk),
+            method=f"sched+dcn_{dcn_wire}", ranks=n,
+        )
+    return core()
+
+
+def scheduled_ep_combine(
+    y: jax.Array,
+    splits: jax.Array,
+    mesh: Mesh,
+    inner_axis: str,
+    outer_axis: str,
+    *,
+    token_dim: int,
+    config=None,
+    wire_dtype: str = "auto",
+) -> jax.Array:
+    """Inverse of :func:`scheduled_ep_dispatch`: ICI return hop (same
+    scheduled push kernel, roles reversed), un-merge via the inverse
+    merge permutation, DCN return hop, un-group — restoring the original
+    expert-sorted row order on every source rank.  ``y`` is the zone
+    layout dispatch produced (rows processed in place); ``splits`` the
+    SAME global splits; ``token_dim`` = T."""
+    from .. import obs, resilience
+    from ..tune.autotuner import is_tracer
+    from .all_to_all import AllToAllConfig, ep_combine
+
+    n_in, n_out = _validate_2d(mesh, inner_axis, outer_axis)
+    if n_out == 1:
+        return ep_combine(y, splits, mesh, inner_axis, token_dim=token_dim,
+                          config=config,
+                          wire_dtype="bf16" if wire_dtype == "auto"
+                          else wire_dtype)
+    n = n_in * n_out
+    h = y.shape[-1]
+    t = token_dim
+    e_tot = splits.shape[0] // n
+    epr = e_tot // n
+    cfg = config or AllToAllConfig()
+    chunk, z = _sched_geometry(t, n_out, cfg.chunk)
+    if y.shape[0] != n * n_in or y.shape[1] != z:
+        raise ValueError(
+            f"zone layout {y.shape} does not match dispatch geometry "
+            f"({n * n_in}, {z}, {h})"
+        )
+    schedule = cfg.schedule or ici_schedule(n_in)
+    dcn_wire = resolve_dcn_wire(wire_dtype, h)
+    compilation.verify_protocol("hierarchical", n)
+    fn = _build_sched_combine(mesh, inner_axis, outer_axis, t, h, epr,
+                              chunk, z, jnp.dtype(y.dtype), schedule,
+                              dcn_wire)
+    eager = not (is_tracer(y) or is_tracer(splits))
+    payload = t * h * jnp.dtype(y.dtype).itemsize
+    ici, dcn = hier_a2a_wire_bytes(t, h, y.dtype, n_in, n_out, dcn_wire)
+    core = lambda: fn(y, splits.astype(jnp.int32))  # noqa: E731
+    if eager and resilience.enabled():
+        core = resilience.guarded(
+            "sched_ep_combine", core, family="hierarchical", ranks=n,
+            payload_bytes=payload, topology=(n_out, n_in),
+        )
+    if eager and (obs.enabled() or obs.flight.enabled()):
+        def counted(inner_core=core):
+            if obs.enabled():
+                obs.counter("comm_dcn_bytes", op="sched_ep_combine",
+                            method=f"sched+dcn_{dcn_wire}").inc(dcn)
+            return inner_core()
+
+        return obs.comm_call(
+            "sched_ep_combine", counted, payload_bytes=payload,
+            wire_bytes=ici + dcn, chunks=_cdiv(max(n_out * t, 1), chunk),
+            method=f"sched+dcn_{dcn_wire}", ranks=n,
+        )
+    return core()

@@ -160,41 +160,6 @@ def _build_reduce_scatter(
     )
 
 
-@functools.lru_cache(maxsize=None)
-def _build_hierarchical(
-    mesh: Mesh,
-    inner_axis: str,
-    outer_axis: str,
-    m_partial: int,
-    r_dim: int,
-    dtype: jnp.dtype,
-    cfg: ReduceScatterConfig,
-):
-    n_in = mesh.shape[inner_axis]
-    n_out = mesh.shape[outer_axis]
-    blk = m_partial // (n_in * n_out)
-    call = _build_rs_call(mesh, inner_axis, m_partial // n_in, r_dim, dtype,
-                          cfg)
-
-    def local(x_loc):
-        # Row blocks arrive in flat (outer-major global rank) order; the
-        # inner scatter picks by inner rank first, so transpose the block
-        # grid to inner-major — then chunk i / sub-block o is exactly
-        # global block o*n_in + i.
-        xp = (x_loc.reshape(n_out, n_in, blk, r_dim)
-              .transpose(1, 0, 2, 3).reshape(m_partial, r_dim))
-        part = call(xp)                               # ICI Pallas ring
-        return jax.lax.psum_scatter(                  # DCN via XLA
-            part, outer_axis, scatter_dimension=0, tiled=True
-        )
-
-    return compilation.jit_shard_map(
-        local, mesh,
-        in_specs=P((outer_axis, inner_axis), None),
-        out_specs=P((outer_axis, inner_axis), None),
-    )
-
-
 def hierarchical_reduce_scatter(
     x: jax.Array,
     mesh: Mesh,
@@ -203,35 +168,12 @@ def hierarchical_reduce_scatter(
     *,
     config: ReduceScatterConfig | None = None,
 ) -> jax.Array:
-    """Two-level ReduceScatter over an (outer x inner) mesh — the
-    reference's 2D intra+inter hierarchy (``reduce_scatter.py:688-882``,
-    ``ReduceScatter2DContext:46``: intra-node ring reduce + inter-node
-    p2p stage).
+    """Two-level ReduceScatter (ICI ring per slice + DCN ``psum_scatter``).
+    Canonical implementation: ``comm.hierarchical`` (ISSUE 10); this name
+    stays importable here for the historic call sites."""
+    from .hierarchical import hierarchical_reduce_scatter as _hier
 
-    TPU mapping: the ``inner_axis`` (ICI) level is this module's ring
-    kernel; the ``outer_axis`` (DCN — across slices) level rides XLA's
-    ``psum_scatter``, since remote DMA is ICI-only (SURVEY.md section 7).
-    Semantics match a flat :func:`reduce_scatter` over the combined
-    outer-major axis: golden ``x.reshape(N, M, R).sum(0)`` scattered in
-    global rank order.
-    """
-    n_in = mesh.shape[inner_axis]
-    n_out = mesh.shape[outer_axis]
-    if n_out == 1:
-        return reduce_scatter(x, mesh, inner_axis, config=config)
-    n = n_in * n_out
-    m_stack = x.shape[0]
-    if m_stack % n:
-        raise ValueError(f"dim0 {m_stack} not divisible by N={n}")
-    m_partial = m_stack // n
-    if m_partial % n:
-        raise ValueError(f"partial rows {m_partial} not divisible by N={n}")
-    cfg = (config or ReduceScatterConfig()).clip(m_partial // n_in, x.shape[1])
-    fn = _build_hierarchical(
-        mesh, inner_axis, outer_axis, m_partial, x.shape[1],
-        jnp.dtype(x.dtype), cfg
-    )
-    return fn(x)
+    return _hier(x, mesh, inner_axis, outer_axis, config=config)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
@@ -277,7 +219,16 @@ def reduce_scatter(
     one-shot exchange — ``comm.quantized.quantized_reduce_scatter``:
     quantize at the producer chunk, dequantize + f32-reduce at the
     consumer), or "auto" (tuner-resolved per shape/ranks/wire class).
+
+    ``axis`` may be a 2-tuple ``(outer, inner)`` on a 2D multi-slice
+    mesh: routes to ``comm.hierarchical``.
     """
+    if isinstance(axis, (tuple, list)):
+        from . import hierarchical
+
+        outer_axis, inner_axis = axis
+        return hierarchical.hierarchical_reduce_scatter(
+            x, mesh, inner_axis, outer_axis, config=config)
     n = mesh.shape[axis]
     m_stack = x.shape[0]
     if m_stack % n:
@@ -311,8 +262,10 @@ def reduce_scatter(
     if config is None:
         # add-pipeline tiles through the contextual tuner (VERDICT r5
         # next #5) — cached winner / measured / interpret-pinned
-        # default, exactly like the GEMM ops' config=None path
-        from ..core import platform
+        # default, exactly like the GEMM ops' config=None path; the key
+        # carries the axis's wire class (ISSUE 10) so winners cannot
+        # leak across topologies
+        from ..core import mesh as mesh_lib, platform
         from ..tune.autotuner import (
             collective_tile_candidates, resolve_config,
         )
@@ -320,7 +273,7 @@ def reduce_scatter(
         config = resolve_config(
             "rs_cfg",
             (m_partial, x.shape[1], str(x.dtype), n,
-             platform.device_kind()),
+             mesh_lib.wire_class(mesh, axis), platform.device_kind()),
             collective_tile_candidates(ReduceScatterConfig, m_loc,
                                        x.shape[1]),
             ReduceScatterConfig().clip(m_loc, x.shape[1]),

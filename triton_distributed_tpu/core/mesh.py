@@ -136,12 +136,19 @@ def axis_spans_processes(mesh: Mesh, axis: str) -> bool:
     return bool((procs != procs[:1]).any())
 
 
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=None)
 def wire_class(mesh: Mesh, axis: str) -> str:
     """"dcn" when hops along ``axis`` ride the cross-slice network (by
     naming convention OR by actually spanning processes), else "ici".
     The policy input for wire-cost decisions (e.g. the MoE fp8 wire
     codec, whose measured net win is positive on DCN and negative on
-    ICI — BENCH r04 ``net_us_per_token_hop_*``)."""
+    ICI — BENCH r04 ``net_us_per_token_hop_*``).  Memoized per (mesh,
+    axis): it sits on every collective's contextual-key path (ISSUE 10)
+    and the process-spanning probe is an O(devices) Python scan of a
+    quantity that never changes for a live mesh."""
     if is_dcn_axis(axis) or axis_spans_processes(mesh, axis):
         return "dcn"
     return "ici"

@@ -98,6 +98,13 @@ class MoEMLP:
     # say the halved payload pays for the codec on the slow wire class
     # and not on the ICI torus.  True/False force it either way.
     fp8_wire: bool | str = False
+    # Multi-slice EP (ISSUE 10): when set, the EP axis is the 2D
+    # (dcn_axis x axis) mesh and dispatch/combine ride the hierarchical
+    # TOPOLOGY-SCHEDULED all-to-all (``comm.hierarchical`` — DCN phase
+    # launched first, farthest-first ICI emission order underneath);
+    # the DCN hop's payload quantizes per ``fp8_wire`` (forward-only on
+    # that hop — the straight-through transports cover the flat path).
+    dcn_axis: str | None = None
 
     def __post_init__(self):
         if self.fp8_wire not in (True, False, "auto"):
@@ -114,18 +121,32 @@ class MoEMLP:
         documented cold-start numbers otherwise; with cold-start values
         this reproduces the old DCN-only rule exactly).  ``hdim``: the
         activation width the wire actually ships — narrow rows amortize
-        the scale sidecar worse and can flip the economics."""
+        the scale sidecar worse and can flip the economics.  With
+        ``dcn_axis`` set, the decision keys on the DCN wire class — the
+        hop the hierarchical path would actually quantize."""
         if self.fp8_wire == "auto":
             from ..tools import calibrate
 
             kwargs = {} if hdim is None else {"h": int(hdim)}
+            axis = self.dcn_axis if self.dcn_axis is not None else self.axis
             return calibrate.codec_pays(
-                mesh_lib.wire_class(self.mesh, self.axis), **kwargs)
+                mesh_lib.wire_class(self.mesh, axis), **kwargs)
         return bool(self.fp8_wire)
 
     @property
+    def _ep_spec(self):
+        """The PartitionSpec axis entry of EP-sharded dims: the combined
+        (dcn, tp) tuple on a multi-slice layout, the flat axis
+        otherwise."""
+        return (self.dcn_axis, self.axis) if self.dcn_axis is not None \
+            else self.axis
+
+    @property
     def n(self) -> int:
-        return self.mesh.shape[self.axis]
+        n = self.mesh.shape[self.axis]
+        if self.dcn_axis is not None:
+            n *= self.mesh.shape[self.dcn_axis]
+        return n
 
     def _act(self):
         return dict(silu=jax.nn.silu, gelu=jax.nn.gelu, relu=jax.nn.relu)[self.act]
@@ -157,16 +178,19 @@ class MoEMLP:
 
     def shard_params_ep(self, router, w_up, w_dn) -> MoEParams:
         """EP layout: experts partitioned across ranks (rank r owns the
-        contiguous expert block [r*E/n, (r+1)*E/n))."""
+        contiguous expert block [r*E/n, (r+1)*E/n); under ``dcn_axis``
+        the ranks enumerate outer-major over (dcn, tp) — slice-blocked
+        experts, the hierarchical A2A's global order)."""
+        spec = self._ep_spec
         return MoEParams(
             router=jax.device_put(
                 router, NamedSharding(self.mesh, P(None, None))
             ),
             w_up=jax.device_put(
-                w_up, NamedSharding(self.mesh, P(self.axis, None, None))
+                w_up, NamedSharding(self.mesh, P(spec, None, None))
             ),
             w_dn=jax.device_put(
-                w_dn, NamedSharding(self.mesh, P(self.axis, None, None))
+                w_dn, NamedSharding(self.mesh, P(spec, None, None))
             ),
         )
 
@@ -213,11 +237,11 @@ class MoEMLP:
             xs, splits, unsort = sort_by_expert(xr, eflat, e)
             return xs, splits, wflat, unsort
 
+        spec = self._ep_spec
         return jax.shard_map(
             local, mesh=self.mesh,
-            in_specs=(P(self.axis, None), P(None, None)),
-            out_specs=(P(self.axis, None), P(self.axis), P(self.axis),
-                       P(self.axis)),
+            in_specs=(P(spec, None), P(None, None)),
+            out_specs=(P(spec, None), P(spec), P(spec), P(spec)),
         )(x, router)
 
     # -- TP forward -------------------------------------------------------
@@ -227,6 +251,11 @@ class MoEMLP:
 
         ``x``: (M, K) sharded on dim 0 over ``axis``.  Returns the same.
         """
+        if self.dcn_axis is not None:
+            raise ValueError(
+                "forward_tp is single-slice; multi-slice MoE runs the EP "
+                "strategy (forward_ep with dcn_axis)"
+            )
         n = self.n
         x_sorted, splits, wflat, unsort = self._route_and_sort(
             x, params.router
@@ -319,19 +348,39 @@ class MoEMLP:
         """Route -> A2A dispatch -> local expert MLP -> A2A combine ->
         weighted top-k fold (reference ``ep_a2a_layer.py:40``).
 
-        ``x``: (M, K) sharded on dim 0 over ``axis``.  Returns the same.
+        With ``dcn_axis`` set the exchange is the hierarchical
+        topology-SCHEDULED all-to-all (``comm.hierarchical``): the DCN
+        phase launches first, the ICI phase pipelines underneath with
+        the farthest-first emission order, and the DCN payload quantizes
+        per the layer's wire policy.
+
+        ``x``: (M, K) sharded on dim 0 over the EP axis (both axes when
+        hierarchical).  Returns the same.
         """
         n = self.n
         e, k = self.num_experts, self.top_k
         epr = e // n
         hdim = x.shape[-1]
         x_dtype = x.dtype
+        spec = self._ep_spec
+        hier = self.dcn_axis is not None and \
+            self.mesh.shape[self.dcn_axis] > 1
         x_sorted, splits, wflat, unsort = self._route_and_sort(
             x, params.router
         )
         fp8 = self.fp8_wire_enabled(hdim) and n > 1
         cfg = a2a_config or AllToAllConfig()
-        if fp8:
+        if hier:
+            from ..comm.hierarchical import (
+                scheduled_ep_combine, scheduled_ep_dispatch,
+            )
+
+            wire = "fp8" if fp8 else "bf16"
+            recv, recv_splits = scheduled_ep_dispatch(
+                x_sorted, splits, self.mesh, self.axis, self.dcn_axis,
+                config=cfg, wire_dtype=wire,
+            )
+        elif fp8:
             # quantized wire with a straight-through backward
             # (comm.quantized); zones come back dequantized to the model
             # dtype
@@ -343,46 +392,55 @@ class MoEMLP:
                 x_sorted, splits, self.mesh, self.axis, config=cfg
             )
         z = recv.shape[1]
+        # zones per rank: the flat A2A lands one zone per GLOBAL peer;
+        # the hierarchical one lands one per INNER (merged) source
+        n_src = recv.shape[0] // n
         combine = self._combine
 
         def local_experts(zones, rsplits, w_up_loc, w_dn_loc):
-            # zones: (n, Z, K); rsplits: (n, epr).  Compact zone rows into
-            # one expert-major run for a single ragged_dot, then scatter
-            # back to zone layout for the combine.
+            # zones: (n_src, Z, K); rsplits: (n_src, epr).  Compact zone
+            # rows into one expert-major run for a single ragged_dot,
+            # then scatter back to zone layout for the combine.
             kdim = zones.shape[-1]
-            flat = zones.reshape(n * z, kdim)
+            flat = zones.reshape(n_src * z, kdim)
             # owned-expert index of each zone row; padding rows map to epr
             # (one past the last expert) and stable-sort to the tail
             j = jnp.arange(z)
-            cum = jnp.cumsum(rsplits, axis=1)                        # (n, epr)
+            cum = jnp.cumsum(rsplits, axis=1)                   # (n_src, epr)
             eid = jax.vmap(
                 lambda c: jnp.searchsorted(c, j, side="right")
-            )(cum)                                                   # (n, z)
-            order = jnp.argsort(eid.reshape(n * z), stable=True)
+            )(cum)                                              # (n_src, z)
+            order = jnp.argsort(eid.reshape(n_src * z), stable=True)
             compact = jnp.take(flat, order, axis=0)
             gsz = rsplits.sum(axis=0).astype(jnp.int32)              # (epr,)
             h_loc = combine(jax.lax.ragged_dot(compact, w_up_loc, gsz))
             y = jax.lax.ragged_dot(h_loc, w_dn_loc, gsz)
             # rows past sum(gsz) belong to no expert; zero them before the
             # scatter so padding rows stay inert through the combine
-            valid = jnp.arange(n * z) < gsz.sum()
+            valid = jnp.arange(n_src * z) < gsz.sum()
             y = jnp.where(valid[:, None], y, 0)
             y = y.astype(x_dtype)
-            out = jnp.zeros((n * z, y.shape[-1]), y.dtype)
-            return out.at[order].set(y).reshape(n, z, -1)
+            out = jnp.zeros((n_src * z, y.shape[-1]), y.dtype)
+            return out.at[order].set(y).reshape(n_src, z, -1)
 
         processed = jax.shard_map(
             local_experts, mesh=self.mesh,
-            in_specs=(P(self.axis, None, None), P(self.axis, None),
-                      P(self.axis, None, None), P(self.axis, None, None)),
-            out_specs=P(self.axis, None, None),
+            in_specs=(P(spec, None, None), P(spec, None),
+                      P(spec, None, None), P(spec, None, None)),
+            out_specs=P(spec, None, None),
         )(
-            recv.reshape(n, n, z, -1).reshape(n * n, z, -1),
-            recv_splits.reshape(n * n, epr),
+            recv.reshape(n, n_src, z, -1).reshape(n * n_src, z, -1),
+            recv_splits.reshape(n * n_src, epr),
             params.w_up, params.w_dn,
         )
         t_loc = x_sorted.shape[0] // n
-        if fp8:
+        if hier:
+            back = scheduled_ep_combine(
+                processed, splits, self.mesh, self.axis, self.dcn_axis,
+                token_dim=t_loc, config=cfg,
+                wire_dtype="fp8" if fp8 else "bf16",
+            )
+        elif fp8:
             # quantized return hop, straight-through backward
             back = quantized_ep_combine(self.mesh, self.axis, cfg, hdim,
                                         "fp8", t_loc, processed, splits)
@@ -398,6 +456,6 @@ class MoEMLP:
 
         return jax.shard_map(
             fold, mesh=self.mesh,
-            in_specs=(P(self.axis, None), P(self.axis), P(self.axis)),
-            out_specs=P(self.axis, None),
+            in_specs=(P(spec, None), P(spec), P(spec)),
+            out_specs=P(spec, None),
         )(back, unsort, wflat)

@@ -41,17 +41,22 @@ class KernelCost:
     """Flop/byte/transcendental counts of one kernel invocation (per
     device).  ``wire_bytes`` is the portion of ``bytes_accessed`` that
     crosses ICI (0 for local kernels) — the collective half of a fused
-    op's roofline."""
+    op's roofline.  ``dcn_bytes`` is the portion that crosses the
+    inter-slice (DCN) wire — the two-level families (ISSUE 10) split
+    their wire per class so every consumer (watchdog deadline, timeline
+    pct_sol, report) charges each level its own wire speed."""
 
     flops: int
     bytes_accessed: int
     transcendentals: int = 0
     wire_bytes: int = 0
+    dcn_bytes: int = 0
 
     def scaled(self, k: float) -> "KernelCost":
         return KernelCost(int(self.flops * k), int(self.bytes_accessed * k),
                           int(self.transcendentals * k),
-                          int(self.wire_bytes * k))
+                          int(self.wire_bytes * k),
+                          int(self.dcn_bytes * k))
 
 
 def pallas_cost(cost: KernelCost):
@@ -70,16 +75,22 @@ def pallas_cost(cost: KernelCost):
 
 
 def sol_ms(cost: KernelCost, device_kind: str | None = None) -> float:
-    """Roofline time of ``cost`` on one chip: max(MXU, HBM, ICI) terms —
-    the same max() shape as ``tools.perf_model.gemm_sol_ms``, extended
-    with the wire term for fused collectives."""
+    """Roofline time of ``cost`` on one chip: max(MXU, HBM, ICI, DCN)
+    terms — the same max() shape as ``tools.perf_model.gemm_sol_ms``,
+    extended with a wire term PER WIRE CLASS: ``wire_bytes`` is charged
+    at ICI speed, ``dcn_bytes`` at the (calibrated) DCN speed
+    (``perf_model.dcn_gbps``).  Pricing every hop as ICI would quote
+    multi-slice kernels a deadline/pct_sol the slow wire can never
+    meet — the dishonesty this split removes (ISSUE 10)."""
     from ..tools import perf_model
 
     spec = perf_model.chip_spec(device_kind)
     t_flops = cost.flops / (spec.bf16_tflops * 1e12)
-    t_mem = (cost.bytes_accessed - cost.wire_bytes) / (spec.hbm_gbps * 1e9)
+    t_mem = (cost.bytes_accessed - cost.wire_bytes - cost.dcn_bytes) \
+        / (spec.hbm_gbps * 1e9)
     t_wire = cost.wire_bytes / (spec.ici_gbps * 1e9)
-    return max(t_flops, t_mem, t_wire) * 1e3
+    t_dcn = cost.dcn_bytes / (perf_model.dcn_gbps() * 1e9)
+    return max(t_flops, t_mem, t_wire, t_dcn) * 1e3
 
 
 # ---------------------------------------------------------------------------
@@ -256,6 +267,63 @@ def all_to_all(rows: int, h: int, num_ranks: int, dtype) -> KernelCost:
     )
 
 
+def _hier_cost(ici: int, dcn: int, extra_hbm: int = 0,
+               flops: int = 0) -> KernelCost:
+    return KernelCost(
+        flops=flops,
+        bytes_accessed=extra_hbm + ici + dcn,
+        wire_bytes=ici,
+        dcn_bytes=dcn,
+    )
+
+
+def hier_all_gather(m_loc: int, r: int, n_in: int, n_out: int,
+                    dtype) -> KernelCost:
+    """Two-level AG per chip (``comm.hierarchical``): inner ring
+    forwards (n_in-1) shards on ICI, the outer broadcast lands (n_out-1)
+    slice blocks over DCN; HBM pays the gathered write."""
+    ib = _itemsize(dtype)
+    shard = m_loc * r * ib
+    return _hier_cost((n_in - 1) * shard, (n_out - 1) * n_in * shard,
+                      extra_hbm=n_out * n_in * shard)
+
+
+def hier_reduce_scatter(m_partial: int, r: int, n_in: int, n_out: int,
+                        dtype) -> KernelCost:
+    ib = _itemsize(dtype)
+    chunk = (m_partial // max(n_in, 1)) * r * ib
+    add_flops = (n_in - 1) * (m_partial // max(n_in, 1)) * r
+    return _hier_cost((n_in - 1) * chunk,
+                      (n_out - 1) * chunk // max(n_out, 1),
+                      extra_hbm=2 * (n_in - 1) * chunk, flops=add_flops)
+
+
+def hier_all_reduce(m: int, r: int, n_in: int, n_out: int,
+                    dtype) -> KernelCost:
+    """Two-level AR (RS ∘ AG) per chip: 2(n_in-1)/n_in of the partial on
+    ICI, 2(n_out-1)/n_out of the 1/n_in partial on DCN — the RS∘AG bound
+    ``bench.py hier`` gates."""
+    ib = _itemsize(dtype)
+    partial = m * r * ib
+    ici = 2 * (n_in - 1) * partial // max(n_in, 1)
+    dcn = 2 * (n_out - 1) * (partial // max(n_in, 1)) // max(n_out, 1)
+    add_flops = (n_in - 1) * (m // max(n_in, 1)) * r + \
+        (n_out - 1) * (m // max(n_in, 1)) * r
+    return _hier_cost(ici, dcn, extra_hbm=2 * partial, flops=add_flops)
+
+
+def hier_all_to_all(rows: int, h: int, n_in: int, n_out: int,
+                    dtype) -> KernelCost:
+    """Scheduled EP A2A per chip: the DCN phase ships (n_out-1) FIXED
+    zero-padded payload-sized blocks (static shapes — the bytes move
+    regardless of routing); up to the n_out merged blocks redistribute
+    on ICI."""
+    ib = _itemsize(dtype)
+    payload = rows * h * ib
+    return _hier_cost(n_out * payload, (n_out - 1) * payload,
+                      extra_hbm=2 * n_out * payload)
+
+
 # the registry the report and timeline consume: family -> calculator.
 # (sp_attention and flash_decode ride the attention-family kernels they
 # are built from — flash_attention at chunk shapes, decode_attention at
@@ -275,4 +343,10 @@ FAMILY_COSTS = {
     # timeline reconstructor — like every other family here
     "fused_attn_decode": fused_attn_decode,
     "fused_mlp_ar": fused_mlp_ar,
+    # the two-level (ICI x DCN) families (ISSUE 10): wire split per
+    # class, so deadlines/pct_sol charge each level its own wire
+    "hier_all_gather": hier_all_gather,
+    "hier_reduce_scatter": hier_reduce_scatter,
+    "hier_all_reduce": hier_all_reduce,
+    "hier_all_to_all": hier_all_to_all,
 }

@@ -365,12 +365,13 @@ def record_case(case) -> list[list[FlightEvent]]:
     flight capture installed — the same symbolic execution the protocol
     verifier runs, with the flight stream captured alongside.  Returns
     one event list per rank."""
-    from ..analysis.record import recording
+    from ..analysis.record import coords_of, recording
 
+    axes = getattr(case, "axes", None) or (("tp", case.n),)
     streams: list[list[FlightEvent]] = []
     for rank in range(case.n):
         _, thunk = case.make(rank)
-        with recording((("tp", case.n),), {"tp": rank}):
+        with recording(axes, coords_of(axes, rank)):
             with capture(rank) as cap:
                 thunk()
         streams.append(cap.events)

@@ -55,6 +55,7 @@ from .faults import (
     scoped,
 )
 from .matrix import (
+    run_hier_cells,
     run_integrity_cells,
     run_matrix,
     run_quant_cells,
@@ -87,8 +88,8 @@ __all__ = [
     "guarded", "health_snapshot", "integrity", "matrix", "policy",
     "protocol_pending",
     "record_faulty_case", "reset_breaker", "resilient_call", "run_bounded",
-    "run_integrity_cells", "run_matrix", "run_quant_cells",
-    "run_scheduler_matrix",
+    "run_hier_cells", "run_integrity_cells", "run_matrix",
+    "run_quant_cells", "run_scheduler_matrix",
     "sample_spec", "scoped",
     "simulate", "suppress", "suppressed_thunk", "verify_matrix",
     "verify_scheduler_matrix", "watchdog",

@@ -354,10 +354,11 @@ def record_faulty_case(case, spec: FaultSpec) -> FaultyTraces:
     """Record all N ranks of an ``analysis.registry.KernelCase`` with
     ``spec`` injected on its victim rank, via the primitives-layer
     interception points."""
-    from ..analysis.record import recording
+    from ..analysis.record import coords_of, recording
 
     if not 0 <= spec.rank < case.n:
         raise ValueError(f"victim rank {spec.rank} outside [0, {case.n})")
+    axes = getattr(case, "axes", None) or (("tp", case.n),)
     has_recv = _case_has_wait_recv(case) \
         if spec.kind is FaultKind.STALE_CREDIT else True
     traces: list = []
@@ -372,7 +373,7 @@ def record_faulty_case(case, spec: FaultSpec) -> FaultyTraces:
         _, thunk = case.make(rank)
         scope = FaultScope(spec, has_wait_recv=has_recv) \
             if rank == spec.rank else None
-        with recording((("tp", case.n),), {"tp": rank}) as rec:
+        with recording(axes, coords_of(axes, rank)) as rec:
             with scoped(scope):
                 try:
                     thunk()
@@ -393,8 +394,9 @@ def record_faulty_case(case, spec: FaultSpec) -> FaultyTraces:
             # event BEFORE the rank's first real event
             for sem_key, amount in scope.stale:
                 events.insert(0, NotifyEv(sem_key, rank, amount))
-    # single-axis harness meshes: device id == team rank, so the stale
-    # self-credit above targets the victim's own instance
+    # harness meshes enumerate ranks row-major over their axes, so the
+    # linearized device id == rank index (single- AND multi-axis) and
+    # the stale self-credit above targets the victim's own instance
         traces.append(events)
     return FaultyTraces(case.name, case.n, spec, traces, start_delay,
                         notify_delay, drop_recv, aborted, fired,
@@ -405,7 +407,8 @@ def _case_has_wait_recv(case) -> bool:
     from ..analysis.record import record_kernel
 
     _, thunk = case.make(0)
-    rec = record_kernel(thunk, n=case.n, rank=0)
+    rec = record_kernel(thunk, n=case.n, rank=0,
+                        axes=getattr(case, "axes", None))
     return "wait_recv" in rec.signature
 
 
@@ -417,7 +420,8 @@ def sample_spec(case, kind: FaultKind, rng) -> FaultSpec:
 
     rank = rng.randrange(case.n)
     _, thunk = case.make(rank)
-    rec = record_kernel(thunk, n=case.n, rank=rank)
+    rec = record_kernel(thunk, n=case.n, rank=rank,
+                        axes=getattr(case, "axes", None))
     sig = rec.signature
 
     def count(name: str) -> int:

@@ -61,12 +61,27 @@ DEFAULT_KERNELS = (
     # anywhere in the message (scale sidecar included) must be caught
     "quant_allgather/push_1shot",
     "quant_exchange/oneshot",
+    # the two-level (ICI x DCN) families (ISSUE 10) at the 2x2 layout
+    # (the default matrix runs at ranks=4); the 2x4/4x2 layouts ride
+    # `tdt_lint --hier` (run_hier_cells)
+    "hier_allreduce/2x2",
+    "hier_a2a/2x2",
 )
 
 # the `tdt_lint --quant` slice of the kernel axis
 QUANT_KERNELS = ("quant_allgather/push_1shot",
                  "quant_allgather/ring_bidir",
                  "quant_exchange/oneshot")
+
+# the `tdt_lint --hier` slice: every two-level family, with the
+# inter-slice (DCN) protocol model in the loop — the dropped-inter-slice-
+# credit class is drop_notify/stale_credit landing on the dcn semaphores
+HIER_KERNELS_4 = ("hier_allgather/2x2", "hier_reduce_scatter/2x2",
+                  "hier_allreduce/2x2", "hier_a2a/2x2")
+HIER_KERNELS_8 = ("hier_allgather/2x4", "hier_reduce_scatter/2x4",
+                  "hier_allreduce/2x4", "hier_a2a/2x4",
+                  "hier_allgather/4x2", "hier_reduce_scatter/4x2",
+                  "hier_allreduce/4x2", "hier_a2a/4x2")
 
 # classes whose injection MUST be caught: they stall or corrupt
 MUST_DETECT = (FaultKind.DROP_NOTIFY, FaultKind.STALE_CREDIT,
@@ -380,6 +395,14 @@ def run_scheduler_matrix(seed: int = 0) -> list[dict]:
         _sched_cell(FaultKind.STRAGGLER, "overrun", rng),
         _sched_poison_cell(rng),
     ]
+
+
+def run_hier_cells(seed: int = 0) -> list[dict]:
+    """The ``tdt_lint --hier`` fault slice: every fault class against the
+    two-level kernel cases at all three slice layouts ({2x2} at ranks=4,
+    {2x4, 4x2} at ranks=8).  Verify with :func:`verify_matrix`."""
+    return (run_matrix(seed=seed, kernels=HIER_KERNELS_4, ranks=4)
+            + run_matrix(seed=seed + 1, kernels=HIER_KERNELS_8, ranks=8))
 
 
 def run_quant_cells(seed: int = 0) -> list[dict]:

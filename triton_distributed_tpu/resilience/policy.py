@@ -196,16 +196,19 @@ def resilient_call(op: str, thunk, *, fallback=None,
 
 def guarded(op: str, thunk, *, fallback=None, payload_bytes: int = 0,
             ranks: int = 1, family: str | None = None,
-            policy: RetryPolicy = DEFAULT_POLICY):
+            policy: RetryPolicy = DEFAULT_POLICY,
+            topology: tuple[int, int] | None = None):
     """The shape every ``comm``/``ops`` entry point wires: returns a
     zero-arg thunk running ``thunk`` under the perf-model-derived
     watchdog deadline and the failure ladder.  Composes under
     ``obs.comm_call`` so the recorded span covers retries and the
-    degraded path too."""
+    degraded path too.  ``topology`` ((n_out, n_in)) selects the
+    two-level deadline model that charges each level its own wire class
+    (the hierarchical families, ISSUE 10)."""
     from . import integrity
 
     dl = watchdog.deadline_ms(op, payload_bytes=payload_bytes,
-                              num_ranks=ranks)
+                              num_ranks=ranks, topology=topology)
     # the consumer-side integrity check runs INSIDE this deadline; a
     # wire-SOL budget alone would time out every verified call on a
     # fast slice (0 when integrity is off)

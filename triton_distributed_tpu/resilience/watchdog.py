@@ -63,11 +63,28 @@ def floor_ms() -> float:
 # fused GEMM ops use their collective half's wire model: the GEMM time
 # is bounded separately by the same payload heuristic and the slack
 # absorbs the difference.
-def _estimate_ms(op: str, payload_bytes: int, num_ranks: int) -> float:
+def _estimate_ms(op: str, payload_bytes: int, num_ranks: int,
+                 topology: tuple[int, int] | None = None) -> float:
     from ..tools import perf_model
 
     n = max(int(num_ranks), 2)
     b = max(int(payload_bytes), 1)
+    if topology is not None:
+        # two-level (ICI x DCN) families (ISSUE 10): each level is
+        # charged ITS OWN wire class — pricing the DCN hop at ICI speed
+        # would set a deadline the slow wire can never meet (spurious
+        # timeouts on every healthy multi-slice call)
+        n_out, n_in = (max(int(v), 1) for v in topology)
+        if op in ("hier_all_gather",):
+            return perf_model.hier_allgather_sol_ms(b, n_in, n_out)
+        if op in ("hier_reduce_scatter",):
+            return perf_model.hier_reduce_scatter_sol_ms(b, n_in, n_out)
+        if op in ("hier_all_reduce",):
+            return perf_model.hier_allreduce_sol_ms(b, n_in, n_out)
+        if op in ("sched_ep_dispatch", "sched_ep_combine"):
+            return perf_model.hier_a2a_sol_ms(b, n_in, n_out)
+        # unknown two-level op: whole payload once per wire class
+        return perf_model.hier_a2a_sol_ms(b, n_in, n_out)
     if op in ("all_gather", "ag_gemm"):
         return perf_model.allgather_sol_ms(b, n)
     if op in ("reduce_scatter", "gemm_rs"):
@@ -84,10 +101,14 @@ def _estimate_ms(op: str, payload_bytes: int, num_ranks: int) -> float:
     return perf_model.allgather_sol_ms(b, n)
 
 
-def deadline_ms(op: str, *, payload_bytes: int, num_ranks: int) -> float:
+def deadline_ms(op: str, *, payload_bytes: int, num_ranks: int,
+                topology: tuple[int, int] | None = None) -> float:
     """The watchdog budget for one collective call: SOL estimate x slack
-    + floor.  Monotone in payload and rank count."""
-    return _estimate_ms(op, payload_bytes, num_ranks) * slack() + floor_ms()
+    + floor.  Monotone in payload and rank count.  ``topology``
+    ((n_out, n_in), the hierarchical families) prices each level by its
+    own wire class — ``tools.perf_model``'s two-level sol terms."""
+    return _estimate_ms(op, payload_bytes, num_ranks, topology) * slack() \
+        + floor_ms()
 
 
 @functools.lru_cache(maxsize=None)
@@ -112,7 +133,8 @@ def protocol_pending(family: str, n: int) -> TimeoutDiagnosis | None:
     pending: list[PendingWait] = []
     for rank in range(case.n):
         _, thunk = case.make(rank)
-        rec = record_kernel(thunk, n=case.n, rank=rank)
+        rec = record_kernel(thunk, n=case.n, rank=rank,
+                            axes=getattr(case, "axes", None))
         # chunk attribution: the most recent copy landing through a
         # semaphore is the transfer a wait on it would starve for
         last_chunk: dict[tuple, str] = {}

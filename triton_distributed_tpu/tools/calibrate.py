@@ -55,7 +55,14 @@ def calibration_path() -> str:
 
 @dataclasses.dataclass(frozen=True)
 class LinkCalibration:
-    """Measured wire-class characteristics of the live topology."""
+    """Measured wire-class characteristics of the live topology.
+
+    ``num_slices`` / ``chips_per_slice`` persist the SLICE TOPOLOGY the
+    hierarchical collectives' chunk schedule consumes
+    (``comm.hierarchical.chunk_schedule`` — the FAST-style emission
+    order needs to know which peer groups ride which wire class without
+    a live mesh in hand): one slice per process group on multislice
+    TPU, measured at calibration time alongside the wire speeds."""
 
     ici_gbps: float | None = None      # per-chip neighbor-hop bandwidth
     ici_hop_us: float | None = None    # per-hop latency
@@ -63,6 +70,8 @@ class LinkCalibration:
     dcn_hop_us: float | None = None
     device_kind: str = ""
     n_devices: int = 0
+    num_slices: int = 1                # DCN extent (process groups)
+    chips_per_slice: int = 0           # ICI extent within one slice
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -235,6 +244,10 @@ def calibrate(mesh=None, *, save: bool | None = None,
         dcn_hop_us=None if dcn_us is None else round(dcn_us, 3),
         device_kind=platform.device_kind(),
         n_devices=jax.device_count(),
+        # slice topology (ISSUE 10): one slice per process group — the
+        # persisted shape the hierarchical chunk schedule keys on
+        num_slices=jax.process_count(),
+        chips_per_slice=jax.device_count() // max(jax.process_count(), 1),
     )
     if save:
         save_calibration(cal)
@@ -388,8 +401,42 @@ def one_shot_bytes_threshold() -> int:
     return _thresholds()[1]
 
 
-def main() -> int:
+def slice_topology() -> tuple[int, int]:
+    """(num_slices, chips_per_slice) of the persisted calibration, else
+    of the live process/device layout, else the single-slice default —
+    the topology model the hierarchical chunk schedule consumes
+    (``comm.hierarchical.chunk_schedule``)."""
+    cal = load_calibration()
+    if cal is not None and cal.num_slices >= 1 and cal.chips_per_slice >= 1:
+        return int(cal.num_slices), int(cal.chips_per_slice)
+    try:
+        procs = jax.process_count()
+        per = jax.device_count() // max(procs, 1)
+        return max(procs, 1), max(per, 1)
+    except Exception:
+        return 1, 1
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="measure per-wire-class link characteristics and "
+                    "persist them (plus the slice topology) beside the "
+                    "autotune cache")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output: one JSON object "
+                         "(calibration + derived thresholds), nothing else")
+    args = ap.parse_args(argv)
     cal = calibrate()
+    if args.json:
+        print(json.dumps({
+            **cal.to_json(),
+            "push_bytes_threshold": push_bytes_threshold(),
+            "one_shot_bytes_threshold": one_shot_bytes_threshold(),
+            "path": calibration_path(),
+        }, sort_keys=True))
+        return 0
     print(json.dumps(cal.to_json()))
     print(f"-> push threshold {push_bytes_threshold()} B, "
           f"one-shot threshold {one_shot_bytes_threshold()} B "

@@ -78,6 +78,19 @@ def gemm_sol_ms(m: int, n: int, k: int, dtype=jnp.bfloat16,
     return costs.sol_ms(costs.matmul(m, n, k, dtype, dtype), device_kind)
 
 
+def dcn_gbps() -> float:
+    """Per-chip DCN bandwidth: the MEASURED link calibration when one
+    exists (``tools.calibrate``), else :data:`DCN_GBPS_PER_CHIP` — the
+    one rate every DCN-charging consumer (two-level sol terms below,
+    ``obs.costs.sol_ms``'s dcn wire term, the watchdog) reads."""
+    from . import calibrate
+
+    cal = calibrate.load_calibration()
+    if cal is not None and cal.dcn_gbps:
+        return float(cal.dcn_gbps)
+    return float(DCN_GBPS_PER_CHIP)
+
+
 def allgather_sol_ms(nbytes_per_rank: int, num_ranks: int,
                      device_kind: str | None = None) -> float:
     """Ring AG: each rank receives (n-1)/n of the gathered payload over its
@@ -99,6 +112,61 @@ def allreduce_sol_ms(nbytes: int, num_ranks: int,
     spec = chip_spec(device_kind)
     wire = 2.0 * nbytes * (num_ranks - 1) / num_ranks
     return wire / (spec.ici_gbps * 1e9) * 1e3
+
+
+# ---------------------------------------------------------------------------
+# two-level (ICI x DCN) sol terms (ISSUE 10): the hierarchical families'
+# roofline charges EACH LEVEL ITS OWN WIRE CLASS — max(ici term, dcn
+# term), the perfectly-pipelined bound the scheduled launch order
+# (comm.hierarchical) is built to approach.  Byte formulas are the
+# per-chip accounting of ``comm.hierarchical.hier_*_wire_bytes``.
+
+
+def _two_level_ms(ici_bytes: float, dcn_bytes: float,
+                  device_kind: str | None = None) -> float:
+    spec = chip_spec(device_kind)
+    t_ici = ici_bytes / (spec.ici_gbps * 1e9)
+    t_dcn = dcn_bytes / (dcn_gbps() * 1e9)
+    return max(t_ici, t_dcn) * 1e3
+
+
+def hier_allgather_sol_ms(nbytes_per_rank: int, n_in: int, n_out: int,
+                          device_kind: str | None = None) -> float:
+    """Hierarchical AG: (n_in-1) shard hops on ICI; (n_out-1) slice
+    blocks of n_in shards each landing over DCN."""
+    return _two_level_ms((n_in - 1) * nbytes_per_rank,
+                         (n_out - 1) * n_in * nbytes_per_rank, device_kind)
+
+
+def hier_reduce_scatter_sol_ms(nbytes: int, n_in: int, n_out: int,
+                               device_kind: str | None = None) -> float:
+    """Hierarchical RS (``nbytes`` = the per-chip partial): inner ring
+    moves (n_in-1) chunks of nbytes/n_in each; psum_scatter then moves
+    (n_out-1)/n_out of the 1/n_in chunk across slices."""
+    chunk = nbytes / max(n_in, 1)
+    return _two_level_ms((n_in - 1) * chunk,
+                         (n_out - 1) * chunk / max(n_out, 1), device_kind)
+
+
+def hier_allreduce_sol_ms(nbytes: int, n_in: int, n_out: int,
+                          device_kind: str | None = None) -> float:
+    """Hierarchical AR (RS ∘ AG): two inner rings move 2(n_in-1)/n_in of
+    the partial on ICI; the DCN hop reduces only the 1/n_in partial
+    (2(n_out-1)/n_out of it on the ring)."""
+    return _two_level_ms(
+        2.0 * nbytes * (n_in - 1) / max(n_in, 1),
+        2.0 * (nbytes / max(n_in, 1)) * (n_out - 1) / max(n_out, 1),
+        device_kind)
+
+
+def hier_a2a_sol_ms(nbytes: int, n_in: int, n_out: int,
+                    device_kind: str | None = None) -> float:
+    """Scheduled EP A2A: the DCN phase ships (n_out-1) FIXED zero-padded
+    payload-sized blocks per chip (static shapes — the bytes move
+    regardless of routing); up to the n_out merged blocks redistribute
+    on ICI."""
+    return _two_level_ms(n_out * float(nbytes),
+                         float(nbytes) * (n_out - 1), device_kind)
 
 
 def fused_sol_ms(family: str, device_kind: str | None = None,

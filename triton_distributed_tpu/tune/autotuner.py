@@ -599,10 +599,15 @@ def resolve_gemm_like(name: str, op, config_cls, cand_dims, default,
     """Default-config resolution for the fused collective GEMMs: the hook
     their entry points call when ``config=None``, and the body of the
     explicit ``tuned_*`` wrappers (``force_measure=True``).  One shared
-    cache key — (shape, ranks, dtype, device, canonical kernel-selecting
-    kwargs) — so a one-time tuned or eager run teaches every later jit'd
-    layer call.  ``kw`` goes to the measurement thunks verbatim; ``key_kw``
-    (default ``kw``) is the canonicalized subset that keys the cache."""
+    cache key — (shape, ranks, dtype, WIRE CLASS, device, canonical
+    kernel-selecting kwargs) — so a one-time tuned or eager run teaches
+    every later jit'd layer call, and a winner crowned on the ICI torus
+    never leaks onto a DCN edge (ISSUE 10: tile choices trade
+    compute-ahead against wire pacing, which differs per wire class).
+    ``kw`` goes to the measurement thunks verbatim; ``key_kw`` (default
+    ``kw``) is the canonicalized subset that keys the cache."""
+    from ..core import mesh as mesh_lib
+
     n_ranks = mesh.shape[axis]
     (m, k), (_, n) = a.shape, b.shape
     dm, dn, dk = cand_dims(m, n, k, n_ranks)
@@ -611,7 +616,8 @@ def resolve_gemm_like(name: str, op, config_cls, cand_dims, default,
     kw_key = str(sorted((key_kw if key_kw is not None else kw).items()))
     return resolve_config(
         name,
-        (m, k, n, n_ranks, str(a.dtype), platform.device_kind(), kw_key),
+        (m, k, n, n_ranks, str(a.dtype), mesh_lib.wire_class(mesh, axis),
+         platform.device_kind(), kw_key),
         cands, default,
         lambda c: (lambda: op(a, b, mesh, axis, config=c, **kw)),
         tracing=is_tracer(a) or is_tracer(b),

@@ -1155,6 +1155,147 @@ def bench_serve_throughput():
     }
 
 
+_DISAGG_RUN = None
+
+
+def _serve_disagg_run(n_requests: int = 48) -> dict:
+    """One shared two-tier disaggregated replay (ISSUE 12) behind the
+    ``serve_disagg`` metrics: a prefill-only tier streams finished KV
+    pages over the modeled priority DCN (with a bulk stream contending
+    on the wire — the traffic the LATENCY-class handoffs preempt) to a
+    decode tier through ``serve.DisaggRouter``.  On this container the
+    tiers are SimBackends and the wire is modeled, so every record is
+    marked ``interpret`` (functional smoke the trend sentinel follows);
+    the slice-gated hard claims arm on the first real multislice
+    capture, the PR-10 pattern."""
+    global _DISAGG_RUN
+    if _DISAGG_RUN is not None:
+        return _DISAGG_RUN
+    import time
+
+    from triton_distributed_tpu import obs, resilience, serve
+
+    prev_obs = obs.enabled()
+    obs.enable(True)
+    obs.serve_stats.STATS.reset()
+    resilience.reset_breaker(serve.HANDOFF_OP)
+    vocab = 512
+    pre = serve.Scheduler(
+        serve.SimBackend(slots=8, page_size=16, pool_pages=65,
+                         max_length=256, vocab=vocab),
+        serve.SchedulerConfig(max_queue_depth=128,
+                              prefill_chunk_tokens=32,
+                              prefill_only=True))
+    dec = serve.Scheduler(
+        serve.SimBackend(slots=8, page_size=16, pool_pages=65,
+                         max_length=256, vocab=vocab),
+        serve.SchedulerConfig(max_queue_depth=128))
+    router = serve.DisaggRouter(
+        pre, dec, plane=serve.HandoffPlane(),
+        config=serve.RouterConfig(bulk_bytes_per_step=1 << 20))
+    arrivals = serve.synthetic_trace(
+        0, n_requests, mean_interarrival_steps=0.25,
+        prompt_len=(8, 48), max_new=(8, 48), vocab=vocab)
+    pending = sorted(arrivals, key=lambda a: (a.step, a.request.req_id))
+    idx = 0
+    try:
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            while idx < len(pending) and pending[idx].step <= pre.steps:
+                router.submit(pending[idx].request)
+                idx += 1
+            res = router.step()
+            if idx >= len(pending) and res.idle:
+                break
+        wall_s = time.perf_counter() - t0
+    finally:
+        obs.enable(prev_obs)
+    reqs = [a.request for a in arrivals]
+    from triton_distributed_tpu.serve import RequestState
+
+    done = [r for r in reqs if r.state is RequestState.DONE]
+    ttft = sorted(r.ttft_ms() for r in done if r.ttft_ms() is not None)
+    plane = router.plane
+    _DISAGG_RUN = {
+        "simulated": True,   # SimBackend tiers + modeled DCN on this box
+        "wall_s": wall_s,
+        "ttft_ms": ttft,
+        "handoff_ms": sorted(plane.handoff_ms),
+        "handoffs": router.handoffs,
+        "colocated": router.colocated,
+        "reprefills": router.reprefills,
+        "retries": plane.retries,
+        "pages_moved": plane.pages_moved,
+        "completed": len(done),
+        "failed": sum(r.state is RequestState.FAILED for r in reqs),
+        "shed": sum(r.state is RequestState.SHED for r in reqs),
+        "leaked_pages": router.leaked_pages(),
+    }
+    return _DISAGG_RUN
+
+
+def bench_serve_disagg_ttft():
+    """TTFT under the disaggregated topology: submit -> first token on
+    the PREFILL tier (the handoff then overlaps with other requests'
+    decode — exactly the step-time isolation the topology buys)."""
+    run = _serve_disagg_run()
+    return {
+        "metric": "serve_disagg_ttft_ms_p99",
+        "value": round(_pctl(run["ttft_ms"], 0.99), 2),
+        "unit": "ms",
+        "p50": round(_pctl(run["ttft_ms"], 0.5), 2),
+        "completed": run["completed"],
+        "handoffs": run["handoffs"],
+        "colocated": run["colocated"],
+        "reprefills": run["reprefills"],
+        "leaked_pages": run["leaked_pages"],
+        "interpret": run["simulated"] or _interpret_capture(),
+    }
+
+
+def bench_handoff_latency():
+    """Per-transfer KV-handoff latency (modeled wire on this box): the
+    page payload's queue wait + serialization + hop on the shared DCN
+    at LATENCY priority."""
+    run = _serve_disagg_run()
+    return {
+        "metric": "handoff_ms_p99",
+        "value": round(_pctl(run["handoff_ms"], 0.99), 3),
+        "unit": "ms",
+        "p50": round(_pctl(run["handoff_ms"], 0.5), 3),
+        "transfers": run["handoffs"],
+        "interpret": run["simulated"] or _interpret_capture(),
+    }
+
+
+def bench_handoff_throughput():
+    """KV pages shipped per second across the replay (re-prefilled
+    transfers excluded — they never delivered pages)."""
+    run = _serve_disagg_run()
+    return {
+        "metric": "handoff_pages_per_s",
+        "value": round(run["pages_moved"] / max(run["wall_s"], 1e-9), 2),
+        "unit": "pages/s",
+        "pages_moved": run["pages_moved"],
+        "wall_s": round(run["wall_s"], 4),
+        "interpret": run["simulated"] or _interpret_capture(),
+    }
+
+
+def bench_handoff_retries():
+    """Burned transfer-ladder rungs across the replay: every retry is
+    wire pressure (obs.history trends it lower-is-better; a clean wire
+    reads 0)."""
+    run = _serve_disagg_run()
+    return {
+        "metric": "handoff_retries",
+        "value": float(run["retries"]),
+        "unit": "count",
+        "reprefills": run["reprefills"],
+        "interpret": run["simulated"] or _interpret_capture(),
+    }
+
+
 def bench_integrity_overhead():
     """The TDT_INTEGRITY tax: checksummed vs plain AG/RS at the tuned
     configs, as a percent of the plain eager op (ISSUE 7 satellite —
@@ -1552,6 +1693,14 @@ def main():
         print(json.dumps(bench_serve_ttft()))
         print(json.dumps(bench_serve_throughput()))
         print(json.dumps(bench_serve_kv_quant()))
+    elif mode == "serve_disagg":
+        # the disaggregated prefill/decode topology (ISSUE 12): TTFT
+        # plus the KV-handoff plane's latency/throughput/retry surface,
+        # all off one shared two-tier replay over the modeled DCN
+        print(json.dumps(bench_serve_disagg_ttft()))
+        print(json.dumps(bench_handoff_latency()))
+        print(json.dumps(bench_handoff_throughput()))
+        print(json.dumps(bench_handoff_retries()))
     elif mode == "wire":
         # quantized collective payload byte accounting + dequant parity
         # (ISSUE 9)
@@ -1589,6 +1738,10 @@ def main():
         _emit(bench_serve_ttft)
         _emit(bench_serve_throughput)
         _emit(bench_serve_kv_quant)
+        _emit(bench_serve_disagg_ttft)
+        _emit(bench_handoff_latency)
+        _emit(bench_handoff_throughput)
+        _emit(bench_handoff_retries)
         _emit(bench_wire_bytes)
         _emit(bench_wire_parity)
         _emit(bench_hier_ar_dcn_bytes)
@@ -1625,7 +1778,8 @@ def main():
         raise SystemExit(
             f"unknown bench mode {mode!r} "
             "(auto|gemm|attn|mlp|moe|decode|decode_modes|moe_ep|latency|"
-            "overlap|overlap_collective|serve|wire|hier|integrity)"
+            "overlap|overlap_collective|serve|serve_disagg|wire|hier|"
+            "integrity)"
         )
 
 

@@ -260,6 +260,33 @@ CLAIMS = {
     "hier_ar_dcn_bytes_ratio": {
         "floor": 0.4, "value_max": 1.02, "since": 10,
     },
+    # -- disaggregated prefill/decode serving (ISSUE 12; `bench.py
+    # serve_disagg`) -- TTFT + the KV-handoff plane's surface.  On this
+    # container the tiers are SimBackends over a MODELED DCN, so every
+    # record is interpret-marked (functional smoke, trended by
+    # obs.history from round 12 on); the hard claims are slice-gated
+    # (min_devices 2) and arm on the first real multislice capture —
+    # the same discipline as overlap_hidden_pct / decode_step_dispatches.
+    # The p99 bound is a gross tripwire (TTFT under deliberate overload
+    # includes queue wait); handoff_ms value_max rejects a handoff that
+    # stopped preempting bulk traffic (a page payload is < 1 MB — tens
+    # of seconds on the wire means it queued behind a stream);
+    # pages/s floor 1 = "the plane shipped SOMETHING"
+    "serve_disagg_ttft_ms_p99": {
+        "value_max": 30_000.0, "min_devices": 2, "since": 12,
+    },
+    "handoff_ms_p99": {
+        "value_max": 10_000.0, "min_devices": 2, "since": 12,
+    },
+    "handoff_pages_per_s": {
+        "floor": 1.0, "min_devices": 2, "since": 12,
+    },
+    # burned ladder rungs per replay: a clean wire reads 0; value_max
+    # is the gross tripwire (every transfer retrying means the wire or
+    # the stamps are broken, not noisy) — trended lower-is-better
+    "handoff_retries": {
+        "value_max": 64.0, "min_devices": 2, "since": 12,
+    },
 }
 
 def parse_record(path: str) -> tuple[list[dict], int | None, bool]:

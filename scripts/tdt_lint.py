@@ -72,10 +72,21 @@ chunk group must precede every ICI-bound one, farthest-first within
 each class, self last — and the ordering must FLIP when the synthetic
 calibration says the ICI is the slower wire).  Headless and CPU-only.
 
+``--handoff`` is the disaggregated-serving gate (ISSUE 12,
+docs/serving.md "Disaggregated serving"): a seeded two-tier replay
+(prefill tier -> ModeledDCN -> decode tier through the REAL router)
+with a transfer drop, a corrupt page in flight, and a prefill-slice
+abort injected — zero leaked pages on BOTH tiers, every faulted
+request completes via the re-prefill fallback (or a clean retry) with
+token parity vs the deterministic golden, monotone drain; then the
+handoff fault cells (``resilience.run_handoff_matrix``: the five
+threat-model classes incl. decode-tier saturation -> colocated shed)
+must each be detected-or-survived.  Headless and CPU-only.
+
 ``--all`` runs every gate above — verify matrix, ``--faults``,
 ``--timeline``, ``--serve``, ``--history``, ``--integrity``,
-``--quant``, ``--hier`` — and summarizes them under a single exit code
-(the CI entry; see README).
+``--quant``, ``--hier``, ``--handoff`` — and summarizes them under a
+single exit code (the CI entry; see README).
 
 ``--history`` runs the bench-record trend sentinel
 (``scripts/bench_history.py --check``): exit 1 when a committed
@@ -140,10 +151,18 @@ def main(argv: list[str] | None = None) -> int:
                          "{2x2,2x4,4x2}, fault cells incl. the dropped "
                          "inter-slice credit, and the schedule-order "
                          "selftest on a synthetic 2x4 topology")
+    ap.add_argument("--handoff", action="store_true",
+                    help="disaggregated-serving gate (ISSUE 12): seeded "
+                         "two-tier replay with a transfer drop, a corrupt "
+                         "page and a prefill-slice abort injected (zero "
+                         "leaked pages on both tiers, faulted requests "
+                         "complete via re-prefill), plus the handoff "
+                         "fault cells")
     ap.add_argument("--all", action="store_true", dest="all_gates",
                     help="run every gate (verify matrix, --faults, "
                          "--timeline, --serve, --history, --integrity, "
-                         "--quant, --hier) with one summarized exit code")
+                         "--quant, --hier, --handoff) with one "
+                         "summarized exit code")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection target sampling seed (--faults)")
     ap.add_argument("--json", metavar="PATH",
@@ -166,6 +185,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_quant(args)
     if args.hier:
         return _run_hier(args)
+    if args.handoff:
+        return _run_handoff(args)
 
     from triton_distributed_tpu import analysis
 
@@ -430,6 +451,7 @@ def _run_all(args) -> int:
         ("integrity", lambda: _run_integrity(sub())),
         ("quant", lambda: _run_quant(sub())),
         ("hier", lambda: _run_hier(sub())),
+        ("handoff", lambda: _run_handoff(sub())),
     ]
     results = []
     for name, fn in legs:
@@ -552,6 +574,120 @@ def _run_serve(args) -> int:
     print("serve OK: overload trace drained with zero leaked pages and "
           "per-request isolation; scheduler fault cells all "
           "detected-or-survived")
+    return 0
+
+
+def _run_handoff(args) -> int:
+    """The disaggregated-serving gate (see module docstring): a seeded
+    two-tier replay with three wire faults injected, then the handoff
+    fault cells."""
+    from triton_distributed_tpu import resilience, serve
+
+    problems: list[str] = []
+
+    # leg 1: two-tier replay — 24 requests through the router with a
+    # transfer DROP (every attempt: the ladder must bottom out to
+    # re-prefill), a CORRUPT page (first attempt: the retry recovers),
+    # and a prefill-slice ABORT mid-handoff
+    resilience.reset_breaker(serve.HANDOFF_OP)
+    faults = [
+        serve.WireFault(serve.HandoffFault.TRANSFER_DROP, 2),
+        serve.WireFault(serve.HandoffFault.CORRUPT_PAGE, 5, attempts=1),
+        serve.WireFault(serve.HandoffFault.PREFILL_ABORT, 8),
+    ]
+    pre = serve.Scheduler(
+        serve.SimBackend(slots=4, page_size=4, pool_pages=33,
+                         max_length=64),
+        serve.SchedulerConfig(max_queue_depth=64, prefill_only=True))
+    dec = serve.Scheduler(
+        serve.SimBackend(slots=4, page_size=4, pool_pages=49,
+                         max_length=64),
+        serve.SchedulerConfig(max_queue_depth=64))
+    plane = serve.HandoffPlane(
+        dcn_channel=serve.ModeledDCN(faults=faults, seed=args.seed))
+    router = serve.DisaggRouter(pre, dec, plane=plane)
+    arrivals = serve.synthetic_trace(args.seed, 24,
+                                     mean_interarrival_steps=0.5,
+                                     prompt_len=(2, 12), max_new=(2, 10))
+    idx = 0
+    pending = sorted(arrivals, key=lambda a: (a.step, a.request.req_id))
+    for _ in range(20_000):
+        while idx < len(pending) and \
+                pending[idx].step <= pre.steps:
+            router.submit(pending[idx].request)
+            idx += 1
+        if idx >= len(pending) and router.step().idle:
+            break
+        elif idx < len(pending):
+            router.step()
+    reqs = [a.request for a in arrivals]
+    done = [r for r in reqs if r.state is serve.RequestState.DONE]
+    failed = [r for r in reqs if r.state is serve.RequestState.FAILED]
+    nonterminal = [r for r in reqs if not r.done]
+    parity_bad = [r.req_id for r in done
+                  if r.tokens != pre.backend.expected_tokens(r)]
+    print(f"handoff trace: {len(reqs)} requests -> {len(done)} "
+          f"completed, {len(failed)} failed; {router.handoffs} "
+          f"handoffs, {router.colocated} colocated, "
+          f"{router.reprefills} re-prefills, {router.aborts} aborts, "
+          f"{plane.retries} retries, {len(plane.corruptions)} "
+          f"corruption(s) named, leaked pages {router.leaked_pages()}")
+    if nonterminal:
+        problems.append(f"trace: {len(nonterminal)} request(s) never "
+                        f"terminal: {[r.req_id for r in nonterminal]}")
+    if failed:
+        problems.append(f"trace: {len(failed)} request(s) FAILED — "
+                        f"every faulted transfer must recover via "
+                        f"retry or re-prefill: "
+                        f"{[(r.req_id, r.error) for r in failed]}")
+    if parity_bad:
+        problems.append(f"trace: token parity broken vs the colocated "
+                        f"golden for request(s) {parity_bad}")
+    if router.leaked_pages():
+        problems.append(f"trace: {router.leaked_pages()} page(s) "
+                        f"leaked across the tiers")
+    if plane.dcn.drops < 1 or router.reprefills < 1:
+        problems.append(f"trace: the drop injection never exercised "
+                        f"the re-prefill fallback (drops="
+                        f"{plane.dcn.drops}, reprefills="
+                        f"{router.reprefills})")
+    if not plane.corruptions:
+        problems.append("trace: the corrupt-page injection was never "
+                        "named by the stamp verify")
+    if router.aborts < 1:
+        problems.append("trace: the prefill-slice abort never fired")
+    resilience.reset_breaker(serve.HANDOFF_OP)
+
+    # leg 2: the handoff fault cells
+    rows = resilience.run_handoff_matrix(seed=args.seed)
+    for row in rows:
+        named = f"  [{', '.join(row['named'])}]" if row["named"] else ""
+        print(f"{row['kernel']:<20} {row['fault']:<24} "
+              f"{row['outcome'].upper():<10}{named}")
+    problems += resilience.verify_handoff_matrix(rows)
+
+    for p in problems:
+        print(f"HANDOFF FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({
+                "trace": {
+                    "requests": len(reqs), "completed": len(done),
+                    "failed": len(failed),
+                    "handoffs": router.handoffs,
+                    "colocated": router.colocated,
+                    "reprefills": router.reprefills,
+                    "aborts": router.aborts,
+                    "leaked_pages": router.leaked_pages(),
+                },
+                "cells": rows, "problems": problems,
+            }, f, indent=1, sort_keys=True, default=str)
+    if problems:
+        return 1
+    print("handoff OK: two-tier replay drained with zero leaked pages "
+          "on both tiers, every faulted request completed via "
+          "retry/re-prefill with token parity; all handoff fault "
+          "cells detected-or-survived")
     return 0
 
 

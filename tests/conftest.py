@@ -131,6 +131,7 @@ FAST_NODES = frozenset((
     "test_fused_mlp_ar_protocol_clean[swiglu-4]",
     "tests/test_fused_decode.py::test_fused_fault_cells_detected_or_survived",
     "tests/test_fused_decode.py::test_decode_writeback_copy_count",
+    "tests/test_handoff.py::test_tdt_lint_handoff_smoke",
 ))
 
 

@@ -1,0 +1,615 @@
+"""Disaggregated prefill/decode serving and the KV-handoff plane
+(ISSUE 12).
+
+Headless like the scheduler tests: two REAL schedulers over
+deterministic ``SimBackend``s, the real paged-cache plumbing on both
+tiers, and the ``ModeledDCN`` transport (priority wire model + seeded
+fault plan) in between — so page bookkeeping, stamp verification, the
+transfer ladder, the re-prefill fallback and the colocation shed are
+exercised end to end without hardware.
+"""
+
+import dataclasses
+import os
+import random
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from triton_distributed_tpu import obs, resilience, serve
+from triton_distributed_tpu.comm import dcn
+from triton_distributed_tpu.resilience import integrity
+from triton_distributed_tpu.serve import handoff as handoff_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def obs_on():
+    prev = obs.enabled()
+    obs.enable(True)
+    obs.REGISTRY.reset()
+    obs.serve_stats.STATS.reset()
+    yield obs
+    obs.enable(prev)
+    obs.REGISTRY.reset()
+    obs.serve_stats.STATS.reset()
+
+
+@pytest.fixture()
+def integrity_on():
+    prev = integrity._ENABLED
+    integrity.enable(True)
+    yield integrity
+    integrity.enable(prev)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_handoff_breaker():
+    """The handoff breaker is process-global ladder state: no test may
+    inherit (or donate) an open breaker."""
+    resilience.reset_breaker(serve.HANDOFF_OP)
+    yield
+    resilience.reset_breaker(serve.HANDOFF_OP)
+
+
+def _two_tier(*, faults=(), seed=1, decode_slots=3, decode_pool=32,
+              prefill_pool=24, plane_cfg=None, router_cfg=None):
+    pre = serve.Scheduler(
+        serve.SimBackend(slots=3, page_size=4, pool_pages=prefill_pool,
+                         max_length=48),
+        serve.SchedulerConfig(max_queue_depth=32, prefill_only=True))
+    dec = serve.Scheduler(
+        serve.SimBackend(slots=decode_slots, page_size=4,
+                         pool_pages=decode_pool, max_length=48),
+        serve.SchedulerConfig(max_queue_depth=32))
+    plane = serve.HandoffPlane(
+        dcn_channel=serve.ModeledDCN(faults=faults, seed=seed),
+        config=plane_cfg)
+    return serve.DisaggRouter(pre, dec, plane=plane, config=router_cfg)
+
+
+def _submit_load(router, n=6, seed=0, max_new=(3, 8)):
+    rng = random.Random(seed)
+    reqs = [
+        serve.Request(prompt=tuple(rng.randrange(1, 90)
+                                   for _ in range(rng.randint(2, 6))),
+                      max_new_tokens=rng.randint(*max_new))
+        for _ in range(n)
+    ]
+    for r in reqs:
+        assert router.submit(r)
+    return reqs
+
+
+def _assert_all_done_with_parity(router, reqs):
+    backend = router.prefill.backend
+    for r in reqs:
+        assert r.state is serve.RequestState.DONE, (r.req_id, r.state,
+                                                    r.error)
+        assert r.tokens == backend.expected_tokens(r), r.req_id
+    assert router.leaked_pages() == 0
+
+
+# ---------------------------------------------------------------------------
+# the priority-classed DCN wire model
+
+
+def test_priority_wire_latency_preempts_bulk():
+    """FAST's discipline at the port: a LATENCY send queued behind a
+    multi-chunk bulk stream waits at most ONE chunk's serialization,
+    never the stream."""
+    wire = dcn.PriorityDCNWire(gbps=1.0, hop_us=0.0,
+                               chunk_bytes=1 << 20)
+    bulk_ms = wire.send(64 << 20, priority=dcn.BULK)   # 64 chunks
+    lat_ms = wire.send(1 << 20, priority=dcn.LATENCY)
+    chunk_ms = (1 << 20) / 1e9 * 1e3
+    assert lat_ms < bulk_ms
+    # wait component bounded by one chunk residual
+    assert lat_ms <= 2 * chunk_ms + 1e-9
+    # the same transfer WITHOUT priority queues behind the whole stream
+    tail_ms = wire.send(1 << 20, priority=dcn.BULK)
+    assert tail_ms > 64 * chunk_ms
+
+
+def test_priority_wire_fifo_within_class_and_tick():
+    wire = dcn.PriorityDCNWire(gbps=1.0, hop_us=0.0)
+    a = wire.send(1 << 20, priority=dcn.LATENCY)
+    b = wire.send(1 << 20, priority=dcn.LATENCY)
+    assert b > a                         # FIFO within the class
+    assert wire.backlog_ms(dcn.LATENCY) > 0
+    wire.tick(1e9)
+    assert wire.backlog_ms(dcn.LATENCY) == 0.0
+    assert wire.backlog_ms(dcn.BULK) == 0.0
+    with pytest.raises(ValueError):
+        wire.send(1, priority=7)
+
+
+def test_priority_wire_tick_drains_latency_first():
+    wire = dcn.PriorityDCNWire(gbps=1.0, hop_us=0.0)
+    wire.send(2 << 20, priority=dcn.BULK)
+    wire.send(2 << 20, priority=dcn.LATENCY)
+    one_chunk_ms = (2 << 20) / 1e9 * 1e3
+    wire.tick(one_chunk_ms)
+    assert wire.backlog_ms(dcn.LATENCY) == 0.0
+    assert wire.backlog_ms(dcn.BULK) == pytest.approx(one_chunk_ms)
+
+
+# ---------------------------------------------------------------------------
+# payload: extract / verify / implant
+
+
+def _prefilled_pair(kv_dtype=None, prompt=(5, 9, 14, 3, 7)):
+    """One request prefilled on a producer scheduler; a fresh consumer
+    scheduler of the same geometry."""
+    pre = serve.Scheduler(
+        serve.SimBackend(slots=2, page_size=4, pool_pages=16,
+                         max_length=32, kv_dtype=kv_dtype),
+        serve.SchedulerConfig(max_queue_depth=8, prefill_only=True))
+    dec = serve.Scheduler(
+        serve.SimBackend(slots=2, page_size=4, pool_pages=16,
+                         max_length=32, kv_dtype=kv_dtype),
+        serve.SchedulerConfig(max_queue_depth=8))
+    req = serve.Request(prompt=prompt, max_new_tokens=4)
+    pre.submit(req)
+    for _ in range(20):
+        pre.step()
+        if pre.handoff_ready():
+            break
+    assert pre.handoff_ready()
+    return pre, dec, req
+
+
+@pytest.mark.parametrize("wire_dtype", ["raw", "int8"])
+def test_extract_implant_round_trip(wire_dtype):
+    pre, dec, req = _prefilled_pair()
+    i = pre.handoff_ready()[0]
+    slot = pre.slots[i]
+    payload = handoff_mod.extract_payload(
+        pre.cache, slot.pages, req, slot.next_token,
+        wire_dtype=wire_dtype)
+    assert payload.wire == wire_dtype
+    assert payload.n_pages == serve.pages_needed(req.prompt_len, 4)
+    assert handoff_mod.verify_payload(payload) is None
+    ok = dec.adopt_prefilled(
+        req, lambda c, p: handoff_mod.implant_payload(c, p, payload),
+        length=payload.prompt_len, next_token=payload.first_token)
+    assert ok
+    j = next(k for k, s in enumerate(dec.slots) if s is not None)
+    src = np.asarray(pre.cache.k[:, [int(p) for p in slot.pages[
+        :payload.n_pages]]])
+    dst = np.asarray(dec.cache.k[:, [int(p) for p in dec.slots[j].pages[
+        :payload.n_pages]]])
+    if wire_dtype == "raw":
+        np.testing.assert_array_equal(src, dst)
+    else:
+        # int8 wire: round-trip bounded by the codec's per-row envelope
+        from triton_distributed_tpu.lang import quant
+
+        bound = float(np.abs(src).max()) * quant.rel_error_bound("int8")
+        assert float(np.abs(src - dst).max()) <= bound + 1e-6
+
+
+def test_extract_auto_wire_consults_codec_economics():
+    pre, _, req = _prefilled_pair()
+    i = pre.handoff_ready()[0]
+    slot = pre.slots[i]
+    payload = handoff_mod.extract_payload(
+        pre.cache, slot.pages, req, slot.next_token, wire_dtype="auto")
+    from triton_distributed_tpu.tools import calibrate
+
+    row_width = int(np.prod(payload.page_shape))
+    want = "int8" if calibrate.codec_pays("dcn", row_width) else "raw"
+    assert payload.wire == want
+
+
+def test_int8_pool_ships_pages_and_sidecars_verbatim():
+    pre, dec, req = _prefilled_pair(kv_dtype="int8")
+    i = pre.handoff_ready()[0]
+    slot = pre.slots[i]
+    payload = handoff_mod.extract_payload(
+        pre.cache, slot.pages, req, slot.next_token, wire_dtype="auto")
+    assert payload.wire == "pool"
+    assert payload.k.dtype == np.int8 and payload.k_scale is not None
+    ok = dec.adopt_prefilled(
+        req, lambda c, p: handoff_mod.implant_payload(c, p, payload),
+        length=payload.prompt_len, next_token=payload.first_token)
+    assert ok
+    j = next(k for k, s in enumerate(dec.slots) if s is not None)
+    pids_src = [int(p) for p in slot.pages[:payload.n_pages]]
+    pids_dst = [int(p) for p in dec.slots[j].pages[:payload.n_pages]]
+    np.testing.assert_array_equal(
+        np.asarray(pre.cache.k[:, pids_src]),
+        np.asarray(dec.cache.k[:, pids_dst]))
+    np.testing.assert_array_equal(
+        np.asarray(pre.cache.k_scale[:, pids_src]),
+        np.asarray(dec.cache.k_scale[:, pids_dst]))
+
+
+def test_verify_payload_names_corrupt_page():
+    pre, _, req = _prefilled_pair()
+    i = pre.handoff_ready()[0]
+    slot = pre.slots[i]
+    payload = handoff_mod.extract_payload(
+        pre.cache, slot.pages, req, slot.next_token, wire_dtype="raw")
+    bad = payload.copy()
+    pg = np.ascontiguousarray(bad.k[:, 1])
+    pg.view(np.uint8).reshape(-1)[3] ^= 0xFF
+    bad.k[:, 1] = pg
+    diag = handoff_mod.verify_payload(bad)
+    assert diag is not None
+    assert diag.chunk == "page[1]"
+    assert "stamp" in diag.note
+
+
+def test_verify_payload_flags_stale_stamp_sidecar():
+    pre, _, req = _prefilled_pair()
+    i = pre.handoff_ready()[0]
+    slot = pre.slots[i]
+    payload = handoff_mod.extract_payload(
+        pre.cache, slot.pages, req, slot.next_token, wire_dtype="raw")
+    stale = payload.copy()
+    stale.stamps = {j: (s ^ 0xDEAD) & 0xFFFFFFFF
+                    for j, s in stale.stamps.items()}
+    assert handoff_mod.verify_payload(stale) is not None
+    missing = payload.copy()
+    missing.stamps = {0: payload.stamps[0]}   # sidecar from a SHORTER
+    diag = handoff_mod.verify_payload(missing)  # previous transfer
+    assert diag is not None and "sidecar" in diag.note
+
+
+# ---------------------------------------------------------------------------
+# the transfer ladder (plane level)
+
+
+def _payload_for_plane():
+    pre, _, req = _prefilled_pair()
+    i = pre.handoff_ready()[0]
+    slot = pre.slots[i]
+    return handoff_mod.extract_payload(
+        pre.cache, slot.pages, req, slot.next_token, wire_dtype="raw")
+
+
+def test_plane_clean_transfer_delivers(obs_on):
+    plane = serve.HandoffPlane(dcn_channel=serve.ModeledDCN())
+    out = plane.transfer(_payload_for_plane())
+    assert out is not None
+    assert plane.delivered == 1 and plane.retries == 0
+    assert plane.handoff_ms and plane.handoff_ms[0] > 0
+    snap = obs.serve_stats.STATS.snapshot()
+    assert snap["handoff_ms"]["count"] == 1
+    assert snap["handoff_pages_total"] == out.n_pages
+
+
+def test_plane_retry_recovers_first_attempt_corruption():
+    plane = serve.HandoffPlane(dcn_channel=serve.ModeledDCN(
+        faults=[serve.WireFault(serve.HandoffFault.CORRUPT_PAGE, 0,
+                                attempts=1)]))
+    out = plane.transfer(_payload_for_plane())
+    assert out is not None
+    assert plane.retries == 1
+    assert plane.corruptions and "page[" in plane.corruptions[0]["chunk"]
+    # the retried payload that landed is byte-clean
+    assert handoff_mod.verify_payload(out) is None
+
+
+def test_plane_drop_exhausts_ladder_to_none():
+    plane = serve.HandoffPlane(dcn_channel=serve.ModeledDCN(
+        faults=[serve.WireFault(serve.HandoffFault.TRANSFER_DROP, 0)]))
+    assert plane.transfer(_payload_for_plane()) is None
+    assert plane.exhausted == 1
+    assert plane.retries == plane.cfg.max_retries
+    assert plane.dcn.drops == plane.cfg.max_retries + 1
+
+
+def test_plane_breaker_opens_and_short_circuits():
+    """Three ladder-bottom failures open the sticky handoff breaker;
+    the next transfer goes straight to the re-prefill cue WITHOUT
+    touching the wire, and /healthz would report the op degraded."""
+    plane = serve.HandoffPlane(dcn_channel=serve.ModeledDCN(
+        faults=[serve.WireFault(serve.HandoffFault.TRANSFER_DROP, t)
+                for t in range(3)]))
+    for _ in range(3):
+        assert plane.transfer(_payload_for_plane()) is None
+    assert resilience.breaker(serve.HANDOFF_OP).open
+    attempts_before = plane.dcn.transfers
+    assert plane.transfer(_payload_for_plane()) is None
+    assert plane.dcn.transfers == attempts_before   # wire never touched
+    snap = resilience.health_snapshot()
+    assert serve.HANDOFF_OP in snap["degraded_ops"]
+
+
+# ---------------------------------------------------------------------------
+# the router: end-to-end two-tier behavior
+
+
+def test_disagg_happy_path_parity_and_zero_leaks():
+    router = _two_tier()
+    reqs = _submit_load(router)
+    router.run_until_idle(max_steps=2000)
+    _assert_all_done_with_parity(router, reqs)
+    assert router.handoffs > 0
+    assert router.reprefills == 0
+    # decode work actually ran on the decode tier
+    assert len(router.decode.completed) == router.handoffs
+
+
+def test_handoff_ttft_observed_once_per_request(obs_on):
+    router = _two_tier()
+    reqs = _submit_load(router, n=4)
+    router.run_until_idle(max_steps=2000)
+    _assert_all_done_with_parity(router, reqs)
+    snap = obs.serve_stats.STATS.snapshot()
+    assert snap["ttft_ms"]["count"] == len(reqs)
+
+
+def test_drop_rides_ladder_to_reprefill_on_decode_tier():
+    router = _two_tier(faults=[
+        serve.WireFault(serve.HandoffFault.TRANSFER_DROP, 1)])
+    reqs = _submit_load(router)
+    router.run_until_idle(max_steps=4000)
+    _assert_all_done_with_parity(router, reqs)
+    assert router.reprefills == 1
+    assert router.plane.exhausted == 1
+    # the re-prefilled request completed on the DECODE tier
+    rid = next(iter(router.reprefill_ids))
+    assert any(r.req_id == rid for r in router.decode.completed)
+
+
+def test_prefill_abort_mid_handoff_reprefills():
+    router = _two_tier(faults=[
+        serve.WireFault(serve.HandoffFault.PREFILL_ABORT, 1)])
+    reqs = _submit_load(router)
+    router.run_until_idle(max_steps=4000)
+    _assert_all_done_with_parity(router, reqs)
+    assert router.aborts == 1 and router.reprefills == 1
+
+
+def test_decode_saturation_sheds_to_colocated():
+    router = _two_tier(decode_slots=1, decode_pool=3)
+    reqs = _submit_load(router)
+    router.run_until_idle(max_steps=4000)
+    _assert_all_done_with_parity(router, reqs)
+    assert router.colocated > 0
+    # the colocated requests decoded on the PREFILL tier
+    assert len(router.prefill.completed) == router.colocated
+
+
+def test_router_submit_load_balances_on_queue_pressure():
+    """The telemetry-driven routing: a pressured prefill tier with a
+    healthy decode tier routes fresh submits COLOCATED to the decode
+    tier (queue-depth gauge as the signal)."""
+    router = _two_tier(router_cfg=serve.RouterConfig(queue_pressure=0.2))
+    rng = random.Random(3)
+    for _ in range(10):
+        router.submit(serve.Request(
+            prompt=tuple(rng.randrange(1, 90) for _ in range(4)),
+            max_new_tokens=4))
+    # prefill queue crossed 0.2 * 32 ≈ 6: later submits landed on the
+    # decode tier directly
+    assert router.decode.queue.depth > 0
+
+
+def test_router_requires_prefill_only_tier():
+    sched = serve.Scheduler(
+        serve.SimBackend(slots=2, page_size=4, pool_pages=16,
+                         max_length=32),
+        serve.SchedulerConfig())
+    with pytest.raises(ValueError, match="prefill_only"):
+        serve.DisaggRouter(sched, sched)
+
+
+def test_router_rejects_mismatched_page_geometry():
+    """Mismatched tier page shapes must fail FAST at construction — not
+    crash the first handoff with a raw shape error mid-step."""
+    pre = serve.Scheduler(
+        serve.SimBackend(slots=2, page_size=16, pool_pages=8,
+                         max_length=32),
+        serve.SchedulerConfig(prefill_only=True))
+    dec = serve.Scheduler(
+        serve.SimBackend(slots=2, page_size=8, pool_pages=16,
+                         max_length=32),
+        serve.SchedulerConfig())
+    with pytest.raises(ValueError, match="page geometries differ"):
+        serve.DisaggRouter(pre, dec)
+
+
+def test_mixed_kv_dtype_tiers_handoff_and_reprefill(integrity_on):
+    """A float-pool prefill tier feeding an int8-pool decode tier: the
+    implant requantizes, and the re-prefill fallback must NOT carry the
+    producer's f32 pool stamps (the int8 recompute is byte-different by
+    design — carrying them would fail every re-prefill spuriously)."""
+    pre = serve.Scheduler(
+        serve.SimBackend(slots=3, page_size=4, pool_pages=24,
+                         max_length=48),
+        serve.SchedulerConfig(max_queue_depth=32, prefill_only=True,
+                              kv_audit_interval_steps=1))
+    dec = serve.Scheduler(
+        serve.SimBackend(slots=3, page_size=4, pool_pages=32,
+                         max_length=48, kv_dtype="int8"),
+        serve.SchedulerConfig(max_queue_depth=32))
+    router = serve.DisaggRouter(pre, dec, plane=serve.HandoffPlane(
+        dcn_channel=serve.ModeledDCN(faults=[
+            serve.WireFault(serve.HandoffFault.TRANSFER_DROP, 1)])))
+    assert not router._stamp_carry_ok
+    reqs = _submit_load(router, n=4, seed=9)
+    router.run_until_idle(max_steps=4000)
+    _assert_all_done_with_parity(router, reqs)
+    assert router.reprefills == 1
+    rid = next(iter(router.reprefill_ids))
+    victim = next(r for r in reqs if r.req_id == rid)
+    assert victim.state is serve.RequestState.DONE
+    assert victim.kv_stamps is None      # never carried cross-layout
+
+
+def test_router_health_aggregates_tiers():
+    # decode tier small enough that queued work blocks on PAGES (the
+    # saturation latch) while every request still eventually fits
+    router = _two_tier(decode_slots=3, decode_pool=5)
+    snap = router.health()
+    assert snap["status"] == "ok"
+    assert set(snap["tiers"]) == {"prefill", "decode"}
+    # force decode-tier saturation: queued work it cannot admit
+    rng = random.Random(5)
+    for _ in range(4):
+        router.decode.submit(serve.Request(
+            prompt=tuple(rng.randrange(1, 90) for _ in range(4)),
+            max_new_tokens=2))
+    router.decode.step()
+    snap = router.health()
+    assert snap["status"] == "saturated"
+    assert snap["saturated_tiers"] == ["decode"]
+    # drain: flips back
+    router.run_until_idle(max_steps=2000)
+    assert router.health()["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# the re-prefill carry: recompute verified like a preemption restore
+
+
+def test_reprefill_carries_stamps_and_verifies(integrity_on):
+    router = _two_tier(faults=[
+        serve.WireFault(serve.HandoffFault.TRANSFER_DROP, 1)])
+    # audit every step so prompt pages are stamped by handoff time
+    router.prefill.cfg.kv_audit_interval_steps = 1
+    reqs = _submit_load(router, n=4, seed=7)
+    router.run_until_idle(max_steps=4000)
+    _assert_all_done_with_parity(router, reqs)
+    assert router.reprefills == 1
+    rid = next(iter(router.reprefill_ids))
+    victim = next(r for r in reqs if r.req_id == rid)
+    # the carry was consumed by a SUCCESSFUL restore verification
+    assert victim.kv_stamps is None
+
+
+def test_reprefill_divergent_recompute_fails_named(integrity_on):
+    """A poisoned carry (the producer's stamps do not match the
+    recompute) must FAIL the request with the page named — neither copy
+    can be trusted."""
+    dec = serve.Scheduler(
+        serve.SimBackend(slots=2, page_size=4, pool_pages=16,
+                         max_length=32),
+        serve.SchedulerConfig(max_queue_depth=8))
+    req = serve.Request(prompt=(5, 9, 14, 3, 7), max_new_tokens=4)
+    req.kv_stamps = {0: 0xBAD}   # a stamp the recompute cannot match
+    dec.submit(req)
+    for _ in range(40):
+        if dec.step().idle:
+            break
+    assert req.state is serve.RequestState.FAILED
+    assert "PayloadCorruption" in req.error and "stamp" in req.error
+
+
+# ---------------------------------------------------------------------------
+# TDT_SCRUB_PAGES: poison-fill on free (ISSUE 12 satellite)
+
+
+def test_scrub_pages_poisons_recycled_pages(monkeypatch):
+    """With TDT_SCRUB_PAGES=1 a completed request's freed pages read
+    the POISON pattern, not the previous tenant's token history — any
+    stale-read bug (a handoff mapping a recycled page included) trips
+    deterministically."""
+    from triton_distributed_tpu.serve import budget
+
+    monkeypatch.setenv("TDT_SCRUB_PAGES", "1")
+    backend = serve.SimBackend(slots=2, page_size=4, pool_pages=16,
+                               max_length=32)
+    sched = serve.Scheduler(backend, serve.SchedulerConfig())
+    req = serve.Request(prompt=(5, 9, 14, 3), max_new_tokens=3)
+    sched.submit(req)
+    pages = None
+    for _ in range(40):
+        for s in sched.slots:
+            if s is not None and s.request is req:
+                pages = [int(p) for p in s.pages]
+        if sched.step().idle:
+            break
+    assert req.state is serve.RequestState.DONE and pages
+    # read BEFORE rewrite: every recycled page holds the poison
+    got = np.asarray(sched.cache.k[:, pages])
+    assert np.all(got == budget.POISON_FLOAT), got
+    # and NOT the token history the previous tenant wrote
+    assert not np.any(np.isin(got, np.asarray(req.prompt, np.float32)))
+
+
+def test_scrub_disabled_keeps_stale_bytes(monkeypatch):
+    """The contrast pin: without the flag, freed pages keep the
+    previous tenant's bytes — exactly the hazard the poison surfaces."""
+    monkeypatch.delenv("TDT_SCRUB_PAGES", raising=False)
+    backend = serve.SimBackend(slots=2, page_size=4, pool_pages=16,
+                               max_length=32)
+    sched = serve.Scheduler(backend, serve.SchedulerConfig())
+    req = serve.Request(prompt=(5, 9, 14, 3), max_new_tokens=3)
+    sched.submit(req)
+    pages = None
+    for _ in range(40):
+        for s in sched.slots:
+            if s is not None and s.request is req:
+                pages = [int(p) for p in s.pages]
+        if sched.step().idle:
+            break
+    assert req.state is serve.RequestState.DONE and pages
+    got = np.asarray(sched.cache.k[:, pages])
+    assert np.any(np.isin(got, np.asarray(req.prompt, np.float32)))
+
+
+def test_scrub_int8_pool_uses_int8_poison(monkeypatch):
+    from triton_distributed_tpu.serve import budget
+
+    monkeypatch.setenv("TDT_SCRUB_PAGES", "1")
+    backend = serve.SimBackend(slots=2, page_size=4, pool_pages=16,
+                               max_length=32, kv_dtype="int8")
+    sched = serve.Scheduler(backend, serve.SchedulerConfig())
+    req = serve.Request(prompt=(5, 9, 14, 3), max_new_tokens=3)
+    sched.submit(req)
+    pages = None
+    for _ in range(40):
+        for s in sched.slots:
+            if s is not None and s.request is req:
+                pages = [int(p) for p in s.pages]
+        if sched.step().idle:
+            break
+    assert req.state is serve.RequestState.DONE and pages
+    got = np.asarray(sched.cache.k[:, pages])
+    assert got.dtype == np.int8
+    assert np.all(got == budget.POISON_INT8)
+
+
+# ---------------------------------------------------------------------------
+# the fault matrix's handoff cells + CI smoke
+
+
+def test_handoff_matrix_cells_all_classified():
+    rows = resilience.run_handoff_matrix(seed=0)
+    assert {r["fault"] for r in rows} == \
+        {k.value for k in serve.HANDOFF_FAULT_KINDS}
+    assert resilience.verify_handoff_matrix(rows) == []
+    for row in rows:
+        want = "survived" if row["fault"] == "decode_saturated" \
+            else "detected"
+        assert row["outcome"] == want, row
+
+
+def test_verify_handoff_matrix_flags_missing_class():
+    rows = resilience.run_handoff_matrix(seed=0)
+    problems = resilience.verify_handoff_matrix(
+        [r for r in rows if r["fault"] != "stale_stamp"])
+    assert any("stale_stamp" in p for p in problems)
+
+
+def test_tdt_lint_handoff_smoke():
+    """The tier-1 CI hook (like the --serve / --integrity smokes): the
+    seeded two-tier replay with a drop, a corrupt page and a prefill
+    abort injected, plus the handoff fault cells."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tdt_lint.py"),
+         "--handoff"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "handoff OK" in proc.stdout
+    assert "DETECTED" in proc.stdout and "SURVIVED" in proc.stdout

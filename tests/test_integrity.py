@@ -257,6 +257,17 @@ SCHEDULER_GOLDEN = {
     ("corrupt_kv_page", "poison"),
 }
 
+# the disaggregated-handoff cells (ISSUE 12): one per HandoffFault
+# class.  A class added to serve.handoff.HandoffFault without a matrix
+# cell fails below with the diff as the message (the PR-7 discipline).
+HANDOFF_GOLDEN = {
+    ("transfer_drop", "reprefill"),
+    ("corrupt_page_in_flight", "retry"),
+    ("stale_stamp", "retry"),
+    ("prefill_rank_abort", "reprefill"),
+    ("decode_saturated", "colocate"),
+}
+
 
 def test_fault_matrix_shape_pinned():
     """A golden listing of every (kernel x fault-class) cell: a new
@@ -279,6 +290,19 @@ def test_fault_matrix_shape_pinned():
     assert covered == {k.value for k in rz.FAULT_KINDS}, (
         f"fault class(es) without any matrix cell: "
         f"{sorted({k.value for k in rz.FAULT_KINDS} - covered)}")
+    # the handoff threat model (ISSUE 12) keeps the same discipline:
+    # the cell listing is pinned AND every HandoffFault class must have
+    # a cell — adding a class without one fails with the diff
+    from triton_distributed_tpu.serve import HANDOFF_FAULT_KINDS
+
+    hand = {(r["fault"], r["leg"]) for r in rz.run_handoff_matrix(0)}
+    assert hand == HANDOFF_GOLDEN, (
+        f"handoff cells drifted: +{sorted(hand - HANDOFF_GOLDEN)} "
+        f"-{sorted(HANDOFF_GOLDEN - hand)}")
+    assert {f for f, _ in hand} == \
+        {k.value for k in HANDOFF_FAULT_KINDS}, (
+        f"handoff fault class(es) without any matrix cell: "
+        f"{sorted({k.value for k in HANDOFF_FAULT_KINDS} - {f for f, _ in hand})}")
 
 
 # ---------------------------------------------------------------------------

@@ -977,6 +977,72 @@ def test_telemetry_server_endpoints(obs_on):
         policy._reset_state_for_tests()
 
 
+def test_telemetry_two_tier_healthz_aggregation(obs_on):
+    """ISSUE 12 satellite: /healthz against a DisaggRouter aggregates
+    BOTH schedulers — 503 while EITHER tier is saturated or any breaker
+    is open, flipping back to 200 as each drains independently."""
+    from triton_distributed_tpu import serve
+    from triton_distributed_tpu.obs import server as obs_server
+    from triton_distributed_tpu.resilience import policy
+
+    def tier(prefill_only):
+        return serve.Scheduler(
+            serve.SimBackend(slots=3, page_size=4, pool_pages=5,
+                             max_length=32),
+            serve.SchedulerConfig(max_queue_depth=16,
+                                  prefill_only=prefill_only))
+
+    pre, dec = tier(True), tier(False)
+    router = serve.DisaggRouter(pre, dec)
+    srv = obs_server.start(port=0, engine=router)
+    try:
+        assert _get(srv.url + "/healthz")[0] == 200
+        # saturate the PREFILL tier: queued work blocked on pages
+        for _ in range(4):
+            pre.submit(serve.Request(prompt=(1, 2, 3, 4),
+                                     max_new_tokens=2))
+        pre.step()
+        code, body = _get(srv.url + "/healthz")
+        assert code == 503
+        snap = json.loads(body)
+        assert snap["status"] == "saturated"
+        assert snap["saturated_tiers"] == ["prefill"]
+        # saturate the DECODE tier too (colocated direct submits)
+        for _ in range(4):
+            dec.submit(serve.Request(prompt=(5, 6, 7, 8),
+                                     max_new_tokens=2))
+        dec.step()
+        snap = json.loads(_get(srv.url + "/healthz")[1])
+        assert set(snap["saturated_tiers"]) == {"prefill", "decode"}
+        # drain the decode tier ALONE: still 503 — the prefill tier
+        # holds the aggregate down independently
+        for _ in range(300):
+            if dec.step().idle:
+                break
+        code, body = _get(srv.url + "/healthz")
+        assert code == 503
+        assert json.loads(body)["saturated_tiers"] == ["prefill"]
+        # drain the rest through the router: flips back to 200
+        router.run_until_idle(max_steps=2000)
+        code, body = _get(srv.url + "/healthz")
+        assert code == 200
+        assert json.loads(body)["saturated_tiers"] == []
+        # an open breaker ANYWHERE still answers 503 through the
+        # aggregate (the resilience snapshot is the base layer)
+        policy.breaker("unit_tier_op", threshold=1).record_failure()
+        code, body = _get(srv.url + "/healthz")
+        assert code == 503
+        assert json.loads(body)["status"] == "degraded"
+        # /debug/serve shows both tiers through the router's debug_state
+        code, body = _get(srv.url + "/debug/serve")
+        assert code == 200
+        dump = json.loads(body)
+        assert set(dump["scheduler"]["tiers"]) == {"prefill", "decode"}
+    finally:
+        obs_server.stop()
+        policy._reset_state_for_tests()
+
+
 def test_telemetry_server_env_gate_and_engine_release(monkeypatch):
     """TDT_OBS_HTTP unset -> maybe_start is a no-op (the PR-4-identical
     path); set -> the engine-registered server backs /healthz and
@@ -1137,6 +1203,54 @@ def test_history_lower_is_better_direction(tmp_path):
     trs = history.analyze(history.load_rounds(str(tmp_path)))
     assert any("monotonic decline" in w
                for w in history.all_warnings(trs))
+
+
+def test_history_handoff_metric_directions():
+    """ISSUE 12 satellite: the trend sentinel classifies the handoff
+    metrics — latency/retry growth is the regression, pages/s rides the
+    throughput default."""
+    from triton_distributed_tpu.obs import history
+
+    assert history.direction_for("handoff_ms_p99", "ms") == "lower"
+    assert history.direction_for("serve_disagg_ttft_ms_p99", "ms") \
+        == "lower"
+    assert history.direction_for("handoff_retries", "count") == "lower"
+    assert history.direction_for("handoff_pages_per_s", "pages/s") \
+        == "higher"
+    # the rule is substring-shaped on purpose: any *_failures count is
+    # failure pressure
+    assert history.direction_for("engine_failed_requests", "count") \
+        == "lower"
+
+
+def test_history_handoff_retries_growth_warns(tmp_path):
+    """Synthetic decline fixtures: retry GROWTH warns (lower-is-better
+    count), pages/s decline warns (throughput), and the same retry
+    trajectory falling never warns."""
+    from triton_distributed_tpu.obs import history
+
+    for rnd, (r, pps) in enumerate(
+            ((2.0, 100.0), (4.0, 90.0), (6.0, 80.0), (8.0, 70.0)),
+            start=1):
+        _hist_round(tmp_path, rnd, [
+            {"metric": "handoff_retries", "value": r, "unit": "count"},
+            {"metric": "handoff_pages_per_s", "value": pps,
+             "unit": "pages/s"},
+        ])
+    trs = history.analyze(history.load_rounds(str(tmp_path)))
+    warns = history.all_warnings(trs)
+    assert any("handoff_retries" in w and "decline" in w
+               for w in warns), warns
+    assert any("handoff_pages_per_s" in w and "decline" in w
+               for w in warns), warns
+    for p in tmp_path.glob("BENCH_r*.json"):
+        p.unlink()
+    for rnd, r in enumerate((8.0, 6.0, 4.0, 2.0), start=1):
+        _hist_round(tmp_path, rnd, [
+            {"metric": "handoff_retries", "value": r, "unit": "count"},
+        ])
+    trs = history.analyze(history.load_rounds(str(tmp_path)))
+    assert history.all_warnings(trs) == []
 
 
 def test_history_below_band_retry_reports_transient(tmp_path):

@@ -1,0 +1,140 @@
+"""Priority-classed DCN transfers: the shared slow wire as a scheduled
+resource.
+
+The disaggregated serving topology (``serve.router``) puts two traffic
+classes on the SAME inter-slice DCN port: latency-critical KV-handoff
+transfers (a decode slot is idle until its pages arrive) and bulk
+streams (chunked-prefill shipments, hierarchical-collective phases,
+checkpoint traffic).  FIFO sharing is exactly the failure FAST names
+(PAPERS.md, "FAST: An Efficient Scheduler for All-to-All GPU
+Communication"): a latency-critical transfer queued behind a multi-MB
+bulk stream pays the whole stream's serialization.  The discipline here
+is FAST's, applied at the port: two strict-priority classes with
+CHUNK-granular preemption — bulk streams are emitted in bounded chunks,
+and a :data:`LATENCY` transfer arriving mid-stream waits at most the
+residual of the chunk currently on the wire, never the stream.
+
+On this container the port is MODELED (:class:`PriorityDCNWire` — a
+deterministic queueing model priced from the calibrated link table,
+``tools.calibrate``), which is what the handoff plane's fault matrix
+and the ``bench.py serve_disagg`` smoke run against; the class
+constants and the ``send()`` contract are the interface a real
+multi-slice transport implements, and the slice-gated bench claims arm
+on the first real capture (the PR-10 pattern).
+"""
+
+from __future__ import annotations
+
+import threading
+
+# the two wire classes.  LATENCY preempts BULK at chunk granularity;
+# within a class the port is FIFO.
+LATENCY = 0
+BULK = 1
+
+# bulk streams are emitted in bounded chunks so a latency-class arrival
+# waits at most one chunk's serialization (the preemption grain)
+DEFAULT_CHUNK_BYTES = 1 << 20
+
+
+def dcn_wire_ms(nbytes: int, *, gbps: float | None = None,
+                hop_us: float | None = None) -> float:
+    """Serialization + hop time for one DCN transfer, from the measured
+    link calibration when one exists (``tools.calibrate``), else the
+    documented defaults — the same rate the watchdog's SOL pricing
+    reads."""
+    from ..tools import calibrate, perf_model
+
+    if gbps is None:
+        gbps = perf_model.dcn_gbps()
+    if hop_us is None:
+        cal = calibrate.load_calibration()
+        hop_us = cal.dcn_hop_us if cal is not None and cal.dcn_hop_us \
+            else 20.0
+    return nbytes / (gbps * 1e9) * 1e3 + hop_us / 1e3
+
+
+class PriorityDCNWire:
+    """Deterministic queueing model of ONE shared DCN port with two
+    strict-priority classes.
+
+    State is two per-class backlogs (milliseconds of serialization
+    already committed to the wire); ``send`` returns the modeled
+    completion latency of the new transfer — queue wait + its own
+    serialization + the hop — and adds its serialization to the class
+    backlog.  ``tick(ms)`` drains the backlogs as modeled time passes
+    (latency class first: it owns the port).  The preemption contract:
+
+    - a :data:`LATENCY` send waits for the latency backlog ahead of it
+      plus AT MOST one chunk's residual of the bulk stream (the chunk
+      currently on the wire finishes; the rest of the stream yields);
+    - a :data:`BULK` send waits for everything.
+
+    Thread-safe; deterministic (no wall clock — the router advances the
+    model with its own step cadence, so seeded replays reproduce).
+    """
+
+    def __init__(self, *, gbps: float | None = None,
+                 hop_us: float | None = None,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES):
+        from ..tools import calibrate, perf_model
+
+        self.gbps = float(gbps) if gbps else perf_model.dcn_gbps()
+        if hop_us is None:
+            cal = calibrate.load_calibration()
+            hop_us = cal.dcn_hop_us if cal is not None and cal.dcn_hop_us \
+                else 20.0
+        self.hop_us = float(hop_us)
+        self.chunk_bytes = int(chunk_bytes)
+        self._lock = threading.Lock()
+        self._backlog_ms = {LATENCY: 0.0, BULK: 0.0}
+        self.sent_bytes = {LATENCY: 0, BULK: 0}
+        self.sends = {LATENCY: 0, BULK: 0}
+
+    def _ser_ms(self, nbytes: int) -> float:
+        return nbytes / (self.gbps * 1e9) * 1e3
+
+    def send(self, nbytes: int, *, priority: int = BULK) -> float:
+        """Enqueue one transfer; returns its modeled completion latency
+        in ms (queue wait + serialization + hop)."""
+        if priority not in (LATENCY, BULK):
+            raise ValueError(f"unknown priority class {priority!r}")
+        if nbytes < 0:
+            raise ValueError(f"negative payload {nbytes}")
+        ser = self._ser_ms(nbytes)
+        hop = self.hop_us / 1e3
+        with self._lock:
+            if priority == LATENCY:
+                wait = self._backlog_ms[LATENCY] + min(
+                    self._backlog_ms[BULK], self._ser_ms(self.chunk_bytes))
+            else:
+                wait = self._backlog_ms[LATENCY] + self._backlog_ms[BULK]
+            self._backlog_ms[priority] += ser
+            self.sent_bytes[priority] += int(nbytes)
+            self.sends[priority] += 1
+        return wait + ser + hop
+
+    def tick(self, ms: float) -> None:
+        """Advance the model clock: ``ms`` of wire time drains the
+        backlogs, latency class first (strict priority)."""
+        if ms <= 0:
+            return
+        with self._lock:
+            take = min(ms, self._backlog_ms[LATENCY])
+            self._backlog_ms[LATENCY] -= take
+            self._backlog_ms[BULK] = max(
+                0.0, self._backlog_ms[BULK] - (ms - take))
+
+    def backlog_ms(self, priority: int) -> float:
+        with self._lock:
+            return self._backlog_ms[priority]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "gbps": self.gbps,
+                "chunk_bytes": self.chunk_bytes,
+                "backlog_ms": dict(self._backlog_ms),
+                "sent_bytes": dict(self.sent_bytes),
+                "sends": dict(self.sends),
+            }

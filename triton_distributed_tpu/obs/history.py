@@ -167,6 +167,13 @@ def direction_for(metric: str, unit: str) -> str:
     # growth is the regression the sentinel must warn on
     if "overhead" in metric or "over plain" in u:
         return "lower"
+    # failure-pressure counts (handoff_retries, *_failures, *_failed_*):
+    # every one is a burned retry/ladder rung or a lost request — growth
+    # is the regression even though the unit is a bare count (ISSUE 12;
+    # handoff_ms_p99 and serve_disagg_ttft_ms_p99 ride the ms rule
+    # above, handoff_pages_per_s the throughput default below)
+    if any(tok in metric for tok in ("retries", "failures", "failed")):
+        return "lower"
     return "higher"
 
 

@@ -237,6 +237,11 @@ class ServeStats:
         self.sheds = WindowedRate(window_s)
         self.preemptions = WindowedRate(window_s)
         self.evicted_pages = WindowedRate(window_s)
+        # disaggregated-handoff plane (serve.handoff): per-transfer
+        # latency sketch + pages-shipped window — the `handoff_ms_p99`
+        # / `handoff_pages_per_s` SLO surface
+        self.handoff_ms = QuantileSketch(alpha)
+        self.handoff_pages = WindowedRate(window_s)
         self._wire: dict[str, WindowedRate] = {}
         self._queue_depth = 0
         self._gauges: dict[str, float] = {}
@@ -293,6 +298,13 @@ class ServeStats:
         if pages:
             self.evicted_pages.add(float(pages))
 
+    def observe_handoff(self, ms: float, *, pages: int = 0) -> None:
+        """One completed KV-handoff transfer (serve.handoff): wire
+        latency into the sketch, shipped pages into the rate window."""
+        self.handoff_ms.observe(float(ms))
+        if pages:
+            self.handoff_pages.add(float(pages))
+
     def observe_collective(self, op: str, *, wire_bytes: float) -> None:
         r = self._wire.get(op)
         if r is None:
@@ -323,6 +335,9 @@ class ServeStats:
             "prefill_ms": self.prefill_ms.to_dict(),
             "decode_ms_per_token": self.decode_ms_per_token.to_dict(),
             "ttft_ms": self.ttft_ms.to_dict(),
+            "handoff_ms": self.handoff_ms.to_dict(),
+            "handoff_pages_per_s_window": self.handoff_pages.rate(),
+            "handoff_pages_total": self.handoff_pages.total,
             "tokens_per_s_window": self.tokens.rate(),
             "requests_per_s_window": self.requests.rate(),
             "failed_requests_per_s_window": self.failed_requests.rate(),
@@ -357,6 +372,7 @@ class ServeStats:
         sk("serve_prefill_ms", self.prefill_ms)
         sk("serve_decode_ms_per_token", self.decode_ms_per_token)
         sk("serve_ttft_ms", self.ttft_ms)
+        sk("serve_handoff_ms", self.handoff_ms)
 
         def g(name: str, v: float) -> None:
             lines.append(f"# TYPE {name} gauge")
@@ -371,6 +387,8 @@ class ServeStats:
         g("serve_sheds_total", self.sheds.total)
         g("serve_preemptions_total", self.preemptions.total)
         g("serve_evicted_pages_total", self.evicted_pages.total)
+        g("serve_handoff_pages_per_s_window", self.handoff_pages.rate())
+        g("serve_handoff_pages_total", self.handoff_pages.total)
         with self._lock:
             wire = dict(self._wire)
             gauges = dict(self._gauges)

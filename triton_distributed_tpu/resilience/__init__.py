@@ -55,11 +55,13 @@ from .faults import (
     scoped,
 )
 from .matrix import (
+    run_handoff_matrix,
     run_hier_cells,
     run_integrity_cells,
     run_matrix,
     run_quant_cells,
     run_scheduler_matrix,
+    verify_handoff_matrix,
     verify_matrix,
     verify_scheduler_matrix,
 )
@@ -88,11 +90,11 @@ __all__ = [
     "guarded", "health_snapshot", "integrity", "matrix", "policy",
     "protocol_pending",
     "record_faulty_case", "reset_breaker", "resilient_call", "run_bounded",
-    "run_hier_cells", "run_integrity_cells", "run_matrix",
-    "run_quant_cells", "run_scheduler_matrix",
+    "run_handoff_matrix", "run_hier_cells", "run_integrity_cells",
+    "run_matrix", "run_quant_cells", "run_scheduler_matrix",
     "sample_spec", "scoped",
-    "simulate", "suppress", "suppressed_thunk", "verify_matrix",
-    "verify_scheduler_matrix", "watchdog",
+    "simulate", "suppress", "suppressed_thunk", "verify_handoff_matrix",
+    "verify_matrix", "verify_scheduler_matrix", "watchdog",
 ]
 
 

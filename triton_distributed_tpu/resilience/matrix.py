@@ -397,6 +397,194 @@ def run_scheduler_matrix(seed: int = 0) -> list[dict]:
     ]
 
 
+# -- handoff cells (ISSUE 12): the disaggregated prefill/decode topology's
+# threat model (docs/robustness.md "KV handoff").  Each cell drives a REAL
+# two-tier router (serve.DisaggRouter over deterministic SimBackends and
+# the ModeledDCN transport) under a seeded multi-request load with ONE
+# fault class planned on the wire, then classifies:
+#
+#   detected  — the fault produced its NAMED artifact (a dropped
+#               transfer's watchdog timeout, a corrupt/stale page's
+#               PayloadCorruption naming the page) AND every faulted
+#               request still completed with token parity — via a clean
+#               retry or the terminal re-prefill fallback — with zero
+#               pages leaked on BOTH tiers;
+#   survived  — the condition was absorbed by a scheduling decision
+#               (decode-tier saturation -> colocated mode): everything
+#               completed, nothing leaked, no artifact required.
+#
+# Anything else is an isolation breach `verify_handoff_matrix` turns
+# into a CI problem.
+
+HANDOFF_LEGS = {
+    "transfer_drop": "reprefill",
+    "corrupt_page_in_flight": "retry",
+    "stale_stamp": "retry",
+    "prefill_rank_abort": "reprefill",
+    "decode_saturated": "colocate",
+}
+
+
+def _handoff_cell(kind, rng) -> dict:
+    from ..serve import (
+        DisaggRouter, HandoffFault, HandoffPlane, ModeledDCN, Request,
+        RequestState, Scheduler, SchedulerConfig, SimBackend, WireFault,
+    )
+    from ..serve.handoff import HANDOFF_OP
+    from . import policy
+
+    leg = HANDOFF_LEGS[kind.value]
+    at_transfer = rng.randint(0, 2)
+    faults = []
+    decode_slots, decode_pool = 3, 32
+    if kind is HandoffFault.DECODE_SATURATED:
+        # a decode tier that can adopt (almost) nothing: the router must
+        # shed back to colocated mode, not wedge parked handoffs
+        decode_slots, decode_pool = 1, 3
+    elif leg == "retry":
+        # first attempt corrupted/stale, the retry lands clean
+        faults = [WireFault(kind, at_transfer, attempts=1)]
+    else:
+        # every attempt fails: the ladder must bottom out to re-prefill
+        faults = [WireFault(kind, at_transfer)]
+    pre = Scheduler(
+        SimBackend(slots=3, page_size=4, pool_pages=24, max_length=48),
+        SchedulerConfig(max_queue_depth=32, prefill_only=True))
+    dec = Scheduler(
+        SimBackend(slots=decode_slots, page_size=4,
+                   pool_pages=decode_pool, max_length=48),
+        SchedulerConfig(max_queue_depth=32))
+    plane = HandoffPlane(dcn_channel=ModeledDCN(
+        faults=faults, seed=rng.randrange(1 << 16)))
+    router = DisaggRouter(pre, dec, plane=plane)
+    # cells must not inherit (or donate) ladder state through the
+    # process-global handoff breaker
+    policy.reset_breaker(HANDOFF_OP)
+    reqs = [
+        Request(prompt=tuple(rng.randrange(1, 90)
+                             for _ in range(rng.randint(2, 6))),
+                max_new_tokens=rng.randint(3, 8))
+        for _ in range(6)
+    ]
+    for r in reqs:
+        router.submit(r)
+    router.run_until_idle(max_steps=4000)
+    policy.reset_breaker(HANDOFF_OP)
+
+    fired = {
+        "transfer_drop": plane.dcn.drops > 0,
+        "corrupt_page_in_flight": bool(plane.corruptions),
+        "stale_stamp": bool(plane.corruptions),
+        "prefill_rank_abort": router.aborts > 0,
+        "decode_saturated": router.colocated > 0,
+    }[kind.value]
+    complete = all(r.state is RequestState.DONE for r in reqs)
+    parity = all(r.tokens == pre.backend.expected_tokens(r)
+                 for r in reqs if r.state is RequestState.DONE)
+    leaked = router.leaked_pages()
+    row = {
+        "kernel": "serve/handoff", "fault": kind.value, "leg": leg,
+        "at_transfer": at_transfer, "fired": fired,
+        "requests": len(reqs),
+        "completed": sum(r.state is RequestState.DONE for r in reqs),
+        "failed": sum(r.state is RequestState.FAILED for r in reqs),
+        "pages_leaked": leaked,
+        "handoffs": router.handoffs, "colocated": router.colocated,
+        "reprefills": router.reprefills, "retries": plane.retries,
+    }
+    named: list[str] = []
+    recovered = False
+    if leg == "retry":
+        named = [kind.value] + [c["chunk"] for c in plane.corruptions[:1]]
+        recovered = bool(plane.corruptions) and plane.retries >= 1
+    elif kind is HandoffFault.TRANSFER_DROP:
+        last = policy._LAST_ERROR.get(HANDOFF_OP, "")
+        named = [kind.value] + (["watchdog deadline"]
+                                if "deadline" in last else [])
+        recovered = plane.exhausted >= 1 and router.reprefills >= 1
+    elif kind is HandoffFault.PREFILL_ABORT:
+        named = [kind.value, "RankAborted"]
+        recovered = router.aborts >= 1 and router.reprefills >= 1
+    if kind is HandoffFault.DECODE_SATURATED:
+        if fired and complete and parity and not leaked \
+                and not row["failed"]:
+            row["outcome"] = "survived"
+            row["named"] = []
+            row["detail"] = (
+                f"decode tier refused adoption {router.colocated} "
+                f"time(s); router shed to colocated mode, all "
+                f"{row['completed']} requests completed, zero leaks")
+        else:
+            row["outcome"] = "unisolated"
+            row["named"] = []
+            row["detail"] = (f"fired={fired} complete={complete} "
+                             f"parity={parity} leaked={leaked}")
+        return row
+    if fired and recovered and complete and parity and not leaked:
+        row["outcome"] = "detected"
+        row["named"] = [n for n in named if n]
+        via = ("clean retry" if leg == "retry"
+               else f"re-prefill on the decode tier "
+                    f"({router.reprefills} re-prefill(s))")
+        row["detail"] = (f"fault named ({row['named']}); faulted "
+                         f"request(s) completed via {via} with token "
+                         f"parity; zero pages leaked on both tiers")
+    else:
+        row["outcome"] = "unisolated"
+        row["named"] = []
+        row["detail"] = (f"fired={fired} recovered={recovered} "
+                         f"complete={complete} parity={parity} "
+                         f"leaked={leaked}")
+    return row
+
+
+def run_handoff_matrix(seed: int = 0) -> list[dict]:
+    """The handoff fault cells: one per
+    :class:`~..serve.handoff.HandoffFault` class (the golden listing in
+    ``tests/test_integrity.py`` pins exactly this shape — a class added
+    without a cell fails there with the diff as the message)."""
+    from ..serve import HANDOFF_FAULT_KINDS
+
+    rng = random.Random(seed)
+    return [_handoff_cell(kind, rng) for kind in HANDOFF_FAULT_KINDS]
+
+
+def verify_handoff_matrix(rows: list[dict]) -> list[str]:
+    """CI problems in the handoff cells (empty = pass): every class
+    exercised and fired, wire faults DETECTED with a named artifact
+    (drop/corrupt/stale/abort absorbed silently would mean garbage KV
+    or a wedged request shipped), saturation SURVIVED via colocation,
+    zero leaked pages on both tiers."""
+    from ..serve import HANDOFF_FAULT_KINDS
+
+    problems = []
+    seen = {row["fault"] for row in rows}
+    missing = {k.value for k in HANDOFF_FAULT_KINDS} - seen
+    if missing:
+        problems.append(
+            f"handoff fault class(es) without a matrix cell: "
+            f"{sorted(missing)}")
+    for row in rows:
+        key = f"{row['kernel']} x {row['fault']}/{row['leg']}"
+        if not row["fired"]:
+            problems.append(f"{key}: injection never reached its "
+                            f"transfer (at_transfer="
+                            f"{row['at_transfer']})")
+            continue
+        if row["pages_leaked"]:
+            problems.append(f"{key}: {row['pages_leaked']} page(s) "
+                            f"leaked across the tiers")
+        want = "survived" if row["fault"] == "decode_saturated" \
+            else "detected"
+        if row["outcome"] != want:
+            problems.append(
+                f"{key}: expected {want}, got {row['outcome']!r} — "
+                f"{row['detail']}")
+        if row["outcome"] == "detected" and not row["named"]:
+            problems.append(f"{key}: detected but no artifact named")
+    return problems
+
+
 def run_hier_cells(seed: int = 0) -> list[dict]:
     """The ``tdt_lint --hier`` fault slice: every fault class against the
     two-level kernel cases at all three slice layouts ({2x2} at ranks=4,

@@ -97,6 +97,13 @@ def _estimate_ms(op: str, payload_bytes: int, num_ranks: int,
     if op in ("ep_dispatch", "ep_combine"):
         # worst case: the whole local payload crosses the wire once
         return perf_model.allgather_sol_ms(b, 2)
+    if op == "handoff_transfer":
+        # the disaggregated KV handoff (serve.handoff): the payload
+        # crosses the DCN exactly once, prefill slice -> decode slice —
+        # priced at the calibrated (or documented) DCN rate; pricing it
+        # at ICI speed would set a deadline the slow wire can never
+        # meet (the ISSUE-10 per-wire-class rule)
+        return b / (perf_model.dcn_gbps() * 1e9) * 1e3
     # unknown op: price it as a ring moving the payload once per rank
     return perf_model.allgather_sol_ms(b, n)
 

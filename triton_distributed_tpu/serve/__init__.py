@@ -24,15 +24,33 @@ from __future__ import annotations
 
 from ..models.kv_cache import PagePoolExhausted
 from .backends import EngineBackend, SimBackend
-from .budget import SCRAP_PAGE, PagePool, pages_needed
+from .budget import SCRAP_PAGE, PagePool, pages_needed, scrub_enabled
+from .handoff import (
+    HANDOFF_FAULT_KINDS,
+    HANDOFF_OP,
+    HandoffConfig,
+    HandoffFault,
+    HandoffPlane,
+    ModeledDCN,
+    PagePayload,
+    WireFault,
+    extract_payload,
+    implant_payload,
+    verify_payload,
+)
 from .queue import Request, RequestQueue, RequestState, TERMINAL_STATES
+from .router import DisaggRouter, RouterConfig, RouterStepResult
 from .scheduler import Scheduler, SchedulerConfig, SlotState, StepResult
 from .trace import Arrival, TraceReport, replay, synthetic_trace
 
 __all__ = [
-    "Arrival", "EngineBackend", "PagePool", "PagePoolExhausted",
-    "Request", "RequestQueue", "RequestState", "SCRAP_PAGE", "Scheduler",
-    "SchedulerConfig", "SimBackend", "SlotState", "StepResult",
-    "TERMINAL_STATES", "TraceReport", "pages_needed", "replay",
-    "synthetic_trace",
+    "Arrival", "DisaggRouter", "EngineBackend", "HANDOFF_FAULT_KINDS",
+    "HANDOFF_OP", "HandoffConfig", "HandoffFault", "HandoffPlane",
+    "ModeledDCN", "PagePayload", "PagePool", "PagePoolExhausted",
+    "Request", "RequestQueue", "RequestState", "RouterConfig",
+    "RouterStepResult", "SCRAP_PAGE", "Scheduler", "SchedulerConfig",
+    "SimBackend", "SlotState", "StepResult", "TERMINAL_STATES",
+    "TraceReport", "WireFault", "extract_payload", "implant_payload",
+    "pages_needed", "replay", "scrub_enabled", "synthetic_trace",
+    "verify_payload",
 ]

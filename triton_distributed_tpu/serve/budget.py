@@ -25,6 +25,29 @@ from ..models.kv_cache import PagePoolExhausted
 
 SCRAP_PAGE = 0
 
+# the TDT_SCRUB_PAGES poison values: distinctive constants (exact in
+# every float dtype we pool) a stale read trips on DETERMINISTICALLY —
+# a recycled page's previous-tenant bytes read plausibly (the PR-9
+# stale-bytes hazard was patched only in the quantized write paths;
+# this surfaces the whole class, handoff implants included)
+POISON_FLOAT = -1024.0
+POISON_INT8 = -109
+
+
+def scrub_enabled() -> bool:
+    """``TDT_SCRUB_PAGES=1``: poison-fill pages as they return to the
+    free list (opt-in debugging aid; docs/robustness.md flag matrix)."""
+    from ..core.utils import env_flag
+
+    return env_flag("TDT_SCRUB_PAGES")
+
+
+def poison_value(dtype) -> float:
+    """The per-dtype poison pattern a recycled page is filled with."""
+    import numpy as np
+
+    return POISON_INT8 if np.dtype(dtype) == np.int8 else POISON_FLOAT
+
 
 def pages_needed(num_tokens: int, page_size: int) -> int:
     """Pages to hold ``num_tokens`` KV positions."""
@@ -44,7 +67,8 @@ class PagePool:
     failure mode a robustness PR must never paper over.
     """
 
-    def __init__(self, total_pages: int, page_size: int):
+    def __init__(self, total_pages: int, page_size: int, *,
+                 scrubber=None):
         if total_pages < 2:
             raise ValueError(
                 f"total_pages {total_pages} < 2 (page {SCRAP_PAGE} is "
@@ -53,6 +77,11 @@ class PagePool:
             raise ValueError(f"page_size {page_size} < 1")
         self.total_pages = int(total_pages)
         self.page_size = int(page_size)
+        # TDT_SCRUB_PAGES hook: called with the freed page ids AFTER the
+        # free-list bookkeeping commits, from the owner's (single)
+        # scheduling thread — the owner poison-fills the physical pages
+        # so any stale read before rewrite trips deterministically
+        self.scrubber = scrubber
         self._lock = threading.Lock()
         # lowest-id-first for deterministic replay
         self._free = list(range(1, total_pages))
@@ -112,6 +141,10 @@ class PagePool:
                 self._free_set.add(p)
                 self._free.append(p)
             self._free.sort()
+        # outside the lock: the scrubber touches device pools, and the
+        # validation above has already committed the free
+        if self.scrubber is not None:
+            self.scrubber([int(p) for p in pages])
 
     def snapshot(self) -> dict:
         with self._lock:

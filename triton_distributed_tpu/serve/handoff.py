@@ -1,0 +1,499 @@
+"""The fault-tolerant KV-handoff plane of disaggregated serving.
+
+Disaggregation (``serve.router``) splits the serving topology into a
+prefill-specialized tier and a decode-specialized tier; what crosses
+the DCN between them is a finished prompt's KV pages.  The wire part is
+cheap — PR 10 built a calibrated, quantized, integrity-checked DCN
+layer — the hard part is SURVIVING it, and that is this module:
+
+- **Payload** (:class:`PagePayload` / :func:`extract_payload` /
+  :func:`implant_payload`): the prompt's physical pages pulled from the
+  producer pool, shipped int8 + f32 scale sidecars when
+  ``tools.calibrate.codec_pays("dcn")`` says the codec wins net wire
+  time (an int8 KV pool ships its pages + sidecars verbatim), and
+  implanted into the consumer pool whatever ITS layout is (float pools
+  dequantize on arrival, int8 pools requantize at (page, head)
+  granularity).
+- **Stamps**: every page is ``fold32``-stamped over its WIRE bytes at
+  the producer (PR-7 integrity plane — scale sidecars fold in, a
+  flipped sidecar byte corrupts the whole (page, head) block on
+  dequant) and re-folded at the consumer before implant; a mismatch is
+  a named :class:`~..resilience.errors.PayloadCorruption` carrying the
+  page.  Separately, ``cache_stamps`` fold the producer's POOL bytes of
+  every full prompt page: they ride ``Request.kv_stamps`` into the
+  re-prefill fallback so a recomputed cache is verified exactly like a
+  preemption restore (``Scheduler._verify_restore``).
+- **The ladder** (:meth:`HandoffPlane.transfer`): each transfer runs
+  under a SOL-priced watchdog deadline (``resilience.deadline_ms``
+  prices the payload over the calibrated DCN rate) down the standard
+  failure ladder — bounded retry with backoff, then ``None`` as the
+  ladder bottom, which the router converts into the terminal fallback:
+  RE-PREFILL on the decode tier.  Repeated ladder-bottom failures walk
+  the sticky ``handoff_transfer`` circuit breaker open, after which
+  transfers skip the sick wire entirely (every request re-prefills)
+  until an operator resets it — and ``/healthz`` reports the op
+  degraded meanwhile.
+- **Priority**: transfers ship :data:`~..comm.dcn.LATENCY` class on the
+  shared wire (``comm.dcn.PriorityDCNWire``) — a decode slot is idle
+  until its pages arrive, so handoff pages preempt bulk prefill
+  streams at chunk granularity (FAST's discipline, PAPERS.md).
+
+On this container the transport is :class:`ModeledDCN` — deterministic
+latency from the priority wire model plus a seeded fault plan
+(:class:`WireFault`): transfer drop (no arrival before the deadline —
+the modeled-clock analogue of the live watchdog, the same move
+``resilience.simulate`` makes for record-mode traces), corrupt page in
+flight, stale/mismatched stamp sidecar, and prefill-slice
+``rank_abort`` mid-handoff.  The fault matrix's handoff cells
+(``resilience.matrix.run_handoff_matrix``) and ``scripts/tdt_lint.py
+--handoff`` drive exactly these classes end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import random
+
+import numpy as np
+
+from .. import obs
+from ..comm import dcn
+from ..resilience.errors import (
+    CollectiveTimeoutError,
+    CorruptionDiagnosis,
+    PayloadCorruption,
+    TimeoutDiagnosis,
+)
+from .budget import pages_needed
+
+HANDOFF_OP = "handoff_transfer"
+
+
+class HandoffFault(enum.Enum):
+    """The handoff threat model (docs/robustness.md): every class the
+    fault matrix must show detected-or-survived."""
+
+    TRANSFER_DROP = "transfer_drop"
+    CORRUPT_PAGE = "corrupt_page_in_flight"
+    STALE_STAMP = "stale_stamp"
+    PREFILL_ABORT = "prefill_rank_abort"
+    DECODE_SATURATED = "decode_saturated"
+
+
+HANDOFF_FAULT_KINDS = tuple(HandoffFault)
+
+
+@dataclasses.dataclass(frozen=True)
+class HandoffConfig:
+    """Knobs of the transfer ladder.  ``backoff_ms`` defaults to 0: the
+    modeled wire resolves congestion in MODEL time, so a wall-clock
+    sleep only slows CI; a live deployment sets a real backoff.
+    ``wire_dtype``: "auto" consults ``tools.calibrate.codec_pays("dcn")``
+    at the page's row width; "raw" ships pool bytes; "int8" forces the
+    codec."""
+
+    max_retries: int = 2
+    backoff_ms: float = 0.0
+    wire_dtype: str = "auto"
+    breaker_threshold: int = 3
+
+
+@dataclasses.dataclass
+class PagePayload:
+    """One request's finished KV pages on the wire.
+
+    ``wire``: "raw" (pool-dtype bytes), "int8" (per-page int8 rows +
+    f32 scale sidecars, ``lang.quant``'s codec), or "pool" (an int8 KV
+    pool's pages + per-(page, head) scale sidecars verbatim).
+    ``stamps``: logical page -> fold32 over that page's WIRE bytes
+    (consumer-verified before implant).  ``cache_stamps``: logical page
+    -> fold32 over the producer's POOL bytes (full prompt pages only;
+    carried into the re-prefill fallback via ``Request.kv_stamps``).
+    """
+
+    req_id: int
+    prompt_len: int
+    first_token: int
+    n_pages: int
+    page_shape: tuple        # (L, Hkv, page_size, D) of one pool page
+    wire: str                # "raw" | "int8" | "pool"
+    k: np.ndarray
+    v: np.ndarray
+    k_scale: np.ndarray | None
+    v_scale: np.ndarray | None
+    stamps: dict
+    cache_stamps: dict
+    payload_bytes: int
+
+    def copy(self) -> "PagePayload":
+        return dataclasses.replace(
+            self, k=self.k.copy(), v=self.v.copy(),
+            k_scale=None if self.k_scale is None else self.k_scale.copy(),
+            v_scale=None if self.v_scale is None else self.v_scale.copy(),
+            stamps=dict(self.stamps), cache_stamps=dict(self.cache_stamps),
+        )
+
+
+def resolve_wire(wire_dtype: str, cache, row_width: int) -> str:
+    """The wire layout for one transfer: an int8 pool ships verbatim
+    ("pool"); otherwise "auto" asks the measured DCN codec economics
+    (``codec_pays``) whether packing pays at this row width."""
+    if cache.quantized:
+        return "pool"
+    if wire_dtype in ("raw", "bf16"):
+        return "raw"
+    if wire_dtype == "int8":
+        return "int8"
+    if wire_dtype != "auto":
+        raise ValueError(f"unknown handoff wire_dtype {wire_dtype!r}")
+    from ..tools import calibrate
+
+    return "int8" if calibrate.codec_pays("dcn", int(row_width)) else "raw"
+
+
+def _page_stamps(payload: PagePayload) -> dict:
+    """fold32 per logical page over the wire arrays — the producer
+    stamp the consumer re-folds on arrival."""
+    from ..resilience import integrity
+
+    out = {}
+    for j in range(payload.n_pages):
+        if payload.wire == "int8":
+            parts = [payload.k[j], payload.v[j],
+                     payload.k_scale[j], payload.v_scale[j]]
+        elif payload.wire == "pool":
+            parts = [payload.k[:, j], payload.v[:, j],
+                     payload.k_scale[:, j], payload.v_scale[:, j]]
+        else:
+            parts = [payload.k[:, j], payload.v[:, j]]
+        out[j] = integrity.fold32(*parts)
+    return out
+
+
+def extract_payload(cache, pages, req, first_token: int, *,
+                    wire_dtype: str = "auto") -> PagePayload:
+    """Pull a finished prompt's pages out of the producer pool and
+    build the wire message (see module docstring).  ``pages`` is the
+    slot's physical page list; only the ``pages_needed(prompt_len)``
+    prefix carries prompt KV (the +1 decode-growth reservation page is
+    not shipped)."""
+    from ..resilience import integrity
+
+    ps = cache.page_size
+    plen = int(req.prompt_len)
+    n = pages_needed(plen, ps)
+    pids = [int(p) for p in pages[:n]]
+    k = np.asarray(cache.k[:, pids])          # (L, n, Hkv, ps, D)
+    v = np.asarray(cache.v[:, pids])
+    page_shape = (k.shape[0],) + k.shape[2:]
+    row_width = int(np.prod(page_shape))
+    wire = resolve_wire(wire_dtype, cache, row_width)
+    ksc = vsc = None
+    if wire == "pool":
+        ksc = np.asarray(cache.k_scale[:, pids])      # (L, n, Hkv)
+        vsc = np.asarray(cache.v_scale[:, pids])
+    elif wire == "int8":
+        from ..lang import quant
+        import jax.numpy as jnp
+
+        def pack(x):
+            rows = jnp.asarray(
+                x.transpose(1, 0, 2, 3, 4).reshape(n, row_width))
+            q, scale = quant.quantize_rows(rows, "int8")
+            return np.asarray(q), np.asarray(scale)
+
+        k, ksc = pack(k)
+        v, vsc = pack(v)
+    payload_bytes = sum(a.nbytes for a in (k, v, ksc, vsc)
+                        if a is not None)
+    # cache stamps: POOL bytes of every FULL prompt page, the carry the
+    # re-prefill fallback verifies a decode-tier recompute against
+    # (partial tail pages keep growing, so only full pages pin)
+    cache_stamps = {}
+    if integrity.enabled():
+        folds = integrity.fold_pages(cache, pids[:plen // ps])
+        cache_stamps = {j: folds[pids[j]] for j in range(plen // ps)}
+    payload = PagePayload(
+        req_id=int(req.req_id), prompt_len=plen,
+        first_token=int(first_token), n_pages=n, page_shape=page_shape,
+        wire=wire, k=k, v=v, k_scale=ksc, v_scale=vsc, stamps={},
+        cache_stamps=cache_stamps, payload_bytes=int(payload_bytes),
+    )
+    payload.stamps = _page_stamps(payload)
+    return payload
+
+
+def verify_payload(payload: PagePayload) -> CorruptionDiagnosis | None:
+    """The consumer-side check: re-fold every page's wire bytes and
+    compare with the producer stamps.  Returns a diagnosis NAMING the
+    first bad page (or a stamp-count mismatch), None when clean."""
+    got = _page_stamps(payload)
+    if set(got) != set(payload.stamps):
+        return CorruptionDiagnosis(
+            op=HANDOFF_OP, kind="payload", sem="dcn_handoff",
+            chunk=f"stamps[{sorted(set(payload.stamps) ^ set(got))}]",
+            note=f"stamp sidecar lists {sorted(payload.stamps)} but the "
+                 f"payload carries pages {sorted(got)} — stale or "
+                 f"mismatched sidecar")
+    for j in sorted(got):
+        if got[j] != payload.stamps[j]:
+            return CorruptionDiagnosis(
+                op=HANDOFF_OP, kind="payload", sem="dcn_handoff",
+                chunk=f"page[{j}]",
+                note=f"request {payload.req_id} logical page {j}: wire "
+                     f"fold {got[j]:#010x} != producer stamp "
+                     f"{payload.stamps[j]:#010x}")
+    return None
+
+
+def implant_payload(cache, pages, payload: PagePayload):
+    """Write an arrived (verified) payload into the consumer pool's
+    ``pages`` and return the updated cache — dequantizing or
+    requantizing as the TARGET layout demands, so either tier may run
+    either KV dtype."""
+    import jax.numpy as jnp
+
+    from ..models import kv_cache as kvc
+
+    n = payload.n_pages
+    pids = [int(p) for p in pages[:n]]
+    L, hkv, ps, d = payload.page_shape
+    if payload.wire == "pool" and cache.quantized:
+        # int8 pool -> int8 pool: pages + sidecars land verbatim
+        return dataclasses.replace(
+            cache,
+            k=cache.k.at[:, pids].set(jnp.asarray(payload.k)),
+            v=cache.v.at[:, pids].set(jnp.asarray(payload.v)),
+            k_scale=cache.k_scale.at[:, pids].set(
+                jnp.asarray(payload.k_scale)),
+            v_scale=cache.v_scale.at[:, pids].set(
+                jnp.asarray(payload.v_scale)),
+        )
+    if payload.wire == "pool":
+        vals_k = payload.k.astype(np.float32) \
+            * payload.k_scale[..., None, None]
+        vals_v = payload.v.astype(np.float32) \
+            * payload.v_scale[..., None, None]
+    elif payload.wire == "int8":
+        from ..lang import quant
+
+        def unpack(q, scale):
+            rows = quant.dequantize_rows(
+                jnp.asarray(q), jnp.asarray(scale), jnp.float32)
+            return np.asarray(rows).reshape(n, L, hkv, ps, d) \
+                .transpose(1, 0, 2, 3, 4)
+
+        vals_k = unpack(payload.k, payload.k_scale)
+        vals_v = unpack(payload.v, payload.v_scale)
+    else:
+        vals_k, vals_v = payload.k, payload.v
+    if cache.quantized:
+        qk, sk = kvc._quantize_pages(jnp.asarray(vals_k))
+        qv, sv = kvc._quantize_pages(jnp.asarray(vals_v))
+        return dataclasses.replace(
+            cache,
+            k=cache.k.at[:, pids].set(qk),
+            v=cache.v.at[:, pids].set(qv),
+            k_scale=cache.k_scale.at[:, pids].set(sk),
+            v_scale=cache.v_scale.at[:, pids].set(sv),
+        )
+    return dataclasses.replace(
+        cache,
+        k=cache.k.at[:, pids].set(
+            jnp.asarray(vals_k).astype(cache.k.dtype)),
+        v=cache.v.at[:, pids].set(
+            jnp.asarray(vals_v).astype(cache.v.dtype)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the modeled transport
+
+
+@dataclasses.dataclass(frozen=True)
+class WireFault:
+    """One planned fault on the modeled DCN: ``kind`` hits transfer
+    number ``transfer`` (0-based, in plane order) on its first
+    ``attempts`` attempts (None = every attempt, which forces the
+    transfer all the way down the ladder to re-prefill)."""
+
+    kind: HandoffFault
+    transfer: int
+    attempts: int | None = None
+
+
+class ModeledDCN:
+    """The SimBackend-tier transport: deterministic latency from the
+    priority wire model plus the seeded fault plan.  A dropped (or
+    congestion-delayed-past-deadline) transfer raises
+    :class:`CollectiveTimeoutError` against the caller's SOL deadline
+    on the MODEL clock — the same simulator-world deadline move
+    ``resilience.simulate`` makes for recorded traces, because a wall
+    sleep past the CPU watchdog floor would take a minute per cell."""
+
+    def __init__(self, *, wire: dcn.PriorityDCNWire | None = None,
+                 faults=(), seed: int = 0):
+        self.wire = wire if wire is not None else dcn.PriorityDCNWire()
+        self.faults = list(faults)
+        self.transfers = 0
+        self.drops = 0
+        self._rng = random.Random(seed)
+
+    def _fault_for(self, idx: int, attempt: int) -> WireFault | None:
+        for f in self.faults:
+            if f.transfer == idx and (f.attempts is None
+                                      or attempt < f.attempts):
+                return f
+        return None
+
+    def transmit(self, payload: PagePayload, *, deadline_ms: float,
+                 priority: int = dcn.LATENCY, attempt: int = 0):
+        """One attempt: returns ``(arrived_payload, modeled_ms)`` or
+        raises the fault class the plan scheduled."""
+        if attempt == 0:
+            self.transfers += 1
+        idx = self.transfers - 1
+        fault = self._fault_for(idx, attempt)
+        if fault is not None and fault.kind is HandoffFault.PREFILL_ABORT:
+            from ..resilience.faults import RankAborted
+
+            raise RankAborted(0, idx)
+        if fault is not None and fault.kind is HandoffFault.TRANSFER_DROP:
+            self.drops += 1
+            raise CollectiveTimeoutError(
+                HANDOFF_OP, deadline_ms, TimeoutDiagnosis(
+                    kernel=HANDOFF_OP, ranks=2, static=True,
+                    note=f"transfer #{idx} (request {payload.req_id}, "
+                         f"{payload.n_pages} page(s), "
+                         f"{payload.payload_bytes} B) dropped on the DCN "
+                         f"wire: no arrival before the SOL deadline"))
+        arrived = payload
+        if fault is not None and fault.kind is HandoffFault.CORRUPT_PAGE:
+            arrived = payload.copy()
+            j = self._rng.randrange(payload.n_pages)
+            # flip one byte inside page j's wire region (page-major rows
+            # for the int8 codec, pool-page slices otherwise)
+            if arrived.wire == "int8":
+                row = np.ascontiguousarray(arrived.k[j])
+                row.view(np.uint8).reshape(-1)[
+                    self._rng.randrange(row.nbytes)] ^= 0xFF
+                arrived.k[j] = row
+            else:
+                pg = np.ascontiguousarray(arrived.k[:, j])
+                pg.view(np.uint8).reshape(-1)[
+                    self._rng.randrange(pg.nbytes)] ^= 0xFF
+                arrived.k[:, j] = pg
+        elif fault is not None and fault.kind is HandoffFault.STALE_STAMP:
+            arrived = payload.copy()
+            arrived.stamps = {j: (s ^ 0x5A17A317) & 0xFFFFFFFF
+                              for j, s in arrived.stamps.items()}
+        ms = self.wire.send(payload.payload_bytes, priority=priority)
+        if deadline_ms is not None and ms > deadline_ms:
+            self.drops += 1
+            raise CollectiveTimeoutError(
+                HANDOFF_OP, deadline_ms, TimeoutDiagnosis(
+                    kernel=HANDOFF_OP, ranks=2, static=True,
+                    note=f"transfer #{idx}: modeled DCN completion "
+                         f"{ms:.1f} ms exceeds the SOL deadline (shared-"
+                         f"wire congestion)"))
+        return arrived, ms
+
+    def snapshot(self) -> dict:
+        return {"transfers": self.transfers, "drops": self.drops,
+                "faults_planned": len(self.faults),
+                "wire": self.wire.snapshot()}
+
+
+# ---------------------------------------------------------------------------
+# the plane
+
+
+class HandoffPlane:
+    """One handoff channel prefill tier -> decode tier: the transfer
+    ladder plus its accounting (the ``serve_handoff_*`` telemetry and
+    the fault-matrix evidence)."""
+
+    def __init__(self, *, dcn_channel: ModeledDCN | None = None,
+                 config: HandoffConfig | None = None):
+        from ..resilience import RetryPolicy
+
+        self.dcn = dcn_channel if dcn_channel is not None else ModeledDCN()
+        self.cfg = config or HandoffConfig()
+        self._policy = RetryPolicy(
+            max_retries=self.cfg.max_retries,
+            backoff_ms=self.cfg.backoff_ms,
+            breaker_threshold=self.cfg.breaker_threshold,
+            retry_on=(CollectiveTimeoutError, PayloadCorruption),
+        )
+        self.transfers = 0
+        self.delivered = 0
+        self.retries = 0
+        self.exhausted = 0
+        self.pages_moved = 0
+        self.corruptions: list[dict] = []
+        self.handoff_ms: list[float] = []
+
+    def transfer(self, payload: PagePayload) -> PagePayload | None:
+        """Run one transfer down the ladder.  Returns the VERIFIED
+        arrived payload, or None when the ladder bottomed out (retries
+        exhausted, or the sticky ``handoff_transfer`` breaker is open)
+        — the caller's cue for the terminal fallback, re-prefill on the
+        decode tier.  A prefill-slice ``RankAborted`` propagates: there
+        is nothing left to retry against."""
+        from .. import resilience
+
+        deadline = resilience.deadline_ms(
+            HANDOFF_OP, payload_bytes=payload.payload_bytes, num_ranks=2)
+        self.transfers += 1
+        attempt = {"n": 0}
+
+        def thunk():
+            a = attempt["n"]
+            attempt["n"] += 1
+            if a:
+                self.retries += 1
+                if obs.enabled():
+                    obs.counter("handoff_retries").inc()
+            arrived, ms = self.dcn.transmit(
+                payload, deadline_ms=deadline, priority=dcn.LATENCY,
+                attempt=a)
+            diag = verify_payload(arrived)
+            if diag is not None:
+                self.corruptions.append({
+                    "req_id": payload.req_id, "chunk": diag.chunk,
+                    "note": diag.note, "attempt": a,
+                })
+                if obs.enabled():
+                    obs.counter("handoff_corruptions").inc()
+                raise PayloadCorruption(HANDOFF_OP, diag)
+            return arrived, ms
+
+        result = resilience.resilient_call(
+            HANDOFF_OP, thunk, fallback=lambda: None,
+            deadline_ms=deadline, policy=self._policy)
+        if result is None:
+            self.exhausted += 1
+            if obs.enabled():
+                obs.counter("handoff_exhausted").inc()
+            return None
+        arrived, ms = result
+        self.delivered += 1
+        self.pages_moved += arrived.n_pages
+        self.handoff_ms.append(float(ms))
+        if obs.enabled():
+            obs.counter("handoff_transfers").inc()
+            obs.serve_stats.STATS.observe_handoff(
+                float(ms), pages=arrived.n_pages)
+        return arrived
+
+    def snapshot(self) -> dict:
+        return {
+            "transfers": self.transfers,
+            "delivered": self.delivered,
+            "retries": self.retries,
+            "exhausted": self.exhausted,
+            "pages_moved": self.pages_moved,
+            "corruptions": len(self.corruptions),
+            "dcn": self.dcn.snapshot(),
+        }

@@ -35,6 +35,9 @@ class RequestState(enum.Enum):
     QUEUED = "queued"
     PREFILL = "prefill"
     DECODE = "decode"
+    # prefill finished on a prefill-only tier; the slot holds the pages
+    # while the router ships them to the decode tier (serve.router)
+    HANDOFF = "handoff"
     PREEMPTED = "preempted"
     DONE = "done"
     FAILED = "failed"

@@ -1,0 +1,333 @@
+"""The two-tier disaggregated serving router (ROADMAP item 5).
+
+Production engines split serving across slices by PHASE: a
+prefill-specialized tier runs chunked prefill (compute-bound, long
+kernels) and ships finished KV pages to a decode-specialized tier
+(memory-bound, latency-critical steps), so a long prompt never steals
+decode step time and each pool is sized for its phase.
+:class:`DisaggRouter` owns one :class:`~.scheduler.Scheduler` per tier
+— the prefill tier runs with ``SchedulerConfig.prefill_only=True`` and
+parks finished prompts in HANDOFF state — plus the fault-tolerant
+transfer plane (``serve.handoff``).  Per router ``step()``:
+
+1. the prefill tier steps (admission, chunked prefill);
+2. parked handoffs pump through the plane's ladder:
+   - decode tier saturated (``adopt_prefilled`` refuses under its OWN
+     admission policy) -> **colocate**: the request finishes decode on
+     the prefill tier, where its pages already live;
+   - transfer verified and adopted -> prefill pages released;
+   - ladder bottom (drop/corruption retries exhausted, open breaker)
+     or a prefill-slice ``RankAborted`` mid-handoff -> **re-prefill**:
+     the request re-queues on the decode tier and recomputes from its
+     prompt, with the producer's page stamps carried on
+     ``Request.kv_stamps`` so the recompute is verified like a
+     preemption restore;
+3. the decode tier steps (adopted membership decodes, re-prefills run
+   through its normal prefill path).
+
+Routing is TELEMETRY-DRIVEN, the PR-5 plane as the load-balancing
+signal: ``submit`` reads each tier's queue-depth and pool-occupancy
+gauges (the exact values ``/metrics`` publishes) and a pressured
+prefill tier with a healthy decode tier routes the request COLOCATED to
+the decode tier; ``health()`` aggregates both tiers — ``/healthz``
+answers 503 while EITHER tier is saturated or any breaker is open, and
+flips back to 200 as each drains independently (pinned by
+``tests/test_obs.py``'s two-tier endpoint battery).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .. import obs
+from . import handoff as handoff_mod
+from .queue import Request, RequestState
+from .scheduler import Scheduler, StepResult
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Router knobs.  ``queue_pressure``: the queue-depth fraction at
+    which a tier counts as pressured for submit routing;
+    ``pool_pressure``: same for pool occupancy.  ``bulk_bytes_per_step``
+    models bulk prefill/collective streams sharing the DCN wire (the
+    traffic handoff transfers must preempt — ``bench.py serve_disagg``
+    exercises it); ``step_wall_ms`` advances the modeled wire clock per
+    router step."""
+
+    max_transfers_per_step: int = 4
+    queue_pressure: float = 0.75
+    pool_pressure: float = 0.95
+    colocate_on_saturation: bool = True
+    # router steps a parked handoff waits for the decode tier before
+    # the saturation shed: a decode tier that is merely BUSY (slots
+    # cycling) clears within a step or two, while genuine saturation
+    # persists — colocating on the first refusal would convert every
+    # transient busy moment into a colocated request
+    adopt_patience_steps: int = 2
+    bulk_bytes_per_step: int = 0
+    step_wall_ms: float = 1.0
+
+
+@dataclasses.dataclass
+class RouterStepResult:
+    prefill: StepResult
+    decode: StepResult
+    handoffs: int = 0
+    colocated: int = 0
+    reprefills: int = 0
+
+    @property
+    def idle(self) -> bool:
+        return self.prefill.idle and self.decode.idle
+
+
+class DisaggRouter:
+    """Two schedulers + one handoff plane (see module docstring).
+    Single-threaded like the schedulers it drives; ``submit`` is as
+    thread-safe as theirs."""
+
+    def __init__(self, prefill: Scheduler, decode: Scheduler, *,
+                 plane: handoff_mod.HandoffPlane | None = None,
+                 config: RouterConfig | None = None):
+        if not prefill.cfg.prefill_only:
+            raise ValueError(
+                "the prefill tier's SchedulerConfig must set "
+                "prefill_only=True — without it finished prompts enter "
+                "decode locally and nothing ever hands off")
+        # page GEOMETRY must match for an implant to land (pool dtypes
+        # MAY differ — implant_payload dequantizes/requantizes per the
+        # target layout); fail fast here instead of crashing the first
+        # _pump_handoffs with a raw shape error
+        pk, dk = prefill.cache.k, decode.cache.k
+        if (pk.shape[0], pk.shape[2:]) != (dk.shape[0], dk.shape[2:]):
+            raise ValueError(
+                f"tier page geometries differ — prefill pages are "
+                f"(layers={pk.shape[0]}, kv_heads={pk.shape[2]}, "
+                f"page_size={pk.shape[3]}, head_dim={pk.shape[4]}) but "
+                f"decode pages are (layers={dk.shape[0]}, "
+                f"kv_heads={dk.shape[2]}, page_size={dk.shape[3]}, "
+                f"head_dim={dk.shape[4]}); a handoff payload cannot be "
+                f"implanted across different page shapes (pool SIZES "
+                f"and kv dtypes may differ freely)")
+        self.prefill = prefill
+        self.decode = decode
+        # the re-prefill stamp carry (fold32 over the producer's POOL
+        # bytes) only pins a recompute on a tier with the SAME pool
+        # layout: a decode tier storing int8 where the prefill tier
+        # stored f32 recomputes byte-DIFFERENT (correct) pages, and
+        # carrying the stamps would fail every re-prefill with a
+        # spurious PayloadCorruption
+        self._stamp_carry_ok = (
+            pk.dtype == dk.dtype
+            and prefill.cache.quantized == decode.cache.quantized)
+        self.plane = plane if plane is not None else handoff_mod.HandoffPlane()
+        self.cfg = config or RouterConfig()
+        self.handoffs = 0
+        self.colocated = 0
+        self.reprefills = 0
+        self.aborts = 0
+        self.reprefill_ids: set[int] = set()
+        self._park_strikes: dict[int, int] = {}
+
+    # -- routing -----------------------------------------------------------
+
+    def _pressured(self, sched: Scheduler) -> bool:
+        """The load-balancing signal: the SAME queue-depth and
+        pool-occupancy values the tier's gauges publish, plus its
+        saturation latch."""
+        if sched._saturated_since is not None:
+            return True
+        q = sched.queue.depth / sched.queue.max_depth
+        return (q >= self.cfg.queue_pressure
+                or sched.pool.occupancy() >= self.cfg.pool_pressure)
+
+    def submit(self, req: Request, *, now: float | None = None) -> bool:
+        """Admission: the prefill tier is the default entry; a
+        pressured prefill tier with a healthy decode tier routes the
+        request COLOCATED to the decode tier (it prefills and decodes
+        there).  Both pressured -> normal shed semantics on the prefill
+        tier."""
+        if self._pressured(self.prefill) and not self._pressured(self.decode):
+            if obs.enabled():
+                obs.counter("router_colocated_submits").inc()
+            return self.decode.submit(req, now=now)
+        return self.prefill.submit(req, now=now)
+
+    # -- the step ----------------------------------------------------------
+
+    def step(self) -> RouterStepResult:
+        h0, c0, r0 = self.handoffs, self.colocated, self.reprefills
+        rp = self.prefill.step()
+        self._pump_handoffs()
+        rd = self.decode.step()
+        # advance the modeled wire clock (bulk backlogs drain; a real
+        # transport ignores this)
+        wire = getattr(self.plane.dcn, "wire", None)
+        if wire is not None:
+            wire.tick(self.cfg.step_wall_ms)
+        return RouterStepResult(
+            prefill=rp, decode=rd,
+            handoffs=self.handoffs - h0,
+            colocated=self.colocated - c0,
+            reprefills=self.reprefills - r0,
+        )
+
+    def run_until_idle(self, *, max_steps: int = 100_000) -> int:
+        for _ in range(max_steps):
+            if self.step().idle:
+                return self.prefill.steps
+        raise RuntimeError(
+            f"router not idle after {max_steps} steps: "
+            f"{self.debug_state()}")
+
+    def _pump_handoffs(self) -> None:
+        from ..comm import dcn
+        from ..resilience.faults import RankAborted
+
+        if self.cfg.bulk_bytes_per_step:
+            # the bulk prefill/collective streams sharing the wire —
+            # the traffic the LATENCY-class handoff sends preempt
+            wire = getattr(self.plane.dcn, "wire", None)
+            if wire is not None:
+                wire.send(self.cfg.bulk_bytes_per_step,
+                          priority=dcn.BULK)
+        for i in self.prefill.handoff_ready()[
+                :self.cfg.max_transfers_per_step]:
+            slot = self.prefill.slots[i]
+            req = slot.request
+            if not self.decode.can_adopt(req):
+                # decode tier cannot take it: wait out a transient busy
+                # spell, then shed back to colocated mode BEFORE paying
+                # the wire (the pages never left this tier's pool)
+                strikes = self._park_strikes.get(req.req_id, 0) + 1
+                self._park_strikes[req.req_id] = strikes
+                if self.cfg.colocate_on_saturation and \
+                        strikes > self.cfg.adopt_patience_steps:
+                    self._park_strikes.pop(req.req_id, None)
+                    self.prefill.colocate(i)
+                    self.colocated += 1
+                continue
+            self._park_strikes.pop(req.req_id, None)
+            payload = handoff_mod.extract_payload(
+                self.prefill.cache, slot.pages, req, slot.next_token,
+                wire_dtype=self.plane.cfg.wire_dtype)
+            try:
+                arrived = self.plane.transfer(payload)
+            except RankAborted as e:
+                # the prefill slice died mid-handoff: nothing to retry
+                # against — the decode tier recomputes from the prompt
+                self.aborts += 1
+                if obs.enabled():
+                    obs.counter("handoff_aborts").inc()
+                self._reprefill(i, req, payload,
+                                reason=f"prefill slice aborted "
+                                       f"mid-handoff ({e})")
+                continue
+            if arrived is None:
+                self._reprefill(i, req, payload,
+                                reason="transfer ladder exhausted")
+                continue
+            adopted = self.decode.adopt_prefilled(
+                req,
+                lambda cache, pages: handoff_mod.implant_payload(
+                    cache, pages, arrived),
+                length=arrived.prompt_len,
+                next_token=arrived.first_token)
+            if adopted:
+                self.prefill.release_handoff(i)
+                self.handoffs += 1
+            elif self.cfg.colocate_on_saturation:
+                # decode tier saturated: shed back to colocated mode —
+                # the pages never left this tier's pool
+                self.prefill.colocate(i)
+                self.colocated += 1
+            # else: stay parked; retried next step
+
+    def _reprefill(self, i: int, req: Request,
+                   payload: handoff_mod.PagePayload, *,
+                   reason: str) -> None:
+        """The terminal fallback: recompute the prompt on the decode
+        tier, verified against the producer's page stamps exactly like
+        a preemption restore (``Scheduler._verify_restore``)."""
+        from ..resilience import integrity
+        from .budget import pages_needed
+
+        total = req.prompt_len + req.max_new_tokens
+        if (self.decode.queue.depth >= self.decode.queue.max_depth
+                or pages_needed(total, self.decode.pool.page_size)
+                > self.decode.pool.capacity
+                or total > self.decode.backend.max_length):
+            # no queue room (or a demand that tier can never hold) for
+            # the recompute: colocating loses nothing — the pages are
+            # still here — and sheds no work
+            self.prefill.colocate(i)
+            self.colocated += 1
+            return
+        req.tokens = []
+        if integrity.enabled() and payload.cache_stamps \
+                and self._stamp_carry_ok and req.kv_stamps is None:
+            req.kv_stamps = dict(payload.cache_stamps)
+        self.prefill.release_handoff(i)
+        self.reprefills += 1
+        self.reprefill_ids.add(req.req_id)
+        if obs.enabled():
+            obs.counter("handoff_reprefills").inc()
+        if not self.decode.submit(req):
+            # the submit-time demand checks shed it (terminal state,
+            # accounted on the decode tier) — pages already released,
+            # nothing leaks
+            if obs.enabled():
+                obs.counter("handoff_reprefill_shed").inc()
+        del reason  # carried in counters; the request error stays clean
+
+    # -- health / introspection --------------------------------------------
+
+    def health(self) -> dict:
+        """The tier-aggregated ``/healthz`` payload: the process
+        resilience snapshot (breakers — an open one already flips
+        status to "degraded"), live serve stats, both tiers' state, and
+        saturation aggregation: 503 while EITHER tier is saturated,
+        back to 200 as each drains."""
+        from .. import resilience
+
+        snap = resilience.health_snapshot()
+        snap["serve_stats"] = obs.serve_stats.STATS.snapshot()
+        snap["tiers"] = {
+            "prefill": self.prefill.debug_state(),
+            "decode": self.decode.debug_state(),
+        }
+        snap["handoff"] = self.snapshot()
+        saturated = [
+            name for name, sched in (("prefill", self.prefill),
+                                     ("decode", self.decode))
+            if sched._saturated_since is not None
+            and sched.saturated_s() >= sched.cfg.saturation_sustain_s
+        ]
+        snap["saturated_tiers"] = saturated
+        if snap["status"] == "ok" and saturated:
+            snap["status"] = "saturated"
+        return snap
+
+    def snapshot(self) -> dict:
+        return {
+            "handoffs": self.handoffs,
+            "colocated": self.colocated,
+            "reprefills": self.reprefills,
+            "aborts": self.aborts,
+            "plane": self.plane.snapshot(),
+        }
+
+    def debug_state(self) -> dict:
+        return {
+            "handoff": self.snapshot(),
+            "tiers": {
+                "prefill": self.prefill.debug_state(),
+                "decode": self.decode.debug_state(),
+            },
+        }
+
+    def leaked_pages(self) -> int:
+        """Used pages across BOTH tiers once everything drained — the
+        zero-leak invariant ``tdt_lint --handoff`` gates on."""
+        return self.prefill.pool.used_pages + self.decode.pool.used_pages

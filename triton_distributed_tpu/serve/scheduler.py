@@ -86,6 +86,12 @@ class SchedulerConfig:
     # steps; a mismatch recovers the victim through the preemption-
     # recompute path.  Ignored (zero cost) with integrity off.
     kv_audit_interval_steps: int = 8
+    # prefill-specialized tier (serve.router disaggregation): a request
+    # completing prefill PARKS in HANDOFF state — pages held, first
+    # token computed — instead of entering decode membership; the
+    # router ships the pages to the decode tier (or colocates the
+    # request back here when that tier is saturated)
+    prefill_only: bool = False
 
 
 @dataclasses.dataclass
@@ -133,10 +139,14 @@ class Scheduler:
                  governor=None):
         from .. import resilience
 
+        from .budget import scrub_enabled
+
         self.backend = backend
         self.cfg = config or SchedulerConfig()
         self.queue = RequestQueue(self.cfg.max_queue_depth)
-        self.pool = PagePool(backend.pool_pages, backend.page_size)
+        self.pool = PagePool(
+            backend.pool_pages, backend.page_size,
+            scrubber=self._scrub_pages if scrub_enabled() else None)
         self.cache = backend.make_cache()
         self.slots: list[SlotState | None] = [None] * backend.slots
         self.governor = governor if governor is not None \
@@ -339,7 +349,15 @@ class Scheduler:
                 slot.length = plen
                 slot.next_token = int(first)
                 req.tokens = [int(first)]
-                req.state = RequestState.DECODE
+                # a prefill-only tier parks the finished prompt for the
+                # router's handoff instead of entering decode (a
+                # one-token request is already complete — nothing to
+                # ship); the first token exists either way, so TTFT is
+                # observed here in both modes
+                if self.cfg.prefill_only and req.max_new_tokens > 1:
+                    req.state = RequestState.HANDOFF
+                else:
+                    req.state = RequestState.DECODE
                 # TTFT is a per-REQUEST SLO, observed once on the FIRST
                 # admission; a preempted request's re-prefill must not
                 # contribute a second sample (it would inflate the p99
@@ -594,6 +612,113 @@ class Scheduler:
         req.kv_stamps = None
         return None
 
+    # -- disaggregated handoff (serve.router, docs/serving.md) -------------
+
+    def handoff_ready(self) -> list[int]:
+        """Slots parked in HANDOFF state (``prefill_only`` tiers): the
+        prompt's KV is finished, the first token computed, the pages
+        held until the router ships — or colocates — the request."""
+        return [i for i, s in enumerate(self.slots)
+                if s is not None
+                and s.request.state is RequestState.HANDOFF]
+
+    def colocate(self, i: int) -> None:
+        """Decode-tier-saturation fallback: finish the handoff-parked
+        request HERE — its pages and first token are already in this
+        tier's pool, so flipping it into decode membership costs
+        nothing (the router sheds back to colocated mode instead of
+        queueing transfers against a saturated tier)."""
+        slot = self.slots[i]
+        assert slot is not None and \
+            slot.request.state is RequestState.HANDOFF
+        slot.request.state = RequestState.DECODE
+        if obs.enabled():
+            obs.counter("handoff_colocated").inc()
+
+    def release_handoff(self, i: int) -> Request:
+        """Release a handoff-parked slot after the router took
+        ownership of the request (already adopted into the decode
+        tier's membership, or bound for its re-prefill queue): pages
+        return to this tier's pool, the slot recycles.  The request's
+        state belongs to its NEW owner by now, so only the slot is
+        asserted."""
+        slot = self.slots[i]
+        assert slot is not None
+        return self._release_slot(i).request
+
+    def can_adopt(self, req: Request) -> bool:
+        """Cheap saturation probe for :meth:`adopt_prefilled` — the
+        router consults it BEFORE paying the wire, so a transfer the
+        tier would refuse is shed to colocated mode without queueing
+        bytes against a saturated pool.  A request whose TOTAL demand
+        can never fit this tier's pool (the same never-fits check
+        ``submit`` applies) is refused outright: adopting it would
+        thrash the pool with preemption-recompute cycles forever."""
+        total = pages_needed(req.prompt_len + req.max_new_tokens,
+                             self.pool.page_size)
+        if total > self.pool.capacity or \
+                req.prompt_len + req.max_new_tokens > \
+                self.backend.max_length:
+            return False
+        cap = self.governor.slot_cap(len(self.slots))
+        if sum(s is not None for s in self.slots) >= cap:
+            return False
+        headroom = (self.cfg.admission_headroom_pages
+                    + self.governor.headroom_pages())
+        need = pages_needed(req.prompt_len + 1, self.pool.page_size)
+        return self.pool.free_pages - need >= headroom
+
+    def adopt_prefilled(self, req: Request, implant, *, length: int,
+                        next_token: int) -> bool:
+        """Enter a request whose prompt KV was produced on ANOTHER tier
+        (the verified handoff payload): allocate pages for
+        ``length + 1`` positions under the SAME admission policy
+        ``_admit`` applies (governor slot cap, pool headroom), write
+        the payload into them via ``implant(cache, pages) -> cache``,
+        and place the request directly into decode membership.
+        Returns False — with NO side effects — when this tier cannot
+        take it now (slots at the cap, pages short of headroom, or a
+        total demand that can never fit — :meth:`can_adopt`): the
+        router's cue to shed back to colocated mode."""
+        if not self.can_adopt(req):
+            return False
+        need = pages_needed(length + 1, self.pool.page_size)
+        pages = self.pool.try_alloc(need)
+        if pages is None:
+            return False
+        try:
+            self.cache = implant(self.cache, pages)
+        except Exception:
+            self.pool.free(pages)
+            raise
+        slot_idx = next(i for i, s in enumerate(self.slots) if s is None)
+        req.state = RequestState.DECODE
+        req.tokens = [int(next_token)]
+        self.slots[slot_idx] = SlotState(
+            request=req, pages=pages, length=int(length),
+            prefill_pos=req.prompt_len, next_token=int(next_token))
+        self.admitted += 1
+        if obs.enabled():
+            obs.counter("serve_adopted").inc()
+        return True
+
+    # -- TDT_SCRUB_PAGES (docs/robustness.md flag matrix) ------------------
+
+    def _scrub_pages(self, pages: list[int]) -> None:
+        """Poison-fill recycled pages so any stale read before rewrite
+        — a handoff implant mapping a freed page, a block-table row
+        pointing at a recycled id — trips on the pattern
+        deterministically instead of reading the previous tenant's
+        plausible bytes."""
+        from .budget import poison_value
+
+        val = poison_value(np.dtype(self.cache.k.dtype))
+        self.cache = dataclasses.replace(
+            self.cache,
+            k=self.cache.k.at[:, pages].set(val),
+            v=self.cache.v.at[:, pages].set(val),
+        )
+
     # -- slot lifecycle ----------------------------------------------------
 
     def _release_slot(self, i: int) -> SlotState:
@@ -717,6 +842,7 @@ class Scheduler:
             "preemptions": self.preemptions,
             "evicted_pages": self.evicted_pages,
             "kv_corruptions": len(self.kv_corruptions),
+            "handoff_parked": len(self.handoff_ready()),
             "active_slots": sum(s is not None for s in self.slots),
             "slot_cap": self.governor.slot_cap(len(self.slots)),
             "governor": self.governor.snapshot(),

@@ -711,6 +711,111 @@ def bench_decode_dispatches(batch: int = 8):
     }
 
 
+def bench_persistent_dispatches(batch: int = 8, steps: int = 4):
+    """Static dispatch count of one PERSISTENT step bundle
+    (``ops.persistent_decode.count_bundle_dispatches``): with
+    ``decode_mode="persistent"`` the bundle is ONE megakernel launch +
+    the lm_head GEMM per token window — the ISSUE-13 acceptance number
+    (<= 2 per step bundle, down from 2/layer), claims-gated on slices
+    where the collective megakernel actually builds (tp=1 runs the
+    pure-XLA reference whose dot chain is the honest count there).
+    ``dispatches_per_token_psum`` carries the per-kernel chain's count
+    for the same model as the before number."""
+    from triton_distributed_tpu.core import mesh as mesh_lib
+    from triton_distributed_tpu.models import Engine, ModelConfig, Qwen3
+    from triton_distributed_tpu.ops import count_bundle_dispatches
+
+    mesh = mesh_lib.tp_mesh()
+    ntp = mesh.shape["tp"]
+    cfg = ModelConfig(
+        num_layers=4, hidden=2048, intermediate=4096, num_heads=16,
+        num_kv_heads=8, head_dim=128, vocab=8192, max_length=256,
+        dtype=jnp.bfloat16,
+    )
+    eng = Engine.build(cfg, mesh, key=jax.random.key(0), batch=batch,
+                       cache_layout="paged")
+    tok = jnp.zeros((batch,), jnp.int32)
+    counts = {}
+    for mode in ("psum", "persistent"):
+        model = Qwen3(cfg, mesh, decode_mode=mode)
+        counts[mode] = count_bundle_dispatches(
+            model, eng.params, eng.cache, tok, steps)
+    return {
+        "metric": f"decode_dispatches_per_bundle_b{batch}"
+                  f"_L{cfg.num_layers}_s{steps}_tp{ntp}",
+        # scan bodies count once, so the traced bundle count IS the
+        # per-step-bundle dispatch number the claim binds
+        "value": counts["persistent"],
+        "unit": "dispatches/bundle (persistent)",
+        "dispatches_per_token_psum": counts["psum"],
+        "steps_per_dispatch": steps,
+        "devices": jax.device_count(),
+    }
+
+
+def bench_persistent_decode(batch: int = 128, steps: int = 8):
+    """Persistent multi-step serving decode (ISSUE 13): ONE
+    ``decode_multi`` dispatch of ``steps`` tokens through the persistent
+    megakernel vs ``steps`` per-token dispatches of the psum per-kernel
+    chain — the production before/after.  ``value`` = ms/token
+    persistent; ``vs_baseline`` = psum per-token time / persistent
+    per-token time (>1 means the device-resident loop wins).  The
+    exposed-wait story rides the flight timeline
+    (``scripts/obs_report.py --timeline persistent_decode``)."""
+    import numpy as np
+
+    from triton_distributed_tpu.core import mesh as mesh_lib
+    from triton_distributed_tpu.models import Engine, ModelConfig
+
+    mesh = mesh_lib.tp_mesh()
+    ntp = mesh.shape["tp"]
+    cfg = ModelConfig(
+        num_layers=4, hidden=2048, intermediate=4096, num_heads=16,
+        num_kv_heads=8, head_dim=128, vocab=8192, max_length=256,
+        dtype=jnp.bfloat16,
+    )
+    thunks = {}
+    for mode in ("psum", "persistent"):
+        eng = Engine.build(cfg, mesh, key=jax.random.key(0), batch=batch,
+                           decode_mode=mode, cache_layout="paged")
+        ids = jnp.asarray(
+            np.random.default_rng(1).integers(0, cfg.vocab, (batch, 64)),
+            jnp.int32,
+        )
+        eng.prefill(ids)
+        tok = jnp.zeros((batch,), jnp.int32)
+        if mode == "persistent":
+            multi = jax.jit(eng.model.decode_multi, static_argnums=3)
+
+            def run_p(eng=eng, tok=tok, multi=multi):
+                # STATEFUL like the psum loop below: both modes advance
+                # (and clamp at) the same sequence lengths, so neither
+                # is measured on less attention work than the other
+                toks, eng.cache = multi(eng.params, eng.cache, tok, steps)
+                return toks
+
+            thunks[mode] = run_p
+        else:
+            def run_s(eng=eng, tok=tok):
+                out = None
+                for _ in range(steps):
+                    out = eng.decode_step(tok)
+                return out
+
+            thunks[mode] = run_s
+    times = _bench_interleaved(thunks, iters=8, rounds=9)
+    ms = _median(times["persistent"]) * 1e3 / steps
+    return {
+        "metric": f"decode_ms_per_token_persistent_b{batch}_s{steps}"
+                  f"_tp{ntp}",
+        "value": round(ms, 3),
+        "unit": "ms/token (persistent bundle)",
+        "vs_baseline": round(_median_ratio(times, "psum", "persistent"), 4),
+        "devices": jax.device_count(),
+        "interpret": _interpret_capture(),
+    }
+
+
 def _decode_mode_wire_bytes(cfg, batch: int, ntp: int) -> dict:
     """Per-chip wire bytes one decode step moves through its row-parallel
     reductions (o-proj + MLP down-proj per layer) in each ``decode_mode``,
@@ -1676,10 +1781,14 @@ def main():
         print(json.dumps(bench_group_gemm()))
     elif mode == "decode":
         # the decode surface: split-KV attention kernel, the ISSUE-8
-        # megakernel dispatch accounting, and the fused-mode step time
+        # megakernel dispatch accounting, the fused-mode step time, and
+        # the ISSUE-13 persistent bundle (dispatches-per-bundle ratchets
+        # the 2/layer chain toward O(1)/step)
         print(json.dumps(bench_decode()))
         print(json.dumps(bench_decode_dispatches()))
         print(json.dumps(bench_fused_decode()))
+        print(json.dumps(bench_persistent_dispatches()))
+        print(json.dumps(bench_persistent_decode()))
     elif mode == "decode_modes":
         print(json.dumps(bench_decode_modes()))
     elif mode == "moe_ep":
@@ -1732,6 +1841,8 @@ def main():
         _emit(bench_decode_modes)
         _emit(bench_decode_dispatches)
         _emit(bench_fused_decode)
+        _emit(bench_persistent_dispatches)
+        _emit(bench_persistent_decode)
         _emit(bench_moe_ep_wire)
         _emit(bench_latency)
         _emit(bench_overlap)

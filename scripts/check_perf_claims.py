@@ -213,6 +213,27 @@ CLAIMS = {
         "value_max": 20.0, "ratio_spread": (0.90, 3.0),
         "slice_ratio_floor": 0.95, "since": 8,
     },
+    # persistent serving megakernel (ISSUE 13; `bench.py decode` /
+    # `auto`).  The dispatch count is STATIC (traced step-bundle
+    # accounting, ops.persistent_decode.count_bundle_dispatches): the
+    # persistent bundle is ONE megakernel launch + the lm_head GEMM per
+    # token window — value_max 2.0 IS the acceptance bound, slice-gated
+    # because the collective megakernel only builds at tp >= 2 (tp=1
+    # runs the pure-XLA reference whose dot chain is the honest count,
+    # trended by obs.history; the headless structural pin rides
+    # `tdt_lint --persistent`)
+    "decode_dispatches_per_bundle": {
+        "value_max": 2.0, "min_devices": 2, "since": 13,
+    },
+    # persistent-bundle ms/token: value_max is the gross-regression
+    # tripwire (same bound the fused/step metrics use); on a real slice
+    # the device-resident loop must at least hold parity with the psum
+    # per-token chain it replaces — a persistent path SLOWER than L
+    # host dispatches per token means the chain is broken, not merely
+    # unprofitable
+    "decode_ms_per_token_persistent": {
+        "value_max": 20.0, "slice_ratio_floor": 0.95, "since": 13,
+    },
     # measured DMA/MXU overlap of the tile pipeline (tools/overlap.py
     # three-kernel decomposition): a serialized pipeline reads ~0, the
     # r05 capture read 0.76; the clamp makes 1.0 the hard maximum

@@ -83,10 +83,26 @@ handoff fault cells (``resilience.run_handoff_matrix``: the five
 threat-model classes incl. decode-tier saturation -> colocated shed)
 must each be detected-or-survived.  Headless and CPU-only.
 
+``--persistent`` is the persistent-decode gate (ISSUE 13,
+docs/perf.md "Persistent decode loop"): the chained multi-layer
+protocol (2L ring reductions on ONE re-armed semaphore set) through
+the static verifier at ranks {2,4,8}; every fault class against the
+chain with the must-detect classes naming a semaphore of the shared
+set (the inter-layer dependency edge); a HEADLESS dispatch-count
+assertion — the step-bundle harness (``lax.scan`` + lm_head) adds
+exactly ONE launch-shaped equation around the megakernel, and the
+module carries exactly ONE ``pallas_call``, so a persistent step
+bundle is <= 2 dispatches (``decode_dispatches_per_bundle``'s claim);
+and a scheduler window-parity smoke — ``steps_per_dispatch`` 4 vs 1
+over a seeded pool-pressured trace must complete the SAME requests
+with IDENTICAL tokens (membership changes between windows, preemption
+re-queued cleanly), zero leaked pages, in fewer dispatches.  Headless
+and CPU-only.
+
 ``--all`` runs every gate above — verify matrix, ``--faults``,
 ``--timeline``, ``--serve``, ``--history``, ``--integrity``,
-``--quant``, ``--hier``, ``--handoff`` — and summarizes them under a
-single exit code (the CI entry; see README).
+``--quant``, ``--hier``, ``--handoff``, ``--persistent`` — and
+summarizes them under a single exit code (the CI entry; see README).
 
 ``--history`` runs the bench-record trend sentinel
 (``scripts/bench_history.py --check``): exit 1 when a committed
@@ -151,6 +167,12 @@ def main(argv: list[str] | None = None) -> int:
                          "{2x2,2x4,4x2}, fault cells incl. the dropped "
                          "inter-slice credit, and the schedule-order "
                          "selftest on a synthetic 2x4 topology")
+    ap.add_argument("--persistent", action="store_true",
+                    help="persistent-decode gate (ISSUE 13): chained "
+                         "multi-layer protocol matrix + fault cells with "
+                         "the inter-layer semaphore named + the headless "
+                         "dispatch-count assertion + a scheduler "
+                         "window-parity smoke")
     ap.add_argument("--handoff", action="store_true",
                     help="disaggregated-serving gate (ISSUE 12): seeded "
                          "two-tier replay with a transfer drop, a corrupt "
@@ -187,6 +209,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_hier(args)
     if args.handoff:
         return _run_handoff(args)
+    if args.persistent:
+        return _run_persistent(args)
 
     from triton_distributed_tpu import analysis
 
@@ -452,6 +476,7 @@ def _run_all(args) -> int:
         ("quant", lambda: _run_quant(sub())),
         ("hier", lambda: _run_hier(sub())),
         ("handoff", lambda: _run_handoff(sub())),
+        ("persistent", lambda: _run_persistent(sub())),
     ]
     results = []
     for name, fn in legs:
@@ -688,6 +713,154 @@ def _run_handoff(args) -> int:
           "on both tiers, every faulted request completed via "
           "retry/re-prefill with token parity; all handoff fault "
           "cells detected-or-survived")
+    return 0
+
+
+def _run_persistent(args) -> int:
+    """The persistent-decode gate (ISSUE 13; see module docstring):
+    protocol matrix, fault cells with the inter-layer semaphore named,
+    the headless dispatch-count assertion, and the scheduler
+    window-parity smoke."""
+    from triton_distributed_tpu import analysis, resilience, serve
+
+    problems: list[str] = []
+
+    # 1: the chained multi-layer protocol at ranks {2,4,8}
+    results = analysis.verify_all(ranks=(2, 4, 8),
+                                  kernel_filter="persistent_decode")
+    if not results:
+        problems.append("no persistent_decode kernel cases registered")
+    for case, violations in results:
+        status = "OK" if not violations else "VIOLATION"
+        print(f"{case.name:<28} ranks={case.n:<2} {status}")
+        for v in violations:
+            print(f"    [{v.check}] {v.message}")
+            problems.append(f"{case.name}: [{v.check}] {v.message}")
+
+    # 2: every fault class against the chain; must-detect classes must
+    # name a semaphore of the SHARED re-armed set (the inter-layer edge)
+    cells = resilience.run_persistent_cells(seed=args.seed)
+    for row in cells:
+        named = f"  [{', '.join(row['named'])}]" if row["named"] else ""
+        print(f"{row['kernel']:<26} {row['fault']:<16} "
+              f"{row['outcome'].upper():<9}{named}")
+    problems += resilience.verify_matrix(cells, min_kernels_per_class=1)
+    chain_sems = ("ack_sems", "recv_sems", "ag_recv_sems", "send_sems",
+                  "ag_send_sem")
+    chain_named = [r for r in cells
+                   if r["outcome"] == "detected"
+                   and any(any(s in n for s in chain_sems)
+                           for n in r["named"])]
+    if not chain_named:
+        problems.append(
+            "no fault detection named a semaphore of the shared chain "
+            "set — the inter-layer dependency edge is not being "
+            "exercised")
+
+    # 3: headless dispatch-count assertion.  The step-bundle harness
+    # (embed gather + lax.scan + final norm + lm_head + argmax) must add
+    # exactly ONE launch-shaped equation around the step function, and
+    # the module must carry exactly ONE pallas_call — together: a
+    # persistent step bundle is <= 2 dispatches per token window, the
+    # decode_dispatches_per_bundle claim (slice captures measure the
+    # real traced number; this pin holds on any jax build).
+    import jax
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.core.mesh import TP_AXIS, make_mesh
+    from triton_distributed_tpu.models import ModelConfig, Qwen3
+    from triton_distributed_tpu.models.kv_cache import init_paged_cache
+    from triton_distributed_tpu.ops import persistent_decode as pdm
+
+    mesh = make_mesh({TP_AXIS: 1}, devices=jax.devices()[:1])
+    cfg = ModelConfig(num_layers=2, hidden=32, intermediate=64,
+                      num_heads=4, num_kv_heads=2, head_dim=8, vocab=64,
+                      max_length=32, dtype=jnp.float32)
+    model = Qwen3(cfg, mesh, decode_mode="persistent")
+    params = model.init(jax.random.key(0), scale=0.05)
+    cache = init_paged_cache(mesh, cfg.num_layers, 2, cfg.num_kv_heads,
+                             cfg.max_length, cfg.head_dim, cfg.dtype,
+                             page_size=8)
+    tok = jnp.zeros((2,), jnp.int32)
+    orig = pdm.persistent_decode_step
+    pdm.persistent_decode_step = \
+        lambda x, sp, pk, pv, table, lens, mesh, axis="tp", **kw: (x, pk, pv)
+    try:
+        harness = pdm.count_bundle_dispatches(model, params, cache, tok, 4)
+    finally:
+        pdm.persistent_decode_step = orig
+    with open(pdm.__file__) as f:
+        launches = f.read().count("pl.pallas_call(")
+    print(f"bundle harness dispatches={harness} module pallas_calls="
+          f"{launches} -> per-bundle bound {harness + launches}")
+    if harness != 1:
+        problems.append(
+            f"step-bundle harness contributes {harness} dispatch-shaped "
+            f"equations (want exactly 1, the lm_head GEMM) — the scan "
+            f"harness grew a hidden dispatch")
+    if launches != 1:
+        problems.append(
+            f"ops/persistent_decode.py builds {launches} pallas_calls "
+            f"(want exactly 1 persistent grid) — the <= 2 per-bundle "
+            f"claim no longer follows structurally")
+
+    # 4: scheduler window-parity smoke — steps_per_dispatch 4 vs 1 over
+    # a seeded pool-pressured trace: same completions, identical
+    # tokens, zero leaks, fewer dispatch windows
+    def run(spd):
+        backend = serve.SimBackend(slots=4, page_size=4, pool_pages=17,
+                                   max_length=64, steps_per_dispatch=spd)
+        sched = serve.Scheduler(backend, serve.SchedulerConfig(
+            max_queue_depth=64))
+        arrivals = serve.synthetic_trace(args.seed + 3, 24,
+                                         mean_interarrival_steps=0.5,
+                                         prompt_len=(2, 12),
+                                         max_new=(4, 12))
+        report = serve.replay(sched, arrivals, max_steps=20_000)
+        return sched, report
+
+    s1, r1 = run(1)
+    s4, r4 = run(4)
+    print(f"window smoke: spd=1 {len(r1.completed)} completed / "
+          f"{s1.preemptions} preempted / {s1.decode_windows} windows; "
+          f"spd=4 {len(r4.completed)} completed / {s4.preemptions} "
+          f"preempted / {s4.decode_windows} windows")
+    for tag, s, r in (("spd=1", s1, r1), ("spd=4", s4, r4)):
+        problems += [f"window smoke {tag}: {p}" for p in r.problems()]
+        bad = [q.req_id for q in r.completed
+               if q.tokens != s.backend.expected_tokens(q)]
+        if bad:
+            problems.append(f"window smoke {tag}: token parity broken "
+                            f"vs the deterministic golden for {bad}")
+    if sorted(tuple(q.tokens) for q in r1.completed) != \
+            sorted(tuple(q.tokens) for q in r4.completed):
+        problems.append("window smoke: steps_per_dispatch=4 produced "
+                        "different token sequences than =1 — windows "
+                        "are not membership-transparent")
+    if s4.preemptions < 1:
+        problems.append("window smoke: the pressured trace never "
+                        "preempted — preemption-between-windows is not "
+                        "being exercised")
+    if s4.decode_windows >= s1.decode_windows:
+        problems.append(
+            f"window smoke: spd=4 used {s4.decode_windows} dispatch "
+            f"windows vs {s1.decode_windows} at spd=1 — batching bought "
+            f"nothing")
+
+    for p in problems:
+        print(f"PERSISTENT FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"cells": cells, "harness_dispatches": harness,
+                       "module_pallas_calls": launches,
+                       "problems": problems}, f, indent=1,
+                      sort_keys=True, default=str)
+    if problems:
+        return 1
+    print("persistent OK: chained multi-layer protocol clean at ranks "
+          "{2,4,8}; fault cells detected-or-survived with the "
+          "inter-layer semaphore named; step bundle bounded at 2 "
+          "dispatches; window parity pinned with zero leaks")
     return 0
 
 

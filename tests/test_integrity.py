@@ -149,10 +149,11 @@ def test_clean_traces_have_no_findings():
 
 def test_matrix_corruption_cells_all_detected():
     rows = rz.run_matrix(seed=0, kinds=rz.CORRUPTION_KINDS)
-    # both classes x all 11 kernel cases (fused_mlp_ar since ISSUE 8;
+    # both classes x all 12 kernel cases (fused_mlp_ar since ISSUE 8;
     # quant_allgather/push_1shot + quant_exchange/oneshot since ISSUE 9;
-    # hier_allreduce/2x2 + hier_a2a/2x2 since ISSUE 10)
-    assert len(rows) == 22
+    # hier_allreduce/2x2 + hier_a2a/2x2 since ISSUE 10;
+    # persistent_decode/chain since ISSUE 13)
+    assert len(rows) == 24
     for row in rows:
         assert row["outcome"] == "detected", row
         assert row["named"], row
@@ -248,6 +249,16 @@ MATRIX_GOLDEN = {
     ("hier_a2a/2x2", "rank_abort"),
     ("hier_a2a/2x2", "corrupt_payload"),
     ("hier_a2a/2x2", "corrupt_kv_page"),
+    # the persistent multi-layer decode chain (ISSUE 13): 2L ring
+    # reductions on one re-armed semaphore set — every class must land
+    # somewhere in the chain, with the inter-layer semaphores nameable
+    ("persistent_decode/chain", "drop_notify"),
+    ("persistent_decode/chain", "delay_notify"),
+    ("persistent_decode/chain", "stale_credit"),
+    ("persistent_decode/chain", "straggler"),
+    ("persistent_decode/chain", "rank_abort"),
+    ("persistent_decode/chain", "corrupt_payload"),
+    ("persistent_decode/chain", "corrupt_kv_page"),
 }
 
 SCHEDULER_GOLDEN = {

@@ -272,12 +272,13 @@ def _run_lint(*args):
 def test_cli_full_matrix_clean():
     res = _run_lint()
     assert res.returncode == 0, res.stdout + res.stderr
-    # 66 = the ISSUE-9-era 51 (pre-ISSUE-8 36 + fused_mlp_ar x {2,4,8} +
+    # 69 = the ISSUE-9-era 51 (pre-ISSUE-8 36 + fused_mlp_ar x {2,4,8} +
     # quantized wire variants x {2,4,8}) plus the ISSUE-10
     # all_to_all/scheduled variant x {2,4,8} and the hierarchical
     # two-level cases (4 families x the {2x2} layout at n=4 + 4 x the
-    # {2x4, 4x2} layouts at n=8 = 12)
-    assert "66 kernel cases" in res.stdout
+    # {2x4, 4x2} layouts at n=8 = 12), plus the ISSUE-13 persistent
+    # multi-layer decode chain x {2,4,8}
+    assert "69 kernel cases" in res.stdout
     assert "0 violation(s)" in res.stdout
 
 

@@ -33,7 +33,7 @@ DEFAULT_RANKS = (2, 4, 8)
 FAMILIES = (
     "allgather", "reduce_scatter", "allreduce", "all_to_all",
     "ag_gemm", "gemm_rs", "gemm_ar", "fused_mlp_ar",
-    "quantized_wire", "hierarchical",
+    "quantized_wire", "hierarchical", "persistent_decode",
 )
 
 _FAMILY_ALIASES = {"ep_dispatch": "all_to_all", "ep_combine": "all_to_all",
@@ -384,6 +384,91 @@ def _fused_mlp_ar_cases(n: int) -> list[KernelCase]:
     ]
 
 
+def _persistent_cases(n: int) -> list[KernelCase]:
+    """The persistent multi-layer decode loop (ISSUE 13,
+    ``ops.persistent_decode``): the WHOLE chained body — L layers, each
+    an attention cell plus TWO column-ring AllReduce instances on one
+    shared semaphore/buffer set, the inter-instance dependency carried
+    by deferred ACK credits ("semaphores re-armed in-kernel") — recorded
+    as one kernel.  Two layers suffice to exercise every chaining state:
+    the unarmed first instance, armed same-layer and armed cross-layer
+    reuse, and the single exit drain."""
+    import jax.numpy as jnp
+
+    from ..ops.persistent_decode import (
+        PersistentDecodeConfig,
+        _persistent_decode_kernel,
+    )
+
+    layers, b, k_dim, hk, g, d = 2, 2, 8, 1, 1, 4
+    ps, mp, pool_pages, f_loc = 4, 2, 4, 8
+    h_loc = hk * g
+    qkv_cols = (h_loc + 2 * hk) * d
+    pool_rows = layers * pool_pages * hk
+    team = _team(n)
+    cfg = PersistentDecodeConfig()
+
+    def make(rank):
+        cn = k_dim // n
+        args = [
+            FakeRef("table", (b * mp,)),
+            FakeRef("lens", (b,)),
+            FakeRef("x", (b, k_dim)),
+            FakeRef("ln1_s", (layers, k_dim)),
+            FakeRef("wqkv_s", (layers, k_dim, qkv_cols)),
+            FakeRef("qn_s", (layers, d)),
+            FakeRef("kn_s", (layers, d)),
+            FakeRef("wo_s", (layers, h_loc * d, k_dim)),
+            FakeRef("ln2_s", (layers, k_dim)),
+            FakeRef("gate_up_s", (layers, k_dim, 2 * f_loc)),
+            FakeRef("down_s", (layers, f_loc, k_dim)),
+            FakeRef("pool_k", (pool_rows, ps, d)),
+            FakeRef("pool_v", (pool_rows, ps, d)),
+            FakeRef("x_out", (b, k_dim)),
+            FakeRef("pool_k", (pool_rows, ps, d)),
+            FakeRef("pool_v", (pool_rows, ps, d)),
+            FakeRef("xa", (b, k_dim)),
+            FakeRef("xb", (b, k_dim)),
+            FakeRef("h_buf", (b, k_dim)),
+            FakeRef("qkv_buf", (b, qkv_cols)),
+            FakeRef("attn_vm", (b, h_loc * d)),
+            FakeRef("attn_buf", (b, h_loc * d)),
+            FakeRef("g_buf", (b, f_loc)),
+            FakeRef("u_buf", (b, f_loc)),
+            FakeRef("act_buf", (b, f_loc)),
+            FakeRef("red_buf", (n * b, cn)),
+            FakeRef("mm_buf", (2, b, cn)),
+            FakeRef("recv_buf", (2, b, cn)),
+            FakeRef("send_buf", (2, b, cn)),
+            FakeRef("qrow", (1, qkv_cols)),
+            FakeRef("qn_vm", (1, d)),
+            FakeRef("kn_vm", (1, d)),
+            FakeRef("ktok", (1, d)),
+            FakeRef("vtok", (1, d)),
+            FakeRef("kbuf", (2, ps, d)),
+            FakeRef("vbuf", (2, ps, d)),
+            FakeSem("stage_sems"),
+            FakeSem("pg_sems"),
+            FakeSem("tok_sems"),
+            FakeSem("send_sems"),
+            FakeSem("recv_sems"),
+            FakeSem("ack_sems", kind="regular"),
+            FakeSem("ag_send_sem"),
+            FakeSem("ag_recv_sems"),
+            FakeRef("acc_qkv", (1, 1)),
+            FakeRef("acc_ar", (1, 1)),
+            FakeRef("acc_up", (1, 1)),
+        ]
+        return "chain", lambda: _persistent_decode_kernel(
+            team, layers, b, k_dim, hk, g, d, ps, mp, pool_pages, f_loc,
+            10_000.0, 1e-6, 1e-6, d ** -0.5, 0.0, cfg, jnp.float32,
+            *args,
+        )
+
+    return [KernelCase("persistent_decode/chain", "persistent_decode", n,
+                       make)]
+
+
 def _quant_cases(n: int) -> list[KernelCase]:
     """The quantized collective variants (ISSUE 9) at their WIRE shapes:
     a quantized payload rides the same kernel protocols on the packed u8
@@ -603,6 +688,7 @@ _FAMILY_CASES = {
     "fused_mlp_ar": _fused_mlp_ar_cases,
     "quantized_wire": _quant_cases,
     "hierarchical": _hier_cases,
+    "persistent_decode": _persistent_cases,
 }
 
 

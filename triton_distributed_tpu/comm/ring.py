@@ -111,6 +111,68 @@ def bidir_ring_drain(team: Team, out_ref, m: int, send_sems):
         dl.wait_send(chunk(out_ref, me, m), send_sems.at[1])
 
 
+def gemm_rs_chunk_phase(team: Team, b: int, mm, add, a_ref, w_chunk,
+                        out_ref, mm_buf, recv_buf, send_buf, send_sems,
+                        recv_sems, ack_sems, acc_ref, right_id, left_id):
+    """The travelling-partial phase of the column-chunked GEMM +
+    two-shot-AllReduce kernels — ONE home for the delicate slot/ack
+    accounting (the PR-9 "one home" discipline): the standalone
+    ``ops.fused_decode._fused_mlp_ar_kernel`` and every chained instance
+    of ``ops.persistent_decode._chained_ar`` run THIS body.
+
+    ``mm(a, w, out, scratches=[acc_ref])`` computes one (B, cn) chunk
+    GEMM; ``w_chunk(j)`` returns weight-column chunk j; ``add`` folds
+    the travelling partial.  Ring step s's chunk GEMM computes while
+    step s-1's partial is on the wire, chained through the DMA/ack
+    semaphores — control never returns to the host.  The fully reduced
+    chunk ``me`` lands at its replicated offset of ``out_ref``.  Pair
+    with :func:`gemm_rs_send_drain` (+ an AG phase) and, per the
+    caller's chaining policy, :func:`rs_ack_drain` — the persistent
+    chain defers that drain to the NEXT instance's armed waits."""
+    me, n = team.rank(), team.size
+    j0 = jax.lax.rem(me + n - 1, n)
+    mm(a_ref, w_chunk(j0), mm_buf.at[0], scratches=[acc_ref])
+    dl.remote_copy(mm_buf.at[0], recv_buf.at[0], send_sems.at[0],
+                   recv_sems.at[0], right_id)
+    for s in range(1, n):
+        j = jax.lax.rem(me + n - s - 1, n)
+        slot_in = (s - 1) % 2
+        slot_out = s % 2
+        if s == 2:
+            dl.wait_send(mm_buf.at[0], send_sems.at[0])
+        mm(a_ref, w_chunk(j), mm_buf.at[slot_out], scratches=[acc_ref])
+        dl.wait_recv(recv_buf.at[slot_in], recv_sems.at[slot_in])
+        last = s == n - 1
+        if last:
+            # chunk ``me`` fully reduced: land at its replicated offset
+            add(recv_buf.at[slot_in], mm_buf.at[slot_out],
+                chunk(out_ref, me, b))
+        else:
+            if s >= 3:
+                dl.wait_send(send_buf.at[slot_out], send_sems.at[slot_out])
+            if s >= 2:
+                dl.wait(ack_sems.at[slot_out], 1)
+            add(recv_buf.at[slot_in], mm_buf.at[slot_out],
+                send_buf.at[slot_out])
+            dl.remote_copy(send_buf.at[slot_out], recv_buf.at[slot_out],
+                           send_sems.at[slot_out], recv_sems.at[slot_out],
+                           right_id)
+        dl.notify(ack_sems.at[slot_in], left_id)
+
+
+def gemm_rs_send_drain(n: int, send_buf, send_sems):
+    """Drain the outstanding sends of :func:`gemm_rs_chunk_phase` (the
+    slot parity depends on the ring size; ``send_buf.at[k]`` shapes the
+    element count, which also covers the pre-loop mm_buf send)."""
+    if n == 2:
+        dl.wait_send(send_buf.at[0], send_sems.at[0])
+    elif n == 3:
+        dl.wait_send(send_buf.at[1], send_sems.at[1])
+    else:
+        dl.wait_send(send_buf.at[0], send_sems.at[0])
+        dl.wait_send(send_buf.at[1], send_sems.at[1])
+
+
 def rs_ack_drain(ack_sems, n: int):
     """Consume the outstanding ACK credits of a ring-RS at kernel exit.
 

@@ -133,6 +133,9 @@ _COLLECTIVE_IDS: dict[str, int] = {
     "gemm_ar": 14,
     "tutorial": 15,   # user-authored kernels in tutorials/ share one family
     "fused_mlp_ar": 16,   # decode megakernel reductions (ops/fused_decode)
+    # the persistent multi-layer decode loop (ops/persistent_decode):
+    # all 2L chained ring reductions live in ONE kernel, one family
+    "persistent_decode": 17,
 }
 
 

@@ -204,7 +204,8 @@ class Engine:
         return cls(model, params, batch=batch, **kw)
 
     def scheduler(self, *, pool_pages: int | None = None,
-                  chunk_tokens: int = 64, config=None, **cfg_kw):
+                  chunk_tokens: int = 64, steps_per_dispatch: int = 1,
+                  config=None, **cfg_kw):
         """The continuous-batching serving loop over this engine
         (ROADMAP item 1; ``docs/serving.md``): the engine contributes
         STATELESS, non-donated jit step functions (``Qwen3.decode`` /
@@ -216,6 +217,10 @@ class Engine:
         relying on preemption), chunked prefill at ``chunk_tokens``
         per step, per-request deadlines, per-sequence failure
         isolation, and degradation.  Requires ``cache_layout='paged'``.
+        ``steps_per_dispatch`` > 1 batches membership-stable windows of
+        decode steps into one device dispatch (the ISSUE-13 persistent
+        serving loop; docs/serving.md "steps_per_dispatch") — pair with
+        ``decode_mode="persistent"`` for the full device-resident path.
 
         ``config``: a full ``serve.SchedulerConfig``; or pass its
         fields as ``**cfg_kw``.  ``Engine.serve`` remains the
@@ -223,7 +228,8 @@ class Engine:
         from ..serve import EngineBackend, Scheduler, SchedulerConfig
 
         backend = EngineBackend(self, pool_pages=pool_pages,
-                                chunk_tokens=chunk_tokens)
+                                chunk_tokens=chunk_tokens,
+                                steps_per_dispatch=steps_per_dispatch)
         if config is None:
             cfg_kw.setdefault("prefill_chunk_tokens", chunk_tokens)
             config = SchedulerConfig(**cfg_kw)

@@ -49,6 +49,7 @@ from ..ops.attention import (
     flash_attention,
     paged_decode_attention,
 )
+from ..ops import persistent_decode as pd
 from ..ops.fused_decode import (
     fused_attn_decode,
     fused_linear_ar,
@@ -88,7 +89,28 @@ class QwenParams:
     lm_head: jax.Array        # (K, V) replicated
 
 
-DECODE_MODES = ("psum", "ar", "gemm_ar", "fused")
+DECODE_MODES = ("psum", "ar", "gemm_ar", "fused", "persistent")
+
+
+def stack_decode_params(params: QwenParams) -> pd.StackedDecodeParams:
+    """Stack the per-layer decode weights on a leading (L,) axis — the
+    persistent megakernel's weight layout (``ops.persistent_decode``).
+    Runs under jit (one concatenate per array per traced bundle, hoisted
+    outside the step scan by ``Qwen3.decode_multi``); layouts pass
+    through unchanged (``wqkv`` rank-blocked ``[q_r|k_r|v_r]``,
+    ``gate_up`` rank-blocked ``[gate_r|up_r]``)."""
+    layers = params.layers
+    qk = layers[0].attn.q_norm is not None
+    return pd.StackedDecodeParams(
+        ln1=jnp.stack([lp.ln1 for lp in layers]),
+        wqkv=jnp.stack([lp.attn.wqkv for lp in layers]),
+        q_norm=jnp.stack([lp.attn.q_norm for lp in layers]) if qk else None,
+        k_norm=jnp.stack([lp.attn.k_norm for lp in layers]) if qk else None,
+        wo=jnp.stack([lp.attn.wo for lp in layers]),
+        ln2=jnp.stack([lp.ln2 for lp in layers]),
+        gate_up=jnp.stack([lp.mlp.gate_up for lp in layers]),
+        down=jnp.stack([lp.mlp.down for lp in layers]),
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -132,7 +154,7 @@ class Qwen3:
         over ``axis``, ``w`` (F, H) row-parallel, result (B, H) replicated.
         Dispatches on ``decode_mode`` (see class docstring)."""
         n = self.tp
-        if (self.decode_mode == "fused"
+        if (self.decode_mode in ("fused", "persistent")
                 and h.shape[1] % n == 0 and w.shape[1] % n == 0):
             # megakernel mode: semaphore-chained GEMM + two-shot AR ring
             # over output-column chunks — any B rides (ops.fused_decode);
@@ -672,13 +694,14 @@ class Qwen3:
 
     def _mlp_decode(self, p: TPMLPParams, x: jax.Array) -> jax.Array:
         n = self.tp
-        if (self.decode_mode == "fused"
+        if (self.decode_mode in ("fused", "persistent")
                 and p.down.shape[0] % n == 0 and p.down.shape[1] % n == 0):
             # megakernel mode: gate/up GEMM + SwiGLU + down-proj chained
             # into the AR ring inside ONE kernel (ops.fused_decode) —
             # the host never sits between the GEMM and the reduction
             return fused_mlp_ar(x, p.gate_up, p.down, self.mesh, self.axis)
-        if self.decode_mode in ("psum", "fused") or self.tp == 1:
+        if self.decode_mode in ("psum", "fused", "persistent") \
+                or self.tp == 1:
             def local(x_rep, gu_loc, dn_loc):
                 fused = jnp.dot(x_rep, gu_loc,
                                 preferred_element_type=jnp.float32).astype(x_rep.dtype)
@@ -724,11 +747,13 @@ class Qwen3:
         side as one megakernel per layer (``_attn_decode_paged_fused``);
         on a contiguous cache the fused mode keeps the per-kernel
         attention and fuses the reductions only."""
+        if self.decode_mode == "persistent" and self._persistent_ok(cache):
+            return self._decode_persistent(params, cache, tokens)
         c = self.config
         x = params.embed[tokens]
         if isinstance(cache, PagedKVCache):
             attn_step = (self._attn_decode_paged_fused
-                         if self.decode_mode == "fused"
+                         if self.decode_mode in ("fused", "persistent")
                          else self._attn_decode_paged)
         else:
             attn_step = self._attn_decode
@@ -754,3 +779,79 @@ class Qwen3:
         logits = jnp.dot(x, params.lm_head,
                          preferred_element_type=jnp.float32)
         return logits, advance(cache, 1)
+
+    # -- persistent decode (the device-resident multi-layer loop) ----------
+
+    def _persistent_ok(self, cache) -> bool:
+        """Whether the persistent megakernel serves this (model, cache):
+        paged full-precision pools, dense MLP, every sharded dim
+        divisible by tp.  Anything else falls back to the per-layer
+        ``fused`` chain (docs/perf.md "Persistent decode loop")."""
+        c = self.config
+        n = self.tp
+        return (isinstance(cache, PagedKVCache)
+                and not cache.quantized
+                and not c.is_moe
+                and c.hidden % n == 0 and c.intermediate % n == 0
+                and c.num_kv_heads % n == 0 and c.num_heads % n == 0)
+
+    def _persistent_step(self, params: QwenParams,
+                         sp: "pd.StackedDecodeParams", cache: PagedKVCache,
+                         tokens: jax.Array, config=None):
+        """One token through ALL L layers as one persistent launch, plus
+        the (out-of-kernel) final norm + lm_head: the step the bundle
+        scans.  The page pools ride the kernel's aliased in/outs — no
+        ``replace_layer_slices`` rebuild exists on this path."""
+        c = self.config
+        x = params.embed[tokens]
+        x, pk, pv = pd.persistent_decode_step(
+            x, sp, cache.k, cache.v, cache.block_table, cache.seq_lens,
+            self.mesh, self.axis,
+            rope_theta=c.rope_theta, rms_eps=c.rms_eps,
+            qk_eps=c.rms_eps if c.qk_norm else None, config=config,
+        )
+        cache = dataclasses.replace(cache, k=pk, v=pv)
+        x = rms_norm(x, params.final_norm, c.rms_eps)
+        logits = jnp.dot(x, params.lm_head,
+                         preferred_element_type=jnp.float32)
+        return logits, advance(cache, 1)
+
+    def _decode_persistent(self, params: QwenParams, cache: PagedKVCache,
+                           tokens: jax.Array, config=None):
+        return self._persistent_step(params, stack_decode_params(params),
+                                     cache, tokens, config)
+
+    def decode_multi(self, params: QwenParams, cache: KVCache,
+                     tokens: jax.Array, steps: int, *,
+                     persistent_config=None, stacked=None):
+        """``steps`` greedy decode steps in ONE dispatch
+        (``ops.persistent_decode.decode_bundle``): the argmax token
+        feeds back on device, so the host-visible seam between steps
+        disappears — batch-membership changes apply only BETWEEN
+        bundles (``serve.EngineBackend.steps_per_dispatch`` /
+        ``docs/serving.md``).  ``steps`` is static (one executable per
+        steps bucket).  Returns ``(tokens (steps, B), cache)``.
+
+        ``decode_mode="persistent"`` scans the megakernel step (the
+        weight stack and the tile config are hoisted OUTSIDE the scan —
+        ``persistent_config`` threads a construction-time-resolved
+        config so the hot loop never consults the autotuner winner
+        cache, and ``stacked`` threads a pre-built
+        :class:`~..ops.persistent_decode.StackedDecodeParams` so the
+        traced bundle does not re-materialize the full weight stack per
+        dispatch — ``serve.EngineBackend`` builds it once at
+        construction); every other mode scans its :meth:`decode` chain —
+        same one-dispatch bundle, per-layer launches still inside."""
+        steps = int(steps)
+        if self.decode_mode == "persistent" and self._persistent_ok(cache):
+            sp = stacked if stacked is not None \
+                else stack_decode_params(params)
+
+            def step(cache, tok):
+                return self._persistent_step(params, sp, cache, tok,
+                                             persistent_config)
+
+            return pd.decode_bundle(step, cache, tokens, steps)
+        return pd.decode_bundle(
+            lambda cache, tok: self.decode(params, cache, tok),
+            cache, tokens, steps)

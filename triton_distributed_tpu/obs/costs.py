@@ -242,6 +242,34 @@ def fused_mlp_ar(b: int, k_in: int, k_loc: int, n_dim: int,
     )
 
 
+def persistent_decode(layers: int, b: int, k_dim: int, h: int, hk: int,
+                      seq_kv: int, d: int, f_loc: int, num_ranks: int,
+                      kv_dtype) -> KernelCost:
+    """The persistent multi-layer decode megakernel
+    (``ops.persistent_decode``): per device, L x (the attention-side
+    cell + the o-proj chained AR + the SwiGLU-MLP chained AR) — composed
+    from the per-layer calculators so the watchdog deadline, Mosaic cost
+    estimate and the timeline price the chain exactly as L of the PR-8
+    kernels with the host boundaries removed."""
+    att = fused_attn_decode(b, k_dim, h, hk, seq_kv, d, kv_dtype)
+    # h/hk/f_loc are PER-DEVICE here (the builder's shapes), so the
+    # o-proj's per-rank contraction depth is the full local width h*d —
+    # dividing it by num_ranks again would under-price the GEMM n-fold
+    oproj = fused_mlp_ar(b, h * d, h * d, k_dim, num_ranks, kv_dtype,
+                         swiglu=False)
+    mlp = fused_mlp_ar(b, k_dim, f_loc, k_dim, num_ranks, kv_dtype,
+                       swiglu=True)
+    per_layer = KernelCost(
+        flops=att.flops + oproj.flops + mlp.flops,
+        bytes_accessed=att.bytes_accessed + oproj.bytes_accessed
+        + mlp.bytes_accessed,
+        transcendentals=att.transcendentals + oproj.transcendentals
+        + mlp.transcendentals,
+        wire_bytes=att.wire_bytes + oproj.wire_bytes + mlp.wire_bytes,
+    )
+    return per_layer.scaled(layers)
+
+
 def packed_wire_bytes(rows: int, h: int, wire_dtype: str) -> int:
     """Bytes ``rows`` H-wide rows occupy on a QUANTIZED wire (payload
     byte per element + the 128-lane scale sidecar per row —
@@ -343,6 +371,9 @@ FAMILY_COSTS = {
     # timeline reconstructor — like every other family here
     "fused_attn_decode": fused_attn_decode,
     "fused_mlp_ar": fused_mlp_ar,
+    # the persistent multi-layer decode loop (ops/persistent_decode):
+    # L chained (attention + o-proj AR + MLP AR) layers in one launch
+    "persistent_decode": persistent_decode,
     # the two-level (ICI x DCN) families (ISSUE 10): wire split per
     # class, so deadlines/pct_sol charge each level its own wire
     "hier_all_gather": hier_all_gather,

@@ -167,6 +167,13 @@ def direction_for(metric: str, unit: str) -> str:
     # growth is the regression the sentinel must warn on
     if "overhead" in metric or "over plain" in u:
         return "lower"
+    # per-bundle dispatch counts (decode_dispatches_per_bundle, unit
+    # "dispatches/bundle"): every extra launch is a host seam the
+    # persistent loop exists to remove — growth is the regression.
+    # (The older decode_step_dispatches metric is a HIGHER-is-better
+    # ratio, unit "x fewer dispatches", and keeps the default.)
+    if "dispatches/" in u:
+        return "lower"
     # failure-pressure counts (handoff_retries, *_failures, *_failed_*):
     # every one is a burned retry/ladder rung or a lost request — growth
     # is the regression even though the unit is a bare count (ISSUE 12;

@@ -25,6 +25,13 @@ from .fused_decode import (
 )
 from .gemm_ar import GemmArConfig, gemm_ar
 from .gemm_rs import GemmRsConfig, gemm_rs
+from .persistent_decode import (
+    PersistentDecodeConfig,
+    StackedDecodeParams,
+    count_bundle_dispatches,
+    decode_bundle,
+    persistent_decode_step,
+)
 from .group_gemm import (
     GroupGemmConfig,
     ag_group_gemm,

@@ -155,3 +155,52 @@ def make_add_pipeline(m: int, n: int, bm: int, bn: int):
         ],
         out_specs=[pl.BlockSpec((bm, bn), lambda i, j: (i, j))],
     )
+
+
+def copy_body(a_ref, o_ref):
+    o_ref[...] = a_ref[...]
+
+
+def make_copy_pipeline(m: int, n: int, bm: int, bn: int):
+    """An ``emit_pipeline`` computing O[m,n] = A blockwise (the persistent
+    decode loop's final hidden-state writeback, ``ops.persistent_decode``)."""
+    stub = _protocol_stub("copy")
+    if stub is not None:
+        return stub
+    spec = pl.BlockSpec((bm, bn), lambda i, j: (i, j))
+    return pltpu.emit_pipeline(
+        copy_body, grid=(m // bm, n // bn),
+        in_specs=[spec], out_specs=[spec],
+    )
+
+
+def rmsnorm_body(eps: float, out_dtype, x_ref, w_ref, o_ref):
+    xf = x_ref[...].astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps)
+    o_ref[...] = (out * w_ref[...].astype(jnp.float32)).astype(out_dtype)
+
+
+def make_rmsnorm_pipeline(m: int, n: int, bm: int, eps: float, out_dtype):
+    """An ``emit_pipeline`` computing O[m,n] = rms_norm(X) * W blockwise
+    over WHOLE rows (the norm reduces the full feature axis, so blocks
+    are (bm, n) — fine at decode widths), mirroring
+    ``layers.norm.rms_norm`` numerics (f32 math, cast back).
+
+    Call as ``pipe(x_ref, w_ref, o_ref)`` with ``w_ref`` a (1, n) view
+    (e.g. one layer's slice of a stacked (L, n) norm-weight array) —
+    the residual/norm glue fused between the persistent decode loop's
+    chained stages (``ops.persistent_decode``).
+    """
+    stub = _protocol_stub("rmsnorm")
+    if stub is not None:
+        return stub
+    return pltpu.emit_pipeline(
+        functools.partial(rmsnorm_body, eps, out_dtype),
+        grid=(m // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_specs=[pl.BlockSpec((bm, n), lambda i: (i, 0))],
+    )

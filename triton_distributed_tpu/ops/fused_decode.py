@@ -55,7 +55,6 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..comm import ring
-from ..comm.ring import chunk as _chunk
 from ..core import compilation
 from ..core.mesh import TP_AXIS
 from ..core.utils import clip_block
@@ -532,7 +531,7 @@ def _fused_mlp_ar_kernel(
         (x_ref, dn_ref, out_ref,
          mm_buf, recv_buf, send_buf, send_sems, recv_sems, ack_sems,
          ag_send_sem, ag_recv_sems, acc_ref) = refs
-    me, n = team.rank(), team.size
+    n = team.size
     left, right = team.neighbor_ranks()
     left_id, right_id = team.device_id(left), team.device_id(right)
     cn = n_dim // n
@@ -562,49 +561,20 @@ def _fused_mlp_ar_kernel(
     dl.collective_prologue(team, neighbors_only=True)
 
     # --- phase 1: down-proj GEMM + ring ReduceScatter over OUTPUT column
-    # chunks (the ops/gemm_ar.py flow with N-chunking, so any B rides) —
+    # chunks (the ops/gemm_rs.py flow with N-chunking, so any B rides) —
     # the partial of ring step s computes while step s-1's chunk is on
-    # the wire, chained through the DMA/ack semaphores, never the host
-    j0 = jax.lax.rem(me + n - 1, n)
-    mm(a_ref, dn_chunk(j0), mm_buf.at[0], scratches=[acc_ref])
-    dl.remote_copy(mm_buf.at[0], recv_buf.at[0], send_sems.at[0],
-                   recv_sems.at[0], right_id)
-
-    for s in range(1, n):
-        j = jax.lax.rem(me + n - s - 1, n)
-        slot_in = (s - 1) % 2
-        slot_out = s % 2
-        if s == 2:
-            dl.wait_send(mm_buf.at[0], send_sems.at[0])
-        mm(a_ref, dn_chunk(j), mm_buf.at[slot_out], scratches=[acc_ref])
-        dl.wait_recv(recv_buf.at[slot_in], recv_sems.at[slot_in])
-        last = s == n - 1
-        if last:
-            # chunk ``me`` fully reduced: land at its replicated offset
-            add(recv_buf.at[slot_in], mm_buf.at[slot_out],
-                _chunk(out_ref, me, b))
-        else:
-            if s >= 3:
-                dl.wait_send(send_buf.at[slot_out], send_sems.at[slot_out])
-            if s >= 2:
-                dl.wait(ack_sems.at[slot_out], 1)
-            add(recv_buf.at[slot_in], mm_buf.at[slot_out],
-                send_buf.at[slot_out])
-            dl.remote_copy(send_buf.at[slot_out], recv_buf.at[slot_out],
-                           send_sems.at[slot_out], recv_sems.at[slot_out],
-                           right_id)
-        dl.notify(ack_sems.at[slot_in], left_id)
+    # the wire, chained through the DMA/ack semaphores, never the host.
+    # The slot/ack accounting lives ONCE in ring.gemm_rs_chunk_phase
+    # (shared with the persistent chain, ops/persistent_decode).
+    ring.gemm_rs_chunk_phase(team, b, mm, add, a_ref, dn_chunk, out_ref,
+                             mm_buf, recv_buf, send_buf, send_sems,
+                             recv_sems, ack_sems, acc_ref, right_id,
+                             left_id)
 
     # --- phase 2: AG ring of reduced chunks + drains (gemm_ar accounting)
     ring.ag_ring_phase(team, out_ref, b, ag_send_sem, ag_recv_sems,
                        right_id)
-    if n == 2:
-        dl.wait_send(send_buf.at[0], send_sems.at[0])
-    elif n == 3:
-        dl.wait_send(send_buf.at[1], send_sems.at[1])
-    else:
-        dl.wait_send(send_buf.at[0], send_sems.at[0])
-        dl.wait_send(send_buf.at[1], send_sems.at[1])
+    ring.gemm_rs_send_drain(n, send_buf, send_sems)
     ring.rs_ack_drain(ack_sems, n)
     ring.ag_ring_drain(team, out_ref, b, ag_send_sem)
 

@@ -1,0 +1,870 @@
+"""Persistent serving megakernel: the device-resident multi-layer decode
+loop (ROADMAP item 2, the step past PR 8's per-layer fusion).
+
+PR 8 fused decode *within* a layer — qkv/rope/append/flash in one kernel,
+the MLP chained into its AllReduce — but the step loop still returned
+control to the host L times per token: per layer one attention launch and
+two chained-reduction launches, plus the once-per-step
+``replace_layer_slices`` pool rebuild and the autotuner winner-cache
+consult inside the hot path.  Those are exactly the *hidden
+serialization* seams "Eliminating Hidden Serialization in Multi-Node
+Megakernel Communication" (PAPERS.md) names: the exposed cost is no
+longer kernels, it is the host-visible boundaries between them.  The
+flight recorder + timeline attributor (PRs 4-5) can show every one of
+them as an exposed wait at a dispatch boundary.
+
+This module removes the seams (docs/perf.md "Persistent decode loop"):
+
+- **One persistent grid for all L layers**
+  (:func:`persistent_decode_step`): the PR-8 per-layer megakernels chain
+  inside ONE collective ``pallas_call`` — per layer the attention cell
+  (qkv GEMM + qk-norm + rope + ragged paged append + block-table flash
+  decode), the o-proj column-ring AllReduce, and the SwiGLU-MLP
+  column-ring AllReduce, with the residual/norm glue fused between
+  stages (``blocks.make_rmsnorm_pipeline`` / ``make_add_pipeline``).
+  Layer weights live in stacked ``(L, ...)`` HBM arrays and stream
+  through the double-buffered VMEM pipelines the ``ops.blocks``
+  emit-pipeline factories build — no whole-layer weight resident set.
+- **Semaphores re-armed in-kernel**: all 2L ring-reduction instances
+  share ONE semaphore/buffer set.  Instance j+1's first sends wait the
+  outstanding ACK credits of instance j (the credits the single-kernel
+  form drains at exit), so the inter-layer dependency is carried by the
+  same two-shot-AR semaphore protocol ``fused_mlp_ar`` uses between its
+  GEMM and reduction — never by a host-visible semaphore reset.  One
+  ``rs_ack_drain`` runs at kernel exit for the final instance.
+- **KV writeback folded into the aliased pool**: the stacked page pools
+  ride ``input_output_aliases`` through the one launch; each layer's
+  token append is an in-place DMA into its pool rows.  The per-step
+  ``replace_layer_slices`` rebuild (2 pool materializations per step)
+  disappears from the persistent path entirely.
+- **N steps per dispatch** (:func:`decode_bundle` /
+  ``Qwen3.decode_multi``): the step bundle — embed gather, the
+  megakernel, final-norm + lm_head, greedy argmax feedback — runs under
+  ``lax.scan`` inside ONE jitted dispatch, so batch-membership changes
+  apply only *between* dispatches (the PR-6 stateless step × scheduler
+  split; ``serve.EngineBackend`` grows the ``steps_per_dispatch`` knob
+  and the scheduler batches membership-stable windows).  The static
+  dispatch counter (:func:`count_bundle_dispatches`) sees exactly TWO
+  launch-shaped equations per step bundle: the megakernel and the
+  lm_head GEMM — down from 2·L per token.
+- **Config resolution hoisted out of the step**: the tile config
+  resolves through the contextual autotuner once per (shape, steps)
+  executable — ``serve.EngineBackend`` resolves it at construction and
+  threads it explicitly, so the hot loop never consults the winner
+  cache per dispatch (``tune.fresh_tune_persistent_decode`` is the
+  bench/warmup re-measure hook).
+
+Scope: full-precision paged pools (an int8 pool's in-kernel append would
+have to re-encode page scales — those deployments keep
+``decode_mode="fused"``, whose per-layer kernels return the token for
+the exact quantized scatter); dense MLP (MoE decodes through the
+replicated EP path).  ``n == 1`` degenerates to the pure-XLA reference
+step (:func:`reference_decode_step`) — also the parity golden and the
+resilience ladder's degraded fallback
+(``resilience.fallbacks.xla_persistent_decode``).
+
+Verification discipline (the PR-8 pattern): the kernel body is written
+entirely in the recordable vocabulary — ``lang.primitives`` DMA/signal
+ops, ``ops.blocks`` factories (protocol stubs under record mode), ring
+helpers — so ``analysis.registry`` family ``persistent_decode`` verifies
+the whole chained multi-layer protocol at ranks {2, 4, 8}; the fault
+matrix injects into the chain (``scripts/tdt_lint.py --persistent``);
+``obs.costs`` prices the family for the watchdog, Mosaic and timeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..comm import ring
+from ..core import compilation
+from ..core.mesh import TP_AXIS
+from ..core.utils import clip_block
+from ..lang import primitives as dl
+from ..lang.primitives import Team
+from . import blocks
+from .rope import apply_rope_at
+
+# ---------------------------------------------------------------------------
+# stacked layer parameters (the kernel's weight layout)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class StackedDecodeParams:
+    """Per-layer decode weights stacked on a leading (L,) axis — the
+    persistent kernel streams layer ``l``'s slices through its
+    double-buffered pipelines instead of taking L separate pytrees.
+    Layouts match ``models.qwen`` (``wqkv`` columns rank-blocked
+    ``[q_r | k_r | v_r]``, ``gate_up`` columns rank-blocked
+    ``[gate_r | up_r]``, ``wo``/``down`` row-parallel).  Built once per
+    trace by ``models.qwen.stack_decode_params``."""
+
+    ln1: jax.Array                    # (L, K)
+    wqkv: jax.Array                   # (L, K, (H + 2*Hk) * D)
+    q_norm: jax.Array | None          # (L, D) when qk-norm, else None
+    k_norm: jax.Array | None
+    wo: jax.Array                     # (L, H*D, K)
+    ln2: jax.Array                    # (L, K)
+    gate_up: jax.Array                # (L, K, 2*F)
+    down: jax.Array                   # (L, F, K)
+
+
+# ---------------------------------------------------------------------------
+# config
+
+
+@dataclasses.dataclass(frozen=True)
+class PersistentDecodeConfig:
+    """Tile knobs of the persistent decode megakernel: ``bm`` rows
+    (clipped to B), ``bn`` output columns per matmul block, ``bk``
+    contraction depth, ``bf`` the gate/up feature tile; ``vmem_limit``
+    raises Mosaic's scoped budget (the per-layer streamed working set
+    plus two KV page buffers can exceed the 16 MiB default)."""
+
+    bm: int = 1024
+    bn: int = 512
+    bk: int = 512
+    bf: int = 512
+    vmem_limit: int | None = None
+
+
+_PERSISTENT_VL = 100 * 2**20
+
+
+def persistent_decode_candidates(b: int, k_loc: int, cn: int) -> list:
+    """Default-first sweep for the ``config=None`` path, clipped to the
+    problem and deduped like ``fused_mlp_candidates`` — at decode shapes
+    most tilings collapse onto the default and the one-candidate sweep
+    short-circuits."""
+    dims = [(1024, 512, 512, 512, None), (1024, 1024, 512, 512, None),
+            (1024, 512, 1024, 1024, None),
+            (1024, 512, 512, 512, _PERSISTENT_VL)]
+    out, seen = [], set()
+    for bm, bn, bk, bf, vl in dims:
+        c = PersistentDecodeConfig(
+            bm=clip_block(bm, b), bn=clip_block(bn, cn),
+            bk=clip_block(bk, k_loc), bf=clip_block(bf, k_loc),
+            vmem_limit=vl)
+        if c not in seen:
+            seen.add(c)
+            out.append(c)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the chained column-ring AllReduce (one instance = one fused reduction)
+
+
+def _chained_ar(team: Team, b: int, cn: int, mm, add, a_ref, w_chunk,
+                out_ref, mm_buf, recv_buf, send_buf, send_sems, recv_sems,
+                ack_sems, ag_send_sem, ag_recv_sems, acc_ref, *,
+                armed: bool):
+    """One ``AllReduce(a @ W)`` instance over OUTPUT column chunks — the
+    ``fused_mlp_ar`` two-shot ring (GEMM-RS phase 1, AG phase 2) on a
+    SHARED semaphore/buffer set.
+
+    ``armed`` marks a non-first instance in the persistent chain: its
+    first sends reuse ring buffers the previous instance's consumer may
+    still hold, so it first consumes the previous instance's outstanding
+    ACK credits — the credits the standalone kernel's ``rs_ack_drain``
+    would have burned at exit.  That wait IS the inter-layer dependency
+    edge, carried in-kernel by the same semaphores instead of a host
+    boundary; the caller runs ONE ``rs_ack_drain`` at kernel exit for
+    the final instance.  Chunk ``c`` of the reduced output lands at rows
+    ``[c*b, (c+1)*b)`` of ``out_ref`` (chunk-major, like
+    ``fused_mlp_ar``)."""
+    n = team.size
+    left, right = team.neighbor_ranks()
+    left_id, right_id = team.device_id(left), team.device_id(right)
+
+    if armed:
+        # re-arm in kernel: the previous instance left exactly the
+        # credits its standalone form drains at exit — consuming them
+        # HERE (the SAME rs_ack_drain accounting, one home) proves the
+        # right neighbor consumed every ring slot of the previous
+        # instance before this one's first write reuses them
+        ring.rs_ack_drain(ack_sems, n)
+
+    # phase 1: chunk GEMM + travelling-partial ring — the ONE shared
+    # body (ring.gemm_rs_chunk_phase, also run by the standalone
+    # fused_mlp_ar kernel): step s's partial computes while step s-1's
+    # chunk is on the wire, chained through the DMA/ack semaphores
+    ring.gemm_rs_chunk_phase(team, b, mm, add, a_ref, w_chunk, out_ref,
+                             mm_buf, recv_buf, send_buf, send_sems,
+                             recv_sems, ack_sems, acc_ref, right_id,
+                             left_id)
+
+    # phase 2: AG ring of reduced chunks + per-instance local drains
+    # (the fused_mlp_ar accounting; ACK credits deliberately NOT drained
+    # here — the next instance's armed waits consume them)
+    ring.ag_ring_phase(team, out_ref, b, ag_send_sem, ag_recv_sems,
+                       right_id)
+    ring.gemm_rs_send_drain(n, send_buf, send_sems)
+    ring.ag_ring_drain(team, out_ref, b, ag_send_sem)
+
+
+# ---------------------------------------------------------------------------
+# the attention cell (real-mode only; a protocol stub under record mode)
+
+
+def _attn_cell_real(l: int, b: int, hk: int, g: int, d: int, ps: int,
+                    mp: int, pool_pages: int, theta: float, qk_eps,
+                    sm_scale: float, soft_cap: float, qkv_hbm, qn_s, kn_s,
+                    table_ref, lens_ref, pool_k, pool_v, out_vm, qrow,
+                    qn_vm, kn_vm, ktok, vtok, kbuf, vbuf, stage_sems,
+                    pg_sems, tok_sems):
+    """One layer's attention-side decode inside the persistent loop:
+    the ``_fused_attn_kernel`` cell (qk-norm + rope + ragged in-place
+    paged append + double-buffered page-streamed flash decode with the
+    fresh token folded from registers) with the (kv-head, batch) grid
+    unrolled as static loops and the pool rows offset into layer ``l``'s
+    block of the stacked pool."""
+    from .attention import _init_carry, _tile_update, safe_normalize_decode
+    from .fused_decode import _rms, _rope1
+
+    h_loc = hk * g
+    base = l * pool_pages
+    if qk_eps is not None:
+        cq = pltpu.make_async_copy(qn_s.at[pl.ds(l, 1)], qn_vm,
+                                   stage_sems.at[1])
+        ck = pltpu.make_async_copy(kn_s.at[pl.ds(l, 1)], kn_vm,
+                                   stage_sems.at[1])
+        cq.start()
+        ck.start()
+        cq.wait()
+        ck.wait()
+    for b_i in range(b):
+        cp = pltpu.make_async_copy(qkv_hbm.at[pl.ds(b_i, 1)], qrow,
+                                   stage_sems.at[1])
+        cp.start()
+        cp.wait()
+        pos = lens_ref[b_i]
+        for h_i in range(hk):
+            q = qrow[0, h_i * g * d:(h_i + 1) * g * d].reshape(g, d)
+            k_new = qrow[0, (h_loc + h_i) * d:(h_loc + h_i + 1) * d
+                         ].reshape(1, d)
+            v_new = qrow[0, (h_loc + hk + h_i) * d:
+                         (h_loc + hk + h_i + 1) * d].reshape(1, d)
+            if qk_eps is not None:
+                q = _rms(q, qn_vm[...], qk_eps)
+                k_new = _rms(k_new, kn_vm[...], qk_eps)
+            q = _rope1(q, pos, theta)
+            k_new = _rope1(k_new, pos, theta)
+
+            # ragged in-place append into layer l's pool rows (the KV
+            # writeback folded into the persistent loop's aliased pool)
+            pg = jnp.minimum(pos // ps, mp - 1)
+            row = (base + table_ref[b_i * mp + pg]) * hk + h_i
+            off = pos % ps
+            ktok[...] = k_new.astype(ktok.dtype)
+            vtok[...] = v_new.astype(vtok.dtype)
+            wk = pltpu.make_async_copy(
+                ktok, pool_k.at[row, pl.ds(off, 1)], tok_sems.at[0])
+            wv = pltpu.make_async_copy(
+                vtok, pool_v.at[row, pl.ds(off, 1)], tok_sems.at[1])
+            wk.start()
+            wv.start()
+            wk.wait()
+            wv.wait()
+
+            q_s = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
+            npages = jnp.minimum((pos + ps - 1) // ps, mp)
+
+            def page_dma(slot, j, b_i=b_i, h_i=h_i):
+                r = (base + table_ref[b_i * mp + j]) * hk + h_i
+                return (
+                    pltpu.make_async_copy(pool_k.at[r], kbuf.at[slot],
+                                          pg_sems.at[slot, 0]),
+                    pltpu.make_async_copy(pool_v.at[r], vbuf.at[slot],
+                                          pg_sems.at[slot, 1]),
+                )
+
+            @pl.when(npages > 0)
+            def _():
+                ck0, cv0 = page_dma(0, 0)
+                ck0.start()
+                cv0.start()
+
+            def body(j, carry, q_s=q_s, pos=pos, page_dma=page_dma):
+                @pl.when(j + 1 < npages)
+                def _():
+                    ckn, cvn = page_dma((j + 1) % 2, j + 1)
+                    ckn.start()
+                    cvn.start()
+
+                ckj, cvj = page_dma(j % 2, j)
+                ckj.wait()
+                cvj.wait()
+                kpos = j * ps + jax.lax.broadcasted_iota(
+                    jnp.int32, (g, ps), 1)
+                return _tile_update(q_s, kbuf[j % 2], vbuf[j % 2],
+                                    kpos < pos, soft_cap, carry)
+
+            carry = jax.lax.fori_loop(0, npages, body, _init_carry(g, d))
+
+            kt8 = jnp.concatenate(
+                [k_new, jnp.zeros((7, d), k_new.dtype)], axis=0)
+            vt8 = jnp.concatenate(
+                [v_new, jnp.zeros((7, d), v_new.dtype)], axis=0)
+            tok_mask = jax.lax.broadcasted_iota(jnp.int32, (g, 8), 1) == 0
+            m1, l1, acc1 = _tile_update(q_s, kt8, vt8, tok_mask, soft_cap,
+                                        carry)
+            out_vm[b_i, h_i * g * d:(h_i + 1) * g * d] = \
+                safe_normalize_decode(acc1, l1, out_vm.dtype).reshape(g * d)
+
+
+# ---------------------------------------------------------------------------
+# the persistent kernel body (shared: real Pallas build AND record mode)
+
+
+def _persistent_decode_kernel(
+    team: Team,
+    layers: int,
+    b: int,
+    k_dim: int,
+    hk: int,
+    g: int,
+    d: int,
+    ps: int,
+    mp: int,
+    pool_pages: int,
+    f_loc: int,
+    theta: float,
+    rms_eps: float,
+    qk_eps,
+    sm_scale: float,
+    soft_cap: float,
+    cfg: PersistentDecodeConfig,
+    out_dtype,
+    *refs,
+    # inputs: table (B*mp,) SMEM; lens (B,) SMEM; x (B, K) ANY;
+    # ln1_s (L, K); wqkv_s (L, K, (hk*g+2hk)*d); [qn_s/kn_s (L, d)];
+    # wo_s (L, hk*g*d, K); ln2_s (L, K); gate_up_s (L, K, 2*f_loc);
+    # down_s (L, f_loc, K); pool_k/pool_v (L*P*hk, ps, d) ANY (aliased).
+    # outputs: x_out (B, K) ANY; pool_k/pool_v aliased ANY.
+    # scratch: xa/xb/h_buf (B, K) HBM; qkv_hbm (B, qkv_cols) HBM;
+    # attn_vm (B, hk*g*d) VMEM; attn_hbm same HBM; g/u/act (B, f_loc)
+    # HBM; red_buf (n*B, cn) HBM; mm/recv/send (2, B, cn) HBM;
+    # qrow (1, qkv_cols) / qn_vm / kn_vm (1, d) / ktok / vtok (1, d) /
+    # kbuf / vbuf (2, ps, d) VMEM; stage (2,) / pg (2,2) / tok (2,) /
+    # send (2,) / recv (2,) DMA sems; ack (2,) REGULAR; ag_send;
+    # ag_recv (n,); acc_qkv / acc_ar / acc_up VMEM f32 accumulators
+):
+    refs = list(refs)
+    table_ref, lens_ref, x_ref, ln1_s, wqkv_s = refs[:5]
+    del refs[:5]
+    if qk_eps is not None:
+        qn_s, kn_s = refs[:2]
+        del refs[:2]
+    else:
+        qn_s = kn_s = None
+    (wo_s, ln2_s, gu_s, dn_s, _pk_in, _pv_in,
+     x_out, pool_k, pool_v) = refs[:9]
+    del refs[:9]
+    (xa, xb, h_buf, qkv_hbm, attn_vm, attn_hbm, g_buf, u_buf, act_buf,
+     red_buf, mm_buf, recv_buf, send_buf,
+     qrow, qn_vm, kn_vm, ktok, vtok, kbuf, vbuf,
+     stage_sems, pg_sems, tok_sems,
+     send_sems, recv_sems, ack_sems, ag_send_sem, ag_recv_sems,
+     acc_qkv, acc_ar, acc_up) = refs
+
+    n = team.size
+    h_loc = hk * g
+    cn = k_dim // n
+    qkv_cols = (h_loc + 2 * hk) * d
+    bm = clip_block(cfg.bm, b)
+    bk = clip_block(cfg.bk, k_dim)
+
+    # hoisted pipelines: one geometry serves every layer (the blocks
+    # factories stream their ANY-space operands through double-buffered
+    # VMEM blocks — this IS the layer-weight streaming pipeline)
+    rms_pipe = blocks.make_rmsnorm_pipeline(b, k_dim, bm, rms_eps,
+                                            out_dtype)
+    mm_qkv = blocks.make_matmul_pipeline(
+        b, qkv_cols, k_dim, bm, clip_block(cfg.bn, qkv_cols), bk,
+        out_dtype)
+    mm_o = blocks.make_matmul_pipeline(
+        b, cn, h_loc * d, bm, clip_block(cfg.bn, cn),
+        clip_block(cfg.bk, h_loc * d), out_dtype)
+    mm_up = blocks.make_matmul_pipeline(
+        b, f_loc, k_dim, bm, clip_block(cfg.bf, f_loc), bk, out_dtype)
+    sw_pipe = blocks.make_swiglu_pipeline(b, f_loc, bm,
+                                          clip_block(cfg.bf, f_loc),
+                                          out_dtype)
+    mm_dn = blocks.make_matmul_pipeline(
+        b, cn, f_loc, bm, clip_block(cfg.bn, cn),
+        clip_block(cfg.bk, f_loc), out_dtype)
+    add_cn = blocks.make_add_pipeline(b, cn, bm, clip_block(cfg.bn, cn))
+    copy_out = blocks.make_copy_pipeline(b, k_dim, bm,
+                                         clip_block(cfg.bn, k_dim))
+    attn_stub = blocks._protocol_stub("attn_decode")
+
+    dl.collective_prologue(team, neighbors_only=True)
+
+    cur = x_ref
+    for l in range(layers):
+        nxt = xa if cur is not xa else xb
+        # --- attention side ------------------------------------------------
+        rms_pipe(cur, ln1_s.at[pl.ds(l, 1)], h_buf)
+        mm_qkv(h_buf, wqkv_s.at[l], qkv_hbm, scratches=[acc_qkv])
+        if attn_stub is not None:
+            attn_stub(qkv_hbm, pool_k, pool_v, attn_vm)
+        else:
+            _attn_cell_real(l, b, hk, g, d, ps, mp, pool_pages, theta,
+                            qk_eps, sm_scale, soft_cap, qkv_hbm, qn_s,
+                            kn_s, table_ref, lens_ref, pool_k, pool_v,
+                            attn_vm, qrow, qn_vm, kn_vm, ktok, vtok,
+                            kbuf, vbuf, stage_sems, pg_sems, tok_sems)
+        dl.local_copy(attn_vm, attn_hbm, stage_sems.at[0]).wait()
+
+        # --- o-proj + chained AllReduce ring (instance 2l) -----------------
+        _chained_ar(team, b, cn, mm_o, add_cn, attn_hbm,
+                    lambda c, l=l: wo_s.at[l].at[:, pl.ds(c * cn, cn)],
+                    red_buf, mm_buf, recv_buf, send_buf, send_sems,
+                    recv_sems, ack_sems, ag_send_sem, ag_recv_sems,
+                    acc_ar, armed=l > 0)
+        for c in range(n):     # residual, un-chunked in place
+            add_cn(cur.at[:, pl.ds(c * cn, cn)],
+                   red_buf.at[pl.ds(c * b, b)],
+                   nxt.at[:, pl.ds(c * cn, cn)])
+        cur = nxt
+        nxt = xa if cur is not xa else xb
+
+        # --- MLP + chained AllReduce ring (instance 2l+1) ------------------
+        rms_pipe(cur, ln2_s.at[pl.ds(l, 1)], h_buf)
+        mm_up(h_buf, gu_s.at[l].at[:, pl.ds(0, f_loc)], g_buf,
+              scratches=[acc_up])
+        mm_up(h_buf, gu_s.at[l].at[:, pl.ds(f_loc, f_loc)], u_buf,
+              scratches=[acc_up])
+        sw_pipe(g_buf, u_buf, act_buf)
+        _chained_ar(team, b, cn, mm_dn, add_cn, act_buf,
+                    lambda c, l=l: dn_s.at[l].at[:, pl.ds(c * cn, cn)],
+                    red_buf, mm_buf, recv_buf, send_buf, send_sems,
+                    recv_sems, ack_sems, ag_send_sem, ag_recv_sems,
+                    acc_ar, armed=True)
+        for c in range(n):
+            add_cn(cur.at[:, pl.ds(c * cn, cn)],
+                   red_buf.at[pl.ds(c * b, b)],
+                   nxt.at[:, pl.ds(c * cn, cn)])
+        cur = nxt
+
+    # the final instance's outstanding ACK credits (every earlier
+    # instance's were consumed by its successor's armed waits)
+    ring.rs_ack_drain(ack_sems, n)
+    copy_out(cur, x_out)
+
+
+# ---------------------------------------------------------------------------
+# builder + entry
+
+
+@functools.lru_cache(maxsize=None)
+def _build_persistent_decode(
+    mesh: Mesh,
+    axis: str,
+    layers: int,
+    b: int,
+    k_dim: int,
+    hk_loc: int,
+    g: int,
+    d: int,
+    pool_pages: int,
+    ps: int,
+    mp: int,
+    theta: float,
+    rms_eps: float,
+    qk_eps,
+    sm_scale: float,
+    soft_cap: float,
+    f_loc: int,
+    dtype: jnp.dtype,
+    pool_dtype: jnp.dtype,
+    cfg: PersistentDecodeConfig,
+):
+    team = Team.of(mesh, axis)
+    n = team.size
+    compilation.verify_protocol("persistent_decode", n)
+    h_loc = hk_loc * g
+    cn = k_dim // n
+    qkv_cols = (h_loc + 2 * hk_loc) * d
+    pool_rows = layers * pool_pages * hk_loc
+
+    from ..obs import costs
+
+    kernel = functools.partial(
+        _persistent_decode_kernel, team, layers, b, k_dim, hk_loc, g, d,
+        ps, mp, pool_pages, f_loc, theta, rms_eps, qk_eps, sm_scale,
+        soft_cap, cfg, dtype,
+    )
+    n_in = 11 + (2 if qk_eps is not None else 0) + 1  # + x
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),        # table
+        pl.BlockSpec(memory_space=pltpu.SMEM),        # lens
+    ] + [pl.BlockSpec(memory_space=pl.ANY)] * (n_in - 2)
+    out_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 3
+    out_shape = [
+        jax.ShapeDtypeStruct((b, k_dim), dtype),
+        jax.ShapeDtypeStruct((pool_rows, ps, d), pool_dtype),
+        jax.ShapeDtypeStruct((pool_rows, ps, d), pool_dtype),
+    ]
+    bm = clip_block(cfg.bm, b)
+    scratch = [
+        pltpu.HBM((b, k_dim), dtype),                 # xa
+        pltpu.HBM((b, k_dim), dtype),                 # xb
+        pltpu.HBM((b, k_dim), dtype),                 # h_buf
+        pltpu.HBM((b, qkv_cols), dtype),              # qkv_hbm
+        pltpu.VMEM((b, h_loc * d), dtype),            # attn_vm
+        pltpu.HBM((b, h_loc * d), dtype),             # attn_hbm
+        pltpu.HBM((b, f_loc), dtype),                 # g_buf
+        pltpu.HBM((b, f_loc), dtype),                 # u_buf
+        pltpu.HBM((b, f_loc), dtype),                 # act_buf
+        pltpu.HBM((n * b, cn), dtype),                # red_buf
+        pltpu.HBM((2, b, cn), dtype),                 # mm_buf
+        pltpu.HBM((2, b, cn), dtype),                 # recv_buf
+        pltpu.HBM((2, b, cn), dtype),                 # send_buf
+        pltpu.VMEM((1, qkv_cols), dtype),             # qrow
+        pltpu.VMEM((1, d), dtype),                    # qn_vm
+        pltpu.VMEM((1, d), dtype),                    # kn_vm
+        pltpu.VMEM((1, d), pool_dtype),               # ktok
+        pltpu.VMEM((1, d), pool_dtype),               # vtok
+        pltpu.VMEM((2, ps, d), pool_dtype),           # kbuf
+        pltpu.VMEM((2, ps, d), pool_dtype),           # vbuf
+        pltpu.SemaphoreType.DMA((2,)),                # stage_sems
+        pltpu.SemaphoreType.DMA((2, 2)),              # pg_sems
+        pltpu.SemaphoreType.DMA((2,)),                # tok_sems
+        pltpu.SemaphoreType.DMA((2,)),                # send_sems
+        pltpu.SemaphoreType.DMA((2,)),                # recv_sems
+        pltpu.SemaphoreType.REGULAR((2,)),            # ack_sems
+        pltpu.SemaphoreType.DMA(()),                  # ag_send_sem
+        pltpu.SemaphoreType.DMA((n,)),                # ag_recv_sems
+        pltpu.VMEM((bm, clip_block(cfg.bn, qkv_cols)), jnp.float32),
+        pltpu.VMEM((bm, clip_block(cfg.bn, cn)), jnp.float32),
+        pltpu.VMEM((bm, clip_block(cfg.bf, f_loc)), jnp.float32),
+    ]
+    call = pl.pallas_call(
+        kernel,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        # the stacked pools travel in place: each layer's token append
+        # touches one (1, d) slot of the aliased buffers — no per-step
+        # pool rebuild ever materializes on this path
+        input_output_aliases={n_in - 2: 1, n_in - 1: 2},
+        scratch_shapes=scratch,
+        cost_estimate=costs.pallas_cost(
+            costs.persistent_decode(layers, b, k_dim, h_loc, hk_loc,
+                                    mp * ps, d, f_loc, n, pool_dtype)),
+        compiler_params=compilation.compiler_params(
+            collective=True,
+            collective_id=compilation.collective_id("persistent_decode"),
+            vmem_limit_bytes=cfg.vmem_limit,
+        ),
+        interpret=compilation.interpret_mode(),
+    )
+
+    has_qk = qk_eps is not None
+
+    def local(table, lens, x, ln1, wqkv, *rest):
+        if has_qk:
+            qn, kn, wo, ln2, gu, dn, pk, pv = rest
+        else:
+            wo, ln2, gu, dn, pk, pv = rest
+        args = [table.astype(jnp.int32).reshape(b * mp),
+                lens.astype(jnp.int32), x, ln1, wqkv]
+        if has_qk:
+            args += [qn, kn]
+        args += [wo, ln2, gu, dn,
+                 pk.reshape(pool_rows, ps, d),
+                 pv.reshape(pool_rows, ps, d)]
+        xo, pk2, pv2 = call(*args)
+        shape5 = (layers, pool_pages, hk_loc, ps, d)
+        return xo, pk2.reshape(shape5), pv2.reshape(shape5)
+
+    in_p = [P(None, None), P(None), P(None, None), P(None, None),
+            P(None, None, axis)]
+    if has_qk:
+        in_p += [P(None, None), P(None, None)]
+    in_p += [P(None, axis, None), P(None, None),
+             P(None, None, axis), P(None, axis, None),
+             P(None, None, axis, None, None),
+             P(None, None, axis, None, None)]
+    pool_p = P(None, None, axis, None, None)
+    return compilation.jit_shard_map(
+        local, mesh, in_specs=tuple(in_p),
+        out_specs=(P(None, None), pool_p, pool_p),
+    )
+
+
+def _heads_from_qkv_global(qkv: jax.Array, b: int, n: int, h: int,
+                           hk: int, d: int):
+    """Split a (B, (H+2Hk)*D) qkv row whose columns are rank-blocked
+    ``[q_r | k_r | v_r]`` into rank-major global-head (B, H, D) /
+    (B, Hk, D) / (B, Hk, D) — the decode-step (S=1) form of
+    ``Qwen3._heads_from_qkv``."""
+    hl, hkl = h // n, hk // n
+    t = qkv.reshape(b, n, (hl + 2 * hkl) * d)
+    q = t[..., :hl * d].reshape(b, n * hl, d)
+    k = t[..., hl * d:(hl + hkl) * d].reshape(b, n * hkl, d)
+    v = t[..., (hl + hkl) * d:].reshape(b, n * hkl, d)
+    return q, k, v
+
+
+def reference_decode_step(
+    x: jax.Array,
+    sp: StackedDecodeParams,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_table: jax.Array,
+    seq_lens: jax.Array,
+    n: int,
+    *,
+    rope_theta: float,
+    rms_eps: float,
+    qk_eps: float | None = None,
+    sm_scale: float | None = None,
+    soft_cap: float = 0.0,
+):
+    """Pure-XLA golden of one persistent decode step (all L layers, the
+    hidden state returned pre-final-norm): the parity reference, the
+    ``n == 1`` degenerate path, and the resilience ladder's degraded
+    fallback (``resilience.fallbacks.xla_persistent_decode``).  ``n`` is
+    the TP width the rank-blocked weight layouts were built for.
+    Returns ``(x_out, pool_k, pool_v)`` with the token appended at each
+    sequence's position."""
+    from ..layers.norm import rms_norm
+
+    layers, pages, hk, ps, d = pool_k.shape
+    b, k_dim = x.shape
+    qkv_cols = sp.wqkv.shape[2]
+    h = qkv_cols // d - 2 * hk
+    f_dim = sp.down.shape[1]
+    mp = block_table.shape[1]
+    max_len = mp * ps
+    sm = float(sm_scale) if sm_scale is not None else d ** -0.5
+    lens = seq_lens.astype(jnp.int32)
+    rep = h // hk
+
+    for l in range(layers):
+        hN = rms_norm(x, sp.ln1[l], rms_eps)
+        qkv = jnp.dot(hN, sp.wqkv[l],
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+        q, k, v = _heads_from_qkv_global(qkv, b, n, h, hk, d)
+        if qk_eps is not None:
+            q = rms_norm(q, sp.q_norm[l], qk_eps)
+            k = rms_norm(k, sp.k_norm[l], qk_eps)
+        pos = lens[:, None, None]
+        q = apply_rope_at(q[:, :, None, :], pos, theta=rope_theta)[:, :, 0]
+        k = apply_rope_at(k[:, :, None, :], pos, theta=rope_theta)[:, :, 0]
+        # ragged append into layer l's pool
+        pages_b = jnp.take_along_axis(
+            block_table, (lens // ps)[:, None], axis=1)[:, 0]
+        offs = lens % ps
+        pool_k = pool_k.at[l, pages_b, :, offs].set(
+            k.astype(pool_k.dtype))
+        pool_v = pool_v.at[l, pages_b, :, offs].set(
+            v.astype(pool_v.dtype))
+        # attend over [0, pos] through the block table (token included)
+        kc = pool_k[l][block_table]          # (B, mp, Hk, ps, D)
+        vc = pool_v[l][block_table]
+        kc = kc.transpose(0, 2, 1, 3, 4).reshape(b, hk, max_len, d)
+        vc = vc.transpose(0, 2, 1, 3, 4).reshape(b, hk, max_len, d)
+        kc = jnp.repeat(kc, rep, axis=1).astype(jnp.float32)
+        vc = jnp.repeat(vc, rep, axis=1).astype(jnp.float32)
+        scores = jnp.einsum("bhd,bhkd->bhk", q.astype(jnp.float32),
+                            kc) * sm
+        if soft_cap > 0.0:
+            scores = soft_cap * jnp.tanh(scores / soft_cap)
+        mask = jnp.arange(max_len, dtype=jnp.int32)[None, :] <= \
+            lens[:, None]
+        scores = jnp.where(mask[:, None, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhk,bhkd->bhd", probs, vc).astype(x.dtype)
+        x = x + jnp.dot(attn.reshape(b, h * d), sp.wo[l],
+                        preferred_element_type=jnp.float32).astype(x.dtype)
+        # dense MLP, rank-blocked [gate_r | up_r] feature layout
+        h2 = rms_norm(x, sp.ln2[l], rms_eps)
+        fused = jnp.dot(h2, sp.gate_up[l],
+                        preferred_element_type=jnp.float32).astype(x.dtype)
+        t = fused.reshape(b, n, 2, f_dim // n)
+        act = (jax.nn.silu(t[..., 0, :].astype(jnp.float32))
+               * t[..., 1, :].astype(jnp.float32)).astype(x.dtype)
+        x = x + jnp.dot(act.reshape(b, f_dim), sp.down[l],
+                        preferred_element_type=jnp.float32).astype(x.dtype)
+    return x, pool_k, pool_v
+
+
+def persistent_decode_step(
+    x: jax.Array,
+    sp: StackedDecodeParams,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_table: jax.Array,
+    seq_lens: jax.Array,
+    mesh: Mesh,
+    axis: str = TP_AXIS,
+    *,
+    rope_theta: float = 10_000.0,
+    rms_eps: float = 1e-6,
+    qk_eps: float | None = None,
+    sm_scale: float | None = None,
+    soft_cap: float = 0.0,
+    config: PersistentDecodeConfig | None = None,
+):
+    """ONE decode step through ALL L layers as a single persistent
+    collective kernel (module docstring).  ``x``: (B, K) embedded
+    tokens; ``sp``: stacked layer weights; ``pool_k``/``pool_v``:
+    (L, P, Hk, ps, D) full-precision page pools (aliased in place);
+    ``block_table``: (B, max_pages); ``seq_lens``: (B,).  Returns
+    ``(x_out, pool_k, pool_v)`` with ``x_out`` the post-layer-stack
+    hidden state (final norm + lm_head stay in the step bundle — see
+    :func:`decode_bundle`) and the token appended at each sequence's
+    position.  ``n == 1`` runs :func:`reference_decode_step` (no
+    collective exists to fuse)."""
+    n = mesh.shape[axis]
+    layers, pages, hk, ps, d = pool_k.shape
+    b, k_dim = x.shape
+    if pool_v.shape != pool_k.shape:
+        raise ValueError(
+            f"pool shape mismatch: {pool_k.shape} vs {pool_v.shape}")
+    if jnp.dtype(pool_k.dtype) == jnp.int8:
+        raise NotImplementedError(
+            "persistent decode needs full-precision pools (the in-kernel "
+            "append cannot re-encode a page's int8 scale); int8-KV "
+            "deployments keep decode_mode='fused'")
+    qkv_cols = sp.wqkv.shape[2]
+    h = qkv_cols // d - 2 * hk
+    f_dim = sp.down.shape[1]
+    mp = block_table.shape[1]
+    if block_table.shape[0] != b or seq_lens.shape != (b,):
+        raise ValueError(
+            f"block_table {block_table.shape} / seq_lens {seq_lens.shape} "
+            f"inconsistent with B={b}")
+    if h < 1 or h % hk:
+        raise ValueError(
+            f"wqkv {sp.wqkv.shape} does not hold [q|k|v] for {hk} kv "
+            f"heads at head_dim {d}")
+    sm = float(sm_scale) if sm_scale is not None else d ** -0.5
+    eps = None if qk_eps is None else float(qk_eps)
+    if n == 1:
+        return reference_decode_step(
+            x, sp, pool_k, pool_v, block_table, seq_lens, 1,
+            rope_theta=rope_theta, rms_eps=rms_eps, qk_eps=eps,
+            sm_scale=sm, soft_cap=soft_cap)
+    if k_dim % n or f_dim % n or hk % n or h % n:
+        raise ValueError(
+            f"hidden={k_dim}, intermediate={f_dim}, heads={h}, "
+            f"kv_heads={hk} must all divide by {axis}={n}")
+
+    from ..tune import autotuner as _tune
+
+    if config is None:
+        def thunk(c):
+            return lambda: persistent_decode_step(
+                x, sp, pool_k, pool_v, block_table, seq_lens, mesh, axis,
+                rope_theta=rope_theta, rms_eps=rms_eps, qk_eps=qk_eps,
+                sm_scale=sm, soft_cap=soft_cap, config=c)
+
+        config = _tune.resolve_config(
+            "persistent_decode",
+            persistent_config_key(layers, b, k_dim, f_dim, hk, ps, mp, d,
+                                  n, x.dtype),
+            persistent_decode_candidates(b, f_dim // n, k_dim // n),
+            PersistentDecodeConfig(),
+            thunk,
+            tracing=any(map(_tune.is_tracer, (x, pool_k, seq_lens))),
+        )
+    cfg = config
+
+    def run():
+        fn = _build_persistent_decode(
+            mesh, axis, layers, b, k_dim, hk // n, (h // n) // (hk // n),
+            d, pages, ps, mp, float(rope_theta), float(rms_eps), eps, sm,
+            float(soft_cap), f_dim // n, jnp.dtype(x.dtype),
+            jnp.dtype(pool_k.dtype), cfg,
+        )
+        args = [block_table, seq_lens, x, sp.ln1, sp.wqkv]
+        if eps is not None:
+            args += [sp.q_norm, sp.k_norm]
+        args += [sp.wo, sp.ln2, sp.gate_up, sp.down, pool_k, pool_v]
+        return fn(*args)
+
+    from .. import resilience
+
+    eager = not _tune.is_tracer(x)
+    if eager and resilience.enabled():
+        itemsize = jnp.dtype(x.dtype).itemsize
+        return resilience.guarded(
+            "persistent_decode", run,
+            family="persistent_decode", ranks=n,
+            # 2L chained reductions, each wiring a (B, K) payload
+            payload_bytes=2 * layers * b * k_dim * itemsize,
+            fallback=lambda: resilience.fallbacks.xla_persistent_decode(
+                x, sp, pool_k, pool_v, block_table, seq_lens, mesh, axis,
+                rope_theta=rope_theta, rms_eps=rms_eps, qk_eps=eps,
+                sm_scale=sm, soft_cap=soft_cap),
+        )()
+    return run()
+
+
+def persistent_config_key(layers: int, b: int, k_dim: int, f_dim: int,
+                          hk: int, ps: int, mp: int, d: int, n: int,
+                          dtype) -> tuple:
+    """The ONE autotuner cache key of the persistent kernel — shared by
+    the transparent ``config=None`` resolve,
+    ``tune.fresh_tune_persistent_decode``, and the
+    ``serve.EngineBackend`` construction-time hoist, so a bench/warmup
+    crown reaches the serving path without any per-dispatch consult."""
+    from ..core import platform
+
+    return (layers, b, k_dim, f_dim, hk, ps, mp, d, n, str(dtype),
+            platform.device_kind())
+
+
+# ---------------------------------------------------------------------------
+# the step bundle: N decode steps per dispatch
+
+
+def decode_bundle(step, cache_state, tokens: jax.Array, steps: int):
+    """Run ``steps`` greedy decode steps inside ONE traced dispatch.
+
+    ``step(cache_state, tokens) -> (logits, cache_state)`` is one decode
+    step (the persistent megakernel step, or any ``Qwen3.decode``-shaped
+    chain); the bundle scans it with the argmax token fed back on
+    device, so the host sees a single dispatch per N tokens.  Returns
+    ``(tokens (steps, B), cache_state)``.  ``lax.scan`` (not a Python
+    loop) keeps the traced body ONE copy of the step — the static
+    dispatch counter (:func:`count_bundle_dispatches`) charges the
+    bundle the step's own launches plus nothing: the scan harness adds
+    zero dispatch-shaped equations."""
+    def body(carry, _):
+        cache, tok = carry
+        logits, cache = step(cache, tok)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache, nxt), nxt
+
+    (cache_state, _), toks = jax.lax.scan(
+        body, (cache_state, tokens.astype(jnp.int32)), None,
+        length=int(steps))
+    return toks, cache_state
+
+
+def count_bundle_dispatches(model, params, cache, tokens,
+                            steps: int) -> int:
+    """Static dispatch count of one ``model.decode_multi`` step bundle
+    (the metric ``bench.py decode`` records as
+    ``decode_dispatches_per_bundle``): scan bodies count ONCE, so this
+    is dispatches per *bundle*, the number the persistent kernel exists
+    to pin at <= 2 (megakernel + lm_head)."""
+    from .fused_decode import count_jaxpr_dispatches
+
+    return count_jaxpr_dispatches(
+        lambda p, c, t: model.decode_multi(p, c, t, steps),
+        params, cache, tokens)

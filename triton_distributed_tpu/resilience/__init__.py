@@ -59,6 +59,7 @@ from .matrix import (
     run_hier_cells,
     run_integrity_cells,
     run_matrix,
+    run_persistent_cells,
     run_quant_cells,
     run_scheduler_matrix,
     verify_handoff_matrix,
@@ -91,7 +92,8 @@ __all__ = [
     "protocol_pending",
     "record_faulty_case", "reset_breaker", "resilient_call", "run_bounded",
     "run_handoff_matrix", "run_hier_cells", "run_integrity_cells",
-    "run_matrix", "run_quant_cells", "run_scheduler_matrix",
+    "run_matrix", "run_persistent_cells", "run_quant_cells",
+    "run_scheduler_matrix",
     "sample_spec", "scoped",
     "simulate", "suppress", "suppressed_thunk", "verify_handoff_matrix",
     "verify_matrix", "verify_scheduler_matrix", "watchdog",

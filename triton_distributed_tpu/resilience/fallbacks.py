@@ -242,3 +242,24 @@ def xla_ep_combine(y: jax.Array, splits: jax.Array, mesh, axis: str, *,
     zone = r_of * n + jnp.arange(n)[:, None]                 # (n, t)
     idx = (zone * z + within).reshape(-1)
     return jnp.take(y.reshape(nz * z, h), idx, axis=0).astype(y.dtype)
+
+
+# ---------------------------------------------------------------------------
+# persistent multi-layer decode loop (ISSUE 13)
+
+
+def xla_persistent_decode(x, sp, pool_k, pool_v, block_table, seq_lens,
+                          mesh, axis: str, *, rope_theta: float,
+                          rms_eps: float, qk_eps=None, sm_scale=None,
+                          soft_cap: float = 0.0):
+    """Degraded ``ops.persistent_decode.persistent_decode_step``: the
+    pure-XLA layer loop (local GEMMs + materialized block-table
+    attention + GSPMD reductions) — no Pallas kernel, no semaphore, the
+    code path a stuck link cannot reach.  Same function doubles as the
+    parity golden (``reference_decode_step``)."""
+    from ..ops.persistent_decode import reference_decode_step
+
+    return reference_decode_step(
+        x, sp, pool_k, pool_v, block_table, seq_lens, mesh.shape[axis],
+        rope_theta=rope_theta, rms_eps=rms_eps, qk_eps=qk_eps,
+        sm_scale=sm_scale, soft_cap=soft_cap)

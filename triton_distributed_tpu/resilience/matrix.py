@@ -66,12 +66,30 @@ DEFAULT_KERNELS = (
     # `tdt_lint --hier` (run_hier_cells)
     "hier_allreduce/2x2",
     "hier_a2a/2x2",
+    # the persistent multi-layer decode chain (ISSUE 13): 2L ring
+    # reductions on ONE re-armed semaphore set — a dropped credit
+    # anywhere in the chain must name the inter-layer semaphore
+    "persistent_decode/chain",
 )
 
 # the `tdt_lint --quant` slice of the kernel axis
 QUANT_KERNELS = ("quant_allgather/push_1shot",
                  "quant_allgather/ring_bidir",
                  "quant_exchange/oneshot")
+
+# the `tdt_lint --persistent` slice: the chained family alone, every
+# fault class (verify with min_kernels_per_class=1 — one kernel case
+# carries the whole chain)
+PERSISTENT_KERNELS = ("persistent_decode/chain",)
+
+
+def run_persistent_cells(seed: int = 0, *, ranks: int = 4) -> list[dict]:
+    """The ``tdt_lint --persistent`` fault slice: every fault class
+    against the chained multi-layer decode case.  A detection anywhere
+    mid-chain must name a semaphore of the SHARED re-armed set (the
+    inter-layer dependency edge); verify with
+    ``verify_matrix(rows, min_kernels_per_class=1)``."""
+    return run_matrix(seed=seed, kernels=PERSISTENT_KERNELS, ranks=ranks)
 
 # the `tdt_lint --hier` slice: every two-level family, with the
 # inter-slice (DCN) protocol model in the loop — the dropped-inter-slice-

@@ -89,7 +89,11 @@ def _estimate_ms(op: str, payload_bytes: int, num_ranks: int,
         return perf_model.allgather_sol_ms(b, n)
     if op in ("reduce_scatter", "gemm_rs"):
         return perf_model.reduce_scatter_sol_ms(b, n)
-    if op in ("all_reduce", "gemm_ar", "fused_mlp_ar", "fused_linear_ar"):
+    if op in ("all_reduce", "gemm_ar", "fused_mlp_ar", "fused_linear_ar",
+              "persistent_decode"):
+        # persistent_decode's caller passes payload_bytes already summed
+        # over its 2L chained reductions, so the two-shot model prices
+        # the whole in-kernel chain
         # the decode megakernel reductions wire 2(n-1)/n of the payload
         # like any two-shot AllReduce; the chained GEMM/SwiGLU time is
         # bounded by the same payload heuristic under the slack

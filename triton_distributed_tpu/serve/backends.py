@@ -89,7 +89,8 @@ class SimBackend:
     def __init__(self, *, slots: int = 4, page_size: int = 4,
                  pool_pages: int = 32, max_length: int = 64,
                  num_layers: int = 1, kv_heads: int = 1, head_dim: int = 8,
-                 vocab: int = 101, step_hook=None, kv_dtype=None):
+                 vocab: int = 101, step_hook=None, kv_dtype=None,
+                 steps_per_dispatch: int = 1):
         from ..core import mesh as mesh_lib
         from ..core.mesh import TP_AXIS, make_mesh
 
@@ -107,6 +108,11 @@ class SimBackend:
         # sidecars), headlessly; tests materialize pages via
         # kv_cache.layer_pool and still see the token history
         self.kv_dtype = kv_dtype
+        # steps_per_dispatch: the multi-step window knob the scheduler
+        # reads (docs/serving.md) — the automaton's decode_multi loops
+        # its one-step rule, calling step_hook per INNER step so fault
+        # cells can land mid-window
+        self.steps_per_dispatch = max(int(steps_per_dispatch), 1)
         self._mesh = make_mesh({TP_AXIS: 1}, devices=jax.devices()[:1])
         self._step = 0
         del mesh_lib
@@ -173,6 +179,20 @@ class SimBackend:
         )
         return cache, nxt
 
+    def decode_multi(self, cache: PagedKVCache, tokens, steps: int):
+        """``steps`` decode steps as ONE dispatch (the scheduler's
+        membership-stable window): functional like :meth:`decode` — the
+        caller's cache is untouched until the whole window returns, so a
+        fault at any inner step discards the window (the non-donated
+        isolation contract).  Returns ``(cache, (steps, slots) tokens)``.
+        """
+        toks = []
+        tok = np.asarray(tokens, np.int32)
+        for _ in range(int(steps)):
+            cache, tok = self.decode(cache, tok)
+            toks.append(tok)
+        return cache, np.stack(toks)
+
 
 class EngineBackend:
     """The real-model backend: stateless jitted step functions from the
@@ -197,7 +217,7 @@ class EngineBackend:
     """
 
     def __init__(self, engine, *, pool_pages: int | None = None,
-                 chunk_tokens: int = 64):
+                 chunk_tokens: int = 64, steps_per_dispatch: int = 1):
         if engine.cache_layout != "paged":
             raise ValueError(
                 "EngineBackend needs cache_layout='paged'; this engine "
@@ -218,16 +238,52 @@ class EngineBackend:
         mp = self.max_length // self.page_size
         self.pool_pages = int(pool_pages) if pool_pages is not None \
             else self.slots * mp + 1
+        # steps_per_dispatch (ISSUE 13, docs/serving.md): the scheduler
+        # batches membership-STABLE windows of up to this many decode
+        # steps into one dispatch of `decode_multi` — the whole window
+        # (argmax feedback included) runs on device under one launch,
+        # trading per-token host turnarounds against membership
+        # staleness of at most steps_per_dispatch - 1 steps
+        self.steps_per_dispatch = max(int(steps_per_dispatch), 1)
+        # autotuner-hoist (ISSUE 13 satellite): resolve the persistent
+        # kernel's tile config ONCE here — the shape key is constant
+        # across membership windows (membership edits change VALUES,
+        # never shapes), so the hot loop never consults the winner
+        # cache; a bench/warmup crown planted before construction (or
+        # `tune.fresh_tune_persistent_decode`) is picked up here
+        self._persistent_cfg = self._resolve_persistent_config()
+        # persistent mode: stack the per-layer weights ONCE here and
+        # thread the stack as a jit ARGUMENT — stacking inside the
+        # traced bundle would re-materialize the full weight set on
+        # every dispatch (a whole-model HBM copy per token window).
+        # Weights are immutable for the backend's lifetime; rebuild the
+        # backend after a weight swap, like the step executables.
+        self._stacked = None
+        if getattr(self.model, "decode_mode", None) == "persistent":
+            from ..models.qwen import stack_decode_params
+
+            self._stacked = stack_decode_params(engine.params)
         # stateless, NON-donated step executables (models/engine.py
         # refactor): values of table/lens/tokens change per step, shapes
         # never do — one trace each for the scheduler's whole lifetime
         self._decode = jax.jit(self.model.decode)
         self._prefill_chunk = jax.jit(self.model.prefill_chunk)
+        # one multi-step executable per steps bucket (steps is static);
+        # decode_multi fills this lazily, precompile_decode eagerly
+        cfg_hoisted = self._persistent_cfg
+        self._decode_multi = jax.jit(
+            lambda p, sp, c, t, s: self.model.decode_multi(
+                p, c, t, s, persistent_config=cfg_hoisted, stacked=sp),
+            static_argnums=(4,))
+        # AOT bucket set (precompile_decode / load_precompiled_decode):
+        # {steps: Compiled} — serving never retraces mid-traffic
+        self._decode_exec: dict[int, object] = {}
 
     @property
     def decode_mode(self) -> str:
         """The decode kernel chain this backend's step executes
-        (``"psum"`` | ``"ar"`` | ``"gemm_ar"`` | ``"fused"``)."""
+        (``"psum"`` | ``"ar"`` | ``"gemm_ar"`` | ``"fused"`` |
+        ``"persistent"``)."""
         return self.model.decode_mode
 
     def make_cache(self) -> PagedKVCache:
@@ -261,6 +317,157 @@ class EngineBackend:
         return cache, first
 
     def decode(self, cache: PagedKVCache, tokens):
+        if self._stacked is not None:
+            # persistent mode: a single step is a steps=1 bundle, so it
+            # rides the hoisted weight stack (re-stacking inside the
+            # jitted Qwen3.decode would re-materialize the full weight
+            # set per dispatch) and the same argmax-greedy semantics
+            cache, toks = self.decode_multi(cache, tokens, 1)
+            return cache, toks[0]
         tok = jnp.asarray(np.asarray(tokens, np.int32))
         logits, cache = self._decode(self.engine.params, cache, tok)
         return cache, np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+
+    def decode_multi(self, cache: PagedKVCache, tokens, steps: int):
+        """``steps`` greedy decode steps in ONE dispatch
+        (``Qwen3.decode_multi``): the scheduler's membership-stable
+        window.  Non-donated like :meth:`decode` — a fault anywhere in
+        the window leaves the pre-window cache intact.  Returns
+        ``(cache, (steps, slots) tokens)``; prefers an AOT bucket
+        executable (:meth:`precompile_decode`) when one matches."""
+        steps = int(steps)
+        tok = jnp.asarray(np.asarray(tokens, np.int32))
+        ex = self._decode_exec.get(steps)
+        if ex is not None:
+            toks, cache = self.engine._call_exec(
+                ex, self.engine.params, self._stacked, cache, tok)
+        else:
+            toks, cache = self._decode_multi(
+                self.engine.params, self._stacked, cache, tok, steps)
+        return cache, np.asarray(toks, np.int32)
+
+    def _resolve_persistent_config(self):
+        """The ISSUE-13 autotuner hoist: the persistent kernel's tile
+        config, resolved ONCE at backend construction from the winner
+        cache (shape key is membership-invariant) and threaded
+        explicitly through every ``decode_multi`` trace — no winner-
+        cache consult ever runs inside the serving hot loop.  None for
+        non-persistent modes and degenerate meshes."""
+        if getattr(self.model, "decode_mode", None) != "persistent":
+            return None
+        n = self.model.tp
+        if n < 2:
+            return None   # the n==1 path is the pure-XLA reference
+        from ..ops import persistent_decode as pd
+        from ..tune import autotuner as tune
+
+        c = self.model.config
+        key = pd.persistent_config_key(
+            c.num_layers, self.slots, c.hidden, c.intermediate,
+            c.num_kv_heads, self.page_size,
+            self.max_length // self.page_size, c.head_dim, n,
+            jnp.dtype(c.dtype))
+        # tracing=True == pure cache consult: a cached crown (bench
+        # warmup, fresh_tune_persistent_decode) is adopted, otherwise
+        # the default — never a measurement at construction time
+        return tune.resolve_config(
+            "persistent_decode", key,
+            pd.persistent_decode_candidates(
+                self.slots, c.intermediate // n, c.hidden // n),
+            pd.PersistentDecodeConfig(),
+            lambda cfg: (lambda: None),
+            tracing=True,
+        )
+
+    # -- AOT bucket set (ISSUE 13 satellite) ------------------------------
+
+    _MANIFEST = "aot_decode_manifest.json"
+
+    def precompile_decode(self, steps_buckets=(),
+                          save_dir: str | None = None) -> dict:
+        """AOT-compile the serving decode grid — (batch = the backend's
+        slot count) x (every steps bucket, ``steps_per_dispatch`` and 1
+        always included) — so serving never retraces mid-traffic; the
+        manifest rides the PR-2 ``arch``-fingerprinted path
+        (``models.engine.arch_fingerprint`` / ``check_arch``), so a
+        bundle compiled for a different model, mesh, pool geometry or
+        decode mode fails loudly at load."""
+        import json
+        import os
+
+        from ..core import platform
+        from ..models.engine import arch_fingerprint
+        from ..tools import aot
+
+        buckets = sorted({1, self.steps_per_dispatch}
+                         | {int(s) for s in steps_buckets})
+        if buckets[0] < 1:
+            raise ValueError(f"steps buckets must be >= 1; got {buckets}")
+        cache0 = self.make_cache()
+        tok = jnp.zeros((self.slots,), jnp.int32)
+        for s in buckets:
+            self._decode_exec[s] = self._decode_multi.lower(
+                self.engine.params, self._stacked, cache0, tok,
+                s).compile()
+        manifest = {
+            "steps_buckets": buckets,
+            "batch": self.slots,
+            "page_size": self.page_size,
+            "pool_pages": self.pool_pages,
+            "chunk_tokens": self.chunk_tokens,
+            "decode_mode": self.model.decode_mode,
+            "kv_dtype": getattr(self.engine, "kv_dtype", None),
+            "arch": arch_fingerprint(self.model.config, self.model.mesh,
+                                     self.model.axis),
+        }
+        if save_dir is not None:
+            if platform.on_cpu():
+                # same contract as Engine.precompile, probed via the
+                # platform (interpret_mode() needs InterpretParams,
+                # absent on older jax builds): interpret kernels embed
+                # python callbacks XLA cannot serialize
+                raise RuntimeError(
+                    "serializing AOT bundles requires real-TPU lowering "
+                    "(interpret kernels embed python callbacks XLA "
+                    "cannot serialize)")
+            os.makedirs(save_dir, exist_ok=True)
+            for s, ex in self._decode_exec.items():
+                aot.save(ex, os.path.join(save_dir, f"decode_multi_{s}.xla"))
+            with open(os.path.join(save_dir, self._MANIFEST), "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+        return manifest
+
+    def load_precompiled_decode(self, save_dir: str) -> dict:
+        """Restore :meth:`precompile_decode`'s executables in another
+        process: after this, every windowed decode dispatch within the
+        bucket set runs with zero tracing."""
+        import json
+        import os
+
+        from ..models.engine import arch_fingerprint, check_arch
+        from ..tools import aot
+
+        with open(os.path.join(save_dir, self._MANIFEST)) as f:
+            manifest = json.load(f)
+        mine = {
+            "batch": self.slots,
+            "page_size": self.page_size,
+            "pool_pages": self.pool_pages,
+            "chunk_tokens": self.chunk_tokens,
+            "decode_mode": self.model.decode_mode,
+            "kv_dtype": getattr(self.engine, "kv_dtype", None),
+        }
+        for field, have in mine.items():
+            want = manifest.get(field)
+            if want != have:
+                raise ValueError(
+                    f"AOT decode bundle was compiled for {field}="
+                    f"{want!r}; this backend has {field}={have!r}")
+        check_arch(manifest,
+                   arch_fingerprint(self.model.config, self.model.mesh,
+                                    self.model.axis))
+        self._decode_exec = {
+            int(s): aot.load(os.path.join(save_dir, f"decode_multi_{s}.xla"))
+            for s in manifest["steps_buckets"]
+        }
+        return manifest

@@ -158,6 +158,7 @@ class Scheduler:
         self.shed: list[Request] = []
         self.preemptions = 0
         self.evicted_pages = 0
+        self.decode_windows = 0
         self._consec_step_failures = 0
         self._saturated_since: float | None = None
         # TDT_INTEGRITY=1 KV-pool audit findings (req_id, page, step)
@@ -378,9 +379,31 @@ class Scheduler:
                 if s is not None
                 and s.request.state is RequestState.DECODE]
 
+    def _window_steps(self, active: list[int]) -> int:
+        """The membership-STABLE decode window (ISSUE 13,
+        docs/serving.md "steps_per_dispatch"): how many steps the next
+        dispatch may run with membership edits applied only BETWEEN
+        dispatches.  Bounded by the backend's ``steps_per_dispatch``
+        knob, by the steps until any member finishes (membership would
+        change), and by the steps until any member outgrows its mapped
+        pages (growth/preemption run between windows, so a window can
+        neither leak a page nor preempt mid-flight)."""
+        w = getattr(self.backend, "steps_per_dispatch", 1)
+        if w <= 1:
+            return 1
+        ps = self.pool.page_size
+        for i in active:
+            slot = self.slots[i]
+            w = min(w,
+                    slot.request.max_new_tokens - len(slot.request.tokens),
+                    len(slot.pages) * ps - slot.length)
+        return max(int(w), 1)
+
     def _decode_work(self, now: float) -> int:
-        """One batched decode step; returns the number of sequences
-        decoded (terminal outcomes are counted by the caller's deltas)."""
+        """One batched decode dispatch — a membership-stable window of
+        ``_window_steps`` steps (1 without the knob); returns the number
+        of (sequence, step) decodes (terminal outcomes are counted by
+        the caller's deltas)."""
         self._grow_pages()
         active = self._active_decode()
         if not active:
@@ -389,14 +412,18 @@ class Scheduler:
         tokens = np.zeros((len(self.slots),), np.int32)
         for i in active:
             tokens[i] = self.slots[i].next_token
+        window = self._window_steps(active)
 
         from .. import resilience
 
         try:
-            new_cache, nxt = self._dispatch(tokens, active, now)
+            new_cache, toks = self._dispatch(tokens, active, now, window)
         except Exception as e:
             # fresh clock: the breach typically happened DURING the
-            # dispatch, after the step-start timestamp
+            # dispatch, after the step-start timestamp.  The whole
+            # window is discarded — the non-donated step left the
+            # pre-window cache intact, so cohabitants retry and a
+            # preempted victim re-queues cleanly from its prompt
             self._isolate_step_failure(e, active, time.monotonic())
             return 0
         self._consec_step_failures = 0
@@ -407,19 +434,24 @@ class Scheduler:
         resilience.breaker(self.governor.breaker_op).record_success()
         self.cache = new_cache
 
+        for s in range(window):
+            for i in active:
+                slot = self.slots[i]
+                req = slot.request
+                slot.length += 1
+                tok = int(toks[s][i])
+                req.tokens.append(tok)
+                slot.next_token = tok
         for i in active:
-            slot = self.slots[i]
-            req = slot.request
-            slot.length += 1
-            tok = int(nxt[i])
-            req.tokens.append(tok)
-            slot.next_token = tok
+            req = self.slots[i].request
             if len(req.tokens) >= req.max_new_tokens:
                 self._finish_slot(i)
         if obs.enabled():
-            obs.serve_stats.STATS.tokens.add(float(len(active)))
-            obs.counter("serve_decode_steps").inc()
-        return len(active)
+            obs.serve_stats.STATS.tokens.add(float(len(active) * window))
+            obs.counter("serve_decode_steps").inc(window)
+            obs.counter("serve_decode_windows").inc()
+        self.decode_windows += 1
+        return len(active) * window
 
     def _grow_pages(self) -> int:
         """Allocate the next page for every sequence whose write
@@ -470,23 +502,38 @@ class Scheduler:
         return best
 
     def _dispatch(self, tokens: np.ndarray, active: list[int],
-                  now: float):
+                  now: float, window: int = 1):
         """The bounded decode dispatch: per-request deadlines ride the
         PR-3 watchdog (``resilience.call_with_deadline``), budget = the
         tightest remaining request deadline, floored so one nearly-dead
-        request cannot watchdog a healthy step."""
+        request cannot watchdog a healthy step.  A ``window`` > 1 runs
+        the backend's multi-step bundle (ONE host dispatch for the
+        whole membership-stable window); the return is normalized to
+        ``(cache, (window, slots) tokens)``."""
         from .. import resilience
 
         remaining = [
             self.slots[i].request.remaining_ms(now) for i in active
         ]
         remaining = [r for r in remaining if r is not None]
-        thunk = lambda: self.backend.decode(self.cache, tokens)  # noqa: E731
+        if window > 1:
+            def thunk():
+                return self.backend.decode_multi(self.cache, tokens,
+                                                 window)
+        else:
+            def thunk():
+                cache, nxt = self.backend.decode(self.cache, tokens)
+                return cache, np.asarray(nxt, np.int32)[None]
         if not remaining and not resilience.enabled():
             return thunk()
         budget = None
         if remaining:
-            budget = max(min(remaining), self.cfg.step_deadline_floor_ms)
+            # the floor is per STEP: a window of W legitimately takes ~W
+            # single-step times, so an unscaled floor would watchdog a
+            # healthy multi-step dispatch whenever any request runs low
+            # and then fail an innocent victim W tokens at a time
+            budget = max(min(remaining),
+                         self.cfg.step_deadline_floor_ms * window)
         return resilience.call_with_deadline(
             "serve_decode_step", thunk, budget)
 
@@ -841,6 +888,7 @@ class Scheduler:
             "shed": len(self.shed),
             "preemptions": self.preemptions,
             "evicted_pages": self.evicted_pages,
+            "decode_windows": self.decode_windows,
             "kv_corruptions": len(self.kv_corruptions),
             "handoff_parked": len(self.handoff_ready()),
             "active_slots": sum(s is not None for s in self.slots),

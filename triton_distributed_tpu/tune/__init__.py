@@ -4,6 +4,7 @@ from .autotuner import (
     Autotuner,
     TuneResult,
     autotune,
+    fresh_tune_persistent_decode,
     lookup_winner,
     matmul_tile_candidates,
     resolve_config,

@@ -817,6 +817,45 @@ def fresh_tune_fused_mlp(x, gate_up, down, mesh, axis: str = "tp") -> Any:
     )
 
 
+def fresh_tune_persistent_decode(x, sp, pool_k, pool_v, block_table,
+                                 seq_lens, mesh, axis: str = "tp", *,
+                                 rope_theta: float = 10_000.0,
+                                 rms_eps: float = 1e-6,
+                                 qk_eps=None) -> Any:
+    """Fresh re-tune of the persistent decode megakernel's tile sweep
+    (``ops.persistent_decode.persistent_decode_candidates``) for this
+    shape, NOW, in this process — same cache entry the transparent
+    ``config=None`` path AND the ``serve.EngineBackend`` construction-
+    time hoist consult, so a bench/serving-warmup crown reaches every
+    later jitted step bundle without a per-dispatch consult."""
+    from ..ops.persistent_decode import (
+        PersistentDecodeConfig,
+        persistent_config_key,
+        persistent_decode_candidates,
+        persistent_decode_step,
+    )
+
+    n = mesh.shape[axis]
+    layers, _, hk, ps, d = pool_k.shape
+    b, k_dim = x.shape
+    f_dim = sp.down.shape[1]
+    return resolve_config(
+        "persistent_decode",
+        persistent_config_key(layers, b, k_dim, f_dim, hk, ps,
+                              block_table.shape[1], d, n, x.dtype),
+        persistent_decode_candidates(b, f_dim // max(n, 1),
+                                     k_dim // max(n, 1)),
+        PersistentDecodeConfig(),
+        lambda c: (lambda: persistent_decode_step(
+            x, sp, pool_k, pool_v, block_table, seq_lens, mesh, axis,
+            rope_theta=rope_theta, rms_eps=rms_eps, qk_eps=qk_eps,
+            config=c)),
+        tracing=is_tracer(x),
+        force_measure=True,
+        fresh=True,
+    )
+
+
 def fresh_tune_wire_dtype(op: str, x, mesh, axis: str = "tp") -> Any:
     """Fresh re-measure of a collective's ``wire_dtype`` axis (ISSUE 9:
     {bf16, int8, fp8} as a tuner dimension, keyed on shape AND wire

@@ -1260,7 +1260,140 @@ def bench_serve_throughput():
     }
 
 
+def _trace_overhead_record(metric: str, run_once, *,
+                           rounds: int = 3) -> dict:
+    """Traced-vs-untraced wall time of the SAME seeded replay (ISSUE 14
+    satellite): ``run_once()`` drives one deterministic serve replay;
+    both arms run with obs on (isolating the TDT_TRACE cost alone),
+    interleaved, min-of-rounds against CI jitter.  The traced arm runs
+    last so the committed record can also attest the acceptance
+    criterion: the TTFT p99 exemplar resolves to a retained trace.
+    Always a SimBackend replay on this box — marked ``interpret`` so
+    the 3% warn ceiling binds on real captures, and the trend sentinel
+    (``obs.history.direction_for``: "overhead" -> lower-is-better)
+    guards growth everywhere."""
+    import time as _time
+
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.obs import request_trace
+
+    prev_obs = obs.enabled()
+    obs.enable(True)
+    prev_trace = request_trace.enable(False)
+    walls = {False: [], True: []}
+    try:
+        run_once()                      # compile warmup, untimed
+        for _ in range(rounds):
+            for traced in (False, True):
+                request_trace.enable(traced)
+                if traced:
+                    request_trace.RING.clear()
+                    obs.serve_stats.STATS.reset()
+                t0 = _time.perf_counter()
+                run_once()
+                walls[traced].append(_time.perf_counter() - t0)
+        ex = obs.serve_stats.STATS.ttft_ms.exemplar(0.99)
+        exemplar_resolved = (ex is not None
+                             and request_trace.RING.get(ex) is not None)
+    finally:
+        request_trace.enable(prev_trace)
+        obs.enable(prev_obs)
+    t_off, t_on = min(walls[False]), min(walls[True])
+    return {
+        "metric": metric,
+        "value": round(100.0 * (t_on - t_off) / max(t_off, 1e-9), 2),
+        "unit": "% over untraced",
+        "untraced_s": round(t_off, 4),
+        "traced_s": round(t_on, 4),
+        "ttft_p99_exemplar_resolved": exemplar_resolved,
+        "traces_retained": len(request_trace.RING),
+        "interpret": True,   # SimBackend replay on this box
+        "devices": jax.device_count(),
+    }
+
+
+def bench_trace_overhead():
+    """TDT_TRACE tax on the single-tier scheduler replay (`bench.py
+    serve`): the same seeded 48-request overcommit mix replayed
+    untraced vs traced."""
+    from triton_distributed_tpu import serve
+
+    vocab = 512
+
+    def run_once():
+        backend = serve.SimBackend(slots=8, page_size=16, pool_pages=65,
+                                   max_length=256, vocab=vocab)
+        sched = serve.Scheduler(backend, serve.SchedulerConfig(
+            max_queue_depth=128, prefill_chunk_tokens=32))
+        arrivals = serve.synthetic_trace(
+            7, 48, mean_interarrival_steps=0.25,
+            prompt_len=(8, 48), max_new=(8, 48), vocab=vocab)
+        serve.replay(sched, arrivals, max_steps=100_000)
+
+    return _trace_overhead_record("trace_overhead_pct", run_once)
+
+
+def bench_trace_overhead_disagg():
+    """TDT_TRACE tax on the two-tier disaggregated replay (`bench.py
+    serve_disagg`): the handoff plane's extract/wire/verify spans ride
+    this arm, so its overhead is gated separately.  Same harness as
+    ``_serve_disagg_run`` (``_disagg_setup``/``_disagg_drive``), fewer
+    requests per arm; both arms include setup equally, so the pct
+    compares like with like."""
+    def run_once():
+        router, pending = _disagg_setup(32, seed=7, bulk_bytes_per_step=0)
+        _disagg_drive(router, pending)
+
+    return _trace_overhead_record("trace_overhead_pct_disagg", run_once)
+
+
 _DISAGG_RUN = None
+
+
+def _disagg_setup(n_requests: int, *, seed: int = 0,
+                  bulk_bytes_per_step: int = 1 << 20):
+    """ONE home for the bench-scale two-tier harness (shared by
+    ``_serve_disagg_run`` and the trace-overhead arm): fresh SimBackend
+    tiers + router over the modeled DCN plus the seeded open-loop mix.
+    Setup only — ``_disagg_drive`` is the (separately timed) replay, so
+    ``wall_s``-derived metrics never absorb pool-allocation cost."""
+    from triton_distributed_tpu import resilience, serve
+
+    resilience.reset_breaker(serve.HANDOFF_OP)
+    vocab = 512
+    pre = serve.Scheduler(
+        serve.SimBackend(slots=8, page_size=16, pool_pages=65,
+                         max_length=256, vocab=vocab),
+        serve.SchedulerConfig(max_queue_depth=128,
+                              prefill_chunk_tokens=32,
+                              prefill_only=True))
+    dec = serve.Scheduler(
+        serve.SimBackend(slots=8, page_size=16, pool_pages=65,
+                         max_length=256, vocab=vocab),
+        serve.SchedulerConfig(max_queue_depth=128))
+    router = serve.DisaggRouter(
+        pre, dec, plane=serve.HandoffPlane(),
+        config=serve.RouterConfig(
+            bulk_bytes_per_step=bulk_bytes_per_step))
+    arrivals = serve.synthetic_trace(
+        seed, n_requests, mean_interarrival_steps=0.25,
+        prompt_len=(8, 48), max_new=(8, 48), vocab=vocab)
+    pending = sorted(arrivals, key=lambda a: (a.step, a.request.req_id))
+    return router, pending
+
+
+def _disagg_drive(router, pending) -> None:
+    """Drive the two-tier harness until idle (arrivals scheduled
+    against the prefill tier's step count, the open-loop contract)."""
+    idx = 0
+    for _ in range(100_000):
+        while idx < len(pending) and \
+                pending[idx].step <= router.prefill.steps:
+            router.submit(pending[idx].request)
+            idx += 1
+        res = router.step()
+        if idx >= len(pending) and res.idle:
+            break
 
 
 def _serve_disagg_run(n_requests: int = 48) -> dict:
@@ -1278,44 +1411,19 @@ def _serve_disagg_run(n_requests: int = 48) -> dict:
         return _DISAGG_RUN
     import time
 
-    from triton_distributed_tpu import obs, resilience, serve
+    from triton_distributed_tpu import obs
 
     prev_obs = obs.enabled()
     obs.enable(True)
     obs.serve_stats.STATS.reset()
-    resilience.reset_breaker(serve.HANDOFF_OP)
-    vocab = 512
-    pre = serve.Scheduler(
-        serve.SimBackend(slots=8, page_size=16, pool_pages=65,
-                         max_length=256, vocab=vocab),
-        serve.SchedulerConfig(max_queue_depth=128,
-                              prefill_chunk_tokens=32,
-                              prefill_only=True))
-    dec = serve.Scheduler(
-        serve.SimBackend(slots=8, page_size=16, pool_pages=65,
-                         max_length=256, vocab=vocab),
-        serve.SchedulerConfig(max_queue_depth=128))
-    router = serve.DisaggRouter(
-        pre, dec, plane=serve.HandoffPlane(),
-        config=serve.RouterConfig(bulk_bytes_per_step=1 << 20))
-    arrivals = serve.synthetic_trace(
-        0, n_requests, mean_interarrival_steps=0.25,
-        prompt_len=(8, 48), max_new=(8, 48), vocab=vocab)
-    pending = sorted(arrivals, key=lambda a: (a.step, a.request.req_id))
-    idx = 0
     try:
+        router, pending = _disagg_setup(n_requests)
         t0 = time.perf_counter()
-        for _ in range(100_000):
-            while idx < len(pending) and pending[idx].step <= pre.steps:
-                router.submit(pending[idx].request)
-                idx += 1
-            res = router.step()
-            if idx >= len(pending) and res.idle:
-                break
+        _disagg_drive(router, pending)
         wall_s = time.perf_counter() - t0
     finally:
         obs.enable(prev_obs)
-    reqs = [a.request for a in arrivals]
+    reqs = [a.request for a in pending]
     from triton_distributed_tpu.serve import RequestState
 
     done = [r for r in reqs if r.state is RequestState.DONE]
@@ -1802,6 +1910,7 @@ def main():
         print(json.dumps(bench_serve_ttft()))
         print(json.dumps(bench_serve_throughput()))
         print(json.dumps(bench_serve_kv_quant()))
+        print(json.dumps(bench_trace_overhead()))
     elif mode == "serve_disagg":
         # the disaggregated prefill/decode topology (ISSUE 12): TTFT
         # plus the KV-handoff plane's latency/throughput/retry surface,
@@ -1810,6 +1919,7 @@ def main():
         print(json.dumps(bench_handoff_latency()))
         print(json.dumps(bench_handoff_throughput()))
         print(json.dumps(bench_handoff_retries()))
+        print(json.dumps(bench_trace_overhead_disagg()))
     elif mode == "wire":
         # quantized collective payload byte accounting + dequant parity
         # (ISSUE 9)
@@ -1853,6 +1963,8 @@ def main():
         _emit(bench_handoff_latency)
         _emit(bench_handoff_throughput)
         _emit(bench_handoff_retries)
+        _emit(bench_trace_overhead)
+        _emit(bench_trace_overhead_disagg)
         _emit(bench_wire_bytes)
         _emit(bench_wire_parity)
         _emit(bench_hier_ar_dcn_bytes)

@@ -308,6 +308,19 @@ CLAIMS = {
     "handoff_retries": {
         "value_max": 64.0, "min_devices": 2, "since": 12,
     },
+    # -- request tracing (ISSUE 14; `bench.py serve` / `serve_disagg`) --
+    # TDT_TRACE tax: traced vs untraced wall of the SAME seeded replay
+    # (the prefix also covers trace_overhead_pct_disagg, the two-tier
+    # arm).  warn_max 3.0 is ADVISORY — the acceptance ceiling from the
+    # issue, a drift past it is a trend finding (obs.history classifies
+    # "overhead" lower-is-better); value_max is the gross tripwire (a
+    # trace plane that doubles the serve loop is broken, not taxed).
+    # This box's SimBackend replays are interpret-marked (wall jitter on
+    # a shared CPU container is not a timing claim); the bounds bind on
+    # real-engine captures
+    "trace_overhead_pct": {
+        "warn_max": 3.0, "value_max": 100.0, "since": 14,
+    },
 }
 
 def parse_record(path: str) -> tuple[list[dict], int | None, bool]:

@@ -21,6 +21,16 @@ order), so per-rank lanes stay disjoint; a single input may already be a
 merged trace.  ``--json`` additionally writes the rows + aggregate as
 JSON for machine consumers (CI gates on mean overlap).
 
+``--request <trace_id>`` is the per-request waterfall (ISSUE 14,
+docs/observability.md "Request tracing"): given a trace id it prints
+the request's gapless span chain (offsets, durations, tiers, tags),
+its overlay events (wire/verify splits, retry rungs) and the SLO
+attribution footer.  Traces resolve against ``--trace-file`` (a JSON
+dump from ``obs.request_trace.export_traces`` or a saved
+``/debug/trace/<id>`` payload); without a file the in-process ring is
+consulted (useful from a REPL or test).  ``--request list`` prints the
+available ids.
+
 ``--timeline`` is the flight-recorder view (docs/observability.md
 "Flight recorder"): given a kernel family name it records every rank of
 the registry case under deterministic record mode, reconstructs the
@@ -72,10 +82,20 @@ def main(argv: list[str] | None = None) -> int:
                     help="with --timeline: also write the reconstructed "
                          "timeline as Chrome-trace JSON with stall flow "
                          "arrows")
+    ap.add_argument("--request", metavar="TRACE_ID",
+                    help="per-request waterfall for one trace id "
+                         "('list' prints the available ids)")
+    ap.add_argument("--trace-file", metavar="PATH",
+                    help="with --request: resolve trace ids from this "
+                         "JSON dump (obs.request_trace.export_traces / "
+                         "a saved /debug/trace/<id> payload) instead of "
+                         "the in-process ring")
     args = ap.parse_args(argv)
 
     from triton_distributed_tpu.obs import report
 
+    if args.request:
+        return _run_request(args)
     if args.timeline:
         return _run_timeline(args)
     if args.selftest:
@@ -103,6 +123,37 @@ def main(argv: list[str] | None = None) -> int:
         with open(args.json, "w") as f:
             json.dump({"rows": rows, "aggregate": report.aggregate(rows)},
                       f, indent=1, sort_keys=True)
+    return 0
+
+
+def _run_request(args) -> int:
+    """The ``--request`` leg: resolve one trace (file dump or the
+    in-process ring) and print its waterfall + attribution."""
+    from triton_distributed_tpu.obs import request_trace
+
+    if args.trace_file:
+        traces = {t.trace_id: t
+                  for t in request_trace.load_traces(args.trace_file)}
+        where = args.trace_file
+    else:
+        traces = {t.trace_id: t
+                  for t in request_trace.RING.recent(
+                      len(request_trace.RING))}
+        where = "the in-process ring"
+    if args.request == "list":
+        for tid in traces:
+            print(tid)
+        print(f"{len(traces)} trace(s) in {where}")
+        return 0
+    tr = traces.get(args.request)
+    if tr is None:
+        print(f"trace {args.request!r} not found in {where} "
+              f"({len(traces)} trace(s): {list(traces)[-8:]})")
+        return 1
+    sys.stdout.write(request_trace.format_waterfall(tr))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(tr.to_dict(), f, indent=1, sort_keys=True)
     return 0
 
 

@@ -21,6 +21,7 @@ Usage:
     python scripts/tdt_lint.py --serve           # scheduler overload smoke
     python scripts/tdt_lint.py --integrity       # data-integrity gate
     python scripts/tdt_lint.py --hier            # hierarchical (ICIxDCN) gate
+    python scripts/tdt_lint.py --trace           # request-tracing gate
     python scripts/tdt_lint.py --all             # every gate, one exit code
     python scripts/tdt_lint.py --json report.json
 
@@ -99,10 +100,21 @@ with IDENTICAL tokens (membership changes between windows, preemption
 re-queued cleanly), zero leaked pages, in fewer dispatches.  Headless
 and CPU-only.
 
+``--trace`` is the request-tracing gate (ISSUE 14,
+docs/observability.md "Request tracing"): a seeded two-tier replay
+(the ``--handoff`` harness shape) with a transfer DROP injected runs
+with ``TDT_TRACE`` armed, asserting every terminal request carries a
+GAPLESS span chain (no hop unaccounted), the SLO attributor's phase
+budgets sum exactly to each trace's end-to-end latency, the TTFT /
+request-latency p99 exemplar ids resolve to retained ring traces, and
+the drop-faulted request's trace names every retry rung plus the
+re-prefill fallback.  Headless and CPU-only.
+
 ``--all`` runs every gate above — verify matrix, ``--faults``,
 ``--timeline``, ``--serve``, ``--history``, ``--integrity``,
-``--quant``, ``--hier``, ``--handoff``, ``--persistent`` — and
-summarizes them under a single exit code (the CI entry; see README).
+``--quant``, ``--hier``, ``--handoff``, ``--persistent``, ``--trace``
+— and summarizes them under a single exit code (the CI entry; see
+README).
 
 ``--history`` runs the bench-record trend sentinel
 (``scripts/bench_history.py --check``): exit 1 when a committed
@@ -173,6 +185,13 @@ def main(argv: list[str] | None = None) -> int:
                          "the inter-layer semaphore named + the headless "
                          "dispatch-count assertion + a scheduler "
                          "window-parity smoke")
+    ap.add_argument("--trace", action="store_true", dest="trace_gate",
+                    help="request-tracing gate (ISSUE 14): seeded "
+                         "two-tier replay with a transfer drop under "
+                         "TDT_TRACE — gapless span chains, attributor "
+                         "sums equal e2e latency, exemplar ids resolve, "
+                         "the faulted trace names its retry/re-prefill "
+                         "rungs")
     ap.add_argument("--handoff", action="store_true",
                     help="disaggregated-serving gate (ISSUE 12): seeded "
                          "two-tier replay with a transfer drop, a corrupt "
@@ -183,8 +202,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--all", action="store_true", dest="all_gates",
                     help="run every gate (verify matrix, --faults, "
                          "--timeline, --serve, --history, --integrity, "
-                         "--quant, --hier, --handoff) with one "
-                         "summarized exit code")
+                         "--quant, --hier, --handoff, --persistent, "
+                         "--trace) with one summarized exit code")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection target sampling seed (--faults)")
     ap.add_argument("--json", metavar="PATH",
@@ -211,6 +230,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_handoff(args)
     if args.persistent:
         return _run_persistent(args)
+    if args.trace_gate:
+        return _run_trace(args)
 
     from triton_distributed_tpu import analysis
 
@@ -477,6 +498,7 @@ def _run_all(args) -> int:
         ("hier", lambda: _run_hier(sub())),
         ("handoff", lambda: _run_handoff(sub())),
         ("persistent", lambda: _run_persistent(sub())),
+        ("trace", lambda: _run_trace(sub())),
     ]
     results = []
     for name, fn in legs:
@@ -602,24 +624,14 @@ def _run_serve(args) -> int:
     return 0
 
 
-def _run_handoff(args) -> int:
-    """The disaggregated-serving gate (see module docstring): a seeded
-    two-tier replay with three wire faults injected, then the handoff
-    fault cells."""
-    from triton_distributed_tpu import resilience, serve
+def _two_tier_replay(seed: int, faults):
+    """ONE home for the seeded two-tier gate harness (shared by
+    ``--handoff`` and ``--trace``): prefill tier -> ModeledDCN with the
+    given fault plan -> decode tier through the REAL router, 24
+    requests driven open-loop until idle.  Returns
+    ``(router, plane, requests)``; the caller owns breaker hygiene."""
+    from triton_distributed_tpu import serve
 
-    problems: list[str] = []
-
-    # leg 1: two-tier replay — 24 requests through the router with a
-    # transfer DROP (every attempt: the ladder must bottom out to
-    # re-prefill), a CORRUPT page (first attempt: the retry recovers),
-    # and a prefill-slice ABORT mid-handoff
-    resilience.reset_breaker(serve.HANDOFF_OP)
-    faults = [
-        serve.WireFault(serve.HandoffFault.TRANSFER_DROP, 2),
-        serve.WireFault(serve.HandoffFault.CORRUPT_PAGE, 5, attempts=1),
-        serve.WireFault(serve.HandoffFault.PREFILL_ABORT, 8),
-    ]
     pre = serve.Scheduler(
         serve.SimBackend(slots=4, page_size=4, pool_pages=33,
                          max_length=64),
@@ -629,9 +641,9 @@ def _run_handoff(args) -> int:
                          max_length=64),
         serve.SchedulerConfig(max_queue_depth=64))
     plane = serve.HandoffPlane(
-        dcn_channel=serve.ModeledDCN(faults=faults, seed=args.seed))
+        dcn_channel=serve.ModeledDCN(faults=list(faults), seed=seed))
     router = serve.DisaggRouter(pre, dec, plane=plane)
-    arrivals = serve.synthetic_trace(args.seed, 24,
+    arrivals = serve.synthetic_trace(seed, 24,
                                      mean_interarrival_steps=0.5,
                                      prompt_len=(2, 12), max_new=(2, 10))
     idx = 0
@@ -645,7 +657,28 @@ def _run_handoff(args) -> int:
             break
         elif idx < len(pending):
             router.step()
-    reqs = [a.request for a in arrivals]
+    return router, plane, [a.request for a in arrivals]
+
+
+def _run_handoff(args) -> int:
+    """The disaggregated-serving gate (see module docstring): a seeded
+    two-tier replay with three wire faults injected, then the handoff
+    fault cells."""
+    from triton_distributed_tpu import resilience, serve
+
+    problems: list[str] = []
+
+    # leg 1: two-tier replay — 24 requests through the router with a
+    # transfer DROP (every attempt: the ladder must bottom out to
+    # re-prefill), a CORRUPT page (first attempt: the retry recovers),
+    # and a prefill-slice ABORT mid-handoff
+    resilience.reset_breaker(serve.HANDOFF_OP)
+    router, plane, reqs = _two_tier_replay(args.seed, [
+        serve.WireFault(serve.HandoffFault.TRANSFER_DROP, 2),
+        serve.WireFault(serve.HandoffFault.CORRUPT_PAGE, 5, attempts=1),
+        serve.WireFault(serve.HandoffFault.PREFILL_ABORT, 8),
+    ])
+    pre = router.prefill
     done = [r for r in reqs if r.state is serve.RequestState.DONE]
     failed = [r for r in reqs if r.state is serve.RequestState.FAILED]
     nonterminal = [r for r in reqs if not r.done]
@@ -713,6 +746,104 @@ def _run_handoff(args) -> int:
           "on both tiers, every faulted request completed via "
           "retry/re-prefill with token parity; all handoff fault "
           "cells detected-or-survived")
+    return 0
+
+
+def _run_trace(args) -> int:
+    """The request-tracing gate (ISSUE 14; see module docstring): a
+    seeded two-tier replay with a transfer drop, under TDT_TRACE —
+    gapless chains, attributor exactness, exemplar resolution, and the
+    faulted request's ladder rungs named."""
+    from triton_distributed_tpu import obs, resilience, serve
+    from triton_distributed_tpu.obs import request_trace as rtrace
+
+    problems: list[str] = []
+    prev_obs = obs.enabled()
+    obs.enable(True)
+    prev_trace = rtrace.enable(True)
+    rtrace.RING.clear()
+    obs.serve_stats.STATS.reset()
+    resilience.reset_breaker(serve.HANDOFF_OP)
+    try:
+        # transfer #2 drops on EVERY attempt: the ladder must bottom
+        # out to the re-prefill fallback with every rung on the trace
+        # (the --handoff harness, one home: _two_tier_replay)
+        router, plane, reqs = _two_tier_replay(args.seed, [
+            serve.WireFault(serve.HandoffFault.TRANSFER_DROP, 2),
+        ])
+        nonterminal = [r for r in reqs if not r.done]
+        if nonterminal:
+            problems.append(f"{len(nonterminal)} request(s) never "
+                            f"terminal: "
+                            f"{[r.req_id for r in nonterminal]}")
+        # leg 1: every request traced with a gapless chain whose
+        # attributor phases sum exactly to end-to-end latency
+        worst_gap = 0.0
+        for r in reqs:
+            tr = r.trace
+            if tr is None:
+                problems.append(f"request {r.req_id} carries no trace "
+                                f"with TDT_TRACE armed")
+                continue
+            problems += rtrace.verify_chain(tr)
+            att = rtrace.attribute_request(tr)
+            total = sum(p["exposed_ms"] for p in att["phases"].values())
+            worst_gap = max(worst_gap, abs(total - att["e2e_ms"]))
+            if abs(total - att["e2e_ms"]) > 1e-6:
+                problems.append(
+                    f"trace {tr.trace_id}: attributor phases sum to "
+                    f"{total:.6f} ms but e2e is {att['e2e_ms']:.6f} ms "
+                    f"— {att['gap_ms']:.6f} ms unaccounted")
+        print(f"trace replay: {len(reqs)} requests, {router.handoffs} "
+              f"handoffs, {router.reprefills} re-prefills, "
+              f"{len(rtrace.RING)} traces retained, worst attribution "
+              f"gap {worst_gap * 1e3:.3f} us")
+        # leg 2: p99 exemplar ids resolve to retained traces
+        stats = obs.serve_stats.STATS
+        for name, sketch in (("ttft_ms", stats.ttft_ms),
+                             ("request_ms", stats.request_ms)):
+            ex = sketch.exemplar(0.99)
+            if ex is None:
+                problems.append(f"{name} p99 bucket carries no exemplar")
+            elif rtrace.RING.get(ex) is None:
+                problems.append(f"{name} p99 exemplar {ex!r} does not "
+                                f"resolve to a retained trace")
+            else:
+                print(f"{name} p99 exemplar -> {ex} (retained)")
+        # leg 3: the drop-faulted request's trace names the ladder
+        if router.reprefills < 1 or not router.reprefill_ids:
+            problems.append("the drop injection never exercised the "
+                            "re-prefill fallback")
+        for rid in sorted(router.reprefill_ids):
+            tr = next((r.trace for r in reqs if r.req_id == rid), None)
+            names = [] if tr is None else [e.name for e in tr.events]
+            if "retry" not in names:
+                problems.append(f"faulted request {rid}: trace names no "
+                                f"retry rung ({names})")
+            if "reprefill" not in names:
+                problems.append(f"faulted request {rid}: trace names no "
+                                f"re-prefill rung ({names})")
+            if tr is not None and "decode" not in tr.tiers():
+                problems.append(f"faulted request {rid}: chain never "
+                                f"reached the decode tier "
+                                f"({tr.tiers()})")
+    finally:
+        resilience.reset_breaker(serve.HANDOFF_OP)
+        rtrace.enable(prev_trace)
+        obs.enable(prev_obs)
+
+    for p in problems:
+        print(f"TRACE FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"problems": problems}, f, indent=1,
+                      sort_keys=True, default=str)
+    if problems:
+        return 1
+    print("trace OK: every request's span chain is gapless with "
+          "attributor phases summing exactly to e2e latency; p99 "
+          "exemplars resolve to retained traces; the drop-faulted "
+          "request names its retry and re-prefill rungs")
     return 0
 
 

@@ -1,0 +1,431 @@
+"""Per-request distributed tracing + cross-tier SLO attribution
+(ISSUE 14, ``obs.request_trace``).
+
+Headless and model-free like the serve battery: every replay drives the
+REAL scheduler/router over the deterministic ``serve.SimBackend``, so
+the traces under test come from the production span call sites, not a
+harness.  Pinned here: context propagation through preemption/recompute
+and the handoff-to-re-prefill fallback, attributor exactness (phase
+budgets sum to end-to-end latency, no silent gap), ring retention
+bounds, zero behavior change with TDT_TRACE off, exemplar exclusion
+under ``obs.suppress()``, the sketch exemplar slots, the
+``/debug/trace`` endpoint battery, the queued-age high-water mark, and
+the ``tdt_lint --trace`` / ``obs_report --request`` CLI hooks.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from triton_distributed_tpu import obs, resilience, serve
+from triton_distributed_tpu.obs import request_trace as rtrace
+from triton_distributed_tpu.obs.serve_stats import QuantileSketch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def trace_on():
+    """Enabled obs + trace plane with clean collector/ring state,
+    restored after."""
+    prev_obs = obs.enabled()
+    obs.enable(True)
+    obs.REGISTRY.reset()
+    obs.tracing.clear()
+    obs.serve_stats.STATS.reset()
+    prev_trace = rtrace.enable(True)
+    rtrace.RING.clear()
+    yield
+    rtrace.enable(prev_trace)
+    rtrace.RING.clear()
+    obs.enable(prev_obs)
+    obs.REGISTRY.reset()
+    obs.tracing.clear()
+    obs.serve_stats.STATS.reset()
+
+
+def _replay(seed=0, n=24, *, pool_pages=17, max_new=(4, 12),
+            max_steps=20_000):
+    backend = serve.SimBackend(slots=4, page_size=4,
+                               pool_pages=pool_pages, max_length=64)
+    sched = serve.Scheduler(backend, serve.SchedulerConfig(
+        max_queue_depth=64))
+    arrivals = serve.synthetic_trace(seed, n,
+                                     mean_interarrival_steps=0.5,
+                                     prompt_len=(2, 12), max_new=max_new)
+    report = serve.replay(sched, arrivals, max_steps=max_steps)
+    return sched, report
+
+
+def _router_replay(faults=(), seed=0, n=24):
+    resilience.reset_breaker(serve.HANDOFF_OP)
+    pre = serve.Scheduler(
+        serve.SimBackend(slots=4, page_size=4, pool_pages=33,
+                         max_length=64),
+        serve.SchedulerConfig(max_queue_depth=64, prefill_only=True))
+    dec = serve.Scheduler(
+        serve.SimBackend(slots=4, page_size=4, pool_pages=49,
+                         max_length=64),
+        serve.SchedulerConfig(max_queue_depth=64))
+    plane = serve.HandoffPlane(
+        dcn_channel=serve.ModeledDCN(faults=list(faults), seed=seed))
+    router = serve.DisaggRouter(pre, dec, plane=plane)
+    arrivals = serve.synthetic_trace(seed, n, mean_interarrival_steps=0.5,
+                                     prompt_len=(2, 12), max_new=(2, 10))
+    pending = sorted(arrivals, key=lambda a: (a.step, a.request.req_id))
+    idx = 0
+    for _ in range(20_000):
+        while idx < len(pending) and pending[idx].step <= pre.steps:
+            router.submit(pending[idx].request)
+            idx += 1
+        if idx >= len(pending) and router.step().idle:
+            break
+        elif idx < len(pending):
+            router.step()
+    resilience.reset_breaker(serve.HANDOFF_OP)
+    return router, [a.request for a in arrivals]
+
+
+# ---------------------------------------------------------------------------
+# the chain + attributor
+
+
+def test_chain_gapless_and_attributor_sums(trace_on):
+    """Every terminal request carries a gapless span chain whose phase
+    budgets sum EXACTLY to its end-to-end latency — the no-silent-gap
+    contract the lint gate rides."""
+    sched, report = _replay()
+    assert report.completed and not report.problems()
+    for req in report.requests:
+        tr = req.trace
+        assert tr is not None and tr.closed
+        assert rtrace.verify_chain(tr) == []
+        att = rtrace.attribute_request(tr)
+        total = sum(p["exposed_ms"] for p in att["phases"].values())
+        assert att["gap_ms"] == pytest.approx(0.0, abs=1e-9)
+        assert total == pytest.approx(att["e2e_ms"], abs=1e-6)
+        assert att["dominant_phase"] in att["phases"]
+    done = report.completed[0].trace
+    att = rtrace.attribute_request(done)
+    # a completed request passed through queue -> prefill -> decode
+    assert {"queue", "prefill", "decode"} <= set(att["phases"])
+    # TTFT decomposition sums to the trace's own TTFT
+    assert att["ttft_ms"] is not None
+    assert sum(att["ttft_phases"].values()) == \
+        pytest.approx(att["ttft_ms"], abs=1e-6)
+
+
+def test_propagation_through_preemption_recompute(trace_on):
+    """A preempted request's ONE trace carries the preemption episode
+    (pages tag), the recompute-marked second prefill, and still sums
+    exactly — the thrash regime is where per-request attribution earns
+    its keep."""
+    sched, report = _replay(pool_pages=13, max_new=(6, 14))
+    assert sched.preemptions >= 1
+    preempted = [r for r in report.completed if r.preemptions]
+    assert preempted, "the pressured replay never preempted a completer"
+    for req in preempted:
+        tr = req.trace
+        assert rtrace.verify_chain(tr) == []
+        names = [s.name for s in tr.spans]
+        assert "preempted" in names
+        pre_span = next(s for s in tr.spans if s.name == "preempted")
+        assert pre_span.tags["pages"] >= 1
+        # the recompute prefill is marked, and the chain stays ONE trace
+        recompute = [s for s in tr.spans
+                     if s.name == "prefill_chunk"
+                     and s.tags.get("recompute")]
+        assert recompute, names
+        att = rtrace.attribute_request(tr)
+        assert "preempted" in att["phases"]
+        total = sum(p["exposed_ms"] for p in att["phases"].values())
+        assert total == pytest.approx(att["e2e_ms"], abs=1e-6)
+
+
+def test_handoff_reprefill_fallback_trace(trace_on):
+    """The drop-faulted request's trace crosses both tiers on ONE chain
+    and names every ladder rung: the retry annotations (reason strings
+    from ``resilience.resilient_call``), the fallback, the re-prefill,
+    then the decode-tier recompute."""
+    faults = [serve.WireFault(serve.HandoffFault.TRANSFER_DROP, 2)]
+    router, reqs = _router_replay(faults)
+    assert router.reprefills >= 1
+    for rid in router.reprefill_ids:
+        tr = next(r.trace for r in reqs if r.req_id == rid)
+        assert rtrace.verify_chain(tr) == []
+        assert tr.tiers() == ["prefill", "decode"]
+        names = [e.name for e in tr.events]
+        assert "retry" in names and "fallback" in names \
+            and "reprefill" in names
+        rung = next(e for e in tr.events if e.name == "retry")
+        assert "dropped" in rung.tags["reason"]
+        # the wire/verify split: per-attempt overlay events
+        wires = [e for e in tr.events if e.name == "handoff_wire"]
+        assert len(wires) >= 2            # original + retries
+        # after the fallback, the decode tier re-queued and re-prefilled
+        span_names = [s.name for s in tr.spans]
+        i = span_names.index("handoff_transfer")
+        assert "queue_wait" in span_names[i:] \
+            and "prefill_chunk" in span_names[i:]
+    # clean handoffs split wire from stamp-verify time
+    handed = [r for r in reqs
+              if r.trace is not None
+              and any(s.name == "adopt" for s in r.trace.spans)]
+    assert handed
+    ev_names = [e.name for e in handed[0].trace.events]
+    assert "handoff_wire" in ev_names and "stamp_verify" in ev_names
+
+
+def test_ring_retention_bound(trace_on):
+    """The ring keeps the most recent ``cap`` traces, oldest evicted."""
+    ring = rtrace.TraceRing(cap=8)
+    traces = []
+    for i in range(20):
+        tr = rtrace.TraceContext(i, "serve")
+        tr.end("done")
+        ring.retire(tr)
+        traces.append(tr)
+    assert len(ring) == 8
+    assert ring.ids() == [t.trace_id for t in traces[-8:]]
+    assert ring.get(traces[0].trace_id) is None
+    assert ring.get(traces[-1].trace_id) is traces[-1]
+
+
+def test_tdt_trace_off_is_byte_identical(trace_on):
+    """With the plane off the scheduler behaves identically: same
+    tokens, same outcomes, same step count — and no request carries a
+    trace, nothing lands in the ring."""
+    rtrace.enable(False)
+    sched_off, rep_off = _replay(seed=3)
+    assert all(r.trace is None for r in rep_off.requests)
+    assert len(rtrace.RING) == 0
+    rtrace.enable(True)
+    sched_on, rep_on = _replay(seed=3)
+    assert all(r.trace is not None for r in rep_on.requests)
+    assert sched_on.steps == sched_off.steps
+    assert [(r.state, tuple(r.tokens)) for r in rep_on.requests] == \
+        [(r.state, tuple(r.tokens)) for r in rep_off.requests]
+
+
+def test_exemplar_excluded_under_suppress(trace_on):
+    """``obs.suppress()`` traffic (sweeps, warmups) mints no traces and
+    feeds no exemplars — the ring and the p99 lookups describe REAL
+    traffic only."""
+    with obs.suppress():
+        _replay(seed=5, n=8)
+    assert len(rtrace.RING) == 0
+    assert obs.serve_stats.STATS.ttft_ms.exemplar(0.99) is None
+    # real traffic afterwards populates both
+    _replay(seed=6, n=8)
+    assert len(rtrace.RING) == 8
+    ex = obs.serve_stats.STATS.ttft_ms.exemplar(0.99)
+    assert ex is not None and rtrace.RING.get(ex) is not None
+
+
+def test_sketch_exemplar_slots():
+    """Unit: the p99 bucket returns the id of the observation that
+    landed there; omitting exemplars keeps the sketch unchanged."""
+    sk = QuantileSketch()
+    for i in range(95):
+        sk.observe(10.0 + 0.001 * i, exemplar=f"fast-{i}")
+    for i in range(5):
+        sk.observe(5000.0, exemplar=f"slow-{i}")
+    # p99 rank (0.99 * 99 = 98.01) lands in the slow-tail bucket, whose
+    # slot holds the LAST exemplar that landed there
+    assert sk.exemplar(0.99) == "slow-4"
+    assert sk.exemplar(0.5).startswith("fast-")
+    assert sk.to_dict()["exemplars"]["p99"] == "slow-4"
+    # merge carries exemplars; plain observations carry none
+    other = QuantileSketch()
+    other.observe(9999.0, exemplar="merged-tail")
+    sk.merge(other)
+    assert sk.exemplar(1.0) == "merged-tail"
+    plain = QuantileSketch()
+    plain.observe(1.0)
+    assert plain.exemplar(0.99) is None
+    assert "exemplars" not in plain.to_dict()
+
+
+def test_sketch_exemplar_survives_bucket_collapse():
+    sk = QuantileSketch(max_buckets=4)
+    for i, v in enumerate((0.001, 0.01, 0.1, 1.0, 10.0, 100.0)):
+        sk.observe(v, exemplar=f"e{i}")
+    # collapses hit the SMALLEST keys; the tail exemplar survives
+    assert sk.exemplar(1.0) == "e5"
+
+
+# ---------------------------------------------------------------------------
+# export surfaces
+
+
+def _get(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_debug_trace_endpoint_battery(trace_on):
+    """/debug/trace listing, /debug/trace/<id> payload (spans + events
+    + attribution), 404 for unknown ids, and the exemplar ids surfaced
+    in /debug/serve."""
+    from triton_distributed_tpu.obs import server as obs_server
+
+    sched, report = _replay(n=8)
+    srv = obs_server.start(port=0, engine=sched)
+    try:
+        code, body = _get(srv.url + "/debug/trace")
+        assert code == 200
+        listing = json.loads(body)
+        assert listing["enabled"] and listing["retained"] == 8
+        tid = listing["ids"][-1]
+        code, body = _get(srv.url + f"/debug/trace/{tid}")
+        assert code == 200
+        tr = json.loads(body)
+        assert tr["trace_id"] == tid and tr["state"] == "done"
+        assert tr["spans"] and tr["attribution"]["gap_ms"] == 0.0
+        total = sum(p["exposed_ms"]
+                    for p in tr["attribution"]["phases"].values())
+        assert total == pytest.approx(tr["attribution"]["e2e_ms"],
+                                      abs=1e-6)
+        code, body = _get(srv.url + "/debug/trace/nope")
+        assert code == 404 and "not retained" in body
+        code, body = _get(srv.url + "/debug/serve")
+        assert code == 200
+        dump = json.loads(body)
+        ex = dump["trace"]["exemplars"]["ttft_ms_p99"]
+        assert ex in listing["ids"]
+        # the small fix: queued-age high-water rides the queue snapshot
+        assert "queued_age_hw_s" in dump["scheduler"]["queue"]
+        # 404 listing names the new endpoint
+        code, body = _get(srv.url + "/nope")
+        assert code == 404 and "/debug/trace" in body
+    finally:
+        obs_server.stop()
+
+
+def test_waterfall_and_offline_round_trip(trace_on, tmp_path):
+    """format_waterfall names every hop; export_traces -> load_traces
+    -> attribute_request round-trips the offline debugging path the
+    ``obs_report --request --trace-file`` CLI uses."""
+    sched, report = _replay(n=6)
+    tr = report.completed[0].trace
+    text = rtrace.format_waterfall(tr)
+    for name in ("queue_wait", "prefill_chunk", "decode_window",
+                 "attribution:", "dominant="):
+        assert name in text
+    dump = tmp_path / "traces.json"
+    rtrace.export_traces(str(dump))
+    loaded = {t.trace_id: t for t in rtrace.load_traces(str(dump))}
+    assert set(loaded) == set(rtrace.RING.ids())
+    att0 = rtrace.attribute_request(tr)
+    att1 = rtrace.attribute_request(loaded[tr.trace_id])
+    assert att1["e2e_ms"] == pytest.approx(att0["e2e_ms"], abs=1e-9)
+    assert att1["phases"].keys() == att0["phases"].keys()
+
+
+def test_chrome_export_merges_with_process_spans(trace_on, tmp_path):
+    """The request spans share the obs.tracing wall timebase, so
+    trace_merge (the ts_offsets path included) folds request traces and
+    the process span trace into one Chrome timeline."""
+    from triton_distributed_tpu.obs import report as obs_report_mod
+    from triton_distributed_tpu.tools.trace_merge import merge_traces
+
+    _replay(n=4)
+    proc = tmp_path / "proc.json"
+    reqs = tmp_path / "requests.json"
+    obs.tracing.export(str(proc))
+    rtrace.export_chrome(str(reqs))
+    merged = tmp_path / "merged.json"
+    merge_traces([str(proc), str(reqs)], [0, 0], str(merged),
+                 ts_offsets=[0.0, 0.0])
+    events = obs_report_mod.load_trace(str(merged))
+    cats = {e.get("cat") for e in events}
+    # scheduler ticks (satellite: serve/ now emits step spans), compute
+    # spans and request spans coexist on one timeline
+    assert {"step", "compute", "request"} <= cats
+    steps = [e for e in events if e.get("cat") == "step"]
+    assert any(e["name"] == "sched_step" for e in steps)
+    req_ts = [e["ts"] for e in events if e.get("cat") == "request"]
+    step_ts = [e["ts"] for e in steps]
+    # one shared clock: request spans land inside the process span window
+    assert min(step_ts) - 1e6 <= min(req_ts) <= max(step_ts) + 1e6
+
+
+def test_obs_report_cli_request_waterfall(trace_on, tmp_path):
+    sched, report = _replay(n=4)
+    dump = tmp_path / "traces.json"
+    rtrace.export_traces(str(dump))
+    tid = report.completed[0].trace.trace_id
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         "--request", tid, "--trace-file", str(dump)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert tid in proc.stdout and "attribution:" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         "--request", "list", "--trace-file", str(dump)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0 and tid in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# the small fix + history direction
+
+
+def test_queue_age_high_water_survives_expiry():
+    """A starving low-priority request leaves its queued-age high-water
+    mark even after deadline expiry sheds it — the evidence outlives
+    the request."""
+    q = serve.RequestQueue(max_depth=8)
+    lo = serve.Request(prompt=(1, 2), max_new_tokens=2, priority=0,
+                       deadline_ms=50.0)
+    hi = serve.Request(prompt=(3, 4), max_new_tokens=2, priority=2)
+    t0 = 100.0
+    assert q.submit(lo, now=t0) and q.submit(hi, now=t0)
+    q.expire_deadlines(now=t0 + 0.02)          # both still queued
+    assert q.age_high_water_s[0] == pytest.approx(0.02)
+    expired = q.expire_deadlines(now=t0 + 0.2)  # lo's deadline passed
+    assert expired == [lo]
+    snap = q.snapshot()
+    # the mark recorded lo's final 200 ms of starvation BEFORE the shed
+    assert snap["queued_age_hw_s"][0] == pytest.approx(0.2)
+    assert snap["queued_age_hw_s"][2] == pytest.approx(0.2)
+    # a preempted re-queue restarts ITS residency clock
+    q.requeue_preempted(hi)
+
+
+def test_history_classifies_trace_overhead_lower_is_better():
+    from triton_distributed_tpu.obs.history import direction_for
+
+    assert direction_for("trace_overhead_pct", "% over untraced") == \
+        "lower"
+    assert direction_for("trace_overhead_pct_disagg",
+                         "% over untraced") == "lower"
+
+
+def test_tdt_lint_trace_smoke():
+    """The tier-1 CI hook (like the --serve / --handoff smokes): the
+    seeded two-tier replay under TDT_TRACE with a transfer drop —
+    gapless chains, attributor exactness, exemplar resolution, ladder
+    rungs named."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tdt_lint.py"),
+         "--trace"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "trace OK" in proc.stdout
+    assert "exemplar ->" in proc.stdout

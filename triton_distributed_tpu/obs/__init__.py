@@ -17,6 +17,9 @@ This package is that layer:
 - ``obs.serve_stats``  live serving telemetry: streaming quantile
   sketches (1% relative error) + windowed rates, fed by the engine and
   the comm entry points.
+- ``obs.request_trace``  the per-request distributed trace plane
+  (``TDT_TRACE=1``): gapless cross-tier span chains, the SLO
+  attributor, p99 exemplars, the retained-trace ring.
 - ``obs.server``    the ``TDT_OBS_HTTP`` endpoint: ``/metrics``,
   ``/healthz``, ``/debug/flight``, ``/debug/timeline``.
 - ``obs.history``   the perf-trajectory sentinel over the committed
@@ -35,8 +38,8 @@ import contextlib
 import threading
 
 from . import (
-    costs, export, flight, history, registry, report, serve_stats,
-    timeline, tracing,
+    costs, export, flight, history, registry, report, request_trace,
+    serve_stats, timeline, tracing,
 )
 
 
@@ -72,7 +75,8 @@ __all__ = [
     "Registry", "comm_call", "costs", "counter", "dump_jsonl",
     "dump_prometheus", "enable", "enabled", "flight", "gauge", "histogram",
     "history", "instant", "observe_timer", "parse_prometheus", "read_jsonl",
-    "record_collective", "serve_stats", "server", "span", "summary",
+    "record_collective", "request_trace", "serve_stats", "server", "span",
+    "summary",
     "summary_table", "suppress", "suppressed_thunk", "timeline",
     "to_prometheus", "write_jsonl",
 ]

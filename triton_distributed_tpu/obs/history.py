@@ -163,9 +163,10 @@ def direction_for(metric: str, unit: str) -> str:
     if u.startswith("ms") or u.startswith("us") or "ms/" in u \
             or metric.startswith("latency"):
         return "lower"
-    # cost/tax metrics (e.g. integrity_overhead_pct, "% over plain"):
-    # growth is the regression the sentinel must warn on
-    if "overhead" in metric or "over plain" in u:
+    # cost/tax metrics (integrity_overhead_pct "% over plain",
+    # trace_overhead_pct "% over untraced" — ISSUE 14): growth is the
+    # regression the sentinel must warn on
+    if "overhead" in metric or "over plain" in u or "over untraced" in u:
         return "lower"
     # per-bundle dispatch counts (decode_dispatches_per_bundle, unit
     # "dispatches/bundle"): every extra launch is a host seam the

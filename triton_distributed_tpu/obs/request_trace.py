@@ -1,0 +1,648 @@
+"""Per-request distributed tracing with cross-tier SLO attribution.
+
+``serve_stats`` can say TTFT p99 regressed; nothing in the aggregate
+plane can say WHICH hop of WHICH request ate the budget.  This module is
+the per-request measurement substrate (T3's chunk/arrival-granular
+tracking discipline, PAPERS.md, applied to the serving path): a
+:class:`TraceContext` is minted at ``Scheduler.submit`` and rides
+``Request.trace`` across every hop of the multi-tier pipeline —
+
+    queue wait -> prefill chunk(s) -> [handoff wait -> extract ->
+    transfer (wire / stamp-verify split) -> adopt] -> decode window(s)
+    -> done | failed | shed            (preemption/recompute and the
+                                        retry/re-prefill rungs ride
+                                        along as spans + annotations)
+
+The chain is **gapless by construction**: ``begin(name)`` closes the
+current span and opens the next AT THE SAME TIMESTAMP, and ``end()``
+closes the last — so the spans partition [submit, terminal] exactly and
+:func:`attribute_request` decomposes end-to-end latency into named phase
+budgets with NO silent gap (``tests/test_request_trace.py`` and
+``scripts/tdt_lint.py --trace`` pin the equality).  Overlay events
+(``event(...)`` intervals: DCN wire time, stamp-verify time, retry
+rungs) carry the sub-phase detail; the attributor reports them as the
+per-phase exposed-vs-overlapped split using the same interval arithmetic
+as the overlap report (``obs.report``).
+
+Timebase: every timestamp is WALL-anchored microseconds — the anchor is
+``time.time_ns() // 1000`` at mint, advanced by ``perf_counter_ns``
+deltas (monotonic) — exactly the clock ``obs.tracing`` spans use, so a
+request trace and the process span trace merge into ONE Chrome timeline
+(:func:`export_chrome` + ``tools.trace_merge``).  Cross-process tiers
+align through the same ``ts_offsets`` path the flight recorder uses
+(``obs.timeline.align_clocks`` -> ``merge_traces(ts_offsets=...)``);
+in-process tiers (the SimBackend harnesses) share the clock, offset 0.
+
+Everything is OFF by default (``TDT_TRACE=1`` or :func:`enable` — the
+TDT_OBS discipline): with the flag unset no context is ever minted, the
+scheduler's per-hop sites see ``req.trace is None`` and the serve loop
+is byte-identical.  ``obs.suppress()`` is honored at mint time, so
+autotune sweeps and bench warmups never land in the ring or the
+exemplars.  Completed traces retire into a bounded ring
+(``TDT_TRACE_RING``, default 256) served by ``/debug/trace/<id>``; the
+``ttft_ms`` / ``request_ms`` p99 buckets carry exemplar trace ids
+(``obs.serve_stats.QuantileSketch``), making "show me a p99 request" a
+one-call lookup.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import itertools
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+
+# hard cap on chain spans per trace: beyond it new hops COALESCE into
+# the open span (a `coalesced` tag counts them) instead of growing the
+# list — the chain stays gapless and memory stays bounded even for a
+# pathological ten-thousand-window decode
+MAX_SPANS = 512
+
+DEFAULT_RING = 256
+
+# span name -> attribution phase (anything unlisted is its own phase)
+PHASE_OF = {
+    "queue_wait": "queue",
+    "prefill_chunk": "prefill",
+    "handoff_wait": "handoff",
+    "handoff_extract": "handoff",
+    "handoff_transfer": "handoff",
+    "adopt": "handoff",
+    "decode_wait": "decode",
+    "decode_window": "decode",
+    "preempted": "preempted",
+}
+
+# overlay event name -> phase (the wire/verify split of a handoff
+# transfer; retry rungs are zero-duration annotations and carry no time)
+EVENT_PHASE_OF = {
+    "handoff_wire": "handoff",
+    "stamp_verify": "handoff",
+}
+
+_ids = itertools.count()
+
+_pkg_cache: list = []
+
+
+def _suppressed() -> bool:
+    # the obs package's thread-local suppress() gate, read through a
+    # memoized module ref (obs imports this module at package init, so a
+    # top-level `from .. import obs` would be circular)
+    if not _pkg_cache:
+        import sys
+
+        _pkg_cache.append(sys.modules[__package__])
+    return _pkg_cache[0]._suppressed()
+
+
+def _env_enabled() -> bool:
+    from ..core.utils import env_flag
+
+    return env_flag("TDT_TRACE")
+
+
+_ENABLED = _env_enabled()
+
+
+def enabled() -> bool:
+    """Whether request traces are minted (``TDT_TRACE=1`` or
+    :func:`enable`, and not inside an ``obs.suppress()`` block on this
+    thread — sweep/warmup traffic stays out of the ring)."""
+    return _ENABLED and not _suppressed()
+
+
+def enable(on: bool | None = True) -> bool:
+    """Turn the trace plane on/off at runtime; ``None`` re-reads
+    ``TDT_TRACE``.  Returns the PREVIOUS state (so callers can restore)."""
+    global _ENABLED
+    prev = _ENABLED
+    _ENABLED = _env_enabled() if on is None else bool(on)
+    return prev
+
+
+@dataclasses.dataclass
+class Span:
+    """One chain hop.  ``t1_us`` is None while the span is open."""
+
+    name: str
+    tier: str
+    t0_us: float
+    t1_us: float | None = None
+    tags: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def dur_us(self) -> float:
+        return 0.0 if self.t1_us is None else self.t1_us - self.t0_us
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "tier": self.tier,
+                "t0_us": self.t0_us, "t1_us": self.t1_us,
+                "tags": dict(self.tags)}
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    """One overlay interval or zero-duration annotation (retry rungs,
+    wire/verify sub-phases) — detail ON the chain, never part of it."""
+
+    name: str
+    tier: str
+    t0_us: float
+    t1_us: float
+    tags: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "tier": self.tier,
+                "t0_us": self.t0_us, "t1_us": self.t1_us,
+                "tags": dict(self.tags)}
+
+
+class TraceContext:
+    """The per-request trace: a gapless span chain plus overlay events.
+
+    Mutated from the scheduler loop that owns the request (``submit``
+    runs on a caller thread, but a request enters the step loop only
+    through the queue, so chain mutations never race).  Deterministic:
+    ids come from a process counter, never randomness.
+    """
+
+    __slots__ = ("trace_id", "req_id", "state", "spans", "events",
+                 "first_token_us", "dropped", "_wall0_us", "_mono0_ns")
+
+    def __init__(self, req_id: int, tier: str):
+        self.trace_id = f"t{int(req_id)}-{next(_ids):04x}"
+        self.req_id = int(req_id)
+        self.state: str | None = None          # terminal request state
+        self.spans: list[Span] = []
+        self.events: list[TraceEvent] = []
+        self.first_token_us: float | None = None
+        self.dropped = 0
+        # wall anchor advanced by monotonic deltas: the obs.tracing
+        # timebase, so request spans and process spans share one clock
+        self._wall0_us = time.time_ns() / 1e3
+        self._mono0_ns = time.perf_counter_ns()
+        self.begin("queue_wait", tier=tier)
+
+    # -- clock -------------------------------------------------------------
+
+    def now_us(self) -> float:
+        return self._wall0_us \
+            + (time.perf_counter_ns() - self._mono0_ns) / 1e3
+
+    @property
+    def closed(self) -> bool:
+        return self.state is not None
+
+    @property
+    def t0_us(self) -> float:
+        return self.spans[0].t0_us if self.spans else self._wall0_us
+
+    @property
+    def total_ms(self) -> float:
+        if not self.spans or self.spans[-1].t1_us is None:
+            return 0.0
+        return (self.spans[-1].t1_us - self.spans[0].t0_us) / 1e3
+
+    # -- the chain ---------------------------------------------------------
+
+    def begin(self, name: str, *, tier: str, **tags) -> None:
+        """Close the open span and open ``name`` at the SAME timestamp
+        — the gapless-chain contract.  No-op after :meth:`end`."""
+        if self.closed:
+            return
+        now = self.now_us()
+        if self.spans and self.spans[-1].t1_us is None:
+            self.spans[-1].t1_us = now
+        if len(self.spans) >= MAX_SPANS:
+            # coalesce: the open span absorbs the hop (chain stays
+            # gapless); reopen it and count the drop
+            self.dropped += 1
+            last = self.spans[-1]
+            last.t1_us = None
+            last.tags["coalesced"] = last.tags.get("coalesced", 0) + 1
+            return
+        self.spans.append(Span(name, tier, now, None, dict(tags)))
+
+    def end(self, state: str, *, tier: str | None = None, **tags) -> None:
+        """Close the chain at the terminal request state (idempotent)."""
+        if self.closed:
+            return
+        now = self.now_us()
+        if self.spans and self.spans[-1].t1_us is None:
+            self.spans[-1].t1_us = now
+        self.state = str(state)
+        if tags and self.spans:
+            self.spans[-1].tags.update(tags)
+        del tier
+
+    # -- overlays ----------------------------------------------------------
+
+    def annotate(self, name: str, *, tier: str = "", **tags) -> None:
+        """Zero-duration annotation at now (admission marks, retry
+        rungs, re-prefill decisions — reason strings ride the tags)."""
+        if self.closed:
+            return
+        now = self.now_us()
+        self.events.append(TraceEvent(name, tier, now, now, dict(tags)))
+
+    def event(self, name: str, t0_us: float, t1_us: float, *,
+              tier: str = "", **tags) -> None:
+        """Overlay interval (wire time, stamp-verify time): detail the
+        attributor splits exposed-vs-overlapped per phase."""
+        self.events.append(
+            TraceEvent(name, tier, float(t0_us), float(t1_us), dict(tags)))
+
+    def mark_first_token(self) -> None:
+        if self.first_token_us is None:
+            self.first_token_us = self.now_us()
+
+    # -- read --------------------------------------------------------------
+
+    def ttft_ms(self) -> float | None:
+        if self.first_token_us is None or not self.spans:
+            return None
+        return (self.first_token_us - self.spans[0].t0_us) / 1e3
+
+    def tiers(self) -> list[str]:
+        out: list[str] = []
+        for s in self.spans:
+            if not out or out[-1] != s.tier:
+                out.append(s.tier)
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "req_id": self.req_id,
+            "state": self.state,
+            "tiers": self.tiers(),
+            "t0_us": self.t0_us,
+            "total_ms": self.total_ms,
+            "ttft_ms": self.ttft_ms(),
+            "dropped_spans": self.dropped,
+            "spans": [s.to_dict() for s in self.spans],
+            "events": [e.to_dict() for e in self.events],
+            "attribution": attribute_request(self) if self.closed else None,
+        }
+
+
+def from_dict(d: dict) -> TraceContext:
+    """Rebuild a trace from its :meth:`TraceContext.to_dict` JSON (the
+    ``/debug/trace/<id>`` payload / an :func:`export_traces` file) so
+    the waterfall and attributor run offline."""
+    tr = TraceContext.__new__(TraceContext)
+    tr.trace_id = d["trace_id"]
+    tr.req_id = int(d.get("req_id", -1))
+    tr.state = d.get("state")
+    tr.first_token_us = None
+    tr.dropped = int(d.get("dropped_spans", 0))
+    tr._mono0_ns = time.perf_counter_ns()
+    tr.spans = [Span(s["name"], s.get("tier", ""), s["t0_us"],
+                     s.get("t1_us"), dict(s.get("tags", {})))
+                for s in d.get("spans", [])]
+    tr.events = [TraceEvent(e["name"], e.get("tier", ""), e["t0_us"],
+                            e["t1_us"], dict(e.get("tags", {})))
+                 for e in d.get("events", [])]
+    tr._wall0_us = tr.spans[0].t0_us if tr.spans else 0.0
+    if d.get("ttft_ms") is not None and tr.spans:
+        tr.first_token_us = tr.spans[0].t0_us + d["ttft_ms"] * 1e3
+    return tr
+
+
+# ---------------------------------------------------------------------------
+# lifecycle helpers (the serve-layer call sites)
+
+
+def maybe_begin(req, tier: str):
+    """Mint (or resume) the request's trace at ``Scheduler.submit``:
+    returns None when the plane is off or this thread is suppressed —
+    the serve loop then sees ``req.trace is None`` everywhere and runs
+    byte-identical.  A request that already carries a trace (re-prefill
+    resubmission on the decode tier) re-enters the queue phase on the
+    EXISTING chain instead of minting a second id."""
+    tr = getattr(req, "trace", None)
+    if tr is not None:
+        tr.begin("queue_wait", tier=tier, resubmit=True)
+        return tr
+    if not enabled():
+        return None
+    tr = TraceContext(req.req_id, tier)
+    req.trace = tr
+    return tr
+
+
+def finish(req) -> None:
+    """Close the request's trace at its terminal state and retire it
+    into the ring (idempotent; no-op for untraced requests)."""
+    tr = getattr(req, "trace", None)
+    if tr is None or tr.closed:
+        return
+    reason = getattr(req, "error", None) or getattr(req, "shed_reason", None)
+    state = getattr(getattr(req, "state", None), "value", None) or "done"
+    if reason:
+        tr.end(state, reason=str(reason))
+    else:
+        tr.end(state)
+    RING.retire(tr)
+
+
+# ---------------------------------------------------------------------------
+# the retained-trace ring
+
+
+class TraceRing:
+    """Bounded ring of the last-N completed traces (``TDT_TRACE_RING``,
+    default 256): the exemplar lookups and ``/debug/trace`` resolve
+    against it.  Thread-safe; oldest traces evict first."""
+
+    def __init__(self, cap: int | None = None):
+        if cap is None:
+            raw = os.environ.get("TDT_TRACE_RING", "").strip()
+            cap = int(raw) if raw.isdigit() and int(raw) > 0 \
+                else DEFAULT_RING
+        self.cap = int(cap)
+        self._lock = threading.Lock()
+        self._traces: OrderedDict[str, TraceContext] = OrderedDict()
+
+    def retire(self, trace: TraceContext) -> None:
+        with self._lock:
+            self._traces[trace.trace_id] = trace
+            self._traces.move_to_end(trace.trace_id)
+            while len(self._traces) > self.cap:
+                self._traces.popitem(last=False)
+
+    def get(self, trace_id: str) -> TraceContext | None:
+        with self._lock:
+            return self._traces.get(trace_id)
+
+    def ids(self) -> list[str]:
+        """Retained ids, oldest first."""
+        with self._lock:
+            return list(self._traces)
+
+    def recent(self, n: int = 16) -> list[TraceContext]:
+        with self._lock:
+            return list(self._traces.values())[-n:]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+
+RING = TraceRing()
+
+
+# ---------------------------------------------------------------------------
+# retry-rung plumbing (resilience.policy -> the active trace)
+
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def activate(trace: TraceContext | None):
+    """Bind ``trace`` as this thread's active trace for the enclosed
+    call, so ladder rungs recorded deep inside ``resilient_call`` attach
+    to the request that paid them (``note_rung``)."""
+    prev = getattr(_tls, "active", None)
+    _tls.active = trace
+    try:
+        yield
+    finally:
+        _tls.active = prev
+
+
+def note_rung(op: str, kind: str, reason: str) -> None:
+    """One failure-ladder rung (retry / fallback) against this thread's
+    active trace; reason strings land as span tags.  No-op (one
+    thread-local read) when no trace is active."""
+    tr = getattr(_tls, "active", None)
+    if tr is None:
+        return
+    tr.annotate(kind, op=op, reason=str(reason)[:240])
+
+
+# ---------------------------------------------------------------------------
+# the SLO attributor
+
+
+def verify_chain(trace: TraceContext, *, tol_us: float = 0.5) -> list[str]:
+    """Gapless-chain check: every hop accounted, contiguous, closed.
+    Returns problem strings (empty = clean) — the ``tdt_lint --trace``
+    per-request gate."""
+    problems: list[str] = []
+    if not trace.spans:
+        return [f"{trace.trace_id}: no spans recorded"]
+    if not trace.closed:
+        problems.append(f"{trace.trace_id}: trace never reached a "
+                        f"terminal state")
+    for a, b in zip(trace.spans, trace.spans[1:]):
+        if a.t1_us is None:
+            problems.append(
+                f"{trace.trace_id}: span {a.name!r} never closed but "
+                f"{b.name!r} follows it")
+        elif abs(b.t0_us - a.t1_us) > tol_us:
+            problems.append(
+                f"{trace.trace_id}: {abs(b.t0_us - a.t1_us):.1f}us gap "
+                f"between {a.name!r} and {b.name!r} — a hop is "
+                f"unaccounted")
+    if trace.closed and trace.spans[-1].t1_us is None:
+        problems.append(f"{trace.trace_id}: final span "
+                        f"{trace.spans[-1].name!r} left open")
+    return problems
+
+
+def attribute_request(trace: TraceContext) -> dict:
+    """Decompose the trace into named phase budgets.
+
+    ``phases[p]["exposed_ms"]`` is the chain wall time spent in phase
+    ``p`` — the chain partitions [submit, terminal], so the exposed
+    sums equal ``e2e_ms`` exactly (``gap_ms`` reports any violation).
+    ``overlapped_ms`` is overlay-event time of phase ``p`` that fell
+    UNDER another phase's chain time (work hidden behind other hops —
+    the ``obs.report`` exposed-vs-hidden interval arithmetic).
+    ``ttft_phases`` is the same decomposition clipped to the first
+    token.  ``dominant_phase`` names the largest exposed budget — the
+    one-line answer to "where did this request's latency go"."""
+    from .report import _subtract, _total, _union
+
+    spans = [s for s in trace.spans if s.t1_us is not None]
+    if not spans:
+        return {"trace_id": trace.trace_id, "e2e_ms": 0.0,
+                "gap_ms": 0.0, "phases": {}, "ttft_phases": {},
+                "ttft_ms": None, "dominant_phase": None}
+    t0 = spans[0].t0_us
+    t_end = spans[-1].t1_us
+    gap_us = sum(max(0.0, b.t0_us - a.t1_us)
+                 for a, b in zip(spans, spans[1:]))
+
+    chain: dict[str, list[tuple[float, float]]] = {}
+    counts: dict[str, int] = {}
+    for s in spans:
+        p = PHASE_OF.get(s.name, s.name)
+        chain.setdefault(p, []).append((s.t0_us, s.t1_us))
+        counts[p] = counts.get(p, 0) + 1
+    overlays: dict[str, list[tuple[float, float]]] = {}
+    for e in trace.events:
+        if e.t1_us <= e.t0_us:
+            continue
+        p = EVENT_PHASE_OF.get(e.name)
+        if p is not None:
+            overlays.setdefault(p, []).append((e.t0_us, e.t1_us))
+
+    phases: dict[str, dict] = {}
+    for p, ivs in chain.items():
+        exposed_ms = sum(e - b for b, e in ivs) / 1e3
+        ov = overlays.get(p, [])
+        overlapped_ms = _total(_subtract(_union(ov), _union(ivs))) / 1e3 \
+            if ov else 0.0
+        phases[p] = {"exposed_ms": exposed_ms,
+                     "overlapped_ms": overlapped_ms,
+                     "spans": counts[p]}
+
+    ttft_ms = trace.ttft_ms()
+    ttft_phases: dict[str, float] = {}
+    if ttft_ms is not None:
+        cut = trace.first_token_us
+        for p, ivs in chain.items():
+            ms = sum(min(e, cut) - b for b, e in ivs if b < cut) / 1e3
+            if ms > 0:
+                ttft_phases[p] = ms
+    dominant = max(phases, key=lambda p: phases[p]["exposed_ms"]) \
+        if phases else None
+    return {
+        "trace_id": trace.trace_id,
+        "state": trace.state,
+        "e2e_ms": (t_end - t0) / 1e3,
+        "gap_ms": gap_us / 1e3,
+        "ttft_ms": ttft_ms,
+        "phases": phases,
+        "ttft_phases": ttft_phases,
+        "dominant_phase": dominant,
+    }
+
+
+# ---------------------------------------------------------------------------
+# export: waterfall text, Chrome trace, JSON dump
+
+
+def format_waterfall(trace: TraceContext) -> str:
+    """The per-request waterfall (``scripts/obs_report.py --request``):
+    chain spans with offsets/durations/tiers/tags, overlay events, and
+    the attribution footer."""
+    att = attribute_request(trace)
+    t0 = trace.t0_us
+    ttft = "-" if att["ttft_ms"] is None else f"{att['ttft_ms']:.3f}"
+    lines = [
+        f"trace {trace.trace_id}  request {trace.req_id}  "
+        f"state {trace.state or 'open'}  e2e {att['e2e_ms']:.3f} ms  "
+        f"ttft {ttft} ms",
+    ]
+    header = ("offset_ms", "dur_ms", "tier", "span", "tags")
+    table = [header]
+    for s in trace.spans:
+        tags = " ".join(f"{k}={v}" for k, v in sorted(s.tags.items()))
+        table.append((f"{(s.t0_us - t0) / 1e3:.3f}",
+                      f"{s.dur_us / 1e3:.3f}", s.tier, s.name, tags))
+    widths = [max(len(r[i]) for r in table) for i in range(4)]
+    for i, row in enumerate(table):
+        lines.append("  ".join(
+            c.rjust(w) if j < 2 else c.ljust(w)
+            for j, (c, w) in enumerate(zip(row[:4], widths)))
+            + ("  " + row[4] if row[4] else ""))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    for e in trace.events:
+        tags = " ".join(f"{k}={v}" for k, v in sorted(e.tags.items()))
+        dur = (e.t1_us - e.t0_us) / 1e3
+        lines.append(f"  +{(e.t0_us - t0) / 1e3:.3f}ms "
+                     f"{e.name}" + (f" ({dur:.3f}ms)" if dur else "")
+                     + (f" {tags}" if tags else ""))
+    parts = []
+    for p, d in sorted(att["phases"].items(),
+                       key=lambda kv: -kv[1]["exposed_ms"]):
+        s = f"{p} {d['exposed_ms']:.3f}ms"
+        if d["overlapped_ms"]:
+            s += f" ({d['overlapped_ms']:.3f}ms overlapped)"
+        parts.append(s)
+    lines.append(f"attribution: {' | '.join(parts)}  "
+                 f"dominant={att['dominant_phase']}  "
+                 f"gap={att['gap_ms']:.3f}ms")
+    return "\n".join(lines) + "\n"
+
+
+def to_chrome(traces) -> list[dict]:
+    """Chrome-trace events for one or more traces: one pid LANE per
+    tier, one tid row per request — the same timebase as
+    ``obs.tracing`` spans, so ``tools.trace_merge`` (with its
+    ``ts_offsets`` clock-alignment path for cross-process tiers) merges
+    request traces and process span traces into one timeline."""
+    if isinstance(traces, TraceContext):
+        traces = [traces]
+    tier_pids: dict[str, int] = {}
+    evs: list[dict] = []
+    for tr in traces:
+        for s in tr.spans:
+            pid = tier_pids.setdefault(s.tier, 9000 + len(tier_pids))
+            ev = {"name": s.name, "cat": "request", "ph": "X",
+                  "ts": s.t0_us, "dur": s.dur_us,
+                  "pid": pid, "tid": tr.req_id,
+                  "args": {"trace_id": tr.trace_id, **s.tags}}
+            evs.append(ev)
+        for e in tr.events:
+            pid = tier_pids.setdefault(e.tier or "serve",
+                                       9000 + len(tier_pids))
+            if e.t1_us > e.t0_us:
+                evs.append({"name": e.name, "cat": "request", "ph": "X",
+                            "ts": e.t0_us, "dur": e.t1_us - e.t0_us,
+                            "pid": pid, "tid": tr.req_id,
+                            "args": {"trace_id": tr.trace_id, **e.tags}})
+            else:
+                evs.append({"name": e.name, "cat": "request", "ph": "i",
+                            "s": "p", "ts": e.t0_us, "pid": pid,
+                            "tid": tr.req_id,
+                            "args": {"trace_id": tr.trace_id, **e.tags}})
+    return evs
+
+
+def export_chrome(path: str, traces=None) -> str:
+    """Write traces (default: the whole ring) as Chrome-trace JSON in
+    the exact envelope layout ``obs.tracing.export`` uses, so
+    ``tools.trace_merge.merge_traces`` (native or Python, with
+    ``ts_offsets``) accepts it like any per-process span file."""
+    if traces is None:
+        traces = RING.recent(len(RING))
+    with open(path, "w") as f:
+        f.write('{"displayTimeUnit":"ms","traceEvents":')
+        f.write(json.dumps(to_chrome(traces), separators=(",", ":")))
+        f.write("}")
+    return path
+
+
+def export_traces(path: str, traces=None) -> str:
+    """JSON dump of traces (default: the ring) for offline waterfall /
+    attribution (``obs_report.py --request <id> --trace-file dump``)."""
+    if traces is None:
+        traces = RING.recent(len(RING))
+    with open(path, "w") as f:
+        json.dump({"traces": [tr.to_dict() for tr in traces]}, f,
+                  indent=1, sort_keys=True)
+    return path
+
+
+def load_traces(path: str) -> list[TraceContext]:
+    with open(path) as f:
+        obj = json.load(f)
+    if isinstance(obj, dict) and "traces" in obj:
+        return [from_dict(d) for d in obj["traces"]]
+    if isinstance(obj, dict):
+        return [from_dict(obj)]
+    return [from_dict(d) for d in obj]

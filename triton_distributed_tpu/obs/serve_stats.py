@@ -51,10 +51,18 @@ class QuantileSketch:
     ``alpha`` relative error of the true quantile by construction.
     Non-positive observations land in a dedicated zero bucket (rank 0
     side).  Thread-safe; ``merge`` adds another sketch of the SAME gamma.
+
+    **Exemplar slots** (ISSUE 14): ``observe(v, exemplar="t42-001a")``
+    additionally remembers the LAST exemplar id per bucket (one string
+    per live bucket — bounded by the bucket cap), and ``exemplar(q)``
+    returns the id stored in the q-rank bucket: "show me a p99 request"
+    resolves to a retained trace id in one call.  Omitting the exemplar
+    argument keeps the sketch byte-identical to the pre-exemplar shape.
     """
 
     __slots__ = ("alpha", "gamma", "_lg", "max_buckets", "_lock",
-                 "_buckets", "_zero", "_count", "_sum", "_min", "_max")
+                 "_buckets", "_zero", "_count", "_sum", "_min", "_max",
+                 "_exemplars", "_zero_exemplar")
 
     def __init__(self, alpha: float = DEFAULT_ALPHA,
                  max_buckets: int = 4096):
@@ -71,11 +79,13 @@ class QuantileSketch:
         self._sum = 0.0
         self._min = math.inf
         self._max = -math.inf
+        self._exemplars: dict[int, str] = {}
+        self._zero_exemplar: str | None = None
 
     def _key(self, v: float) -> int:
         return math.ceil(math.log(v) / self._lg)
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: str | None = None) -> None:
         v = float(v)
         with self._lock:
             self._count += 1
@@ -86,15 +96,22 @@ class QuantileSketch:
                 self._max = v
             if v <= 0.0:
                 self._zero += 1
+                if exemplar is not None:
+                    self._zero_exemplar = exemplar
                 return
             k = self._key(v)
             self._buckets[k] = self._buckets.get(k, 0) + 1
+            if exemplar is not None:
+                self._exemplars[k] = exemplar
             if len(self._buckets) > self.max_buckets:
                 # collapse the two smallest keys (lowest-latency tail):
                 # high quantiles — the serving signal — stay exact-bound
                 ks = sorted(self._buckets)
                 self._buckets[ks[1]] = (self._buckets.pop(ks[0])
                                         + self._buckets[ks[1]])
+                ex = self._exemplars.pop(ks[0], None)
+                if ex is not None:
+                    self._exemplars.setdefault(ks[1], ex)
 
     @property
     def count(self) -> int:
@@ -124,6 +141,8 @@ class QuantileSketch:
             buckets = dict(other._buckets)
             zero, count, s = other._zero, other._count, other._sum
             mn, mx = other._min, other._max
+            exemplars = dict(other._exemplars)
+            zex = other._zero_exemplar
         with self._lock:
             for k, c in buckets.items():
                 self._buckets[k] = self._buckets.get(k, 0) + c
@@ -132,10 +151,36 @@ class QuantileSketch:
             self._sum += s
             self._min = min(self._min, mn)
             self._max = max(self._max, mx)
+            self._exemplars.update(exemplars)
+            if zex is not None:
+                self._zero_exemplar = zex
+
+    def exemplar(self, q: float) -> str | None:
+        """The exemplar id stored in the q-rank bucket (None when that
+        bucket never saw one — e.g. traffic recorded with the trace
+        plane off, or under ``obs.suppress()``)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        with self._lock:
+            return self._exemplar_unlocked(q)
+
+    def _exemplar_unlocked(self, q: float) -> str | None:
+        # the same bucket walk as quantile_unlocked, lock held by caller
+        if not self._count:
+            return None
+        rank = q * (self._count - 1)
+        seen = self._zero
+        if rank < seen:
+            return self._zero_exemplar
+        for k in sorted(self._buckets):
+            seen += self._buckets[k]
+            if rank < seen:
+                return self._exemplars.get(k)
+        return None
 
     def to_dict(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "alpha": self.alpha, "count": self._count,
                 "sum": self._sum,
                 "min": self._min if self._count else None,
@@ -143,6 +188,15 @@ class QuantileSketch:
                 "quantiles": {f"p{int(q * 100)}": self.quantile_unlocked(q)
                               for q in SERVE_QUANTILES},
             }
+            if self._exemplars or self._zero_exemplar:
+                # additive (only when the feed attached trace ids), and
+                # computed under the SAME lock hold as the quantiles so
+                # the id next to a p99 value belongs to the same state
+                out["exemplars"] = {
+                    f"p{int(q * 100)}": self._exemplar_unlocked(q)
+                    for q in SERVE_QUANTILES
+                }
+        return out
 
     def quantile_unlocked(self, q: float) -> float:
         # the walk itself, lock held by the caller (quantile / to_dict)
@@ -275,14 +329,19 @@ class ServeStats:
 
     # -- scheduler feeds (serve.Scheduler; gated on obs.enabled() there) ---
 
-    def observe_ttft(self, ms: float) -> None:
-        self.ttft_ms.observe(float(ms))
+    def observe_ttft(self, ms: float,
+                     exemplar: str | None = None) -> None:
+        """``exemplar``: the request's trace id (TDT_TRACE=1 only) —
+        the p99 bucket then answers "show me a p99 request" with a
+        retained trace id (``obs.request_trace``)."""
+        self.ttft_ms.observe(float(ms), exemplar)
 
-    def request_completed(self, e2e_ms: float, *, tokens: int = 0) -> None:
+    def request_completed(self, e2e_ms: float, *, tokens: int = 0,
+                          exemplar: str | None = None) -> None:
         """One scheduler-completed request: end-to-end latency (submit
         -> last token) into the request sketch; the per-step token feed
         happens at decode time, not here."""
-        self.request_ms.observe(float(e2e_ms))
+        self.request_ms.observe(float(e2e_ms), exemplar)
         self.requests.add(1.0)
         del tokens   # tokens ride the per-step feed; kept for call shape
 

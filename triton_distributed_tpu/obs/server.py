@@ -22,7 +22,12 @@
 - ``GET /debug/serve``   — the live serve-stats snapshot plus, when the
   registered health source is a continuous-batching scheduler
   (``serve.Scheduler`` — it exposes ``debug_state()``), its queue /
-  page-pool / slot / degradation-governor state.
+  page-pool / slot / degradation-governor state, and the request-trace
+  plane's p99 exemplar ids (TDT_TRACE=1).
+- ``GET /debug/trace``   — the retained-trace ring listing;
+  ``/debug/trace/<id>`` one trace's spans, overlay events and SLO
+  attribution (``obs.request_trace``) — the SLO-debugging workflow's
+  last hop: 503 -> exemplar id -> waterfall (docs/serving.md).
 
 The health source registered via ``maybe_start`` / ``register_engine``
 may be an :class:`~..models.engine.Engine` or a
@@ -108,11 +113,18 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(self._telemetry().serve_dump(),
                                            default=str),
                            "application/json")
+            elif path == "/debug/trace" or path.startswith("/debug/trace/"):
+                trace_id = path[len("/debug/trace/"):] \
+                    if path.startswith("/debug/trace/") else None
+                code, body = self._telemetry().trace_dump(trace_id)
+                self._send(code, json.dumps(body, default=str),
+                           "application/json")
             else:
                 self._send(404, json.dumps({
                     "error": f"unknown path {path!r}",
                     "endpoints": ["/metrics", "/healthz", "/debug/flight",
-                                  "/debug/timeline", "/debug/serve"],
+                                  "/debug/timeline", "/debug/serve",
+                                  "/debug/trace"],
                 }), "application/json")
         except BrokenPipeError:
             pass
@@ -181,15 +193,50 @@ class TelemetryServer:
         """The scheduler inspection endpoint (``/debug/serve``): the
         live serve-stats snapshot plus — when the registered health
         source is a scheduler (or anything exposing ``debug_state()``)
-        — its queue / pool / slot / governor state."""
-        from . import serve_stats
+        — its queue / pool / slot / governor state, plus the request-
+        trace plane's exemplar ids (TDT_TRACE=1): the p99 buckets of
+        the TTFT and request-latency sketches name the retained traces
+        that landed there — the "show me a p99 request" entry point
+        (follow with ``/debug/trace/<id>``)."""
+        from . import request_trace, serve_stats
 
         out: dict = {"serve_stats": serve_stats.STATS.snapshot()}
         src = self._engine_ref()
         debug = getattr(src, "debug_state", None)
         if callable(debug):
             out["scheduler"] = debug()
+        out["trace"] = {
+            "enabled": request_trace.enabled(),
+            "retained": len(request_trace.RING),
+            "exemplars": {
+                "ttft_ms_p99": serve_stats.STATS.ttft_ms.exemplar(0.99),
+                "request_ms_p99":
+                    serve_stats.STATS.request_ms.exemplar(0.99),
+            },
+        }
         return out
+
+    def trace_dump(self, trace_id: str | None = None) -> tuple[int, dict]:
+        """``/debug/trace`` (ring listing) and ``/debug/trace/<id>``
+        (one retained trace: spans, events, SLO attribution)."""
+        from . import request_trace
+
+        if not trace_id:
+            return 200, {
+                "enabled": request_trace.enabled(),
+                "cap": request_trace.RING.cap,
+                "retained": len(request_trace.RING),
+                "ids": request_trace.RING.ids(),
+            }
+        tr = request_trace.RING.get(trace_id)
+        if tr is None:
+            return 404, {
+                "error": f"trace {trace_id!r} not retained (ring keeps "
+                         f"the last {request_trace.RING.cap} completed "
+                         f"traces)",
+                "ids": request_trace.RING.ids()[-16:],
+            }
+        return 200, tr.to_dict()
 
     def flight_dump(self, n: int = 256) -> dict:
         from . import flight

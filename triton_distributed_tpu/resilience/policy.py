@@ -154,6 +154,8 @@ def resilient_call(op: str, thunk, *, fallback=None,
         if obs.enabled():
             obs.counter("resilience_degraded_calls", op=op,
                         reason="quarantined_peer").inc()
+        obs.request_trace.note_rung(
+            op, "fallback", "team contains a quarantined peer")
         return fallback()
 
     br = breaker(op, policy.breaker_threshold)
@@ -163,6 +165,11 @@ def resilient_call(op: str, thunk, *, fallback=None,
         if obs.enabled():
             obs.counter("resilience_degraded_calls", op=op,
                         reason="breaker_open").inc()
+        # ladder rung -> the active request trace (TDT_TRACE=1): one
+        # thread-local read when no trace is bound (obs.request_trace)
+        obs.request_trace.note_rung(
+            op, "fallback", f"breaker open after {br.failures} "
+                            f"consecutive failures")
         return fallback()
 
     last: BaseException | None = None
@@ -179,6 +186,7 @@ def resilient_call(op: str, thunk, *, fallback=None,
             if attempt < policy.max_retries:
                 if obs.enabled():
                     obs.counter("resilience_retries", op=op).inc()
+                obs.request_trace.note_rung(op, "retry", str(e))
                 if backoff > 0:
                     time.sleep(backoff / 1e3)
                 backoff *= policy.backoff_factor
@@ -188,6 +196,8 @@ def resilient_call(op: str, thunk, *, fallback=None,
         if obs.enabled():
             obs.counter("resilience_degraded_calls", op=op,
                         reason="retries_exhausted").inc()
+        obs.request_trace.note_rung(op, "fallback",
+                                    f"retries exhausted: {last}")
         result = fallback()
         return result
     assert last is not None

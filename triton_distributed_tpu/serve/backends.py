@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..models.kv_cache import (
     PagedKVCache,
     advance,
@@ -143,14 +144,18 @@ class SimBackend:
     def prefill_chunk(self, cache: PagedKVCache, pages_row, chunk,
                       start: int, total_len: int):
         chunk = np.asarray(chunk, np.int32)
-        view = _slot_view(cache, pages_row, start)
-        vals = jnp.broadcast_to(
-            jnp.asarray(chunk, jnp.float32)[None, None, :, None],
-            (1, self.kv_heads, len(chunk), self.head_dim),
-        )
-        for layer in range(self.num_layers):
-            view = write_chunk_paged(view, layer, vals, vals, start)
-        cache = _merge_pools(cache, view)
+        # compute-category span (ISSUE 14 satellite): serve dispatches
+        # land in the same process Chrome trace as the comm spans, so
+        # the overlap report and the request traces share one timeline
+        with obs.span("sim_prefill_chunk", "compute", tokens=len(chunk)):
+            view = _slot_view(cache, pages_row, start)
+            vals = jnp.broadcast_to(
+                jnp.asarray(chunk, jnp.float32)[None, None, :, None],
+                (1, self.kv_heads, len(chunk), self.head_dim),
+            )
+            for layer in range(self.num_layers):
+                view = write_chunk_paged(view, layer, vals, vals, start)
+            cache = _merge_pools(cache, view)
         first = None
         if start + len(chunk) == total_len:
             first = self.next_token(int(chunk[-1]), total_len)
@@ -164,14 +169,15 @@ class SimBackend:
         if self.step_hook is not None:
             self.step_hook(step)
         tokens = np.asarray(tokens, np.int32)
-        tok = jnp.asarray(tokens)
-        vals = jnp.broadcast_to(
-            tok.astype(jnp.float32)[:, None, None],
-            (self.slots, self.kv_heads, self.head_dim),
-        )
-        for layer in range(self.num_layers):
-            cache = append_paged(cache, layer, vals, vals)
-        cache = advance(cache, 1)
+        with obs.span("sim_decode", "compute", step=step):
+            tok = jnp.asarray(tokens)
+            vals = jnp.broadcast_to(
+                tok.astype(jnp.float32)[:, None, None],
+                (self.slots, self.kv_heads, self.head_dim),
+            )
+            for layer in range(self.num_layers):
+                cache = append_paged(cache, layer, vals, vals)
+            cache = advance(cache, 1)
         new_lens = np.asarray(cache.seq_lens)
         nxt = np.asarray(
             [self.next_token(t, int(l)) for t, l in zip(tokens, new_lens)],
@@ -307,9 +313,10 @@ class EngineBackend:
         ids = jnp.asarray(
             np.pad(chunk, (0, pad))[None, :], jnp.int32)
         view = _slot_view(cache, pages_row, start)
-        logits, view = self._prefill_chunk(
-            self.engine.params, view, ids, jnp.int32(start),
-            jnp.int32(true))
+        with obs.span("prefill_chunk", "compute", tokens=true):
+            logits, view = self._prefill_chunk(
+                self.engine.params, view, ids, jnp.int32(start),
+                jnp.int32(true))
         cache = _merge_pools(cache, view)
         first = None
         if start + true == total_len:
@@ -338,12 +345,13 @@ class EngineBackend:
         steps = int(steps)
         tok = jnp.asarray(np.asarray(tokens, np.int32))
         ex = self._decode_exec.get(steps)
-        if ex is not None:
-            toks, cache = self.engine._call_exec(
-                ex, self.engine.params, self._stacked, cache, tok)
-        else:
-            toks, cache = self._decode_multi(
-                self.engine.params, self._stacked, cache, tok, steps)
+        with obs.span("decode_multi", "compute", steps=steps):
+            if ex is not None:
+                toks, cache = self.engine._call_exec(
+                    ex, self.engine.params, self._stacked, cache, tok)
+            else:
+                toks, cache = self._decode_multi(
+                    self.engine.params, self._stacked, cache, tok, steps)
         return cache, np.asarray(toks, np.int32)
 
     def _resolve_persistent_config(self):

@@ -434,14 +434,23 @@ class HandoffPlane:
         self.corruptions: list[dict] = []
         self.handoff_ms: list[float] = []
 
-    def transfer(self, payload: PagePayload) -> PagePayload | None:
+    def transfer(self, payload: PagePayload,
+                 trace=None) -> PagePayload | None:
         """Run one transfer down the ladder.  Returns the VERIFIED
         arrived payload, or None when the ladder bottomed out (retries
         exhausted, or the sticky ``handoff_transfer`` breaker is open)
         — the caller's cue for the terminal fallback, re-prefill on the
         decode tier.  A prefill-slice ``RankAborted`` propagates: there
-        is nothing left to retry against."""
+        is nothing left to retry against.
+
+        ``trace`` (TDT_TRACE=1, ``obs.request_trace``): the request's
+        trace context — per-attempt DCN wire time and stamp-verify time
+        land as overlay events (the wire/verify split of the handoff
+        phase), and the ladder's retry rungs attach their reason
+        strings through ``request_trace.activate`` so a faulted
+        transfer's trace names every rung it burned."""
         from .. import resilience
+        from ..obs import request_trace
 
         deadline = resilience.deadline_ms(
             HANDOFF_OP, payload_bytes=payload.payload_bytes, num_ranks=2)
@@ -455,10 +464,26 @@ class HandoffPlane:
                 self.retries += 1
                 if obs.enabled():
                     obs.counter("handoff_retries").inc()
-            arrived, ms = self.dcn.transmit(
-                payload, deadline_ms=deadline, priority=dcn.LATENCY,
-                attempt=a)
+            t0 = trace.now_us() if trace is not None else 0.0
+            try:
+                arrived, ms = self.dcn.transmit(
+                    payload, deadline_ms=deadline, priority=dcn.LATENCY,
+                    attempt=a)
+            except Exception as e:
+                if trace is not None:
+                    trace.event("handoff_wire", t0, trace.now_us(),
+                                tier="wire", attempt=a,
+                                error=type(e).__name__)
+                raise
+            t1 = trace.now_us() if trace is not None else 0.0
+            if trace is not None:
+                trace.event("handoff_wire", t0, t1, tier="wire",
+                            attempt=a, modeled_ms=round(float(ms), 4))
             diag = verify_payload(arrived)
+            if trace is not None:
+                trace.event("stamp_verify", t1, trace.now_us(),
+                            tier="wire", attempt=a,
+                            clean=diag is None)
             if diag is not None:
                 self.corruptions.append({
                     "req_id": payload.req_id, "chunk": diag.chunk,
@@ -469,9 +494,10 @@ class HandoffPlane:
                 raise PayloadCorruption(HANDOFF_OP, diag)
             return arrived, ms
 
-        result = resilience.resilient_call(
-            HANDOFF_OP, thunk, fallback=lambda: None,
-            deadline_ms=deadline, policy=self._policy)
+        with request_trace.activate(trace):
+            result = resilience.resilient_call(
+                HANDOFF_OP, thunk, fallback=lambda: None,
+                deadline_ms=deadline, policy=self._policy)
         if result is None:
             self.exhausted += 1
             if obs.enabled():

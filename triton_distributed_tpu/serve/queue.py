@@ -82,6 +82,12 @@ class Request:
     # pages, taken at preemption and verified when the recompute's
     # prefill completes; None on every path with integrity off
     kv_stamps: dict | None = None
+    # per-request trace context (TDT_TRACE=1, obs.request_trace):
+    # minted at Scheduler.submit, propagated across every hop — queue,
+    # prefill chunks, handoff, adoption, decode windows, preemption —
+    # and retired into the trace ring at the terminal state.  Always
+    # None with the trace plane off (zero behavior change)
+    trace: object | None = None
 
     def __post_init__(self):
         self.prompt = tuple(int(t) for t in self.prompt)
@@ -128,10 +134,19 @@ class RequestQueue:
             raise ValueError(f"max_depth {max_depth} < 1")
         self.max_depth = int(max_depth)
         self._lock = threading.Lock()
-        self._items: list[tuple] = []   # (-prio, fresh, seq, Request)
+        # (-prio, fresh, seq, Request, enqueued_s) — enqueued_s is THIS
+        # residency's entry time (a preempted re-queue restarts it), the
+        # clock behind the queued-age high-water mark below
+        self._items: list[tuple] = []
         self._seq = itertools.count()
         self.sheds = 0
         self.submitted = 0
+        # queued-age high-water per priority class (ISSUE 14 small fix):
+        # the depth gauge is a snapshot, so a starving low-priority
+        # request is invisible the moment deadline expiry sheds it —
+        # this mark keeps the evidence: the LONGEST any request of each
+        # priority has sat in the queue, updated on every sweep
+        self.age_high_water_s: dict[int, float] = {}
 
     def __len__(self) -> int:
         with self._lock:
@@ -157,7 +172,8 @@ class RequestQueue:
             req.submitted_s = now if req.submitted_s is None \
                 else req.submitted_s
             req.state = RequestState.QUEUED
-            self._items.append((-req.priority, 1, next(self._seq), req))
+            self._items.append((-req.priority, 1, next(self._seq), req,
+                                now))
             self._items.sort()
             return True
 
@@ -173,7 +189,8 @@ class RequestQueue:
         # first_token_s is KEPT: TTFT is a once-per-request SLO sample
         # from the first admission
         with self._lock:
-            self._items.append((-req.priority, 0, next(self._seq), req))
+            self._items.append((-req.priority, 0, next(self._seq), req,
+                                time.monotonic()))
             self._items.sort()
 
     def peek(self) -> Request | None:
@@ -211,6 +228,12 @@ class RequestQueue:
             keep = []
             for item in self._items:
                 req = item[3]
+                # the high-water update rides the sweep (every tick AND
+                # every submit), so the mark is current BEFORE the
+                # expiry below deletes the starving request
+                age = now - item[4]
+                if age > self.age_high_water_s.get(req.priority, 0.0):
+                    self.age_high_water_s[req.priority] = age
                 rem = req.remaining_ms(now)
                 if rem is not None and rem <= 0:
                     self.sheds += 1
@@ -233,4 +256,11 @@ class RequestQueue:
                 "submitted": self.submitted,
                 "sheds": self.sheds,
                 "queued_ids": [it[3].req_id for it in self._items],
+                # per-priority high-water queued age (seconds): survives
+                # the request leaving the queue, so /debug/serve shows a
+                # starvation episode even after expiry shed the evidence
+                "queued_age_hw_s": {
+                    prio: round(age, 6) for prio, age in
+                    sorted(self.age_high_water_s.items())
+                },
             }

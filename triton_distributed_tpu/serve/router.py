@@ -112,6 +112,9 @@ class DisaggRouter:
                 f"and kv dtypes may differ freely)")
         self.prefill = prefill
         self.decode = decode
+        # request traces name the tier each hop ran on (TDT_TRACE=1)
+        prefill.trace_tier = "prefill"
+        decode.trace_tier = "decode"
         # the re-prefill stamp carry (fold32 over the producer's POOL
         # bytes) only pins a recompute on a tier with the SAME pool
         # layout: a decode tier storing int8 where the prefill tier
@@ -182,6 +185,12 @@ class DisaggRouter:
             f"{self.debug_state()}")
 
     def _pump_handoffs(self) -> None:
+        # the pump runs under a process-level span (ISSUE 14 satellite)
+        # so the router shares the scheduler ticks' Chrome timeline
+        with obs.span("router_pump", "step"):
+            self._pump_handoffs_impl()
+
+    def _pump_handoffs_impl(self) -> None:
         from ..comm import dcn
         from ..resilience.faults import RankAborted
 
@@ -209,11 +218,18 @@ class DisaggRouter:
                     self.colocated += 1
                 continue
             self._park_strikes.pop(req.req_id, None)
+            tr = req.trace
+            if tr is not None:
+                tr.begin("handoff_extract", tier=self.prefill.trace_tier)
             payload = handoff_mod.extract_payload(
                 self.prefill.cache, slot.pages, req, slot.next_token,
                 wire_dtype=self.plane.cfg.wire_dtype)
+            if tr is not None:
+                tr.begin("handoff_transfer", tier=self.prefill.trace_tier,
+                         pages=payload.n_pages,
+                         bytes=payload.payload_bytes, wire=payload.wire)
             try:
-                arrived = self.plane.transfer(payload)
+                arrived = self.plane.transfer(payload, trace=tr)
             except RankAborted as e:
                 # the prefill slice died mid-handoff: nothing to retry
                 # against — the decode tier recomputes from the prompt
@@ -271,6 +287,11 @@ class DisaggRouter:
         self.prefill.release_handoff(i)
         self.reprefills += 1
         self.reprefill_ids.add(req.req_id)
+        if req.trace is not None:
+            # the terminal-fallback rung, named: the decode.submit below
+            # re-enters the queue phase on the SAME chain
+            req.trace.annotate("reprefill", tier=self.decode.trace_tier,
+                               reason=reason)
         if obs.enabled():
             obs.counter("handoff_reprefills").inc()
         if not self.decode.submit(req):
@@ -279,7 +300,6 @@ class DisaggRouter:
             # nothing leaks
             if obs.enabled():
                 obs.counter("handoff_reprefill_shed").inc()
-        del reason  # carried in counters; the request error stays clean
 
     # -- health / introspection --------------------------------------------
 

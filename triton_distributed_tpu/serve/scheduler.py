@@ -163,6 +163,10 @@ class Scheduler:
         self._saturated_since: float | None = None
         # TDT_INTEGRITY=1 KV-pool audit findings (req_id, page, step)
         self.kv_corruptions: list[dict] = []
+        # request-trace tier tag (TDT_TRACE=1, obs.request_trace): the
+        # router renames its tiers "prefill"/"decode" so cross-tier
+        # span chains name where each hop ran
+        self.trace_tier = "serve"
 
     # -- submission --------------------------------------------------------
 
@@ -172,6 +176,11 @@ class Scheduler:
         immediately with a typed reason — queueing it would waste its
         deadline on an impossible promise."""
         now = time.monotonic() if now is None else now
+        # mint (or, for a re-prefill resubmission, resume) the request
+        # trace BEFORE the shed checks so a shed-at-submit is a traced
+        # terminal outcome too; None whenever TDT_TRACE is off or this
+        # thread is suppressed — every later hop then no-ops
+        obs.request_trace.maybe_begin(req, self.trace_tier)
         # eager deadline sweep (ISSUE 7 satellite): expired entries must
         # not occupy depth against THIS submit — between ticks a burst
         # would otherwise shed viable work because the queue is "full"
@@ -204,7 +213,14 @@ class Scheduler:
 
     def step(self) -> StepResult:
         """One scheduling iteration: expire -> admit -> prefill ->
-        decode -> account."""
+        decode -> account.  The tick runs under a process-level
+        ``step`` span (ISSUE 14 satellite) so the scheduler shares one
+        Chrome timeline with the comm/compute spans and the per-request
+        traces."""
+        with obs.span("sched_step", "step", tier=self.trace_tier):
+            return self._step_impl()
+
+    def _step_impl(self) -> StepResult:
         now = time.monotonic()
         res = StepResult()
         self.steps += 1
@@ -300,6 +316,9 @@ class Scheduler:
             req.state = RequestState.PREFILL
             self.slots[slot_idx] = SlotState(request=req, pages=pages)
             admitted += 1
+            if req.trace is not None:
+                req.trace.annotate("admitted", tier=self.trace_tier,
+                                   slot=slot_idx, pages=len(pages))
             if obs.enabled():
                 obs.counter("serve_admitted").inc()
         # saturation: pool pressure with a live backlog
@@ -332,6 +351,16 @@ class Scheduler:
             if bucket is not None:
                 take = min(take, bucket)
             chunk = req.prompt[slot.prefill_pos:slot.prefill_pos + take]
+            if req.trace is not None:
+                # chunk index + true_len land as tags; a recompute
+                # (preemption restore or re-prefill fallback) is marked
+                # so the attributor can name the re-paid prefill work
+                req.trace.begin(
+                    "prefill_chunk", tier=self.trace_tier,
+                    start=slot.prefill_pos, tokens=int(take),
+                    true_len=plen,
+                    recompute=bool(req.preemptions
+                                   or req.kv_stamps is not None))
             try:
                 self.cache, first = self.backend.prefill_chunk(
                     self.cache, np.asarray(slot.pages, np.int32), chunk,
@@ -357,17 +386,28 @@ class Scheduler:
                 # observed here in both modes
                 if self.cfg.prefill_only and req.max_new_tokens > 1:
                     req.state = RequestState.HANDOFF
+                    if req.trace is not None:
+                        req.trace.begin("handoff_wait",
+                                        tier=self.trace_tier)
                 else:
                     req.state = RequestState.DECODE
+                    if req.trace is not None:
+                        req.trace.begin("decode_wait",
+                                        tier=self.trace_tier)
                 # TTFT is a per-REQUEST SLO, observed once on the FIRST
                 # admission; a preempted request's re-prefill must not
                 # contribute a second sample (it would inflate the p99
                 # exactly in the thrash regime the sketch characterizes)
                 if req.first_token_s is None:
                     req.first_token_s = time.monotonic()
+                    if req.trace is not None:
+                        req.trace.mark_first_token()
                     ttft = req.ttft_ms()
                     if obs.enabled() and ttft is not None:
-                        obs.serve_stats.STATS.observe_ttft(ttft)
+                        obs.serve_stats.STATS.observe_ttft(
+                            ttft,
+                            exemplar=None if req.trace is None
+                            else req.trace.trace_id)
                 if req.max_new_tokens == 1:
                     self._finish_slot(i)
         return done_tokens
@@ -413,6 +453,13 @@ class Scheduler:
         for i in active:
             tokens[i] = self.slots[i].next_token
         window = self._window_steps(active)
+        for i in active:
+            tr = self.slots[i].request.trace
+            if tr is not None:
+                # window size + membership cohort (the PR-12
+                # _window_steps decision) tag every dispatch hop
+                tr.begin("decode_window", tier=self.trace_tier,
+                         window=window, cohort=len(active))
 
         from .. import resilience
 
@@ -679,6 +726,9 @@ class Scheduler:
         assert slot is not None and \
             slot.request.state is RequestState.HANDOFF
         slot.request.state = RequestState.DECODE
+        if slot.request.trace is not None:
+            slot.request.trace.annotate("colocated", tier=self.trace_tier)
+            slot.request.trace.begin("decode_wait", tier=self.trace_tier)
         if obs.enabled():
             obs.counter("handoff_colocated").inc()
 
@@ -733,6 +783,9 @@ class Scheduler:
         pages = self.pool.try_alloc(need)
         if pages is None:
             return False
+        if req.trace is not None:
+            req.trace.begin("adopt", tier=self.trace_tier,
+                            length=int(length), pages=need)
         try:
             self.cache = implant(self.cache, pages)
         except Exception:
@@ -745,6 +798,8 @@ class Scheduler:
             request=req, pages=pages, length=int(length),
             prefill_pos=req.prompt_len, next_token=int(next_token))
         self.admitted += 1
+        if req.trace is not None:
+            req.trace.begin("decode_wait", tier=self.trace_tier)
         if obs.enabled():
             obs.counter("serve_adopted").inc()
         return True
@@ -782,11 +837,13 @@ class Scheduler:
         req.state = RequestState.DONE
         req.finished_s = time.monotonic()
         self.completed.append(req)
+        obs.request_trace.finish(req)
         if obs.enabled():
             e2e_ms = (req.finished_s - (req.submitted_s or req.finished_s)) \
                 * 1e3
             obs.serve_stats.STATS.request_completed(
-                e2e_ms, tokens=len(req.tokens))
+                e2e_ms, tokens=len(req.tokens),
+                exemplar=None if req.trace is None else req.trace.trace_id)
             obs.counter("serve_completed").inc()
 
     def _fail_slot(self, i: int, error: str, now: float) -> None:
@@ -796,6 +853,7 @@ class Scheduler:
         req.error = error
         req.finished_s = now
         self.failed.append(req)
+        obs.request_trace.finish(req)
         if obs.enabled():
             obs.serve_stats.STATS.request_failed()
             obs.counter("serve_failed").inc()
@@ -803,6 +861,11 @@ class Scheduler:
     def _preempt_slot(self, i: int) -> None:
         slot = self._release_slot(i)
         npages = len(slot.pages)
+        if slot.request.trace is not None:
+            # the span runs until the recompute's first prefill chunk:
+            # requeue wait + the re-paid admission are one episode
+            slot.request.trace.begin("preempted", tier=self.trace_tier,
+                                     pages=npages)
         self.preemptions += 1
         self.evicted_pages += npages
         self.governor.note_preemption()
@@ -827,6 +890,7 @@ class Scheduler:
 
     def _note_shed(self, req: Request) -> None:
         self.shed.append(req)
+        obs.request_trace.finish(req)
         if obs.enabled():
             obs.serve_stats.STATS.request_shed()
             obs.counter("serve_shed").inc()

@@ -14,6 +14,8 @@ Usage:
     python scripts/tdt_lint.py --ranks 2,4       # restrict rank counts
     python scripts/tdt_lint.py --kernel gemm_rs  # name filter (substring)
     python scripts/tdt_lint.py --selftest        # seeded-bad fixture battery
+    python scripts/tdt_lint.py --dpor            # schedule-exhaustive (DPOR) gate
+    python scripts/tdt_lint.py --completeness    # cross-subsystem wiring gate
     python scripts/tdt_lint.py --faults          # fault-injection matrix
     python scripts/tdt_lint.py --faults --seed 7 # reseed the injection
     python scripts/tdt_lint.py --timeline        # flight-timeline smoke
@@ -110,11 +112,35 @@ request-latency p99 exemplar ids resolve to retained ring traces, and
 the drop-faulted request's trace names every retry rung plus the
 re-prefill fallback.  Headless and CPU-only.
 
-``--all`` runs every gate above — verify matrix, ``--faults``,
-``--timeline``, ``--serve``, ``--history``, ``--integrity``,
-``--quant``, ``--hier``, ``--handoff``, ``--persistent``, ``--trace``
-— and summarizes them under a single exit code (the CI entry; see
-README).
+``--dpor`` is the schedule-exhaustive gate (ISSUE 15,
+docs/static_analysis.md "Schedule exhaustiveness"): the canonical
+maximal execution is sound for deadlock but NOT for the credit->wait
+matching on multi-producer semaphore pools, so this leg model-checks
+every registry case over ALL schedules up to equivalence (dynamic
+partial-order reduction: sleep sets + singleton persistent sets over
+the credit-FIFO independence relation; branch points exactly at
+multi-producer credit races), re-running deadlock + write-overlap per
+class, with a context-switch-bounded mode (``--explore-bound``,
+default 2) and per-case schedule/time caps that print PRUNED rather
+than masking; then the DPOR fixture selftest pins BOTH directions —
+each order-dependent fixture passes every canonical check AND is
+flagged under reordering with the reused slot named.
+
+``--completeness`` is the cross-subsystem wiring gate (ISSUE 15):
+every family in ``analysis.registry`` must have an
+``obs.costs.FAMILY_COSTS`` calculator, a ``resilience.fallbacks``
+entry (or documented watchdog-only / rides-base-family status),
+fault-matrix coverage, and a registered unique ``collective_id`` —
+golden-pinned in ``analysis.completeness`` so a family added without
+full wiring fails with the diff as the message; plus the static
+VMEM-footprint check on every family's DEFAULT tile config
+(``analysis.footprint``) at its representative serving shape.
+
+``--all`` runs every gate above — verify matrix, ``--dpor``,
+``--completeness``, ``--faults``, ``--timeline``, ``--serve``,
+``--history``, ``--integrity``, ``--quant``, ``--hier``,
+``--handoff``, ``--persistent``, ``--trace`` — and summarizes them
+under a single exit code (the CI entry; see README).
 
 ``--history`` runs the bench-record trend sentinel
 (``scripts/bench_history.py --check``): exit 1 when a committed
@@ -185,6 +211,23 @@ def main(argv: list[str] | None = None) -> int:
                          "the inter-layer semaphore named + the headless "
                          "dispatch-count assertion + a scheduler "
                          "window-parity smoke")
+    ap.add_argument("--dpor", action="store_true",
+                    help="schedule-exhaustive gate (ISSUE 15): the DPOR "
+                         "explorer over every registry case (bounded "
+                         "mode; see --explore-bound), plus the "
+                         "canonical-pass/DPOR-fail fixture selftest in "
+                         "both directions")
+    ap.add_argument("--explore-bound", default="2",
+                    help="DPOR preemption bound for --dpor (integer, or "
+                         "'exact' for the unbounded mode; default 2)")
+    ap.add_argument("--completeness", action="store_true",
+                    help="cross-subsystem completeness gate (ISSUE 15): "
+                         "every registry family must have a cost "
+                         "calculator, a fallback or documented "
+                         "watchdog-only status, fault-matrix coverage "
+                         "and a unique collective_id (golden-pinned), "
+                         "and every DEFAULT tile config must fit the "
+                         "static VMEM footprint budget")
     ap.add_argument("--trace", action="store_true", dest="trace_gate",
                     help="request-tracing gate (ISSUE 14): seeded "
                          "two-tier replay with a transfer drop under "
@@ -212,6 +255,10 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.all_gates:
         return _run_all(args)
+    if args.dpor:
+        return _run_dpor(args)
+    if args.completeness:
+        return _run_completeness(args)
     if args.faults:
         return _run_faults(args)
     if args.timeline:
@@ -254,6 +301,105 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     return _run_verify(args)
+
+
+def _run_dpor(args) -> int:
+    """The schedule-exhaustive gate (ISSUE 15, docs/static_analysis.md
+    "Schedule exhaustiveness"): (1) the DPOR explorer over every
+    registry kernel case — all schedules up to equivalence, deadlock +
+    write-overlap re-checked per class, branch points exactly at
+    multi-producer credit races; (2) the DPOR fixture selftest, pinning
+    BOTH directions of the soundness gap (each fixture passes every
+    canonical check AND fails under reordering with the reused slot
+    named).  A case hitting a resource cap prints PRUNED — bounded-mode
+    verification, never silent."""
+    from triton_distributed_tpu import analysis
+    from triton_distributed_tpu.analysis import fixtures
+
+    # same convention as TDT_VERIFY_EXPLORE: 'exact' or any negative
+    # integer means unbounded (a raw negative bound would silently
+    # behave like the WEAKEST bound, 0, while claiming exhaustiveness)
+    if args.explore_bound == "exact":
+        bound = None
+    else:
+        try:
+            bound = int(args.explore_bound)
+        except ValueError:
+            print(f"DPOR FAIL: --explore-bound {args.explore_bound!r}: "
+                  f"expected an integer preemption bound or 'exact'")
+            return 2
+    if bound is not None and bound < 0:
+        bound = None
+    ranks = tuple(int(r) for r in args.ranks.split(","))
+    problems: list[str] = []
+    rows = []
+    results = analysis.explore_all(ranks, kernel_filter=args.kernel,
+                                   preemption_bound=bound)
+    if not results:
+        problems.append(f"no kernel cases match --kernel {args.kernel!r}")
+    pruned = 0
+    classes = 0
+    for res in results:
+        status = "OK" if not res.violations else "VIOLATION"
+        extra = "  PRUNED" if res.pruned else ""
+        pruned += res.pruned
+        classes += res.schedules
+        print(f"{res.kernel:<28} ranks={res.n:<2} "
+              f"classes={res.schedules:<4} {status}{extra}")
+        for v in res.violations:
+            print(f"    [{v.check}] {v.message}")
+            problems.append(f"{res.kernel}: [{v.check}] {v.message}")
+        rows.append({"kernel": res.kernel, "ranks": res.n,
+                     "classes": res.schedules, "pruned": res.pruned,
+                     "violations": len(res.violations)})
+
+    selftest = fixtures.run_dpor_selftest()
+    problems += [f"dpor selftest: {p}" for p in selftest]
+
+    for p in problems:
+        print(f"DPOR FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"cases": rows, "selftest_problems": selftest,
+                       "problems": problems}, f, indent=1, sort_keys=True)
+    if problems:
+        return 1
+    print(f"dpor OK: {len(results)} cases x {classes} schedule classes "
+          f"clean under preemption bound "
+          f"{'exact' if bound is None else bound} ({pruned} case(s) "
+          f"capped); every order-dependent fixture passes canonically "
+          f"and fails under reordering with the reused slot named")
+    return 0
+
+
+def _run_completeness(args) -> int:
+    """The cross-subsystem completeness gate (ISSUE 15): the golden
+    wiring table (costs + fallback + fault coverage + collective_id per
+    registry family) diffed against the live modules, plus the static
+    footprint check on every family's DEFAULT tile config at its
+    representative serving shape."""
+    from triton_distributed_tpu.analysis import completeness, footprint
+
+    problems = completeness.check()
+    for fam, spec in sorted(completeness.GOLDEN.items()):
+        cid = spec["collective_id"]
+        print(f"{fam:<18} costs={','.join(spec['costs']):<40} "
+              f"fallback={spec['fallback'][:36]:<38} id={cid}")
+    fp_problems = footprint.check_defaults()
+    problems += fp_problems
+    print(f"default-config footprints: {len(fp_problems)} problem(s)")
+
+    for p in problems:
+        print(f"COMPLETENESS FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"problems": problems}, f, indent=1, sort_keys=True)
+    if problems:
+        return 1
+    print("completeness OK: every registry family priced, degradable "
+          "(or documented watchdog-only), fault-covered, and uniquely "
+          "id'd; every default tile config fits its static VMEM budget")
+    return 0
 
 
 def _run_verify(args) -> int:
@@ -484,6 +630,8 @@ def _run_all(args) -> int:
 
     legs = [
         ("verify", lambda: _run_verify(sub())),
+        ("dpor", lambda: _run_dpor(sub())),
+        ("completeness", lambda: _run_completeness(sub())),
         ("faults", lambda: _run_faults(sub())),
         ("timeline", lambda: _run_timeline(sub())),
         ("serve", lambda: _run_serve(sub())),

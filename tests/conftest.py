@@ -134,6 +134,8 @@ FAST_NODES = frozenset((
     "tests/test_handoff.py::test_tdt_lint_handoff_smoke",
     "tests/test_request_trace.py::test_tdt_lint_trace_smoke",
     "tests/test_persistent_decode.py::test_persistent_protocol_clean[4]",
+    "tests/test_static_analysis.py::test_tdt_lint_dpor_smoke",
+    "tests/test_static_analysis.py::test_tdt_lint_completeness_smoke",
     "tests/test_persistent_decode.py::"
     "test_window_token_parity_under_pressure[4]",
     "tests/test_persistent_decode.py::test_bundle_equals_single_steps_tp1",

@@ -503,3 +503,162 @@ def test_all_reduce_config_consults_planted_winner(monkeypatch):
     # pin the method so the planted key is the one consulted
     ar.all_reduce(x, mesh, "tp", method=ar.AllReduceMethod.ONE_SHOT)
     assert seen["cfg"] == winner
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: static footprint pruning (candidates dropped BEFORE measuring)
+
+
+def test_prune_infeasible_drops_oversubscribing_tiles():
+    """An infeasible tile never reaches the measurement phase: the
+    (2048, 2048, 2048) bf16 working set (~48 MiB) cannot build under
+    the 16 MiB default budget, so the pruner drops it, counts it on
+    ``footprint_rejections``, and keeps the default, the XLA dispatch
+    candidate, and every feasible tile."""
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.tune import autotuner as at
+
+    default = at.XlaBackend()
+    cands = [default, (512, 512, 512), (2048, 2048, 2048),
+             (2048, 2048, 2048, at.MATMUL_TILE_VL)]
+    obs.enable(True)
+    obs.REGISTRY.reset()
+    try:
+        kept = at.prune_infeasible(
+            "matmul", cands, default,
+            dict(m=8192, n=8192, k=8192, dtype=jnp.bfloat16))
+        rows = {(r["name"], r["labels"].get("name")): r["value"]
+                for r in obs.REGISTRY.snapshot()}
+        assert rows[("footprint_rejections", "matmul")] == 1
+    finally:
+        obs.REGISTRY.reset()
+        obs.enable(None)
+    # the bare big tile is gone; the SAME tile under its raised budget
+    # survives (the budget is part of the candidate)
+    assert kept == [default, (512, 512, 512),
+                    (2048, 2048, 2048, at.MATMUL_TILE_VL)]
+
+
+def test_prune_infeasible_never_drops_default_or_unknown():
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.tune import autotuner as at
+
+    # an infeasible DEFAULT passes through (the completeness lint owns
+    # flagging it; the sweep must keep its baseline)
+    bad_default = (2048, 2048, 2048)
+    kept = at.prune_infeasible(
+        "matmul", [bad_default], bad_default,
+        dict(m=8192, n=8192, k=8192, dtype=jnp.bfloat16))
+    assert kept == [bad_default]
+    # unknown families never prune (no false positives)
+    kept = at.prune_infeasible("no_such_family", [(9, 9, 9)], None, {})
+    assert kept == [(9, 9, 9)]
+
+
+def test_resolve_gemm_like_prunes_before_resolve(monkeypatch):
+    """The spy the ISSUE asks for: resolve_gemm_like hands
+    resolve_config a candidate list ALREADY pruned of statically
+    infeasible tiles — the tuner cannot spend a compile or a timing
+    slot on them, and on multi-process sweeps a doomed per-rank build
+    (fatal by contract) is avoided."""
+    import jax
+
+    from triton_distributed_tpu.ops.gemm_rs import GemmRsConfig
+    from triton_distributed_tpu.tune import autotuner as at
+
+    infeasible = (2048, 2048, 2048)
+    monkeypatch.setattr(
+        at, "matmul_tile_candidates",
+        lambda m, n, k: [(256, 256, 256), infeasible])
+    seen = {}
+
+    def spy_resolve(name, key, candidates, default, make_thunk, **kw):
+        seen["cands"] = list(candidates)
+        return default
+
+    monkeypatch.setattr(at, "resolve_config", spy_resolve)
+    mesh = __import__(
+        "triton_distributed_tpu.core.mesh", fromlist=["tp_mesh"]
+    ).tp_mesh(1)
+    a = jax.numpy.ones((8192, 8192), jax.numpy.bfloat16)
+    b = jax.numpy.ones((8192, 8192), jax.numpy.bfloat16)
+    at.resolve_gemm_like("gemm_rs", lambda *a_, **k_: None, GemmRsConfig,
+                         at.GEMM_RS_CAND_DIMS, GemmRsConfig(), a, b,
+                         mesh, "tp", {})
+    tiles = [(c.bm, c.bn, c.bk) for c in seen["cands"]
+             if isinstance(c, GemmRsConfig)]
+    assert (256, 256, 256) in tiles
+    assert infeasible not in tiles
+
+
+def test_gemm_like_footprint_dims_mapping():
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.tune import autotuner as at
+
+    d = at._gemm_like_footprint_dims("ag_gemm", 512, 1024, 2048, 4,
+                                     jnp.bfloat16)
+    assert (d["m_loc"], d["k"], d["n_loc"]) == (128, 2048, 256)
+    d = at._gemm_like_footprint_dims("gemm_rs", 512, 1024, 2048, 4,
+                                     jnp.bfloat16)
+    assert (d["m_loc"], d["k_loc"], d["n_dim"]) == (128, 512, 1024)
+
+
+def test_all_matmul_resolve_paths_share_the_pruned_candidate_list(
+        monkeypatch):
+    """The winner cache is keyed by a digest of the candidate LIST, so
+    the transparent ``matmul(config=None)`` path, ``matmul_callable``,
+    and the measuring ``_matmul_resolve`` must all consume the SAME
+    pruned list — a one-sided prune would silently split the cache the
+    moment anything is pruned (review finding on this PR)."""
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.ops import matmul as mm
+    from triton_distributed_tpu.tune import autotuner as at
+
+    # plant an infeasible tile so pruning actually changes the list
+    monkeypatch.setattr(
+        at, "matmul_backend_candidates",
+        lambda m, n, k: [at.XlaBackend(), (512, 512, 512),
+                         (2048, 2048, 2048)])
+    monkeypatch.setenv("TDT_AUTOTUNE", "0")
+    seen = []
+    real = at.resolve_config
+
+    def spy(name, key, candidates, default, make_thunk, **kw):
+        seen.append(list(candidates))
+        return default
+
+    monkeypatch.setattr(at, "resolve_config", spy)
+    m = n = k = 8192
+    a = jnp.ones((m, k), jnp.bfloat16)
+    b = jnp.ones((k, n), jnp.bfloat16)
+    mm.matmul(a, b)                                   # transparent path
+    mm.matmul_callable(a, b)                          # hot-loop path
+    monkeypatch.setattr(at, "resolve_config", real)
+    pruned = at.matmul_candidates_pruned(m, n, k, a.dtype)
+    assert (2048, 2048, 2048) not in pruned
+    assert seen[0] == seen[1] == pruned
+
+
+def test_fused_mlp_resolve_paths_share_the_pruned_candidate_list(
+        monkeypatch):
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.ops import fused_decode as fd
+    from triton_distributed_tpu.tune import autotuner as at
+
+    seen = []
+
+    def spy(name, key, candidates, default, make_thunk, **kw):
+        seen.append(list(candidates))
+        return default
+
+    monkeypatch.setattr(at, "resolve_config", spy)
+    fd._resolve_fused_mlp("fused_mlp_ar", 8, 2048, 768, 2048, 8,
+                          jnp.bfloat16, lambda c: None, tracing=True)
+    assert seen[0] == at.fused_mlp_candidates_pruned(
+        8, 2048, 768, 2048, 8, jnp.bfloat16)

@@ -149,11 +149,12 @@ def test_clean_traces_have_no_findings():
 
 def test_matrix_corruption_cells_all_detected():
     rows = rz.run_matrix(seed=0, kinds=rz.CORRUPTION_KINDS)
-    # both classes x all 12 kernel cases (fused_mlp_ar since ISSUE 8;
+    # both classes x all 13 kernel cases (fused_mlp_ar since ISSUE 8;
     # quant_allgather/push_1shot + quant_exchange/oneshot since ISSUE 9;
     # hier_allreduce/2x2 + hier_a2a/2x2 since ISSUE 10;
-    # persistent_decode/chain since ISSUE 13)
-    assert len(rows) == 24
+    # persistent_decode/chain since ISSUE 13; ag_gemm/unidir since
+    # ISSUE 15 — the completeness lint found it uncovered)
+    assert len(rows) == 26
     for row in rows:
         assert row["outcome"] == "detected", row
         assert row["named"], row
@@ -196,6 +197,14 @@ MATRIX_GOLDEN = {
     ("all_to_all/dispatch", "rank_abort"),
     ("all_to_all/dispatch", "corrupt_payload"),
     ("all_to_all/dispatch", "corrupt_kv_page"),
+    # ag_gemm: the one family the ISSUE-15 completeness lint found with
+    # no fault coverage (pure-DMA protocol: no delay_notify target)
+    ("ag_gemm/unidir", "drop_notify"),
+    ("ag_gemm/unidir", "stale_credit"),
+    ("ag_gemm/unidir", "straggler"),
+    ("ag_gemm/unidir", "rank_abort"),
+    ("ag_gemm/unidir", "corrupt_payload"),
+    ("ag_gemm/unidir", "corrupt_kv_page"),
     ("gemm_rs/ring", "drop_notify"),
     ("gemm_rs/ring", "delay_notify"),
     ("gemm_rs/ring", "stale_credit"),

@@ -589,3 +589,25 @@ def test_tdt_lint_persistent_smoke():
     )
     assert res.returncode == 0, res.stdout + res.stderr
     assert "persistent OK" in res.stdout
+
+
+def test_persistent_resolve_paths_share_the_pruned_candidate_list():
+    """All three persistent resolve paths (transparent step, fresh tune,
+    EngineBackend hoist) consume ONE pruned sweep: at serving dims the
+    default-budget (vmem_limit=None) variant is statically unbuildable
+    (~28 MiB streamed weights vs the 16 MiB Mosaic default) and must be
+    pruned BEFORE any compile/measure — and pruning must happen in the
+    shared helper so the candidates digest (the winner-cache key) stays
+    common (review finding on ISSUE 15)."""
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.ops import persistent_decode as pdm
+
+    serving = pdm.persistent_candidates_pruned(
+        24, 8, 2048, 6144, 16, 8, 16, 128, 8, jnp.bfloat16)
+    assert serving, "pruning emptied the sweep"
+    assert all(c.vmem_limit is not None for c in serving), serving
+    # tiny dims: the None variant fits 16 MiB and stays in the sweep
+    tiny = pdm.persistent_candidates_pruned(
+        2, 2, 64, 128, 4, 2, 8, 16, 2, jnp.float32)
+    assert any(c.vmem_limit is None for c in tiny), tiny

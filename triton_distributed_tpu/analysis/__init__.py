@@ -13,18 +13,38 @@ each collective kernel's primitive vocabulary per rank (record mode in
 3. write-overlap         no unordered overlapping destination writes
 4. collective divergence all ranks run the same collective program
 
+The canonical checks are schedule-sound for deadlock (credit
+monotonicity) but NOT for the credit->wait matching on multi-producer
+pools; ``explore`` closes that gap by model-checking every schedule
+class up to equivalence (DPOR: sleep sets + singleton persistent sets
+over the credit-FIFO independence relation), and ``footprint`` adds the
+static resource leg — symbolic VMEM/SMEM/semaphore footprints per
+(family x tile config) that the autotuner prunes against before
+measuring.
+
 Entry points:
 
 - ``verify_all()`` / ``verify_case``   the registry matrix (CLI:
   ``scripts/tdt_lint.py``)
+- ``explore_all()`` / ``explore_case`` schedule-exhaustive DPOR sweep
+  (CLI: ``tdt_lint --dpor``)
 - ``maybe_verify_build(family, n)``    build-time gate, ``TDT_VERIFY=1``
+  (+ ``TDT_VERIFY_EXPLORE`` for bounded/exact exploration)
 - ``fixtures.run_selftest()``          seeded-bad kernels battery
+- ``fixtures.run_dpor_selftest()``     canonical-pass / DPOR-fail pins
+- ``footprint.check_defaults()``       default-config feasibility
+- ``completeness.check()``             cross-subsystem wiring lint
 
 See docs/static_analysis.md for the event model and check semantics.
 """
 
 from .checks import CHECKS, ProtocolViolationError, Violation, analyze
 from .events import FakeRef, FakeSem, FakeSmem, Region
+# NOTE: the raw-traces ``explore(kernel, n, traces)`` entry stays on the
+# submodule (``analysis.explore.explore``) — re-exporting it here would
+# shadow the submodule name itself
+from .explore import ExploreResult, explore_all, explore_case
+from .footprint import Footprint
 from .record import KernelRecorder, record_kernel, recording
 from .registry import (
     DEFAULT_RANKS,
@@ -33,13 +53,17 @@ from .registry import (
     all_cases,
     cases_for,
     maybe_verify_build,
+    record_case,
     verify_all,
     verify_case,
 )
 
 __all__ = [
-    "CHECKS", "DEFAULT_RANKS", "FAMILIES", "FakeRef", "FakeSem", "FakeSmem",
-    "KernelCase", "KernelRecorder", "ProtocolViolationError", "Region",
-    "Violation", "all_cases", "analyze", "cases_for", "maybe_verify_build",
-    "record_kernel", "recording", "verify_all", "verify_case",
+    "CHECKS", "DEFAULT_RANKS", "ExploreResult", "FAMILIES", "FakeRef",
+    "FakeSem", "FakeSmem", "Footprint", "KernelCase", "KernelRecorder",
+    "ProtocolViolationError", "Region", "Violation", "all_cases",
+    "analyze", "cases_for", "explore_all", "explore_case",
+    "maybe_verify_build", "record_case", "record_kernel", "recording",
+    "verify_all",
+    "verify_case",
 ]

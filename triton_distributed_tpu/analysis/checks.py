@@ -16,11 +16,25 @@ probed dynamically with racecheck runs (SURVEY.md §5):
 2. **deadlock freedom** — the cross-rank wait-for structure admits an
    execution: a round-robin scheduler advances every rank past its waits;
    a stall is reported with the blocked waits and the wait-for cycle.
-   Semaphore credits make this schedule-insensitive for the properties
-   checked: sends are asynchronous (credits appear at issue) and a wait
-   only ever consumes credits, so an event enabled once stays enabled —
-   the simulation is a canonical maximal execution, and it stalls iff
-   every interleaving stalls.
+   Credit monotonicity makes THIS check schedule-insensitive: sends are
+   asynchronous (credits appear at issue), each pool is consumed only by
+   its owner in program order, so availability at any wait is monotone in
+   schedule progress — the simulation is a canonical maximal execution,
+   and it stalls iff every interleaving stalls.
+
+   Soundness scope (corrected in ISSUE 15 — the claim used to be stated
+   for the whole verifier): monotonicity covers ENABLEDNESS only.  The
+   happens-before structure check 3 consumes is built from the FIFO
+   credit->wait MATCHING, and when a pool is fed by two CONCURRENT
+   producers that matching is schedule-dependent — one schedule's safe
+   settle assignment is another schedule's un-ACKed slot reuse.  Exactly
+   the protocols shipped since: the persistent megakernel's chained ring
+   instances re-arm one shared semaphore set in-kernel, and the
+   quantized/hierarchical/handoff families layer sidecars and multi-axis
+   credits on shared pools.  For those, run ``analysis.explore`` (DPOR
+   over all schedule classes; ``tdt_lint --dpor``, ``TDT_VERIFY_EXPLORE``)
+   — the seeded ``fixtures.dpor_fixture_cases`` pass every check below on
+   the canonical schedule yet race under reordering, pinning the gap.
 
 3. **write-overlap** — the static analogue of interpret-mode
    ``detect_races``: no two writes (remote DMA landings, local DMA, or

@@ -1,0 +1,207 @@
+"""Cross-subsystem completeness lint: every kernel family, fully wired.
+
+A collective family in this codebase is not DONE when its kernel works:
+it must be priced (``obs.costs.FAMILY_COSTS`` — the one flop/byte source
+the watchdog deadline, Mosaic cost estimate and timeline read), it must
+have a degradation story (an ``resilience.fallbacks`` XLA fallback on
+the retry ladder, or a DOCUMENTED watchdog-only / rides-the-base-family
+status), it must appear in the fault-injection matrix, and its
+``collective_id`` must be registered and unique (two in-flight
+collectives sharing an id share a Mosaic barrier semaphore).  Until
+ISSUE 15 each of those was enforced only by convention — and the
+convention had already broken: ``ag_gemm`` shipped five PRs of features
+with NO fault-matrix coverage (found by this lint, fixed in the same
+PR).
+
+:data:`GOLDEN` pins the full wiring table.  :func:`check` recomputes
+the ACTUAL wiring from the live modules and reports every divergence
+with the diff as the message — adding a family without full wiring (or
+wiring without a golden row) fails loudly in ``tdt_lint
+--completeness``.
+"""
+
+from __future__ import annotations
+
+
+# fallback / collective_id values starting with "via:" or
+# "watchdog-only:" are DOCUMENTED statuses, verified textually against
+# this table rather than against module attributes
+GOLDEN: dict[str, dict] = {
+    "allgather": {
+        "costs": ("allgather",),
+        "fallback": "xla_all_gather",
+        "faults": ("allgather/push_1shot",),
+        "collective_id": 1,
+    },
+    "reduce_scatter": {
+        "costs": ("reduce_scatter",),
+        "fallback": "xla_reduce_scatter",
+        "faults": ("reduce_scatter/ring",),
+        "collective_id": 2,
+    },
+    "allreduce": {
+        "costs": ("allreduce",),
+        "fallback": "xla_all_reduce",
+        "faults": ("allreduce/two_shot",),
+        "collective_id": 3,
+    },
+    "all_to_all": {
+        "costs": ("all_to_all",),
+        "fallback": "xla_ep_dispatch",
+        "faults": ("all_to_all/dispatch",),
+        "collective_id": 4,
+    },
+    "ag_gemm": {
+        "costs": ("ag_gemm",),
+        "fallback": "xla_ag_gemm",
+        "faults": ("ag_gemm/unidir",),
+        "collective_id": 5,
+    },
+    "gemm_rs": {
+        "costs": ("gemm_rs",),
+        "fallback": "xla_gemm_rs",
+        "faults": ("gemm_rs/ring",),
+        "collective_id": 6,
+    },
+    "gemm_ar": {
+        "costs": ("gemm_ar",),
+        "fallback": "xla_gemm_ar",
+        "faults": ("gemm_ar/ring",),
+        "collective_id": 14,
+    },
+    "fused_mlp_ar": {
+        "costs": ("fused_mlp_ar",),
+        "fallback": "xla_fused_mlp_ar",
+        "faults": ("fused_mlp_ar/swiglu",),
+        "collective_id": 16,
+    },
+    "quantized_wire": {
+        "costs": ("quantized_wire",),
+        # the quantized variants degrade through the BASE family's XLA
+        # fallback with the codec bypassed (comm.quantized rides the
+        # eager entries' resilient_call; docs/robustness.md)
+        "fallback": "via:base-family XLA fallbacks, codec bypassed",
+        "faults": ("quant_allgather/push_1shot", "quant_exchange/oneshot"),
+        # packed payloads ride the underlying kernels' collective ids
+        "collective_id": "via:underlying families",
+    },
+    "hierarchical": {
+        "costs": ("hier_all_gather", "hier_reduce_scatter",
+                  "hier_all_reduce", "hier_all_to_all"),
+        # hier entries wrap their cores in resilience.guarded with
+        # flat-entry fallbacks; the DCN hop is an XLA collective already
+        "fallback": "via:guarded flat-entry fallbacks (DCN hop is XLA)",
+        "faults": ("hier_allreduce/2x2", "hier_a2a/2x2"),
+        "collective_id": "via:inner-ring families",
+    },
+    "persistent_decode": {
+        "costs": ("persistent_decode",),
+        "fallback": "xla_persistent_decode",
+        "faults": ("persistent_decode/chain",),
+        "collective_id": 17,
+    },
+}
+
+
+def _fault_kernel_axis() -> set[str]:
+    """Every kernel-case name any fault-matrix slice injects into."""
+    from ..resilience import matrix as rmat
+
+    return (set(rmat.DEFAULT_KERNELS) | set(rmat.QUANT_KERNELS)
+            | set(rmat.HIER_KERNELS_4) | set(rmat.HIER_KERNELS_8)
+            | set(rmat.PERSISTENT_KERNELS))
+
+
+def check() -> list[str]:
+    """Recompute the wiring from the live modules and diff against
+    :data:`GOLDEN`; every problem line names the family and the missing
+    or drifted piece."""
+    from ..core.compilation import _COLLECTIVE_IDS
+    from ..obs.costs import FAMILY_COSTS
+    from ..resilience import fallbacks
+    from .registry import FAMILIES, cases_for
+
+    problems: list[str] = []
+
+    if set(GOLDEN) != set(FAMILIES):
+        extra = sorted(set(GOLDEN) - set(FAMILIES))
+        missing = sorted(set(FAMILIES) - set(GOLDEN))
+        problems.append(
+            f"family axis drifted: registry families without a golden "
+            f"wiring row {missing}, golden rows without a registry "
+            f"family {extra} — new families must land FULLY wired "
+            f"(costs + fallback + fault cells + collective_id) and "
+            f"pinned here")
+
+    fault_axis = _fault_kernel_axis()
+    case_family: dict[str, str] = {}
+    for fam in FAMILIES:
+        try:
+            for n in (2, 4, 8):
+                for c in cases_for(fam, n):
+                    case_family[c.name] = c.family
+        except KeyError:
+            problems.append(
+                f"{fam}: listed in registry.FAMILIES but has no case "
+                f"builder in _FAMILY_CASES — nothing verifies it")
+    covered_families = {case_family[k] for k in fault_axis
+                        if k in case_family}
+
+    ids_seen: dict[int, str] = {}
+    for fam, spec in sorted(GOLDEN.items()):
+        # 1) cost calculators
+        for key in spec["costs"]:
+            if key not in FAMILY_COSTS:
+                problems.append(
+                    f"{fam}: cost calculator {key!r} missing from "
+                    f"obs.costs.FAMILY_COSTS — the watchdog deadline and "
+                    f"timeline cannot price this family")
+        # 2) degradation story
+        fb = spec["fallback"]
+        if fb.startswith(("via:", "watchdog-only:")):
+            pass   # documented status; the text IS the contract
+        elif not hasattr(fallbacks, fb):
+            problems.append(
+                f"{fam}: resilience fallback {fb!r} not found in "
+                f"resilience.fallbacks — the retry ladder has no bottom "
+                f"for this family")
+        # 3) fault-matrix coverage
+        missing_cases = [k for k in spec["faults"] if k not in fault_axis]
+        if missing_cases:
+            problems.append(
+                f"{fam}: golden fault case(s) {missing_cases} not on any "
+                f"fault-matrix kernel axis (resilience.matrix)")
+        if fam not in covered_families:
+            problems.append(
+                f"{fam}: NO fault-matrix kernel case covers this family "
+                f"— injection coverage is part of shipping a collective")
+        # 4) collective id
+        cid = spec["collective_id"]
+        if isinstance(cid, int):
+            actual = _COLLECTIVE_IDS.get(fam)
+            if actual != cid:
+                problems.append(
+                    f"{fam}: collective_id drifted — golden {cid}, "
+                    f"core.compilation registers {actual}")
+            if cid in ids_seen:
+                problems.append(
+                    f"{fam}: collective_id {cid} collides with "
+                    f"{ids_seen[cid]} — two in-flight collectives would "
+                    f"share a Mosaic barrier semaphore")
+            ids_seen[cid] = fam
+        elif not str(cid).startswith("via:"):
+            problems.append(
+                f"{fam}: collective_id must be an int or a documented "
+                f"'via:' status, got {cid!r}")
+
+    # global id uniqueness (beyond the golden families: the registry in
+    # core.compilation must never alias two names onto one id)
+    all_ids: dict[int, list[str]] = {}
+    for name, cid in _COLLECTIVE_IDS.items():
+        all_ids.setdefault(cid, []).append(name)
+    for cid, names in sorted(all_ids.items()):
+        if len(names) > 1:
+            problems.append(
+                f"collective_id {cid} registered for multiple families: "
+                f"{sorted(names)}")
+    return problems

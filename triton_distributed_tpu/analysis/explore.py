@@ -1,0 +1,537 @@
+"""Schedule-exhaustive protocol exploration: DPOR over recorded traces.
+
+The canonical maximal execution (``checks._simulate``) is sound for
+ENABLEDNESS: credits only accumulate, every pool is consumed by exactly
+one rank in program order, so availability at any wait is monotone in
+schedule progress and the canonical run stalls iff every interleaving
+stalls.  It is NOT sound for the happens-before structure the
+write-overlap check consumes: a wait consumes credits in FIFO *arrival*
+order, and when a pool is fed by two concurrent producers the arrival
+order — hence which transfer each wait SETTLES — depends on the
+schedule.  The chained protocols (ISSUE 13's in-kernel re-armed ring
+instances, the quantized sidecar messages, the hierarchical DCN credit
+models) are exactly the family where per-credit identity carries the
+ordering, i.e. where one schedule can witness a safe matching while
+another witnesses an un-ACKed slot reuse ("Demystifying NVSHMEM"'s
+order-dependent slot reuse / premature credit consumption / ABA class).
+
+This module explores ALL schedules up to Mazurkiewicz-trace equivalence
+and re-runs the hazard checks on every explored class.  The reduction
+stack (each step proved in terms of the credit-FIFO semantics):
+
+- **independence relation** (the vector-clock model's, made explicit):
+  two cross-rank events are dependent iff they PRODUCE into a common
+  non-*bulk* pool, or one produces into a pool whose consume is not yet
+  enabled.  A *bulk* pool (consumed by at most one balanced wait, or
+  never consumed) joins every credit regardless of arrival order.  An
+  ALREADY-ENABLED consume commutes with any produce: FIFO hands it the
+  same credit prefix either way — and it commutes leftward past any
+  prefix of other-rank events, because an executed consume was
+  necessarily enabled without any later-arriving credit.  Overlapping
+  writes need no dependence edge: the per-schedule vector-clock race
+  check is symmetric in the order of unordered writes.
+- **persistent-set reduction**: by the above, an enabled event is a
+  singleton persistent set — executed eagerly, never a branch point —
+  unless it produces into a non-bulk pool into which another rank still
+  has produces outstanding (tracked with per-pool suffix counts).  The
+  exploration therefore branches ONLY at multi-producer credit races:
+  the exact class the canonical schedule cannot decide.
+- **sleep sets**: after a branch explores transition ``t``, ``t`` sleeps
+  in the subtrees of its later siblings while independent, so each
+  equivalence class is counted exactly once.
+
+``preemption_bound`` (the context-switch-bounded mode) caps the number
+of *preemptive* switches among branch choices per schedule — switching
+away from a rank whose next event could still run.  Eager and forced
+switches are free.  Bounded exploration is CHESS-style best-effort
+below the bound; ``bound=None`` is the exact mode.  ``max_schedules`` /
+``budget_ms`` are hard resource caps; hitting one marks the result
+``pruned`` (surfaced by the ``explore_pruned`` obs counter and the
+``--dpor`` lint column, never silently).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+
+from .checks import Violation, _Credit, _join, _write_overlap, _Write, \
+    sem_label
+from .events import ComputeEv, CopyEv, NotifyEv, WaitEv
+
+
+# ---------------------------------------------------------------------------
+# static pool analysis
+
+
+def _pools_of(ev, rank: int):
+    """((pool, mode), ...) for one event: pool = (owner_rank, sem_key),
+    mode = "p" (produce) | "c" (consume)."""
+    if isinstance(ev, NotifyEv):
+        return (((ev.target, ev.sem), "p"),)
+    if isinstance(ev, WaitEv):
+        return (((rank, ev.sem), "c"),)
+    if isinstance(ev, CopyEv):
+        out = [((ev.dst_rank, ev.recv_sem), "p")]
+        if ev.send_sem is not None:
+            out.append(((rank, ev.send_sem), "p"))
+        return tuple(out)
+    return ()
+
+
+@dataclasses.dataclass(frozen=True)
+class _PoolInfo:
+    producers: frozenset      # ranks producing into it
+    waits: int                # number of WaitEv consuming it
+    produced: int
+    consumed: int
+
+    @property
+    def bulk(self) -> bool:
+        """Arrival order into this pool is unobservable: no wait ever
+        consumes it, or a SINGLE balanced wait consumes every credit
+        (joining every clock regardless of order) — produces into such
+        a pool commute."""
+        return self.waits == 0 or \
+            (self.waits == 1 and self.produced == self.consumed)
+
+
+def _pool_table(n: int, traces) -> dict:
+    t: dict[tuple, dict] = {}
+    for r in range(n):
+        for ev in traces[r]:
+            for pool, mode in _pools_of(ev, r):
+                d = t.setdefault(pool, {"prod": set(), "waits": 0,
+                                        "p": 0, "c": 0})
+                if mode == "p":
+                    d["prod"].add(r)
+                    if isinstance(ev, NotifyEv):
+                        d["p"] += ev.amount
+                    else:  # CopyEv: src elements on send, dst on recv
+                        d["p"] += ev.src.elements() \
+                            if (pool[0] == r and pool[1] == ev.send_sem) \
+                            else ev.dst.elements()
+                else:
+                    d["waits"] += 1
+                    d["c"] += ev.amount
+    return {
+        pool: _PoolInfo(frozenset(d["prod"]), d["waits"], d["p"], d["c"])
+        for pool, d in t.items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# the exploration state (with O(1)-amortized undo)
+
+_MISS = object()
+
+
+class _State:
+    def __init__(self, n: int, traces, pools: dict):
+        self.n = n
+        self.traces = traces
+        self.pools = pools
+        self.pcs = [0] * n
+        self.credits: dict[tuple, deque] = {}
+        self.avail: dict[tuple, int] = {}
+        self.clocks = [tuple(0 for _ in range(n)) for _ in range(n)]
+        self.writes: list[_Write] = []
+        self.settle: dict[int, tuple] = {}
+        self.next_tid = 0
+        self.schedule: list[int] = []
+        # suffix produce counts: rem_prod[pool][rank] = produces rank
+        # still has outstanding into pool (drives the branch-point test)
+        self.rem_prod: dict[tuple, list[int]] = {}
+        for r in range(n):
+            for ev in traces[r]:
+                for pool, mode in _pools_of(ev, r):
+                    if mode == "p":
+                        self.rem_prod.setdefault(pool, [0] * n)[r] += 1
+
+    def next_ev(self, r: int):
+        return self.traces[r][self.pcs[r]] if self.pcs[r] < \
+            len(self.traces[r]) else None
+
+    def enabled(self, r: int) -> bool:
+        ev = self.next_ev(r)
+        if ev is None:
+            return False
+        if isinstance(ev, WaitEv):
+            return self.avail.get((r, ev.sem), 0) >= ev.amount
+        return True
+
+    def branches(self, r: int) -> bool:
+        """True when rank ``r``'s next (enabled) event is a real branch
+        point: it produces into a non-bulk pool into which another rank
+        still has produces outstanding — the multi-producer credit race
+        whose arrival order the schedule decides.  Everything else is a
+        singleton persistent set (see the module docstring)."""
+        ev = self.traces[r][self.pcs[r]]
+        for pool, mode in _pools_of(ev, r):
+            if mode != "p" or self.pools[pool].bulk:
+                continue
+            rem = self.rem_prod[pool]
+            if sum(rem) - rem[r] > 0:
+                return True
+        return False
+
+    def done(self) -> bool:
+        return all(self.pcs[r] >= len(self.traces[r])
+                   for r in range(self.n))
+
+    # -- execute/undo -------------------------------------------------------
+
+    def execute(self, r: int):
+        """Run rank ``r``'s next event; returns an opaque undo record.
+
+        SEMANTICS CONTRACT: this is the same credit-FIFO execution
+        ``checks._simulate`` implements (FIFO consumption, vector-clock
+        joins, settle-on-consume), restated with an undo journal so the
+        DFS can backtrack.  The one textual difference — ``_simulate``
+        settles each credit at the consumer's MID-LOOP clock while this
+        settles every consumed credit at the POST-join clock — is
+        observationally equivalent whenever no single wait spans
+        settle-carrying credits from multiple transfers, which holds
+        for every shipped protocol and is pinned byte-for-byte over the
+        whole registry by
+        ``test_explorer_state_agrees_with_canonical_simulator``; a
+        change to either implementation must keep that test green."""
+        ev = self.traces[r][self.pcs[r]]
+        undo = {"r": r, "clock": self.clocks[r], "tid": self.next_tid,
+                "writes": len(self.writes), "cons": None, "adds": []}
+        if isinstance(ev, WaitEv):
+            need = ev.amount
+            pool = (r, ev.sem)
+            self.avail[pool] = self.avail.get(pool, 0) - need
+            q = self.credits.setdefault(pool, deque())
+            consumed = []   # [credit, taken, popped]
+            clock = self.clocks[r]
+            while need > 0:
+                c = q[0]
+                take = min(need, c.amount)
+                c.amount -= take
+                need -= take
+                clock = _join(clock, c.clock)
+                popped = c.amount == 0
+                if popped:
+                    q.popleft()
+                consumed.append((c, take, popped))
+            # settle joins use the POST-join clock (the consumer has
+            # observed every landing this wait consumed)
+            prev_settles = []
+            for c, _take, _popped in consumed:
+                if c.settle_tid is not None:
+                    prev = self.settle.get(c.settle_tid, _MISS)
+                    prev_settles.append((c.settle_tid, prev))
+                    self.settle[c.settle_tid] = clock if prev is _MISS \
+                        else _join(prev, clock)
+            self.clocks[r] = clock
+            undo["cons"] = (pool, consumed, prev_settles)
+        elif isinstance(ev, NotifyEv):
+            self._add(undo, r, (ev.target, ev.sem),
+                      _Credit(ev.amount, self.clocks[r], None))
+        elif isinstance(ev, CopyEv):
+            tid = self.next_tid
+            self.next_tid += 1
+            if ev.send_sem is not None:
+                self._add(undo, r, (r, ev.send_sem),
+                          _Credit(ev.src.elements(), self.clocks[r], None))
+            self._add(undo, r, (ev.dst_rank, ev.recv_sem),
+                      _Credit(ev.dst.elements(), self.clocks[r], tid))
+            self.writes.append(_Write(
+                ev.dst_rank, ev.dst, self.clocks[r], tid, r,
+                "remote_copy" if ev.dst_rank != r else "local_copy",
+            ))
+        elif isinstance(ev, ComputeEv):
+            self.writes.append(_Write(r, ev.write, self.clocks[r], None, r,
+                                      f"compute:{ev.kind}"))
+        self.pcs[r] += 1
+        c = list(self.clocks[r])
+        c[r] += 1
+        self.clocks[r] = tuple(c)
+        self.schedule.append(r)
+        return undo
+
+    def _add(self, undo, r, pool, credit):
+        self.credits.setdefault(pool, deque()).append(credit)
+        self.avail[pool] = self.avail.get(pool, 0) + credit.amount
+        self.rem_prod[pool][r] -= 1
+        undo["adds"].append((pool, credit.amount))
+
+    def undo(self, undo) -> None:
+        r = undo["r"]
+        self.schedule.pop()
+        self.pcs[r] -= 1
+        self.clocks[r] = undo["clock"]
+        self.next_tid = undo["tid"]
+        del self.writes[undo["writes"]:]
+        for pool, amount in reversed(undo["adds"]):
+            self.credits[pool].pop()
+            self.avail[pool] -= amount
+            self.rem_prod[pool][r] += 1
+        if undo["cons"] is not None:
+            pool, consumed, prev_settles = undo["cons"]
+            for tid, prev in prev_settles:
+                if prev is _MISS:
+                    del self.settle[tid]
+                else:
+                    self.settle[tid] = prev
+            q = self.credits[pool]
+            for c, take, popped in reversed(consumed):
+                c.amount += take
+                self.avail[pool] += take
+                if popped:
+                    q.appendleft(c)
+
+
+# ---------------------------------------------------------------------------
+# the explorer
+
+
+@dataclasses.dataclass
+class ExploreResult:
+    kernel: str
+    n: int
+    schedules: int                 # complete equivalence classes explored
+    violations: list[Violation]
+    pruned: bool = False           # a resource cap cut the exploration
+    preemption_bound: int | None = None
+    witness: tuple[int, ...] | None = None   # rank order of the first
+    #                                          violating schedule
+
+
+class _Explorer:
+    def __init__(self, kernel: str, n: int, traces, *,
+                 preemption_bound: int | None, max_schedules: int,
+                 budget_ms: float | None, stop_on_violation: bool):
+        self.kernel, self.n, self.traces = kernel, n, traces
+        self.bound = preemption_bound
+        self.max_schedules = max_schedules
+        self.deadline = None if budget_ms is None else \
+            time.monotonic() + budget_ms / 1e3
+        self.stop_on_violation = stop_on_violation
+        self.pools = _pool_table(n, traces)
+        self.state = _State(n, traces, self.pools)
+        self.schedules = 0
+        self.pruned = False
+        self.violations: list[Violation] = []
+        self._seen_msgs: set[str] = set()
+        self.witness: tuple[int, ...] | None = None
+
+    # -- independence (for sleep-set filtering at branch points) ------------
+
+    def _independent(self, a: int, b: int) -> bool:
+        """Are ranks ``a``/``b``'s NEXT events independent in the CURRENT
+        state?  Both are enabled branch choices when consulted."""
+        eva, evb = self.state.next_ev(a), self.state.next_ev(b)
+        pa = dict(_pools_of(eva, a)) if eva is not None else {}
+        pb = dict(_pools_of(evb, b)) if evb is not None else {}
+        for pool in pa.keys() & pb.keys():
+            ma, mb = pa[pool], pb[pool]
+            if ma == "p" and mb == "p":
+                if not self.pools[pool].bulk:
+                    return False
+                continue
+            # produce vs consume: an ALREADY-ENABLED consume commutes
+            # with any produce (FIFO hands it the same credit prefix
+            # either way); only the enabling produce is a dependence
+            ev_c = eva if ma == "c" else evb
+            if self.state.avail.get(pool, 0) >= ev_c.amount:
+                continue
+            return False
+        return True
+
+    # -- per-schedule checks ------------------------------------------------
+
+    def _record_violation(self, v: Violation) -> None:
+        if v.message not in self._seen_msgs:
+            self._seen_msgs.add(v.message)
+            self.violations.append(v)
+            if self.witness is None:
+                self.witness = tuple(self.state.schedule)
+
+    def _check_complete(self) -> None:
+        self.schedules += 1
+        st = self.state
+        sched = _schedule_label(st.schedule, self.n)
+        if not st.done():
+            blocked = []
+            for r in range(self.n):
+                ev = st.next_ev(r)
+                if isinstance(ev, WaitEv):
+                    blocked.append(
+                        f"rank {r} wait({sem_label(ev.sem)}, need "
+                        f"{ev.amount}, have "
+                        f"{st.avail.get((r, ev.sem), 0)})")
+                elif ev is not None:   # pragma: no cover - waits block
+                    blocked.append(f"rank {r} stuck at {ev}")
+            self._record_violation(Violation(
+                "deadlock", self.kernel, self.n,
+                f"schedule {sched} deadlocks (a reordering the canonical "
+                f"maximal execution does not witness): "
+                + "; ".join(blocked)))
+            return
+        for v in _write_overlap(self.kernel, self.n, st.writes, st.settle):
+            self._record_violation(Violation(
+                v.check, v.kernel, v.ranks,
+                f"under schedule {sched}: {v.message}"))
+
+    # -- search -------------------------------------------------------------
+
+    def _stop(self) -> bool:
+        if self.stop_on_violation and self.violations:
+            return True
+        if self.schedules >= self.max_schedules or (
+                self.deadline is not None
+                and time.monotonic() > self.deadline):
+            self.pruned = True
+            return True
+        return False
+
+    def run(self) -> None:
+        self._explore(frozenset(), None, 0)
+
+    def _advance_eager(self, sleep: frozenset) -> list:
+        """Execute every enabled non-branching event (singleton
+        persistent sets) until only branch points or blocked ranks
+        remain; returns the undo stack.  Slept ranks are never advanced
+        (their subtrees are covered by an explored sibling), and eager
+        events are provably independent of every enabled sleep member,
+        so the sleep set passes through unchanged."""
+        st = self.state
+        undos = []
+        progress = True
+        while progress:
+            progress = False
+            for r in range(self.n):
+                if r in sleep:
+                    continue
+                while st.enabled(r) and not st.branches(r):
+                    undos.append(st.execute(r))
+                    progress = True
+        return undos
+
+    def _explore(self, sleep: frozenset, last: int | None,
+                 preemptions: int) -> None:
+        if self._stop():
+            return
+        undos = self._advance_eager(sleep)
+        try:
+            enabled = [r for r in range(self.n) if self.state.enabled(r)]
+            live = [r for r in enabled if r not in sleep]
+            if not enabled:
+                self._check_complete()
+                return
+            if not live:
+                # every continuation is covered by an explored sibling
+                return
+            # context-bound: past the budget, stay on the current rank
+            # when it can still run (eager/forced switches are free)
+            if self.bound is not None and preemptions >= self.bound \
+                    and last is not None and last in live:
+                live = [last]
+            done: list[int] = []
+            for r in live:
+                if self._stop():
+                    return
+                cost = preemptions
+                if last is not None and r != last and \
+                        self.state.enabled(last):
+                    cost += 1
+                    if self.bound is not None and cost > self.bound:
+                        continue
+                child_sleep = frozenset(
+                    u for u in (*sleep, *done)
+                    if self.state.enabled(u) and self._independent(u, r)
+                )
+                undo = self.state.execute(r)
+                self._explore(child_sleep, r, cost)
+                self.state.undo(undo)
+                done.append(r)
+        finally:
+            for u in reversed(undos):
+                self.state.undo(u)
+
+
+def _schedule_label(schedule: list[int], n: int, cap: int = 48) -> str:
+    """Run-length-compressed rank order, e.g. ``r0*3 r1*2 r0``."""
+    runs: list[list[int]] = []
+    for r in schedule:
+        if runs and runs[-1][0] == r:
+            runs[-1][1] += 1
+        else:
+            runs.append([r, 1])
+    parts = [f"r{r}" if k == 1 else f"r{r}*{k}" for r, k in runs]
+    if len(parts) > cap:
+        parts = parts[:cap] + ["..."]
+    return " ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# entry points
+
+
+# resource caps for the registry sweep: generous enough that every
+# shipped kernel (branch points exist only at multi-producer credit
+# races, so most cases explore exhaustively in ONE class) completes,
+# tight enough that a pathological case cannot eat the lint budget
+DEFAULT_MAX_SCHEDULES = 512
+DEFAULT_BUDGET_MS = 2_000.0
+DEFAULT_BOUND = 2
+
+
+def explore(kernel: str, n: int, traces, *,
+            preemption_bound: int | None = DEFAULT_BOUND,
+            max_schedules: int = DEFAULT_MAX_SCHEDULES,
+            budget_ms: float | None = DEFAULT_BUDGET_MS,
+            stop_on_violation: bool = True) -> ExploreResult:
+    """Explore all schedules of the composed per-rank ``traces`` up to
+    equivalence; run deadlock + write-overlap on every explored class.
+    ``preemption_bound=None`` is the exact mode."""
+    ex = _Explorer(kernel, n, traces,
+                   preemption_bound=preemption_bound,
+                   max_schedules=max_schedules, budget_ms=budget_ms,
+                   stop_on_violation=stop_on_violation)
+    ex.run()
+    return ExploreResult(kernel, n, ex.schedules, ex.violations,
+                         pruned=ex.pruned,
+                         preemption_bound=preemption_bound,
+                         witness=ex.witness)
+
+
+def explore_case(case, *, recorded=None, **kw) -> ExploreResult:
+    """Record all N ranks of a registry :class:`KernelCase` (or reuse
+    ``recorded`` from ``registry.record_case`` — callers that already
+    ran the canonical checks share one recording pass) and explore.
+    Counters ``explore_schedules`` / ``explore_pruned`` land in the obs
+    registry when observability is on."""
+    if recorded is not None:
+        traces = recorded[0]
+    else:
+        from .registry import record_case
+
+        traces = record_case(case)[0]
+    res = explore(case.name, case.n, traces, **kw)
+    from .. import obs
+
+    if obs.enabled():
+        obs.counter("explore_schedules",
+                    kernel=case.family).inc(res.schedules)
+        if res.pruned:
+            obs.counter("explore_pruned", kernel=case.family).inc()
+    return res
+
+
+def explore_all(ranks=None, *, kernel_filter: str | None = None,
+                **kw) -> list[ExploreResult]:
+    """The registry sweep: every kernel case at every rank count, under
+    the bounded defaults (``tdt_lint --dpor``)."""
+    from .registry import DEFAULT_RANKS, all_cases
+
+    out = []
+    for case in all_cases(ranks if ranks is not None else DEFAULT_RANKS):
+        if kernel_filter and kernel_filter not in case.name:
+            continue
+        out.append(explore_case(case, **kw))
+    return out

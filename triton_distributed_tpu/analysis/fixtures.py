@@ -83,6 +83,73 @@ def bad_hier_dropped_dcn_credit_kernel(n_out, n_in, src, zones, send_sem,
         dl.wait_send(src, send_sem)
 
 
+def _compute_reuse(ref) -> None:
+    """Record a compute event that reads AND rewrites ``ref`` — the
+    static shape of "the consumer reuses the slot it believes just
+    landed" (what the ``ops.blocks`` pipeline stubs record for a real
+    kernel's in-place stage)."""
+    dl.active_recorder().on_compute("reuse", (ref,), ref)
+
+
+def bad_chained_early_credit_kernel(team, m, r_cols, x_ref, slot_a, slot_b,
+                                    send_sem, inst_recv):
+    """DPOR-only defect #1 — chained instances on ONE shared arrival
+    semaphore, the consumer's per-instance credit armed one instance too
+    early (the ISSUE-13 chained-AR hazard class): every rank feeds ring
+    instance j's chunk to its -1 neighbor and instance j+1's chunk to
+    its -2 neighbor, BOTH crediting the consumer's single unindexed
+    ``inst_recv`` semaphore.  The consumer consumes one credit per
+    instance and immediately reuses the slot it BELIEVES that credit
+    acknowledged — slot identity keyed to arrival order, which nothing
+    orders.  The wait order below follows the canonical round-robin
+    arrival order (the lower-ranked producer's send lands first), so the
+    canonical maximal execution witnesses only the safe matching and
+    ALL FOUR canonical checks pass; swapping the two producers' sends
+    (one context switch) makes the first wait consume the OTHER
+    instance's credit and the reuse overwrites a slot whose landing is
+    still unsettled — un-ACKed slot reuse only DPOR can witness."""
+    me, n = team.rank(), team.size
+    # producer role: instance-1 chunk to (me-1), instance-2 to (me-2)
+    dl.remote_copy(x_ref, slot_a, send_sem, inst_recv,
+                   team.device_id((me - 1) % n))
+    dl.remote_copy(x_ref, slot_b, send_sem, inst_recv,
+                   team.device_id((me - 2) % n))
+    # consumer role: my slot_a is fed by (me+1), slot_b by (me+2); the
+    # canonical sweep delivers the LOWER-ranked producer's credit first
+    a_src, b_src = (me + 1) % n, (me + 2) % n
+    order = (slot_a, slot_b) if a_src < b_src else (slot_b, slot_a)
+    for slot in order:
+        dl.wait_recv(slot, inst_recv)   # BUG: shared sem — which landing?
+        _compute_reuse(slot)
+    dl.wait_send(x_ref, send_sem)
+    dl.wait_send(x_ref, send_sem)
+
+
+def bad_reorderable_slot_reuse_kernel(team, m, r_cols, x_ref, staging,
+                                      scratch, slot, send_sem, io_sem):
+    """DPOR-only defect #2 — ACK-balanced but reorderable slot reuse:
+    every rank prefetches a staging block into ``scratch`` through a
+    local DMA and receives its right neighbor's shard into ``slot``, the
+    local completion and the remote arrival sharing one ``io_sem``.
+    Credits balance EXACTLY, yet the consumer reuses whichever buffer it
+    believes each credit acknowledged.  The wait order follows the
+    canonical arrival order (a producer ranked below me lands before my
+    own prefetch issue; one ranked above lands after), so the canonical
+    execution is clean at every rank; executing the other producer's
+    DMA first flips the credit matching and the reuse races the
+    still-unsettled landing."""
+    me, n = team.rank(), team.size
+    src = (me + 1) % n           # my slot is fed by my +1 neighbor
+    dl.local_copy(staging, scratch, io_sem)
+    dl.remote_copy(x_ref, slot, send_sem, io_sem,
+                   team.device_id((me - 1) % n))
+    order = (scratch, slot) if src > me else (slot, scratch)
+    for buf in order:
+        dl.wait_recv(buf, io_sem)    # BUG: shared sem — local or remote?
+        _compute_reuse(buf)
+    dl.wait_send(x_ref, send_sem)
+
+
 def diverged_method_kernel(team, sem, *, one_shot: bool):
     """Collective divergence: the op sequence depends on which method this
     HOST resolved (the ``tools/calibrate.py`` per-host-threshold hazard) —
@@ -145,6 +212,81 @@ def fixture_cases(n: int = 4) -> list[KernelCase]:
                    make_hier_dropped,
                    axes=(("dcn", n_out), ("tp", n_in))),
     ]
+
+
+def dpor_fixture_cases(n: int = 4) -> list[KernelCase]:
+    """Seeded-bad kernels that PASS every canonical check but fail under
+    reordering — the soundness gap ``analysis.explore`` exists to close
+    (see the kernel docstrings).  Kept OUT of :func:`fixture_cases`: the
+    canonical selftest asserts those are flagged, while
+    :func:`run_dpor_selftest` asserts these are canonical-clean AND
+    DPOR-caught, pinning the gap in both directions."""
+    if n < 3:
+        raise ValueError("the chained fixture needs n >= 3 (two distinct "
+                         "producer ranks per consumer pool)")
+    team = _team(n)
+    m, r = 4, 8
+
+    def make_chained(rank):
+        return "default", lambda: bad_chained_early_credit_kernel(
+            team, m, r, FakeRef("x", (m, r)),
+            FakeRef("slot_a", (m, r)), FakeRef("slot_b", (m, r)),
+            FakeSem("send_sem"), FakeSem("inst_recv"),
+        )
+
+    def make_reorder(rank):
+        return "default", lambda: bad_reorderable_slot_reuse_kernel(
+            team, m, r, FakeRef("x", (m, r)), FakeRef("staging", (m, r)),
+            FakeRef("scratch", (m, r)), FakeRef("slot", (m, r)),
+            FakeSem("send_sem"), FakeSem("io_sem"),
+        )
+
+    return [
+        KernelCase("fixture/chained_early_credit", "fixture", n,
+                   make_chained),
+        KernelCase("fixture/reorderable_slot_reuse", "fixture", n,
+                   make_reorder),
+    ]
+
+
+# DPOR-fixture contract: (check the explorer must report, token the
+# violation message must name)
+DPOR_EXPECTED = {
+    "fixture/chained_early_credit": ("write_overlap", "slot_"),
+    "fixture/reorderable_slot_reuse": ("write_overlap", "scratch"),
+}
+
+
+def run_dpor_selftest(n: int = 4) -> list[str]:
+    """Both directions of the ISSUE-15 soundness pin, per DPOR fixture:
+    (1) the canonical verifier reports NOTHING (the defect provably
+    passes the single maximal execution), and (2) the explorer flags the
+    expected check with the reused slot named.  Returns failure lines;
+    empty means the gap stays pinned."""
+    from .explore import explore_case
+    from .registry import record_case
+
+    problems = []
+    for case in dpor_fixture_cases(n):
+        want_check, token = DPOR_EXPECTED[case.name]
+        recorded = record_case(case)       # one pass feeds both checks
+        canonical = verify_case(case, recorded=recorded)
+        if canonical:
+            problems.append(
+                f"{case.name}: must PASS the canonical schedule, got "
+                f"{[str(v) for v in canonical]}")
+        res = explore_case(case, recorded=recorded)
+        hits = [v for v in res.violations if v.check == want_check]
+        if not hits:
+            problems.append(
+                f"{case.name}: DPOR must report a {want_check} violation "
+                f"(explored {res.schedules} classes), got "
+                f"{[v.check for v in res.violations]}")
+        elif not any(token in v.message for v in hits):
+            problems.append(
+                f"{case.name}: {want_check} message does not name the "
+                f"reused slot ({token!r}): {hits[0].message}")
+    return problems
 
 
 # which check each fixture MUST trip (selftest contract); extra findings

@@ -1,0 +1,419 @@
+"""Symbolic resource-footprint calculator per (kernel family x tile config).
+
+Second leg of the ISSUE-15 verification upgrade, same layer as the DPOR
+explorer: a kernel whose protocol verifies clean can still be
+UNBUILDABLE — its tile config oversubscribes VMEM (Mosaic refuses or
+spills), or its semaphore array count silently grows past what the
+scratch shapes allocate.  This module computes, from the same block
+shapes the builders use, a static :class:`Footprint` per (family x
+config):
+
+- ``vmem_bytes``: the explicit VMEM scratch (f32 accumulators, KV page
+  double buffers) plus the ``emit_pipeline`` double-buffered block
+  working set — two live copies of every in/out block, the pipeline's
+  overlap invariant (``ops.blocks``);
+- ``hbm_scratch_bytes``: HBM/ANY scratch buffers (ring slot/staging
+  arrays);
+- ``smem_bytes``: scalar-prefetch operands (SMEM);
+- ``dma_sems`` / ``regular_sems``: semaphore counts — derivable
+  independently from a RECORDED trace (:func:`sems_of_case`), so the
+  calculator and the protocol recorder cross-check each other.
+
+Validation compares ``vmem_bytes`` against the budget the config
+requests (``config.vmem_limit`` when the family has the knob, else
+Mosaic's default scoped budget, ``core.compilation``); the requested
+budget must itself fit the physical VMEM.  Consumers:
+
+- the autotuner prunes statically-infeasible candidates BEFORE
+  measuring (``tune.autotuner.prune_infeasible`` — an infeasible
+  candidate costs a compile attempt + an interleaved timing slot, and
+  on multi-process sweeps a per-rank build failure is fatal by
+  contract), counted by ``footprint_rejections``;
+- ``tdt_lint --completeness`` flags any family whose DEFAULT config
+  oversubscribes at its representative serving shape
+  (:func:`check_defaults`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+def _ib(dtype) -> int:
+    import jax.numpy as jnp
+
+    return int(jnp.dtype(dtype).itemsize)
+
+
+@dataclasses.dataclass(frozen=True)
+class Footprint:
+    """Static per-device resource footprint of one kernel invocation."""
+
+    vmem_bytes: int
+    hbm_scratch_bytes: int = 0
+    smem_bytes: int = 0
+    dma_sems: int = 0
+    regular_sems: int = 0
+
+    @property
+    def sems(self) -> int:
+        return self.dma_sems + self.regular_sems
+
+    def __add__(self, other: "Footprint") -> "Footprint":
+        return Footprint(
+            self.vmem_bytes + other.vmem_bytes,
+            self.hbm_scratch_bytes + other.hbm_scratch_bytes,
+            self.smem_bytes + other.smem_bytes,
+            self.dma_sems + other.dma_sems,
+            self.regular_sems + other.regular_sems,
+        )
+
+
+# ---------------------------------------------------------------------------
+# pipeline working sets (ops.blocks factories: every in/out block is
+# double-buffered so the next block's DMA rides under the current
+# block's compute)
+
+
+def matmul_pipeline_bytes(bm: int, bn: int, bk: int, dtype,
+                          out_dtype=None) -> int:
+    ib, ob = _ib(dtype), _ib(out_dtype if out_dtype is not None else dtype)
+    return 2 * (bm * bk + bk * bn) * ib + 2 * bm * bn * ob
+
+
+def add_pipeline_bytes(bm: int, bn: int, dtype) -> int:
+    """a + b -> out blockwise (the travelling-partial add)."""
+    return 2 * 3 * bm * bn * _ib(dtype)
+
+
+def sum_pipeline_bytes(n_in: int, bm: int, bn: int, dtype) -> int:
+    """n_in slots summed into one output (one-shot AllReduce)."""
+    return 2 * (n_in + 1) * bm * bn * _ib(dtype)
+
+
+def _acc(bm: int, bn: int) -> int:
+    return bm * bn * 4     # (bm, bn) f32 accumulator scratch
+
+
+# ---------------------------------------------------------------------------
+# per-family calculators (dims = the builders' per-device shapes)
+
+
+def matmul(cfg, m: int, n: int, k: int, dtype, out_dtype=None) -> Footprint:
+    """Plain blocked matmul.  ``cfg``: (bm, bn, bk[, vmem_limit]) tile
+    tuple or an object with .bm/.bn/.bk."""
+    bm, bn, bk = _tile3(cfg)
+    return Footprint(
+        vmem_bytes=_acc(bm, bn)
+        + matmul_pipeline_bytes(bm, bn, bk, dtype, out_dtype),
+    )
+
+
+def ag_gemm(cfg, m_loc: int, k: int, n_loc: int, num_ranks: int, dtype,
+            out_dtype=None, *, bidir: bool = True) -> Footprint:
+    return Footprint(
+        vmem_bytes=_acc(cfg.bm, cfg.bn)
+        + matmul_pipeline_bytes(cfg.bm, cfg.bn, cfg.bk, dtype, out_dtype),
+        dma_sems=1 + (2 if bidir else 1) + num_ranks,
+    )
+
+
+def gemm_rs(cfg, m_loc: int, k_loc: int, n_dim: int, num_ranks: int,
+            dtype, out_dtype=None) -> Footprint:
+    ob = _ib(out_dtype if out_dtype is not None else dtype)
+    return Footprint(
+        vmem_bytes=_acc(cfg.bm, cfg.bn)
+        + matmul_pipeline_bytes(cfg.bm, cfg.bn, cfg.bk, dtype, out_dtype)
+        + add_pipeline_bytes(cfg.bm, cfg.bn, out_dtype or dtype),
+        hbm_scratch_bytes=3 * 2 * m_loc * n_dim * ob,   # mm/recv/send slots
+        dma_sems=2 + 2,
+        regular_sems=2,
+    )
+
+
+def gemm_ar(cfg, m_loc: int, k_loc: int, n_dim: int, num_ranks: int,
+            dtype, out_dtype=None) -> Footprint:
+    base = gemm_rs(cfg, m_loc, k_loc, n_dim, num_ranks, dtype, out_dtype)
+    return base + Footprint(vmem_bytes=0, dma_sems=1 + num_ranks)
+
+
+def allreduce(cfg, m: int, r: int, num_ranks: int, dtype, *,
+              method: str = "two_shot") -> Footprint:
+    ib = _ib(dtype)
+    if method == "one_shot":
+        return Footprint(
+            vmem_bytes=sum_pipeline_bytes(num_ranks, cfg.bm, cfg.bn, dtype),
+            hbm_scratch_bytes=num_ranks * m * r * ib,
+            dma_sems=1 + 1 + num_ranks,
+        )
+    m_chunk = max(m // max(num_ranks, 1), 1)
+    return Footprint(
+        vmem_bytes=add_pipeline_bytes(cfg.bm, cfg.bn, dtype),
+        hbm_scratch_bytes=2 * 2 * m_chunk * r * ib,     # recv + send parity
+        dma_sems=2 + 2 + 1 + num_ranks,                 # rs pair + ag pair
+        regular_sems=2,
+    )
+
+
+def reduce_scatter(cfg, m: int, r: int, num_ranks: int, dtype) -> Footprint:
+    ib = _ib(dtype)
+    m_loc = max(m // max(num_ranks, 1), 1)
+    return Footprint(
+        vmem_bytes=add_pipeline_bytes(cfg.bm, cfg.bn, dtype),
+        hbm_scratch_bytes=2 * 2 * m_loc * r * ib,
+        dma_sems=2 + 2,
+        regular_sems=2,
+    )
+
+
+def all_to_all(cfg, t: int, h: int, num_ranks: int, dtype) -> Footprint:
+    """Pure-DMA push kernel: no pipeline working set; three (n,) int32
+    scalar-prefetch rows (counts/offs/expected) ride SMEM."""
+    return Footprint(
+        vmem_bytes=0,
+        smem_bytes=3 * num_ranks * 4,
+        dma_sems=1 + num_ranks,
+    )
+
+
+def fused_mlp_ar(cfg, b: int, k_in: int, k_loc: int, n_dim: int,
+                 num_ranks: int, dtype, out_dtype=None, *,
+                 swiglu: bool = True) -> Footprint:
+    ob = _ib(out_dtype if out_dtype is not None else dtype)
+    cn = max(n_dim // max(num_ranks, 1), 1)
+    vmem = _acc(cfg.bm, cfg.bn) \
+        + matmul_pipeline_bytes(cfg.bm, cfg.bn, cfg.bk, dtype, out_dtype) \
+        + add_pipeline_bytes(cfg.bm, cfg.bn, out_dtype or dtype)
+    hbm = 3 * 2 * b * cn * ob
+    if swiglu:
+        vmem += cfg.bm * cfg.bf * 4 \
+            + matmul_pipeline_bytes(cfg.bm, cfg.bf, cfg.bk, dtype,
+                                    out_dtype) \
+            + add_pipeline_bytes(cfg.bm, cfg.bf, out_dtype or dtype)
+        hbm += 3 * b * k_loc * ob                        # g/u/act staging
+    return Footprint(
+        vmem_bytes=vmem, hbm_scratch_bytes=hbm,
+        dma_sems=2 + 2 + 1 + num_ranks,
+        regular_sems=2,
+    )
+
+
+def fused_attn_decode(cfg, b: int, k_dim: int, h: int, hk: int, d: int,
+                      page_size: int, dtype) -> Footprint:
+    """Attention megakernel cell: one kv-head group's qkv weight columns
+    stay VMEM-resident across the batch loop, plus double-buffered KV
+    page streams and the token-fold registers."""
+    ib = _ib(dtype)
+    g = max(h // max(hk, 1), 1)
+    qkv_cols = (g + 2) * d       # per kv-head group: g query heads + k + v
+    vmem = k_dim * qkv_cols * ib \
+        + 2 * 2 * page_size * d * ib \
+        + 2 * d * ib + (2 + g) * d * 4
+    return Footprint(vmem_bytes=vmem, dma_sems=4)
+
+
+def persistent_decode(cfg, layers: int, b: int, k_dim: int, hk: int,
+                      g: int, d: int, page_size: int, f_loc: int,
+                      num_ranks: int, dtype) -> Footprint:
+    """The persistent chain: per-layer streamed weights ride
+    double-buffered pipelines (two layers' weights live while layer j
+    computes and j+1 prefetches), plus the residual/activation staging
+    and the shared ring buffers."""
+    ib = _ib(dtype)
+    h_loc = hk * g
+    qkv_cols = (h_loc + 2 * hk) * d
+    cn = max(k_dim // max(num_ranks, 1), 1)
+    layer_weights = (k_dim * qkv_cols + h_loc * d * k_dim
+                     + k_dim * 2 * f_loc + f_loc * k_dim + 3 * k_dim)
+    vmem = (
+        2 * layer_weights * ib                     # double-buffered stream
+        + 3 * b * k_dim * ib                       # xa/xb/h_buf residuals
+        + b * qkv_cols * ib
+        + 2 * b * h_loc * d * ib                   # attn_vm/attn_buf
+        + 3 * b * f_loc * ib                       # g/u/act
+        + num_ranks * b * cn * ib                  # red_buf
+        + 3 * 2 * b * cn * ib                      # mm/recv/send
+        + 2 * 2 * page_size * d * ib               # kbuf/vbuf
+        + (qkv_cols + 4 * d) * ib                  # qrow + token regs
+        + _acc(cfg.bm, cfg.bn) + cfg.bm * cfg.bf * 4
+    )
+    return Footprint(
+        vmem_bytes=vmem,
+        smem_bytes=b * (1 + cfg_mp(cfg)) * 4,
+        dma_sems=3 + 2 + 1 + num_ranks,
+        regular_sems=2,
+    )
+
+
+def cfg_mp(cfg) -> int:
+    """Block-table pages-per-row the persistent kernel prefetches into
+    SMEM; not a tile knob — a serving-geometry input with a modest
+    default for footprint purposes."""
+    return int(getattr(cfg, "max_pages", 8))
+
+
+def _tile3(cfg) -> tuple[int, int, int]:
+    if isinstance(cfg, (tuple, list)):
+        return int(cfg[0]), int(cfg[1]), int(cfg[2])
+    return int(cfg.bm), int(cfg.bn), int(cfg.bk)
+
+
+FAMILY_FOOTPRINTS = {
+    "matmul": matmul,
+    "ag_gemm": ag_gemm,
+    "gemm_rs": gemm_rs,
+    "gemm_ar": gemm_ar,
+    "allreduce": allreduce,
+    "reduce_scatter": reduce_scatter,
+    "all_to_all": all_to_all,
+    "fused_mlp_ar": fused_mlp_ar,
+    "fused_attn_decode": fused_attn_decode,
+    "persistent_decode": persistent_decode,
+}
+
+
+# ---------------------------------------------------------------------------
+# semaphore counts from RECORDED traces (the independent cross-check)
+
+
+def sems_of_case(case) -> tuple[int, int]:
+    """(dma, regular) distinct semaphore instances rank 0 of a registry
+    :class:`KernelCase` touches — derived from the recorded trace, so a
+    kernel growing a semaphore its scratch_shapes (and this module's
+    calculator) do not account for shows up as a count mismatch."""
+    from .events import CopyEv, NotifyEv, WaitEv
+    from .record import record_kernel
+
+    _label, thunk = case.make(0)
+    rec = record_kernel(thunk, n=case.n, rank=0, axes=case.axes)
+    dma, regular = set(), set()
+    for ev in rec.events:
+        if isinstance(ev, CopyEv):
+            if ev.send_sem is not None:
+                dma.add(ev.send_sem)
+            dma.add(ev.recv_sem)
+        elif isinstance(ev, WaitEv):
+            (dma if ev.unit == "elem" else regular).add(ev.sem)
+        elif isinstance(ev, NotifyEv):
+            regular.add(ev.sem)
+    return len(dma), len(regular)
+
+
+# ---------------------------------------------------------------------------
+# validation
+
+
+def budget_for(cfg) -> int:
+    """The VMEM budget a config REQUESTS: its ``vmem_limit`` knob (tile
+    tuples: the optional 4th element) when set, else Mosaic's default
+    scoped budget."""
+    from ..core import compilation
+
+    limit = None
+    if isinstance(cfg, (tuple, list)):
+        limit = cfg[3] if len(cfg) > 3 else None
+    else:
+        limit = getattr(cfg, "vmem_limit", None)
+    return int(limit) if limit else compilation.MOSAIC_DEFAULT_VMEM_BYTES
+
+
+def validate(fp: Footprint, cfg=None, *, budget: int | None = None,
+             physical: int | None = None, label: str = "") -> list[str]:
+    """Problems (empty = feasible): the working set must fit the
+    requested budget, and the requested budget the physical VMEM.
+    ``physical`` pins the physical bound explicitly — the autotuner's
+    pruning passes the compile-time constant so a per-host
+    ``TDT_VMEM_BUDGET`` divergence cannot desynchronize multi-process
+    candidate lists; the lint (default None) honors the env override."""
+    from ..core import compilation
+
+    if budget is None:
+        budget = budget_for(cfg)
+    phys = compilation.vmem_budget_bytes() if physical is None \
+        else int(physical)
+    out = []
+    tag = f"{label}: " if label else ""
+    if budget > phys:
+        out.append(
+            f"{tag}requested VMEM budget {budget / 2**20:.1f} MiB exceeds "
+            f"the physical {phys / 2**20:.0f} MiB")
+    if fp.vmem_bytes > min(budget, phys):
+        out.append(
+            f"{tag}static VMEM working set {fp.vmem_bytes / 2**20:.1f} MiB "
+            f"oversubscribes the {min(budget, phys) / 2**20:.1f} MiB "
+            f"budget — Mosaic will refuse or spill; prune before "
+            f"measuring")
+    return out
+
+
+def config_feasible(family: str, cfg, dims: dict, *,
+                    physical: int | None = None) -> list[str]:
+    """Problems for (family, config) at ``dims`` (keyword args of the
+    family's calculator); unknown families are feasible by definition —
+    pruning must never have false positives.  ``physical`` as in
+    :func:`validate`."""
+    calc = FAMILY_FOOTPRINTS.get(family)
+    if calc is None:
+        return []
+    fp = calc(cfg, **dims)
+    return validate(fp, cfg, physical=physical,
+                    label=f"{family}{_tile_label(cfg)}")
+
+
+def _tile_label(cfg) -> str:
+    if isinstance(cfg, (tuple, list)):
+        return str(tuple(cfg))
+    bm = getattr(cfg, "bm", None)
+    return f"(bm={bm}, bn={getattr(cfg, 'bn', None)})" if bm else ""
+
+
+# representative serving shapes per family for the default-config lint
+# (the bench.py / serve defaults: qwen-class hidden sizes on an 8-way
+# ring) — the completeness leg flags any DEFAULT that cannot build there
+def default_checks() -> list[tuple[str, object, dict]]:
+    import jax.numpy as jnp
+
+    from ..comm.all_to_all import AllToAllConfig
+    from ..comm.allreduce import AllReduceConfig
+    from ..comm.reduce_scatter import ReduceScatterConfig
+    from ..ops.ag_gemm import AgGemmConfig
+    from ..ops.fused_decode import FusedMlpConfig
+    from ..ops.gemm_ar import GemmArConfig
+    from ..ops.gemm_rs import GemmRsConfig
+    from ..ops.persistent_decode import PersistentDecodeConfig
+    from ..tune.autotuner import MATMUL_DEFAULT_TILES
+
+    bf16 = jnp.bfloat16
+    return [
+        ("matmul", MATMUL_DEFAULT_TILES,
+         dict(m=4096, n=4096, k=4096, dtype=bf16)),
+        ("ag_gemm", AgGemmConfig().clip(512, 2048, 512),
+         dict(m_loc=512, k=2048, n_loc=512, num_ranks=8, dtype=bf16)),
+        ("gemm_rs", GemmRsConfig().clip(512, 256, 2048),
+         dict(m_loc=512, k_loc=256, n_dim=2048, num_ranks=8, dtype=bf16)),
+        ("gemm_ar", GemmArConfig().clip(512, 256, 2048),
+         dict(m_loc=512, k_loc=256, n_dim=2048, num_ranks=8, dtype=bf16)),
+        ("allreduce", AllReduceConfig().clip(4096, 2048),
+         dict(m=4096, r=2048, num_ranks=8, dtype=bf16)),
+        ("reduce_scatter", ReduceScatterConfig().clip(512, 2048),
+         dict(m=4096, r=2048, num_ranks=8, dtype=bf16)),
+        ("all_to_all", AllToAllConfig(),
+         dict(t=4096, h=2048, num_ranks=8, dtype=bf16)),
+        ("fused_mlp_ar", FusedMlpConfig().clip(8, 768, 256),
+         dict(b=8, k_in=2048, k_loc=768, n_dim=2048, num_ranks=8,
+              dtype=bf16)),
+        ("persistent_decode", PersistentDecodeConfig(),
+         dict(layers=24, b=8, k_dim=2048, hk=1, g=2, d=128, page_size=16,
+              f_loc=768, num_ranks=8, dtype=bf16)),
+    ]
+
+
+def check_defaults() -> list[str]:
+    """The ``tdt_lint --completeness`` footprint leg: every family's
+    DEFAULT config must be statically buildable at its representative
+    serving shape — a default that oversubscribes means the op fails
+    exactly when the autotuner is disabled or cold, the worst time."""
+    out = []
+    for family, cfg, dims in default_checks():
+        out.extend(config_feasible(family, cfg, dims))
+    return out

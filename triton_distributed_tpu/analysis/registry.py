@@ -716,9 +716,11 @@ def all_cases(ranks=DEFAULT_RANKS) -> list[KernelCase]:
 # verification entry points
 
 
-def verify_case(case: KernelCase) -> list[Violation]:
-    """Record all N ranks of one case and run the four checks.  Check and
-    violation totals land in the obs registry when observability is on."""
+def record_case(case: KernelCase) -> tuple[list, list, list]:
+    """Record all N ranks of one case ONCE: (traces, signatures,
+    variants).  Shareable between the canonical checks and the DPOR
+    explorer — a build-time verification with the explore knob armed
+    must not pay the trace recording twice per case."""
     traces, sigs, variants = [], [], []
     for rank in range(case.n):
         label, thunk = case.make(rank)
@@ -726,6 +728,15 @@ def verify_case(case: KernelCase) -> list[Violation]:
         traces.append(rec.events)
         sigs.append(rec.collapsed_signature())
         variants.append(label)
+    return traces, sigs, variants
+
+
+def verify_case(case: KernelCase, *, recorded=None) -> list[Violation]:
+    """Record all N ranks of one case (or reuse ``recorded`` from
+    :func:`record_case`) and run the four checks.  Check and violation
+    totals land in the obs registry when observability is on."""
+    traces, sigs, variants = recorded if recorded is not None \
+        else record_case(case)
     violations = analyze(case.name, case.n, traces, sigs, variants)
     from .. import obs
 
@@ -751,31 +762,63 @@ def verify_all(ranks=DEFAULT_RANKS, *, kernel_filter: str | None = None):
     return out
 
 
-# one verification per (family, n) per process: builders are themselves
-# cached, but the flat entry points re-invoke them per shape class
-_VERIFIED: set[tuple[str, int]] = set()
+# one verification per (family, n, explore depth) per process: builders
+# are themselves cached, but the flat entry points re-invoke them per
+# shape class
+_VERIFIED: set[tuple[str, int, int | None]] = set()
 _VERIFIED_LOCK = threading.Lock()
 
 
-def maybe_verify_build(family: str, n: int) -> None:
+def maybe_verify_build(family: str, n: int, *,
+                       explore: int | None = None) -> None:
     """Statically verify ``family`` at ``n`` ranks before the kernel is
     built; raises :class:`ProtocolViolationError` on any violation — a
     kernel with a broken wait/notify protocol must not reach the compiler.
 
-    The ``TDT_VERIFY`` env gate is owned by its one caller,
-    ``core.compilation.verify_protocol`` (a direct call here verifies
-    unconditionally); degenerate meshes have no protocol to check."""
+    ``explore`` (the ``TDT_VERIFY_EXPLORE`` knob via
+    ``core.compilation.verify_protocol``) additionally model-checks every
+    schedule class with the DPOR explorer: an integer is the preemption
+    bound, -1 the exact mode, None canonical-only.  The ``TDT_VERIFY``
+    env gate is owned by the compilation hook (a direct call here
+    verifies unconditionally); degenerate meshes have no protocol to
+    check."""
     if n < 2:
         return
     family = _FAMILY_ALIASES.get(family, family)
-    key = (family, int(n))
+    key = (family, int(n), explore)
     with _VERIFIED_LOCK:
         if key in _VERIFIED:
             return
     violations = []
+    capped = False
     for case in cases_for(family, n):
-        violations.extend(verify_case(case))
+        recorded = record_case(case)           # ONE recording pass
+        violations.extend(verify_case(case, recorded=recorded))
+        if explore is not None and not violations:
+            from .explore import explore_case
+
+            if explore < 0:
+                # the operator asked for EXACT: no preemption bound and
+                # no resource caps — truncating here and memoizing the
+                # result as verified would silently weaken the gate
+                res = explore_case(case, recorded=recorded,
+                                   preemption_bound=None,
+                                   max_schedules=2**62, budget_ms=None)
+            else:
+                res = explore_case(case, recorded=recorded,
+                                   preemption_bound=explore)
+            violations.extend(res.violations)
+            if res.pruned:
+                import warnings
+
+                capped = True
+                warnings.warn(
+                    f"TDT_VERIFY_EXPLORE: {case.name}@{n} hit a "
+                    f"schedule/time cap after {res.schedules} clean "
+                    f"classes — bounded verification only; the result "
+                    f"is NOT memoized as verified")
     if violations:
         raise ProtocolViolationError(violations)
-    with _VERIFIED_LOCK:
-        _VERIFIED.add(key)
+    if not capped:
+        with _VERIFIED_LOCK:
+            _VERIFIED.add(key)

@@ -86,16 +86,73 @@ def protocol_verify_enabled() -> bool:
     return env_flag("TDT_VERIFY")
 
 
+def explore_depth() -> int | None:
+    """The ``TDT_VERIFY`` explore-depth knob, ``TDT_VERIFY_EXPLORE``:
+    unset/``0`` = canonical verification only (None); an integer ``N`` =
+    additionally model-check every schedule class under the DPOR
+    explorer with a preemption bound of N (``analysis.explore``);
+    ``exact`` = exhaustive (no bound, encoded as -1).  The canonical
+    run is sound for deadlock; the explorer closes the multi-producer
+    credit-matching gap (docs/static_analysis.md "Schedule
+    exhaustiveness")."""
+    import os
+
+    raw = os.environ.get("TDT_VERIFY_EXPLORE", "").strip().lower()
+    if raw in ("", "0", "off", "no", "false"):
+        return None
+    if raw == "exact":
+        return -1
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"TDT_VERIFY_EXPLORE={raw!r}: expected an integer preemption "
+            f"bound, 'exact', or unset") from None
+    # any negative means exact — the -1 encoding maybe_verify_build
+    # documents (clamping to bound 0 would silently WEAKEN a gate the
+    # operator asked to be exhaustive)
+    return -1 if v < 0 else v
+
+
 def verify_protocol(family: str, num_ranks: int) -> None:
     """Build-time hook the collective op builders call: no-op unless
     ``TDT_VERIFY=1`` (one env read + int compare), else delegates to
     ``analysis.registry.maybe_verify_build`` (memoized per family x ranks;
-    raises ``analysis.ProtocolViolationError`` on violation)."""
+    raises ``analysis.ProtocolViolationError`` on violation).  With
+    ``TDT_VERIFY_EXPLORE`` set, the schedule-exhaustive explorer runs on
+    top of the canonical checks at that preemption depth."""
     if num_ranks < 2 or not protocol_verify_enabled():
         return
     from ..analysis import maybe_verify_build
 
-    maybe_verify_build(family, num_ranks)
+    maybe_verify_build(family, num_ranks, explore=explore_depth())
+
+
+# Physical VMEM per TensorCore (v5-class parts: 128 MiB) and Mosaic's
+# DEFAULT scoped-VMEM compile budget (16 MiB — what a kernel gets unless
+# its pallas_call raises ``vmem_limit_bytes``, see ``ops.group_gemm``/
+# ``ops.matmul``).  The static footprint lint (``analysis.footprint``)
+# validates tile working sets against these; ``TDT_VMEM_BUDGET`` (bytes)
+# overrides the physical number for other parts.
+VMEM_BYTES = 128 * 2**20
+MOSAIC_DEFAULT_VMEM_BYTES = 16 * 2**20
+
+
+def vmem_budget_bytes() -> int:
+    import os
+
+    raw = os.environ.get("TDT_VMEM_BUDGET", "")
+    if not raw:
+        return VMEM_BYTES
+    try:
+        return int(raw)
+    except ValueError:
+        # silently falling back would green-light the lint against the
+        # wrong part's budget — the masking failure the PRUNED-marker
+        # discipline exists to prevent
+        raise ValueError(
+            f"TDT_VMEM_BUDGET={raw!r}: expected bytes as an integer"
+        ) from None
 
 
 def interpret_mode() -> pltpu.InterpretParams | bool:

@@ -270,6 +270,66 @@ def persistent_decode(layers: int, b: int, k_dim: int, h: int, hk: int,
     return per_layer.scaled(layers)
 
 
+def all_gather(m_loc: int, r: int, num_ranks: int, dtype) -> KernelCost:
+    """Eager AG per device (ring accounting — push/bidir move the same
+    total bytes over more links): (n-1) shards transit this rank's ICI
+    links; HBM pays the gathered write plus the local shard read."""
+    n = num_ranks
+    ib = _itemsize(dtype)
+    shard = m_loc * r * ib
+    wire = (n - 1) * shard
+    return KernelCost(
+        flops=0,
+        bytes_accessed=(n + 1) * shard + wire,
+        wire_bytes=wire,
+    )
+
+
+def reduce_scatter(m: int, r: int, num_ranks: int, dtype) -> KernelCost:
+    """Ring RS per device: (n-1) travelling-partial hops of the m/n
+    chunk, one add per forwarded element."""
+    n = num_ranks
+    ib = _itemsize(dtype)
+    chunk = (m // max(n, 1)) * r
+    wire = (n - 1) * chunk * ib
+    return KernelCost(
+        flops=(n - 1) * chunk,
+        bytes_accessed=m * r * ib + chunk * ib + 2 * wire,
+        wire_bytes=wire,
+    )
+
+
+def all_reduce(m: int, r: int, num_ranks: int, dtype) -> KernelCost:
+    """Two-shot AR per device: the RS phase plus the AG ring returning
+    every reduced chunk — 2(n-1)/n of the payload per link."""
+    n = num_ranks
+    ib = _itemsize(dtype)
+    rs = reduce_scatter(m, r, n, dtype)
+    ag_wire = (n - 1) * (m // max(n, 1)) * r * ib
+    return KernelCost(
+        flops=rs.flops,
+        bytes_accessed=rs.bytes_accessed + 2 * ag_wire,
+        wire_bytes=rs.wire_bytes + ag_wire,
+    )
+
+
+def quantized_wire(rows: int, h: int, num_ranks: int, wire_dtype: str,
+                   kind: str = "all_gather") -> KernelCost:
+    """A quantized collective at its packed-u8 wire geometry: the same
+    ring/exchange protocols over ``packed_wire_bytes`` rows (payload
+    byte per element + the 128-lane scale sidecar), plus the pack/unpack
+    pass over the full-precision payload."""
+    packed = packed_wire_bytes(rows, h, wire_dtype)
+    n = num_ranks
+    wire = (n - 1) * packed // max(n, 1) if kind != "all_gather" \
+        else (n - 1) * packed
+    return KernelCost(
+        flops=2 * rows * h,               # absmax + scale multiply
+        bytes_accessed=2 * rows * h * 2 + packed + wire,
+        wire_bytes=wire,
+    )
+
+
 def packed_wire_bytes(rows: int, h: int, wire_dtype: str) -> int:
     """Bytes ``rows`` H-wide rows occupy on a QUANTIZED wire (payload
     byte per element + the 128-lane scale sidecar per row —
@@ -361,6 +421,13 @@ FAMILY_COSTS = {
     "ag_gemm": ag_gemm,
     "gemm_rs": gemm_rs,
     "gemm_ar": gemm_ar,
+    # the eager collective families (ISSUE 15 completeness: every
+    # analysis.registry family prices through ONE flop/byte source —
+    # these fold the perf_model wire arithmetic into KernelCost form)
+    "allgather": all_gather,
+    "reduce_scatter": reduce_scatter,
+    "allreduce": all_reduce,
+    "quantized_wire": quantized_wire,
     "flash_attention": flash_attention,
     "sp_attention": flash_attention,
     "decode_attention": decode_attention,

@@ -658,7 +658,11 @@ def _resolve_fused_mlp(name, b, k_in, k_loc, n_dim, n, dtype, run, *,
     return _tune.resolve_config(
         name,
         (b, k_in, k_loc, n_dim, n, str(dtype), platform.device_kind()),
-        fused_mlp_candidates(b, k_loc, n_dim // n),
+        # the SHARED pruned sweep (tune.autotuner) — the candidates
+        # digest keys the winner cache, so this transparent path and
+        # fresh_tune_fused_mlp must consume the identical list
+        _tune.fused_mlp_candidates_pruned(b, k_in, k_loc, n_dim, n,
+                                          dtype),
         FusedMlpConfig().clip(b, k_loc, n_dim // n),
         lambda c: (lambda: run(c)),
         tracing=tracing,

@@ -140,7 +140,7 @@ def matmul(
 
         config = _tune.resolve_config(
             "matmul", _tune.matmul_resolve_key(m, n, k, a.dtype),
-            _tune.matmul_backend_candidates(m, n, k),
+            _tune.matmul_candidates_pruned(m, n, k, a.dtype),
             XlaBackend(),
             lambda c: (lambda: matmul(a, b, config=c, out_dtype=out_dtype)),
             tracing=_tune.is_tracer(a) or _tune.is_tracer(b),
@@ -184,7 +184,7 @@ def matmul_callable(a: jax.Array, b: jax.Array, *, out_dtype=None):
 
     config = _tune.resolve_config(
         "matmul", _tune.matmul_resolve_key(m, n, k, a.dtype),
-        _tune.matmul_backend_candidates(m, n, k),
+        _tune.matmul_candidates_pruned(m, n, k, a.dtype),
         XlaBackend(),
         lambda c: (lambda: matmul(a, b, config=c, out_dtype=out_dtype)),
         tracing=False,

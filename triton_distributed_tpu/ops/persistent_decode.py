@@ -121,32 +121,44 @@ class StackedDecodeParams:
 # config
 
 
+_PERSISTENT_VL = 100 * 2**20
+
+
 @dataclasses.dataclass(frozen=True)
 class PersistentDecodeConfig:
     """Tile knobs of the persistent decode megakernel: ``bm`` rows
     (clipped to B), ``bn`` output columns per matmul block, ``bk``
     contraction depth, ``bf`` the gate/up feature tile; ``vmem_limit``
-    raises Mosaic's scoped budget (the per-layer streamed working set
-    plus two KV page buffers can exceed the 16 MiB default)."""
+    raises Mosaic's scoped budget.  The default REQUESTS the raised
+    budget: the per-layer streamed weight working set (double-buffered
+    qkv/o/gate-up/down stacks) is ~2x the layer's weight bytes and
+    exceeds the 16 MiB Mosaic default at every serving hidden size —
+    the ISSUE-15 footprint lint (``analysis.footprint.check_defaults``)
+    caught the old ``None`` default as statically unbuildable exactly
+    when the autotuner is cold."""
 
     bm: int = 1024
     bn: int = 512
     bk: int = 512
     bf: int = 512
-    vmem_limit: int | None = None
-
-
-_PERSISTENT_VL = 100 * 2**20
+    vmem_limit: int | None = _PERSISTENT_VL
 
 
 def persistent_decode_candidates(b: int, k_loc: int, cn: int) -> list:
     """Default-first sweep for the ``config=None`` path, clipped to the
     problem and deduped like ``fused_mlp_candidates`` — at decode shapes
     most tilings collapse onto the default and the one-candidate sweep
-    short-circuits."""
-    dims = [(1024, 512, 512, 512, None), (1024, 1024, 512, 512, None),
-            (1024, 512, 1024, 1024, None),
-            (1024, 512, 512, 512, _PERSISTENT_VL)]
+    short-circuits.  The default-budget (``None``) variant stays in the
+    sweep for small models whose streamed set fits 16 MiB; the footprint
+    pruner drops it where it cannot build."""
+    dims = [(1024, 512, 512, 512, _PERSISTENT_VL),
+            (1024, 1024, 512, 512, _PERSISTENT_VL),
+            (1024, 512, 1024, 1024, _PERSISTENT_VL),
+            (1024, 512, 512, 512, None)]
+    # NOTE: resolve paths consume this through
+    # ``persistent_candidates_pruned`` (the footprint pruner drops the
+    # default-budget variant where it cannot build); this raw list is
+    # the unpruned sweep definition
     out, seen = [], set()
     for bm, bn, bk, bf, vl in dims:
         c = PersistentDecodeConfig(
@@ -157,6 +169,34 @@ def persistent_decode_candidates(b: int, k_loc: int, cn: int) -> list:
             seen.add(c)
             out.append(c)
     return out
+
+
+def persistent_candidates_pruned(layers: int, b: int, k_dim: int,
+                                 f_dim: int, h: int, hk: int, ps: int,
+                                 d: int, n: int, dtype) -> list:
+    """The ONE pruned sweep every persistent resolve path must consume —
+    the transparent ``persistent_decode_step(config=None)`` path,
+    ``tune.fresh_tune_persistent_decode``, and the ``serve.EngineBackend``
+    construction-time hoist: the candidates digest keys the winner
+    cache, so a one-sided prune would split it (the review-pinned
+    invariant, see ``tune.autotuner.prune_infeasible``), and the
+    per-device streamed weight working set decides which budget
+    variants can build at all (at serving hidden sizes the
+    default-budget variant cannot — measuring it pays a doomed compile,
+    fatal per-rank in multi-process sweeps).  Dims are GLOBAL (the
+    entry-point shapes); per-device hk/g/f_loc are derived here exactly
+    as the builder derives them."""
+    from ..tune.autotuner import prune_infeasible
+
+    n = max(n, 1)
+    hk_loc = max(hk // n, 1)
+    g = max((h // n) // hk_loc, 1)
+    return prune_infeasible(
+        "persistent_decode",
+        persistent_decode_candidates(b, f_dim // n, k_dim // n),
+        PersistentDecodeConfig(),
+        dict(layers=layers, b=b, k_dim=k_dim, hk=hk_loc, g=g, d=d,
+             page_size=ps, f_loc=f_dim // n, num_ranks=n, dtype=dtype))
 
 
 # ---------------------------------------------------------------------------
@@ -776,7 +816,8 @@ def persistent_decode_step(
             "persistent_decode",
             persistent_config_key(layers, b, k_dim, f_dim, hk, ps, mp, d,
                                   n, x.dtype),
-            persistent_decode_candidates(b, f_dim // n, k_dim // n),
+            persistent_candidates_pruned(layers, b, k_dim, f_dim, h, hk,
+                                         ps, d, n, x.dtype),
             PersistentDecodeConfig(),
             thunk,
             tracing=any(map(_tune.is_tracer, (x, pool_k, seq_lens))),

@@ -50,6 +50,10 @@ DEFAULT_KERNELS = (
     "reduce_scatter/ring",
     "allreduce/two_shot",
     "all_to_all/dispatch",
+    # ag_gemm joined the matrix in ISSUE 15: the cross-subsystem
+    # completeness lint (`tdt_lint --completeness`) found it was the one
+    # registry family with NO fault-injection coverage
+    "ag_gemm/unidir",
     "gemm_rs/ring",
     "gemm_ar/ring",
     # the decode megakernel's semaphore-chained MLP+AR (ISSUE 8): the

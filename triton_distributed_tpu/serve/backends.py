@@ -380,8 +380,13 @@ class EngineBackend:
         # the default — never a measurement at construction time
         return tune.resolve_config(
             "persistent_decode", key,
-            pd.persistent_decode_candidates(
-                self.slots, c.intermediate // n, c.hidden // n),
+            # the SHARED pruned sweep — all three persistent resolve
+            # paths must hand resolve_config the identical list (the
+            # candidates digest keys the winner cache)
+            pd.persistent_candidates_pruned(
+                c.num_layers, self.slots, c.hidden, c.intermediate,
+                c.num_heads, c.num_kv_heads, self.page_size, c.head_dim,
+                n, jnp.dtype(c.dtype)),
             pd.PersistentDecodeConfig(),
             lambda cfg: (lambda: None),
             tracing=True,

@@ -592,6 +592,83 @@ def is_tracer(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
+def prune_infeasible(name: str, candidates: Sequence[Any], default: Any,
+                     dims: dict) -> list:
+    """Drop candidates whose static resource footprint
+    (``analysis.footprint``) cannot build at ``dims`` BEFORE anything is
+    measured: an infeasible candidate costs a compile attempt plus an
+    interleaved timing slot, and in multi-process sweeps a per-rank
+    build failure is fatal by contract (see ``Autotuner.tune``).  The
+    pruning is deterministic in (name, config, dims) — it deliberately
+    pins the physical-VMEM bound to the compile-time constant
+    (``compilation.VMEM_BYTES``) rather than the ``TDT_VMEM_BUDGET``
+    env override, so a per-host env divergence cannot break the
+    multi-process identical-candidates invariant (the env knob scopes
+    to the LINT, ``footprint.check_defaults``).  Because a pruned list
+    has a different candidates digest than an unpruned one, EVERY
+    resolve path sharing a cache key must consume the same pruned list
+    — use the shared per-family helpers (``matmul_candidates_pruned``,
+    ``fused_mlp_candidates_pruned``, ``resolve_gemm_like``), never a
+    one-sided prune.  The DEFAULT is never pruned (the sweep's
+    baseline; if it is itself infeasible the ``tdt_lint
+    --completeness`` default-config leg flags it); non-tile candidates
+    (``XlaBackend``) pass through.  Rejections land on the
+    ``footprint_rejections`` counter."""
+    from .. import obs
+    from ..analysis import footprint
+    from ..core import compilation
+
+    kept = []
+    for c in candidates:
+        tile_like = isinstance(c, (tuple, list)) or hasattr(c, "bm")
+        if c == default or not tile_like \
+                or not footprint.config_feasible(
+                    name, c, dims, physical=compilation.VMEM_BYTES):
+            kept.append(c)
+            continue
+        if obs.enabled():
+            obs.counter("footprint_rejections", name=name).inc()
+    return kept
+
+
+def matmul_candidates_pruned(m: int, n: int, k: int, dtype) -> list:
+    """The ONE candidate list every matmul resolve path (transparent
+    ``matmul(config=None)``, ``matmul_callable``, ``tuned_matmul``,
+    ``fresh_tune_matmul``) must use: the backend sweep with statically
+    infeasible tiles pruned.  Sharing the exact list keeps the
+    candidates digest — and therefore the winner-cache entry — common
+    to all paths."""
+    return prune_infeasible("matmul", matmul_backend_candidates(m, n, k),
+                            XlaBackend(), dict(m=m, n=n, k=k, dtype=dtype))
+
+
+def fused_mlp_candidates_pruned(b: int, k_in: int, k_loc: int, n_dim: int,
+                                num_ranks: int, dtype) -> list:
+    """``matmul_candidates_pruned``'s analogue for the fused MLP+AR
+    sweep, shared by ``ops.fused_decode._resolve_fused_mlp`` (the
+    transparent path) and ``fresh_tune_fused_mlp``."""
+    from ..ops.fused_decode import FusedMlpConfig, fused_mlp_candidates
+
+    cn = n_dim // max(num_ranks, 1)
+    return prune_infeasible(
+        "fused_mlp_ar", fused_mlp_candidates(b, k_loc, cn),
+        FusedMlpConfig().clip(b, k_loc, cn),
+        dict(b=b, k_in=k_in, k_loc=k_loc, n_dim=n_dim,
+             num_ranks=num_ranks, dtype=dtype))
+
+
+def _gemm_like_footprint_dims(name: str, m: int, n: int, k: int,
+                              n_ranks: int, dtype) -> dict:
+    """The fused collective GEMMs' per-device calculator dims from the
+    flat (m, n, k) problem ``resolve_gemm_like`` sees."""
+    r = max(n_ranks, 1)
+    if name == "ag_gemm":
+        return dict(m_loc=max(m // r, 1), k=k, n_loc=max(n // r, 1),
+                    num_ranks=r, dtype=dtype)
+    return dict(m_loc=max(m // r, 1), k_loc=max(k // r, 1), n_dim=n,
+                num_ranks=r, dtype=dtype)
+
+
 def resolve_gemm_like(name: str, op, config_cls, cand_dims, default,
                       a, b, mesh, axis: str, kw: dict,
                       key_kw: dict | None = None, *,
@@ -613,6 +690,9 @@ def resolve_gemm_like(name: str, op, config_cls, cand_dims, default,
     dm, dn, dk = cand_dims(m, n, k, n_ranks)
     cands = [config_cls(bm, bn, bk)
              for bm, bn, bk in matmul_tile_candidates(dm, dn, dk)]
+    cands = prune_infeasible(
+        name, cands, default,
+        _gemm_like_footprint_dims(name, m, n, k, n_ranks, a.dtype))
     kw_key = str(sorted((key_kw if key_kw is not None else kw).items()))
     return resolve_config(
         name,
@@ -737,7 +817,8 @@ def _matmul_resolve(a: jax.Array, b: jax.Array, kw: dict, *,
     (m, k), (_, n) = a.shape, b.shape
     return resolve_config(
         "matmul", matmul_resolve_key(m, n, k, a.dtype),
-        matmul_backend_candidates(m, n, k), XlaBackend(),
+        matmul_candidates_pruned(m, n, k, a.dtype),
+        XlaBackend(),
         lambda c: (lambda: matmul(a, b, config=c, **kw)),
         tracing=is_tracer(a) or is_tracer(b),
         force_measure=True,
@@ -794,11 +875,7 @@ def fresh_tune_fused_mlp(x, gate_up, down, mesh, axis: str = "tp") -> Any:
     ``config=None`` path consults, so a bench/warmup crown teaches every
     later jitted decode step."""
     from ..core import platform
-    from ..ops.fused_decode import (
-        FusedMlpConfig,
-        fused_mlp_ar,
-        fused_mlp_candidates,
-    )
+    from ..ops.fused_decode import FusedMlpConfig, fused_mlp_ar
 
     n = mesh.shape[axis]
     b, k_in = x.shape
@@ -807,7 +884,7 @@ def fresh_tune_fused_mlp(x, gate_up, down, mesh, axis: str = "tp") -> Any:
     return resolve_config(
         "fused_mlp_ar",
         (b, k_in, k_loc, n_dim, n, str(x.dtype), platform.device_kind()),
-        fused_mlp_candidates(b, k_loc, cn),
+        fused_mlp_candidates_pruned(b, k_in, k_loc, n_dim, n, x.dtype),
         FusedMlpConfig().clip(b, k_loc, cn),
         lambda c: (lambda: fused_mlp_ar(x, gate_up, down, mesh, axis,
                                         config=c)),
@@ -830,8 +907,8 @@ def fresh_tune_persistent_decode(x, sp, pool_k, pool_v, block_table,
     later jitted step bundle without a per-dispatch consult."""
     from ..ops.persistent_decode import (
         PersistentDecodeConfig,
+        persistent_candidates_pruned,
         persistent_config_key,
-        persistent_decode_candidates,
         persistent_decode_step,
     )
 
@@ -839,12 +916,13 @@ def fresh_tune_persistent_decode(x, sp, pool_k, pool_v, block_table,
     layers, _, hk, ps, d = pool_k.shape
     b, k_dim = x.shape
     f_dim = sp.down.shape[1]
+    h = sp.wo.shape[1] // d        # (L, H*D, K) — global head count
     return resolve_config(
         "persistent_decode",
         persistent_config_key(layers, b, k_dim, f_dim, hk, ps,
                               block_table.shape[1], d, n, x.dtype),
-        persistent_decode_candidates(b, f_dim // max(n, 1),
-                                     k_dim // max(n, 1)),
+        persistent_candidates_pruned(layers, b, k_dim, f_dim, h, hk, ps,
+                                     d, n, x.dtype),
         PersistentDecodeConfig(),
         lambda c: (lambda: persistent_decode_step(
             x, sp, pool_k, pool_v, block_table, seq_lens, mesh, axis,

@@ -1347,6 +1347,97 @@ def bench_trace_overhead_disagg():
     return _trace_overhead_record("trace_overhead_pct_disagg", run_once)
 
 
+def _profile_overhead_record(metric: str, run_once, *,
+                             rounds: int = 3) -> dict:
+    """Profiled-vs-unprofiled wall time of the SAME seeded replay
+    (ISSUE 16 satellite): the continuous profiler's always-on cost —
+    flight ring recording plus the per-step incremental drain /
+    window rotation (``TDT_PROFILE=1``, persistence off so disk IO is
+    not in the number).  Both arms run with obs on, interleaved,
+    min-of-rounds against CI jitter — the ``_trace_overhead_record``
+    discipline.  Marked ``interpret`` (SimBackend replay on this box)
+    so the 2% warn ceiling binds on real captures; the trend sentinel
+    ("overhead" -> lower-is-better) guards growth everywhere."""
+    import time as _time
+
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.obs import continuous, flight
+
+    prev_obs = obs.enabled()
+    obs.enable(True)
+    prev_flight = flight.enabled()
+    prev_prof = continuous.enabled()
+    flight.enable(False)
+    continuous.enable(False)
+    walls = {False: [], True: []}
+    try:
+        run_once()                      # compile warmup, untimed
+        for _ in range(rounds):
+            for profiled in (False, True):
+                flight.enable(profiled)
+                continuous.enable(profiled)
+                if profiled:
+                    flight.clear()
+                    continuous.install(continuous.ContinuousProfiler(
+                        window_steps=32, out_dir=""))
+                t0 = _time.perf_counter()
+                run_once()
+                walls[profiled].append(_time.perf_counter() - t0)
+        snap = continuous.profiler().snapshot()
+        windows = snap["windows_total"]
+    finally:
+        continuous.reset()
+        flight.clear()
+        continuous.enable(prev_prof)
+        flight.enable(prev_flight)
+        obs.enable(prev_obs)
+    t_off, t_on = min(walls[False]), min(walls[True])
+    return {
+        "metric": metric,
+        "value": round(100.0 * (t_on - t_off) / max(t_off, 1e-9), 2),
+        "unit": "% over unprofiled",
+        "unprofiled_s": round(t_off, 4),
+        "profiled_s": round(t_on, 4),
+        "windows_rotated": windows,
+        "interpret": True,   # SimBackend replay on this box
+        "devices": jax.device_count(),
+    }
+
+
+def bench_profile_overhead():
+    """TDT_PROFILE tax on the single-tier scheduler replay (`bench.py
+    serve`): the same seeded 48-request overcommit mix replayed
+    unprofiled vs with the continuous profiler armed."""
+    from triton_distributed_tpu import serve
+
+    vocab = 512
+
+    def run_once():
+        backend = serve.SimBackend(slots=8, page_size=16, pool_pages=65,
+                                   max_length=256, vocab=vocab)
+        sched = serve.Scheduler(backend, serve.SchedulerConfig(
+            max_queue_depth=128, prefill_chunk_tokens=32))
+        arrivals = serve.synthetic_trace(
+            7, 48, mean_interarrival_steps=0.25,
+            prompt_len=(8, 48), max_new=(8, 48), vocab=vocab)
+        serve.replay(sched, arrivals, max_steps=100_000)
+
+    return _profile_overhead_record("profile_overhead_pct", run_once)
+
+
+def bench_profile_overhead_disagg():
+    """TDT_PROFILE tax on the two-tier disaggregated replay (`bench.py
+    serve_disagg`): the router's three per-step hooks (prefill /
+    handoff / decode tiers) ride this arm, so its overhead is gated
+    separately."""
+    def run_once():
+        router, pending = _disagg_setup(32, seed=7, bulk_bytes_per_step=0)
+        _disagg_drive(router, pending)
+
+    return _profile_overhead_record("profile_overhead_pct_disagg",
+                                    run_once)
+
+
 _DISAGG_RUN = None
 
 
@@ -1911,6 +2002,7 @@ def main():
         print(json.dumps(bench_serve_throughput()))
         print(json.dumps(bench_serve_kv_quant()))
         print(json.dumps(bench_trace_overhead()))
+        print(json.dumps(bench_profile_overhead()))
     elif mode == "serve_disagg":
         # the disaggregated prefill/decode topology (ISSUE 12): TTFT
         # plus the KV-handoff plane's latency/throughput/retry surface,
@@ -1920,6 +2012,7 @@ def main():
         print(json.dumps(bench_handoff_throughput()))
         print(json.dumps(bench_handoff_retries()))
         print(json.dumps(bench_trace_overhead_disagg()))
+        print(json.dumps(bench_profile_overhead_disagg()))
     elif mode == "wire":
         # quantized collective payload byte accounting + dequant parity
         # (ISSUE 9)
@@ -1965,6 +2058,8 @@ def main():
         _emit(bench_handoff_retries)
         _emit(bench_trace_overhead)
         _emit(bench_trace_overhead_disagg)
+        _emit(bench_profile_overhead)
+        _emit(bench_profile_overhead_disagg)
         _emit(bench_wire_bytes)
         _emit(bench_wire_parity)
         _emit(bench_hier_ar_dcn_bytes)

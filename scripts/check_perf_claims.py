@@ -321,6 +321,18 @@ CLAIMS = {
     "trace_overhead_pct": {
         "warn_max": 3.0, "value_max": 100.0, "since": 14,
     },
+    # -- continuous profiler (ISSUE 16; `bench.py serve` / `serve_disagg`)
+    # TDT_PROFILE tax: profiled vs unprofiled wall of the SAME seeded
+    # replay (the prefix also covers profile_overhead_pct_disagg, the
+    # two-tier arm).  warn_max 2.0 is the issue's acceptance ceiling —
+    # an always-on profiler must stay under 2% or it is not always-on;
+    # value_max is the gross tripwire.  Interpret-marked on this box's
+    # SimBackend replays; the bounds bind on real-engine captures and
+    # the trend sentinel ("overhead" -> lower-is-better) guards growth
+    # everywhere
+    "profile_overhead_pct": {
+        "warn_max": 2.0, "value_max": 100.0, "since": 16,
+    },
 }
 
 def parse_record(path: str) -> tuple[list[dict], int | None, bool]:

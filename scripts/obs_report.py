@@ -15,6 +15,7 @@ Usage:
     python scripts/obs_report.py r0.json r1.json --json report.json
     python scripts/obs_report.py --timeline ag_gemm --ranks 4
     python scripts/obs_report.py --timeline flight_streams.json --chrome t.json
+    python scripts/obs_report.py --live http://127.0.0.1:9100
 
 Multiple inputs are merged with ``tools.trace_merge`` (rank i = argv
 order), so per-rank lanes stay disjoint; a single input may already be a
@@ -41,6 +42,14 @@ achieved-vs-SOL percentage, and every stall attributed to its
 save_streams`` JSON) it reconstructs the saved streams instead.
 ``--chrome`` additionally writes the timeline as Chrome-trace JSON with
 flow arrows linking each stall to the transfer it starved for.
+
+``--live`` is the continuous profiler's operator view (ISSUE 16,
+docs/observability.md "Continuous profiling"): given a telemetry-plane
+URL it fetches ``/debug/profile`` and renders the per-(family x
+topology x tier) rollup table with the window/anomaly state; with no
+operand it snapshots the IN-PROCESS profiler (a REPL or harness that
+armed ``TDT_PROFILE=1`` locally).  Exit code 1 when the latest window
+carries anomalies, so a cron probe can page on it.
 """
 
 from __future__ import annotations
@@ -90,10 +99,16 @@ def main(argv: list[str] | None = None) -> int:
                          "JSON dump (obs.request_trace.export_traces / "
                          "a saved /debug/trace/<id> payload) instead of "
                          "the in-process ring")
+    ap.add_argument("--live", nargs="?", const="local", metavar="URL",
+                    help="continuous-profiler view: fetch /debug/profile "
+                         "from a telemetry-plane URL, or snapshot the "
+                         "in-process profiler when no URL is given")
     args = ap.parse_args(argv)
 
     from triton_distributed_tpu.obs import report
 
+    if args.live:
+        return _run_live(args)
     if args.request:
         return _run_request(args)
     if args.timeline:
@@ -124,6 +139,36 @@ def main(argv: list[str] | None = None) -> int:
             json.dump({"rows": rows, "aggregate": report.aggregate(rows)},
                       f, indent=1, sort_keys=True)
     return 0
+
+
+def _run_live(args) -> int:
+    """The ``--live`` leg: one continuous-profiler snapshot (remote
+    ``/debug/profile`` or the in-process profiler), rendered as the
+    rollup table.  Exit 1 when the latest window carries anomalies."""
+    from triton_distributed_tpu.obs import continuous
+
+    if args.live == "local":
+        prof = continuous.profiler() if continuous.enabled() else None
+        snap = prof.snapshot() if prof is not None \
+            else {"enabled": continuous.enabled()}
+        where = "in-process profiler"
+    else:
+        import urllib.request
+
+        url = args.live.rstrip("/") + "/debug/profile"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            snap = json.load(r)
+        where = url
+    sys.stdout.write(continuous.format_snapshot(snap))
+    if not snap.get("enabled"):
+        print(f"profiler not armed at {where} "
+              f"(set TDT_PROFILE=1; docs/observability.md)")
+        return 0
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(snap, f, indent=1, sort_keys=True, default=str)
+    last = snap.get("last_window") or {}
+    return 1 if last.get("anomalies") else 0
 
 
 def _run_request(args) -> int:

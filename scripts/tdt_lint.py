@@ -24,6 +24,7 @@ Usage:
     python scripts/tdt_lint.py --integrity       # data-integrity gate
     python scripts/tdt_lint.py --hier            # hierarchical (ICIxDCN) gate
     python scripts/tdt_lint.py --trace           # request-tracing gate
+    python scripts/tdt_lint.py --profile         # continuous-profiler gate
     python scripts/tdt_lint.py --all             # every gate, one exit code
     python scripts/tdt_lint.py --json report.json
 
@@ -112,6 +113,19 @@ request-latency p99 exemplar ids resolve to retained ring traces, and
 the drop-faulted request's trace names every retry rung plus the
 re-prefill fallback.  Headless and CPU-only.
 
+``--profile`` is the continuous-profiler gate (ISSUE 16,
+docs/observability.md "Continuous profiling"): an ARMED
+(``TDT_PROFILE``) seeded two-tier replay must rotate windows through
+the real scheduler/router step hooks; every registry family with an
+``obs.costs`` calculator (the set cross-checked against the
+completeness wiring table) must land a live per-family rollup whose
+exposed/compute/critical/SOL/skew attribution agrees with the offline
+``obs.timeline`` reconstructor on the SAME capture; and the anomaly
+selftest must pass in BOTH directions — the clean replay stays quiet,
+the seeded wire-inflation regression is caught with the (semaphore,
+chunk, peer) stall triple and the p99 exemplar named.  Headless and
+CPU-only.
+
 ``--dpor`` is the schedule-exhaustive gate (ISSUE 15,
 docs/static_analysis.md "Schedule exhaustiveness"): the canonical
 maximal execution is sound for deadlock but NOT for the credit->wait
@@ -139,8 +153,8 @@ VMEM-footprint check on every family's DEFAULT tile config
 ``--all`` runs every gate above — verify matrix, ``--dpor``,
 ``--completeness``, ``--faults``, ``--timeline``, ``--serve``,
 ``--history``, ``--integrity``, ``--quant``, ``--hier``,
-``--handoff``, ``--persistent``, ``--trace`` — and summarizes them
-under a single exit code (the CI entry; see README).
+``--handoff``, ``--persistent``, ``--trace``, ``--profile`` — and
+summarizes them under a single exit code (the CI entry; see README).
 
 ``--history`` runs the bench-record trend sentinel
 (``scripts/bench_history.py --check``): exit 1 when a committed
@@ -242,11 +256,19 @@ def main(argv: list[str] | None = None) -> int:
                          "leaked pages on both tiers, faulted requests "
                          "complete via re-prefill), plus the handoff "
                          "fault cells")
+    ap.add_argument("--profile", action="store_true",
+                    help="continuous-profiler gate (ISSUE 16): armed "
+                         "two-tier replay rotates windows through the "
+                         "step hooks, every cost-calculated family "
+                         "lands a live rollup agreeing with the "
+                         "offline timeline on the same capture, and "
+                         "the anomaly selftest passes both directions")
     ap.add_argument("--all", action="store_true", dest="all_gates",
                     help="run every gate (verify matrix, --faults, "
                          "--timeline, --serve, --history, --integrity, "
                          "--quant, --hier, --handoff, --persistent, "
-                         "--trace) with one summarized exit code")
+                         "--trace, --profile) with one summarized exit "
+                         "code")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection target sampling seed (--faults)")
     ap.add_argument("--json", metavar="PATH",
@@ -279,6 +301,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_persistent(args)
     if args.trace_gate:
         return _run_trace(args)
+    if args.profile:
+        return _run_profile(args)
 
     from triton_distributed_tpu import analysis
 
@@ -647,6 +671,7 @@ def _run_all(args) -> int:
         ("handoff", lambda: _run_handoff(sub())),
         ("persistent", lambda: _run_persistent(sub())),
         ("trace", lambda: _run_trace(sub())),
+        ("profile", lambda: _run_profile(sub())),
     ]
     results = []
     for name, fn in legs:
@@ -992,6 +1017,151 @@ def _run_trace(args) -> int:
           "attributor phases summing exactly to e2e latency; p99 "
           "exemplars resolve to retained traces; the drop-faulted "
           "request names its retry and re-prefill rungs")
+    return 0
+
+
+def _run_profile(args) -> int:
+    """The continuous-profiler gate (ISSUE 16; see module docstring):
+    (1) an ARMED seeded two-tier replay must rotate windows through the
+    real scheduler/router step hooks; (2) every registry family with an
+    ``obs.costs`` calculator — the set cross-checked against the
+    completeness gate's wiring table — must land a live per-family
+    rollup whose attribution agrees with the offline timeline
+    reconstructor on the SAME capture; (3) the anomaly selftest must
+    pass in BOTH directions (clean replay quiet, seeded regression
+    caught with the stall triple and exemplar named)."""
+    from triton_distributed_tpu import obs, resilience, serve
+    from triton_distributed_tpu.analysis import completeness, registry
+    from triton_distributed_tpu.obs import anomaly, continuous, flight
+    from triton_distributed_tpu.obs import timeline as tl_mod
+    from triton_distributed_tpu.obs.costs import FAMILY_COSTS
+
+    problems: list[str] = []
+    prev_obs = obs.enabled()
+    prev_flight = flight.enabled()
+    prev_prof = continuous.enabled()
+    obs.enable(True)
+    flight.enable(True)
+    continuous.enable(True)
+    flight.clear()
+    obs.serve_stats.STATS.reset()
+    resilience.reset_breaker(serve.HANDOFF_OP)
+    # a fresh unpersisted profiler so the gate never touches disk and
+    # never inherits another harness's accumulators
+    prev_installed = continuous.install(continuous.ContinuousProfiler(
+        window_steps=16, out_dir=""))
+    try:
+        # leg 1: the armed two-tier replay (the --handoff harness, one
+        # home) — the scheduler/router step hooks must rotate windows
+        router, _plane, reqs = _two_tier_replay(args.seed, [])
+        prof = continuous.profiler()
+        snap = prof.snapshot()
+        nonterminal = [r for r in reqs if not r.done]
+        if nonterminal:
+            problems.append(f"replay: {len(nonterminal)} request(s) "
+                            f"never terminal under TDT_PROFILE")
+        if snap["windows_total"] < 1:
+            problems.append(
+                f"replay: the step hooks rotated no window over "
+                f"{router.prefill.steps} router steps "
+                f"(window_steps=16) — the profiler is not wired into "
+                f"the serve loop")
+        last = prof.last_window()
+        if last is not None and last.get("window_steps") != 16:
+            problems.append(
+                f"replay: window reports window_steps="
+                f"{last.get('window_steps')}, profiler configured 16")
+        print(f"profile replay: {len(reqs)} requests, "
+              f"{router.prefill.steps} prefill steps -> "
+              f"{snap['windows_total']} windows rotated")
+
+        # leg 2: per-family rollup coverage + live-vs-offline agreement
+        # on the SAME capture, for every family the completeness gate
+        # says carries a cost calculator (no silent subset: the family
+        # list is the registry's, the calculator set is cross-checked)
+        wiring = completeness.check()
+        if wiring:
+            problems += [f"completeness cross-check: {p}"
+                         for p in wiring]
+        # the wiring table (GOLDEN) names each family's cost-calculator
+        # keys (hierarchical's are the hier_* variants); a family whose
+        # named keys are absent from FAMILY_COSTS is a wiring break the
+        # completeness leg above already flags
+        families = [f for f in registry.FAMILIES
+                    if any(k in FAMILY_COSTS for k in
+                           completeness.GOLDEN.get(f, {}).get("costs",
+                                                              ()))]
+        skipped = sorted(set(registry.FAMILIES) - set(families))
+        if skipped:
+            print(f"(families without a cost calculator, skipped: "
+                  f"{skipped})")
+        for family in families:
+            streams = None
+            for n in (2, 4, 8):
+                try:
+                    _, streams = flight.record_family(family, n)
+                    break
+                except (IndexError, ValueError):
+                    continue
+            if streams is None:
+                problems.append(f"{family}: no registry case records "
+                                f"at ranks 2/4/8")
+                continue
+            fresh = continuous.ContinuousProfiler(window_steps=1,
+                                                  out_dir="")
+            flight.clear()
+            flight.feed_streams(family, streams)
+            fresh.on_step("decode", 1)
+            rollups = {k: r for k, r in fresh.lifetime_rollups().items()
+                       if k[0] == family}
+            if not rollups:
+                problems.append(
+                    f"{family}: the live drain produced no rollup for "
+                    f"the fed capture (keys: "
+                    f"{sorted(fresh.lifetime_rollups())})")
+                continue
+            live = next(iter(rollups.values()))
+            off = tl_mod.reconstruct(streams, kernel=family)
+            off_exposed = sum(r.exposed_us for r in off.rows)
+            off_compute = sum(r.compute_us for r in off.rows)
+            pairs = (("exposed_us", live.exposed_us, off_exposed),
+                     ("compute_us", live.compute_us, off_compute),
+                     ("critical_us", live.critical_us, off.critical_us),
+                     ("sol_us", live.sol_us, off.sol_us),
+                     ("skew_us", live.skew_us, off.skew_us))
+            for name, lv, ov in pairs:
+                if abs(lv - ov) > 1e-6 + 1e-9 * abs(ov):
+                    problems.append(
+                        f"{family}: live rollup {name}={lv!r} disagrees "
+                        f"with the offline timeline {ov!r} on the same "
+                        f"capture")
+        print(f"profile coverage: {len(families)} famil"
+              f"{'y' if len(families) == 1 else 'ies'} fed and "
+              f"reconciled against the offline reconstructor")
+
+        # leg 3: the anomaly selftest, both directions
+        problems += anomaly.selftest(args.seed)
+    finally:
+        resilience.reset_breaker(serve.HANDOFF_OP)
+        continuous.install(prev_installed)
+        anomaly.clear()
+        flight.clear()
+        continuous.enable(prev_prof)
+        flight.enable(prev_flight)
+        obs.enable(prev_obs)
+
+    for p in problems:
+        print(f"PROFILE FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"problems": problems}, f, indent=1,
+                      sort_keys=True, default=str)
+    if problems:
+        return 1
+    print("profile OK: armed replay rotated windows through the step "
+          "hooks; every cost-calculated registry family lands a live "
+          "rollup agreeing with the offline timeline on the same "
+          "capture; anomaly selftest passes both directions")
     return 0
 
 

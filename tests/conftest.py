@@ -120,6 +120,7 @@ FAST_NODES = frozenset((
     "tests/test_perf_claims.py::test_repo_records_consistent",
     "tests/test_autotuner.py::test_picks_fastest_candidate",
     "tests/test_obs.py::test_tdt_lint_timeline_smoke",
+    "tests/test_obs.py::test_tdt_lint_profile_smoke",
     "tests/test_obs.py::test_bench_history_check_repo_green",
     "tests/test_obs.py::test_telemetry_endpoints_during_live_decode",
     "tests/test_serve.py::test_tdt_lint_serve_smoke",

@@ -1371,3 +1371,462 @@ def test_check_perf_claims_trend_hook():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "trend:" in proc.stdout
     assert "satisfy their primary claims" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# continuous overlap profiler (ISSUE 16): incremental drain, windowed
+# rollups, on-disk time-series, anomaly detection, HTTP surface
+
+
+@pytest.fixture()
+def profile_on(obs_on):
+    """Armed flight ring + continuous profiler with a fresh unpersisted
+    profiler installed, everything restored after."""
+    from triton_distributed_tpu.obs import anomaly, continuous, flight
+
+    prev_flight = flight.enabled()
+    prev_prof = continuous.enabled()
+    flight.enable(True)
+    continuous.enable(True)
+    flight.clear()
+    prev_installed = continuous.install(
+        continuous.ContinuousProfiler(window_steps=2, out_dir=""))
+    yield continuous
+    continuous.install(prev_installed)
+    anomaly.clear()
+    flight.clear()
+    continuous.enable(prev_prof)
+    flight.enable(prev_flight)
+
+
+def test_profile_disarmed_hook_is_noop():
+    """TDT_PROFILE unset: the step hook must neither instantiate a
+    profiler nor touch the ring — byte-identical serve behavior is the
+    acceptance criterion, and no-profiler-object is its observable."""
+    from triton_distributed_tpu import serve
+    from triton_distributed_tpu.obs import continuous
+
+    assert not continuous.enabled()
+    continuous.reset()
+    continuous.on_step("decode", 1)
+    assert continuous.profiler() is None
+    assert continuous.to_prometheus() == ""
+    # a real scheduler replay with the hook wired in leaves it None too
+    backend = serve.SimBackend(slots=2, page_size=4, pool_pages=16,
+                               max_length=32)
+    sched = serve.Scheduler(backend)
+    arrivals = serve.synthetic_trace(1, 4, mean_interarrival_steps=0.5,
+                                     prompt_len=(2, 6), max_new=(2, 4))
+    report = serve.replay(sched, arrivals, max_steps=2000)
+    assert report.problems() == []
+    assert continuous.profiler() is None
+
+
+def test_profile_rollup_agrees_with_offline_timeline(profile_on):
+    """The acceptance pin: live per-family rollups from the incremental
+    drain must agree with the OFFLINE timeline reconstructor on the
+    same capture — same code path, exact float equality on the raw
+    Rollup, not the rounded to_dict."""
+    from triton_distributed_tpu.obs import continuous, flight, timeline
+
+    _, streams = flight.record_family("allgather", 2)
+    prof = continuous.ContinuousProfiler(window_steps=1, out_dir="")
+    flight.clear()
+    flight.feed_streams("allgather", streams)
+    prof.on_step("decode", 1)
+    rollups = prof.lifetime_rollups()
+    assert ("allgather", "n2", "decode") in rollups, sorted(rollups)
+    live = rollups[("allgather", "n2", "decode")]
+    off = timeline.reconstruct(streams, kernel="allgather")
+    assert live.exposed_us == sum(r.exposed_us for r in off.rows)
+    assert live.compute_us == sum(r.compute_us for r in off.rows)
+    assert live.critical_us == off.critical_us
+    assert live.sol_us == off.sol_us
+    assert live.skew_us == off.skew_us
+    assert live.pct_sol == off.pct_sol
+    # the stall aggregation keeps the (sem, chunk, peer) attribution
+    sem, chunk, peer, exposed = live.dominant_stall()
+    assert sem and exposed > 0
+    assert any(w.sem == sem and w.chunk == chunk and w.source == peer
+               for w in off.waits)
+
+
+def test_profile_incremental_drain_and_rotation(profile_on):
+    """The drain is incremental (an identity cursor — each event
+    ingested exactly once) and windows rotate at window_steps with the
+    gauges/sketches fed."""
+    from triton_distributed_tpu.obs import continuous, flight
+
+    _, streams = flight.record_family("allgather", 2)
+    prof = continuous.profiler()
+    flight.clear()
+    flight.feed_streams("allgather", streams)
+    prof.on_step("decode", 1)           # drains the episode, no window yet
+    assert prof.last_window() is None
+    flight.feed_streams("allgather", streams)
+    prof.on_step("decode", 2)           # second boundary -> rotate (ws=2)
+    win = prof.last_window()
+    assert win is not None and win["window"] == 0
+    assert win["steps"] == 2 and win["window_steps"] == 2
+    [r] = win["rollups"]
+    assert (r["family"], r["topology"], r["tier"]) == \
+        ("allgather", "n2", "decode")
+    assert r["episodes"] == 2           # both feeds, each counted ONCE
+    assert win["totals"]["episodes"] == 2
+    # the serve_stats plane carries the window
+    snap = obs.serve_stats.STATS.snapshot()
+    assert snap["gauges"]["profile_windows"] == 1.0
+    assert "tdt_profile_windows_total 1" in continuous.to_prometheus()
+    # an idle window (no new events) still rotates, with empty rollups
+    prof.on_step("decode", 3)
+    prof.on_step("decode", 4)
+    win2 = prof.last_window()
+    assert win2["window"] == 1 and win2["rollups"] == []
+
+
+def test_profile_scheduler_replay_rotates_windows(profile_on, tmp_path):
+    """The serve hook end-to-end: an armed seeded replay through the
+    REAL scheduler rotates windows and persists the time-series, and
+    ``obs.history`` parses the segments back."""
+    from triton_distributed_tpu import serve
+    from triton_distributed_tpu.obs import continuous, history
+
+    continuous.install(continuous.ContinuousProfiler(
+        window_steps=4, out_dir=str(tmp_path)))
+    backend = serve.SimBackend(slots=3, page_size=4, pool_pages=32,
+                               max_length=48)
+    sched = serve.Scheduler(backend)
+    arrivals = serve.synthetic_trace(3, 14, mean_interarrival_steps=0.5,
+                                     prompt_len=(2, 9), max_new=(2, 8))
+    report = serve.replay(sched, arrivals, max_steps=2000)
+    assert report.problems() == []
+    prof = continuous.profiler()
+    snap = prof.snapshot()
+    assert snap["windows_total"] >= 2
+    wins = history.load_profile_windows(str(tmp_path))
+    assert len(wins) == snap["windows_total"]
+    assert [w["window"] for w in wins] == \
+        sorted(w["window"] for w in wins)
+    series = history.profile_series(wins, "exposed_ms")
+    assert len(series) == len(wins)
+    assert all(isinstance(v, float) for v in series)
+
+
+def test_profile_segments_bounded(profile_on, tmp_path, monkeypatch):
+    """The on-disk time-series is bounded BY CONSTRUCTION: segments
+    rotate at the size cap and only the newest MAX_SEGMENTS survive."""
+    from triton_distributed_tpu.obs import continuous, flight
+
+    monkeypatch.setattr(continuous, "SEGMENT_MAX_BYTES", 512)
+    _, streams = flight.record_family("allgather", 2)
+    prof = continuous.ContinuousProfiler(window_steps=1,
+                                         out_dir=str(tmp_path))
+    for step in range(1, 41):
+        flight.feed_streams("allgather", streams)
+        prof.on_step("decode", step)
+    segs = sorted(tmp_path.glob("profile_*.jsonl"))
+    assert 1 <= len(segs) <= continuous.MAX_SEGMENTS
+    assert all(s.stat().st_size <= 512 + 4096 for s in segs)
+    # the newest window is in the newest segment (pruning drops OLD)
+    from triton_distributed_tpu.obs import history
+
+    wins = history.load_profile_windows(str(tmp_path))
+    assert wins and wins[-1]["window"] == 39
+
+
+def test_band_shared_implementation_pins_analyze(tmp_path):
+    """Satellite 1: ONE band implementation.  ``healthy_band`` /
+    ``Band.breach`` must agree exactly with ``analyze``'s below-band
+    warning predicate, both directions, on the same synthetic rounds."""
+    from triton_distributed_tpu.obs import history
+
+    def run(values):
+        for rnd, v in enumerate(values, start=1):
+            _hist_round(tmp_path, rnd, [_toy(v)])
+        trs = history.analyze(history.load_rounds(str(tmp_path)))
+        warned = any("outside" in w or "band" in w
+                     for w in history.all_warnings(trs))
+        band = history.healthy_band([float(v) for v in values[:-1]],
+                                    "higher")
+        return warned, band.breach(float(values[-1])) is not None
+
+    warned, breached = run((100.0, 102.0, 98.0, 80.0))
+    assert warned and breached
+    for p in tmp_path.glob("BENCH_*"):
+        p.unlink()
+    warned, breached = run((100.0, 102.0, 98.0, 99.0))
+    assert not warned and not breached
+    # bands_for: the same Band from committed draws by metric name
+    for p in tmp_path.glob("BENCH_*"):
+        p.unlink()
+    for rnd, v in enumerate((100.0, 102.0, 98.0), start=1):
+        _hist_round(tmp_path, rnd, [_toy(v)])
+    band = history.bands_for("toy_tflops", root=str(tmp_path))
+    assert band == history.healthy_band([100.0, 102.0, 98.0], "higher")
+
+
+def test_anomaly_selftest_both_directions():
+    """Tier-1 wiring for the acceptance criterion: the clean replay is
+    quiet, the seeded regression is caught with the stall triple and
+    exemplar named."""
+    from triton_distributed_tpu.obs import anomaly
+
+    prev = obs.enabled()
+    obs.enable(True)
+    try:
+        assert anomaly.selftest() == []
+    finally:
+        anomaly.clear()
+        obs.serve_stats.STATS.reset()
+        obs.enable(prev)
+
+
+def test_anomaly_event_surfaces_in_health(profile_on):
+    """A breaching window is a WARNING on the health surface — the
+    `profile` fragment appears, the status (and therefore the /healthz
+    code) stays ok, and the governor takes the advisory."""
+    from triton_distributed_tpu import resilience, serve
+    from triton_distributed_tpu.obs import anomaly, continuous, flight
+    from triton_distributed_tpu.obs import history
+
+    _, streams = flight.record_family("allgather", 2)
+    band = history.healthy_band([1e-6, 2e-6], "lower")  # everything breaches
+    anomaly.set_detector(anomaly.AnomalyDetector({"exposed_ms": band}))
+    try:
+        backend = serve.SimBackend(slots=2, page_size=4, pool_pages=16,
+                                   max_length=32)
+        sched = serve.Scheduler(backend)
+        prof = continuous.ContinuousProfiler(window_steps=1, out_dir="")
+        continuous.install(prof)
+        flight.clear()
+        flight.feed_streams("allgather", streams)
+        sched.step()                      # the hook drains + rotates
+        assert prof.snapshot()["anomalies_total"] == 1
+        [ev] = anomaly.current()
+        assert ev.metric == "exposed_ms" and ev.stall is not None
+        assert ev.excerpt                  # flight-ring excerpt attached
+        # health(): warning fragment, status stays ok
+        snap = sched.health()
+        assert snap["status"] == "ok"
+        assert snap["profile"]["status"] == "warn"
+        assert any("exposed_ms" in s for s in snap["profile"]["anomalies"])
+        assert resilience.health_snapshot()["profile"]["total"] == 1
+        # the governor counted the advisory
+        assert sched.governor.snapshot()["advisories"] == 1
+        # a later healthy window CLEARS the warning state
+        anomaly.set_detector(anomaly.AnomalyDetector({}))
+        sched.step()
+        assert anomaly.current() == []
+        assert "profile" not in sched.health()
+    finally:
+        anomaly.set_detector(None)
+
+
+def test_governor_advisory_needs_recurrence():
+    """One advisory does nothing; recurring advisories within the
+    window degrade admission exactly like preemption thrash."""
+    from triton_distributed_tpu.resilience.policy import AdmissionGovernor
+
+    g = AdmissionGovernor()
+    g.note_advisory()
+    g.note_step_ok()
+    assert g.level == 0
+    for _ in range(3):
+        g.note_advisory()
+        g.note_step_ok()
+    assert g.level == 1
+    assert g.snapshot()["advisories"] == 4
+
+
+def test_debug_endpoints_bounded_and_profile_surface(profile_on):
+    """Satellite 2: /debug/flight and /debug/timeline are ring-TAIL
+    bounded with ?n= clamping; armed /debug/timeline serves the
+    profiler's window instead of re-reconstructing; /debug/profile
+    answers in both disarmed and armed states."""
+    from triton_distributed_tpu.obs import continuous, flight
+    from triton_distributed_tpu.obs import server as obs_server
+
+    srv = obs_server.start(port=0)
+    try:
+        for _ in range(600):
+            flight.mark_collective("allgather", payload_bytes=64,
+                                   ranks=2, method="ring")
+        code, body = _get(srv.url + "/debug/flight")
+        assert code == 200
+        d = json.loads(body)
+        assert d["n"] == 256 and len(d["events"]) == 256
+        code, body = _get(srv.url + "/debug/flight?n=10")
+        assert json.loads(body)["n"] == 10
+        code, body = _get(srv.url + "/debug/flight?n=999999")
+        assert json.loads(body)["n"] == obs_server.FLIGHT_DUMP_MAX
+        code, body = _get(srv.url + "/debug/flight?n=garbage")
+        assert code == 200 and json.loads(body)["n"] == 256
+        # armed but windowless: timeline falls back to the ring tail
+        code, body = _get(srv.url + "/debug/timeline?n=50")
+        d = json.loads(body)
+        assert code == 200 and d["source"] == "ring" and d["n"] == 50
+        # /debug/profile before any step boundary: armed stub
+        code, body = _get(srv.url + "/debug/profile")
+        d = json.loads(body)
+        assert code == 200 and d["enabled"] and d["windows_total"] == 0
+        # rotate a window; timeline flips to the profiler snapshot
+        _, streams = flight.record_family("allgather", 2)
+        flight.clear()
+        flight.feed_streams("allgather", streams)
+        prof = continuous.profiler()
+        prof.on_step("decode", 1)
+        prof.on_step("decode", 2)
+        code, body = _get(srv.url + "/debug/timeline")
+        d = json.loads(body)
+        assert code == 200 and d["source"] == "profiler"
+        assert d["window"]["rollups"]
+        code, body = _get(srv.url + "/debug/profile")
+        d = json.loads(body)
+        assert d["windows_total"] == 1
+        assert d["last_window"]["totals"]["episodes"] == 1
+        code, body = _get(srv.url + "/metrics")
+        assert "tdt_profile_windows_total 1" in body
+        assert 'tdt_profile_overlap_hidden_pct{family="allgather"' in body
+        code, body = _get(srv.url + "/nope")
+        assert code == 404 and "/debug/profile" in body
+        # disarmed: stub, and timeline back to the ring path
+        continuous.enable(False)
+        code, body = _get(srv.url + "/debug/profile")
+        d = json.loads(body)
+        assert code == 200 and d["enabled"] is False
+        code, body = _get(srv.url + "/debug/timeline")
+        assert json.loads(body)["source"] == "ring"
+    finally:
+        obs_server.stop()
+
+
+def test_profile_scrape_during_window_rotation(profile_on):
+    """Satellite 3: /metrics and /debug/profile scraped from threads
+    WHILE windows rotate — every response parses (no torn snapshot),
+    no 500s, and the final window count matches the rotations driven
+    (no dropped window)."""
+    from triton_distributed_tpu.obs import continuous, flight
+    from triton_distributed_tpu.obs import server as obs_server
+
+    _, streams = flight.record_family("allgather", 2)
+    prof = continuous.ContinuousProfiler(window_steps=1, out_dir="")
+    continuous.install(prof)
+    srv = obs_server.start(port=0)
+    stop = threading.Event()
+    failures: list = []
+    seen_windows: list = []
+
+    def scraper():
+        while not stop.is_set():
+            code, body = _get(srv.url + "/metrics")
+            if code != 200:
+                failures.append(("metrics", code, body))
+            code, body = _get(srv.url + "/debug/profile")
+            if code != 200:
+                failures.append(("profile", code, body))
+                continue
+            snap = json.loads(body)     # raises on a torn payload
+            if snap.get("last_window"):
+                w = snap["last_window"]
+                # a published window is immutable and self-consistent
+                if len(w["rollups"]) != len(set(
+                        (r["family"], r["topology"], r["tier"])
+                        for r in w["rollups"])):
+                    failures.append(("dup rollup", w))
+                seen_windows.append(w["window"])
+
+    threads = [threading.Thread(target=scraper) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        rotations = 25
+        for step in range(1, rotations + 1):
+            flight.feed_streams("allgather", streams)
+            prof.on_step("decode", step)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        obs_server.stop()
+    assert not failures, failures[:3]
+    assert prof.snapshot()["windows_total"] == rotations
+    # scrapers observed monotone window ids (no rollback, no tear)
+    for ws in seen_windows:
+        assert 0 <= ws < rotations
+
+
+def test_telemetry_profile_during_live_decode(obs_on):
+    """Satellite 3, the PR-5 harness shape: with the profiler armed and
+    a rotated window, /metrics and /debug/profile answer from INSIDE a
+    live decode step without dropping the window."""
+    from triton_distributed_tpu.core import mesh as mesh_lib
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.obs import continuous, flight
+    from triton_distributed_tpu.obs import server as obs_server
+
+    prev_flight = flight.enabled()
+    prev_prof = continuous.enabled()
+    flight.enable(True)
+    continuous.enable(True)
+    flight.clear()
+    prof = continuous.ContinuousProfiler(window_steps=1, out_dir="")
+    prev_installed = continuous.install(prof)
+    cfg = ModelConfig(
+        num_layers=1, hidden=8, intermediate=16, num_heads=1,
+        num_kv_heads=1, head_dim=8, vocab=32, max_length=32,
+        dtype=jnp.float32,
+    )
+    model = _TinyServeModel(mesh_lib.tp_mesh(1), cfg)
+    eng = Engine(model, {"w": jnp.zeros((), jnp.float32)}, batch=1)
+    srv = obs_server.start(port=0, engine=eng)
+    try:
+        _, streams = flight.record_family("allgather", 2)
+        flight.clear()
+        flight.feed_streams("allgather", streams)
+        prof.on_step("decode", 1)       # one completed window pre-serve
+        seen: dict = {}
+        orig = eng.decode_step
+
+        def hooked(tok):
+            if obs.enabled() and not seen:
+                seen["metrics"] = _get(srv.url + "/metrics")
+                seen["profile"] = _get(srv.url + "/debug/profile")
+                seen["timeline"] = _get(srv.url + "/debug/timeline")
+            return orig(tok)
+
+        eng.decode_step = hooked
+        ids = jnp.zeros((1, 4), jnp.int32)
+        eng.serve(ids, gen_len=6)
+        assert seen, "decode loop never ran with telemetry enabled"
+        code, body = seen["metrics"]
+        assert code == 200 and "tdt_profile_windows_total 1" in body
+        code, body = seen["profile"]
+        assert code == 200
+        snap = json.loads(body)
+        assert snap["enabled"] and snap["windows_total"] == 1
+        assert snap["last_window"]["rollups"]
+        code, body = seen["timeline"]
+        assert code == 200 and json.loads(body)["source"] == "profiler"
+        # the window survived the serve (not dropped by live traffic)
+        assert prof.snapshot()["windows_total"] == 1
+    finally:
+        eng.close()
+        continuous.install(prev_installed)
+        flight.clear()
+        continuous.enable(prev_prof)
+        flight.enable(prev_flight)
+
+
+def test_tdt_lint_profile_smoke():
+    """The CI gate wiring (ISSUE 16 satellite): armed two-tier replay
+    rotates windows, per-family rollups reconcile against the offline
+    timeline, anomaly selftest passes both directions."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tdt_lint.py"),
+         "--profile"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "profile OK" in proc.stdout
+    assert "windows rotated" in proc.stdout

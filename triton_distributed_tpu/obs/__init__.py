@@ -20,8 +20,17 @@ This package is that layer:
 - ``obs.request_trace``  the per-request distributed trace plane
   (``TDT_TRACE=1``): gapless cross-tier span chains, the SLO
   attributor, p99 exemplars, the retained-trace ring.
+- ``obs.continuous``  the continuous overlap profiler
+  (``TDT_PROFILE=1``): per-step incremental flight-ring drain into
+  windowed per-(family x topology x tier) SOL / exposed-wait rollups
+  with a bounded on-disk time-series.
+- ``obs.anomaly``   live-vs-baseline comparison of profiler windows
+  against the committed-bench healthy bands (``obs.history`` — one
+  band implementation); breaches surface in ``health()`` and advise
+  the AdmissionGovernor.
 - ``obs.server``    the ``TDT_OBS_HTTP`` endpoint: ``/metrics``,
-  ``/healthz``, ``/debug/flight``, ``/debug/timeline``.
+  ``/healthz``, ``/debug/flight``, ``/debug/timeline``,
+  ``/debug/profile``.
 - ``obs.history``   the perf-trajectory sentinel over the committed
   ``BENCH_r*`` rounds (``scripts/bench_history.py``).
 
@@ -38,8 +47,8 @@ import contextlib
 import threading
 
 from . import (
-    costs, export, flight, history, registry, report, request_trace,
-    serve_stats, timeline, tracing,
+    anomaly, continuous, costs, export, flight, history, registry, report,
+    request_trace, serve_stats, timeline, tracing,
 )
 
 
@@ -72,7 +81,8 @@ from .tracing import instant, span
 
 __all__ = [
     "DEFAULT_BYTES_BUCKETS", "DEFAULT_LATENCY_BUCKETS_MS", "REGISTRY",
-    "Registry", "comm_call", "costs", "counter", "dump_jsonl",
+    "Registry", "anomaly", "comm_call", "continuous", "costs", "counter",
+    "dump_jsonl",
     "dump_prometheus", "enable", "enabled", "flight", "gauge", "histogram",
     "history", "instant", "observe_timer", "parse_prometheus", "read_jsonl",
     "record_collective", "request_trace", "serve_stats", "server", "span",

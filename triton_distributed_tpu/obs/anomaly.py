@@ -1,0 +1,341 @@
+"""Live-vs-baseline anomaly detection for the continuous profiler.
+
+``obs.continuous`` rotates a window; this module compares the window's
+totals against healthy bands — the ONE band implementation shared with
+the trend sentinel (``obs.history.Band`` / ``healthy_band``; ISSUE 16
+satellite) — and on a breach emits a typed :class:`AnomalyEvent`
+carrying everything triage needs in one record:
+
+- the window's dominant (semaphore, chunk, peer) stall triple (the
+  ``obs.timeline`` attribution, already aggregated by the rollup);
+- the p99 exemplar trace id (``obs.serve_stats`` sketches, TDT_TRACE —
+  the "show me a p99 request" hop of docs/serving.md);
+- a flight-ring excerpt (the protocol's recent history, the same tail
+  a timeout dump attaches).
+
+Default bands come from the committed bench rounds
+(:func:`detector_from_rounds` -> ``history.bands_for``), so "anomalous"
+means the SAME thing as a trend warning: outside the committed healthy
+band by more than the slack.  Harnesses inject synthetic bands
+(:class:`AnomalyDetector` takes any metric->Band dict).
+
+Surfacing: the latest window's breaches are the WARNING state —
+``resilience.health_snapshot`` attaches :func:`health_fragment` so
+``health()``/``/healthz`` carry them (status stays "ok": a perf
+anomaly is a warning, not a 503 — the load balancer must not shed over
+drift), and the scheduler offers each anomalous window to its
+AdmissionGovernor as an advisory signal (``note_advisory``: pressure
+that only degrades admission if it RECURS within the governor's
+window).
+
+:func:`selftest` pins both directions: a seeded regression replay
+(inflated wire payloads on a recorded capture) must be caught with the
+stall triple and exemplar named; the clean replay must stay quiet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import deque
+
+from . import history
+
+MAX_RETAINED = 32
+
+# live window-total metrics the default detector watches, with their
+# directions (derived metrics carry no unit for direction_for to sniff)
+# and the committed bench-metric prefix each maps onto
+WATCH = {
+    "overlap_hidden_pct": ("higher", "overlap_hidden_pct"),
+    "exposed_ms": ("lower", "profile_exposed_ms"),
+    "pct_sol": ("higher", "profile_pct_sol"),
+}
+
+_LOCK = threading.Lock()
+_EVENTS: deque = deque(maxlen=MAX_RETAINED)
+_CURRENT: tuple = ()           # the LATEST window's breaches (warning state)
+_TOTAL = 0
+_DETECTOR: "AnomalyDetector | None" = None
+
+
+@dataclasses.dataclass(frozen=True)
+class AnomalyEvent:
+    """One live-window band breach, typed and self-contained."""
+
+    metric: str
+    value: float
+    band: tuple[float, float]
+    direction: str
+    drift_pct: float           # fraction past the worse band edge
+    window: int
+    step_end: int
+    stall: tuple | None        # dominant (sem, chunk, peer, exposed_us)
+    exemplar: str | None       # p99 exemplar trace id, if traced
+    excerpt: tuple[str, ...]   # flight-ring tail at detection time
+
+    def summary(self) -> str:
+        s = (f"{self.metric}={self.value:g} outside healthy band "
+             f"[{self.band[0]:g}, {self.band[1]:g}] "
+             f"({100 * self.drift_pct:.1f}% worse, window "
+             f"#{self.window} @ step {self.step_end})")
+        if self.stall:
+            sem, chunk, peer = self.stall[:3]
+            s += (f"; dominant stall sem={sem} chunk={chunk} "
+                  f"peer={peer}")
+        if self.exemplar:
+            s += f"; p99 exemplar {self.exemplar}"
+        return s
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["summary"] = self.summary()
+        return d
+
+
+class AnomalyDetector:
+    """Compares window totals against a metric->Band map (bands are
+    ``obs.history.Band`` — the shared implementation).  ``record=False``
+    keeps a harness run out of the process warning state."""
+
+    def __init__(self, bands: dict[str, history.Band], *,
+                 record: bool = True):
+        self.bands = dict(bands)
+        self.record = record
+
+    def check_window(self, window: dict) -> list[AnomalyEvent]:
+        from . import flight, serve_stats
+
+        totals = window.get("totals") or {}
+        out: list[AnomalyEvent] = []
+        for metric, band in self.bands.items():
+            value = totals.get(metric)
+            if not isinstance(value, (int, float)) \
+                    or isinstance(value, bool):
+                continue
+            drift = band.breach(float(value))
+            if drift is None:
+                continue
+            exemplar = None
+            for sk in (serve_stats.STATS.request_ms,
+                       serve_stats.STATS.ttft_ms):
+                exemplar = sk.exemplar(0.99)
+                if exemplar:
+                    break
+            out.append(AnomalyEvent(
+                metric=metric, value=float(value),
+                band=(band.lo, band.hi), direction=band.direction,
+                drift_pct=drift, window=int(window.get("window", -1)),
+                step_end=int(window.get("step_end", -1)),
+                stall=totals.get("dominant_stall"),
+                exemplar=exemplar,
+                excerpt=flight.recent_lines(16),
+            ))
+        if self.record:
+            _publish(window, out)
+        return out
+
+
+def _publish(window: dict, events: list[AnomalyEvent]) -> None:
+    """Retain breaches and refresh the warning state: the LATEST
+    completed window defines whether health warns (an hour-old breach
+    must not page forever)."""
+    global _CURRENT, _TOTAL
+    with _LOCK:
+        _CURRENT = tuple(events)
+        for e in events:
+            _EVENTS.append(e)
+            _TOTAL += 1
+
+
+def check_window(window: dict) -> list[AnomalyEvent]:
+    """The profiler's rotation hook: run the process detector (built
+    lazily from the committed rounds) over a finished window."""
+    det = _detector()
+    if det is None:
+        _publish(window, [])
+        return []
+    return det.check_window(window)
+
+
+def _detector() -> AnomalyDetector | None:
+    global _DETECTOR
+    if _DETECTOR is None:
+        with _LOCK:
+            if _DETECTOR is None:
+                _DETECTOR = detector_from_rounds()
+    return _DETECTOR
+
+
+def set_detector(det: AnomalyDetector | None) -> None:
+    """Install the process detector (harnesses; None re-derives from
+    the committed rounds on next use)."""
+    global _DETECTOR
+    with _LOCK:
+        _DETECTOR = det
+
+
+def detector_from_rounds(root: str = ".") -> AnomalyDetector:
+    """Bands from the committed bench rounds: each watched live metric
+    maps onto the first committed trajectory matching its bench-metric
+    prefix (interpret-mode rounds carry no trajectory — the detector is
+    then empty and every window is healthy by definition)."""
+    try:
+        rounds = history.load_rounds(root)
+        trs = history.trajectories(rounds)
+    except OSError:
+        trs = {}
+    bands: dict[str, history.Band] = {}
+    for live, (direction, prefix) in WATCH.items():
+        names = sorted(n for n in trs if n.startswith(prefix))
+        for name in names:
+            tr = trs[name]
+            band = history.healthy_band(tr.values, direction)
+            if band is not None:
+                bands[live] = band
+                break
+    return AnomalyDetector(bands)
+
+
+# ---------------------------------------------------------------------------
+# read side (health surface, /debug/profile)
+
+
+def current() -> list[AnomalyEvent]:
+    """The latest completed window's breaches (the warning state)."""
+    return list(_CURRENT)
+
+
+def recent(n: int = 8) -> list[AnomalyEvent]:
+    """The newest retained breaches across windows."""
+    with _LOCK:
+        return list(_EVENTS)[-int(n):]
+
+
+def total() -> int:
+    return _TOTAL
+
+
+def clear() -> None:
+    global _CURRENT, _TOTAL
+    with _LOCK:
+        _EVENTS.clear()
+        _CURRENT = ()
+        _TOTAL = 0
+
+
+def health_fragment() -> dict | None:
+    """What ``resilience.health_snapshot`` attaches under ``profile``
+    when the latest window breached: a warning state — NOT a status
+    flip (``/healthz`` stays 200; docs/observability.md).  None when
+    healthy, so an unarmed process's snapshot is byte-identical."""
+    cur = current()
+    if not cur:
+        return None
+    return {
+        "status": "warn",
+        "anomalies": [e.summary() for e in cur],
+        "total": _TOTAL,
+    }
+
+
+# ---------------------------------------------------------------------------
+# selftest (tdt_lint --profile + tier-1)
+
+
+def _inflate_wire(streams, factor: int):
+    """The seeded regression: every remote_copy's payload inflated, so
+    wire time (and the waits it starves) grows — the canonical
+    'overlap got worse' shape, deterministic under the model clock."""
+    import copy
+
+    out = []
+    for s in streams:
+        evs = []
+        for ev in s:
+            e2 = copy.copy(ev)
+            if ev.kind == "remote_copy":
+                e2.elems = ev.elems * factor
+            evs.append(e2)
+        out.append(evs)
+    return out
+
+
+def selftest(seed: int = 0) -> list[str]:
+    """Both-direction anomaly check over a REAL recorded capture run
+    through the REAL profiler path: the clean replay must stay quiet;
+    the regression replay (wire payloads inflated 65536x) must breach with
+    the (sem, chunk, peer) stall triple and the p99 exemplar named.
+    Perturbs the flight ring and serve stats; callers reset.  Returns
+    problems (empty = pass)."""
+    from . import continuous, flight, serve_stats
+
+    problems: list[str] = []
+    prev_flight = flight.enabled()
+    prev_prof = continuous.enabled()
+    flight.enable(True)
+    continuous.enable(True)
+    try:
+        # a named p99 exemplar for the event to carry — on a FRESH
+        # sketch, so prior (exemplar-less) traffic cannot occupy the
+        # p99 bucket (the docstring's "perturbs serve stats")
+        serve_stats.STATS.reset()
+        serve_stats.STATS.request_ms.observe(
+            123.0, exemplar=f"req-anomaly-selftest-{seed}")
+        _, streams = flight.record_family("allgather", 2)
+
+        def window_of(streams_):
+            prof = continuous.ContinuousProfiler(window_steps=1,
+                                                 out_dir="")
+            flight.clear()
+            flight.feed_streams("allgather", streams_)
+            prof.on_step("selftest", 1)
+            return prof.last_window()
+
+        healthy = window_of(streams)
+        if healthy is None or not healthy["totals"]["episodes"]:
+            return ["selftest: the recorded capture produced no "
+                    "profiler window"]
+        tot = healthy["totals"]
+        bands = {}
+        for metric, direction in (("exposed_ms", "lower"),
+                                  ("overlap_hidden_pct", "higher")):
+            v = tot[metric]
+            band = history.healthy_band([v, v], direction)
+            if band is not None:
+                bands[metric] = band
+        det = AnomalyDetector(bands, record=False)
+
+        # direction 1: the clean replay (identical capture, identical
+        # model clock) must stay quiet
+        clean = det.check_window(window_of(streams))
+        if clean:
+            problems.append(
+                f"selftest: clean replay flagged "
+                f"{[e.metric for e in clean]} — identical capture must "
+                f"reconstruct identically")
+
+        # direction 2: the seeded regression must be caught
+        bad = det.check_window(window_of(_inflate_wire(streams, 1 << 16)))
+        if not bad:
+            problems.append(
+                "selftest: the 65536x wire inflation was not flagged — "
+                "the live comparator is blind")
+        for e in bad:
+            if not e.stall or e.stall[0] is None:
+                problems.append(
+                    f"selftest: breach {e.metric} carries no dominant "
+                    f"(sem, chunk, peer) stall triple")
+            if not e.exemplar:
+                problems.append(
+                    f"selftest: breach {e.metric} names no p99 "
+                    f"exemplar")
+            if not e.excerpt:
+                problems.append(
+                    f"selftest: breach {e.metric} carries no "
+                    f"flight-ring excerpt")
+    finally:
+        flight.clear()
+        flight.enable(prev_flight)
+        continuous.enable(prev_prof)
+    return problems

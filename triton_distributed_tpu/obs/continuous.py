@@ -1,0 +1,657 @@
+"""Continuous overlap profiler: always-on streaming SOL / exposed-wait
+attribution (``TDT_PROFILE=1``).
+
+The stack can attribute overlap *offline* (``obs.timeline`` over flight
+captures) and trend *committed bench rounds* (``obs.history``); this
+module is the always-on bridge: at every scheduler step boundary
+(``serve.Scheduler._step_impl`` calls :func:`on_step`) the profiler
+drains the flight ring **incrementally** — an identity cursor on the
+last-consumed event; never a re-reconstruction of the whole retained
+ring — and folds the new events into windowed per-(collective family x
+topology x tier) rollups:
+
+- ``overlap_hidden_pct`` — how much of the wire time compute hid
+  (``100 * (1 - exposed/wire)``, clamped to [0, 100]);
+- ``exposed_ms`` — the attributed stall total;
+- ``pct_sol`` — reconstructed critical path vs the ``obs.costs`` /
+  ``tools.perf_model`` roofline (``Timeline.pct_sol``);
+- straggler ``skew_us`` and the dominant (semaphore, chunk, peer)
+  stall triple.
+
+Attribution runs the SAME credit replay as the offline reconstructor
+(``obs.timeline.reconstruct``) over each drained episode — a marker-
+delimited run of events: a ``collective`` marker (``flight.
+mark_collective`` / ``flight.feed_streams``) opens an episode and names
+its family; rank >= 0 events group into per-rank streams; live rank −1
+primitives form one stream; a marker with no primitives still counts
+(episode + wire bytes).  Because the arithmetic is shared, the live
+rollups AGREE with ``obs_report.py --timeline`` on the same capture —
+pinned by test.
+
+Every ``TDT_PROFILE_WINDOW`` (default 32) step-boundary drains the
+open window rotates: an immutable summary dict is published (readers
+never see a torn window), per-window totals feed rotating
+``obs.serve_stats`` quantile sketches and gauges, one JSONL line is
+appended to the bounded on-disk time-series (``TDT_PROFILE_DIR``:
+``profile_NNNN.jsonl`` segments, size-rotated, oldest deleted —
+``obs.history.load_profile_windows`` parses them back), and the window
+is handed to ``obs.anomaly`` for the live-vs-baseline comparison
+(breaches surface in ``health()`` and nudge the AdmissionGovernor).
+
+Exported via ``/metrics`` (:func:`to_prometheus`), ``/debug/profile``
+(:meth:`ContinuousProfiler.snapshot`), and ``scripts/obs_report.py
+--live``.  The TDT_OBS discipline holds: unset, the scheduler hook is
+one cached-bool check and behavior is byte-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from . import serve_stats
+
+DEFAULT_WINDOW_STEPS = 32
+# on-disk time-series bounds: segments rotate at this size, oldest
+# segments beyond the cap are deleted — the series is downsampled (one
+# line per window) AND bounded (docs/observability.md)
+SEGMENT_MAX_BYTES = 256 * 1024
+MAX_SEGMENTS = 8
+
+# flight-event kinds the credit replay consumes (timeline.reconstruct
+# filters the rest); a marker-only episode has none of these
+_PRIM_KINDS = frozenset((
+    "wait", "notify", "remote_copy", "local_copy", "wait_recv",
+    "wait_send", "barrier", "compute",
+))
+
+
+def _env_enabled() -> bool:
+    from ..core.utils import env_flag
+
+    return env_flag("TDT_PROFILE")
+
+
+# Cached so a disabled scheduler step pays one global load + one bool
+# check (the TDT_OBS discipline); re-read the env via enable(None).
+_ENABLED = _env_enabled()
+
+_LOCK = threading.Lock()
+_PROFILER: "ContinuousProfiler | None" = None
+
+_pkg_cache: list = []
+
+
+def _suppressed() -> bool:
+    """Honor ``obs.suppress()``: warmup / measurement-only steps must
+    not pollute the live windows (same marker the flight ring honors)."""
+    if not _pkg_cache:
+        import sys
+
+        _pkg_cache.append(sys.modules[__package__])
+    return _pkg_cache[0]._suppressed()
+
+
+def enabled() -> bool:
+    """Whether the profiler records (``TDT_PROFILE=1`` or
+    :func:`enable`, and not inside an ``obs.suppress()`` block)."""
+    return _ENABLED and not _suppressed()
+
+
+def enable(on: bool | None = True) -> bool:
+    """Turn the profiler on/off; ``None`` re-reads ``TDT_PROFILE``."""
+    global _ENABLED
+    _ENABLED = _env_enabled() if on is None else bool(on)
+    return _ENABLED
+
+
+def window_steps() -> int:
+    """Window length in scheduler step boundaries
+    (``TDT_PROFILE_WINDOW``, default 32)."""
+    try:
+        return max(1, int(os.environ.get("TDT_PROFILE_WINDOW", "")
+                          or DEFAULT_WINDOW_STEPS))
+    except ValueError:
+        return DEFAULT_WINDOW_STEPS
+
+
+def profile_dir() -> str | None:
+    """Where the time-series segments land (``TDT_PROFILE_DIR``); None
+    disables persistence (in-memory windows only)."""
+    return os.environ.get("TDT_PROFILE_DIR", "").strip() or None
+
+
+def _clamp(v: float, lo: float, hi: float) -> float:
+    return max(lo, min(hi, v))
+
+
+class Rollup:
+    """Accumulated attribution for one (family, topology, tier) key —
+    within the open window, and cumulatively over the profiler's
+    lifetime (the coverage view ``tdt_lint --profile`` asserts on)."""
+
+    __slots__ = ("family", "topology", "tier", "episodes", "events",
+                 "compute_us", "wire_us", "exposed_us", "barrier_us",
+                 "critical_us", "sol_us", "skew_us", "wire_bytes",
+                 "stalls", "pending")
+
+    def __init__(self, family: str, topology: str, tier: str):
+        self.family = family
+        self.topology = topology
+        self.tier = tier
+        self.episodes = 0
+        self.events = 0
+        self.compute_us = 0.0
+        self.wire_us = 0.0
+        self.exposed_us = 0.0
+        self.barrier_us = 0.0
+        self.critical_us = 0.0
+        self.sol_us = 0.0
+        self.skew_us = 0.0
+        self.wire_bytes = 0
+        # (sem, chunk, peer) -> exposed_us: the attribution triples
+        self.stalls: dict[tuple, float] = {}
+        self.pending = 0
+
+    def add_timeline(self, tl, n_events: int) -> None:
+        """Fold one reconstructed episode in — the SAME sums the
+        offline table prints, so live == offline on a shared capture."""
+        self.episodes += 1
+        self.events += n_events
+        self.compute_us += sum(rw.compute_us for rw in tl.rows)
+        self.wire_us += sum(rw.wire_us for rw in tl.rows)
+        self.exposed_us += sum(rw.exposed_us for rw in tl.rows)
+        self.barrier_us += sum(rw.barrier_us for rw in tl.rows)
+        self.critical_us += tl.critical_us
+        self.sol_us += tl.sol_us
+        self.skew_us = max(self.skew_us, tl.skew_us)
+        self.pending += len(tl.pending)
+        for w in tl.waits:
+            key = (w.sem, w.chunk, w.source)
+            self.stalls[key] = self.stalls.get(key, 0.0) + w.exposed_us
+
+    def add_marker(self, nbytes: int) -> None:
+        """A host-dispatch marker with no primitive events: the episode
+        still counts (live comm traffic is legible even when no record-
+        mode stream rides along)."""
+        self.episodes += 1
+        self.events += 1
+        self.wire_bytes += int(nbytes)
+
+    @property
+    def overlap_hidden_pct(self) -> float:
+        """How much of the wire time the compute/protocol hid.  All
+        hidden (vacuously) when there is no wire time."""
+        if self.wire_us <= 0:
+            return 100.0
+        return _clamp(100.0 * (1.0 - self.exposed_us / self.wire_us),
+                      0.0, 100.0)
+
+    @property
+    def pct_sol(self) -> float:
+        """Roofline-vs-critical-path, the ``Timeline.pct_sol`` figure
+        summed over the window's episodes."""
+        if self.critical_us <= 0:
+            return 1.0
+        return min(1.0, self.sol_us / self.critical_us)
+
+    def dominant_stall(self) -> tuple | None:
+        """The (sem, chunk, peer) triple with the largest attributed
+        exposed-wait in this rollup, with its total."""
+        if not self.stalls:
+            return None
+        key = max(self.stalls, key=lambda k: self.stalls[k])
+        return (*key, round(self.stalls[key], 3))
+
+    def merge(self, other: "Rollup") -> None:
+        self.episodes += other.episodes
+        self.events += other.events
+        self.compute_us += other.compute_us
+        self.wire_us += other.wire_us
+        self.exposed_us += other.exposed_us
+        self.barrier_us += other.barrier_us
+        self.critical_us += other.critical_us
+        self.sol_us += other.sol_us
+        self.skew_us = max(self.skew_us, other.skew_us)
+        self.wire_bytes += other.wire_bytes
+        self.pending += other.pending
+        for k, v in other.stalls.items():
+            self.stalls[k] = self.stalls.get(k, 0.0) + v
+
+    def to_dict(self) -> dict:
+        return {
+            "family": self.family,
+            "topology": self.topology,
+            "tier": self.tier,
+            "episodes": self.episodes,
+            "events": self.events,
+            "compute_us": round(self.compute_us, 3),
+            "wire_us": round(self.wire_us, 3),
+            "exposed_us": round(self.exposed_us, 3),
+            "barrier_us": round(self.barrier_us, 3),
+            "critical_us": round(self.critical_us, 3),
+            "sol_us": round(self.sol_us, 3),
+            "skew_us": round(self.skew_us, 3),
+            "wire_bytes": self.wire_bytes,
+            "overlap_hidden_pct": round(self.overlap_hidden_pct, 3),
+            "pct_sol": round(self.pct_sol, 4),
+            "dominant_stall": self.dominant_stall(),
+            "pending": self.pending,
+        }
+
+
+def _split_episodes(events):
+    """Marker-delimited episode split of a drained batch: a
+    ``collective`` event opens an episode named by its ``op`` (the
+    family); ``step`` marks close without opening.  Yields
+    ``(family | None, [events])`` — family None means raw primitive
+    traffic with no marker (attributed as "unattributed")."""
+    episodes: list[tuple[str | None, list]] = []
+    fam: str | None = None
+    cur: list = []
+    for ev in events:
+        if ev.kind == "collective":
+            if cur:
+                episodes.append((fam, cur))
+            fam, cur = ev.op, [ev]
+        elif ev.kind == "step":
+            if cur:
+                episodes.append((fam, cur))
+            fam, cur = None, []
+        else:
+            cur.append(ev)
+    if cur:
+        episodes.append((fam, cur))
+    return episodes
+
+
+class ContinuousProfiler:
+    """The streaming profiler state machine (one per process under the
+    module singleton; harnesses may install their own via
+    :func:`install`).  All mutation happens under one lock; the last
+    rotated window is published as an immutable dict so concurrent
+    ``/metrics`` / ``/debug/profile`` scrapes never see a torn
+    snapshot."""
+
+    def __init__(self, *, window_steps: int | None = None,
+                 out_dir: str | None = None,
+                 device_kind: str | None = None):
+        self.window_steps = int(window_steps) if window_steps \
+            else globals()["window_steps"]()
+        self.out_dir = out_dir if out_dir is not None else profile_dir()
+        self.device_kind = device_kind
+        self._lock = threading.RLock()
+        self._last_ev = None            # identity cursor into the ring
+        self._accum: dict[tuple, Rollup] = {}
+        self._lifetime: dict[tuple, Rollup] = {}
+        self._window_id = 0
+        self._steps_in_window = 0
+        self._last_window: dict | None = None
+        self.windows_total = 0
+        self.anomalies_total = 0
+        # rotating per-window sketches (the serve_stats substrate):
+        # exposed-wait and hidden-overlap distributions across windows
+        self.exposed_ms_sketch = serve_stats.QuantileSketch()
+        self.overlap_sketch = serve_stats.QuantileSketch()
+        self._segment_idx = 0
+        self._segment_path: str | None = None
+
+    # -- drain -------------------------------------------------------------
+
+    def _drain(self) -> list:
+        """New flight-ring events since the last drain.  The cursor is
+        the identity of the last consumed event: pruning only removes
+        from the ring's LEFT (oldest), so when the cursor is gone every
+        retained event is newer — O(new events), never a rescan of the
+        whole ring."""
+        from . import flight
+
+        ring = flight._ring
+        last = self._last_ev
+        out: list = []
+        try:
+            for ev in reversed(ring):
+                if ev is last:
+                    break
+                out.append(ev)
+        except RuntimeError:
+            # the deque mutated under a lock-free append mid-iteration:
+            # fall back to a snapshot copy for this drain
+            evs = list(ring)
+            out = []
+            for ev in reversed(evs):
+                if ev is last:
+                    break
+                out.append(ev)
+        out.reverse()
+        if out:
+            self._last_ev = out[-1]
+        return out
+
+    # -- ingest ------------------------------------------------------------
+
+    def _rollup(self, sink: dict, family: str, topology: str,
+                tier: str) -> Rollup:
+        key = (family, topology, tier)
+        r = sink.get(key)
+        if r is None:
+            r = sink[key] = Rollup(family, topology, tier)
+        return r
+
+    def _ingest(self, events, tier: str) -> None:
+        from . import timeline
+
+        for fam, evs in _split_episodes(events):
+            marker = next((e for e in evs if e.kind == "collective"),
+                          None)
+            family = fam or "unattributed"
+            ranks = sorted({e.rank for e in evs if e.rank >= 0})
+            if ranks:
+                streams = [[e for e in evs if e.rank == r]
+                           for r in ranks]
+                topology = f"n{len(ranks)}"
+            else:
+                prims = [e for e in evs if e.kind in _PRIM_KINDS]
+                if not prims:
+                    if marker is None:
+                        continue
+                    topology = f"n{marker.elems}" if marker.elems \
+                        else "live"
+                    for sink in (self._accum, self._lifetime):
+                        self._rollup(sink, family, topology,
+                                     tier).add_marker(marker.bytes)
+                    continue
+                streams = [prims]
+                topology = f"n{marker.elems}" \
+                    if marker is not None and marker.elems else "live"
+            tl = timeline.reconstruct(streams, kernel=family,
+                                      device_kind=self.device_kind)
+            n_events = sum(len(s) for s in streams)
+            for sink in (self._accum, self._lifetime):
+                self._rollup(sink, family, topology,
+                             tier).add_timeline(tl, n_events)
+
+    # -- the scheduler hook ------------------------------------------------
+
+    def on_step(self, tier: str, step: int, governor=None) -> None:
+        """One step boundary: drain, ingest, maybe rotate."""
+        with self._lock:
+            new = self._drain()
+            if new:
+                self._ingest(new, tier)
+            self._steps_in_window += 1
+            if self._steps_in_window >= self.window_steps:
+                self._rotate(step, governor)
+
+    # -- rotation ----------------------------------------------------------
+
+    def _totals(self, rollups) -> dict:
+        tot = Rollup("_totals", "-", "-")
+        for r in rollups:
+            tot.merge(r)
+        return {
+            "episodes": tot.episodes,
+            "events": tot.events,
+            "exposed_ms": round(tot.exposed_us / 1e3, 6),
+            "wire_ms": round(tot.wire_us / 1e3, 6),
+            "compute_ms": round(tot.compute_us / 1e3, 6),
+            "overlap_hidden_pct": round(tot.overlap_hidden_pct, 3),
+            "pct_sol": round(tot.pct_sol, 4),
+            "skew_us": round(tot.skew_us, 3),
+            "wire_bytes": tot.wire_bytes,
+            "dominant_stall": tot.dominant_stall(),
+        }
+
+    def _rotate(self, step: int, governor=None) -> None:
+        rollups = list(self._accum.values())
+        window = {
+            "window": self._window_id,
+            "step_end": int(step),
+            "steps": self._steps_in_window,
+            "window_steps": self.window_steps,
+            "rollups": [r.to_dict() for r in rollups],
+            "totals": self._totals(rollups),
+        }
+        tot = window["totals"]
+        self.exposed_ms_sketch.observe(tot["exposed_ms"])
+        self.overlap_sketch.observe(tot["overlap_hidden_pct"])
+        # live gauges beside the serve block in /metrics (rendered
+        # `serve_profile_*` by ServeStats) — last-window values
+        stats = serve_stats.STATS
+        stats.set_gauge("profile_overlap_hidden_pct",
+                        tot["overlap_hidden_pct"])
+        stats.set_gauge("profile_exposed_ms", tot["exposed_ms"])
+        stats.set_gauge("profile_windows", float(self.windows_total + 1))
+        self._persist(window)
+        # live-vs-baseline comparison (obs.anomaly): breaches carry the
+        # dominant stall triple + p99 exemplar + ring excerpt, surface
+        # in health() and nudge the AdmissionGovernor (advisory)
+        try:
+            from . import anomaly
+
+            events = anomaly.check_window(window)
+        except Exception:
+            events = []
+        if events:
+            window["anomalies"] = [e.summary() for e in events]
+            self.anomalies_total += len(events)
+            if governor is not None:
+                try:
+                    governor.note_advisory()
+                except Exception:
+                    pass
+        # publish: the dict is complete before the reference swap, and
+        # never mutated after — a concurrent scrape sees old or new,
+        # never a torn mix
+        self._last_window = window
+        self.windows_total += 1
+        self._window_id += 1
+        self._steps_in_window = 0
+        self._accum = {}
+
+    # -- persistence -------------------------------------------------------
+
+    def _persist(self, window: dict) -> None:
+        if not self.out_dir:
+            return
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            if self._segment_path is None:
+                self._segment_path = os.path.join(
+                    self.out_dir, f"profile_{self._segment_idx:04d}.jsonl")
+            line = json.dumps(window, separators=(",", ":"),
+                              default=str)
+            with open(self._segment_path, "a") as f:
+                f.write(line + "\n")
+            if os.path.getsize(self._segment_path) >= SEGMENT_MAX_BYTES:
+                self._segment_idx += 1
+                self._segment_path = None
+                self._prune_segments()
+        except OSError:
+            # a full/unwritable disk must not take the serve loop down;
+            # the in-memory windows and /metrics keep working
+            pass
+
+    def _prune_segments(self) -> None:
+        import glob as _glob
+        import re as _re
+
+        rx = _re.compile(r"profile_(\d+)\.jsonl$")
+        segs = []
+        for p in _glob.glob(os.path.join(self.out_dir,
+                                         "profile_*.jsonl")):
+            m = rx.search(p)
+            if m:
+                segs.append((int(m.group(1)), p))
+        segs.sort()
+        for _, p in segs[:-MAX_SEGMENTS]:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    # -- read side ---------------------------------------------------------
+
+    def last_window(self) -> dict | None:
+        """The most recently rotated window (immutable once
+        published)."""
+        return self._last_window
+
+    def lifetime_rollups(self) -> dict[tuple, Rollup]:
+        """Cumulative per-key rollups since construction (the coverage
+        view; copy under the lock)."""
+        with self._lock:
+            return dict(self._lifetime)
+
+    def snapshot(self) -> dict:
+        """The ``/debug/profile`` payload."""
+        from . import anomaly
+
+        with self._lock:
+            return {
+                "enabled": enabled(),
+                "window_steps": self.window_steps,
+                "windows_total": self.windows_total,
+                "anomalies_total": self.anomalies_total,
+                "open_window": {
+                    "id": self._window_id,
+                    "steps": self._steps_in_window,
+                    "rollup_keys": len(self._accum),
+                },
+                "last_window": self._last_window,
+                "exposed_ms": {
+                    "p50": self.exposed_ms_sketch.quantile(0.5),
+                    "p99": self.exposed_ms_sketch.quantile(0.99),
+                },
+                "overlap_hidden_pct": {
+                    "p50": self.overlap_sketch.quantile(0.5),
+                    "p99": self.overlap_sketch.quantile(0.99),
+                },
+                "anomalies": [e.to_dict() for e in anomaly.recent()],
+                "segments": {
+                    "dir": self.out_dir,
+                    "current": self._segment_path,
+                    "index": self._segment_idx,
+                },
+            }
+
+
+# ---------------------------------------------------------------------------
+# module singleton + the hook call sites use
+
+
+def profiler() -> ContinuousProfiler | None:
+    """The process profiler, if one has been created (armed step seen
+    or :func:`install` called)."""
+    return _PROFILER
+
+
+def install(prof: ContinuousProfiler | None) -> ContinuousProfiler | None:
+    """Install (or clear, with None) the process profiler — the harness
+    entry for custom window sizes.  Returns the previous one."""
+    global _PROFILER
+    with _LOCK:
+        prev, _PROFILER = _PROFILER, prof
+    return prev
+
+
+def _get_profiler() -> ContinuousProfiler:
+    global _PROFILER
+    if _PROFILER is None:
+        with _LOCK:
+            if _PROFILER is None:
+                _PROFILER = ContinuousProfiler()
+    return _PROFILER
+
+
+def on_step(tier: str, step: int, governor=None) -> None:
+    """The scheduler step-boundary hook (``serve.Scheduler._step_impl``
+    and the router's handoff pump).  One cached-bool check when
+    ``TDT_PROFILE`` is unset — byte-identical behavior."""
+    if not _ENABLED:
+        return
+    if _suppressed():
+        return
+    _get_profiler().on_step(tier, step, governor=governor)
+
+
+def reset() -> None:
+    """Drop the process profiler (tests / lint harness hygiene)."""
+    install(None)
+
+
+# ---------------------------------------------------------------------------
+# exposition
+
+
+def to_prometheus() -> str:
+    """Profiler gauges for ``/metrics`` (appended by
+    ``obs.server.metrics_text``): last-window per-key rollups plus the
+    window counters.  Empty when no window has rotated."""
+    prof = _PROFILER
+    if prof is None:
+        return ""
+    window = prof.last_window()
+    if window is None:
+        return ""
+    lines = [
+        "# TYPE tdt_profile_windows_total counter",
+        f"tdt_profile_windows_total {prof.windows_total}",
+        "# TYPE tdt_profile_anomalies_total counter",
+        f"tdt_profile_anomalies_total {prof.anomalies_total}",
+    ]
+    for name in ("overlap_hidden_pct", "exposed_us", "pct_sol",
+                 "skew_us", "episodes"):
+        lines.append(f"# TYPE tdt_profile_{name} gauge")
+        for r in window["rollups"]:
+            labels = (f'family="{r["family"]}",'
+                      f'topology="{r["topology"]}",tier="{r["tier"]}"')
+            lines.append(f"tdt_profile_{name}{{{labels}}} {r[name]}")
+    return "\n".join(lines) + "\n"
+
+
+def format_snapshot(snap: dict) -> str:
+    """Human-readable rendering of a :meth:`ContinuousProfiler.snapshot`
+    payload (``scripts/obs_report.py --live``)."""
+    lines = [
+        f"continuous profiler: enabled={snap.get('enabled')} "
+        f"windows={snap.get('windows_total', 0)} "
+        f"window_steps={snap.get('window_steps')} "
+        f"anomalies={snap.get('anomalies_total', 0)}",
+    ]
+    window = snap.get("last_window")
+    if not window:
+        lines.append("(no rotated window yet — is TDT_PROFILE armed and "
+                     "the serve loop stepping?)")
+        return "\n".join(lines) + "\n"
+    lines.append(f"last window #{window['window']} "
+                 f"(ends step {window['step_end']}, "
+                 f"{window['steps']} steps):")
+    header = ("family", "topology", "tier", "episodes", "hidden%",
+              "exposed_ms", "pct_sol", "skew_us")
+    rows = [header]
+    for r in sorted(window.get("rollups", []),
+                    key=lambda r: (r["tier"], r["family"])):
+        rows.append((r["family"], r["topology"], r["tier"],
+                     str(r["episodes"]),
+                     f"{r['overlap_hidden_pct']:.1f}",
+                     f"{r['exposed_us'] / 1e3:.3f}",
+                     f"{100 * r['pct_sol']:.1f}",
+                     f"{r['skew_us']:.1f}"))
+    widths = [max(len(row[i]) for row in rows)
+              for i in range(len(header))]
+    for i, row in enumerate(rows):
+        lines.append("  ".join(c.ljust(w)
+                               for c, w in zip(row, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    tot = window.get("totals", {})
+    lines.append(
+        f"totals: exposed={tot.get('exposed_ms', 0):.3f}ms "
+        f"hidden={tot.get('overlap_hidden_pct', 0):.1f}% "
+        f"pct_sol={100 * tot.get('pct_sol', 0):.1f}% "
+        f"dominant_stall={tot.get('dominant_stall')}")
+    for a in snap.get("anomalies", []):
+        lines.append(f"ANOMALY {a.get('summary', a)}")
+    return "\n".join(lines) + "\n"

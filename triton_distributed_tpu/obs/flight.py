@@ -339,6 +339,31 @@ def mark_collective(op: str, *, payload_bytes: int = 0, ranks: int = 0,
                    sem=method)
 
 
+def feed_streams(family: str, streams) -> int:
+    """Append recorded per-rank streams into the global ring behind a
+    family marker (``collective`` event, ``op=family``) — the feeder
+    the continuous-profiler harness (``tdt_lint --profile``, tests)
+    uses to put deterministic record-mode traffic where the live drain
+    will find it.  Events are COPIED with the current step stamp: fresh
+    identities (the profiler's drain cursor is identity-based) and
+    correct ring pruning.  Returns the appended event count; 0 when the
+    ring is off."""
+    if not enabled():
+        return 0
+    mark_collective(family, ranks=len(streams))
+    count = 1
+    with _lock:
+        step = _state["step"]
+        for evs in streams:
+            for ev in evs:
+                _ring.append(FlightEvent(
+                    ev.kind, _now_us(), ev.rank, ev.sem, ev.sem2,
+                    ev.chunk, ev.peer, ev.elems, ev.flops, ev.bytes,
+                    ev.op, step))
+                count += 1
+    return count
+
+
 def recent(n: int | None = None) -> list[FlightEvent]:
     """The global ring's newest ``n`` events (all when None), oldest
     first."""

@@ -28,10 +28,22 @@ derived from the record's unit: latency-class units (``ms``/``us``)
 are lower-better, throughput units higher-better, byte-accounting
 units exact (no band).
 
+The healthy-band computation itself lives in ONE place —
+:func:`healthy_band` / :class:`Band` — consumed by both the trend
+sentinel (:func:`analyze`) and the continuous profiler's live
+comparator (``obs.anomaly``, ISSUE 16): a live window and a committed
+round are judged against a band by the SAME arithmetic, so "the live
+overlap fell out of band" means exactly what a trend warning means.
+:func:`bands_for` is the lookup front-door (metric name -> band over
+the committed rounds).  This module also parses the profiler's on-disk
+time-series segments (:func:`load_profile_windows` — the JSONL window
+lines ``obs.continuous`` rotates out).
+
 Consumers: ``scripts/bench_history.py`` (the CLI, ``--json`` /
 ``--markdown`` / ``--check``), ``scripts/check_perf_claims.py --trend``
 (trend warnings next to floor verdicts), ``scripts/tdt_lint.py
---history`` (the CI hook), and ``tests/test_obs.py`` fixtures.
+--history`` (the CI hook), ``obs.anomaly`` (the live comparator), and
+``tests/test_obs.py`` fixtures.
 """
 
 from __future__ import annotations
@@ -156,6 +168,49 @@ def load_rounds(root: str) -> list[Round]:
     return rounds
 
 
+_PROFILE_SEGMENT_RE = re.compile(r"profile_(\d+)\.jsonl$")
+
+
+def load_profile_windows(dirpath: str) -> list[dict]:
+    """Parse the continuous profiler's on-disk time-series segments
+    (``obs.continuous`` writes one JSONL line per rotated window into
+    ``profile_NNNN.jsonl`` segments under ``TDT_PROFILE_DIR``).
+    Returns the window dicts in rotation order — ascending (segment,
+    line) — skipping unparseable lines (a segment truncated by rotation
+    mid-write must not turn analysis into a crash)."""
+    paths = []
+    for p in glob.glob(os.path.join(dirpath, "profile_*.jsonl")):
+        m = _PROFILE_SEGMENT_RE.search(p)
+        if m:
+            paths.append((int(m.group(1)), p))
+    out: list[dict] = []
+    for _, p in sorted(paths):
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "window" in rec:
+                    out.append(rec)
+    return out
+
+
+def profile_series(windows: list[dict], metric: str) -> list[float]:
+    """One window-total metric as a time series (the per-window
+    ``totals`` dict of :func:`load_profile_windows` records)."""
+    out = []
+    for w in windows:
+        v = (w.get("totals") or {}).get(metric)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and math.isfinite(float(v)):
+            out.append(float(v))
+    return out
+
+
 def direction_for(metric: str, unit: str) -> str:
     u = (unit or "").lower()
     if "bytes/token" in u or u == "bool":
@@ -226,6 +281,66 @@ def _drift_pct(direction: str, newest: float, ref: float) -> float:
     return d
 
 
+@dataclasses.dataclass(frozen=True)
+class Band:
+    """A healthy band: the draws' [min, max] around their median, with
+    a slack margin before a value outside it counts as a breach.  The
+    ONE band shape both the trend sentinel and the live comparator
+    (``obs.anomaly``) judge against."""
+
+    lo: float
+    hi: float
+    median: float
+    direction: str             # "higher" | "lower"
+    slack: float = BAND_SLACK
+
+    @property
+    def edge(self) -> float:
+        """The band boundary on the WORSE side."""
+        return self.lo if self.direction == "higher" else self.hi
+
+    def breach(self, value: float) -> float | None:
+        """Drift (fraction) past the worse edge when ``value`` falls
+        out of band by more than ``slack``; ``None`` when healthy.
+        Exactly the :func:`analyze` below-band predicate."""
+        if not _worse(self.direction, float(value), self.edge):
+            return None
+        d = _drift_pct(self.direction, float(value), self.edge)
+        return d if d > self.slack else None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def healthy_band(values, direction: str, *,
+                 slack: float = BAND_SLACK) -> Band | None:
+    """THE healthy-band computation (one implementation, two consumers:
+    :func:`analyze`'s below-band check and ``obs.anomaly``'s live
+    comparator).  ``None`` when there is no band to speak of: fewer
+    than two draws (one point has no spread) or an exact-direction
+    metric."""
+    vals = [float(v) for v in values]
+    if direction == "exact" or len(vals) < 2:
+        return None
+    med = sorted(vals)[len(vals) // 2]
+    return Band(min(vals), max(vals), med, direction, slack)
+
+
+def bands_for(metric: str, *, rounds: list[Round] | None = None,
+              root: str = ".",
+              band_slack: float = BAND_SLACK) -> Band | None:
+    """The band lookup front-door: the healthy band of ``metric`` over
+    ALL committed draws (they are all prior rounds relative to a live
+    window).  ``None`` when the metric has no committed trajectory or
+    too few draws for a band."""
+    if rounds is None:
+        rounds = load_rounds(root)
+    tr = trajectories(rounds).get(metric)
+    if tr is None:
+        return None
+    return healthy_band(tr.values, tr.direction, slack=band_slack)
+
+
 def analyze(rounds: list[Round], *, decline_rounds: int = DECLINE_ROUNDS,
             decline_pct: float = DECLINE_PCT,
             band_slack: float = BAND_SLACK) -> dict[str, Trajectory]:
@@ -237,7 +352,6 @@ def analyze(rounds: list[Round], *, decline_rounds: int = DECLINE_ROUNDS,
         vals = tr.values
         newest = tr.draws[-1]
         prior = vals[:-1]
-        med = sorted(prior)[len(prior) // 2]
         tr.band = (min(prior), max(prior))
         # -- N-round monotonic decline ---------------------------------
         if len(vals) >= decline_rounds + 1:
@@ -253,16 +367,16 @@ def analyze(rounds: list[Round], *, decline_rounds: int = DECLINE_ROUNDS,
                     f"r{tr.draws[-decline_rounds - 1].round:02d}.."
                     f"r{newest.round:02d})")
         # -- newest draw below the prior healthy band ------------------
-        # (two prior rounds minimum: one draw has no spread, and a
-        # "band" of one point would flag ordinary round noise)
-        if len(prior) < 2:
+        # (healthy_band returns None under two prior rounds: one draw
+        # has no spread, and a "band" of one point would flag ordinary
+        # round noise)
+        band = healthy_band(prior, tr.direction, slack=band_slack)
+        if band is None:
             continue
-        lo, hi = tr.band
-        edge = lo if tr.direction == "higher" else hi
-        if _worse(tr.direction, newest.value, edge) and \
-                _drift_pct(tr.direction, newest.value, edge) > band_slack:
+        lo, hi, med = band.lo, band.hi, band.median
+        if band.breach(newest.value) is not None:
             retry_ok = newest.retry_value is not None and not _worse(
-                tr.direction, newest.retry_value, edge)
+                tr.direction, newest.retry_value, band.edge)
             if retry_ok:
                 tr.warnings.append(
                     f"{tr.metric}: r{newest.round:02d} draw "

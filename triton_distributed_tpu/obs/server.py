@@ -11,14 +11,23 @@
   ``resilience.health_snapshot()``); **503** when the snapshot reports
   ``status != "ok"`` (an open circuit breaker), 200 otherwise — the
   load-balancer contract.
-- ``GET /debug/flight``   — the current flight-ring dump
+- ``GET /debug/flight``   — the current flight-ring tail
   (``obs.flight.recent``) as JSON: enabled state, step, event dicts and
-  their ``describe()`` lines.
-- ``GET /debug/timeline`` — the ring reconstructed through
+  their ``describe()`` lines.  Bounded: the last 256 events by default,
+  ``?n=`` up to 2048 — a full 100k-event ring must not be serialized
+  into one response on a serving box.
+- ``GET /debug/timeline`` — the per-collective attribution view.  With
+  the continuous profiler armed (``TDT_PROFILE=1``) this serves the
+  profiler's last completed window snapshot (``source: "profiler"``) —
+  already reconstructed at the step boundary, so the scrape does no
+  ring replay at all.  Otherwise the ring tail (last 4096 events,
+  ``?n=`` caps lower/higher up to 16384) is reconstructed through
   ``obs.timeline`` (events grouped per recorded rank; live rank −1
-  events form one stream) rendered as the per-collective attribution
-  table, best-effort: a ring the credit replay cannot complete reports
-  ``pending`` instead of erroring.
+  events form one stream), best-effort: a ring the credit replay cannot
+  complete reports ``pending`` instead of erroring.
+- ``GET /debug/profile`` — the continuous profiler's full snapshot
+  (``obs.continuous``): open-window state, last completed window,
+  lifetime sketch quantiles, retained anomalies, on-disk segments.
 - ``GET /debug/serve``   — the live serve-stats snapshot plus, when the
   registered health source is a continuous-batching scheduler
   (``serve.Scheduler`` — it exposes ``debug_state()``), its queue /
@@ -46,11 +55,30 @@ from __future__ import annotations
 import json
 import os
 import threading
+import urllib.parse
 import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 _LOCK = threading.Lock()
 _SERVER: "TelemetryServer | None" = None
+
+# response bounds for the ring-backed debug endpoints (?n= clamps
+# within these; the ring itself holds up to 100k events)
+FLIGHT_DUMP_DEFAULT = 256
+FLIGHT_DUMP_MAX = 2048
+TIMELINE_DUMP_DEFAULT = 4096
+TIMELINE_DUMP_MAX = 16384
+
+
+def _query_n(query: str, default: int, cap: int) -> int:
+    """The ``?n=`` override for a ring-tail endpoint, clamped to
+    [1, cap]; absent/garbage values fall back to the default."""
+    try:
+        raw = urllib.parse.parse_qs(query).get("n", [None])[0]
+        n = int(raw) if raw is not None else default
+    except (ValueError, TypeError):
+        n = default
+    return max(1, min(int(n), cap))
 
 
 def port_from_env() -> int | None:
@@ -91,7 +119,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def do_GET(self):  # noqa: N802 — http.server API
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        raw_path, _, query = self.path.partition("?")
+        path = raw_path.rstrip("/") or "/"
         try:
             if path == "/metrics":
                 self._send(200, self._telemetry().metrics_text(),
@@ -102,11 +131,18 @@ class _Handler(BaseHTTPRequestHandler):
                                             default=str),
                            "application/json")
             elif path == "/debug/flight":
-                self._send(200, json.dumps(self._telemetry().flight_dump(),
+                n = _query_n(query, FLIGHT_DUMP_DEFAULT, FLIGHT_DUMP_MAX)
+                self._send(200, json.dumps(self._telemetry().flight_dump(n),
                                            default=str),
                            "application/json")
             elif path == "/debug/timeline":
-                self._send(200, json.dumps(self._telemetry().timeline_dump(),
+                n = _query_n(query, TIMELINE_DUMP_DEFAULT, TIMELINE_DUMP_MAX)
+                self._send(200,
+                           json.dumps(self._telemetry().timeline_dump(n),
+                                      default=str),
+                           "application/json")
+            elif path == "/debug/profile":
+                self._send(200, json.dumps(self._telemetry().profile_dump(),
                                            default=str),
                            "application/json")
             elif path == "/debug/serve":
@@ -123,8 +159,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(404, json.dumps({
                     "error": f"unknown path {path!r}",
                     "endpoints": ["/metrics", "/healthz", "/debug/flight",
-                                  "/debug/timeline", "/debug/serve",
-                                  "/debug/trace"],
+                                  "/debug/timeline", "/debug/profile",
+                                  "/debug/serve", "/debug/trace"],
                 }), "application/json")
         except BrokenPipeError:
             pass
@@ -174,9 +210,10 @@ class TelemetryServer:
     # -- endpoint bodies ---------------------------------------------------
 
     def metrics_text(self) -> str:
-        from . import dump_prometheus, serve_stats
+        from . import continuous, dump_prometheus, serve_stats
 
-        return dump_prometheus() + serve_stats.STATS.to_prometheus()
+        return (dump_prometheus() + serve_stats.STATS.to_prometheus()
+                + continuous.to_prometheus())
 
     def health(self) -> tuple[int, dict]:
         engine = self._engine_ref()
@@ -238,25 +275,57 @@ class TelemetryServer:
             }
         return 200, tr.to_dict()
 
-    def flight_dump(self, n: int = 256) -> dict:
+    def flight_dump(self, n: int = FLIGHT_DUMP_DEFAULT) -> dict:
         from . import flight
 
+        n = max(1, min(int(n), FLIGHT_DUMP_MAX))
         evs = flight.recent(n)
         return {
             "enabled": flight.enabled(),
             "keep_steps": flight.keep_steps(),
+            "n": n,
             "events": [ev.to_dict() for ev in evs],
             "lines": [ev.describe() for ev in evs],
         }
 
-    def timeline_dump(self) -> dict:
-        """Reconstruct the current ring through ``obs.timeline``: events
-        grouped by recorded rank (a deterministic capture harness writes
-        rank >= 0; live ring events carry rank −1 and form one stream).
-        Partial rings reconstruct as far as credits allow (``pending``)."""
-        from . import flight, timeline
+    def profile_dump(self) -> dict:
+        """``/debug/profile``: the continuous profiler's snapshot
+        (``obs.continuous``).  Disarmed processes answer a stub rather
+        than 404, so a dashboard can probe for the capability."""
+        from . import continuous
 
-        evs = flight.recent()
+        if not continuous.enabled():
+            return {"enabled": False,
+                    "hint": "set TDT_PROFILE=1 (docs/observability.md)"}
+        prof = continuous.profiler()
+        if prof is None:      # armed but no step boundary reached yet
+            return {"enabled": True, "windows_total": 0,
+                    "anomalies_total": 0, "last_window": None}
+        return prof.snapshot()
+
+    def timeline_dump(self, n: int = TIMELINE_DUMP_DEFAULT) -> dict:
+        """The attribution view.  Armed (``TDT_PROFILE=1``) with a
+        completed window, serve the profiler's own snapshot — the
+        reconstruction already happened incrementally at the step
+        boundary; a scrape must not replay the ring again.  Otherwise
+        reconstruct the ring TAIL (last ``n`` events) through
+        ``obs.timeline``: events grouped by recorded rank (a
+        deterministic capture harness writes rank >= 0; live ring
+        events carry rank −1 and form one stream).  Partial rings
+        reconstruct as far as credits allow (``pending``)."""
+        from . import continuous, flight, timeline
+
+        prof = continuous.profiler() if continuous.enabled() else None
+        if prof is not None:
+            last = prof.last_window()
+            if last is not None:
+                return {
+                    "enabled": flight.enabled(),
+                    "source": "profiler",
+                    "window": last,
+                }
+        n = max(1, min(int(n), TIMELINE_DUMP_MAX))
+        evs = flight.recent(n)
         ranks = sorted({ev.rank for ev in evs if ev.rank >= 0})
         if ranks:
             streams = [[ev for ev in evs if ev.rank == r] for r in ranks]
@@ -266,6 +335,8 @@ class TelemetryServer:
             tl = timeline.reconstruct(streams, kernel="flight-ring")
             return {
                 "enabled": flight.enabled(),
+                "source": "ring",
+                "n": n,
                 "ranks": tl.n,
                 "events": len(evs),
                 "critical_us": tl.critical_us,
@@ -278,6 +349,8 @@ class TelemetryServer:
         except Exception as e:
             return {
                 "enabled": flight.enabled(),
+                "source": "ring",
+                "n": n,
                 "events": len(evs),
                 "error": f"{type(e).__name__}: {e}",
                 "lines": [ev.describe() for ev in evs[-64:]],

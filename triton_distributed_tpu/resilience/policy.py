@@ -260,6 +260,7 @@ class AdmissionGovernor:
         self._window: list[int] = []     # preemptions per recent step
         self._pending_preempts = 0
         self._clean_steps = 0
+        self.advisories = 0
 
     def note_preemption(self) -> None:
         self._pending_preempts += 1
@@ -267,6 +268,17 @@ class AdmissionGovernor:
     def note_step_failure(self) -> None:
         # a failed dispatch is pressure too: count it like a preemption
         self._pending_preempts += 1
+
+    def note_advisory(self) -> None:
+        """An out-of-band pressure signal — the continuous profiler's
+        anomaly detector offers each breaching window here (ISSUE 16).
+        Advisory means exactly that: counted like one preemption, so a
+        single anomalous window does nothing and only a RECURRING
+        anomaly (>= thrash_threshold within the window) degrades
+        admission.  The governor stays deterministic — advisories
+        arrive at step boundaries, never from wall time."""
+        self._pending_preempts += 1
+        self.advisories += 1
 
     def note_step_ok(self) -> None:
         self._window.append(self._pending_preempts)
@@ -313,6 +325,7 @@ class AdmissionGovernor:
             "recent_preemptions": sum(self._window)
             + self._pending_preempts,
             "headroom_pages": self.headroom_pages(),
+            "advisories": self.advisories,
         }
 
 
@@ -337,7 +350,7 @@ def health_snapshot() -> dict:
             for op, b in sorted(_BREAKERS.items())
         }
     degraded_ops = sorted(op for op, b in breakers.items() if b["open"])
-    return {
+    out = {
         "status": "degraded" if degraded_ops else "ok",
         # the ops currently serving through their XLA fallback (open
         # breakers) — what /healthz consumers alert on by name, without
@@ -353,6 +366,17 @@ def health_snapshot() -> dict:
         "last_errors": dict(sorted(_LAST_ERROR.items())),
         "counters": counters,
     }
+    # the continuous profiler's anomaly state (ISSUE 16): a WARNING,
+    # not a status flip — /healthz must answer 200 on perf drift (the
+    # load balancer sheds on 503; a slow-but-correct replica still
+    # serves).  Absent when the latest window was healthy, so an
+    # unarmed process's snapshot is byte-identical to before.
+    from ..obs import anomaly
+
+    frag = anomaly.health_fragment()
+    if frag is not None:
+        out["profile"] = frag
+    return out
 
 
 def _reset_state_for_tests() -> None:

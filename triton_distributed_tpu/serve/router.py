@@ -163,6 +163,10 @@ class DisaggRouter:
         h0, c0, r0 = self.handoffs, self.colocated, self.reprefills
         rp = self.prefill.step()
         self._pump_handoffs()
+        # continuous profiler (TDT_PROFILE=1, ISSUE 16): the pump's DCN
+        # handoff traffic drains under the "handoff" tier before the
+        # decode tick claims the rest of the ring for "decode"
+        obs.continuous.on_step("handoff", self.prefill.steps)
         rd = self.decode.step()
         # advance the modeled wire clock (bulk backlogs drain; a real
         # transport ignores this)

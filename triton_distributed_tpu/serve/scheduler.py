@@ -269,6 +269,12 @@ class Scheduler:
         res.free_pages = self.pool.free_pages
         res.active = sum(s is not None for s in self.slots)
         self._publish_gauges()
+        # continuous profiler step boundary (TDT_PROFILE=1, ISSUE 16):
+        # drain the flight ring incrementally into this tier's rollups
+        # and rotate the window when due; anomalous windows advise the
+        # governor.  One cached-bool check when unarmed.
+        obs.continuous.on_step(self.trace_tier, self.steps,
+                               governor=self.governor)
         return res
 
     def run_until_idle(self, *, max_steps: int = 100_000) -> int:

@@ -25,6 +25,7 @@ Usage:
     python scripts/tdt_lint.py --hier            # hierarchical (ICIxDCN) gate
     python scripts/tdt_lint.py --trace           # request-tracing gate
     python scripts/tdt_lint.py --profile         # continuous-profiler gate
+    python scripts/tdt_lint.py --pages           # page-lifetime ownership gate
     python scripts/tdt_lint.py --all             # every gate, one exit code
     python scripts/tdt_lint.py --json report.json
 
@@ -150,10 +151,19 @@ full wiring fails with the diff as the message; plus the static
 VMEM-footprint check on every family's DEFAULT tile config
 (``analysis.footprint``) at its representative serving shape.
 
+``--pages`` is the page-lifetime ownership gate (docs/static_analysis.md
+"Page lifetime checking"): the DPOR explorer over the clean two-tier
+handoff/preempt/colocate/shared-release page scenarios (every schedule
+class leak-free, no use-after-free / read-before-stamp / double-free /
+scrub-under-reader), the seeded-bad lifecycle fixture battery in both
+directions, and a static ownership re-check of every fault-matrix
+serving cell's recorded page trace.
+
 ``--all`` runs every gate above — verify matrix, ``--dpor``,
 ``--completeness``, ``--faults``, ``--timeline``, ``--serve``,
 ``--history``, ``--integrity``, ``--quant``, ``--hier``,
-``--handoff``, ``--persistent``, ``--trace``, ``--profile`` — and
+``--handoff``, ``--persistent``, ``--trace``, ``--profile``,
+``--pages`` — and
 summarizes them under a single exit code (the CI entry; see README).
 
 ``--history`` runs the bench-record trend sentinel
@@ -263,12 +273,19 @@ def main(argv: list[str] | None = None) -> int:
                          "lands a live rollup agreeing with the "
                          "offline timeline on the same capture, and "
                          "the anomaly selftest passes both directions")
+    ap.add_argument("--pages", action="store_true", dest="pages_gate",
+                    help="page-lifetime ownership gate (ISSUE 17): the "
+                         "DPOR sweep over the clean two-tier page "
+                         "scenarios, the seeded-bad lifecycle fixture "
+                         "selftest both directions, and a static "
+                         "ownership re-check of every fault-matrix "
+                         "serving cell's recorded page trace")
     ap.add_argument("--all", action="store_true", dest="all_gates",
                     help="run every gate (verify matrix, --faults, "
                          "--timeline, --serve, --history, --integrity, "
                          "--quant, --hier, --handoff, --persistent, "
-                         "--trace, --profile) with one summarized exit "
-                         "code")
+                         "--trace, --profile, --pages) with one "
+                         "summarized exit code")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection target sampling seed (--faults)")
     ap.add_argument("--json", metavar="PATH",
@@ -303,6 +320,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_trace(args)
     if args.profile:
         return _run_profile(args)
+    if args.pages_gate:
+        return _run_pages(args)
 
     from triton_distributed_tpu import analysis
 
@@ -672,6 +691,7 @@ def _run_all(args) -> int:
         ("persistent", lambda: _run_persistent(sub())),
         ("trace", lambda: _run_trace(sub())),
         ("profile", lambda: _run_profile(sub())),
+        ("pages", lambda: _run_pages(sub())),
     ]
     results = []
     for name, fn in legs:
@@ -691,6 +711,83 @@ def _run_all(args) -> int:
             json.dump({"legs": dict(results), "rc": worst}, f,
                       indent=1, sort_keys=True)
     return worst
+
+
+def _run_pages(args) -> int:
+    """The page-lifetime ownership gate (docs/static_analysis.md "Page
+    lifetime checking"): (1) the DPOR explorer over every clean
+    two-tier scenario — all schedule classes of the prefill/router/
+    decode/scrubber interleaving leak-free and lifetime-safe, never
+    pruned; (2) the seeded-bad fixture selftest in both directions
+    (clean quiet, every planted double-free / scrub-under-reader /
+    leak-on-abort / unverified-adopt / refcount-underflow caught with
+    the page id and violating transition named); (3) a static replay of
+    the fault matrix's serving cells — every scheduler and handoff
+    cell's recorded page trace re-checked by the ownership state
+    machine, so each cell's "zero leaked pages" claim is discharged
+    structurally, not just by the free-list counter."""
+    from triton_distributed_tpu.analysis import fixtures
+    from triton_distributed_tpu.analysis.pages import (
+        explore_pages, two_tier_scenarios,
+    )
+    from triton_distributed_tpu.resilience import matrix
+
+    problems: list[str] = []
+    scen_rows = []
+    classes = 0
+    for name, scenario in two_tier_scenarios():
+        res = explore_pages(name, scenario)
+        classes += res.schedules
+        status = "OK" if not res.violations else "VIOLATION"
+        extra = "  PRUNED" if res.pruned else ""
+        print(f"{name:<28} actors={len(res.actors):<2} "
+              f"classes={res.schedules:<4} {status}{extra}")
+        for v in res.violations:
+            print(f"    [{v.check}] {v.message}")
+            problems.append(f"{name}: [{v.check}] {v.message}")
+        if res.pruned:
+            problems.append(f"{name}: clean-scenario exploration was "
+                            f"pruned — the sweep must be exhaustive")
+        scen_rows.append({"scenario": name, "actors": len(res.actors),
+                          "classes": res.schedules, "pruned": res.pruned,
+                          "violations": len(res.violations)})
+
+    selftest = fixtures.run_page_selftest()
+    problems += [f"page selftest: {p}" for p in selftest]
+
+    sched_rows = matrix.run_scheduler_matrix(seed=args.seed)
+    hand_rows = matrix.run_handoff_matrix(seed=args.seed)
+    events = 0
+    for row in sched_rows + hand_rows:
+        key = f"{row['kernel']} x {row['fault']}/{row['leg']}"
+        ev = row.get("lifecycle_events", 0)
+        vs = row.get("lifecycle_violations", [])
+        events += ev
+        print(f"{key:<44} events={ev:<4} "
+              f"{'clean' if not vs and ev else 'VIOLATION'}")
+        if not ev:
+            problems.append(f"{key}: lifecycle recorder saw zero page "
+                            f"events — interception unwired")
+        problems += [f"{key}: {v}" for v in vs]
+
+    for p in problems:
+        print(f"PAGES FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"scenarios": scen_rows,
+                       "selftest_problems": selftest,
+                       "matrix_events": events,
+                       "problems": problems}, f, indent=1,
+                      sort_keys=True)
+    if problems:
+        return 1
+    print(f"pages OK: {len(scen_rows)} two-tier scenarios x {classes} "
+          f"schedule classes leak-free and lifetime-safe; every seeded "
+          f"lifecycle fixture caught with the page and transition "
+          f"named; {len(sched_rows) + len(hand_rows)} fault-matrix "
+          f"cells statically re-verified over {events} recorded page "
+          f"events")
+    return 0
 
 
 def _run_faults(args) -> int:

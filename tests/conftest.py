@@ -137,6 +137,11 @@ FAST_NODES = frozenset((
     "tests/test_persistent_decode.py::test_persistent_protocol_clean[4]",
     "tests/test_static_analysis.py::test_tdt_lint_dpor_smoke",
     "tests/test_static_analysis.py::test_tdt_lint_completeness_smoke",
+    "tests/test_page_lifecycle.py::test_tdt_lint_pages_smoke",
+    "tests/test_page_lifecycle.py::"
+    "test_refcount_share_release_and_scrub_refusal",
+    "tests/test_page_lifecycle.py::"
+    "test_page_fixture_selftest_both_directions",
     "tests/test_persistent_decode.py::"
     "test_window_token_parity_under_pressure[4]",
     "tests/test_persistent_decode.py::test_bundle_equals_single_steps_tp1",

@@ -1,0 +1,391 @@
+"""Page-lifetime ownership model checking (ISSUE 17).
+
+Covers the three layers of ``analysis.pages`` — the ownership state
+machine, the recorder's interception at the REAL call sites, and the
+page-footprint DPOR explorer (with hand-computed class counts) — plus
+the refcounted ``PagePool.share``/``release`` substrate it certifies
+(scrub refusal under live references pinned with a scrubber spy), the
+seeded-bad fixtures both directions, TDT_VERIFY_PAGES inertness when
+unset, and the ``tdt_lint --pages`` smoke.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from triton_distributed_tpu import serve
+from triton_distributed_tpu.analysis import fixtures, pages
+from triton_distributed_tpu.analysis.pages import PageEvent, PageOp
+from triton_distributed_tpu.resilience import integrity
+from triton_distributed_tpu.serve import budget
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ev(actor, op, key, **meta):
+    return PageEvent(actor, op, key, tuple(sorted(meta.items())))
+
+
+def _checks(violations):
+    return sorted({v.check for v in violations})
+
+
+# ---------------------------------------------------------------------------
+# the ownership state machine
+
+
+def test_clean_lifecycle_is_quiet():
+    evs = [
+        _ev("serve", "alloc", "P1"), _ev("serve", "write", "P1"),
+        _ev("serve", "seal", "P1"), _ev("serve", "stamp", "P1"),
+        _ev("serve", "read", "P1"),
+        _ev("serve", "free", "P1", scrub_pending=True),
+        _ev("serve", "scrub", "P1"),
+    ]
+    assert pages.check_events(evs) == []
+
+
+@pytest.mark.parametrize("evs,check,page", [
+    # use-after-free: read of recycled storage
+    ([_ev("a", "alloc", "U1"), _ev("a", "write", "U1"),
+      _ev("a", "seal", "U1"), _ev("a", "free", "U1"),
+      _ev("a", "read", "U1")], "use_after_free", "U1"),
+    # read of a reserved, never-written page
+    ([_ev("a", "alloc", "R1"), _ev("a", "read", "R1"),
+      _ev("a", "free", "R1")], "read_before_stamp", "R1"),
+    # double free / double alloc
+    ([_ev("a", "alloc", "F1"), _ev("a", "free", "F1"),
+      _ev("a", "free", "F1")], "double_free", "F1"),
+    ([_ev("a", "alloc", "A1"), _ev("b", "alloc", "A1"),
+      _ev("a", "free", "A1"), _ev("b", "free", "A1")],
+     "double_alloc", "A1"),
+    # stamped bytes never legally change
+    ([_ev("a", "alloc", "S1"), _ev("a", "write", "S1"),
+      _ev("a", "stamp", "S1"), _ev("a", "write", "S1"),
+      _ev("a", "free", "S1")], "write_after_stamp", "S1"),
+    # copy-on-write: no mutation under a share
+    ([_ev("a", "alloc", "W1"), _ev("a", "write", "W1"),
+      _ev("a", "seal", "W1"), _ev("b", "share", "W1"),
+      _ev("a", "write", "W1"), _ev("a", "free", "W1"),
+      _ev("b", "release", "W1")], "write_under_share", "W1"),
+    # ABA: re-alloc before the pending poison-fill landed
+    ([_ev("a", "alloc", "B1"), _ev("a", "write", "B1"),
+      _ev("a", "seal", "B1"),
+      _ev("a", "free", "B1", scrub_pending=True),
+      _ev("a", "alloc", "B1"), _ev("a", "write", "B1"),
+      _ev("a", "seal", "B1"), _ev("a", "free", "B1")],
+     "reuse_before_scrub", "B1"),
+    # poison-fill under a live reference
+    ([_ev("a", "alloc", "L1"), _ev("a", "write", "L1"),
+      _ev("a", "seal", "L1"), _ev("s", "scrub", "L1"),
+      _ev("a", "free", "L1")], "scrub_under_live_reader", "L1"),
+    # more releases than references
+    ([_ev("a", "alloc", "N1"), _ev("a", "write", "N1"),
+      _ev("a", "seal", "N1"), _ev("a", "release", "N1"),
+      _ev("a", "release", "N1")], "refcount_underflow", "N1"),
+    # implanted wire bytes sealed before stamp verification
+    ([_ev("d", "alloc", "V1"), _ev("d", "implant", "V1"),
+      _ev("d", "seal", "V1"), _ev("d", "free", "V1")],
+     "adopt_before_stamp_verify", "V1"),
+    # sharing a still-filling page serves a torn read
+    ([_ev("a", "alloc", "T1"), _ev("a", "write", "T1"),
+      _ev("b", "share", "T1"), _ev("a", "free", "T1"),
+      _ev("b", "release", "T1")], "share_unsealed", "T1"),
+    # leak: a terminal path failed to return the page
+    ([_ev("a", "alloc", "K1"), _ev("a", "write", "K1")],
+     "page_leak", "K1"),
+])
+def test_hazard_flagged_with_page_named(evs, check, page):
+    vs = pages.check_events(evs)
+    assert check in _checks(vs), _checks(vs)
+    hit = next(v for v in vs if v.check == check)
+    assert f"page {page}" in hit.message
+
+
+def test_decode_reads_partially_filled_tail_page_legally():
+    # decode attends over the FILLING tail page every step — the
+    # read-before-stamp check must be narrowed to never-written pages
+    evs = [
+        _ev("serve", "alloc", "P1"), _ev("serve", "write", "P1"),
+        _ev("serve", "read", "P1"), _ev("serve", "write", "P1"),
+        _ev("serve", "seal", "P1"), _ev("serve", "free", "P1"),
+    ]
+    assert pages.check_events(evs) == []
+
+
+def test_verified_implant_then_seal_is_quiet():
+    evs = [
+        _ev("decode", "alloc", "D1"), _ev("decode", "implant", "D1"),
+        _ev("decode", "verify", "D1"), _ev("decode", "seal", "D1"),
+        _ev("decode", "read", "D1"), _ev("decode", "free", "D1"),
+    ]
+    assert pages.check_events(evs) == []
+
+
+def test_scrub_pending_free_then_scrub_is_quiet_and_terminal():
+    # SCRUB_PENDING at end of trace is NOT a leak (the free committed);
+    # but the next alloc before the scrub IS the ABA window
+    evs = [
+        _ev("a", "alloc", "P1"), _ev("a", "write", "P1"),
+        _ev("a", "seal", "P1"),
+        _ev("a", "free", "P1", scrub_pending=True),
+    ]
+    assert pages.check_events(evs) == []
+
+
+# ---------------------------------------------------------------------------
+# the refcounted share/release substrate (PagePool)
+
+
+def test_refcount_share_release_and_scrub_refusal():
+    scrubbed = []
+    pool = serve.PagePool(8, page_size=4, scrubber=scrubbed.extend)
+    a = pool.alloc(2)
+    assert [pool.refcount(p) for p in a] == [1, 1]
+    pool.share(a)
+    assert [pool.refcount(p) for p in a] == [2, 2]
+    assert pool.snapshot()["shared_pages"] == 2
+    # first release: refs 2 -> 1, pages stay allocated, NOTHING scrubbed
+    pool.free(a)
+    assert scrubbed == []
+    assert [pool.refcount(p) for p in a] == [1, 1]
+    assert pool.used_pages == 2
+    # last release: back to the free list, and only now the scrub
+    pool.release(a)
+    assert scrubbed == a
+    assert [pool.refcount(p) for p in a] == [0, 0]
+    assert pool.used_pages == 0
+    # acquire is the share alias the radix cache will use
+    b = pool.alloc(1)
+    pool.acquire(b)
+    assert pool.refcount(b[0]) == 2
+    pool.free(b)
+    pool.free(b)
+
+
+def test_page_lifecycle_error_is_typed_and_names_the_page():
+    pool = serve.PagePool(6, page_size=4)
+    a = pool.alloc(1)
+    pool.free(a)
+    with pytest.raises(budget.PageLifecycleError) as ei:
+        pool.free(a)
+    assert ei.value.page == a[0]
+    assert ei.value.transition == "FREE->free"
+    assert isinstance(ei.value, ValueError)     # old callers keep working
+    with pytest.raises(budget.PageLifecycleError) as ei:
+        pool.share(a)
+    assert ei.value.page == a[0]
+    assert ei.value.transition == "FREE->share"
+    with pytest.raises(budget.PageLifecycleError) as ei:
+        pool.free([serve.SCRAP_PAGE])
+    assert ei.value.page == serve.SCRAP_PAGE
+    assert isinstance(ei.value, serve.PageLifecycleError)  # exported
+
+
+def test_shared_page_survives_owner_free_with_content_intact():
+    # the structural half of scrub-never-under-reader: with a poison
+    # scrubber armed, an owner's free of a SHARED page must not poison
+    # it — the last release does
+    calls = []
+    pool = serve.PagePool(8, page_size=4, scrubber=lambda ps: calls.append(
+        list(ps)))
+    a = pool.alloc(1)
+    pool.share(a)
+    pool.free(a)          # owner departs; radix still holds a ref
+    assert calls == []
+    pool.release(a)       # last reference -> scrub fires exactly once
+    assert calls == [a]
+
+
+# ---------------------------------------------------------------------------
+# recorder interception at the real call sites
+
+
+def test_recorder_intercepts_scheduler_lifecycle():
+    prev = integrity.enable(True)
+    try:
+        backend = serve.SimBackend(slots=3, page_size=4, pool_pages=32,
+                                   max_length=48)
+        sched = serve.Scheduler(backend)
+        arrivals = serve.synthetic_trace(
+            3, 10, mean_interarrival_steps=0.5, prompt_len=(2, 9),
+            max_new=(2, 8))
+        with pages.record() as rec:
+            report = serve.replay(sched, arrivals, max_steps=2000)
+    finally:
+        integrity.enable(prev)
+    assert report.problems() == []
+    ops = {e.op for e in rec.events}
+    # pool ops + scheduler prefill-write/seal + decode read/append +
+    # audit stamps (integrity on) all funnel through the one hook
+    assert {"alloc", "write", "seal", "read", "stamp",
+            "free"} <= ops, ops
+    assert pages.check_recorder(rec, label="sched_replay") == []
+    # the pool is attributed to its owning scheduler's tier
+    actors = {e.actor for e in rec.events}
+    assert "serve" in actors
+
+
+def test_recorder_intercepts_two_tier_handoff():
+    pre = serve.Scheduler(
+        serve.SimBackend(slots=3, page_size=4, pool_pages=24,
+                         max_length=48),
+        serve.SchedulerConfig(max_queue_depth=32, prefill_only=True))
+    dec = serve.Scheduler(
+        serve.SimBackend(slots=3, page_size=4, pool_pages=32,
+                         max_length=48),
+        serve.SchedulerConfig(max_queue_depth=32))
+    router = serve.DisaggRouter(pre, dec)
+    reqs = [serve.Request(prompt=(5, 6, 7), max_new_tokens=4),
+            serve.Request(prompt=(8, 9), max_new_tokens=3)]
+    with pages.record() as rec:
+        for r in reqs:
+            router.submit(r)
+        router.run_until_idle()
+    assert router.leaked_pages() == 0
+    ops = {e.op for e in rec.events}
+    assert {"alloc", "extract", "implant", "free"} <= ops, ops
+    assert pages.check_recorder(rec, label="two_tier") == []
+    actors = {e.actor for e in rec.events}
+    assert {"prefill", "decode"} <= actors, actors
+
+
+def test_record_restores_previous_recorder():
+    assert budget.lifecycle_recorder() is None
+    with pages.record() as outer:
+        assert budget.lifecycle_recorder() is outer
+        with pages.record() as inner:
+            assert budget.lifecycle_recorder() is inner
+        assert budget.lifecycle_recorder() is outer
+    assert budget.lifecycle_recorder() is None
+
+
+# ---------------------------------------------------------------------------
+# the page-footprint DPOR explorer
+
+
+def test_dpor_hand_computed_class_counts():
+    # two actors, two ops each, ALL on one page: every interleaving is
+    # its own Mazurkiewicz class -> C(4, 2) = 6
+    dep = {
+        "a": [PageOp("alloc", "p1"), PageOp("free", "p1")],
+        "b": [PageOp("alloc", "p1"), PageOp("free", "p1")],
+    }
+    res = pages.explore_pages("dep", dep)
+    assert res.schedules == 6 and not res.pruned
+    # ...and the race IS caught in the interleaved classes
+    assert "double_alloc" in _checks(res.violations)
+    # disjoint footprints: everything commutes -> ONE class, clean
+    dis = {
+        "a": [PageOp("alloc", "p1"), PageOp("free", "p1")],
+        "b": [PageOp("alloc", "p2"), PageOp("free", "p2")],
+    }
+    res = pages.explore_pages("dis", dis)
+    assert res.schedules == 1 and res.violations == []
+
+
+def test_dpor_guard_tokens_enforce_happens_before():
+    # the guarded consumer can never run first: one class, clean
+    sc = {
+        "prod": [PageOp("alloc", "p"), PageOp("write", "p"),
+                 PageOp("seal", "p", token="done")],
+        "cons": [PageOp("read", "p", guard=("done",)),
+                 PageOp("free", "p")],
+    }
+    res = pages.explore_pages("guarded", sc)
+    assert res.violations == []
+    # a guard token nobody produces is a deadlock, named
+    stuck = {
+        "cons": [PageOp("read", "p", guard=("never",))],
+    }
+    res = pages.explore_pages("stuck", stuck)
+    assert _checks(res.violations) == ["deadlock"]
+    assert "never" in res.violations[0].message
+
+
+def test_two_tier_scenarios_all_verify_clean():
+    total = 0
+    for name, sc in pages.two_tier_scenarios():
+        res = pages.explore_pages(name, sc)
+        assert res.violations == [], (name, [str(v) for v in
+                                             res.violations])
+        assert not res.pruned
+        total += res.schedules
+    # the sweep walks multiple genuine classes, not one serialization
+    assert total > len(pages.two_tier_scenarios())
+
+
+def test_shared_release_scenario_scrubs_only_after_last_release():
+    # drop the scrub's guard on the owner's release: some schedule now
+    # poisons under the radix cache's live reference — the exact bug
+    # PagePool's refcounts (and the clean scenario's guards) prevent
+    sc = dict(dict(pages.two_tier_scenarios())["pages/shared_release"])
+    sc["scrubber"] = [PageOp("scrub", "D1", guard=("cache_released",))]
+    res = pages.explore_pages("pages/shared_release_bad", sc)
+    assert "scrub_under_live_reader" in _checks(res.violations)
+
+
+# ---------------------------------------------------------------------------
+# fixtures: both directions
+
+
+def test_page_fixture_selftest_both_directions():
+    problems = fixtures.run_page_selftest()
+    assert problems == []
+
+
+def test_each_page_fixture_names_page_and_transition():
+    for name, sc in fixtures.page_fixture_cases():
+        check, page = fixtures.PAGE_EXPECTED[name]
+        res = pages.explore_pages(name, sc)
+        assert check in _checks(res.violations), (name,
+                                                  _checks(res.violations))
+        hit = next(v for v in res.violations if v.check == check)
+        assert f"page {page}" in hit.message
+        assert "->" in hit.message          # the violating transition
+
+
+# ---------------------------------------------------------------------------
+# TDT_VERIFY_PAGES gate
+
+
+def test_unset_env_is_inert(monkeypatch):
+    monkeypatch.delenv("TDT_VERIFY_PAGES", raising=False)
+    assert not pages.verify_pages_enabled()
+    backend = serve.SimBackend(slots=2, page_size=4, pool_pages=16,
+                               max_length=48)
+    sched = serve.Scheduler(backend)
+    arrivals = serve.synthetic_trace(5, 4)
+    report = serve.replay(sched, arrivals, max_steps=2000)
+    assert report.problems() == []
+    assert budget.lifecycle_recorder() is None
+
+
+def test_env_armed_replay_records_and_passes(monkeypatch):
+    monkeypatch.setenv("TDT_VERIFY_PAGES", "1")
+    assert pages.verify_pages_enabled()
+    backend = serve.SimBackend(slots=2, page_size=4, pool_pages=16,
+                               max_length=48)
+    sched = serve.Scheduler(backend)
+    arrivals = serve.synthetic_trace(5, 4)
+    report = serve.replay(sched, arrivals, max_steps=2000)
+    assert report.problems() == []
+    # clean drain: the gate armed, checked, and raised nothing; it
+    # disarmed on exit
+    assert budget.lifecycle_recorder() is None
+
+
+# ---------------------------------------------------------------------------
+# lint smoke
+
+
+def test_tdt_lint_pages_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tdt_lint.py"),
+         "--pages"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pages OK" in proc.stdout

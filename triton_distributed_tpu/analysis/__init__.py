@@ -32,8 +32,12 @@ Entry points:
   (+ ``TDT_VERIFY_EXPLORE`` for bounded/exact exploration)
 - ``fixtures.run_selftest()``          seeded-bad kernels battery
 - ``fixtures.run_dpor_selftest()``     canonical-pass / DPOR-fail pins
+- ``fixtures.run_page_selftest()``     seeded-bad page lifecycles
 - ``footprint.check_defaults()``       default-config feasibility
 - ``completeness.check()``             cross-subsystem wiring lint
+- ``pages.check_events`` / ``pages.explore_pages``  page-lifetime
+  ownership model checking for the serving KV pool (CLI:
+  ``tdt_lint --pages``; gate: ``TDT_VERIFY_PAGES=1``)
 
 See docs/static_analysis.md for the event model and check semantics.
 """
@@ -45,6 +49,17 @@ from .events import FakeRef, FakeSem, FakeSmem, Region
 # shadow the submodule name itself
 from .explore import ExploreResult, explore_all, explore_case
 from .footprint import Footprint
+# NOTE: same treatment for ``pages`` — the checker's entry points stay
+# importable as names here while the submodule keeps its own name
+from .pages import (
+    PageEvent,
+    PageExploreResult,
+    PageOp,
+    PageRecorder,
+    check_events,
+    explore_pages,
+    two_tier_scenarios,
+)
 from .record import KernelRecorder, record_kernel, recording
 from .registry import (
     DEFAULT_RANKS,
@@ -61,9 +76,11 @@ from .registry import (
 __all__ = [
     "CHECKS", "DEFAULT_RANKS", "ExploreResult", "FAMILIES", "FakeRef",
     "FakeSem", "FakeSmem", "Footprint", "KernelCase", "KernelRecorder",
+    "PageEvent", "PageExploreResult", "PageOp", "PageRecorder",
     "ProtocolViolationError", "Region", "Violation", "all_cases",
-    "analyze", "cases_for", "explore_all", "explore_case",
-    "maybe_verify_build", "record_case", "record_kernel", "recording",
+    "analyze", "cases_for", "check_events", "explore_all",
+    "explore_case", "explore_pages", "maybe_verify_build",
+    "record_case", "record_kernel", "recording", "two_tier_scenarios",
     "verify_all",
     "verify_case",
 ]

@@ -204,4 +204,45 @@ def check() -> list[str]:
             problems.append(
                 f"collective_id {cid} registered for multiple families: "
                 f"{sorted(names)}")
+    problems.extend(check_lifecycle_coverage())
+    return problems
+
+
+def check_lifecycle_coverage() -> list[str]:
+    """The page-lifetime wiring row: every live ``RequestState`` and
+    every ``HandoffFault`` class must have a documented lifecycle-
+    coverage entry in ``pages.LIFECYCLE_COVERAGE`` (how the page checker
+    exercises that state's alloc/free path), and no coverage entry may
+    name a state or fault class that no longer exists.  A new request
+    state or handoff fault landing without a page-ownership story is
+    exactly the leak-on-abort shape the checker exists to rule out."""
+    from ..serve.handoff import HandoffFault
+    from ..serve.queue import RequestState
+    from .pages import LIFECYCLE_COVERAGE
+
+    problems: list[str] = []
+    live_states = {s.name for s in RequestState}
+    golden_states = set(LIFECYCLE_COVERAGE["request_states"])
+    for name in sorted(live_states - golden_states):
+        problems.append(
+            f"RequestState.{name}: no page-lifecycle coverage entry in "
+            f"analysis.pages.LIFECYCLE_COVERAGE — a request state "
+            f"without a documented alloc/free story is an unchecked "
+            f"leak path")
+    for name in sorted(golden_states - live_states):
+        problems.append(
+            f"lifecycle coverage names RequestState.{name} which no "
+            f"longer exists — prune the stale row")
+    live_faults = {f.value for f in HandoffFault}
+    golden_faults = set(LIFECYCLE_COVERAGE["handoff_faults"])
+    for name in sorted(live_faults - golden_faults):
+        problems.append(
+            f"HandoffFault {name!r}: no page-lifecycle coverage entry "
+            f"in analysis.pages.LIFECYCLE_COVERAGE — a wire fault "
+            f"class without a both-tier page-return story is an "
+            f"unchecked leak path")
+    for name in sorted(golden_faults - live_faults):
+        problems.append(
+            f"lifecycle coverage names handoff fault {name!r} which no "
+            f"longer exists — prune the stale row")
     return problems

@@ -300,6 +300,144 @@ EXPECTED = {
 }
 
 
+def page_fixture_cases() -> list[tuple[str, dict]]:
+    """Seeded-bad page-lifetime scenarios for ``analysis.pages`` — each
+    is a clean two-tier scenario with ONE ordering edge or release
+    dropped, reproducing a real bug class the ownership state machine
+    (plus the page-footprint DPOR) must flag.  Kept beside the kernel
+    fixtures so one module owns every seeded-bad battery."""
+    from .pages import PageOp
+
+    w = lambda **kw: tuple(sorted(kw.items()))
+
+    # the owner frees twice: the bookkeeping bug PagePool's typed
+    # PageLifecycleError rejects dynamically, flagged here statically
+    double_free = {
+        "serve": [
+            PageOp("alloc", "F1"), PageOp("write", "F1"),
+            PageOp("seal", "F1"), PageOp("read", "F1"),
+            PageOp("free", "F1"), PageOp("free", "F1"),
+        ],
+    }
+
+    # pre-refcount TDT_SCRUB_PAGES: the scrubber poison-fills as soon
+    # as the OWNER departs, with the radix cache's reference still live
+    scrub_under_live_reader = {
+        "decode": [
+            PageOp("alloc", "S1"), PageOp("write", "S1"),
+            PageOp("seal", "S1", token="sealed"),
+            PageOp("free", "S1", token="owner_gone",
+                   meta=w(scrub_pending=True)),
+        ],
+        "radix": [
+            PageOp("share", "S1", guard=("sealed",)),
+            PageOp("read", "S1"),
+            PageOp("release", "S1"),
+        ],
+        "scrubber": [
+            # BUG: guarded only on the owner's release, not the LAST
+            PageOp("scrub", "S1", guard=("owner_gone",)),
+        ],
+    }
+
+    # an abort path returns the first page but forgets the growth page
+    leak_on_abort = {
+        "serve": [
+            PageOp("alloc", "L1"), PageOp("alloc", "L2"),
+            PageOp("write", "L1"),
+            PageOp("free", "L1"),     # BUG: L2 never comes home
+        ],
+    }
+
+    # the decode tier seals (and reads) implanted wire bytes without
+    # the stamp verification the handoff plane exists to run
+    adopt_before_stamp_verify = {
+        "decode": [
+            PageOp("alloc", "A1"), PageOp("implant", "A1"),
+            PageOp("seal", "A1"),     # BUG: no verify before the seal
+            PageOp("read", "A1"), PageOp("free", "A1"),
+        ],
+    }
+
+    # more releases than references: a holder releases a page it
+    # already gave up, recycling it under the remaining owner
+    refcount_underflow = {
+        "decode": [
+            PageOp("alloc", "R1"), PageOp("write", "R1"),
+            PageOp("seal", "R1", token="sealed"),
+            PageOp("release", "R1"),
+        ],
+        "radix": [
+            PageOp("share", "R1", guard=("sealed",)),
+            PageOp("release", "R1", token="done"),
+            PageOp("release", "R1", guard=("done",)),   # BUG: twice
+        ],
+    }
+
+    return [
+        ("pagefix/double_free", double_free),
+        ("pagefix/scrub_under_live_reader", scrub_under_live_reader),
+        ("pagefix/leak_on_abort", leak_on_abort),
+        ("pagefix/adopt_before_stamp_verify", adopt_before_stamp_verify),
+        ("pagefix/refcount_underflow", refcount_underflow),
+    ]
+
+
+# page-fixture contract: (check the state machine must report, page id
+# the violation message must name — the transition is asserted by the
+# selftest via the "->" the message format always carries)
+PAGE_EXPECTED = {
+    "pagefix/double_free": ("double_free", "F1"),
+    "pagefix/scrub_under_live_reader": ("scrub_under_live_reader", "S1"),
+    "pagefix/leak_on_abort": ("page_leak", "L2"),
+    "pagefix/adopt_before_stamp_verify": ("adopt_before_stamp_verify",
+                                          "A1"),
+    "pagefix/refcount_underflow": ("refcount_underflow", "R1"),
+}
+
+
+def run_page_selftest() -> list[str]:
+    """Both directions of the page-lifetime pin, mirroring
+    :func:`run_dpor_selftest`: (1) every CLEAN two-tier scenario
+    (``pages.two_tier_scenarios``) verifies quiet across ALL its
+    schedule classes, and (2) every seeded-bad fixture is flagged with
+    the expected check, the page id, and the violating transition
+    named.  Returns failure lines; empty means the pin holds."""
+    from .pages import explore_pages, two_tier_scenarios
+
+    problems = []
+    for name, scenario in two_tier_scenarios():
+        res = explore_pages(name, scenario)
+        if res.violations:
+            problems.append(
+                f"{name}: clean scenario must verify quiet across all "
+                f"{res.schedules} classes, got "
+                f"{[str(v) for v in res.violations]}")
+        if res.pruned:
+            problems.append(
+                f"{name}: exploration was pruned — the clean sweep "
+                f"must be exhaustive")
+    for name, scenario in page_fixture_cases():
+        want_check, page = PAGE_EXPECTED[name]
+        res = explore_pages(name, scenario)
+        hits = [v for v in res.violations if v.check == want_check]
+        if not hits:
+            problems.append(
+                f"{name}: expected a {want_check} violation (explored "
+                f"{res.schedules} classes), got "
+                f"{[v.check for v in res.violations]}")
+            continue
+        if not any(f"page {page}" in v.message for v in hits):
+            problems.append(
+                f"{name}: {want_check} message does not name page "
+                f"{page!r}: {hits[0].message}")
+        elif not any("->" in v.message for v in hits):
+            problems.append(
+                f"{name}: {want_check} message does not name the "
+                f"violating transition: {hits[0].message}")
+    return problems
+
+
 def run_selftest(n: int = 4) -> list[str]:
     """Verify every fixture trips its expected check (and that the flagged
     message names the offending semaphore/chunk).  Returns failure lines;

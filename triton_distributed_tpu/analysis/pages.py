@@ -1,0 +1,946 @@
+"""Page-lifetime ownership model checking for the serving KV pool.
+
+The analysis plane proves device-side collective protocols
+schedule-exhaustively (``analysis.checks`` + the ``analysis.explore``
+DPOR explorer), but the HOST-side page protocol — the paged KV pool
+crossed by two tiers, handoff, preemption, eviction, scrub and audit —
+was guarded only by dynamic "zero leaked pages" assertions in the fault
+matrix, which witness ONE interleaving per seed.  "Demystifying
+NVSHMEM" (PAPERS.md) shows the order-dependent slot-reuse/ABA hazard
+class is exactly what single-schedule testing provably misses; this
+module closes that gap for pages the same way PR 2/PR 14 closed it for
+semaphores.
+
+Three layers:
+
+1. **Record mode** — :func:`record` arms a :class:`PageRecorder` via
+   ``serve.budget.set_lifecycle_recorder``; every page operation at its
+   real call site (``PagePool`` alloc/share/release/free/scrub, the
+   scheduler's prefill-write / decode-append / audit-stamp /
+   restore-verify / colocate-retain, ``serve.handoff``'s extract and
+   the adopt-side implant) funnels through ``budget.page_event`` into
+   one per-actor event stream.  Unarmed, the call sites pay a single
+   module-global load.
+
+2. **Ownership state machine** — :func:`check_events` walks a stream
+   and tracks each page through
+
+   ``FREE -> RESERVED -> FILLING -> STAMPED -> READABLE ->
+   {SHARED, IN_FLIGHT, SCRUB_PENDING} -> FREE``
+
+   (SHARED is the refcount>1 face of a sealed page, not a stored
+   state), flagging leak-on-terminal-path, use-after-free,
+   read-before-stamp, double-free/alloc, refcount underflow,
+   write-under-share, adopt-before-stamp-verify, ABA reuse-before-
+   scrub, and scrub-under-live-reader — each violation names the page
+   id and the violating transition.
+
+3. **Schedule exhaustion** — :func:`explore_pages` mirrors the PR-14
+   DPOR reduction stack (sleep sets, singleton persistent sets via
+   eager advancement, optional preemption bound, resource caps ->
+   ``pruned``) over per-actor :class:`PageOp` scenarios: page-footprint
+   overlap is the dependence relation and guard tokens encode the
+   happens-before edges reality enforces (the router only extracts a
+   PARKED handoff; release waits for adoption).  Every complete
+   schedule class runs the full state machine, so an order-dependent
+   lifecycle race is caught exhaustively, not per-seed.
+
+Wired as ``tdt_lint --pages`` (fixture selftest + fault-matrix static
+replay + the DPOR sweep over :func:`two_tier_scenarios`), the opt-in
+``TDT_VERIFY_PAGES=1`` gate on ``serve.trace.replay``, and the
+``page_lifecycle_checks`` / ``page_lifecycle_violations`` obs
+counters.  The refcounted ``PagePool.share``/``release`` substrate this
+module certifies is the exact primitive the radix prefix cache
+(ROADMAP item 3) needs — shipped here verified-before-used.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+
+from .checks import ProtocolViolationError, Violation
+
+# ---------------------------------------------------------------------------
+# events + recorder
+
+#: ops the state machine understands (call sites emit these via
+#: ``serve.budget.page_event``)
+OPS = frozenset({
+    "alloc", "write", "implant", "seal", "stamp", "verify", "read",
+    "share", "release", "free", "scrub", "extract", "retain",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class PageEvent:
+    """One page operation: ``actor`` (tier / pump / audit), ``op``
+    (member of :data:`OPS`), ``key`` (a hashable page identity —
+    ``(pool_idx, page_id)`` for recorded pools, a plain string in
+    synthetic scenarios) and frozen ``meta`` pairs."""
+
+    actor: str
+    op: str
+    key: object
+    meta: tuple = ()
+
+    def get(self, name, default=None):
+        for k, v in self.meta:
+            if k == name:
+                return v
+        return default
+
+
+class PageRecorder:
+    """Accumulates :class:`PageEvent` streams from the live call sites.
+
+    Pools are keyed by identity (two tiers legitimately use the same
+    physical page ids); an actor defaults to the owning scheduler's
+    ``trace_tier`` so recorded traces read ``prefill``/``decode``
+    exactly like request traces do.  Thread-safe: the straggler
+    watchdog's abandoned dispatches and the pool's own lock discipline
+    mean emits can arrive from more than one thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events: list[PageEvent] = []
+        self._pools: dict[int, tuple[int, object]] = {}
+
+    def _pool_idx(self, pool) -> int:
+        if pool is None:
+            return 0
+        ent = self._pools.get(id(pool))
+        if ent is None:
+            ent = (len(self._pools) + 1, pool)
+            self._pools[id(pool)] = ent
+        return ent[0]
+
+    def pool_name(self, idx: int) -> str:
+        for i, pool in self._pools.values():
+            if i == idx:
+                tier = getattr(getattr(pool, "owner", None),
+                               "trace_tier", None)
+                return tier if tier else f"pool{idx}"
+        return "pool" if idx == 0 else f"pool{idx}"
+
+    def emit(self, op: str, pages, *, pool=None, actor=None,
+             **meta) -> None:
+        if isinstance(pages, int):
+            pages = (pages,)
+        frozen = tuple(sorted(meta.items()))
+        with self._lock:
+            idx = self._pool_idx(pool)
+            if actor is None:
+                actor = getattr(getattr(pool, "owner", None),
+                                "trace_tier", None) or "pool"
+            for p in pages:
+                self.events.append(
+                    PageEvent(str(actor), op, (idx, int(p)), frozen))
+
+    def page_label(self, key) -> str:
+        if isinstance(key, tuple) and len(key) == 2:
+            return f"{key[1]} ({self.pool_name(key[0])} pool)"
+        return str(key)
+
+    def __len__(self):
+        return len(self.events)
+
+
+@contextlib.contextmanager
+def record():
+    """Arm a fresh :class:`PageRecorder` on ``serve.budget`` for the
+    duration of the block (restoring whatever was armed before)."""
+    from ..serve import budget
+
+    rec = PageRecorder()
+    prev = budget.set_lifecycle_recorder(rec)
+    try:
+        yield rec
+    finally:
+        budget.set_lifecycle_recorder(prev)
+
+
+# ---------------------------------------------------------------------------
+# the ownership state machine
+
+FREE = "FREE"
+RESERVED = "RESERVED"
+FILLING = "FILLING"
+STAMPED = "STAMPED"
+READABLE = "READABLE"
+IN_FLIGHT = "IN_FLIGHT"
+SCRUB_PENDING = "SCRUB_PENDING"
+
+#: readable states a ``read`` is legal in (FILLING included: decode
+#: legitimately attends over the partially-filled tail page)
+_READ_OK = frozenset({FILLING, STAMPED, READABLE, IN_FLIGHT})
+_DEAD = frozenset({FREE, SCRUB_PENDING})
+
+
+class _Page:
+    __slots__ = ("state", "refs", "adopted", "verified")
+
+    def __init__(self):
+        self.state = FREE
+        self.refs = 0
+        self.adopted = False
+        self.verified = False
+
+    def face(self) -> str:
+        """The display state: SHARED is the refs>1 face of a sealed
+        page, derived rather than stored so share/release never lose
+        the underlying STAMPED/READABLE."""
+        if self.refs > 1 and self.state not in _DEAD:
+            return "SHARED"
+        return self.state
+
+
+class _Machine:
+    """One pass of the ownership state machine over an event stream."""
+
+    def __init__(self, label: str, page_label=None):
+        self.label = label
+        self.page_label = page_label or str
+        self.pages: dict[object, _Page] = {}
+        self.violations: list[Violation] = []
+
+    # -- helpers ------------------------------------------------------------
+
+    def _flag(self, check: str, ev: PageEvent, pg: _Page,
+              why: str) -> None:
+        self.violations.append(Violation(
+            check, self.label, 0,
+            f"page {self.page_label(ev.key)}: illegal transition "
+            f"{pg.face()}->{ev.op} by actor {ev.actor} — {why}"))
+
+    def _page(self, key) -> _Page:
+        pg = self.pages.get(key)
+        if pg is None:
+            pg = self.pages[key] = _Page()
+        return pg
+
+    # -- the transition table ----------------------------------------------
+
+    def step(self, ev: PageEvent) -> None:
+        pg = self._page(ev.key)
+        op = ev.op
+        if op == "alloc":
+            if pg.state == SCRUB_PENDING:
+                self._flag(
+                    "reuse_before_scrub", ev, pg,
+                    "re-allocated before the pending poison-fill "
+                    "landed — the ABA window where the new tenant can "
+                    "read the previous tenant's bytes OR the late "
+                    "scrub can poison the new tenant's writes")
+            elif pg.state != FREE:
+                self._flag(
+                    "double_alloc", ev, pg,
+                    "allocated while live — two sequences would share "
+                    "it and corrupt each other's KV")
+            pg.state, pg.refs = RESERVED, 1
+            pg.adopted = pg.verified = False
+        elif op in ("write", "implant"):
+            if pg.state in _DEAD:
+                self._flag("use_after_free", ev, pg,
+                           "write lands in recycled (or scrub-pending) "
+                           "storage")
+                return
+            if pg.refs > 1:
+                self._flag(
+                    "write_under_share", ev, pg,
+                    "a shared page must be copied before mutation "
+                    "(copy-on-write) — every other reference sees the "
+                    "edit")
+                return
+            if pg.state == STAMPED and op == "write":
+                self._flag(
+                    "write_after_stamp", ev, pg,
+                    "stamped bytes may not change — the next audit "
+                    "fold would quarantine a legal write as "
+                    "corruption")
+                return
+            if pg.state == IN_FLIGHT:
+                self._flag(
+                    "write_in_flight", ev, pg,
+                    "the extracted payload and the pool bytes would "
+                    "diverge mid-transfer")
+                return
+            if op == "implant":
+                pg.adopted, pg.verified = True, False
+            pg.state = FILLING if pg.state in (
+                RESERVED, FILLING) else pg.state
+        elif op == "seal":
+            if pg.state in _DEAD:
+                self._flag("use_after_free", ev, pg,
+                           "sealing recycled storage")
+                return
+            if pg.adopted and not pg.verified:
+                self._flag(
+                    "adopt_before_stamp_verify", ev, pg,
+                    "an implanted page must pass stamp verification "
+                    "before it is declared readable — adopting "
+                    "unverified wire bytes is how a corrupt transfer "
+                    "becomes silent KV corruption")
+            if pg.state == IN_FLIGHT:
+                self._flag("seal_in_flight", ev, pg,
+                           "cannot seal mid-transfer")
+                return
+            pg.state = READABLE if pg.state != STAMPED else STAMPED
+        elif op == "stamp":
+            if pg.state in _DEAD:
+                self._flag("use_after_free", ev, pg,
+                           "stamping recycled storage")
+                return
+            if pg.state == RESERVED:
+                self._flag(
+                    "stamp_unwritten", ev, pg,
+                    "folding a never-written page pins garbage as the "
+                    "golden stamp")
+                return
+            # audit may re-fold a page parked IN_FLIGHT (HANDOFF slots
+            # stay in slots[] until released) — state unchanged there
+            if pg.state in (FILLING, READABLE):
+                pg.state = STAMPED
+        elif op == "verify":
+            if pg.state in _DEAD:
+                self._flag("use_after_free", ev, pg,
+                           "verifying recycled storage")
+                return
+            pg.verified = True
+        elif op == "read":
+            if pg.state in _DEAD:
+                self._flag("use_after_free", ev, pg,
+                           "attention would read recycled (or poison-"
+                           "filled) KV")
+                return
+            if pg.state == RESERVED:
+                self._flag(
+                    "read_before_stamp", ev, pg,
+                    "reading a reserved, never-written page returns "
+                    "whatever the previous tenant left")
+                return
+            if pg.adopted and not pg.verified:
+                self._flag(
+                    "adopt_before_stamp_verify", ev, pg,
+                    "reading implanted wire bytes before stamp "
+                    "verification")
+        elif op == "share":
+            if pg.state in _DEAD or pg.refs == 0:
+                self._flag("use_after_free", ev, pg,
+                           "a reference to recycled storage reads the "
+                           "next tenant's KV")
+                return
+            if pg.state in (RESERVED, FILLING):
+                self._flag(
+                    "share_unsealed", ev, pg,
+                    "only sealed content may be shared — a prefix "
+                    "cache handing out a still-filling page serves a "
+                    "torn read")
+                return
+            if pg.state == IN_FLIGHT:
+                self._flag("share_in_flight", ev, pg,
+                           "cannot take references mid-transfer")
+                return
+            pg.refs += 1
+        elif op in ("free", "release"):
+            if pg.refs == 0:
+                if op == "release":
+                    self._flag(
+                        "refcount_underflow", ev, pg,
+                        "more releases than references — some earlier "
+                        "release already recycled the page under a "
+                        "holder that still believes it owns one")
+                else:
+                    self._flag(
+                        "double_free", ev, pg,
+                        "two sequences would share it and corrupt "
+                        "each other's KV")
+                return
+            pg.refs -= 1
+            if pg.refs == 0:
+                pg.state = SCRUB_PENDING if ev.get("scrub_pending") \
+                    else FREE
+                pg.adopted = pg.verified = False
+        elif op == "scrub":
+            if pg.refs > 0:
+                self._flag(
+                    "scrub_under_live_reader", ev, pg,
+                    f"poison-fill with {pg.refs} live reference(s) — "
+                    f"the reader's next attention step returns the "
+                    f"poison pattern")
+                return
+            pg.state = FREE
+        elif op == "extract":
+            if pg.state in _DEAD:
+                self._flag("use_after_free", ev, pg,
+                           "extracting recycled storage ships garbage")
+                return
+            if pg.state in (RESERVED, FILLING):
+                self._flag(
+                    "extract_unsealed", ev, pg,
+                    "the handoff payload must cover sealed content — "
+                    "extracting mid-fill ships a torn prefix")
+                return
+            if pg.state in (STAMPED, READABLE):
+                pg.state = IN_FLIGHT
+        elif op == "retain":
+            if pg.state in _DEAD:
+                self._flag("use_after_free", ev, pg,
+                           "colocating onto recycled storage")
+                return
+            if pg.state == IN_FLIGHT:
+                pg.state = READABLE
+        else:   # pragma: no cover - call sites only emit OPS members
+            raise ValueError(f"unknown page op {op!r}")
+
+    def finish(self) -> None:
+        """Terminal-path leak check: every page must be back to FREE
+        (SCRUB_PENDING counts — the free committed, only the poison
+        fill is outstanding) with zero references."""
+        for key in sorted(self.pages, key=str):
+            pg = self.pages[key]
+            if pg.state not in _DEAD or pg.refs > 0:
+                self.violations.append(Violation(
+                    "page_leak", self.label, 0,
+                    f"page {self.page_label(key)}: still {pg.face()} "
+                    f"with {pg.refs} reference(s) at end of trace — a "
+                    f"terminal path (complete/abort/shed/preempt/"
+                    f"re-prefill/drain) failed to return it (missing "
+                    f"{pg.face()}->free)"))
+
+
+def check_events(events, *, label: str = "pages",
+                 page_label=None) -> list[Violation]:
+    """Run the ownership state machine over one merged event stream;
+    returns the violations (empty = leak-free and lifetime-safe).
+    Bumps the ``page_lifecycle_checks`` / ``page_lifecycle_violations``
+    counters when observability is on."""
+    m = _Machine(label, page_label)
+    for ev in events:
+        m.step(ev)
+    m.finish()
+    from .. import obs
+
+    if obs.enabled():
+        obs.counter("page_lifecycle_checks").inc()
+        if m.violations:
+            obs.counter("page_lifecycle_violations").inc(
+                len(m.violations))
+    return m.violations
+
+
+def check_recorder(rec: PageRecorder, *,
+                   label: str = "pages") -> list[Violation]:
+    """:func:`check_events` over a live recording, with page labels
+    resolved through the recorder's pool table (``3 (prefill pool)``)."""
+    return check_events(rec.events, label=label,
+                        page_label=rec.page_label)
+
+
+# ---------------------------------------------------------------------------
+# the TDT_VERIFY_PAGES gate
+
+
+def verify_pages_enabled() -> bool:
+    """``TDT_VERIFY_PAGES=1``: serve-trace replays record every page
+    op and raise :class:`ProtocolViolationError` on any lifecycle
+    violation (docs/static_analysis.md flag matrix)."""
+    from ..core.utils import env_flag
+
+    return env_flag("TDT_VERIFY_PAGES")
+
+
+@contextlib.contextmanager
+def maybe_record(label: str = "serve_replay"):
+    """The replay hook: arm + check when ``TDT_VERIFY_PAGES=1`` (and
+    no outer recorder is already armed), a no-op otherwise.  Raises on
+    violations only when the guarded block exits cleanly — a replay
+    that already raised keeps its own error."""
+    from ..serve import budget
+
+    if not verify_pages_enabled() \
+            or budget.lifecycle_recorder() is not None:
+        yield None
+        return
+    with record() as rec:
+        yield rec
+    vs = check_recorder(rec, label=label)
+    if vs:
+        raise ProtocolViolationError(vs)
+
+
+# ---------------------------------------------------------------------------
+# the page-footprint DPOR explorer
+
+
+@dataclasses.dataclass(frozen=True)
+class PageOp:
+    """One static scenario event.  ``guard``: tokens that must ALL be
+    produced before this op is enabled (the happens-before edges
+    reality enforces — e.g. the router only extracts a PARKED
+    handoff); ``token``: produced when the op executes.  ``meta``:
+    frozen ``(k, v)`` pairs forwarded to the state machine (e.g.
+    ``(("scrub_pending", True),)``)."""
+
+    op: str
+    page: object
+    guard: tuple = ()
+    token: str | None = None
+    meta: tuple = ()
+
+
+@dataclasses.dataclass
+class PageExploreResult:
+    name: str
+    actors: tuple
+    schedules: int                 # complete equivalence classes
+    violations: list[Violation]
+    pruned: bool = False
+    preemption_bound: int | None = None
+    witness: str | None = None     # schedule label of first violation
+
+
+DEFAULT_MAX_SCHEDULES = 2048
+DEFAULT_BUDGET_MS = 2_000.0
+
+
+class _PageExplorer:
+    """The PR-14 reduction stack over per-actor PageOp traces: sleep
+    sets, singleton persistent sets via eager advancement of
+    non-branching events, optional preemption bound, resource caps ->
+    ``pruned``.  Dependence is page-footprint overlap; guard tokens
+    never make co-enabled events dependent (tokens are produced, never
+    consumed, so an enabled op stays enabled)."""
+
+    def __init__(self, name, scenario, *, preemption_bound,
+                 max_schedules, budget_ms, stop_on_violation):
+        self.name = name
+        self.actors = tuple(scenario)
+        self.traces = [tuple(scenario[a]) for a in self.actors]
+        self.n = len(self.actors)
+        self.bound = preemption_bound
+        self.max_schedules = max_schedules
+        self.deadline = None if budget_ms is None else \
+            time.monotonic() + budget_ms / 1e3
+        self.stop_on_violation = stop_on_violation
+        self.pcs = [0] * self.n
+        self.produced: set[str] = set()
+        self.schedule: list[int] = []
+        self.schedules = 0
+        self.pruned = False
+        self.violations: list[Violation] = []
+        self._seen: set[tuple[str, str]] = set()
+        self.witness: str | None = None
+
+    # -- state --------------------------------------------------------------
+
+    def next_op(self, i: int) -> PageOp | None:
+        t = self.traces[i]
+        return t[self.pcs[i]] if self.pcs[i] < len(t) else None
+
+    def enabled(self, i: int) -> bool:
+        op = self.next_op(i)
+        return op is not None and all(
+            g in self.produced for g in op.guard)
+
+    def execute(self, i: int):
+        op = self.traces[i][self.pcs[i]]
+        self.pcs[i] += 1
+        self.schedule.append(i)
+        new_token = op.token is not None and op.token not in self.produced
+        if new_token:
+            self.produced.add(op.token)
+        return (i, op.token if new_token else None)
+
+    def undo(self, undo) -> None:
+        i, token = undo
+        self.pcs[i] -= 1
+        self.schedule.pop()
+        if token is not None:
+            self.produced.discard(token)
+
+    def done(self) -> bool:
+        return all(self.pcs[i] >= len(self.traces[i])
+                   for i in range(self.n))
+
+    # -- dependence ---------------------------------------------------------
+
+    def _independent(self, a: int, b: int) -> bool:
+        """Co-enabled branch choices commute iff their page footprints
+        are disjoint (token production only ever ENABLES more — it
+        cannot disable, so it is not a dependence between co-enabled
+        ops)."""
+        oa, ob = self.next_op(a), self.next_op(b)
+        if oa is None or ob is None:
+            return True
+        return oa.page != ob.page
+
+    def branches(self, i: int) -> bool:
+        """Is actor ``i``'s next op a branch point?  Conservative:
+        it branches if ANY other actor still has an op on the same
+        page anywhere in its remaining trace — a conflicting op that
+        is merely not-yet-enabled can become enabled after other
+        steps, so only pages no one else will ever touch again are
+        safe to advance eagerly (singleton persistent set)."""
+        oi = self.next_op(i)
+        if oi is None:
+            return False
+        for j in range(self.n):
+            if j == i:
+                continue
+            t = self.traces[j]
+            if any(t[k].page == oi.page
+                   for k in range(self.pcs[j], len(t))):
+                return True
+        return False
+
+    # -- per-class check ----------------------------------------------------
+
+    def _label(self, cap: int = 48) -> str:
+        runs: list[list[int]] = []
+        for r in self.schedule:
+            if runs and runs[-1][0] == r:
+                runs[-1][1] += 1
+            else:
+                runs.append([r, 1])
+        parts = [self.actors[r] if k == 1 else f"{self.actors[r]}*{k}"
+                 for r, k in runs]
+        if len(parts) > cap:
+            parts = parts[:cap] + ["..."]
+        return " ".join(parts)
+
+    def _record(self, v: Violation, sched: str) -> None:
+        key = (v.check, v.message)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.violations.append(Violation(
+            v.check, v.kernel, v.ranks,
+            f"under schedule [{sched}]: {v.message}"))
+        if self.witness is None:
+            self.witness = sched
+
+    def _check_complete(self) -> None:
+        self.schedules += 1
+        sched = self._label()
+        events = []
+        pcs = [0] * self.n
+        for r in self.schedule:
+            op = self.traces[r][pcs[r]]
+            pcs[r] += 1
+            events.append(PageEvent(self.actors[r], op.op, op.page,
+                                    op.meta))
+        for v in check_events(events, label=self.name):
+            self._record(v, sched)
+
+    def _deadlock(self) -> None:
+        self.schedules += 1
+        sched = self._label()
+        blocked = []
+        for i in range(self.n):
+            op = self.next_op(i)
+            if op is not None:
+                missing = [g for g in op.guard
+                           if g not in self.produced]
+                blocked.append(
+                    f"{self.actors[i]} stuck at {op.op}({op.page}) "
+                    f"awaiting {missing}")
+        self._record(Violation(
+            "deadlock", self.name, 0,
+            "no actor can advance (guard tokens never produced): "
+            + "; ".join(blocked)), sched)
+
+    # -- search -------------------------------------------------------------
+
+    def _stop(self) -> bool:
+        if self.stop_on_violation and self.violations:
+            return True
+        if self.schedules >= self.max_schedules or (
+                self.deadline is not None
+                and time.monotonic() > self.deadline):
+            self.pruned = True
+            return True
+        return False
+
+    def run(self) -> None:
+        self._explore(frozenset(), None, 0)
+
+    def _advance_eager(self, sleep: frozenset) -> list:
+        undos = []
+        progress = True
+        while progress:
+            progress = False
+            for i in range(self.n):
+                if i in sleep:
+                    continue
+                while self.enabled(i) and not self.branches(i):
+                    undos.append(self.execute(i))
+                    progress = True
+        return undos
+
+    def _explore(self, sleep: frozenset, last, preemptions) -> None:
+        if self._stop():
+            return
+        undos = self._advance_eager(sleep)
+        try:
+            enabled = [i for i in range(self.n) if self.enabled(i)]
+            live = [i for i in enabled if i not in sleep]
+            if not enabled:
+                if self.done():
+                    self._check_complete()
+                elif not sleep:
+                    self._deadlock()
+                # else: a slept sibling covers this continuation
+                return
+            if not live:
+                return
+            if self.bound is not None and preemptions >= self.bound \
+                    and last is not None and last in live:
+                live = [last]
+            done: list[int] = []
+            for i in live:
+                if self._stop():
+                    return
+                cost = preemptions
+                if last is not None and i != last \
+                        and self.enabled(last):
+                    cost += 1
+                    if self.bound is not None and cost > self.bound:
+                        continue
+                child_sleep = frozenset(
+                    u for u in (*sleep, *done)
+                    if self.enabled(u) and self._independent(u, i))
+                undo = self.execute(i)
+                self._explore(child_sleep, i, cost)
+                self.undo(undo)
+                done.append(i)
+        finally:
+            for u in reversed(undos):
+                self.undo(u)
+
+
+def explore_pages(name: str, scenario: dict, *,
+                  preemption_bound: int | None = None,
+                  max_schedules: int = DEFAULT_MAX_SCHEDULES,
+                  budget_ms: float | None = DEFAULT_BUDGET_MS,
+                  stop_on_violation: bool = False) -> PageExploreResult:
+    """Explore all schedule classes of ``scenario`` (actor name ->
+    list of :class:`PageOp`) and run the ownership state machine on
+    every complete class.  ``preemption_bound=None`` is the exact
+    mode — scenario traces are short enough that the sweep defaults to
+    it, unlike the semaphore explorer."""
+    ex = _PageExplorer(name, scenario,
+                       preemption_bound=preemption_bound,
+                       max_schedules=max_schedules,
+                       budget_ms=budget_ms,
+                       stop_on_violation=stop_on_violation)
+    ex.run()
+    return PageExploreResult(name, ex.actors, ex.schedules,
+                             ex.violations, pruned=ex.pruned,
+                             preemption_bound=preemption_bound,
+                             witness=ex.witness)
+
+
+# ---------------------------------------------------------------------------
+# the clean two-tier scenarios (the sweep `tdt_lint --pages` walks)
+
+
+def two_tier_scenarios() -> list[tuple[str, dict]]:
+    """The router-pump x prefill-tier x decode-tier x audit-cadence
+    interleaving, modeled per terminal path.  Guard tokens encode
+    exactly the happens-before edges the protocol enforces (extract
+    only after parked, release only after adoption, scrub only after
+    the LAST release); everything else — audit cadence against the
+    other tier's progress, decode stepping against the pump — is left
+    free for the explorer to permute.  All must verify clean; the
+    seeded-bad twins live in ``fixtures.page_fixture_cases``."""
+    P, D = "P1", "D1"    # prefill-pool / decode-pool page ids
+    w = lambda **kw: tuple(sorted(kw.items()))
+
+    handoff_clean = {
+        "prefill": [
+            PageOp("alloc", P), PageOp("write", P),
+            PageOp("seal", P, token="parked"),
+        ],
+        "audit": [
+            # audit cadence: the re-fold + re-read race the pump and
+            # the decode tier freely, INCLUDING mid-transfer (HANDOFF
+            # slots stay in slots[] until released) — but the release
+            # waits for the tick, because audit and release share the
+            # prefill scheduler's single thread and audit only ever
+            # touches slots still present
+            PageOp("stamp", P, guard=("parked",)),
+            PageOp("read", P, guard=("parked",), token="audited"),
+        ],
+        "router": [
+            PageOp("extract", P, guard=("parked",), token="shipped"),
+            PageOp("free", P, guard=("adopted", "audited"),
+                   meta=w(scrub_pending=True)),
+            PageOp("scrub", P),
+        ],
+        "decode": [
+            PageOp("alloc", D, guard=("shipped",)),
+            PageOp("implant", D), PageOp("verify", D),
+            PageOp("seal", D, token="adopted"),
+            PageOp("read", D), PageOp("write", D), PageOp("seal", D),
+            PageOp("free", D, meta=w(scrub_pending=True)),
+            PageOp("scrub", D),
+        ],
+    }
+
+    reprefill_drop = {
+        # transfer ladder exhausted (TRANSFER_DROP / open breaker):
+        # producer pages come home from IN_FLIGHT, the decode tier
+        # recomputes from the prompt with carried stamps
+        "prefill": [
+            PageOp("alloc", P), PageOp("write", P),
+            PageOp("seal", P, token="parked"),
+        ],
+        "router": [
+            PageOp("extract", P, guard=("parked",)),
+            PageOp("free", P, token="reprefilled",
+                   meta=w(scrub_pending=True)),
+            PageOp("scrub", P),
+        ],
+        "decode": [
+            PageOp("alloc", D, guard=("reprefilled",)),
+            PageOp("write", D), PageOp("verify", D),
+            PageOp("seal", D), PageOp("read", D),
+            PageOp("free", D, meta=w(scrub_pending=True)),
+            PageOp("scrub", D),
+        ],
+    }
+
+    preempt_restore = {
+        # preemption returns pages mid-decode; the restore re-allocs
+        # (possibly the SAME id — the ABA shape the scrub ordering
+        # must survive) and re-verifies against carried stamps
+        "serve": [
+            PageOp("alloc", P), PageOp("write", P), PageOp("seal", P),
+            PageOp("stamp", P, token="stamped"), PageOp("read", P),
+            # preempt frees only after the audit tick — audit and the
+            # scheduling loop share one thread, so audit never holds a
+            # reference across a free
+            PageOp("free", P, guard=("audited",),
+                   meta=w(scrub_pending=True)),
+            PageOp("scrub", P),
+            # restore: the pool's free-list commit + same-thread
+            # scrubber put the scrub strictly before any re-alloc of
+            # the same id (program order above); the fixtures' ABA
+            # seed is exactly this ordering dropped
+            PageOp("alloc", P),
+            PageOp("write", P), PageOp("verify", P), PageOp("seal", P),
+            PageOp("read", P),
+            PageOp("free", P, meta=w(scrub_pending=True)),
+            PageOp("scrub", P),
+        ],
+        "audit": [
+            # the audit re-fold floats between the stamp and the
+            # preempt — the explorer permutes it against the owner's
+            # read
+            PageOp("read", P, guard=("stamped",), token="audited"),
+        ],
+    }
+
+    colocate_drain = {
+        # decode tier saturated: the request finishes decode on the
+        # prefill tier, where its pages already live (retain from
+        # park, never extracted)
+        "prefill": [
+            PageOp("alloc", P), PageOp("write", P),
+            PageOp("seal", P, token="parked"),
+        ],
+        "router": [
+            PageOp("retain", P, guard=("parked",), token="colocated"),
+        ],
+        "serve": [
+            PageOp("read", P, guard=("colocated",)),
+            PageOp("write", P), PageOp("seal", P),
+            PageOp("free", P, guard=("colocated",),
+                   meta=w(scrub_pending=True)),
+            PageOp("scrub", P),
+        ],
+    }
+
+    shared_release = {
+        # the refcount substrate (radix prefix cache): owner seals, a
+        # sharer takes a reference, BOTH release — whichever order the
+        # explorer picks, the scrub must come only after the LAST
+        # release.  The owner's free is guarded on the share having
+        # happened (references are taken synchronously during the
+        # owner's lifetime); the scrub waits on both release tokens —
+        # exactly what PagePool's refcounts enforce structurally.
+        "decode": [
+            PageOp("alloc", D), PageOp("write", D),
+            PageOp("seal", D, token="sealed"),
+            PageOp("read", D),
+            PageOp("free", D, guard=("cached",),
+                   token="owner_released"),
+        ],
+        "radix": [
+            PageOp("share", D, guard=("sealed",), token="cached"),
+            PageOp("read", D),
+            PageOp("release", D, token="cache_released",
+                   meta=w(scrub_pending=True)),
+        ],
+        "scrubber": [
+            PageOp("scrub", D,
+                   guard=("owner_released", "cache_released")),
+        ],
+    }
+
+    return [
+        ("pages/handoff_clean", handoff_clean),
+        ("pages/reprefill_drop", reprefill_drop),
+        ("pages/preempt_restore", preempt_restore),
+        ("pages/colocate_drain", colocate_drain),
+        ("pages/shared_release", shared_release),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# lifecycle coverage (the completeness golden reads this)
+
+#: every RequestState member and every HandoffFault class -> the
+#: harness that discharges its page-lifetime claim statically.
+#: ``analysis.completeness`` diffs this against the live enums BOTH
+#: ways, so adding a state or a fault class without lifecycle coverage
+#: fails the lint.
+LIFECYCLE_COVERAGE = {
+    "request_states": {
+        "QUEUED": "matrix:scheduler (admission holds no pages; the "
+                  "pop_if race-path alloc/free is recorded)",
+        "PREFILL": "matrix:scheduler + scenario pages/handoff_clean",
+        "DECODE": "matrix:scheduler + scenario pages/handoff_clean",
+        "HANDOFF": "matrix:handoff + scenarios pages/handoff_clean, "
+                   "pages/colocate_drain",
+        "PREEMPTED": "matrix:scheduler preempt cells + scenario "
+                     "pages/preempt_restore",
+        "DONE": "every matrix cell drains to DONE; terminal leak "
+                "check on all recorded replays",
+        "FAILED": "matrix:scheduler poison cells (fail_slot frees; "
+                  "fixture pagefix/leak_on_abort pins the omission)",
+        "SHED": "matrix:scheduler shed cells (shed before alloc / "
+                "release on shed both recorded)",
+    },
+    "handoff_faults": {
+        "transfer_drop": "matrix:handoff drop cell + scenario "
+                         "pages/reprefill_drop (producer pages freed "
+                         "from IN_FLIGHT on the exhausted ladder)",
+        "corrupt_page_in_flight": "matrix:handoff corrupt cell (clean "
+                                  "retry re-extracts; stamp-verify "
+                                  "before the adopted seal)",
+        "stale_stamp": "matrix:handoff stale cell (same retry path — "
+                       "a stale sidecar is a corrupt payload to the "
+                       "verify step)",
+        "prefill_rank_abort": "matrix:handoff abort cell + scenario "
+                              "pages/reprefill_drop (aborted "
+                              "producer's pages freed, victim "
+                              "re-prefills on the decode tier)",
+        "decode_saturated": "matrix:handoff saturation cell + "
+                            "scenario pages/colocate_drain (colocated "
+                            "slot retains IN_FLIGHT pages in place)",
+    },
+}

@@ -234,6 +234,22 @@ class _SchedInjector:
             time.sleep(self.delay_s)
 
 
+def _lifecycle_summary(rec) -> dict:
+    """Discharge a matrix cell's "zero leaked pages" claim STATICALLY:
+    run the recorded page trace through the ``analysis.pages`` ownership
+    state machine and fold the verdict into the row.  A cell whose
+    replay freed everything dynamically but whose TRACE shows a
+    use-after-free / read-before-stamp / scrub-under-reader (or shows
+    zero events — interception unwired) still fails its verify."""
+    from ..analysis.pages import check_recorder
+
+    return {
+        "lifecycle_events": len(rec.events),
+        "lifecycle_violations": [
+            str(v) for v in check_recorder(rec, label="matrix")],
+    }
+
+
 def _sched_cell(kind: FaultKind, leg: str, rng) -> dict:
     """One scheduler matrix cell: seeded 12-request load on 3 slots
     over a 24-page pool, fault injected at a sampled decode step."""
@@ -267,8 +283,11 @@ def _sched_cell(kind: FaultKind, leg: str, rng) -> dict:
         # unbounded and the straggle absorbed)
         arrivals[0].request.deadline_ms = deadline_ms
         arrivals[0].request.max_new_tokens = 24
+    from ..analysis import pages as _pages
+
     t0 = _time.monotonic()
-    report = replay(sched, arrivals, max_steps=4000)
+    with _pages.record() as rec:
+        report = replay(sched, arrivals, max_steps=4000)
     if kind is FaultKind.STRAGGLER and leg == "overrun":
         # the watchdog ABANDONED the straggling dispatch thread (by
         # design); let it wake from its sleep and finish its discarded
@@ -286,6 +305,7 @@ def _sched_cell(kind: FaultKind, leg: str, rng) -> dict:
         "pages_leaked": report.leaked_pages,
         "drain_monotone": report.drain_monotone,
         "wall_s": round(_time.monotonic() - t0, 3),
+        **_lifecycle_summary(rec),
     }
     problems = report.problems()
     victims = report.failed
@@ -332,6 +352,8 @@ def _sched_poison_cell(rng) -> dict:
         Request, RequestState, Scheduler, SchedulerConfig, SimBackend,
     )
 
+    from ..analysis import pages as _pages
+
     prev = integrity._ENABLED
     integrity.enable(True)
     try:
@@ -349,23 +371,25 @@ def _sched_poison_cell(rng) -> dict:
         fired = False
         victim = None
         page = None
-        for _ in range(400):
-            res = sched.step()
-            if not fired:
-                cand = next(
-                    (s for s in sched.slots
-                     if s is not None and s.page_stamps
-                     and s.request.state is RequestState.DECODE), None)
-                if cand is not None:
-                    j = max(cand.page_stamps)
-                    page = int(cand.pages[j])
-                    victim = cand.request
-                    sched.cache = _dc.replace(
-                        sched.cache,
-                        k=sched.cache.k.at[:, page].add(1000.0))
-                    fired = True
-            if res.idle and fired:
-                break
+        with _pages.record() as rec:
+            for _ in range(400):
+                res = sched.step()
+                if not fired:
+                    cand = next(
+                        (s for s in sched.slots
+                         if s is not None and s.page_stamps
+                         and s.request.state is RequestState.DECODE),
+                        None)
+                    if cand is not None:
+                        j = max(cand.page_stamps)
+                        page = int(cand.pages[j])
+                        victim = cand.request
+                        sched.cache = _dc.replace(
+                            sched.cache,
+                            k=sched.cache.k.at[:, page].add(1000.0))
+                        fired = True
+                if res.idle and fired:
+                    break
     finally:
         integrity.enable(prev)
 
@@ -389,6 +413,7 @@ def _sched_poison_cell(rng) -> dict:
         "pages_leaked": leaked,
         "drain_monotone": True,
         "preemptions": sched.preemptions,
+        **_lifecycle_summary(rec),
     }
     if fired and detections and recovered and cohab_ok and not leaked:
         row["outcome"] = "detected"
@@ -488,9 +513,12 @@ def _handoff_cell(kind, rng) -> dict:
                 max_new_tokens=rng.randint(3, 8))
         for _ in range(6)
     ]
+    from ..analysis import pages as _pages
+
     for r in reqs:
         router.submit(r)
-    router.run_until_idle(max_steps=4000)
+    with _pages.record() as rec:
+        router.run_until_idle(max_steps=4000)
     policy.reset_breaker(HANDOFF_OP)
 
     fired = {
@@ -513,6 +541,7 @@ def _handoff_cell(kind, rng) -> dict:
         "pages_leaked": leaked,
         "handoffs": router.handoffs, "colocated": router.colocated,
         "reprefills": router.reprefills, "retries": plane.retries,
+        **_lifecycle_summary(rec),
     }
     named: list[str] = []
     recovered = False
@@ -604,7 +633,23 @@ def verify_handoff_matrix(rows: list[dict]) -> list[str]:
                 f"{row['detail']}")
         if row["outcome"] == "detected" and not row["named"]:
             problems.append(f"{key}: detected but no artifact named")
+        problems.extend(_lifecycle_problems(key, row))
     return problems
+
+
+def _lifecycle_problems(key: str, row: dict) -> list[str]:
+    """The static leg of a cell's verify: the recorded page trace must
+    be non-empty (interception wired) and ownership-clean (the "zero
+    leaked pages" claim discharged by the state machine, not just the
+    free-list counter)."""
+    out = []
+    if row.get("lifecycle_events") == 0:
+        out.append(f"{key}: lifecycle recorder saw zero page events — "
+                   f"the call-site interception is unwired")
+    for v in row.get("lifecycle_violations", []):
+        out.append(f"{key}: page-lifetime violation in the recorded "
+                   f"trace — {v}")
+    return out
 
 
 def run_hier_cells(seed: int = 0) -> list[dict]:
@@ -671,6 +716,7 @@ def verify_scheduler_matrix(rows: list[dict]) -> list[str]:
         if row["outcome"] == "detected" and not row["named"]:
             problems.append(f"{key}: detected but the victim's error "
                             f"names no fault class")
+        problems.extend(_lifecycle_problems(key, row))
     return problems
 
 

@@ -24,7 +24,13 @@ from __future__ import annotations
 
 from ..models.kv_cache import PagePoolExhausted
 from .backends import EngineBackend, SimBackend
-from .budget import SCRAP_PAGE, PagePool, pages_needed, scrub_enabled
+from .budget import (
+    SCRAP_PAGE,
+    PageLifecycleError,
+    PagePool,
+    pages_needed,
+    scrub_enabled,
+)
 from .handoff import (
     HANDOFF_FAULT_KINDS,
     HANDOFF_OP,
@@ -46,7 +52,8 @@ from .trace import Arrival, TraceReport, replay, synthetic_trace
 __all__ = [
     "Arrival", "DisaggRouter", "EngineBackend", "HANDOFF_FAULT_KINDS",
     "HANDOFF_OP", "HandoffConfig", "HandoffFault", "HandoffPlane",
-    "ModeledDCN", "PagePayload", "PagePool", "PagePoolExhausted",
+    "ModeledDCN", "PageLifecycleError", "PagePayload", "PagePool",
+    "PagePoolExhausted",
     "Request", "RequestQueue", "RequestState", "RouterConfig",
     "RouterStepResult", "SCRAP_PAGE", "Scheduler", "SchedulerConfig",
     "SimBackend", "SlotState", "StepResult", "TERMINAL_STATES",

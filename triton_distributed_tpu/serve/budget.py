@@ -25,6 +25,56 @@ from ..models.kv_cache import PagePoolExhausted
 
 SCRAP_PAGE = 0
 
+
+class PageLifecycleError(ValueError):
+    """A page-lifetime protocol breach at the pool boundary — the
+    DYNAMIC twin of what ``analysis.pages`` flags statically: the
+    message carries the page id and the violating transition so a
+    crash and a lint finding read the same vocabulary.
+
+    Subclasses :class:`ValueError` so pre-existing callers catching
+    the old untyped errors keep working.
+    """
+
+    def __init__(self, message: str, *, page: int | None = None,
+                 transition: str | None = None):
+        super().__init__(message)
+        self.page = page
+        self.transition = transition
+
+
+# ---------------------------------------------------------------------------
+# lifecycle record hook (analysis.pages): the checker arms a recorder
+# here and every page-op call site in serve/ funnels through
+# ``page_event`` — one module-global load when unarmed, so the serving
+# hot path pays nothing until TDT_VERIFY_PAGES (or a test/lint) arms it
+
+_LIFECYCLE_RECORDER = None
+
+
+def set_lifecycle_recorder(rec):
+    """Install (or, with None, disarm) the page-lifecycle recorder;
+    returns the previous recorder so callers can restore it."""
+    global _LIFECYCLE_RECORDER
+    prev = _LIFECYCLE_RECORDER
+    _LIFECYCLE_RECORDER = rec
+    return prev
+
+
+def lifecycle_recorder():
+    return _LIFECYCLE_RECORDER
+
+
+def page_event(op: str, pages, *, pool=None, actor=None, **meta) -> None:
+    """Emit one page operation into the armed recorder (no-op when
+    unarmed).  ``pool`` keys the page ids (two tiers legitimately use
+    the same physical ids); ``actor`` defaults to the owning
+    scheduler's ``trace_tier``."""
+    rec = _LIFECYCLE_RECORDER
+    if rec is None:
+        return
+    rec.emit(op, pages, pool=pool, actor=actor, **meta)
+
 # the TDT_SCRUB_PAGES poison values: distinctive constants (exact in
 # every float dtype we pool) a stale read trips on DETERMINISTICALLY —
 # a recycled page's previous-tenant bytes read plausibly (the PR-9
@@ -62,9 +112,18 @@ class PagePool:
     ``alloc`` raises :class:`PagePoolExhausted`; ``try_alloc`` returns
     None — the scheduler uses the latter on its preemption path (an
     exception per probed allocation under sustained pressure would be
-    noise).  Double-free and foreign-page frees raise: a bookkeeping
-    bug here corrupts two sequences' caches silently, which is the one
-    failure mode a robustness PR must never paper over.
+    noise).  Double-free and foreign-page frees raise a typed
+    :class:`PageLifecycleError`: a bookkeeping bug here corrupts two
+    sequences' caches silently, which is the one failure mode a
+    robustness PR must never paper over.
+
+    **Refcounted sharing** (the radix-prefix-cache substrate,
+    ``analysis.pages`` certifies it): ``share`` takes an extra
+    reference on live pages; ``free``/``release`` under refs is a
+    RELEASE (the page stays allocated, nothing is scrubbed); the LAST
+    release returns the page to the free list and only then may the
+    TDT_SCRUB_PAGES scrubber poison-fill it — a shared page is never
+    poison-filled under a live reader.
     """
 
     def __init__(self, total_pages: int, page_size: int, *,
@@ -86,6 +145,14 @@ class PagePool:
         # lowest-id-first for deterministic replay
         self._free = list(range(1, total_pages))
         self._free_set = set(self._free)
+        # page -> live reference count (absent = free); alloc starts a
+        # page at 1, ``share`` increments, ``free``/``release``
+        # decrement — the last release returns the page to the free
+        # list and scrubs
+        self._refs: dict[int, int] = {}
+        # the owning Scheduler (if any) — the lifecycle recorder reads
+        # its ``trace_tier`` to attribute this pool's ops to a tier
+        self.owner = None
 
     @property
     def capacity(self) -> int:
@@ -113,7 +180,11 @@ class PagePool:
                 return None
             pages, self._free = self._free[:n], self._free[n:]
             self._free_set.difference_update(pages)
-            return pages
+            for p in pages:
+                self._refs[p] = 1
+        if pages and _LIFECYCLE_RECORDER is not None:
+            page_event("alloc", pages, pool=self)
+        return pages
 
     def alloc(self, n: int) -> list[int]:
         pages = self.try_alloc(n)
@@ -125,34 +196,91 @@ class PagePool:
             )
         return pages
 
-    def free(self, pages) -> None:
-        pages = list(pages)
+    def share(self, pages) -> None:
+        """Take an extra reference on live pages (the radix-prefix-
+        cache primitive): each page's later ``free``/``release`` calls
+        decrement, and only the LAST one returns it to the free list.
+        Sharing a free page raises — a reference to recycled storage
+        is exactly the stale-read hazard the scrub plane exists for."""
+        pages = [int(p) for p in pages]
         with self._lock:
             for p in pages:
-                p = int(p)
+                if p not in self._refs:
+                    raise PageLifecycleError(
+                        f"share of free page {p} — taking a reference "
+                        f"to recycled storage would read the next "
+                        f"tenant's KV", page=p, transition="FREE->share")
+            for p in pages:
+                self._refs[p] += 1
+        if _LIFECYCLE_RECORDER is not None:
+            page_event("share", pages, pool=self)
+
+    def refcount(self, page: int) -> int:
+        """Live references on ``page`` (0 = free)."""
+        with self._lock:
+            return self._refs.get(int(page), 0)
+
+    def free(self, pages) -> None:
+        """Release one reference per page.  A page with references
+        remaining stays allocated (a RELEASE — nothing is scrubbed);
+        the last release returns it to the free list and only then is
+        the scrubber allowed to poison-fill it, so a shared page is
+        never poison-filled under a live reader."""
+        pages = [int(p) for p in pages]
+        final: list[int] = []
+        released: list[int] = []
+        with self._lock:
+            for p in pages:
                 if p == SCRAP_PAGE or not 0 < p < self.total_pages:
-                    raise ValueError(
+                    raise PageLifecycleError(
                         f"free of page {p} outside the allocatable pool "
-                        f"[1, {self.total_pages})")
-                if p in self._free_set:
-                    raise ValueError(
+                        f"[1, {self.total_pages})", page=p,
+                        transition="free")
+                if p in self._free_set or p not in self._refs:
+                    raise PageLifecycleError(
                         f"double free of page {p} — two sequences would "
-                        f"share it and corrupt each other's KV")
+                        f"share it and corrupt each other's KV", page=p,
+                        transition="FREE->free")
+            for p in pages:
+                self._refs[p] -= 1
+                if self._refs[p] > 0:
+                    released.append(p)
+                    continue
+                del self._refs[p]
                 self._free_set.add(p)
                 self._free.append(p)
+                final.append(p)
             self._free.sort()
+        if _LIFECYCLE_RECORDER is not None:
+            if released:
+                page_event("release", released, pool=self)
+            if final:
+                page_event("free", final, pool=self,
+                           scrub_pending=self.scrubber is not None)
         # outside the lock: the scrubber touches device pools, and the
-        # validation above has already committed the free
-        if self.scrubber is not None:
-            self.scrubber([int(p) for p in pages])
+        # validation above has already committed the free.  Only the
+        # FINAL releases scrub — the refcount IS the scrub refusal
+        if final and self.scrubber is not None:
+            self.scrubber(final)
+            if _LIFECYCLE_RECORDER is not None:
+                page_event("scrub", final, pool=self)
+
+    # the refcount vocabulary the sharing callers (radix prefix cache)
+    # read as acquire/share/release: ``alloc`` acquires fresh pages at
+    # refcount 1, ``acquire``/``share`` take an extra reference, and
+    # ``release``/``free`` drop one (last release scrubs)
+    acquire = share
+    release = free
 
     def snapshot(self) -> dict:
         with self._lock:
             free = len(self._free)
+            shared = sum(r > 1 for r in self._refs.values())
         return {
             "capacity": self.capacity,
             "free_pages": free,
             "used_pages": self.capacity - free,
+            "shared_pages": shared,
             "occupancy": (self.capacity - free) / self.capacity,
             "page_size": self.page_size,
         }

@@ -65,7 +65,7 @@ from ..resilience.errors import (
     PayloadCorruption,
     TimeoutDiagnosis,
 )
-from .budget import pages_needed
+from .budget import lifecycle_recorder, page_event, pages_needed
 
 HANDOFF_OP = "handoff_transfer"
 
@@ -172,18 +172,23 @@ def _page_stamps(payload: PagePayload) -> dict:
 
 
 def extract_payload(cache, pages, req, first_token: int, *,
-                    wire_dtype: str = "auto") -> PagePayload:
+                    wire_dtype: str = "auto", pool=None) -> PagePayload:
     """Pull a finished prompt's pages out of the producer pool and
     build the wire message (see module docstring).  ``pages`` is the
     slot's physical page list; only the ``pages_needed(prompt_len)``
     prefix carries prompt KV (the +1 decode-growth reservation page is
-    not shipped)."""
+    not shipped).  ``pool``: the producer :class:`~.budget.PagePool`,
+    for page-lifecycle attribution (``analysis.pages``) only."""
     from ..resilience import integrity
 
     ps = cache.page_size
     plen = int(req.prompt_len)
     n = pages_needed(plen, ps)
     pids = [int(p) for p in pages[:n]]
+    if lifecycle_recorder() is not None:
+        # lifecycle: the shipped prefix is in flight until the router
+        # releases (adopted / re-prefill) or colocates (retain)
+        page_event("extract", pids, pool=pool)
     k = np.asarray(cache.k[:, pids])          # (L, n, Hkv, ps, D)
     v = np.asarray(cache.v[:, pids])
     page_shape = (k.shape[0],) + k.shape[2:]
@@ -247,17 +252,23 @@ def verify_payload(payload: PagePayload) -> CorruptionDiagnosis | None:
     return None
 
 
-def implant_payload(cache, pages, payload: PagePayload):
+def implant_payload(cache, pages, payload: PagePayload, *, pool=None):
     """Write an arrived (verified) payload into the consumer pool's
     ``pages`` and return the updated cache — dequantizing or
     requantizing as the TARGET layout demands, so either tier may run
-    either KV dtype."""
+    either KV dtype.  ``pool``: the consumer :class:`~.budget.PagePool`,
+    for page-lifecycle attribution (``analysis.pages``) only."""
     import jax.numpy as jnp
 
     from ..models import kv_cache as kvc
 
     n = payload.n_pages
     pids = [int(p) for p in pages[:n]]
+    if lifecycle_recorder() is not None:
+        # lifecycle: wire bytes land in freshly reserved pages; the
+        # adopting scheduler marks them verified+sealed after this
+        # returns (the plane verified the payload before implanting)
+        page_event("implant", pids, pool=pool)
     L, hkv, ps, d = payload.page_shape
     if payload.wire == "pool" and cache.quantized:
         # int8 pool -> int8 pool: pages + sidecars land verbatim

@@ -227,7 +227,8 @@ class DisaggRouter:
                 tr.begin("handoff_extract", tier=self.prefill.trace_tier)
             payload = handoff_mod.extract_payload(
                 self.prefill.cache, slot.pages, req, slot.next_token,
-                wire_dtype=self.plane.cfg.wire_dtype)
+                wire_dtype=self.plane.cfg.wire_dtype,
+                pool=self.prefill.pool)
             if tr is not None:
                 tr.begin("handoff_transfer", tier=self.prefill.trace_tier,
                          pages=payload.n_pages,
@@ -251,7 +252,7 @@ class DisaggRouter:
             adopted = self.decode.adopt_prefilled(
                 req,
                 lambda cache, pages: handoff_mod.implant_payload(
-                    cache, pages, arrived),
+                    cache, pages, arrived, pool=self.decode.pool),
                 length=arrived.prompt_len,
                 next_token=arrived.first_token)
             if adopted:

@@ -55,7 +55,7 @@ import numpy as np
 
 from .. import obs
 from ..models.kv_cache import PagePoolExhausted
-from .budget import PagePool, pages_needed
+from .budget import PagePool, lifecycle_recorder, page_event, pages_needed
 from .queue import Request, RequestQueue, RequestState
 
 
@@ -147,6 +147,9 @@ class Scheduler:
         self.pool = PagePool(
             backend.pool_pages, backend.page_size,
             scrubber=self._scrub_pages if scrub_enabled() else None)
+        # page-lifecycle attribution (analysis.pages): the recorder
+        # names this pool's ops after our trace_tier
+        self.pool.owner = self
         self.cache = backend.make_cache()
         self.slots: list[SlotState | None] = [None] * backend.slots
         self.governor = governor if governor is not None \
@@ -376,6 +379,16 @@ class Scheduler:
                 self._fail_slot(i, f"prefill failed: "
                                    f"{type(e).__name__}: {e}", now)
                 continue
+            if take and lifecycle_recorder() is not None:
+                # lifecycle: this chunk's KV landed in these pages
+                ps = self.pool.page_size
+                page_event(
+                    "write",
+                    [slot.pages[j]
+                     for j in range(slot.prefill_pos // ps,
+                                    (slot.prefill_pos + take - 1) // ps
+                                    + 1)],
+                    pool=self.pool)
             slot.prefill_pos += take
             done_tokens += take
             if slot.prefill_pos >= plen:
@@ -385,6 +398,15 @@ class Scheduler:
                 slot.length = plen
                 slot.next_token = int(first)
                 req.tokens = [int(first)]
+                if lifecycle_recorder() is not None:
+                    # lifecycle: the prompt's pages are now complete
+                    # readable content (parked for handoff or entering
+                    # decode membership)
+                    page_event(
+                        "seal",
+                        slot.pages[:pages_needed(plen,
+                                                 self.pool.page_size)],
+                        pool=self.pool)
                 # a prefill-only tier parks the finished prompt for the
                 # router's handoff instead of entering decode (a
                 # one-token request is already complete — nothing to
@@ -487,6 +509,22 @@ class Scheduler:
         resilience.breaker(self.governor.breaker_op).record_success()
         self.cache = new_cache
 
+        if lifecycle_recorder() is not None:
+            # lifecycle: the dispatch attended over every member's
+            # written pages and appended ``window`` tokens to its tail
+            ps = self.pool.page_size
+            for i in active:
+                slot = self.slots[i]
+                page_event("read",
+                           slot.pages[:pages_needed(slot.length, ps)],
+                           pool=self.pool)
+                page_event(
+                    "write",
+                    [slot.pages[j]
+                     for j in range(slot.length // ps,
+                                    (slot.length + window - 1) // ps
+                                    + 1)],
+                    pool=self.pool)
         for s in range(window):
             for i in active:
                 slot = self.slots[i]
@@ -663,6 +701,16 @@ class Scheduler:
         folds = integrity.fold_pages(self.cache, pages)
         for slot, j in to_stamp:
             slot.page_stamps[j] = folds[int(slot.pages[j])]
+        if lifecycle_recorder() is not None and pages:
+            # lifecycle: newly-full pages acquired their golden stamp;
+            # an audit tick re-reads every stamped page
+            if to_stamp:
+                page_event("stamp",
+                           [int(s.pages[j]) for s, j in to_stamp],
+                           pool=self.pool)
+            if audit:
+                page_event("read", sorted(pages), pool=self.pool,
+                           audit=True)
         if not audit:
             return
         for i, slot in enumerate(self.slots):
@@ -709,6 +757,11 @@ class Scheduler:
                        f"{req.req_id} does not match its pre-eviction "
                        f"stamp", time.monotonic())
                 return j
+        if lifecycle_recorder() is not None and req.kv_stamps:
+            # lifecycle: the recompute matched the pre-eviction stamps
+            page_event("verify",
+                       [int(slot.pages[j]) for j in req.kv_stamps],
+                       pool=self.pool)
         req.kv_stamps = None
         return None
 
@@ -732,6 +785,10 @@ class Scheduler:
         assert slot is not None and \
             slot.request.state is RequestState.HANDOFF
         slot.request.state = RequestState.DECODE
+        if lifecycle_recorder() is not None:
+            # lifecycle: the pages come home (possibly from a
+            # mid-transfer extract the adopt refused) to local decode
+            page_event("retain", slot.pages, pool=self.pool)
         if slot.request.trace is not None:
             slot.request.trace.annotate("colocated", tier=self.trace_tier)
             slot.request.trace.begin("decode_wait", tier=self.trace_tier)
@@ -797,6 +854,14 @@ class Scheduler:
         except Exception:
             self.pool.free(pages)
             raise
+        if lifecycle_recorder() is not None:
+            # lifecycle: the implanted prompt pages passed the plane's
+            # stamp verification before this call — mark them verified
+            # and readable (the +1 growth reservation page stays
+            # reserved until decode writes into it)
+            used = pages[:pages_needed(int(length), self.pool.page_size)]
+            page_event("verify", used, pool=self.pool)
+            page_event("seal", used, pool=self.pool)
         slot_idx = next(i for i, s in enumerate(self.slots) if s is None)
         req.state = RequestState.DECODE
         req.tokens = [int(next_token)]

@@ -120,7 +120,25 @@ def replay(scheduler, arrivals: list[Arrival], *,
            max_steps: int = 100_000) -> TraceReport:
     """Drive the scheduler through the trace until every request is
     terminal (or ``max_steps`` fires — reported, not raised: a stuck
-    replay is a finding for the caller's gate, not a crash)."""
+    replay is a finding for the caller's gate, not a crash).
+
+    With ``TDT_VERIFY_PAGES=1`` the whole replay runs under the
+    ``analysis.pages`` lifecycle recorder and raises
+    ``ProtocolViolationError`` on any page-lifetime violation (leak,
+    use-after-free, double-free, scrub-under-reader, ...); unset, the
+    cost is one env check."""
+    from ..core.utils import env_flag
+
+    if not env_flag("TDT_VERIFY_PAGES"):
+        return _replay_impl(scheduler, arrivals, max_steps=max_steps)
+    from ..analysis.pages import maybe_record
+
+    with maybe_record(label="serve_replay"):
+        return _replay_impl(scheduler, arrivals, max_steps=max_steps)
+
+
+def _replay_impl(scheduler, arrivals: list[Arrival], *,
+                 max_steps: int = 100_000) -> TraceReport:
     pending = sorted(arrivals, key=lambda a: (a.step, a.request.req_id))
     requests = [a.request for a in pending]
     idx = 0

@@ -1600,6 +1600,170 @@ def bench_handoff_retries():
     }
 
 
+_FLEET_RUN: dict | None = None
+_FLEET_REBALANCE_RUN: dict | None = None
+
+
+def _fleet_run(n_requests: int = 64) -> dict:
+    """One shared fleet-tier replay (ISSUE 18) behind the fleet
+    metrics: a diurnal + bursty open-loop mix over 2 prefill + 2 decode
+    SimBackend replicas through ``serve.FleetRouter``, with a decode
+    REPLICA LOST mid-replay — the p99 TTFT under loss is the headline
+    (failover re-prefills residents on survivors; the claims gate
+    bounds the tail).  SimBackend replicas + modeled DCN on this box,
+    so the record is interpret-marked; the hard bound binds on real
+    multi-replica captures."""
+    global _FLEET_RUN
+    if _FLEET_RUN is not None:
+        return _FLEET_RUN
+    import time
+
+    from triton_distributed_tpu import obs, resilience, serve
+
+    for rid in ("p0", "p1", "d0", "d1"):
+        resilience.reset_breaker(serve.replica_breaker_name(rid))
+    resilience.reset_breaker(serve.HANDOFF_OP)
+    vocab = 512
+    replicas = []
+    for rid in ("p0", "p1"):
+        replicas.append(serve.Replica(
+            rid,
+            serve.Scheduler(
+                serve.SimBackend(slots=8, page_size=16, pool_pages=65,
+                                 max_length=256, vocab=vocab),
+                serve.SchedulerConfig(max_queue_depth=128,
+                                      prefill_chunk_tokens=32,
+                                      prefill_only=True)),
+            "prefill"))
+    for rid in ("d0", "d1"):
+        replicas.append(serve.Replica(
+            rid,
+            serve.Scheduler(
+                serve.SimBackend(slots=8, page_size=16, pool_pages=65,
+                                 max_length=256, vocab=vocab),
+                serve.SchedulerConfig(max_queue_depth=128)),
+            "decode"))
+    router = serve.FleetRouter(
+        replicas, plane=serve.HandoffPlane(),
+        config=serve.FleetConfig(max_failovers_per_request=4,
+                                 probe_interval_steps=1 << 30))
+    # diurnal + bursty: a dense "peak" phase, a sparse "trough", then a
+    # burst wave (interarrival 0 gaps are the point) — stitched from
+    # three seeded open-loop traces with offset clocks
+    peak = serve.synthetic_trace(
+        11, n_requests // 2, mean_interarrival_steps=0.25,
+        prompt_len=(8, 48), max_new=(8, 48), vocab=vocab)
+    trough_off = max(a.step for a in peak) + 8
+    trough = serve.synthetic_trace(
+        12, n_requests // 4, mean_interarrival_steps=4.0,
+        prompt_len=(8, 48), max_new=(8, 48), vocab=vocab)
+    burst_off = trough_off + max(a.step for a in trough) + 8
+    burst = serve.synthetic_trace(
+        13, n_requests - n_requests // 2 - n_requests // 4,
+        mean_interarrival_steps=0.0,
+        prompt_len=(8, 48), max_new=(8, 48), vocab=vocab)
+    arrivals = (list(peak)
+                + [serve.Arrival(step=a.step + trough_off,
+                                 request=a.request) for a in trough]
+                + [serve.Arrival(step=a.step + burst_off,
+                                 request=a.request) for a in burst])
+    pending = sorted(arrivals, key=lambda a: (a.step, a.request.req_id))
+    lose_at = trough_off  # the loss lands between peak and burst
+    prev_obs = obs.enabled()
+    obs.enable(True)
+    obs.serve_stats.STATS.reset()
+    lost = []
+    try:
+        t0 = time.perf_counter()
+        idx = 0
+        for _ in range(200_000):
+            while idx < len(pending) and \
+                    pending[idx].step <= router.steps:
+                router.submit(pending[idx].request)
+                idx += 1
+            res = router.step()
+            if not lost and router.steps >= lose_at:
+                lost = router.lose_replica(
+                    "d0", reason="bench-injected replica loss")
+                lost = ["d0"]
+            if idx >= len(pending) and res.idle:
+                break
+        wall_s = time.perf_counter() - t0
+    finally:
+        obs.enable(prev_obs)
+    reqs = [a.request for a in pending]
+    done = [r for r in reqs if r.state is serve.RequestState.DONE]
+    ttft = sorted(r.ttft_ms() for r in done if r.ttft_ms() is not None)
+    _FLEET_RUN = {
+        "simulated": True,  # SimBackend replicas + modeled DCN here
+        "wall_s": wall_s,
+        "ttft_ms": ttft,
+        "lost": lost,
+        "completed": len(done),
+        "failed": sum(r.state is serve.RequestState.FAILED
+                      for r in reqs),
+        "shed": sum(r.state is serve.RequestState.SHED for r in reqs),
+        "failovers": router.failovers,
+        "reprefills": router.reprefills,
+        "handoffs": router.handoffs,
+        "colocated": router.colocated,
+        "leaked_pages": router.leaked_pages(),
+    }
+    return _FLEET_RUN
+
+
+def bench_fleet_ttft_under_loss():
+    """p99 TTFT across the diurnal+bursty fleet replay WITH a decode
+    replica lost mid-replay: the robustness headline — failover
+    re-prefills must keep the tail bounded, not just eventually
+    complete (claims gate: ``fleet_ttft_ms_p99_under_loss``)."""
+    run = _fleet_run()
+    return {
+        "metric": "fleet_ttft_ms_p99_under_loss",
+        "value": round(_pctl(run["ttft_ms"], 0.99), 2),
+        "unit": "ms",
+        "p50": round(_pctl(run["ttft_ms"], 0.5), 2),
+        "completed": run["completed"],
+        "failed": run["failed"],
+        "shed": run["shed"],
+        "lost_replicas": run["lost"],
+        "failovers": run["failovers"],
+        "reprefills": run["reprefills"],
+        "leaked_pages": run["leaked_pages"],
+        "interpret": run["simulated"] or _interpret_capture(),
+    }
+
+
+def bench_fleet_rebalance():
+    """Steps from first sustained decode-dominant demand reading to the
+    membership conversion (prefill replica recruited into the decode
+    role) in the fleet rebalance drill — the SLO-driven rebalance loop's
+    convergence latency (claims gate:
+    ``fleet_rebalance_convergence_steps``, lower is better)."""
+    global _FLEET_REBALANCE_RUN
+    if _FLEET_REBALANCE_RUN is None:
+        import random
+
+        from triton_distributed_tpu.resilience import matrix as rmatrix
+
+        row = rmatrix._fleet_rebalance_cell(random.Random(0))
+        _FLEET_REBALANCE_RUN = row
+    row = _FLEET_REBALANCE_RUN
+    conv = row.get("convergence_steps")
+    return {
+        "metric": "fleet_rebalance_convergence_steps",
+        # a drill that never converged reads as the gate's ceiling —
+        # red, not silently absent
+        "value": float(conv) if conv is not None else 1e9,
+        "unit": "steps",
+        "outcome": row["outcome"],
+        "recruited": row.get("replica"),
+        "rebalances": row["rebalances"],
+        "leaked_pages": row["pages_leaked"],
+        "interpret": True,  # SimBackend drill on this box
+    }
+
+
 def bench_integrity_overhead():
     """The TDT_INTEGRITY tax: checksummed vs plain AG/RS at the tuned
     configs, as a percent of the plain eager op (ISSUE 7 satellite —
@@ -2013,6 +2177,12 @@ def main():
         print(json.dumps(bench_handoff_retries()))
         print(json.dumps(bench_trace_overhead_disagg()))
         print(json.dumps(bench_profile_overhead_disagg()))
+    elif mode == "fleet":
+        # the N-replica fleet tier (ISSUE 18): diurnal+bursty replay
+        # with a replica lost mid-stream, plus the rebalance drill's
+        # convergence latency
+        print(json.dumps(bench_fleet_ttft_under_loss()))
+        print(json.dumps(bench_fleet_rebalance()))
     elif mode == "wire":
         # quantized collective payload byte accounting + dequant parity
         # (ISSUE 9)
@@ -2056,6 +2226,8 @@ def main():
         _emit(bench_handoff_latency)
         _emit(bench_handoff_throughput)
         _emit(bench_handoff_retries)
+        _emit(bench_fleet_ttft_under_loss)
+        _emit(bench_fleet_rebalance)
         _emit(bench_trace_overhead)
         _emit(bench_trace_overhead_disagg)
         _emit(bench_profile_overhead)
@@ -2096,8 +2268,8 @@ def main():
         raise SystemExit(
             f"unknown bench mode {mode!r} "
             "(auto|gemm|attn|mlp|moe|decode|decode_modes|moe_ep|latency|"
-            "overlap|overlap_collective|serve|serve_disagg|wire|hier|"
-            "integrity)"
+            "overlap|overlap_collective|serve|serve_disagg|fleet|wire|"
+            "hier|integrity)"
         )
 
 

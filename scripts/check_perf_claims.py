@@ -333,6 +333,22 @@ CLAIMS = {
     "profile_overhead_pct": {
         "warn_max": 2.0, "value_max": 100.0, "since": 16,
     },
+    # -- fleet tier (ISSUE 18; `bench.py fleet`) --
+    # p99 TTFT of the diurnal+bursty replay WITH a decode replica lost
+    # mid-stream: failover must keep the tail bounded, not merely
+    # complete.  The gross 30s ceiling mirrors serve_ttft_ms_p99 —
+    # interpret-marked on this box's SimBackend replicas (never
+    # hard-gated here); binds on real multi-replica captures
+    "fleet_ttft_ms_p99_under_loss": {
+        "value_max": 30_000.0, "since": 18,
+    },
+    # steps from the first sustained decode-dominant demand reading to
+    # the membership conversion in the rebalance drill (lower is
+    # better; obs.history classifies "steps"/"convergence" accordingly).
+    # A drill that never converges reports 1e9 and trips this ceiling
+    "fleet_rebalance_convergence_steps": {
+        "value_max": 512.0, "since": 18,
+    },
 }
 
 def parse_record(path: str) -> tuple[list[dict], int | None, bool]:

@@ -26,6 +26,7 @@ Usage:
     python scripts/tdt_lint.py --trace           # request-tracing gate
     python scripts/tdt_lint.py --profile         # continuous-profiler gate
     python scripts/tdt_lint.py --pages           # page-lifetime ownership gate
+    python scripts/tdt_lint.py --fleet           # fleet-tier (N-replica) gate
     python scripts/tdt_lint.py --all             # every gate, one exit code
     python scripts/tdt_lint.py --json report.json
 
@@ -159,11 +160,25 @@ scrub-under-reader), the seeded-bad lifecycle fixture battery in both
 directions, and a static ownership re-check of every fault-matrix
 serving cell's recorded page trace.
 
+``--fleet`` is the fleet-tier gate (ISSUE 18, docs/serving.md "Fleet
+tier"): a seeded N=4 replay (two prefill + two decode replicas through
+the REAL ``serve.FleetRouter``) with one replica LOST mid-decode and a
+second replica FLAPPING through its sticky ``replica:<id>`` breaker —
+every faulted request must complete on a survivor with token parity vs
+the deterministic golden, EXACTLY the flapping replica must walk
+quarantine (drain-before-evict), the lost replica must be named in
+``lost_replicas``, and zero pages may leak on ANY replica (per-pool
+lifecycle discharge); then the fleet fault cells
+(``resilience.run_fleet_matrix``: replica-abort failover, flap
+quarantine, rebalance-under-load membership conversion, quarantine
+readmission) must each be detected-or-survived.  Headless and
+CPU-only.
+
 ``--all`` runs every gate above — verify matrix, ``--dpor``,
 ``--completeness``, ``--faults``, ``--timeline``, ``--serve``,
 ``--history``, ``--integrity``, ``--quant``, ``--hier``,
 ``--handoff``, ``--persistent``, ``--trace``, ``--profile``,
-``--pages`` — and
+``--pages``, ``--fleet`` — and
 summarizes them under a single exit code (the CI entry; see README).
 
 ``--history`` runs the bench-record trend sentinel
@@ -280,12 +295,20 @@ def main(argv: list[str] | None = None) -> int:
                          "selftest both directions, and a static "
                          "ownership re-check of every fault-matrix "
                          "serving cell's recorded page trace")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet-tier gate (ISSUE 18): seeded N=4 "
+                         "replay with one replica lost mid-decode and "
+                         "one flapping into quarantine (every faulted "
+                         "request completes on a survivor with token "
+                         "parity, exactly the flapping replica "
+                         "quarantine-evicted, zero leaked pages per "
+                         "replica), plus the fleet fault cells")
     ap.add_argument("--all", action="store_true", dest="all_gates",
                     help="run every gate (verify matrix, --faults, "
                          "--timeline, --serve, --history, --integrity, "
                          "--quant, --hier, --handoff, --persistent, "
-                         "--trace, --profile, --pages) with one "
-                         "summarized exit code")
+                         "--trace, --profile, --pages, --fleet) with "
+                         "one summarized exit code")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection target sampling seed (--faults)")
     ap.add_argument("--json", metavar="PATH",
@@ -322,6 +345,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_profile(args)
     if args.pages_gate:
         return _run_pages(args)
+    if args.fleet:
+        return _run_fleet(args)
 
     from triton_distributed_tpu import analysis
 
@@ -692,6 +717,7 @@ def _run_all(args) -> int:
         ("trace", lambda: _run_trace(sub())),
         ("profile", lambda: _run_profile(sub())),
         ("pages", lambda: _run_pages(sub())),
+        ("fleet", lambda: _run_fleet(sub())),
     ]
     results = []
     for name, fn in legs:
@@ -1016,6 +1042,178 @@ def _run_handoff(args) -> int:
           "on both tiers, every faulted request completed via "
           "retry/re-prefill with token parity; all handoff fault "
           "cells detected-or-survived")
+    return 0
+
+
+def _run_fleet(args) -> int:
+    """The fleet-tier gate (ISSUE 18; see module docstring): a seeded
+    N=4 replay with one replica lost mid-decode and one flapping into
+    quarantine, then the fleet fault cells."""
+    import random
+
+    from triton_distributed_tpu import resilience, serve
+    from triton_distributed_tpu.resilience.faults import RankAborted
+
+    _FLEET_IDS = ("p0", "p1", "d0", "d1")
+
+    def reset_replica_breakers():
+        for rid in _FLEET_IDS:
+            resilience.reset_breaker(serve.replica_breaker_name(rid))
+        resilience.reset_breaker(serve.HANDOFF_OP)
+
+    problems: list[str] = []
+    rng = random.Random(args.seed)
+    reset_replica_breakers()
+
+    # leg 1: N=4 replay — 12 requests over 2 prefill + 2 decode
+    # replicas; d1 FLAPS (RankAborted on every dispatch in a step
+    # window — its sticky replica:d1 breaker must walk open, drain,
+    # evict) and d0 is LOST mid-decode (every resident re-prefilled on
+    # a survivor, original clock carried)
+    class _Flap:
+        def __init__(self, first, last):
+            self.first, self.last, self.fired = first, last, 0
+
+        def __call__(self, step):
+            if self.first <= step <= self.last:
+                self.fired += 1
+                raise RankAborted(0, step)
+
+    inj = _Flap(3, 10)
+    replicas = []
+    for rid in ("p0", "p1"):
+        replicas.append(serve.Replica(
+            rid,
+            serve.Scheduler(
+                serve.SimBackend(slots=3, page_size=4, pool_pages=24,
+                                 max_length=64),
+                serve.SchedulerConfig(max_queue_depth=32,
+                                      prefill_only=True)),
+            "prefill"))
+    for rid in ("d0", "d1"):
+        replicas.append(serve.Replica(
+            rid,
+            serve.Scheduler(
+                serve.SimBackend(slots=3, page_size=4, pool_pages=32,
+                                 max_length=64,
+                                 step_hook=inj if rid == "d1" else None),
+                serve.SchedulerConfig(max_queue_depth=32)),
+            "decode"))
+    router = serve.FleetRouter(
+        replicas,
+        plane=serve.HandoffPlane(dcn_channel=serve.ModeledDCN(
+            seed=rng.randrange(1 << 16))),
+        # a request can fault TWICE here (flap off d1, then lose d0 it
+        # landed on, then bounce off d1 again before its breaker
+        # opens): give the ladder headroom above the default cap
+        config=serve.FleetConfig(flap_threshold=3,
+                                 max_failovers_per_request=4,
+                                 probe_interval_steps=1 << 30))
+    reqs = [
+        serve.Request(prompt=tuple(rng.randrange(1, 90)
+                                   for _ in range(rng.randint(2, 6))),
+                      max_new_tokens=rng.randint(6, 10))
+        for _ in range(12)
+    ]
+    from triton_distributed_tpu.analysis import pages as _pages
+
+    lost_id = None
+    moved: list[int] = []
+    with _pages.record() as rec:
+        for r in reqs:
+            router.submit(r)
+        for _ in range(600):
+            router.step()
+            d0 = next(rep for rep in router.replicas
+                      if rep.replica_id == "d0")
+            if lost_id is None and any(
+                    s is not None
+                    and s.request.state is serve.RequestState.DECODE
+                    for s in d0.scheduler.slots):
+                lost_id = "d0"
+                moved = router.lose_replica(
+                    "d0", reason="injected mid-decode replica loss")
+                break
+        router.run_until_idle(max_steps=4000)
+    backend = router.replicas[0].scheduler.backend
+    done = [r for r in reqs if r.state is serve.RequestState.DONE]
+    nonterminal = [r for r in reqs if not r.done]
+    parity_bad = [r.req_id for r in done
+                  if r.tokens != backend.expected_tokens(r)]
+    quarantined = [rep.replica_id for rep in router.replicas
+                   if rep.quarantined]
+    leaked_by = {rep.replica_id: rep.scheduler.pool.used_pages
+                 for rep in router.replicas if rep.scheduler.pool.used_pages}
+    lifecycle = [str(v) for v in _pages.check_recorder(rec, label="fleet")]
+    print(f"fleet replay: {len(reqs)} requests -> {len(done)} "
+          f"completed; replica {lost_id} lost with {len(moved)} "
+          f"resident(s), d1 flapped {inj.fired}x, quarantined="
+          f"{quarantined}, {router.failovers} failovers, "
+          f"{router.reprefills} re-prefills, {router.handoffs} "
+          f"handoffs, leaked pages {router.leaked_pages()}")
+    if lost_id is None or not moved:
+        problems.append(f"replay: the replica-loss injection never "
+                        f"landed mid-decode (lost={lost_id}, "
+                        f"moved={len(moved)})")
+    if inj.fired < 3:
+        problems.append(f"replay: the flap window only fired "
+                        f"{inj.fired}x — below the breaker threshold")
+    if nonterminal:
+        problems.append(f"replay: {len(nonterminal)} request(s) never "
+                        f"terminal: {[r.req_id for r in nonterminal]}")
+    if len(done) != len(reqs):
+        problems.append(f"replay: {len(reqs) - len(done)} faulted "
+                        f"request(s) did not complete on a survivor: "
+                        f"{[(r.req_id, r.state.name, r.error) for r in reqs if r.state is not serve.RequestState.DONE]}")
+    if parity_bad:
+        problems.append(f"replay: token parity broken vs the "
+                        f"deterministic golden for request(s) "
+                        f"{parity_bad}")
+    if quarantined != ["d1"]:
+        problems.append(f"replay: exactly the flapping replica must be "
+                        f"quarantine-evicted — expected ['d1'], got "
+                        f"{quarantined}")
+    if router.lost_replicas != ["d0"]:
+        problems.append(f"replay: lost_replicas must name exactly the "
+                        f"lost replica — got {router.lost_replicas}")
+    if leaked_by:
+        problems.append(f"replay: page(s) leaked per replica: "
+                        f"{leaked_by}")
+    if lifecycle:
+        problems.append(f"replay: page-lifecycle violations: "
+                        f"{lifecycle}")
+    reset_replica_breakers()
+
+    # leg 2: the fleet fault cells
+    rows = resilience.run_fleet_matrix(seed=args.seed)
+    for row in rows:
+        named = f"  [{', '.join(row['named'])}]" if row["named"] else ""
+        print(f"{row['kernel']:<20} {row['fault']:<26} "
+              f"{row['outcome'].upper():<10}{named}")
+    problems += resilience.verify_fleet_matrix(rows)
+
+    for p in problems:
+        print(f"FLEET FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({
+                "replay": {
+                    "requests": len(reqs), "completed": len(done),
+                    "lost": lost_id, "moved": len(moved),
+                    "flaps": inj.fired, "quarantined": quarantined,
+                    "failovers": router.failovers,
+                    "reprefills": router.reprefills,
+                    "leaked_pages": router.leaked_pages(),
+                },
+                "cells": rows, "problems": problems,
+            }, f, indent=1, sort_keys=True, default=str)
+    if problems:
+        return 1
+    print("fleet OK: N=4 replay survived one replica loss mid-decode "
+          "and one flap into quarantine — every faulted request "
+          "completed on a survivor with token parity, exactly the "
+          "flapping replica evicted, zero leaked pages on every "
+          "replica; all fleet fault cells detected-or-survived")
     return 0
 
 

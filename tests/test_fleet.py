@@ -1,0 +1,479 @@
+"""Fleet tier: N-replica serving with replica-loss failover,
+per-replica quarantine, and SLO-driven rebalance (ISSUE 18).
+
+Headless like the router tests: N REAL schedulers over deterministic
+``SimBackend``s (prefill- and decode-role pools), the real paged-cache
+plumbing on every replica, and the ``ModeledDCN`` transport in between
+— so admission routing, the replica breakers, drain-before-evict
+quarantine, probe readmission, loss failover (original clock + gapless
+trace chain carried) and the rebalance actuator are exercised end to
+end without hardware.
+"""
+
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from triton_distributed_tpu import obs, resilience, serve
+from triton_distributed_tpu.obs import request_trace as rtrace
+from triton_distributed_tpu.resilience import matrix as rmatrix
+from triton_distributed_tpu.resilience.faults import RankAborted
+from triton_distributed_tpu.serve.fleet import replica_breaker_name
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_IDS = ("p0", "p1", "d0", "d1")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_breakers():
+    """Replica breakers are process-global sticky state keyed by id —
+    and the test fleets reuse ids — so no test may inherit (or donate)
+    an open breaker."""
+    for rid in _IDS:
+        resilience.reset_breaker(replica_breaker_name(rid))
+    resilience.reset_breaker(serve.HANDOFF_OP)
+    yield
+    for rid in _IDS:
+        resilience.reset_breaker(replica_breaker_name(rid))
+    resilience.reset_breaker(serve.HANDOFF_OP)
+
+
+@pytest.fixture()
+def trace_on():
+    prev_obs = obs.enabled()
+    obs.enable(True)
+    obs.REGISTRY.reset()
+    obs.serve_stats.STATS.reset()
+    prev_trace = rtrace.enable(True)
+    rtrace.RING.clear()
+    yield
+    rtrace.enable(prev_trace)
+    rtrace.RING.clear()
+    obs.enable(prev_obs)
+    obs.REGISTRY.reset()
+    obs.serve_stats.STATS.reset()
+
+
+def _sched(*, prefill_only=False, slots=3, pool_pages=24, hook=None,
+           max_queue_depth=32):
+    return serve.Scheduler(
+        serve.SimBackend(slots=slots, page_size=4, pool_pages=pool_pages,
+                         max_length=64, step_hook=hook),
+        serve.SchedulerConfig(max_queue_depth=max_queue_depth,
+                              prefill_only=prefill_only))
+
+
+def _fleet(*, hooks=None, config=None, decode_pool=32, seed=1):
+    hooks = hooks or {}
+    replicas = [
+        serve.Replica(rid, _sched(prefill_only=True,
+                                  hook=hooks.get(rid)), "prefill")
+        for rid in ("p0", "p1")
+    ] + [
+        serve.Replica(rid, _sched(pool_pages=decode_pool,
+                                  hook=hooks.get(rid)), "decode")
+        for rid in ("d0", "d1")
+    ]
+    plane = serve.HandoffPlane(dcn_channel=serve.ModeledDCN(seed=seed))
+    return serve.FleetRouter(replicas, plane=plane, config=config)
+
+
+def _load(n=6, seed=0, max_new=(4, 8)):
+    rng = random.Random(seed)
+    return [
+        serve.Request(prompt=tuple(rng.randrange(1, 90)
+                                   for _ in range(rng.randint(2, 6))),
+                      max_new_tokens=rng.randint(*max_new))
+        for _ in range(n)
+    ]
+
+
+class _Flap:
+    """Decode-step hook raising ``RankAborted`` while the backend step
+    counter is inside the window — a flapping replica."""
+
+    def __init__(self, first, last):
+        self.first, self.last, self.fired = first, last, 0
+
+    def __call__(self, step):
+        if self.first <= step <= self.last:
+            self.fired += 1
+            raise RankAborted(0, step)
+
+
+# ---------------------------------------------------------------------------
+# construction validation
+
+
+def test_duplicate_replica_ids_rejected():
+    reps = [serve.Replica("a", _sched(prefill_only=True), "prefill"),
+            serve.Replica("a", _sched(), "decode")]
+    with pytest.raises(ValueError, match="duplicate replica id"):
+        serve.FleetRouter(reps)
+
+
+def test_role_must_match_prefill_only():
+    reps = [serve.Replica("a", _sched(prefill_only=False), "prefill"),
+            serve.Replica("b", _sched(), "decode")]
+    with pytest.raises(ValueError, match="prefill_only"):
+        serve.FleetRouter(reps)
+
+
+def test_each_role_required():
+    reps = [serve.Replica("a", _sched(prefill_only=True), "prefill")]
+    with pytest.raises(ValueError, match="at least one 'decode'"):
+        serve.FleetRouter(reps)
+
+
+def test_page_geometry_must_match():
+    bad = serve.Scheduler(
+        serve.SimBackend(slots=3, page_size=8, pool_pages=24,
+                         max_length=64),
+        serve.SchedulerConfig(max_queue_depth=32))
+    reps = [serve.Replica("a", _sched(prefill_only=True), "prefill"),
+            serve.Replica("b", bad, "decode")]
+    with pytest.raises(ValueError, match="page geometry"):
+        serve.FleetRouter(reps)
+
+
+# ---------------------------------------------------------------------------
+# routing + affinity
+
+
+def test_clean_fleet_drains_with_parity_and_zero_leaks():
+    router = _fleet()
+    reqs = _load(8)
+    for r in reqs:
+        router.submit(r)
+    router.run_until_idle(max_steps=4000)
+    backend = router.replicas[0].scheduler.backend
+    assert all(r.state is serve.RequestState.DONE for r in reqs)
+    assert all(r.tokens == backend.expected_tokens(r) for r in reqs)
+    assert router.leaked_pages() == 0
+    assert router.handoffs > 0          # the disaggregated path ran
+
+
+def test_submit_routes_to_least_loaded_prefill():
+    router = _fleet()
+    # preload p0 so p1 is strictly less loaded
+    for r in _load(3, seed=7):
+        router._by_id["p0"].scheduler.submit(r)
+    req = _load(1, seed=8)[0]
+    assert router.submit(req)
+    p1 = router._by_id["p1"].scheduler
+    assert any(req is q for q in [p1.queue.pop()])
+
+
+def test_session_affinity_sticks_and_follows_failover():
+    router = _fleet()
+    first = _load(1, seed=3)[0]
+    assert router.submit(first, session="tenant-a")
+    home = router._affinity["tenant-a"]
+    router.run_until_idle(max_steps=2000)
+    # after the handoff the session's pages live on the decode replica
+    moved_home = router._affinity["tenant-a"]
+    assert router._by_id[moved_home].role == "decode"
+    second = _load(1, seed=4)[0]
+    assert router.submit(second, session="tenant-a")
+    assert home is not None  # affinity was recorded at admission
+
+
+def test_fleet_shed_when_no_replica_admits():
+    router = _fleet()
+    for rep in router.replicas:
+        rep.draining = True
+    req = _load(1)[0]
+    assert not router.submit(req)
+    assert req.state is serve.RequestState.SHED
+    assert "no admitting replica" in req.shed_reason
+
+
+# ---------------------------------------------------------------------------
+# replica loss mid-decode: failover ladder, original clock, zero leaks
+
+
+def test_replica_loss_mid_decode_reprefills_on_survivor():
+    router = _fleet()
+    reqs = _load(8, max_new=(6, 10))
+    for r in reqs:
+        router.submit(r)
+    lost = None
+    for _ in range(400):
+        router.step()
+        d0 = router._by_id["d0"]
+        if any(s is not None
+               and s.request.state is serve.RequestState.DECODE
+               for s in d0.scheduler.slots):
+            before = {r.req_id: r.submitted_s for r in reqs}
+            moved = router.lose_replica("d0", reason="test loss")
+            lost = ("d0", moved, before)
+            break
+    assert lost is not None, "no mid-decode resident to lose"
+    _, moved, before = lost
+    assert moved, "the lost replica had residents"
+    router.run_until_idle(max_steps=4000)
+    backend = router.replicas[0].scheduler.backend
+    assert all(r.state is serve.RequestState.DONE for r in reqs)
+    assert all(r.tokens == backend.expected_tokens(r) for r in reqs)
+    # the ORIGINAL submit clock survived the failover resubmit
+    for r in reqs:
+        if r.req_id in moved:
+            assert r.submitted_s == before[r.req_id]
+    # zero leaked pages on EVERY replica, the lost one included
+    for rep in router.replicas:
+        assert rep.scheduler.pool.used_pages == 0, rep.replica_id
+    assert router.lost_replicas == ["d0"]
+    # a lost replica is terminal: probes never readmit it
+    with pytest.raises(ValueError, match="LOST"):
+        router.readmit("d0")
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: failover resubmit extends the SAME gapless trace chain
+
+
+def test_failover_trace_chain_gapless_with_resubmit_tag(trace_on):
+    inj = _Flap(2, 6)
+    router = _fleet(hooks={"d0": inj},
+                    config=serve.FleetConfig(
+                        flap_threshold=100,   # no quarantine: pure failover
+                        probe_interval_steps=1 << 30))
+    req = _load(1, seed=5, max_new=(6, 8))[0]
+    t_submit = time.monotonic()
+    router.submit(req)
+    router.run_until_idle(max_steps=4000)
+    assert req.state is serve.RequestState.DONE
+    assert inj.fired >= 1, "the decode fault never landed"
+    tr = req.trace
+    assert tr is not None and tr.closed
+    # one chain, no gap: every span closes where the next opens, the
+    # failed hop's spans included
+    assert rtrace.verify_chain(tr) == []
+    # the resubmit's queue_wait is tagged, and the failover annotation
+    # names the replica it left
+    assert any(s.name == "queue_wait" and s.tags.get("resubmit")
+               for s in tr.spans)
+    assert any(e.name == "failover" and e.tier == "d0"
+               for e in tr.events)
+    # the original clock survived: the terminal latency covers the
+    # WHOLE life including the failed replica's time
+    assert req.submitted_s <= t_submit + 0.5
+    assert req.finished_s is not None
+    assert req.finished_s > req.submitted_s
+
+
+# ---------------------------------------------------------------------------
+# flap -> sticky breaker -> drain-before-evict -> probe readmission
+
+
+def test_flap_walks_quarantine_with_drain_before_evict():
+    inj = _Flap(2, 12)
+    router = _fleet(hooks={"d1": inj},
+                    config=serve.FleetConfig(
+                        flap_threshold=3,
+                        probe_interval_steps=1 << 30))
+    reqs = _load(10, max_new=(6, 10))
+    for r in reqs:
+        router.submit(r)
+    d1 = router._by_id["d1"]
+    saw_draining = False
+    for _ in range(4000):
+        res = router.step()
+        if d1.draining and not d1.evicted:
+            saw_draining = True
+            # draining refuses NEW admission but keeps stepping
+            assert not router._admitting(d1)
+            assert router._steppable(d1)
+        if res.idle:
+            break
+    assert inj.fired >= 3
+    assert saw_draining, "the breaker never opened into a drain"
+    assert d1.evicted and d1.quarantined and not d1.lost
+    assert resilience.breaker(replica_breaker_name("d1")).open
+    assert router.quarantined_history == ["d1"]
+    backend = router.replicas[0].scheduler.backend
+    assert all(r.state is serve.RequestState.DONE for r in reqs)
+    assert all(r.tokens == backend.expected_tokens(r) for r in reqs)
+    for rep in router.replicas:
+        assert rep.scheduler.pool.used_pages == 0, rep.replica_id
+
+
+def test_probe_readmission_after_flap_clears():
+    inj = _Flap(2, 9)
+    router = _fleet(hooks={"d1": inj},
+                    config=serve.FleetConfig(
+                        flap_threshold=3,
+                        probe_interval_steps=8,
+                        readmit_probe_successes=2))
+    reqs = _load(10, max_new=(6, 10))
+    for r in reqs:
+        router.submit(r)
+    for _ in range(4000):
+        router.step()
+        if router.readmissions:
+            break
+    assert router.readmissions == ["d1"]
+    d1 = router._by_id["d1"]
+    assert "d1" in router.quarantined_history   # it DID quarantine
+    assert not d1.evicted and not d1.draining
+    assert router._admitting(d1)
+    assert not resilience.breaker(replica_breaker_name("d1")).open
+    router.run_until_idle(max_steps=4000)
+    assert all(r.state is serve.RequestState.DONE for r in reqs)
+    assert router.leaked_pages() == 0
+
+
+# ---------------------------------------------------------------------------
+# SLO-driven rebalance: attribution -> membership conversion
+
+
+def test_rebalance_converts_prefill_replica_under_decode_demand(trace_on):
+    rng = random.Random(0)
+    row = rmatrix._fleet_rebalance_cell(rng)
+    assert row["outcome"] == "survived", row["detail"]
+    assert row["rebalances"], "no membership conversion recorded"
+    rb = row["rebalances"][0]
+    assert (rb["from"], rb["to"]) == ("prefill", "decode")
+    # the convergence pin: within the claims gate's ceiling
+    assert rb["convergence_steps"] <= 512
+    assert row["pages_leaked"] == 0
+
+
+def test_rebalance_never_empties_the_donor_role():
+    router = _fleet(config=serve.FleetConfig(rebalance_interval_steps=1,
+                                             rebalance_sustain=1))
+    # force-drain p1 so only ONE admitting prefill donor remains
+    router._by_id["p1"].draining = True
+    router._dom_role = "decode"
+    router._dom_count = 5
+    # directly exercise the donor guard: one admitting prefill replica
+    # must never be recruited away
+    router.steps = router.cfg.rebalance_interval_steps
+    router._rebalance_tick()
+    assert router._recruit is None
+    assert router._by_id["p0"].role == "prefill"
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: health aggregation over N named replicas
+
+
+def test_health_snapshot_carries_quarantined_replicas():
+    br = resilience.breaker(replica_breaker_name("d1"), 1)
+    br.record_failure()
+    snap = resilience.health_snapshot()
+    assert "d1" in snap["quarantined_replicas"]
+    resilience.reset_breaker(replica_breaker_name("d1"))
+    assert "d1" not in \
+        resilience.health_snapshot()["quarantined_replicas"]
+
+
+def test_fleet_health_names_replicas_and_roles():
+    router = _fleet()
+    snap = router.health()
+    assert snap["status"] == "ok"
+    assert set(snap["replicas"]) == set(_IDS)
+    assert snap["unavailable_roles"] == []
+    assert snap["saturated_replicas"] == []
+    assert snap["fleet"]["roles"] == {"prefill": 2, "decode": 2}
+
+
+def test_fleet_health_unavailable_when_role_empty():
+    router = _fleet()
+    router.lose_replica("d0", reason="test")
+    router.lose_replica("d1", reason="test")
+    snap = router.health()
+    assert snap["status"] == "unavailable"
+    assert snap["unavailable_roles"] == ["decode"]
+
+
+def test_fleet_health_saturated_replica_named():
+    router = _fleet()
+    d0 = router._by_id["d0"].scheduler
+    d0._saturated_since = time.monotonic() - 1.0
+    snap = router.health()
+    assert "d0" in snap["saturated_replicas"]
+    assert snap["status"] == "saturated"
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: FLEET_GOLDEN <-> FleetFault both directions
+
+
+def test_fleet_golden_matches_live_enum_both_directions():
+    live = {f.value for f in serve.FleetFault}
+    assert set(rmatrix.FLEET_GOLDEN) == live
+    from triton_distributed_tpu.analysis import completeness
+
+    assert completeness.check_fleet_coverage() == []
+
+
+def test_fleet_coverage_flags_drift(monkeypatch):
+    from triton_distributed_tpu.analysis import completeness
+
+    golden = dict(rmatrix.FLEET_GOLDEN)
+    removed = next(iter(golden))
+    trimmed = {k: v for k, v in golden.items() if k != removed}
+    trimmed["ghost_fault"] = {"leg": "x", "outcome": "survived"}
+    monkeypatch.setattr(rmatrix, "FLEET_GOLDEN", trimmed)
+    problems = completeness.check_fleet_coverage()
+    assert any(removed in p and "no FLEET_GOLDEN" in p
+               for p in problems)
+    assert any("ghost_fault" in p and "no longer exists" in p
+               for p in problems)
+
+
+def test_verify_fleet_matrix_flags_missing_cell():
+    rows = [{"kernel": "serve/fleet", "fault": f, "leg": g["leg"],
+             "fired": True, "outcome": g["outcome"], "named": ["x"],
+             "replica": "x", "pages_leaked": 0,
+             "pages_leaked_by_replica": {}, "lifecycle_events": 1,
+             "lifecycle_violations": [], "detail": ""}
+            for f, g in rmatrix.FLEET_GOLDEN.items()]
+    assert rmatrix.verify_fleet_matrix(rows) == []
+    problems = rmatrix.verify_fleet_matrix(rows[1:])
+    assert any(rows[0]["fault"] in p for p in problems)
+    # wrong outcome flagged
+    flipped = [dict(r) for r in rows]
+    flipped[0]["outcome"] = ("survived"
+                             if rows[0]["outcome"] == "detected"
+                             else "detected")
+    assert any("expected" in p
+               for p in rmatrix.verify_fleet_matrix(flipped))
+
+
+# ---------------------------------------------------------------------------
+# the trend sentinel classifies the fleet metrics
+
+
+def test_history_direction_for_fleet_metrics():
+    from triton_distributed_tpu.obs import history
+
+    assert history.direction_for(
+        "fleet_ttft_ms_p99_under_loss", "ms") == "lower"
+    assert history.direction_for(
+        "fleet_rebalance_convergence_steps", "steps") == "lower"
+
+
+# ---------------------------------------------------------------------------
+# the CI hook
+
+
+def test_tdt_lint_fleet_smoke():
+    """The tier-1 CI hook (like the --handoff / --serve smokes): the
+    seeded N=4 replay with one replica lost and one flapping, plus the
+    fleet fault cells."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tdt_lint.py"),
+         "--fleet"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "fleet OK" in proc.stdout
+    assert "DETECTED" in proc.stdout and "SURVIVED" in proc.stdout

@@ -205,6 +205,7 @@ def check() -> list[str]:
                 f"collective_id {cid} registered for multiple families: "
                 f"{sorted(names)}")
     problems.extend(check_lifecycle_coverage())
+    problems.extend(check_fleet_coverage())
     return problems
 
 
@@ -245,4 +246,35 @@ def check_lifecycle_coverage() -> list[str]:
         problems.append(
             f"lifecycle coverage names handoff fault {name!r} which no "
             f"longer exists — prune the stale row")
+    return problems
+
+
+def check_fleet_coverage() -> list[str]:
+    """The fleet-tier wiring row: every live ``serve.fleet.FleetFault``
+    class must have a golden matrix cell in
+    ``resilience.matrix.FLEET_GOLDEN`` (which leg exercises it and the
+    pinned detected/survived outcome), and no golden row may name a
+    fault class that no longer exists.  A new fleet fault landing
+    without a matrix cell is a membership-change path the fault drills
+    never rehearse."""
+    from ..resilience.matrix import FLEET_GOLDEN
+    from ..serve.fleet import FleetFault
+
+    problems: list[str] = []
+    live = {f.value for f in FleetFault}
+    golden = set(FLEET_GOLDEN)
+    for name in sorted(live - golden):
+        problems.append(
+            f"FleetFault {name!r}: no FLEET_GOLDEN matrix row in "
+            f"resilience.matrix — a fleet fault class without a "
+            f"rehearsed cell is an undrilled membership change")
+    for name in sorted(golden - live):
+        problems.append(
+            f"FLEET_GOLDEN names fleet fault {name!r} which no longer "
+            f"exists — prune the stale row")
+    for name, row in sorted(FLEET_GOLDEN.items()):
+        if row.get("outcome") not in ("detected", "survived"):
+            problems.append(
+                f"FLEET_GOLDEN[{name!r}]: outcome must be "
+                f"'detected' or 'survived', got {row.get('outcome')!r}")
     return problems

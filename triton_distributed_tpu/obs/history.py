@@ -237,6 +237,12 @@ def direction_for(metric: str, unit: str) -> str:
     # above, handoff_pages_per_s the throughput default below)
     if any(tok in metric for tok in ("retries", "failures", "failed")):
         return "lower"
+    # convergence latencies in scheduler steps (fleet_rebalance_
+    # convergence_steps — ISSUE 18): every extra step is load served by
+    # the wrong membership — growth is the regression (fleet_ttft_ms_
+    # p99_under_loss rides the ms rule above)
+    if u == "steps" or "convergence" in metric:
+        return "lower"
     return "higher"
 
 

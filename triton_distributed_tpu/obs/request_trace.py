@@ -351,6 +351,25 @@ def finish(req) -> None:
     RING.retire(tr)
 
 
+def reopen_for_failover(req) -> None:
+    """Un-close a trace that a replica-local terminal state already
+    finished, so a fleet failover resubmission extends the SAME chain
+    (``serve.fleet``): ``Scheduler._fail_slot`` ended the chain at the
+    failure and retired it, but the request is about to be re-prefilled
+    on a survivor — the failed replica's time must stay accounted on
+    this request's sketch samples, not restart a fresh clock.  The
+    terminal span reopens (its close moves to the resubmit's
+    ``queue_wait`` begin, keeping the chain gapless) and the next
+    :func:`finish` re-retires under the same trace id, replacing the
+    ring entry.  No-op for untraced or still-open requests."""
+    tr = getattr(req, "trace", None)
+    if tr is None or not tr.closed:
+        return
+    tr.state = None
+    if tr.spans:
+        tr.spans[-1].t1_us = None
+
+
 # ---------------------------------------------------------------------------
 # the retained-trace ring
 

@@ -55,6 +55,7 @@ from .faults import (
     scoped,
 )
 from .matrix import (
+    run_fleet_matrix,
     run_handoff_matrix,
     run_hier_cells,
     run_integrity_cells,
@@ -62,6 +63,7 @@ from .matrix import (
     run_persistent_cells,
     run_quant_cells,
     run_scheduler_matrix,
+    verify_fleet_matrix,
     verify_handoff_matrix,
     verify_matrix,
     verify_scheduler_matrix,
@@ -74,6 +76,7 @@ from .policy import (
     breaker,
     guarded,
     health_snapshot,
+    quarantined_replicas,
     reset_breaker,
     resilient_call,
 )
@@ -89,13 +92,15 @@ __all__ = [
     "TimeoutDiagnosis", "breaker", "call_with_deadline", "check_hazards",
     "clean_ticks", "deadline_ms", "enable", "enabled", "fallbacks", "faults",
     "guarded", "health_snapshot", "integrity", "matrix", "policy",
-    "protocol_pending",
+    "protocol_pending", "quarantined_replicas",
     "record_faulty_case", "reset_breaker", "resilient_call", "run_bounded",
-    "run_handoff_matrix", "run_hier_cells", "run_integrity_cells",
+    "run_fleet_matrix", "run_handoff_matrix", "run_hier_cells",
+    "run_integrity_cells",
     "run_matrix", "run_persistent_cells", "run_quant_cells",
     "run_scheduler_matrix",
     "sample_spec", "scoped",
-    "simulate", "suppress", "suppressed_thunk", "verify_handoff_matrix",
+    "simulate", "suppress", "suppressed_thunk", "verify_fleet_matrix",
+    "verify_handoff_matrix",
     "verify_matrix", "verify_scheduler_matrix", "watchdog",
 ]
 

@@ -735,6 +735,438 @@ def run_matrix(seed: int = 0, *, kernels=DEFAULT_KERNELS, ranks: int = 4,
     return rows
 
 
+# -- fleet cells (ISSUE 18): the N-replica serving topology's threat
+# model (docs/robustness.md "Fleet tier").  Each cell drives a REAL
+# 2-prefill + 2-decode FleetRouter (serve.fleet over deterministic
+# SimBackends and the ModeledDCN transport) under a seeded multi-request
+# load with one fleet fault injected, then classifies:
+#
+#   detected  — the fault produced its NAMED artifact (the lost/flapping
+#               REPLICA is named; its breaker/quarantine walked) AND
+#               every faulted request still completed on a SURVIVOR with
+#               token parity vs the unfaulted golden, with zero pages
+#               leaked on EVERY replica (page-lifecycle discharge per
+#               pool, not just free-list counters);
+#   survived  — the condition was absorbed by a membership decision
+#               (rebalance converted a drained donor; a quarantined
+#               replica re-earned admission through probes): everything
+#               completed, nothing leaked.
+#
+# Anything else is a membership breach ``verify_fleet_matrix`` turns
+# into a CI problem.  FLEET_GOLDEN pins (fault -> leg/outcome) and
+# ``analysis.completeness.check_fleet_coverage`` asserts it stays in
+# lockstep with the live FleetFault enum BOTH directions.
+
+FLEET_GOLDEN = {
+    "replica_abort_mid_decode": {"leg": "failover", "outcome": "detected"},
+    "replica_flap": {"leg": "quarantine", "outcome": "detected"},
+    "rebalance_under_load": {"leg": "rebalance", "outcome": "survived"},
+    "quarantine_readmit": {"leg": "readmit", "outcome": "survived"},
+}
+
+
+class _FlapInjector:
+    """Decode-step fault hook raising ``RankAborted`` on every dispatch
+    whose backend step counter falls in ``[first, last]`` — a flapping
+    replica, not a one-shot fault."""
+
+    def __init__(self, first: int, last: int, *, rank: int = 0):
+        self.first = first
+        self.last = last
+        self.rank = rank
+        self.fired = 0
+
+    def __call__(self, step: int) -> None:
+        if self.first <= step <= self.last:
+            from .faults import RankAborted
+
+            self.fired += 1
+            raise RankAborted(self.rank, step)
+
+
+def _reset_fleet_breakers() -> None:
+    """Cells must not inherit (or donate) quarantine state through the
+    process-global ``replica:<id>`` breakers (ids repeat across cells)
+    or the handoff-transfer breaker."""
+    from . import policy
+    from ..serve.fleet import REPLICA_BREAKER_PREFIX
+    from ..serve.handoff import HANDOFF_OP
+
+    with policy._BREAKERS_LOCK:
+        ops = [op for op in policy._BREAKERS
+               if op.startswith(REPLICA_BREAKER_PREFIX)]
+    for op in ops:
+        policy.reset_breaker(op)
+    policy.reset_breaker(HANDOFF_OP)
+
+
+def _fleet_setup(rng, *, decode_slots: int = 3, decode_pool: int = 32,
+                 step_hooks: dict | None = None, config=None):
+    """The seeded 2-prefill + 2-decode fleet every cell drives
+    (``p0 p1 d0 d1``); ``step_hooks`` maps a replica id to a SimBackend
+    decode-step hook (the flap injection point)."""
+    from ..serve import (
+        FleetRouter, HandoffPlane, ModeledDCN, Replica, Scheduler,
+        SchedulerConfig, SimBackend,
+    )
+
+    hooks = step_hooks or {}
+    replicas = []
+    for i in range(2):
+        rid = f"p{i}"
+        replicas.append(Replica(
+            rid,
+            Scheduler(
+                SimBackend(slots=3, page_size=4, pool_pages=24,
+                           max_length=64, step_hook=hooks.get(rid)),
+                SchedulerConfig(max_queue_depth=32, prefill_only=True)),
+            "prefill"))
+    for i in range(2):
+        rid = f"d{i}"
+        replicas.append(Replica(
+            rid,
+            Scheduler(
+                SimBackend(slots=decode_slots, page_size=4,
+                           pool_pages=decode_pool, max_length=64,
+                           step_hook=hooks.get(rid)),
+                SchedulerConfig(max_queue_depth=32)),
+            "decode"))
+    plane = HandoffPlane(dcn_channel=ModeledDCN(
+        seed=rng.randrange(1 << 16)))
+    return FleetRouter(replicas, plane=plane, config=config)
+
+
+def _fleet_requests(rng, n: int, *, max_new=(4, 8)) -> list:
+    from ..serve import Request
+
+    return [
+        Request(prompt=tuple(rng.randrange(1, 90)
+                             for _ in range(rng.randint(2, 6))),
+                max_new_tokens=rng.randint(*max_new))
+        for _ in range(n)
+    ]
+
+
+def _fleet_row(router, reqs, kind, leg, rec) -> dict:
+    from ..serve import RequestState
+
+    backend = router.replicas[0].scheduler.backend
+    leaked_by = {rep.replica_id: rep.scheduler.pool.used_pages
+                 for rep in router.replicas}
+    return {
+        "kernel": "serve/fleet", "fault": kind.value, "leg": leg,
+        "requests": len(reqs),
+        "completed": sum(r.state is RequestState.DONE for r in reqs),
+        "failed": sum(r.state is RequestState.FAILED for r in reqs),
+        "shed": sum(r.state is RequestState.SHED for r in reqs),
+        "parity": all(r.tokens == backend.expected_tokens(r)
+                      for r in reqs if r.state is RequestState.DONE),
+        "pages_leaked": router.leaked_pages(),
+        "pages_leaked_by_replica": leaked_by,
+        "handoffs": router.handoffs, "colocated": router.colocated,
+        "reprefills": router.reprefills, "failovers": router.failovers,
+        "quarantined": [r.replica_id for r in router.replicas
+                        if r.quarantined],
+        "readmissions": list(router.readmissions),
+        "rebalances": list(router.rebalances),
+        **_lifecycle_summary(rec),
+    }
+
+
+def _fleet_abort_cell(rng) -> dict:
+    """replica_abort_mid_decode: a decode replica dies with residents
+    mid-decode; every resident re-prefills on the survivor, original
+    clock carried, zero pages left behind."""
+    from ..serve import FleetConfig, FleetFault, RequestState
+
+    from ..analysis import pages as _pages
+
+    _reset_fleet_breakers()
+    router = _fleet_setup(rng, config=FleetConfig(
+        probe_interval_steps=1 << 30))
+    reqs = _fleet_requests(rng, 8, max_new=(6, 10))
+    victim_id = None
+    moved: list[int] = []
+    with _pages.record() as rec:
+        for r in reqs:
+            router.submit(r)
+        for _ in range(400):
+            router.step()
+            cand = next(
+                (rep for rep in router.replicas
+                 if rep.role == "decode" and any(
+                     s is not None
+                     and s.request.state is RequestState.DECODE
+                     for s in rep.scheduler.slots)),
+                None)
+            if cand is not None:
+                victim_id = cand.replica_id
+                moved = router.lose_replica(
+                    victim_id, reason="injected mid-decode replica loss")
+                break
+        router.run_until_idle(max_steps=4000)
+    row = _fleet_row(router, reqs, FleetFault.REPLICA_ABORT_MID_DECODE,
+                     "failover", rec)
+    row["fired"] = victim_id is not None and bool(moved)
+    row["replica"] = victim_id
+    row["moved"] = len(moved)
+    complete = all(r.state is RequestState.DONE for r in reqs)
+    # a LOST replica is not "quarantined" (loss is terminal, quarantine
+    # is probation) — it must show up in lost_replicas instead, and no
+    # survivor may have been collaterally quarantined
+    lost_ok = (victim_id in router.lost_replicas
+               and row["quarantined"] == [])
+    if row["fired"] and complete and row["parity"] \
+            and not row["pages_leaked"] and lost_ok:
+        row["outcome"] = "detected"
+        row["named"] = [victim_id, "replica_lost"]
+        row["detail"] = (
+            f"replica {victim_id} lost with {len(moved)} resident(s); "
+            f"all re-prefilled on survivors with token parity, zero "
+            f"pages leaked on every replica")
+    else:
+        row["outcome"] = "unisolated"
+        row["named"] = []
+        row["detail"] = (
+            f"fired={row['fired']} complete={complete} "
+            f"parity={row['parity']} leaked={row['pages_leaked']} "
+            f"quarantined={row['quarantined']}")
+    _reset_fleet_breakers()
+    return row
+
+
+def _fleet_flap_cell(rng, *, readmit: bool) -> dict:
+    """replica_flap / quarantine_readmit: a decode replica aborts every
+    dispatch in a step window; its sticky breaker walks open, it drains
+    and evicts.  With ``readmit`` the probe ladder then re-earns
+    admission once the flap clears."""
+    from ..serve import FleetConfig, FleetFault, RequestState
+    from . import policy as _policy
+    from ..serve.fleet import replica_breaker_name
+
+    from ..analysis import pages as _pages
+
+    _reset_fleet_breakers()
+    kind = FleetFault.QUARANTINE_READMIT if readmit \
+        else FleetFault.REPLICA_FLAP
+    leg = FLEET_GOLDEN[kind.value]["leg"]
+    inj = _FlapInjector(2, 12, rank=rng.randrange(4))
+    router = _fleet_setup(
+        rng, step_hooks={"d1": inj},
+        config=FleetConfig(
+            flap_threshold=3,
+            probe_interval_steps=8 if readmit else 1 << 30,
+            readmit_probe_successes=2))
+    reqs = _fleet_requests(rng, 10, max_new=(6, 10))
+    with _pages.record() as rec:
+        for r in reqs:
+            router.submit(r)
+        for _ in range(2000):
+            res = router.step()
+            if readmit and router.readmissions:
+                break
+            if not readmit and res.idle and "d1" in [
+                    rep.replica_id for rep in router.replicas
+                    if rep.quarantined]:
+                break
+        router.run_until_idle(max_steps=4000)
+    row = _fleet_row(router, reqs, kind, leg, rec)
+    row["fired"] = inj.fired >= 3
+    row["replica"] = "d1"
+    row["flaps"] = inj.fired
+    complete = all(r.state is RequestState.DONE for r in reqs)
+    breaker_open = _policy.breaker(replica_breaker_name("d1")).open
+    if readmit:
+        ok = (row["fired"] and complete and row["parity"]
+              and not row["pages_leaked"]
+              and "d1" in router.quarantined_history
+              and "d1" in router.readmissions
+              and not breaker_open and row["quarantined"] == [])
+        if ok:
+            row["outcome"] = "survived"
+            row["named"] = ["d1"]
+            row["detail"] = (
+                f"replica d1 flapped {inj.fired}x into quarantine, "
+                f"then re-earned admission through "
+                f"{router.cfg.readmit_probe_successes} green probe(s); "
+                f"all requests completed with parity, zero leaks")
+        else:
+            row["outcome"] = "unisolated"
+            row["named"] = []
+            row["detail"] = (
+                f"fired={row['fired']} complete={complete} "
+                f"parity={row['parity']} leaked={row['pages_leaked']} "
+                f"quarantined_hist={router.quarantined_history} "
+                f"readmissions={router.readmissions} "
+                f"breaker_open={breaker_open}")
+    else:
+        ok = (row["fired"] and complete and row["parity"]
+              and not row["pages_leaked"]
+              and row["quarantined"] == ["d1"] and breaker_open
+              and router.failovers >= 1)
+        if ok:
+            row["outcome"] = "detected"
+            row["named"] = ["d1", "RankAborted"]
+            row["detail"] = (
+                f"replica d1 flapped {inj.fired}x; breaker "
+                f"replica:d1 open, drained then evicted (exactly d1 "
+                f"quarantined); {router.failovers} failover(s) "
+                f"completed on survivors with parity, zero leaks")
+        else:
+            row["outcome"] = "unisolated"
+            row["named"] = []
+            row["detail"] = (
+                f"fired={row['fired']} complete={complete} "
+                f"parity={row['parity']} leaked={row['pages_leaked']} "
+                f"quarantined={row['quarantined']} "
+                f"breaker_open={breaker_open} "
+                f"failovers={router.failovers}")
+    _reset_fleet_breakers()
+    return row
+
+
+def _fleet_rebalance_cell(rng) -> dict:
+    """rebalance_under_load: sustained decode-dominant p99 attribution
+    with the decode role pressured recruits a drained prefill replica
+    into the decode role (drain-before-convert; the donor role keeps a
+    member).  Needs the trace plane armed — the actuation signal IS the
+    attributor's dominant_phase over live exemplars."""
+    from .. import obs
+    from ..obs import request_trace as rtrace
+    from ..serve import FleetConfig, FleetFault, RequestState
+
+    from ..analysis import pages as _pages
+
+    _reset_fleet_breakers()
+    prev_obs = obs.enable(True)
+    prev_trace = rtrace.enable(True)
+    rtrace.RING.clear()
+    obs.serve_stats.STATS.reset()
+    try:
+        # tiny decode pools + colocation effectively off (prompts PARK
+        # in handoff until a decode slot frees): adopted requests
+        # outgrow the pools (preemption thrash), the parked backlog
+        # makes the p99 handoff/decode-dominant, and the low pressure
+        # threshold keeps both decode replicas reading saturated —
+        # decode-capacity shortage by construction.  The load is
+        # SUSTAINED: decode-heavy waves keep arriving until the
+        # membership converts (the p99 exemplar rides wall-clock
+        # request_ms, so any single wave's tick alignment is timing-
+        # sensitive; sustained demand is what the actuator is FOR).
+        router = _fleet_setup(
+            rng, decode_slots=2, decode_pool=10,
+            config=FleetConfig(
+                rebalance_interval_steps=2, rebalance_sustain=2,
+                adopt_patience_steps=10_000, pool_pressure=0.55,
+                probe_interval_steps=1 << 30))
+        reqs: list = []
+        with _pages.record() as rec:
+            for _wave in range(6):
+                wave = _fleet_requests(rng, 12, max_new=(16, 24))
+                reqs.extend(wave)
+                for r in wave:
+                    router.submit(r)
+                router.run_until_idle(max_steps=6000)
+                # a recruit initiated on the final drain steps converts
+                # on the next (idle) ticks
+                for _ in range(50):
+                    if router._recruit is None:
+                        break
+                    router.step()
+                if router.rebalances:
+                    break
+    finally:
+        obs.serve_stats.STATS.reset()
+        rtrace.RING.clear()
+        rtrace.enable(prev_trace)
+        obs.enable(prev_obs)
+    row = _fleet_row(router, reqs, FleetFault.REBALANCE_UNDER_LOAD,
+                     "rebalance", rec)
+    converted = [rb for rb in router.rebalances
+                 if rb["from"] == "prefill" and rb["to"] == "decode"]
+    row["fired"] = bool(converted)
+    row["replica"] = converted[0]["replica"] if converted else None
+    row["convergence_steps"] = router.last_convergence_steps
+    complete = all(r.state is RequestState.DONE for r in reqs)
+    roles = {role: sum(1 for rep in router.replicas if rep.role == role)
+             for role in ("prefill", "decode")}
+    if row["fired"] and complete and row["parity"] \
+            and not row["pages_leaked"] and roles["prefill"] >= 1:
+        row["outcome"] = "survived"
+        row["named"] = [converted[0]["replica"]]
+        row["detail"] = (
+            f"decode-dominant p99 under pressure recruited "
+            f"{converted[0]['replica']} prefill->decode in "
+            f"{converted[0]['convergence_steps']} step(s); roles now "
+            f"{roles}; all requests completed with parity, zero leaks")
+    else:
+        row["outcome"] = "unisolated"
+        row["named"] = []
+        row["detail"] = (
+            f"fired={row['fired']} complete={complete} "
+            f"parity={row['parity']} leaked={row['pages_leaked']} "
+            f"rebalances={router.rebalances} roles={roles}")
+    _reset_fleet_breakers()
+    return row
+
+
+def run_fleet_matrix(seed: int = 0) -> list[dict]:
+    """The fleet fault cells: one per :class:`~..serve.fleet.FleetFault`
+    class, in enum order (``FLEET_GOLDEN`` pins the expected leg and
+    outcome; ``analysis.completeness`` pins golden <-> enum both
+    directions)."""
+    rng = random.Random(seed)
+    return [
+        _fleet_abort_cell(rng),
+        _fleet_flap_cell(rng, readmit=False),
+        _fleet_rebalance_cell(rng),
+        _fleet_flap_cell(rng, readmit=True),
+    ]
+
+
+def verify_fleet_matrix(rows: list[dict]) -> list[str]:
+    """CI problems in the fleet cells (empty = pass): both-directions
+    coverage against FLEET_GOLDEN, every injection landed, every cell's
+    outcome matches its golden, the faulted REPLICA is named, and zero
+    pages leaked on EVERY replica (per-pool lifecycle discharge)."""
+    problems = []
+    seen = {row["fault"] for row in rows}
+    for missing in sorted(set(FLEET_GOLDEN) - seen):
+        problems.append(
+            f"fleet fault class {missing!r} has a golden row but no "
+            f"matrix cell ran for it")
+    for extra in sorted(seen - set(FLEET_GOLDEN)):
+        problems.append(
+            f"fleet matrix cell {extra!r} has no FLEET_GOLDEN row — "
+            f"pin its leg and outcome")
+    for row in rows:
+        key = f"{row['kernel']} x {row['fault']}/{row['leg']}"
+        golden = FLEET_GOLDEN.get(row["fault"])
+        if not row["fired"]:
+            problems.append(f"{key}: injection never landed — "
+                            f"{row['detail']}")
+            continue
+        leaked = {rid: n for rid, n
+                  in row["pages_leaked_by_replica"].items() if n}
+        if leaked:
+            problems.append(
+                f"{key}: page(s) leaked per replica: {leaked}")
+        if golden is not None and row["outcome"] != golden["outcome"]:
+            problems.append(
+                f"{key}: expected {golden['outcome']!r}, got "
+                f"{row['outcome']!r} — {row['detail']}")
+        if golden is not None and row["leg"] != golden["leg"]:
+            problems.append(
+                f"{key}: leg drifted — golden {golden['leg']!r}, "
+                f"ran {row['leg']!r}")
+        if row["outcome"] in ("detected", "survived") \
+                and not row.get("replica"):
+            problems.append(
+                f"{key}: {row['outcome']} but no replica named")
+        problems.extend(_lifecycle_problems(key, row))
+    return problems
+
+
 def verify_matrix(rows: list[dict], *, min_kernels_per_class: int = 3,
                   kinds=FAULT_KINDS) -> list[str]:
     """CI problems in a matrix run (empty = pass):

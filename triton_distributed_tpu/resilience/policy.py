@@ -329,6 +329,18 @@ class AdmissionGovernor:
         }
 
 
+def quarantined_replicas() -> list[str]:
+    """Fleet replicas whose per-replica sticky breaker
+    (``replica:<id>``, ``serve.fleet``) is open — the replica-granular
+    twin of ``integrity.quarantined_peers()``.  Replica ids are
+    strings (they key schedulers, gauges and page-lifecycle pools),
+    so no int cast."""
+    prefix = "replica:"
+    with _BREAKERS_LOCK:
+        return sorted(op[len(prefix):] for op, b in _BREAKERS.items()
+                      if op.startswith(prefix) and b.open)
+
+
 def health_snapshot() -> dict:
     """Point-in-time serving-health view: breaker states, last errors,
     and the resilience counters — the engine's ``/health`` payload."""
@@ -361,6 +373,10 @@ def health_snapshot() -> dict:
         # corruption, resilience.integrity — /healthz flips 503 because
         # an open peer breaker lands in degraded_ops too)
         "quarantined_peers": integrity.quarantined_peers(),
+        # fleet replicas whose replica:<id> breaker is open (flap
+        # quarantine or hard loss, serve.fleet — same 503-via-
+        # degraded_ops mechanics as the peer quarantine above)
+        "quarantined_replicas": quarantined_replicas(),
         "obs_enabled": obs.enabled(),
         "breakers": breakers,
         "last_errors": dict(sorted(_LAST_ERROR.items())),

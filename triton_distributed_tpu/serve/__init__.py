@@ -44,20 +44,32 @@ from .handoff import (
     implant_payload,
     verify_payload,
 )
+from .fleet import (
+    FLEET_FAULT_KINDS,
+    REPLICA_BREAKER_PREFIX,
+    FleetConfig,
+    FleetFault,
+    FleetRouter,
+    FleetStepResult,
+    Replica,
+    replica_breaker_name,
+)
 from .queue import Request, RequestQueue, RequestState, TERMINAL_STATES
 from .router import DisaggRouter, RouterConfig, RouterStepResult
 from .scheduler import Scheduler, SchedulerConfig, SlotState, StepResult
 from .trace import Arrival, TraceReport, replay, synthetic_trace
 
 __all__ = [
-    "Arrival", "DisaggRouter", "EngineBackend", "HANDOFF_FAULT_KINDS",
+    "Arrival", "DisaggRouter", "EngineBackend", "FLEET_FAULT_KINDS",
+    "FleetConfig", "FleetFault", "FleetRouter", "FleetStepResult",
+    "HANDOFF_FAULT_KINDS",
     "HANDOFF_OP", "HandoffConfig", "HandoffFault", "HandoffPlane",
     "ModeledDCN", "PageLifecycleError", "PagePayload", "PagePool",
-    "PagePoolExhausted",
+    "PagePoolExhausted", "REPLICA_BREAKER_PREFIX", "Replica",
     "Request", "RequestQueue", "RequestState", "RouterConfig",
     "RouterStepResult", "SCRAP_PAGE", "Scheduler", "SchedulerConfig",
     "SimBackend", "SlotState", "StepResult", "TERMINAL_STATES",
     "TraceReport", "WireFault", "extract_payload", "implant_payload",
-    "pages_needed", "replay", "scrub_enabled", "synthetic_trace",
-    "verify_payload",
+    "pages_needed", "replay", "replica_breaker_name", "scrub_enabled",
+    "synthetic_trace", "verify_payload",
 ]

@@ -1,0 +1,993 @@
+"""The fleet tier: N-replica serving with replica-loss failover,
+per-replica quarantine, and SLO-driven rebalance (ROADMAP item 4).
+
+:class:`DisaggRouter` scaled serving across PHASES — one prefill tier,
+one decode tier.  :class:`FleetRouter` scales it across REPLICAS: N
+:class:`~.scheduler.Scheduler` pools, each playing a prefill or decode
+ROLE, sharing one handoff plane.  Three loops close here:
+
+**Admission routing** is telemetry-driven over the same live gauges
+``/metrics`` publishes: a request lands on the least-loaded ADMITTING
+replica of its role (queue-depth fraction + pool occupancy), with
+session AFFINITY — a session that already decoded on replica ``d1``
+keeps landing on ``d1``, where its KV pages live — overridden only when
+that replica is pressured or quarantined.
+
+**The robustness core** is membership that survives faults:
+
+- ``lose_replica``: a replica dying mid-decode re-prefills every
+  resident request on a survivor through the existing
+  retry→fallback→re-prefill ladder — pages reclaimed first (the
+  page-lifecycle recorder sees every free), audit stamps carried on
+  ``Request.kv_stamps`` exactly like a preemption (``_preempt_slot``'s
+  carry rule), ORIGINAL submit clock and trace chain preserved so the
+  lost replica's time stays on the request's latency sample.
+- A FLAPPING replica (repeated step failures) walks its per-replica
+  sticky breaker (``replica:<id>`` — the per-peer quarantine shape of
+  ``resilience.integrity``) open: the replica DRAINS first (refuses
+  admission, finishes residents), then evicts from membership, then
+  re-earns admission through suppressed PROBE requests
+  (``readmit_probe_successes`` consecutive green probes reset the
+  breaker).  ``resilience.health_snapshot()`` reports the quarantine
+  set as ``quarantined_replicas``.
+
+**Rebalance** closes the measurement→actuation loop: the PR-13
+attributor's ``dominant_phase`` over the live p99 sketch EXEMPLARS
+(``request_ms`` for decode dominance, ``ttft_ms`` for prefill/queue
+dominance), cross-checked against role-wide pressure, recruits a
+replica from the other role — drain-before-convert, the donor role
+never empties — and ``fleet_rebalance_convergence_steps`` (bench-gated)
+counts detection→conversion.
+
+Fault coverage lands in ``resilience.matrix`` as :data:`FleetFault`
+cells (golden-pinned both directions by ``analysis.completeness``);
+``scripts/tdt_lint.py --fleet`` replays the seeded N=4 fleet with an
+abort and a flap injected and gates token parity + exact quarantine +
+zero leaked pages per replica.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+
+from .. import obs
+from . import handoff as handoff_mod
+from .budget import pages_needed
+from .queue import Request, RequestState
+from .scheduler import Scheduler, StepResult
+
+# the per-replica sticky breaker namespace — the same shape as the
+# integrity plane's per-peer "peer:<rank>" quarantine: an open
+# "replica:<id>" breaker IS the quarantine membership bit, and
+# resilience.health_snapshot() aggregates the open set as
+# ``quarantined_replicas``
+REPLICA_BREAKER_PREFIX = "replica:"
+
+
+def replica_breaker_name(replica_id: str) -> str:
+    return REPLICA_BREAKER_PREFIX + str(replica_id)
+
+
+class FleetFault(enum.Enum):
+    """The fleet fault classes the matrix must cover (golden-pinned in
+    ``resilience.matrix.FLEET_GOLDEN``; ``analysis.completeness``
+    asserts the two stay in lockstep both directions)."""
+
+    REPLICA_ABORT_MID_DECODE = "replica_abort_mid_decode"
+    REPLICA_FLAP = "replica_flap"
+    REBALANCE_UNDER_LOAD = "rebalance_under_load"
+    QUARANTINE_READMIT = "quarantine_readmit"
+
+
+FLEET_FAULT_KINDS = tuple(f.value for f in FleetFault)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet knobs.  The routing thresholds (``queue_pressure``,
+    ``pool_pressure``) and pump knobs mirror :class:`RouterConfig`;
+    the rest parameterize the quarantine walk and the rebalance loop."""
+
+    max_transfers_per_step: int = 4
+    queue_pressure: float = 0.75
+    pool_pressure: float = 0.95
+    colocate_on_saturation: bool = True
+    adopt_patience_steps: int = 2
+    bulk_bytes_per_step: int = 0
+    step_wall_ms: float = 1.0
+    # consecutive step failures before a replica's sticky breaker opens
+    # (drain begins); the same threshold re-arms it during probation
+    flap_threshold: int = 3
+    # fleet steps between readmission probes of a quarantined replica
+    probe_interval_steps: int = 16
+    # consecutive green probes that re-earn admission
+    readmit_probe_successes: int = 2
+    # scheduler steps one probe request may take before it counts failed
+    probe_max_steps: int = 64
+    # failover ladder depth per request: a request that keeps failing on
+    # SURVIVORS is the request's fault, not the fleet's — replaying it
+    # forever would replay the fault forever
+    max_failovers_per_request: int = 2
+    # fleet steps between rebalance evaluations of the p99 exemplars
+    rebalance_interval_steps: int = 16
+    # consecutive dominant-phase evaluations before a recruit begins
+    # (one anomalous window must not flip membership)
+    rebalance_sustain: int = 2
+    rebalance_enabled: bool = True
+
+
+@dataclasses.dataclass
+class Replica:
+    """One fleet member: a scheduler pool plus its membership bits.
+    ``draining``: refuses admission, finishes residents.  ``evicted``:
+    out of membership (quarantined — probes may readmit it).  ``lost``:
+    gone for good (crash/partition); never probed, never readmitted.
+    ``recruiting``: draining toward a ROLE conversion, not an
+    eviction."""
+
+    replica_id: str
+    scheduler: Scheduler
+    role: str                     # "prefill" | "decode"
+    draining: bool = False
+    evicted: bool = False
+    lost: bool = False
+    recruiting: bool = False
+    probe_successes: int = 0
+    # high-water mark into scheduler.failed the flap watcher has seen
+    _seen_failed: int = 0
+
+    @property
+    def quarantined(self) -> bool:
+        return self.evicted and not self.lost
+
+
+@dataclasses.dataclass
+class FleetStepResult:
+    """What one fleet ``step()`` did, per stepped replica plus the
+    fleet-level deltas."""
+
+    results: dict[str, StepResult]
+    handoffs: int = 0
+    colocated: int = 0
+    reprefills: int = 0
+    failovers: int = 0
+
+    @property
+    def idle(self) -> bool:
+        return all(r.idle for r in self.results.values())
+
+
+class FleetRouter:
+    """N schedulers + one handoff plane (see module docstring).
+    Single-threaded like the schedulers it drives; ``submit`` is as
+    thread-safe as theirs."""
+
+    def __init__(self, replicas, *,
+                 plane: handoff_mod.HandoffPlane | None = None,
+                 config: FleetConfig | None = None):
+        replicas = list(replicas)
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        seen: set[str] = set()
+        for rep in replicas:
+            if rep.role not in ("prefill", "decode"):
+                raise ValueError(
+                    f"replica {rep.replica_id!r}: role must be "
+                    f"'prefill' or 'decode', got {rep.role!r}")
+            if rep.replica_id in seen:
+                raise ValueError(
+                    f"duplicate replica id {rep.replica_id!r} — ids key "
+                    f"breakers, gauges and page-lifecycle pools, they "
+                    f"must be unique")
+            seen.add(rep.replica_id)
+            want = rep.role == "prefill"
+            if rep.scheduler.cfg.prefill_only != want:
+                raise ValueError(
+                    f"replica {rep.replica_id!r} has role {rep.role!r} "
+                    f"but its SchedulerConfig.prefill_only is "
+                    f"{rep.scheduler.cfg.prefill_only} — a prefill "
+                    f"replica must park finished prompts in HANDOFF "
+                    f"(prefill_only=True) and a decode replica must "
+                    f"decode locally (prefill_only=False)")
+        for role in ("prefill", "decode"):
+            if not any(r.role == role for r in replicas):
+                raise ValueError(
+                    f"a fleet needs at least one {role!r}-role replica")
+        # page GEOMETRY must match fleet-wide for a handoff implant (and
+        # a failover re-prefill's stamp carry) to land on ANY member —
+        # the DisaggRouter check, applied pairwise against replica 0
+        k0 = replicas[0].scheduler.cache.k
+        for rep in replicas[1:]:
+            k = rep.scheduler.cache.k
+            if (k0.shape[0], k0.shape[2:]) != (k.shape[0], k.shape[2:]):
+                raise ValueError(
+                    f"replica {rep.replica_id!r} page geometry "
+                    f"(layers={k.shape[0]}, kv_heads={k.shape[2]}, "
+                    f"page_size={k.shape[3]}, head_dim={k.shape[4]}) "
+                    f"differs from replica "
+                    f"{replicas[0].replica_id!r}'s — a handoff payload "
+                    f"cannot be implanted across page shapes (pool "
+                    f"SIZES and kv dtypes may differ freely)")
+        self.replicas = replicas
+        self._by_id = {r.replica_id: r for r in replicas}
+        # request traces name the REPLICA each hop ran on
+        for rep in replicas:
+            rep.scheduler.trace_tier = rep.replica_id
+        # the re-prefill/failover stamp carry only pins a recompute on a
+        # pool with the SAME layout (router.py's rule, fleet-wide)
+        self._stamp_carry_ok = all(
+            rep.scheduler.cache.k.dtype == k0.dtype
+            and rep.scheduler.cache.quantized
+            == replicas[0].scheduler.cache.quantized
+            for rep in replicas)
+        self.plane = plane if plane is not None \
+            else handoff_mod.HandoffPlane()
+        self.cfg = config or FleetConfig()
+        self.steps = 0
+        self.handoffs = 0
+        self.colocated = 0
+        self.reprefills = 0
+        self.aborts = 0
+        self.failovers = 0
+        self.failover_shed = 0
+        self.reprefill_ids: set[int] = set()
+        self.failover_ids: set[int] = set()
+        self.lost_replicas: list[str] = []
+        self.quarantined_history: list[str] = []
+        self.readmissions: list[str] = []
+        self.rebalances: list[dict] = []
+        self.last_convergence_steps: int | None = None
+        self._park_strikes: dict[int, int] = {}
+        # session affinity: session key -> replica id where its pages
+        # (or its conversation's most recent pages) live
+        self._affinity: dict[str, str] = {}
+        self._session_of: dict[int, str] = {}
+        self._failover_count: dict[int, int] = {}
+        # pending role recruit: (replica, target role, detection step)
+        self._recruit: tuple[Replica, str, int] | None = None
+        self._dom_role: str | None = None
+        self._dom_count = 0
+        self._dom_first_step = 0
+
+    # -- membership predicates ---------------------------------------------
+
+    def _admitting(self, rep: Replica) -> bool:
+        """May new work land on this replica?  Membership flags plus
+        the live breaker — an open ``replica:<id>`` breaker refuses
+        admission even before the quarantine tick flips the flag."""
+        from .. import resilience
+
+        if rep.draining or rep.evicted or rep.lost:
+            return False
+        return not resilience.breaker(
+            replica_breaker_name(rep.replica_id),
+            self.cfg.flap_threshold).open
+
+    def _steppable(self, rep: Replica) -> bool:
+        return not (rep.lost or rep.evicted)
+
+    def _pressured(self, sched: Scheduler) -> bool:
+        if sched._saturated_since is not None:
+            return True
+        q = sched.queue.depth / sched.queue.max_depth
+        return (q >= self.cfg.queue_pressure
+                or sched.pool.occupancy() >= self.cfg.pool_pressure)
+
+    def _load(self, sched: Scheduler) -> float:
+        """The routing score: the SAME queue-depth fraction and pool
+        occupancy the replica's gauges publish."""
+        return (sched.queue.depth / sched.queue.max_depth
+                + sched.pool.occupancy())
+
+    def _fits(self, rep: Replica, req: Request) -> bool:
+        """Never-fits + queue-room screen (``Scheduler.submit`` would
+        shed; routing there would convert a survivable failover into a
+        terminal shed)."""
+        sched = rep.scheduler
+        total = req.prompt_len + req.max_new_tokens
+        return (total <= sched.backend.max_length
+                and pages_needed(total, sched.pool.page_size)
+                <= sched.pool.capacity
+                and sched.queue.depth < sched.queue.max_depth)
+
+    def _candidates(self, role: str, *, exclude: str | None = None,
+                    req: Request | None = None) -> list[Replica]:
+        out = [rep for rep in self.replicas
+               if rep.role == role and self._admitting(rep)
+               and rep.replica_id != exclude
+               and (req is None or self._fits(rep, req))]
+        out.sort(key=lambda r: (self._load(r.scheduler), r.replica_id))
+        return out
+
+    # -- admission routing -------------------------------------------------
+
+    def submit(self, req: Request, *, session: str | None = None,
+               now: float | None = None) -> bool:
+        """Telemetry-driven admission: session affinity first (the
+        session's pages live there), else the least-loaded admitting
+        prefill replica, else — every prefill replica pressured or
+        quarantined — the least-loaded admitting decode replica runs it
+        COLOCATED.  No admitting replica anywhere -> terminal shed."""
+        if session is not None:
+            self._session_of[req.req_id] = session
+            home = self._affinity.get(session)
+            rep = self._by_id.get(home) if home is not None else None
+            if rep is not None and self._admitting(rep) \
+                    and self._fits(rep, req) \
+                    and not self._pressured(rep.scheduler):
+                if obs.enabled():
+                    obs.counter("fleet_affinity_hits").inc()
+                if rep.role == "decode":
+                    self.colocated += 1
+                return rep.scheduler.submit(req, now=now)
+        prefills = self._candidates("prefill", req=req)
+        unpressured = [r for r in prefills
+                       if not self._pressured(r.scheduler)]
+        target = (unpressured or prefills)[0] if (unpressured or prefills) \
+            else None
+        if target is not None and self._pressured(target.scheduler):
+            # every admitting prefill replica is pressured: colocate on
+            # a healthy decode replica instead (the DisaggRouter move,
+            # fleet-wide)
+            decodes = [r for r in self._candidates("decode", req=req)
+                       if not self._pressured(r.scheduler)]
+            if decodes:
+                target = decodes[0]
+        if target is None:
+            decodes = self._candidates("decode", req=req)
+            target = decodes[0] if decodes else None
+        if target is None:
+            # no admitting replica can ever hold it: the fleet-level
+            # backpressure terminal, accounted like a queue shed
+            obs.request_trace.maybe_begin(req, "fleet")
+            req.state = RequestState.SHED
+            req.shed_reason = "no admitting replica in any role"
+            req.finished_s = time.monotonic() if now is None else now
+            obs.request_trace.finish(req)
+            if obs.enabled():
+                obs.serve_stats.STATS.request_shed()
+                obs.counter("fleet_shed_no_replica").inc()
+            return False
+        if target.role == "decode":
+            self.colocated += 1
+            if obs.enabled():
+                obs.counter("router_colocated_submits").inc()
+        ok = target.scheduler.submit(req, now=now)
+        if ok and session is not None:
+            self._affinity[session] = target.replica_id
+        return ok
+
+    # -- the step ----------------------------------------------------------
+
+    def step(self) -> FleetStepResult:
+        h0, c0, r0, f0 = (self.handoffs, self.colocated, self.reprefills,
+                          self.failovers)
+        self.steps += 1
+        results: dict[str, StepResult] = {}
+        # prefill-role replicas first (draining ones still step — they
+        # finish residents; evicted/lost ones don't)
+        for rep in self.replicas:
+            if rep.role == "prefill" and self._steppable(rep):
+                results[rep.replica_id] = rep.scheduler.step()
+                self._watch_failures(rep)
+        self._pump_handoffs()
+        obs.continuous.on_step("handoff", self.steps)
+        for rep in self.replicas:
+            if rep.role == "decode" and self._steppable(rep):
+                results[rep.replica_id] = rep.scheduler.step()
+                self._watch_failures(rep)
+        wire = getattr(self.plane.dcn, "wire", None)
+        if wire is not None:
+            wire.tick(self.cfg.step_wall_ms)
+        self._quarantine_tick()
+        self._probe_tick()
+        if self.cfg.rebalance_enabled:
+            self._rebalance_tick()
+        self._publish_gauges()
+        return FleetStepResult(
+            results=results,
+            handoffs=self.handoffs - h0,
+            colocated=self.colocated - c0,
+            reprefills=self.reprefills - r0,
+            failovers=self.failovers - f0,
+        )
+
+    def run_until_idle(self, *, max_steps: int = 100_000) -> int:
+        for _ in range(max_steps):
+            if self.step().idle:
+                return self.steps
+        raise RuntimeError(
+            f"fleet not idle after {max_steps} steps: "
+            f"{self.debug_state()}")
+
+    # -- replica loss + flap failover --------------------------------------
+
+    def lose_replica(self, replica_id: str, *,
+                     reason: str = "replica lost") -> list[int]:
+        """Hard loss mid-flight (crash, partition): evict the replica,
+        reclaim every resident page (the lifecycle recorder sees the
+        frees — nothing leaks with the pool), and re-prefill every
+        resident and queued request on a survivor.  Audit stamps carry
+        on ``Request.kv_stamps`` (the ``_preempt_slot`` rule) so the
+        recompute is verified like a preemption restore; the original
+        submit clock and trace chain ride along.  Returns the moved
+        request ids."""
+        from .. import resilience
+        from ..resilience import integrity
+
+        rep = self._by_id[replica_id]
+        if rep.lost:
+            return []
+        rep.lost = True
+        rep.evicted = True
+        rep.draining = True
+        self.lost_replicas.append(replica_id)
+        # walk the replica breaker fully open: membership math (and the
+        # health snapshot's quarantined_replicas) treats a lost replica
+        # as permanently quarantined — probes skip it, only an operator
+        # replacing the replica object brings the id back
+        br = resilience.breaker(replica_breaker_name(replica_id),
+                                self.cfg.flap_threshold)
+        while not br.open:
+            br.record_failure()
+        sched = rep.scheduler
+        moved: list[int] = []
+        for i, slot in enumerate(sched.slots):
+            if slot is None:
+                continue
+            req = slot.request
+            if integrity.enabled() and slot.page_stamps \
+                    and self._stamp_carry_ok and req.kv_stamps is None:
+                full_prompt = req.prompt_len // sched.pool.page_size
+                carry = {j: s for j, s in slot.page_stamps.items()
+                         if j < full_prompt}
+                req.kv_stamps = carry or None
+            sched._release_slot(i)
+            if self._failover(req, from_rid=replica_id, reason=reason,
+                              reopen=False):
+                moved.append(req.req_id)
+        while True:
+            req = sched.queue.pop()
+            if req is None:
+                break
+            if self._failover(req, from_rid=replica_id, reason=reason,
+                              reopen=False):
+                moved.append(req.req_id)
+        if obs.enabled():
+            obs.counter("fleet_replicas_lost").inc()
+        return moved
+
+    def _watch_failures(self, rep: Replica) -> None:
+        """The flap watcher: every NEW terminal failure on this replica
+        feeds its sticky breaker (deadline breaches excepted — those
+        are the request's SLO, not replica health) and rides the
+        failover ladder onto a survivor."""
+        from .. import resilience
+
+        new = rep.scheduler.failed[rep._seen_failed:]
+        rep._seen_failed = len(rep.scheduler.failed)
+        for req in new:
+            if (req.error or "").startswith("deadline"):
+                continue
+            opened = resilience.breaker(
+                replica_breaker_name(rep.replica_id),
+                self.cfg.flap_threshold).record_failure()
+            if opened and not rep.draining:
+                rep.draining = True
+                if obs.enabled():
+                    obs.counter("fleet_quarantine_drains").inc()
+            if self._failover_count.get(req.req_id, 0) \
+                    >= self.cfg.max_failovers_per_request:
+                continue   # replaying it again would replay the fault
+            self._failover(req, from_rid=rep.replica_id,
+                           reason=f"step failure on replica "
+                                  f"{rep.replica_id}: {req.error}",
+                           reopen=True)
+
+    def _failover(self, req: Request, *, from_rid: str, reason: str,
+                  reopen: bool) -> bool:
+        """Resubmit one displaced request on a survivor.  The ORIGINAL
+        submit timestamp survives (``RequestQueue.submit`` only stamps
+        ``submitted_s`` when unset) so the ``ttft_ms``/``request_ms``
+        sketches account the lost replica's time; ``reopen=True``
+        additionally un-closes a trace ``_fail_slot`` already finished,
+        so the resubmit's ``queue_wait`` extends the SAME gapless
+        chain with a ``resubmit`` tag."""
+        self._failover_count[req.req_id] = \
+            self._failover_count.get(req.req_id, 0) + 1
+        req.error = None
+        req.shed_reason = None
+        req.finished_s = None
+        req.tokens = []   # deterministic recompute from the prompt
+        if reopen:
+            obs.request_trace.reopen_for_failover(req)
+        if req.trace is not None:
+            req.trace.annotate("failover", tier=from_rid, reason=reason)
+        targets = (self._candidates("decode", exclude=from_rid, req=req)
+                   or self._candidates("prefill", exclude=from_rid,
+                                       req=req))
+        if not targets:
+            # no survivor can hold it: terminal shed, accounted at the
+            # fleet level — the pages were already reclaimed
+            req.state = RequestState.SHED
+            req.shed_reason = (f"no survivor replica can hold the "
+                               f"request after failover ({reason})")
+            req.finished_s = time.monotonic()
+            obs.request_trace.finish(req)
+            if obs.enabled():
+                obs.serve_stats.STATS.request_shed()
+                obs.counter("fleet_failover_shed").inc()
+            self.failover_shed += 1
+            return False
+        target = targets[0]
+        self.failovers += 1
+        self.failover_ids.add(req.req_id)
+        if obs.enabled():
+            obs.counter("fleet_failovers").inc()
+        ok = target.scheduler.submit(req)
+        if ok:
+            sess = self._session_of.get(req.req_id)
+            if sess is not None:
+                self._affinity[sess] = target.replica_id
+        return ok
+
+    # -- quarantine / readmission ------------------------------------------
+
+    def _drained(self, rep: Replica) -> bool:
+        sched = rep.scheduler
+        return (sched.queue.depth == 0
+                and all(s is None for s in sched.slots))
+
+    def _quarantine_tick(self) -> None:
+        """Drain-before-evict: an open breaker flips the replica to
+        draining (admission refused, residents finish); once drained it
+        evicts from membership and waits for probes."""
+        from .. import resilience
+
+        for rep in self.replicas:
+            if rep.lost or rep.recruiting:
+                continue
+            br = resilience.breaker(
+                replica_breaker_name(rep.replica_id),
+                self.cfg.flap_threshold)
+            if not br.open:
+                continue
+            if not rep.draining:
+                rep.draining = True
+                if obs.enabled():
+                    obs.counter("fleet_quarantine_drains").inc()
+            if not rep.evicted and self._drained(rep):
+                rep.evicted = True
+                rep.probe_successes = 0
+                self.quarantined_history.append(rep.replica_id)
+                if obs.enabled():
+                    obs.counter("fleet_quarantine_evictions").inc()
+
+    def _probe_tick(self) -> None:
+        """Readmission probes: every ``probe_interval_steps`` each
+        quarantined (evicted, not lost) replica runs one suppressed
+        probe request end-to-end; ``readmit_probe_successes``
+        consecutive greens readmit it, any red resets the count and
+        re-feeds the breaker."""
+        from .. import resilience
+
+        if self.steps % self.cfg.probe_interval_steps != 0:
+            return
+        for rep in self.replicas:
+            if not rep.quarantined:
+                continue
+            if self._probe(rep):
+                rep.probe_successes += 1
+                if rep.probe_successes >= self.cfg.readmit_probe_successes:
+                    self.readmit(rep.replica_id)
+            else:
+                rep.probe_successes = 0
+                resilience.breaker(
+                    replica_breaker_name(rep.replica_id),
+                    self.cfg.flap_threshold).record_failure()
+
+    def _probe(self, rep: Replica) -> bool:
+        """One canary request driven to a terminal state on the
+        quarantined replica, under ``obs.suppress()`` so probe traffic
+        never lands in the latency sketches or mints traces."""
+        sched = rep.scheduler
+        probe = Request(
+            prompt=(1, 2, 3),
+            max_new_tokens=1 if sched.cfg.prefill_only else 2)
+        ok = False
+        with obs.suppress():
+            if sched.submit(probe):
+                for _ in range(self.cfg.probe_max_steps):
+                    sched.step()
+                    if probe.state is RequestState.DONE:
+                        ok = True
+                        break
+                    if probe.state in (RequestState.FAILED,
+                                       RequestState.SHED):
+                        break
+        # probe outcomes must not feed the flap watcher as tenant
+        # failures — the probe loop scores them itself
+        rep._seen_failed = len(sched.failed)
+        if obs.enabled():
+            obs.counter("fleet_probes",
+                        outcome="ok" if ok else "failed").inc()
+        return ok
+
+    def readmit(self, replica_id: str) -> None:
+        """Re-enter membership after probation: breaker reset, flags
+        cleared; the replica starts taking new admissions next step."""
+        from .. import resilience
+
+        rep = self._by_id[replica_id]
+        if rep.lost:
+            raise ValueError(
+                f"replica {replica_id!r} was LOST, not quarantined — "
+                f"readmission needs a replacement replica, not a "
+                f"breaker reset")
+        resilience.reset_breaker(replica_breaker_name(replica_id))
+        rep.draining = False
+        rep.evicted = False
+        rep.probe_successes = 0
+        self.readmissions.append(replica_id)
+        if obs.enabled():
+            obs.counter("fleet_readmissions").inc()
+
+    # -- SLO-driven rebalance ----------------------------------------------
+
+    def _role_pressured(self, role: str) -> bool:
+        admitting = [r for r in self.replicas
+                     if r.role == role and self._admitting(r)]
+        return bool(admitting) and all(
+            self._pressured(r.scheduler) for r in admitting)
+
+    def _dominant_role_demand(self) -> str | None:
+        """The measurement half of the loop: the attributor's
+        ``dominant_phase`` over the live p99 sketch exemplars,
+        cross-checked against role-wide pressure.  Decode demand reads
+        the ``request_ms`` p99: ``decode`` dominance directly, but also
+        ``preempted`` (decode-pool thrash — eviction-recompute cycles
+        ARE decode-capacity shortage) and ``handoff`` (prompts parked
+        because no decode replica can adopt).  Prefill demand reads the
+        ``ttft_ms`` p99: ``prefill`` or ``queue`` dominance with the
+        prefill role pressured."""
+        from ..obs import request_trace as rtrace
+
+        stats = obs.serve_stats.STATS
+
+        def dom(sketch):
+            ex = sketch.exemplar(0.99)
+            if ex is None:
+                return None
+            tr = rtrace.RING.get(ex)
+            if tr is None:
+                return None
+            return rtrace.attribute_request(tr).get("dominant_phase")
+
+        if self._role_pressured("decode"):
+            d = dom(stats.request_ms)
+            if d in ("decode", "preempted", "handoff"):
+                return "decode"
+            # queue-dominated end-to-end p99 with the decode role
+            # saturated and the prefill role healthy: the queue is
+            # backing up BEHIND the saturated decode tier (prefill
+            # slots parked in handoff with nowhere to adopt), so the
+            # binding constraint is still decode capacity
+            if d == "queue" and not self._role_pressured("prefill"):
+                return "decode"
+        if self._role_pressured("prefill") \
+                and dom(stats.ttft_ms) in ("prefill", "queue"):
+            return "prefill"
+        return None
+
+    def _rebalance_tick(self) -> None:
+        # a pending recruit converts the moment its donor drains —
+        # residents finish under the OLD role (drain-before-convert);
+        # one conversion in flight at a time
+        if self._recruit is not None:
+            rep, to_role, first_seen = self._recruit
+            if self._drained(rep):
+                self._convert(rep, to_role, first_seen)
+                self._recruit = None
+            return
+        if self.steps % self.cfg.rebalance_interval_steps != 0:
+            return
+        want = self._dominant_role_demand()
+        if want is None:
+            # the demand read is SPARSE (the p99 exemplar only moves
+            # when a request completes; pressure flickers as pools
+            # drain): a quiet tick neither confirms nor refutes the
+            # streak, so it doesn't reset it — only a CONTRARY read
+            # does
+            return
+        if want != self._dom_role:
+            self._dom_role = want
+            self._dom_count = 1
+            self._dom_first_step = self.steps
+            return
+        self._dom_count += 1
+        if self._dom_count < self.cfg.rebalance_sustain:
+            return
+        donor_role = "prefill" if want == "decode" else "decode"
+        donors = self._candidates(donor_role)
+        # the donor role must keep at least one admitting replica — a
+        # rebalance that empties a role trades saturation for outage
+        if len(donors) < 2:
+            return
+        donor = donors[0]   # least loaded = fastest to drain
+        donor.recruiting = True
+        donor.draining = True
+        self._recruit = (donor, want, self._dom_first_step)
+        self._dom_role = None
+        self._dom_count = 0
+        if obs.enabled():
+            obs.counter("fleet_recruits", role=want).inc()
+
+    def _convert(self, rep: Replica, to_role: str,
+                 first_seen: int) -> None:
+        from_role = rep.role
+        rep.scheduler.cfg = dataclasses.replace(
+            rep.scheduler.cfg, prefill_only=(to_role == "prefill"))
+        rep.role = to_role
+        rep.recruiting = False
+        rep.draining = False
+        steps = self.steps - first_seen
+        self.last_convergence_steps = steps
+        self.rebalances.append({
+            "replica": rep.replica_id, "from": from_role, "to": to_role,
+            "step": self.steps, "convergence_steps": steps,
+        })
+        if obs.enabled():
+            obs.counter("fleet_rebalances").inc()
+            obs.serve_stats.STATS.set_gauge(
+                "fleet_rebalance_convergence_steps", float(steps))
+
+    # -- the handoff pump ---------------------------------------------------
+
+    def _pump_handoffs(self) -> None:
+        with obs.span("router_pump", "step"):
+            self._pump_handoffs_impl()
+
+    def _pump_handoffs_impl(self) -> None:
+        from ..comm import dcn
+        from ..resilience.faults import RankAborted
+
+        if self.cfg.bulk_bytes_per_step:
+            wire = getattr(self.plane.dcn, "wire", None)
+            if wire is not None:
+                wire.send(self.cfg.bulk_bytes_per_step,
+                          priority=dcn.BULK)
+        budget = self.cfg.max_transfers_per_step
+        for rep in self.replicas:
+            if rep.role != "prefill" or not self._steppable(rep):
+                continue
+            if budget <= 0:
+                break
+            sched = rep.scheduler
+            for i in sched.handoff_ready():
+                if budget <= 0:
+                    break
+                budget -= 1
+                slot = sched.slots[i]
+                req = slot.request
+                target = self._adopt_target(rep, req)
+                if target is None:
+                    # no decode replica can take it: wait out a
+                    # transient busy spell, then shed back to colocated
+                    # mode BEFORE paying the wire
+                    strikes = self._park_strikes.get(req.req_id, 0) + 1
+                    self._park_strikes[req.req_id] = strikes
+                    if self.cfg.colocate_on_saturation and \
+                            strikes > self.cfg.adopt_patience_steps:
+                        self._park_strikes.pop(req.req_id, None)
+                        self._colocate(rep, i, req)
+                    continue
+                self._park_strikes.pop(req.req_id, None)
+                tr = req.trace
+                if tr is not None:
+                    tr.begin("handoff_extract", tier=rep.replica_id)
+                payload = handoff_mod.extract_payload(
+                    sched.cache, slot.pages, req, slot.next_token,
+                    wire_dtype=self.plane.cfg.wire_dtype,
+                    pool=sched.pool)
+                if tr is not None:
+                    tr.begin("handoff_transfer", tier=rep.replica_id,
+                             pages=payload.n_pages,
+                             bytes=payload.payload_bytes,
+                             wire=payload.wire, target=target.replica_id)
+                try:
+                    arrived = self.plane.transfer(payload, trace=tr)
+                except RankAborted as e:
+                    self.aborts += 1
+                    if obs.enabled():
+                        obs.counter("handoff_aborts").inc()
+                    self._reprefill(rep, i, req, payload,
+                                    reason=f"prefill replica "
+                                           f"{rep.replica_id} aborted "
+                                           f"mid-handoff ({e})")
+                    continue
+                if arrived is None:
+                    self._reprefill(rep, i, req, payload,
+                                    reason="transfer ladder exhausted")
+                    continue
+                dsched = target.scheduler
+                adopted = dsched.adopt_prefilled(
+                    req,
+                    lambda cache, pages: handoff_mod.implant_payload(
+                        cache, pages, arrived, pool=dsched.pool),
+                    length=arrived.prompt_len,
+                    next_token=arrived.first_token)
+                if adopted:
+                    sched.release_handoff(i)
+                    self.handoffs += 1
+                    sess = self._session_of.get(req.req_id)
+                    if sess is not None:
+                        self._affinity[sess] = target.replica_id
+                elif self.cfg.colocate_on_saturation:
+                    self._colocate(rep, i, req)
+                # else: stay parked; retried next step
+
+    def _adopt_target(self, src: Replica, req: Request) -> Replica | None:
+        """Where should this handoff land?  Session affinity first —
+        the session's earlier pages live there — else the least-loaded
+        admitting decode replica whose admission policy says yes."""
+        sess = self._session_of.get(req.req_id)
+        home = self._affinity.get(sess) if sess is not None else None
+        if home is not None and home != src.replica_id:
+            rep = self._by_id.get(home)
+            if rep is not None and rep.role == "decode" \
+                    and self._admitting(rep) \
+                    and rep.scheduler.can_adopt(req):
+                return rep
+        for rep in self._candidates("decode", exclude=src.replica_id):
+            if rep.scheduler.can_adopt(req):
+                return rep
+        return None
+
+    def _colocate(self, rep: Replica, i: int, req: Request) -> None:
+        rep.scheduler.colocate(i)
+        self.colocated += 1
+        sess = self._session_of.get(req.req_id)
+        if sess is not None:
+            self._affinity[sess] = rep.replica_id
+
+    def _reprefill(self, rep: Replica, i: int, req: Request,
+                   payload: handoff_mod.PagePayload, *,
+                   reason: str) -> None:
+        """The terminal fallback, fleet-wide: recompute the prompt on a
+        decode replica, verified against the producer's page stamps
+        exactly like a preemption restore."""
+        from ..resilience import integrity
+
+        targets = self._candidates("decode", exclude=rep.replica_id,
+                                   req=req)
+        if not targets:
+            # nowhere to recompute: colocating loses nothing — the
+            # pages are still in this replica's pool
+            self._colocate(rep, i, req)
+            return
+        target = targets[0]
+        req.tokens = []
+        if integrity.enabled() and payload.cache_stamps \
+                and self._stamp_carry_ok and req.kv_stamps is None:
+            req.kv_stamps = dict(payload.cache_stamps)
+        rep.scheduler.release_handoff(i)
+        self.reprefills += 1
+        self.reprefill_ids.add(req.req_id)
+        if req.trace is not None:
+            req.trace.annotate("reprefill", tier=target.replica_id,
+                               reason=reason)
+        if obs.enabled():
+            obs.counter("handoff_reprefills").inc()
+        if target.scheduler.submit(req):
+            sess = self._session_of.get(req.req_id)
+            if sess is not None:
+                self._affinity[sess] = target.replica_id
+        elif obs.enabled():
+            obs.counter("handoff_reprefill_shed").inc()
+
+    # -- health / introspection --------------------------------------------
+
+    def health(self) -> dict:
+        """The fleet-aggregated ``/healthz`` payload: the process
+        resilience snapshot (now carrying ``quarantined_replicas``),
+        live serve stats, every replica's membership + scheduler state,
+        and the role-availability aggregation — ``status`` leaves "ok"
+        for "unavailable" (503) while ANY role has zero admitting
+        replicas, and for "saturated" (503) while any admitting replica
+        is under sustained pool pressure."""
+        from .. import resilience
+
+        snap = resilience.health_snapshot()
+        snap["serve_stats"] = obs.serve_stats.STATS.snapshot()
+        snap["replicas"] = {
+            rep.replica_id: {
+                "role": rep.role,
+                "draining": rep.draining,
+                "evicted": rep.evicted,
+                "lost": rep.lost,
+                "recruiting": rep.recruiting,
+                "quarantined": rep.quarantined,
+                "admitting": self._admitting(rep),
+                "scheduler": rep.scheduler.debug_state(),
+            }
+            for rep in self.replicas
+        }
+        snap["fleet"] = self.snapshot()
+        saturated = [
+            rep.replica_id for rep in self.replicas
+            if self._steppable(rep)
+            and rep.scheduler._saturated_since is not None
+            and rep.scheduler.saturated_s()
+            >= rep.scheduler.cfg.saturation_sustain_s
+        ]
+        snap["saturated_replicas"] = saturated
+        unavailable = [
+            role for role in ("prefill", "decode")
+            if not any(rep.role == role and self._admitting(rep)
+                       for rep in self.replicas)
+        ]
+        snap["unavailable_roles"] = unavailable
+        if unavailable:
+            snap["status"] = "unavailable"
+        elif snap["status"] == "ok" and saturated:
+            snap["status"] = "saturated"
+        return snap
+
+    def snapshot(self) -> dict:
+        return {
+            "replicas": len(self.replicas),
+            "roles": {role: sum(1 for r in self.replicas
+                                if r.role == role and self._admitting(r))
+                      for role in ("prefill", "decode")},
+            "handoffs": self.handoffs,
+            "colocated": self.colocated,
+            "reprefills": self.reprefills,
+            "aborts": self.aborts,
+            "failovers": self.failovers,
+            "failover_shed": self.failover_shed,
+            "lost_replicas": list(self.lost_replicas),
+            "quarantined": [r.replica_id for r in self.replicas
+                            if r.quarantined],
+            "readmissions": list(self.readmissions),
+            "rebalances": list(self.rebalances),
+            "last_convergence_steps": self.last_convergence_steps,
+            "plane": self.plane.snapshot(),
+        }
+
+    def debug_state(self) -> dict:
+        return {
+            "fleet": self.snapshot(),
+            "replicas": {
+                rep.replica_id: rep.scheduler.debug_state()
+                for rep in self.replicas
+            },
+        }
+
+    def leaked_pages(self) -> int:
+        """Used pages across EVERY replica once everything drained —
+        the zero-leak invariant ``tdt_lint --fleet`` gates per replica
+        (a lost replica's pool was reclaimed at loss time, so it
+        counts too)."""
+        return sum(rep.scheduler.pool.used_pages
+                   for rep in self.replicas)
+
+    def _publish_gauges(self) -> None:
+        if not obs.enabled():
+            return
+        stats = obs.serve_stats.STATS
+        # per-replica labels ride the gauge NAME (the stats block's
+        # flat-gauge rendering; the replica id is the label)
+        for rep in self.replicas:
+            rid = rep.replica_id
+            sched = rep.scheduler
+            stats.set_gauge(f"replica_{rid}_queue_depth",
+                            float(sched.queue.depth))
+            stats.set_gauge(f"replica_{rid}_pool_occupancy",
+                            sched.pool.occupancy())
+            stats.set_gauge(f"replica_{rid}_admitting",
+                            1.0 if self._admitting(rep) else 0.0)
+        stats.set_gauge("fleet_admitting_replicas",
+                        float(sum(1 for r in self.replicas
+                                  if self._admitting(r))))

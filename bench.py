@@ -1764,6 +1764,116 @@ def bench_fleet_rebalance():
     }
 
 
+def bench_fleet_obs_overhead():
+    """The TDT_FLEET_OBS tax (ISSUE 19 satellite): the SAME seeded
+    N=4 fleet replay bare vs with the observability plane armed — the
+    per-replica tee federation, the decision ledger on every router
+    actuation, and the per-step fleet-window rotation.  Both arms run
+    with base obs on (only the fleet plane toggles), interleaved,
+    min-of-rounds — the ``_profile_overhead_record`` discipline;
+    ledger persistence is off so disk IO is not in the number.
+    Marked ``interpret`` (SimBackend replicas on this box) so the 2%
+    warn ceiling (claims gate: ``fleet_obs_overhead_pct``) binds on
+    real multi-replica captures; the trend sentinel guards growth
+    everywhere."""
+    import time as _time
+
+    from triton_distributed_tpu import obs, resilience, serve
+    from triton_distributed_tpu.obs import decisions, fleet_stats
+
+    vocab = 512
+
+    def reset_breakers():
+        for rid in ("p0", "p1", "d0", "d1"):
+            resilience.reset_breaker(serve.replica_breaker_name(rid))
+        resilience.reset_breaker(serve.HANDOFF_OP)
+
+    def run_once():
+        reset_breakers()
+        replicas = []
+        for rid in ("p0", "p1"):
+            replicas.append(serve.Replica(
+                rid,
+                serve.Scheduler(
+                    serve.SimBackend(slots=8, page_size=16,
+                                     pool_pages=65, max_length=256,
+                                     vocab=vocab),
+                    serve.SchedulerConfig(max_queue_depth=128,
+                                          prefill_chunk_tokens=32,
+                                          prefill_only=True)),
+                "prefill"))
+        for rid in ("d0", "d1"):
+            replicas.append(serve.Replica(
+                rid,
+                serve.Scheduler(
+                    serve.SimBackend(slots=8, page_size=16,
+                                     pool_pages=65, max_length=256,
+                                     vocab=vocab),
+                    serve.SchedulerConfig(max_queue_depth=128)),
+                "decode"))
+        router = serve.FleetRouter(
+            replicas, plane=serve.HandoffPlane(),
+            config=serve.FleetConfig(probe_interval_steps=1 << 30))
+        arrivals = serve.synthetic_trace(
+            17, 32, mean_interarrival_steps=0.25,
+            prompt_len=(8, 48), max_new=(8, 48), vocab=vocab)
+        pending = sorted(arrivals,
+                         key=lambda a: (a.step, a.request.req_id))
+        idx = 0
+        for _ in range(100_000):
+            while idx < len(pending) and \
+                    pending[idx].step <= router.steps:
+                router.submit(pending[idx].request)
+                idx += 1
+            if router.step().idle and idx >= len(pending):
+                break
+
+    prev_obs = obs.enabled()
+    obs.enable(True)
+    prev_dec = decisions.enabled()
+    prev_fs = fleet_stats.enabled()
+    prev_ledger = decisions.ledger()
+    prev_fleet = fleet_stats.current()
+    decisions.enable(False)
+    fleet_stats.enable(False)
+    walls = {False: [], True: []}
+    decided = 0
+    try:
+        run_once()                      # warmup, untimed
+        for _ in range(3):
+            for armed in (False, True):
+                decisions.enable(armed)
+                fleet_stats.enable(armed)
+                if armed:
+                    decisions.install(decisions.DecisionLedger(
+                        cap=512, out_dir=""))
+                obs.serve_stats.STATS.reset()
+                t0 = _time.perf_counter()
+                run_once()
+                walls[armed].append(_time.perf_counter() - t0)
+        led = decisions.ledger()
+        decided = 0 if led is None else led.total
+    finally:
+        decisions.install(prev_ledger)
+        decisions.enable(prev_dec)
+        fleet_stats.install(prev_fleet)
+        fleet_stats.enable(prev_fs)
+        obs.serve_stats.STATS.reset()
+        obs.enable(prev_obs)
+        reset_breakers()
+    t_off, t_on = min(walls[False]), min(walls[True])
+    return {
+        "metric": "fleet_obs_overhead_pct",
+        "value": round(100.0 * (t_on - t_off) / max(t_off, 1e-9), 2),
+        "unit": "% over bare",
+        "bare_s": round(t_off, 4),
+        "armed_s": round(t_on, 4),
+        "decisions_ledgered": decided,
+        "interpret": True,   # SimBackend replicas on this box
+        "devices": jax.device_count(),
+    }
+
+
 def bench_integrity_overhead():
     """The TDT_INTEGRITY tax: checksummed vs plain AG/RS at the tuned
     configs, as a percent of the plain eager op (ISSUE 7 satellite —
@@ -2179,10 +2289,12 @@ def main():
         print(json.dumps(bench_profile_overhead_disagg()))
     elif mode == "fleet":
         # the N-replica fleet tier (ISSUE 18): diurnal+bursty replay
-        # with a replica lost mid-stream, plus the rebalance drill's
-        # convergence latency
+        # with a replica lost mid-stream, the rebalance drill's
+        # convergence latency, plus the fleet-observability tax
+        # (ISSUE 19)
         print(json.dumps(bench_fleet_ttft_under_loss()))
         print(json.dumps(bench_fleet_rebalance()))
+        print(json.dumps(bench_fleet_obs_overhead()))
     elif mode == "wire":
         # quantized collective payload byte accounting + dequant parity
         # (ISSUE 9)
@@ -2228,6 +2340,7 @@ def main():
         _emit(bench_handoff_retries)
         _emit(bench_fleet_ttft_under_loss)
         _emit(bench_fleet_rebalance)
+        _emit(bench_fleet_obs_overhead)
         _emit(bench_trace_overhead)
         _emit(bench_trace_overhead_disagg)
         _emit(bench_profile_overhead)

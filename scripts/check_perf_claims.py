@@ -349,6 +349,18 @@ CLAIMS = {
     "fleet_rebalance_convergence_steps": {
         "value_max": 512.0, "since": 18,
     },
+    # -- fleet observability (ISSUE 19; `bench.py fleet`) --
+    # TDT_FLEET_OBS tax: the SAME seeded N=4 replay bare vs with the
+    # per-replica tee federation + decision ledger + fleet-window
+    # rotation armed (ledger persistence off).  warn_max 2.0 is the
+    # issue's acceptance ceiling — a control plane you cannot afford
+    # to leave on is not a control plane; value_max is the gross
+    # tripwire.  Interpret-marked on this box's SimBackend replicas;
+    # binds on real multi-replica captures, and the trend sentinel
+    # ("overhead" -> lower-is-better) guards growth everywhere
+    "fleet_obs_overhead_pct": {
+        "warn_max": 2.0, "value_max": 100.0, "since": 19,
+    },
 }
 
 def parse_record(path: str) -> tuple[list[dict], int | None, bool]:

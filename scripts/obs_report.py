@@ -43,6 +43,14 @@ save_streams`` JSON) it reconstructs the saved streams instead.
 ``--chrome`` additionally writes the timeline as Chrome-trace JSON with
 flow arrows linking each stall to the transfer it starved for.
 
+``--fleet`` is the fleet observability operator view (ISSUE 19,
+docs/observability.md "Fleet observability"): given a telemetry-plane
+URL it fetches ``/debug/fleet`` and renders the federated per-replica
+table (merged p99s, per-replica drill-down, imbalance gauges), any
+open fleet-scope anomalies, and the control-decision ledger tail; with
+no operand it snapshots the in-process plane (``TDT_FLEET_OBS=1``).
+Exit code 1 when the latest fleet window carries anomalies.
+
 ``--live`` is the continuous profiler's operator view (ISSUE 16,
 docs/observability.md "Continuous profiling"): given a telemetry-plane
 URL it fetches ``/debug/profile`` and renders the per-(family x
@@ -103,10 +111,18 @@ def main(argv: list[str] | None = None) -> int:
                     help="continuous-profiler view: fetch /debug/profile "
                          "from a telemetry-plane URL, or snapshot the "
                          "in-process profiler when no URL is given")
+    ap.add_argument("--fleet", nargs="?", const="local", metavar="URL",
+                    help="fleet observability view (TDT_FLEET_OBS=1): "
+                         "fetch /debug/fleet from a telemetry-plane URL, "
+                         "or snapshot the in-process federation plane + "
+                         "decision ledger when no URL is given; exit 1 "
+                         "on an open fleet-scope anomaly")
     args = ap.parse_args(argv)
 
     from triton_distributed_tpu.obs import report
 
+    if args.fleet:
+        return _run_fleet_view(args)
     if args.live:
         return _run_live(args)
     if args.request:
@@ -169,6 +185,78 @@ def _run_live(args) -> int:
             json.dump(snap, f, indent=1, sort_keys=True, default=str)
     last = snap.get("last_window") or {}
     return 1 if last.get("anomalies") else 0
+
+
+def _run_fleet_view(args) -> int:
+    """The ``--fleet`` leg (ISSUE 19): one fleet-observability snapshot
+    — the federation plane's merged/per-replica view, the last window's
+    imbalance gauges, retained fleet anomalies, and the decision-ledger
+    tail — from ``/debug/fleet`` (URL) or the in-process plane.  Exit 1
+    when the latest fleet window carries anomalies, the ``--live``
+    cron-probe contract one level up."""
+    from triton_distributed_tpu.obs import decisions, fleet_stats
+
+    if args.fleet == "local":
+        snap = {"fleet_stats": fleet_stats.snapshot_dump(),
+                "decisions": decisions.tail_dump(64)}
+        where = "in-process fleet plane"
+    else:
+        import urllib.request
+
+        url = args.fleet.rstrip("/") + "/debug/fleet"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            snap = json.load(r)
+        where = url
+    fs = snap.get("fleet_stats") or {}
+    led = snap.get("decisions") or {}
+    if not fs.get("replicas"):
+        print(f"fleet plane not armed at {where} "
+              f"(set TDT_FLEET_OBS=1; docs/observability.md)")
+        return 0
+    print(f"fleet: {len(fs['replicas'])} replica(s), "
+          f"{fs.get('windows', 0)} window(s) of "
+          f"{fs.get('window_steps', '?')} steps, "
+          f"{fs.get('anomalies_total', 0)} anomalies total")
+    merged = fs.get("merged") or {}
+    for name in ("ttft_ms", "request_ms"):
+        sk = merged.get(name) or {}
+        qs = sk.get("quantiles") or {}
+        if sk.get("count"):
+            print(f"  fleet {name}: p50={qs.get('p50', 0):.1f} "
+                  f"p99={qs.get('p99', 0):.1f} (n={sk['count']})")
+    print(f"  tokens/s (window): "
+          f"{merged.get('tokens_per_s_window', 0.0):.2f}")
+    print(f"{'replica':<10} {'role':<8} {'ttft p99':>10} "
+          f"{'req p99':>10} {'tok/s':>8} {'requests':>9} {'sheds':>6}")
+    for rid, row in sorted((fs.get("replicas") or {}).items()):
+        print(f"{rid:<10} {row.get('role') or '?':<8} "
+              f"{row.get('ttft_ms_p99', 0.0):>10.1f} "
+              f"{row.get('request_ms_p99', 0.0):>10.1f} "
+              f"{row.get('tokens_per_s_window', 0.0):>8.2f} "
+              f"{row.get('requests_total', 0):>9.0f} "
+              f"{row.get('sheds_total', 0):>6.0f}")
+    totals = fs.get("last_window_totals") or {}
+    if totals:
+        print("last window: " + "  ".join(
+            f"{k.removeprefix('fleet_')}={v:.3g}"
+            for k, v in sorted(totals.items())
+            if isinstance(v, (int, float))))
+    anomalies = fs.get("anomalies") or []
+    for a in anomalies:
+        print(f"FLEET ANOMALY: {a.get('summary', a)}")
+    tail = led.get("tail") or []
+    if tail:
+        print(f"decision ledger ({led.get('total', 0)} total; "
+              f"last {len(tail)}):")
+        sys.stdout.write(decisions.format_tail(tail, limit=len(tail)))
+    elif led.get("enabled"):
+        print("decision ledger: empty")
+    else:
+        print("decision ledger not armed (TDT_FLEET_OBS=1)")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(snap, f, indent=1, sort_keys=True, default=str)
+    return 1 if anomalies else 0
 
 
 def _run_request(args) -> int:

@@ -27,6 +27,7 @@ Usage:
     python scripts/tdt_lint.py --profile         # continuous-profiler gate
     python scripts/tdt_lint.py --pages           # page-lifetime ownership gate
     python scripts/tdt_lint.py --fleet           # fleet-tier (N-replica) gate
+    python scripts/tdt_lint.py --fleetobs        # fleet-observability gate
     python scripts/tdt_lint.py --all             # every gate, one exit code
     python scripts/tdt_lint.py --json report.json
 
@@ -174,11 +175,30 @@ quarantine, rebalance-under-load membership conversion, quarantine
 readmission) must each be detected-or-survived.  Headless and
 CPU-only.
 
+``--fleetobs`` is the fleet-observability gate (ISSUE 19,
+docs/observability.md "Fleet observability"): the ``--fleet`` replay
+shape (N=4, one replica lost mid-decode, one flapping into
+quarantine) re-runs with ``TDT_FLEET_OBS`` armed — every FleetRouter
+actuation the replay exercised must land in the decision ledger with
+counts reconciling against the router's own counters, the
+quarantine-drain decision must name an exemplar trace id that
+resolves in the retained ring, the ledger ring must round-trip
+through its rotated JSONL segments
+(``obs.history.load_decision_records``), the fleet-merged latency
+sketches must reconcile EXACTLY with the union stream (the tee
+federation is lossless, not approximate), the decision-coverage
+golden must discharge statically in both directions
+(``analysis.completeness.check_decision_coverage``), and the
+fleet-anomaly selftest must pass both directions (clean replay
+quiet, seeded single-replica inflation breaches the p99 band AND the
+same-role skew gauge with the exemplar + window decisions carried).
+Headless and CPU-only.
+
 ``--all`` runs every gate above — verify matrix, ``--dpor``,
 ``--completeness``, ``--faults``, ``--timeline``, ``--serve``,
 ``--history``, ``--integrity``, ``--quant``, ``--hier``,
 ``--handoff``, ``--persistent``, ``--trace``, ``--profile``,
-``--pages``, ``--fleet`` — and
+``--pages``, ``--fleet``, ``--fleetobs`` — and
 summarizes them under a single exit code (the CI entry; see README).
 
 ``--history`` runs the bench-record trend sentinel
@@ -303,12 +323,23 @@ def main(argv: list[str] | None = None) -> int:
                          "parity, exactly the flapping replica "
                          "quarantine-evicted, zero leaked pages per "
                          "replica), plus the fleet fault cells")
+    ap.add_argument("--fleetobs", action="store_true",
+                    help="fleet-observability gate (ISSUE 19): the "
+                         "armed (TDT_FLEET_OBS) N=4 replay — every "
+                         "actuation ledgered with counts reconciling "
+                         "against the router counters, the quarantine "
+                         "decision naming a resolvable exemplar trace, "
+                         "the JSONL segments round-tripping, the "
+                         "fleet-merged sketches exactly equal to the "
+                         "union stream, the decision-coverage golden "
+                         "discharged both directions, and the "
+                         "fleet-anomaly selftest both directions")
     ap.add_argument("--all", action="store_true", dest="all_gates",
                     help="run every gate (verify matrix, --faults, "
                          "--timeline, --serve, --history, --integrity, "
                          "--quant, --hier, --handoff, --persistent, "
-                         "--trace, --profile, --pages, --fleet) with "
-                         "one summarized exit code")
+                         "--trace, --profile, --pages, --fleet, "
+                         "--fleetobs) with one summarized exit code")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection target sampling seed (--faults)")
     ap.add_argument("--json", metavar="PATH",
@@ -347,6 +378,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_pages(args)
     if args.fleet:
         return _run_fleet(args)
+    if args.fleetobs:
+        return _run_fleetobs(args)
 
     from triton_distributed_tpu import analysis
 
@@ -718,6 +751,7 @@ def _run_all(args) -> int:
         ("profile", lambda: _run_profile(sub())),
         ("pages", lambda: _run_pages(sub())),
         ("fleet", lambda: _run_fleet(sub())),
+        ("fleetobs", lambda: _run_fleetobs(sub())),
     ]
     results = []
     for name, fn in legs:
@@ -1215,6 +1249,267 @@ def _run_fleet(args) -> int:
           "flapping replica evicted, zero leaked pages on every "
           "replica; all fleet fault cells detected-or-survived")
     return 0
+
+
+def _run_fleetobs(args) -> int:
+    """The fleet-observability gate (ISSUE 19; see module docstring):
+    the ``--fleet`` replay shape re-run with ``TDT_FLEET_OBS`` armed —
+    (1) every actuation ledgered, with the per-kind counts reconciling
+    against the router's own counters and the quarantine-drain
+    decision naming an exemplar trace id that resolves in the retained
+    ring; (2) the ledger's rotated JSONL segments round-trip through
+    ``obs.history.load_decision_records``; (3) the fleet-merged
+    latency sketches reconcile EXACTLY with the union stream (the tee
+    federation is lossless); (4) the decision-coverage golden
+    discharges statically in both directions; (5) the fleet-anomaly
+    selftest passes both directions."""
+    import random
+    import tempfile
+
+    from triton_distributed_tpu import obs, resilience, serve
+    from triton_distributed_tpu.analysis import completeness
+    from triton_distributed_tpu.obs import decisions, fleet_stats, history
+    from triton_distributed_tpu.obs import request_trace as rtrace
+    from triton_distributed_tpu.resilience.faults import RankAborted
+
+    _FLEET_IDS = ("p0", "p1", "d0", "d1")
+
+    def reset_replica_breakers():
+        for rid in _FLEET_IDS:
+            resilience.reset_breaker(serve.replica_breaker_name(rid))
+        resilience.reset_breaker(serve.HANDOFF_OP)
+
+    problems: list[str] = []
+    rng = random.Random(args.seed)
+    reset_replica_breakers()
+
+    prev_obs = obs.enabled()
+    prev_dec = decisions.enabled()
+    prev_fs = fleet_stats.enabled()
+    obs.enable(True)
+    prev_trace = rtrace.enable(True)
+    decisions.enable(True)
+    fleet_stats.enable(True)
+    prev_ledger = None
+    prev_fleet = fleet_stats.current()
+    rtrace.RING.clear()
+    obs.serve_stats.STATS.reset()
+    tmp = tempfile.mkdtemp(prefix="tdt_fleetobs_")
+    prev_ledger = decisions.install(
+        decisions.DecisionLedger(cap=512, out_dir=tmp))
+    try:
+        # the --fleet replay, armed: d1 flaps into quarantine, d0 is
+        # lost mid-decode — every actuation below must ledger
+        class _Flap:
+            def __init__(self, first, last):
+                self.first, self.last, self.fired = first, last, 0
+
+            def __call__(self, step):
+                if self.first <= step <= self.last:
+                    self.fired += 1
+                    raise RankAborted(0, step)
+
+        inj = _Flap(3, 10)
+        replicas = []
+        for rid in ("p0", "p1"):
+            replicas.append(serve.Replica(
+                rid,
+                serve.Scheduler(
+                    serve.SimBackend(slots=3, page_size=4, pool_pages=24,
+                                     max_length=64),
+                    serve.SchedulerConfig(max_queue_depth=32,
+                                          prefill_only=True)),
+                "prefill"))
+        for rid in ("d0", "d1"):
+            replicas.append(serve.Replica(
+                rid,
+                serve.Scheduler(
+                    serve.SimBackend(slots=3, page_size=4, pool_pages=32,
+                                     max_length=64,
+                                     step_hook=inj if rid == "d1"
+                                     else None),
+                    serve.SchedulerConfig(max_queue_depth=32)),
+                "decode"))
+        router = serve.FleetRouter(
+            replicas,
+            plane=serve.HandoffPlane(dcn_channel=serve.ModeledDCN(
+                seed=rng.randrange(1 << 16))),
+            config=serve.FleetConfig(flap_threshold=3,
+                                     max_failovers_per_request=4,
+                                     probe_interval_steps=1 << 30))
+        if router.fleet_stats is None:
+            problems.append("FleetRouter attached no federation plane "
+                            "with TDT_FLEET_OBS armed")
+        reqs = [
+            serve.Request(prompt=tuple(rng.randrange(1, 90)
+                                       for _ in range(rng.randint(2, 6))),
+                          max_new_tokens=rng.randint(6, 10))
+            for _ in range(12)
+        ]
+        for r in reqs:
+            router.submit(r)
+        lost_id = None
+        for _ in range(600):
+            router.step()
+            d0 = next(rep for rep in router.replicas
+                      if rep.replica_id == "d0")
+            if lost_id is None and any(
+                    s is not None
+                    and s.request.state is serve.RequestState.DECODE
+                    for s in d0.scheduler.slots):
+                lost_id = "d0"
+                router.lose_replica(
+                    "d0", reason="injected mid-decode replica loss")
+                break
+        router.run_until_idle(max_steps=4000)
+        nonterminal = [r.req_id for r in reqs if not r.done]
+        if lost_id is None:
+            problems.append("replay: the replica-loss injection never "
+                            "landed mid-decode")
+        if nonterminal:
+            problems.append(f"replay: {len(nonterminal)} request(s) "
+                            f"never terminal: {nonterminal}")
+
+        led = decisions.ledger()
+        counts = {} if led is None else led.counts()
+        print(f"fleetobs replay: {len(reqs)} requests, "
+              f"{0 if led is None else led.total} decisions ledgered "
+              f"{dict(sorted(counts.items()))}")
+        if led is None:
+            problems.append("armed replay produced no decision ledger")
+            raise _FleetObsBail()
+
+        # leg 1: every actuation ledgered — per-kind counts reconcile
+        # against the router's own counters (the ledger IS the
+        # actuation stream, not a sample of it)
+        admissions = sum(counts.get(k, 0) for k in
+                        ("route", "affinity_hit", "affinity_redirect",
+                         "shed"))
+        pairs = [
+            ("admission decisions", admissions, len(reqs)),
+            ("failover", counts.get("failover", 0), router.failovers),
+            ("failover_shed", counts.get("failover_shed", 0),
+             router.failover_shed),
+            ("reprefill", counts.get("reprefill", 0), router.reprefills),
+            ("replica_lost", counts.get("replica_lost", 0),
+             len(router.lost_replicas)),
+            ("quarantine_evict", counts.get("quarantine_evict", 0),
+             len(router.quarantined_history)),
+        ]
+        for label, got, want in pairs:
+            if got != want:
+                problems.append(f"ledger: {label} count {got} != the "
+                                f"router's {want}")
+        # colocations: the dedicated colocate decisions plus every
+        # admission the ledger itself says landed on a decode replica
+        # (inputs carried verbatim makes this derivable)
+        routed_decode = sum(
+            1 for k in ("route", "affinity_hit", "affinity_redirect")
+            for rec in led.query(kind=k)
+            if rec.inputs.get("role") == "decode")
+        if counts.get("colocate", 0) + routed_decode != router.colocated:
+            problems.append(
+                f"ledger: colocate {counts.get('colocate', 0)} + "
+                f"decode-role admissions {routed_decode} != the "
+                f"router's colocated {router.colocated}")
+        drains = led.query(kind="quarantine_drain")
+        if not drains:
+            problems.append("ledger: the flap walked quarantine but no "
+                            "quarantine_drain decision landed")
+        for rec in drains:
+            ex = rec.inputs.get("exemplar")
+            if ex is None:
+                problems.append(f"ledger: quarantine_drain for "
+                                f"{rec.replica} names no exemplar "
+                                f"trace id")
+            elif rtrace.RING.get(ex) is None:
+                problems.append(f"ledger: quarantine_drain exemplar "
+                                f"{ex!r} does not resolve to a "
+                                f"retained trace")
+            else:
+                print(f"quarantine_drain({rec.replica}) exemplar -> "
+                      f"{ex} (retained)")
+
+        # leg 2: the rotated JSONL segments round-trip the ring
+        disk = history.load_decision_records(tmp)
+        ring = [r.to_dict() for r in led.tail()]
+        key = lambda d: (d.get("seq"), d.get("kind"), d.get("step"),
+                         d.get("replica"))
+        if [key(d) for d in disk] != [key(d) for d in ring]:
+            problems.append(
+                f"persistence: {len(disk)} JSONL record(s) do not "
+                f"round-trip the {len(ring)}-record ring")
+        else:
+            print(f"persistence: {len(disk)} JSONL records round-trip "
+                  f"the ring via load_decision_records")
+
+        # leg 3: the fleet-merged sketches reconcile EXACTLY with the
+        # union stream — the tee forwards every observation, so the
+        # merge is lossless, not approximate (handoff_ms is plane-fed,
+        # union-only by design)
+        fs = router.fleet_stats
+        union = obs.serve_stats.STATS
+        for name in fleet_stats.SKETCH_NAMES:
+            merged = fs.merged(name)
+            ref = getattr(union, name)
+            if name == "handoff_ms":
+                if merged.count > ref.count:
+                    problems.append(f"merge: {name} merged count "
+                                    f"{merged.count} exceeds the union "
+                                    f"{ref.count}")
+                continue
+            if merged.count != ref.count:
+                problems.append(f"merge: {name} merged count "
+                                f"{merged.count} != union {ref.count}")
+                continue
+            for q in obs.serve_stats.SERVE_QUANTILES:
+                m, u = merged.quantile(q), ref.quantile(q)
+                if m != u:
+                    problems.append(f"merge: {name} p{int(q * 100)} "
+                                    f"merged {m!r} != union {u!r}")
+        print(f"merge: request_ms p99 fleet-merged "
+              f"{fs.merged('request_ms').quantile(0.99):.3f} ms == "
+              f"union ({union.request_ms.count} observations)")
+
+        # leg 4: the decision-coverage golden, both directions
+        problems += [str(p) for p in
+                     completeness.check_decision_coverage()]
+
+        # leg 5: the fleet-anomaly selftest, both directions
+        problems += fleet_stats.selftest(args.seed)
+    except _FleetObsBail:
+        pass
+    finally:
+        reset_replica_breakers()
+        decisions.install(prev_ledger)
+        decisions.enable(prev_dec)
+        fleet_stats.install(prev_fleet)
+        fleet_stats.enable(prev_fs)
+        rtrace.RING.clear()
+        rtrace.enable(prev_trace)
+        obs.serve_stats.STATS.reset()
+        obs.enable(prev_obs)
+
+    for p in problems:
+        print(f"FLEETOBS FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"problems": problems}, f, indent=1,
+                      sort_keys=True, default=str)
+    if problems:
+        return 1
+    print("fleetobs OK: every actuation ledgered with counts "
+          "reconciling against the router, the quarantine decision "
+          "names a retained exemplar trace, the JSONL segments "
+          "round-trip, the fleet merge is lossless vs the union "
+          "stream, the coverage golden discharges both directions, "
+          "and the anomaly selftest passes both directions")
+    return 0
+
+
+class _FleetObsBail(Exception):
+    """Early exit for --fleetobs when the armed replay produced no
+    ledger (everything downstream would mask that one failure)."""
 
 
 def _run_trace(args) -> int:

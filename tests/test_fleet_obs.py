@@ -1,0 +1,556 @@
+"""Fleet observability plane (ISSUE 19): cross-replica telemetry
+federation, the control-decision ledger, and fleet-scope anomaly
+detection.
+
+Headless like the fleet tests: real ``FleetRouter`` fleets over
+deterministic ``SimBackend`` replicas, the federation plane armed via
+``obs.fleet_stats.enable`` / ``obs.decisions.enable`` (the in-process
+spelling of ``TDT_FLEET_OBS=1``), and everything restored so the
+plane stays byte-identically off for every other test.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from triton_distributed_tpu import obs, resilience, serve
+from triton_distributed_tpu.obs import decisions, fleet_stats, history
+from triton_distributed_tpu.obs import request_trace as rtrace
+from triton_distributed_tpu.obs.serve_stats import ServeStats
+from triton_distributed_tpu.serve.fleet import replica_breaker_name
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_IDS = ("p0", "p1", "d0", "d1")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_breakers():
+    """The test fleets reuse replica ids; breakers are process-global
+    sticky state (the ``test_fleet.py`` rule)."""
+    for rid in _IDS:
+        resilience.reset_breaker(replica_breaker_name(rid))
+    resilience.reset_breaker(serve.HANDOFF_OP)
+    yield
+    for rid in _IDS:
+        resilience.reset_breaker(replica_breaker_name(rid))
+    resilience.reset_breaker(serve.HANDOFF_OP)
+
+
+@pytest.fixture()
+def fleet_obs_on(tmp_path):
+    """Arm the whole plane — base obs, tracing, the decision ledger
+    (persisted under tmp_path), the federation hook — and restore every
+    singleton afterwards."""
+    prev_obs = obs.enabled()
+    obs.enable(True)
+    prev_trace = rtrace.enable(True)
+    rtrace.RING.clear()
+    obs.serve_stats.STATS.reset()
+    prev_dec = decisions.enabled()
+    prev_fs = fleet_stats.enabled()
+    decisions.enable(True)
+    fleet_stats.enable(True)
+    prev_led = decisions.install(
+        decisions.DecisionLedger(cap=512, out_dir=str(tmp_path)))
+    prev_fleet = fleet_stats.current()
+    yield str(tmp_path)
+    decisions.install(prev_led)
+    decisions.enable(prev_dec)
+    fleet_stats.install(prev_fleet)
+    fleet_stats.enable(prev_fs)
+    rtrace.RING.clear()
+    rtrace.enable(prev_trace)
+    obs.serve_stats.STATS.reset()
+    obs.enable(prev_obs)
+
+
+def _sched(*, prefill_only=False, slots=3, pool_pages=24,
+           max_queue_depth=32):
+    return serve.Scheduler(
+        serve.SimBackend(slots=slots, page_size=4, pool_pages=pool_pages,
+                         max_length=64),
+        serve.SchedulerConfig(max_queue_depth=max_queue_depth,
+                              prefill_only=prefill_only))
+
+
+def _fleet(*, config=None, seed=1):
+    replicas = [
+        serve.Replica(rid, _sched(prefill_only=True), "prefill")
+        for rid in ("p0", "p1")
+    ] + [
+        serve.Replica(rid, _sched(pool_pages=32), "decode")
+        for rid in ("d0", "d1")
+    ]
+    plane = serve.HandoffPlane(dcn_channel=serve.ModeledDCN(seed=seed))
+    return serve.FleetRouter(replicas, plane=plane, config=config)
+
+
+def _load(n=6, seed=0, max_new=(4, 8)):
+    rng = random.Random(seed)
+    return [
+        serve.Request(prompt=tuple(rng.randrange(1, 90)
+                                   for _ in range(rng.randint(2, 6))),
+                      max_new_tokens=rng.randint(*max_new))
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the tee federation: merged == union, exactly
+
+
+def test_tee_federation_merge_is_lossless():
+    """The federation pin: per-replica tee sketches share the union's
+    gamma, so merging the replica copies reconstructs the union stream
+    bucket-for-bucket — count, sum, and every serving quantile EQUAL,
+    not approximately equal."""
+    union = ServeStats()
+    fs = fleet_stats.FleetStats(union=union, record=False)
+    a = fs.replica("p0", "prefill")
+    b = fs.replica("p1", "prefill")
+    rng = random.Random(3)
+    for i in range(400):
+        (a if i % 3 else b).observe_ttft(rng.uniform(1.0, 5000.0),
+                                         exemplar=f"t-{i}")
+        (b if i % 2 else a).request_completed(rng.uniform(5.0, 9000.0))
+    for name in ("ttft_ms", "request_ms"):
+        merged = fs.merged(name)
+        ref = getattr(union, name)
+        assert merged.count == ref.count == 400
+        assert merged.sum == pytest.approx(ref.sum)
+        for q in fleet_stats.SERVE_QUANTILES:
+            assert merged.quantile(q) == ref.quantile(q)
+    # the per-replica drill-down really is a partition of the union
+    assert a.ttft_ms.count + b.ttft_ms.count == union.ttft_ms.count
+    assert a.ttft_ms.count > 0 and b.ttft_ms.count > 0
+
+
+def test_tee_rate_totals_partition_the_union():
+    union = ServeStats()
+    fs = fleet_stats.FleetStats(union=union, record=False)
+    a = fs.replica("d0", "decode")
+    b = fs.replica("d1", "decode")
+    for _ in range(5):
+        a.tokens.add(3.0)
+        b.tokens.add(7.0)
+    assert a.tokens.total == 15.0 and b.tokens.total == 35.0
+    assert union.tokens.total == 50.0
+
+
+def test_role_skew_flags_the_lagging_replica():
+    union = ServeStats()
+    fs = fleet_stats.FleetStats(union=union, record=False)
+    a = fs.replica("p0", "prefill")
+    b = fs.replica("p1", "prefill")
+    for i in range(16):
+        a.observe_ttft(10.0)
+        b.observe_ttft(10.0)
+    assert fs.role_skew() == pytest.approx(0.0)
+    for i in range(16):
+        b.observe_ttft(1000.0)
+    assert fs.role_skew() > 5.0
+
+
+# ---------------------------------------------------------------------------
+# the decision ledger
+
+
+def test_ledger_typed_ring_bound_and_jsonl_roundtrip(tmp_path):
+    led = decisions.DecisionLedger(cap=8, out_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="unknown decision kind"):
+        led.record("not_a_kind", step=0)
+    for i in range(20):
+        led.record("route", step=i, replica=f"p{i % 2}",
+                   request_id=i, inputs={"load": i / 10.0,
+                                         "role": "prefill"})
+    assert led.total == 20 and len(led.tail()) == 8
+    assert led.counts() == {"route": 20}
+    # the ring is bounded; the JSONL segments keep the WHOLE stream
+    disk = history.load_decision_records(str(tmp_path))
+    assert [d["seq"] for d in disk] == list(range(20))
+    # inputs verbatim through the round-trip
+    rec = decisions.from_dict(disk[7])
+    assert rec.kind == "route" and rec.replica == "p1"
+    assert rec.inputs == {"load": 0.7, "role": "prefill"}
+
+
+def test_load_decision_records_skips_garbage(tmp_path):
+    p = tmp_path / "decisions_0000.jsonl"
+    p.write_text('{"kind":"route","seq":0,"step":1}\n'
+                 "\n"
+                 "not json at all\n"
+                 '{"no_kind_key": 1}\n'
+                 '{"kind":"failover","seq":1,"step":2}\n')
+    recs = history.load_decision_records(str(tmp_path))
+    assert [d["kind"] for d in recs] == ["route", "failover"]
+
+
+def test_suppressed_actuations_stay_out_of_the_ledger(fleet_obs_on):
+    """Probe / warmup traffic drives the same actuation sites under
+    ``obs.suppress()`` — the ledger must describe REAL control flow
+    only."""
+    assert decisions.record("route", step=1, replica="p0") is not None
+    with obs.suppress():
+        assert not decisions.enabled()
+        assert decisions.record("route", step=2, replica="p0") is None
+    led = decisions.ledger()
+    assert led.total == 1 and led.tail()[0].step == 1
+
+
+def test_concurrent_records_never_tear(fleet_obs_on):
+    led = decisions.ledger()
+
+    def spam(rid):
+        for i in range(200):
+            decisions.record("route", step=i, replica=rid)
+
+    ts = [threading.Thread(target=spam, args=(f"p{i}",)) for i in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert led.total == 800
+    assert led.counts() == {"route": 800}
+    seqs = [r.seq for r in led.tail()]
+    assert seqs == sorted(seqs)
+
+
+# ---------------------------------------------------------------------------
+# the armed fleet: every actuation ledgered, inputs verbatim
+
+
+def test_armed_fleet_ledgers_every_admission(fleet_obs_on):
+    router = _fleet()
+    assert router.fleet_stats is not None          # attach() armed
+    reqs = _load(6)
+    for i, r in enumerate(reqs):
+        router.submit(r, session=f"s{i % 2}")
+    router.run_until_idle(max_steps=4000)
+    led = decisions.ledger()
+    counts = led.counts()
+    admissions = sum(counts.get(k, 0) for k in
+                     ("route", "affinity_hit", "affinity_redirect",
+                      "shed"))
+    assert admissions == len(reqs)
+    # inputs verbatim: every admission names its target's role and load
+    for kind in ("route", "affinity_hit", "affinity_redirect"):
+        for rec in led.query(kind=kind):
+            assert rec.inputs["role"] in ("prefill", "decode")
+            assert "load" in rec.inputs
+    # session affinity leaves its audit trail
+    hits = led.query(kind="affinity_hit")
+    assert all(r.session in ("s0", "s1") for r in hits)
+
+
+def test_armed_fleet_loss_and_failover_ledgered(fleet_obs_on):
+    router = _fleet(config=serve.FleetConfig(
+        max_failovers_per_request=4, probe_interval_steps=1 << 30))
+    reqs = _load(6)
+    for r in reqs:
+        router.submit(r)
+    lost = False
+    for _ in range(600):
+        router.step()
+        d0 = next(rep for rep in router.replicas
+                  if rep.replica_id == "d0")
+        if not lost and any(
+                s is not None
+                and s.request.state is serve.RequestState.DECODE
+                for s in d0.scheduler.slots):
+            router.lose_replica("d0", reason="test loss")
+            lost = True
+            break
+    assert lost
+    router.run_until_idle(max_steps=4000)
+    led = decisions.ledger()
+    counts = led.counts()
+    assert counts.get("replica_lost") == 1
+    (rec,) = led.query(kind="replica_lost")
+    assert rec.replica == "d0"
+    assert rec.inputs["reason"] == "test loss"
+    assert counts.get("failover", 0) == router.failovers
+    assert counts.get("reprefill", 0) == router.reprefills
+
+
+def test_unarmed_fleet_is_byte_identical(fleet_obs_on):
+    """The ``TDT_FLEET_OBS`` pin: with the plane off, ``attach``
+    returns None and touches nothing — the schedulers keep the global
+    ``STATS`` collector, no ledger grows, and a seeded replay produces
+    token-for-token identical output."""
+    def run():
+        for rid in _IDS:
+            resilience.reset_breaker(replica_breaker_name(rid))
+        resilience.reset_breaker(serve.HANDOFF_OP)
+        router = _fleet(seed=5)
+        reqs = _load(6, seed=9)
+        for r in reqs:
+            router.submit(r)
+        router.run_until_idle(max_steps=4000)
+        return router, [tuple(r.tokens) for r in reqs]
+
+    _, armed_tokens = run()
+    led_total = decisions.ledger().total
+    assert led_total > 0
+    fleet_stats.enable(False)
+    decisions.enable(False)
+    router, off_tokens = run()
+    assert router.fleet_stats is None
+    for rep in router.replicas:
+        assert rep.scheduler.stats is obs.serve_stats.STATS
+    assert decisions.ledger().total == led_total   # nothing new
+    assert off_tokens == armed_tokens
+
+
+# ---------------------------------------------------------------------------
+# fleet-scope anomaly detection
+
+
+def _breach_bands():
+    # any real decision activity breaches: the healthy edge is one
+    # decision per 10 windows, lower-is-better
+    band = history.healthy_band([0.0, 0.1], "lower")
+    assert band is not None
+    return {"fleet_decision_rate": band}
+
+
+def test_anomaly_event_carries_window_decisions(fleet_obs_on):
+    fs = fleet_stats.FleetStats(union=ServeStats(), window_steps=4,
+                                bands=_breach_bands())
+    rs = fs.replica("p0", "prefill")
+    rs.observe_ttft(10.0, exemplar="t-anom-0")
+    rs.union.request_ms.observe(20.0, exemplar="t-anom-0")
+    decisions.record("quarantine_drain", step=2, replica="p0",
+                     inputs={"why": "unit"})
+    assert fs.on_step(3) == []                      # off-boundary
+    events = fs.on_step(4)
+    assert len(events) == 1
+    e = events[0]
+    assert e.metric == "fleet_decision_rate" and e.value > 0.0
+    assert e.step_start == 0 and e.step_end == 4
+    assert [d["kind"] for d in e.decisions] == ["quarantine_drain"]
+    assert e.exemplar == "t-anom-0"
+    assert "ledger decisions" in e.summary()
+    # retained + surfaced as the WARNING fragment (never a status flip)
+    frag = fs.health_fragment()
+    assert frag["status"] == "warn" and frag["total"] == 1
+    assert "fleet_decision_rate" in frag["anomalies"][0]
+    snap = fs.snapshot()
+    assert snap["anomalies"][0]["metric"] == "fleet_decision_rate"
+
+
+def test_router_health_carries_fleet_obs_fragment(fleet_obs_on):
+    router = _fleet()
+    router.fleet_stats.window_steps = 4
+    router.fleet_stats.bands = _breach_bands()
+    reqs = _load(4)
+    for r in reqs:
+        router.submit(r)
+    router.run_until_idle(max_steps=4000)
+    snap = router.health()
+    frag = snap.get("fleet_obs")
+    assert frag is not None and frag["status"] == "warn"
+    # drift warns; it never degrades the load-balancer contract
+    assert snap["status"] == "ok"
+
+
+def test_fleet_selftest_both_directions():
+    assert fleet_stats.selftest(0) == []
+    assert fleet_stats.selftest(7) == []
+
+
+def test_direction_for_fleet_metrics():
+    assert history.direction_for("fleet_decision_rate", "") == "lower"
+    assert history.direction_for("fleet_role_skew", "") == "lower"
+    assert history.direction_for("fleet_occupancy_spread", "") == "lower"
+    assert history.direction_for("fleet_ttft_ms_p99", "ms") == "lower"
+    assert history.direction_for("fleet_tokens_per_s", "") == "higher"
+    assert history.direction_for("fleet_requests_total", "") == "higher"
+
+
+def test_decision_coverage_golden_discharges():
+    from triton_distributed_tpu.analysis import completeness
+
+    assert completeness.check_decision_coverage() == []
+
+
+# ---------------------------------------------------------------------------
+# /debug/fleet + /metrics
+
+
+def _get(url: str):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_debug_fleet_endpoint(fleet_obs_on):
+    from triton_distributed_tpu.obs import server as obs_server
+
+    router = _fleet()
+    reqs = _load(4)
+    for r in reqs:
+        router.submit(r)
+    router.run_until_idle(max_steps=4000)
+    srv = obs_server.start(port=0)
+    try:
+        code, body = _get(srv.url + "/debug/fleet")
+        assert code == 200
+        snap = json.loads(body)
+        assert snap["fleet_stats"]["enabled"] is True
+        assert set(snap["fleet_stats"]["replicas"]) == set(_IDS)
+        assert snap["decisions"]["total"] == decisions.ledger().total
+        assert snap["decisions"]["tail"]
+        # ?n= clamps the ledger tail
+        code, body = _get(srv.url + "/debug/fleet?n=1")
+        assert code == 200
+        assert len(json.loads(body)["decisions"]["tail"]) == 1
+        # the endpoint is advertised in the 404 listing
+        code, body = _get(srv.url + "/nope")
+        assert code == 404 and "/debug/fleet" in body
+        # /metrics grows the tdt_fleet_* series + the decision counters
+        code, body = _get(srv.url + "/metrics")
+        assert code == 200
+        assert "tdt_fleet_request_ms" in body
+        assert 'tdt_fleet_replica_request_ms_p99{replica="d0"' in body
+        assert "tdt_fleet_decisions_total" in body
+        # concurrent scrapes against live records never tear
+        errs = []
+
+        def scrape():
+            try:
+                for _ in range(10):
+                    c, b = _get(srv.url + "/debug/fleet")
+                    assert c == 200 and json.loads(b)["decisions"]
+            except Exception as exc:   # pragma: no cover
+                errs.append(exc)
+
+        def churn():
+            for i in range(200):
+                decisions.record("route", step=1000 + i, replica="p0")
+
+        ts = [threading.Thread(target=scrape),
+              threading.Thread(target=churn)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert errs == []
+    finally:
+        obs_server.stop()
+
+
+def test_debug_fleet_unarmed_stub():
+    from triton_distributed_tpu.obs import server as obs_server
+
+    srv = obs_server.start(port=0)
+    try:
+        code, body = _get(srv.url + "/debug/fleet")
+        assert code == 200
+        snap = json.loads(body)
+        assert snap["fleet_stats"].get("hint")
+        assert snap["decisions"]["enabled"] in (False, True)
+    finally:
+        obs_server.stop()
+
+
+# ---------------------------------------------------------------------------
+# the Chrome fleet timeline
+
+
+def test_chrome_lanes_from_ledger_records(tmp_path):
+    recs = [
+        dict(seq=0, step=1, t_us=100.0, kind="quarantine_drain",
+             replica="d1", inputs={}),
+        dict(seq=1, step=2, t_us=150.0, kind="failover", replica="d0",
+             request_id=7, inputs={"from": "d1"}),
+        dict(seq=2, step=3, t_us=200.0, kind="quarantine_evict",
+             replica="d1", inputs={}),
+        dict(seq=3, step=9, t_us=400.0, kind="readmit", replica="d1",
+             inputs={}),
+        dict(seq=4, step=10, t_us=500.0, kind="replica_lost",
+             replica="d0", inputs={}),
+        # high-volume admission kinds are omitted from the lanes
+        dict(seq=5, step=11, t_us=600.0, kind="route", replica="p0",
+             inputs={}),
+    ]
+    evs = fleet_stats.to_chrome(recs, replica_order=("d0", "d1"))
+    names = {e["name"] for e in evs}
+    assert {"quarantine", "failover", "readmit", "lost",
+            "process_name"} <= names
+    assert "route" not in names
+    # the quarantine span closes at the readmit; the lost span stays
+    # open to the newest record
+    quar = next(e for e in evs if e["name"] == "quarantine")
+    assert quar["ph"] == "X" and quar["dur"] == pytest.approx(300.0)
+    assert quar["args"]["end"] == "readmit"
+    lost = next(e for e in evs if e["name"] == "lost")
+    assert lost["args"]["end"] == "open"
+    assert lost["dur"] == pytest.approx(100.0)
+    # stable lane assignment: replica_order first
+    lanes = {e["args"]["name"]: e["pid"] for e in evs
+             if e["name"] == "process_name"}
+    assert lanes["replica d0"] == 8000 and lanes["replica d1"] == 8001
+
+    out = fleet_stats.export_chrome(str(tmp_path / "lanes.json"), recs)
+    doc = json.loads(open(out).read())
+    assert doc["displayTimeUnit"] == "ms" and doc["traceEvents"]
+
+
+def test_export_fleet_timeline_merges_lanes_and_chains(fleet_obs_on,
+                                                       tmp_path):
+    router = _fleet()
+    reqs = _load(4)
+    for r in reqs:
+        router.submit(r)
+    router.run_until_idle(max_steps=4000)
+    assert len(rtrace.RING) > 0
+    # a clean replay ledgers only admission kinds (omitted from the
+    # lanes by design) — seed the control-plane story the lanes exist
+    # to show
+    decisions.record("quarantine_drain", step=1, replica="d1",
+                     inputs={"why": "timeline-test"})
+    decisions.record("replica_lost", step=2, replica="d0",
+                     inputs={"why": "timeline-test"})
+    out = fleet_stats.export_fleet_timeline(str(tmp_path / "fleet.json"))
+    doc = json.loads(open(out).read())
+    evs = doc["traceEvents"]
+    names = {e.get("name") for e in evs}
+    assert "quarantine" in names and "lost" in names   # fleet lanes
+    assert any(e.get("cat") == "fleet" for e in evs)
+    assert any(e.get("cat") == "request" for e in evs)  # span chains
+
+
+# ---------------------------------------------------------------------------
+# CLI hooks
+
+
+def test_obs_report_fleet_unarmed_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         "--fleet"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "not armed" in proc.stdout
+
+
+def test_tdt_lint_fleetobs_smoke():
+    """The tier-1 CI hook (like the --fleet smoke): the armed N=4
+    replay with ledger/merge/coverage/selftest reconciliation."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tdt_lint.py"),
+         "--fleetobs"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "fleetobs OK" in proc.stdout
+    assert "exemplar ->" in proc.stdout

@@ -206,6 +206,7 @@ def check() -> list[str]:
                 f"{sorted(names)}")
     problems.extend(check_lifecycle_coverage())
     problems.extend(check_fleet_coverage())
+    problems.extend(check_decision_coverage())
     return problems
 
 
@@ -246,6 +247,69 @@ def check_lifecycle_coverage() -> list[str]:
         problems.append(
             f"lifecycle coverage names handoff fault {name!r} which no "
             f"longer exists — prune the stale row")
+    return problems
+
+
+def check_decision_coverage() -> list[str]:
+    """The control-decision wiring row (ISSUE 19): the ledger's typed
+    kind axis (``obs.decisions.DECISION_KINDS`` — kind -> the
+    ``FleetRouter`` method(s) recording it) diffed BOTH directions
+    against the live actuation sites, found by AST over the router's
+    source: every ``self._decide("<kind>", ...)`` call, keyed by its
+    enclosing method.  An actuation added without a ledger emit (or a
+    golden row whose site vanished) fails with the diff as the message
+    — the flight recorder must never silently lose a decision class."""
+    import ast
+    import inspect
+
+    from ..obs.decisions import DECISION_KINDS
+    from ..serve.fleet import FleetRouter
+
+    problems: list[str] = []
+    try:
+        tree = ast.parse(inspect.getsource(FleetRouter))
+    except (OSError, TypeError) as e:
+        return [f"decision coverage: cannot read FleetRouter source "
+                f"({e}) — the actuation-site diff is undischarged"]
+
+    live: set[tuple[str, str]] = set()
+    non_literal: list[str] = []
+    for method in ast.walk(tree):
+        if not isinstance(method, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(method):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "_decide"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                live.add((node.args[0].value, method.name))
+            else:
+                non_literal.append(method.name)
+    for m in non_literal:
+        problems.append(
+            f"decision coverage: FleetRouter.{m} calls _decide with a "
+            f"non-literal kind — the static diff cannot type it; use a "
+            f"string literal from DECISION_KINDS")
+
+    golden: set[tuple[str, str]] = {
+        (kind, m) for kind, methods in DECISION_KINDS.items()
+        for m in methods
+    }
+    for kind, m in sorted(live - golden):
+        problems.append(
+            f"decision coverage: FleetRouter.{m} records decision kind "
+            f"{kind!r} with no DECISION_KINDS golden row — a new "
+            f"actuation class must land typed (obs.decisions)")
+    for kind, m in sorted(golden - live):
+        problems.append(
+            f"decision coverage: DECISION_KINDS pins {kind!r} emitted "
+            f"from FleetRouter.{m}, but no such actuation site exists "
+            f"— the controller changed without its flight recorder")
     return problems
 
 

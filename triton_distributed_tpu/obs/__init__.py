@@ -28,9 +28,17 @@ This package is that layer:
   against the committed-bench healthy bands (``obs.history`` — one
   band implementation); breaches surface in ``health()`` and advise
   the AdmissionGovernor.
+- ``obs.decisions``  the fleet control-decision ledger
+  (``TDT_FLEET_OBS=1``): every FleetRouter actuation recorded with its
+  telemetry inputs verbatim, ring + rotated-JSONL retained, the kind
+  axis golden-pinned by ``analysis.completeness``.
+- ``obs.fleet_stats``  cross-replica telemetry federation + fleet-scope
+  anomaly detection (``TDT_FLEET_OBS=1``): per-replica tee collectors
+  merging losslessly into the fleet view, imbalance/skew gauges, and
+  band breaches that carry the ledger decisions from their window.
 - ``obs.server``    the ``TDT_OBS_HTTP`` endpoint: ``/metrics``,
   ``/healthz``, ``/debug/flight``, ``/debug/timeline``,
-  ``/debug/profile``.
+  ``/debug/profile``, ``/debug/fleet``.
 - ``obs.history``   the perf-trajectory sentinel over the committed
   ``BENCH_r*`` rounds (``scripts/bench_history.py``).
 
@@ -47,8 +55,9 @@ import contextlib
 import threading
 
 from . import (
-    anomaly, continuous, costs, export, flight, history, registry, report,
-    request_trace, serve_stats, timeline, tracing,
+    anomaly, continuous, costs, decisions, export, flight, fleet_stats,
+    history, registry, report, request_trace, serve_stats, timeline,
+    tracing,
 )
 
 
@@ -82,8 +91,9 @@ from .tracing import instant, span
 __all__ = [
     "DEFAULT_BYTES_BUCKETS", "DEFAULT_LATENCY_BUCKETS_MS", "REGISTRY",
     "Registry", "anomaly", "comm_call", "continuous", "costs", "counter",
-    "dump_jsonl",
-    "dump_prometheus", "enable", "enabled", "flight", "gauge", "histogram",
+    "decisions", "dump_jsonl",
+    "dump_prometheus", "enable", "enabled", "fleet_stats", "flight",
+    "gauge", "histogram",
     "history", "instant", "observe_timer", "parse_prometheus", "read_jsonl",
     "record_collective", "request_trace", "serve_stats", "server", "span",
     "summary",

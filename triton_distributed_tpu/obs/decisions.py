@@ -1,0 +1,408 @@
+"""Typed, bounded control-decision ledger for the fleet tier
+(``TDT_FLEET_OBS=1``).
+
+The ``serve.fleet.FleetRouter`` actuates autonomously — it routes
+admissions on live gauges, sheds, fails requests over, walks replicas
+through quarantine, and converts replica roles on the SLO attributor's
+say-so — but until ISSUE 19 those actuations left no record of *which*
+telemetry reads drove them.  This module is the controller's flight
+recorder: every actuation site emits a :class:`DecisionRecord` carrying
+its inputs verbatim (the gauge values read, breaker states, the
+dominant_phase and sustained-streak count behind a rebalance, the p99
+exemplar trace id where one drove the decision) plus the affected
+request/replica ids.
+
+Records are retained two ways, exactly like the PR-15 profiler's
+windows: a bounded in-memory ring (``TDT_DECISION_RING``, default 512)
+served by ``/debug/fleet`` and the fleet anomaly events, and an
+optional size-rotated JSONL time-series (``TDT_DECISION_DIR``:
+``decisions_NNNN.jsonl`` segments, oldest deleted —
+``obs.history.load_decision_records`` parses them back).
+
+The kind axis is TYPED: :data:`DECISION_KINDS` is the golden map from
+decision kind to the ``FleetRouter`` method(s) that emit it, and
+``analysis.completeness.check_decision_coverage`` diffs it both
+directions against the live actuation sites — an actuation added
+without a ledger emit (or a golden row whose site vanished) fails
+``tdt_lint --completeness`` with the diff as the message.
+
+The TDT_OBS discipline holds: with ``TDT_FLEET_OBS`` unset every hook
+is one cached-bool check and the fleet replay is byte-identical
+(pinned by ``tests/test_fleet_obs.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from collections import deque
+
+DEFAULT_RING = 512
+# on-disk time-series bounds, shared with the profiler's discipline:
+# segments rotate at this size, oldest beyond the cap are deleted
+SEGMENT_MAX_BYTES = 256 * 1024
+MAX_SEGMENTS = 8
+
+# The golden kind axis: decision kind -> the FleetRouter method(s) that
+# record it (via ``FleetRouter._decide``).  completeness.
+# check_decision_coverage diffs this against the live source both
+# directions, so the table below IS the contract — extending the
+# controller means extending this map in the same PR.
+DECISION_KINDS: dict[str, tuple[str, ...]] = {
+    # admission plane
+    "affinity_hit": ("submit",),            # session routed to its home
+    "affinity_redirect": ("submit",),       # home unavailable, rerouted
+    "route": ("submit",),                   # least-loaded admission pick
+    "shed": ("submit",),                    # no admitting replica
+    "colocate": ("_colocate",),             # saturation shed-back rule
+    # failure plane
+    "replica_lost": ("lose_replica",),
+    "failover": ("_failover",),
+    "failover_shed": ("_failover",),        # ladder exhausted
+    "reprefill": ("_reprefill",),           # handoff fallback re-prefill
+    # quarantine lifecycle (open -> drain -> probe -> close)
+    "quarantine_drain": ("_watch_failures", "_quarantine_tick"),
+    "quarantine_evict": ("_quarantine_tick",),
+    "readmit_probe": ("_probe_tick",),
+    "readmit": ("readmit",),
+    # rebalance plane
+    "rebalance_streak": ("_rebalance_tick",),
+    "recruit": ("_rebalance_tick",),
+    "convert": ("_convert",),
+}
+
+
+def _env_enabled() -> bool:
+    from ..core.utils import env_flag
+
+    return env_flag("TDT_FLEET_OBS")
+
+
+# Cached so a disabled actuation site pays one global load + one bool
+# check (the TDT_OBS discipline); re-read the env via enable(None).
+_ENABLED = _env_enabled()
+
+_LOCK = threading.Lock()
+_LEDGER: "DecisionLedger | None" = None
+
+_pkg_cache: list = []
+
+
+def _suppressed() -> bool:
+    """Honor ``obs.suppress()``: quarantine probes and warmup traffic
+    drive the same actuation sites but must not pollute the ledger."""
+    if not _pkg_cache:
+        import sys
+
+        _pkg_cache.append(sys.modules[__package__])
+    return _pkg_cache[0]._suppressed()
+
+
+def enabled() -> bool:
+    """Whether the ledger records (``TDT_FLEET_OBS=1`` or
+    :func:`enable`, and not inside an ``obs.suppress()`` block)."""
+    return _ENABLED and not _suppressed()
+
+
+def enable(on: bool | None = True) -> bool:
+    """Turn the ledger on/off; ``None`` re-reads ``TDT_FLEET_OBS``."""
+    global _ENABLED
+    _ENABLED = _env_enabled() if on is None else bool(on)
+    return _ENABLED
+
+
+def ring_cap() -> int:
+    """In-memory retention (``TDT_DECISION_RING``, default 512)."""
+    try:
+        return max(1, int(os.environ.get("TDT_DECISION_RING", "")
+                          or DEFAULT_RING))
+    except ValueError:
+        return DEFAULT_RING
+
+
+def decision_dir() -> str | None:
+    """Where the JSONL segments land (``TDT_DECISION_DIR``); None
+    disables persistence (ring only)."""
+    return os.environ.get("TDT_DECISION_DIR", "").strip() or None
+
+
+@dataclasses.dataclass(frozen=True)
+class DecisionRecord:
+    """One controller actuation, inputs verbatim.
+
+    ``inputs`` carries exactly the values the decision read — gauge
+    reads, breaker states, the dominant_phase / streak behind a
+    rebalance, a ``p99_exemplar`` trace id where one drove the call —
+    so a regressed fleet window can be explained from its ledger tail
+    alone, without re-deriving controller state."""
+
+    seq: int
+    step: int
+    t_us: float                      # wall-anchored us (Chrome lanes)
+    kind: str
+    replica: str | None = None
+    request_id: int | None = None
+    session: str | None = None
+    inputs: dict = dataclasses.field(default_factory=dict)
+    note: str | None = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def summary(self) -> str:
+        who = self.replica or "-"
+        req = f" req={self.request_id}" if self.request_id is not None \
+            else ""
+        return (f"step {self.step}: {self.kind} @{who}{req}"
+                + (f" ({self.note})" if self.note else ""))
+
+
+def from_dict(d: dict) -> DecisionRecord:
+    """Rehydrate a persisted JSONL line (``obs.history.
+    load_decision_records`` hands dicts here)."""
+    return DecisionRecord(
+        seq=int(d.get("seq", 0)),
+        step=int(d.get("step", 0)),
+        t_us=float(d.get("t_us", 0.0)),
+        kind=str(d["kind"]),
+        replica=d.get("replica"),
+        request_id=d.get("request_id"),
+        session=d.get("session"),
+        inputs=dict(d.get("inputs") or {}),
+        note=d.get("note"),
+    )
+
+
+class DecisionLedger:
+    """The bounded decision store (one per process under the module
+    singleton; harnesses may install their own via :func:`install`).
+    All mutation happens under one lock; reads copy, so concurrent
+    ``/debug/fleet`` scrapes never see a torn tail."""
+
+    def __init__(self, *, cap: int | None = None,
+                 out_dir: str | None = None):
+        self.cap = int(cap) if cap else ring_cap()
+        self.out_dir = out_dir if out_dir is not None else decision_dir()
+        self._lock = threading.RLock()
+        self._ring: deque[DecisionRecord] = deque(maxlen=self.cap)
+        self.total = 0
+        self._by_kind: dict[str, int] = {}
+        self._segment_idx = 0
+        self._segment_path: str | None = None
+
+    # -- write side --------------------------------------------------------
+
+    def record(self, kind: str, *, step: int, replica: str | None = None,
+               request_id: int | None = None, session: str | None = None,
+               inputs: dict | None = None,
+               note: str | None = None) -> DecisionRecord:
+        if kind not in DECISION_KINDS:
+            raise ValueError(
+                f"unknown decision kind {kind!r} — the ledger is typed; "
+                f"add the kind to obs.decisions.DECISION_KINDS (and its "
+                f"actuation site to the golden) first")
+        with self._lock:
+            rec = DecisionRecord(
+                seq=self.total, step=int(step),
+                t_us=time.time_ns() / 1e3, kind=kind, replica=replica,
+                request_id=request_id, session=session,
+                inputs=dict(inputs or {}), note=note)
+            self._ring.append(rec)
+            self.total += 1
+            self._by_kind[kind] = self._by_kind.get(kind, 0) + 1
+            self._persist(rec)
+        return rec
+
+    # -- read side ---------------------------------------------------------
+
+    def tail(self, n: int | None = None) -> list[DecisionRecord]:
+        with self._lock:
+            recs = list(self._ring)
+        return recs if n is None else recs[-max(0, int(n)):]
+
+    def query(self, *, replica: str | None = None,
+              kind: str | None = None,
+              step_range: tuple[int, int] | None = None,
+              ) -> list[DecisionRecord]:
+        """Retained records filtered by replica / kind / step window
+        (``step_range`` is inclusive of both ends)."""
+        out = []
+        for rec in self.tail():
+            if replica is not None and rec.replica != replica:
+                continue
+            if kind is not None and rec.kind != kind:
+                continue
+            if step_range is not None and not (
+                    step_range[0] <= rec.step <= step_range[1]):
+                continue
+            out.append(rec)
+        return out
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._by_kind)
+
+    def snapshot(self, n: int = 64) -> dict:
+        """The ``/debug/fleet`` ledger block."""
+        with self._lock:
+            return {
+                "cap": self.cap,
+                "total": self.total,
+                "counts": dict(self._by_kind),
+                "tail": [r.to_dict() for r in list(self._ring)[-n:]],
+                "segments": {
+                    "dir": self.out_dir,
+                    "current": self._segment_path,
+                    "index": self._segment_idx,
+                },
+            }
+
+    # -- persistence -------------------------------------------------------
+
+    def _persist(self, rec: DecisionRecord) -> None:
+        if not self.out_dir:
+            return
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            if self._segment_path is None:
+                self._segment_path = os.path.join(
+                    self.out_dir,
+                    f"decisions_{self._segment_idx:04d}.jsonl")
+            line = json.dumps(rec.to_dict(), separators=(",", ":"),
+                              default=str)
+            with open(self._segment_path, "a") as f:
+                f.write(line + "\n")
+            if os.path.getsize(self._segment_path) >= SEGMENT_MAX_BYTES:
+                self._segment_idx += 1
+                self._segment_path = None
+                self._prune_segments()
+        except OSError:
+            # a full/unwritable disk must not take the control plane
+            # down; the ring and /debug/fleet keep working
+            pass
+
+    def _prune_segments(self) -> None:
+        import glob as _glob
+        import re as _re
+
+        rx = _re.compile(r"decisions_(\d+)\.jsonl$")
+        segs = []
+        for p in _glob.glob(os.path.join(self.out_dir,
+                                         "decisions_*.jsonl")):
+            m = rx.search(p)
+            if m:
+                segs.append((int(m.group(1)), p))
+        segs.sort()
+        for _, p in segs[:-MAX_SEGMENTS]:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# module singleton + the hook call sites use
+
+
+def ledger() -> DecisionLedger | None:
+    """The process ledger, if one has been created (armed actuation
+    seen or :func:`install` called)."""
+    return _LEDGER
+
+
+def install(led: DecisionLedger | None) -> DecisionLedger | None:
+    """Install (or clear, with None) the process ledger — the harness
+    entry for custom caps/dirs.  Returns the previous one."""
+    global _LEDGER
+    with _LOCK:
+        prev, _LEDGER = _LEDGER, led
+    return prev
+
+
+def _get_ledger() -> DecisionLedger:
+    global _LEDGER
+    if _LEDGER is None:
+        with _LOCK:
+            if _LEDGER is None:
+                _LEDGER = DecisionLedger()
+    return _LEDGER
+
+
+def record(kind: str, **kw) -> DecisionRecord | None:
+    """The actuation-site hook (``FleetRouter._decide``).  One
+    cached-bool check when ``TDT_FLEET_OBS`` is unset — byte-identical
+    fleet behavior; None inside ``obs.suppress()`` (probe traffic)."""
+    if not _ENABLED:
+        return None
+    if _suppressed():
+        return None
+    return _get_ledger().record(kind, **kw)
+
+
+def query(**kw) -> list[DecisionRecord]:
+    """Query the retained ring (empty when no ledger exists yet)."""
+    led = _LEDGER
+    return [] if led is None else led.query(**kw)
+
+
+def reset() -> None:
+    """Drop the process ledger (tests / lint harness hygiene)."""
+    install(None)
+
+
+# ---------------------------------------------------------------------------
+# exposition
+
+
+def tail_dump(n: int = 64) -> dict:
+    """The ledger block of the ``/debug/fleet`` payload."""
+    led = _LEDGER
+    if led is None:
+        return {"enabled": enabled(), "total": 0, "counts": {},
+                "tail": []}
+    out = led.snapshot(n=n)
+    out["enabled"] = enabled()
+    return out
+
+
+def to_prometheus() -> str:
+    """Decision counters for ``/metrics`` (appended by
+    ``obs.server.metrics_text``).  Empty when nothing recorded."""
+    led = _LEDGER
+    if led is None or led.total == 0:
+        return ""
+    lines = [
+        "# TYPE tdt_fleet_decisions_total counter",
+        f"tdt_fleet_decisions_total {led.total}",
+        "# TYPE tdt_fleet_decisions counter",
+    ]
+    for kind, n in sorted(led.counts().items()):
+        lines.append(f'tdt_fleet_decisions{{kind="{kind}"}} {n}')
+    return "\n".join(lines) + "\n"
+
+
+def format_tail(records, limit: int = 24) -> str:
+    """Human-readable ledger tail (``obs_report.py --fleet``)."""
+    recs = list(records)[-limit:]
+    if not recs:
+        return "(decision ledger empty)\n"
+    lines = []
+    for r in recs:
+        d = r.to_dict() if hasattr(r, "to_dict") else dict(r)
+        who = d.get("replica") or "-"
+        req = d.get("request_id")
+        parts = [f"  #{d.get('seq', '?')} step={d.get('step')} "
+                 f"{d.get('kind'):<18} replica={who}"]
+        if req is not None:
+            parts.append(f"req={req}")
+        if d.get("note"):
+            parts.append(f"note={d['note']}")
+        ins = d.get("inputs") or {}
+        if ins:
+            parts.append("inputs=" + json.dumps(ins, sort_keys=True,
+                                                default=str))
+        lines.append(" ".join(parts))
+    return "\n".join(lines) + "\n"

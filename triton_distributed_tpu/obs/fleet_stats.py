@@ -1,0 +1,803 @@
+"""Cross-replica telemetry federation + fleet-scope anomaly detection
+(``TDT_FLEET_OBS=1``).
+
+The fleet tier (``serve.fleet.FleetRouter``) runs N scheduler replicas,
+but until ISSUE 19 they all fed ONE process-global ``ServeStats`` — a
+regressed fleet p99 could not name the replica that caused it.  This
+module federates the telemetry:
+
+- :class:`ReplicaStats` — a per-replica ``ServeStats`` whose sketches
+  and rate windows TEE every observation into the union collector
+  (``obs.serve_stats.STATS`` by default).  The scheduler's feed sites
+  write ``self.stats`` (``Scheduler.stats``), so installing a
+  ``ReplicaStats`` per replica buys drill-down without touching the
+  serve loop — and the union keeps seeing the exact stream it always
+  saw, which is what pins the federation: **merging the per-replica
+  sketches reproduces the union sketch bucket-for-bucket**, so the
+  fleet-merged p99 equals observing the union stream directly (within
+  the sketch's alpha; ``tests/test_fleet_obs.py`` pins equality).
+- :class:`FleetStats` — the fleet view: merged ttft/request sketches
+  with per-replica drill-down, summed token/request rates, imbalance
+  gauges (pool-occupancy spread across same-role replicas,
+  routing-concentration fraction over the ledger's admission
+  decisions), and a same-role SKEW detector (p99 ratio across replicas
+  playing the same role).
+- Fleet-scope anomaly detection: every ``FLEET_WINDOW_STEPS`` fleet
+  steps the window's totals are judged against ``obs.history.Band``
+  bands (the ONE band implementation), and a breach emits a
+  :class:`FleetAnomalyEvent` carrying the **decision-ledger entries
+  from its window** (``obs.decisions``) — "fleet p99 breached, and
+  here are the rebalance + quarantine decisions inside it".
+- Export: ``/debug/fleet`` (``obs.server``), ``tdt_fleet_*`` series on
+  ``/metrics`` (:func:`to_prometheus`), and a Chrome fleet timeline —
+  one lane per replica with quarantine/lost/role-change spans
+  synthesized from the ledger, merged with the request-trace chains
+  via ``tools.trace_merge`` ``ts_offsets`` (:func:`export_fleet_timeline`).
+
+The TDT_OBS discipline holds: with ``TDT_FLEET_OBS`` unset the router
+never installs the plane and the fleet replay is byte-identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from collections import deque
+
+from . import decisions, history
+from . import serve_stats as serve_stats_mod
+from .serve_stats import QuantileSketch, ServeStats, WindowedRate
+
+# fleet steps per anomaly window (matches the continuous profiler's
+# default cadence; override per FleetStats)
+FLEET_WINDOW_STEPS = 64
+MAX_RETAINED = 32
+SERVE_QUANTILES = serve_stats_mod.SERVE_QUANTILES
+
+# the sketch / rate attributes ReplicaStats tees (every ServeStats
+# sketch and window the scheduler plane feeds)
+SKETCH_NAMES = ("request_ms", "prefill_ms", "decode_ms_per_token",
+                "ttft_ms", "handoff_ms")
+RATE_NAMES = ("tokens", "requests", "failed_requests", "sheds",
+              "preemptions", "evicted_pages", "handoff_pages")
+
+# the admission-plane decision kinds routing concentration counts over
+ROUTE_KINDS = ("route", "affinity_hit", "affinity_redirect")
+
+
+def _env_enabled() -> bool:
+    from ..core.utils import env_flag
+
+    return env_flag("TDT_FLEET_OBS")
+
+
+# Cached bool, the TDT_OBS discipline: a disabled FleetRouter pays one
+# check at construction and nothing per step.
+_ENABLED = _env_enabled()
+
+_LOCK = threading.Lock()
+_FLEET: "FleetStats | None" = None
+
+
+def enabled() -> bool:
+    """Whether the federation plane arms (``TDT_FLEET_OBS=1`` or
+    :func:`enable`)."""
+    return _ENABLED
+
+
+def enable(on: bool | None = True) -> bool:
+    """Turn the plane on/off; ``None`` re-reads ``TDT_FLEET_OBS``."""
+    global _ENABLED
+    _ENABLED = _env_enabled() if on is None else bool(on)
+    return _ENABLED
+
+
+def window_steps() -> int:
+    """Fleet anomaly window length (``TDT_FLEET_WINDOW``, default 64
+    fleet steps)."""
+    try:
+        return max(1, int(os.environ.get("TDT_FLEET_WINDOW", "")
+                          or FLEET_WINDOW_STEPS))
+    except ValueError:
+        return FLEET_WINDOW_STEPS
+
+
+# ---------------------------------------------------------------------------
+# the tee: per-replica sketches that keep the union stream whole
+
+
+class _TeeSketch(QuantileSketch):
+    """A sketch that forwards every observation into a union sketch of
+    the SAME gamma.  The per-replica copy and the union therefore hold
+    the same log-bucket keys for the same values — merging the replica
+    copies reconstructs the union bucket-for-bucket (the federation
+    pin)."""
+
+    __slots__ = ("_union",)
+
+    def __init__(self, union: QuantileSketch):
+        super().__init__(alpha=union.alpha, max_buckets=union.max_buckets)
+        self._union = union
+
+    def observe(self, v: float, exemplar: str | None = None) -> None:
+        super().observe(v, exemplar)
+        self._union.observe(v, exemplar)
+
+
+class _TeeRate(WindowedRate):
+    """A rate window teeing into a union window — the SAME ``now`` is
+    used for both adds, so the per-second buckets stay aligned and the
+    union total equals the sum of the replica totals."""
+
+    __slots__ = ("_union",)
+
+    def __init__(self, union: WindowedRate):
+        super().__init__(window_s=union.window_s)
+        self._union = union
+
+    def add(self, v: float = 1.0, now: float | None = None) -> None:
+        import time
+
+        now = time.monotonic() if now is None else now
+        super().add(v, now=now)
+        self._union.add(v, now=now)
+
+
+class ReplicaStats(ServeStats):
+    """One replica's ``ServeStats`` with every sketch/rate teeing into
+    the union collector.  Installed as ``Scheduler.stats`` by
+    :func:`attach`; gauges and queue depth stay replica-local (the
+    router already publishes them under ``replica_<id>_*`` names)."""
+
+    def __init__(self, replica_id: str, union: ServeStats):
+        super().__init__(alpha=union._alpha, window_s=union._window_s)
+        self.replica_id = str(replica_id)
+        self.union = union
+        for name in SKETCH_NAMES:
+            setattr(self, name, _TeeSketch(getattr(union, name)))
+        for name in RATE_NAMES:
+            setattr(self, name, _TeeRate(getattr(union, name)))
+
+    def reset(self) -> None:
+        self.__init__(self.replica_id, self.union)
+
+
+# ---------------------------------------------------------------------------
+# fleet anomaly events
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetAnomalyEvent:
+    """One fleet-window band breach, carrying the ledger entries from
+    its window — the explanation loop the module docstring promises."""
+
+    metric: str
+    value: float
+    band: tuple[float, float]
+    direction: str
+    drift_pct: float
+    window: int
+    step_start: int
+    step_end: int
+    exemplar: str | None               # p99 exemplar trace id, if traced
+    decisions: tuple[dict, ...]        # ledger records inside the window
+
+    def summary(self) -> str:
+        s = (f"fleet {self.metric}={self.value:g} outside healthy band "
+             f"[{self.band[0]:g}, {self.band[1]:g}] "
+             f"({100 * self.drift_pct:.1f}% worse, window "
+             f"#{self.window} steps {self.step_start}..{self.step_end})")
+        if self.decisions:
+            kinds: dict[str, int] = {}
+            for d in self.decisions:
+                k = d.get("kind", "?")
+                kinds[k] = kinds.get(k, 0) + 1
+            s += ("; " + str(len(self.decisions)) + " ledger decisions ("
+                  + ", ".join(f"{k} x{n}" for k, n in sorted(kinds.items()))
+                  + ")")
+        if self.exemplar:
+            s += f"; p99 exemplar {self.exemplar}"
+        return s
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["summary"] = self.summary()
+        return d
+
+
+# ---------------------------------------------------------------------------
+# the federation plane
+
+
+class FleetStats:
+    """Federated fleet telemetry over per-replica :class:`ReplicaStats`
+    (see module docstring).  ``bands`` is a metric->``history.Band``
+    map for the window comparator (the harness/lint injection point —
+    empty by default, so an unconfigured plane never warns);
+    ``record=False`` keeps a harness run out of the process warning
+    state."""
+
+    def __init__(self, *, union: ServeStats | None = None,
+                 window_steps: int | None = None,
+                 bands: dict[str, history.Band] | None = None,
+                 record: bool = True):
+        self.union = union if union is not None else serve_stats_mod.STATS
+        self.window_steps = int(window_steps) if window_steps \
+            else globals()["window_steps"]()
+        self.bands = dict(bands) if bands else {}
+        self.record = record
+        self._lock = threading.Lock()
+        self.replicas: dict[str, ReplicaStats] = {}
+        self.roles: dict[str, str] = {}
+        self.windows = 0
+        # first fleet step of the open window: 0, not 1 — admission
+        # decisions recorded before the first step carry step=0
+        self._win_start = 0
+        self.last_totals: dict = {}
+        self._events: deque = deque(maxlen=MAX_RETAINED)
+        self._current: tuple = ()
+        self.anomalies_total = 0
+
+    # -- replica registry --------------------------------------------------
+
+    def replica(self, replica_id: str, role: str) -> ReplicaStats:
+        """Get-or-create the replica's tee collector (idempotent; the
+        role is refreshed — conversions call :meth:`set_role`)."""
+        with self._lock:
+            rs = self.replicas.get(replica_id)
+            if rs is None:
+                rs = self.replicas[replica_id] = ReplicaStats(
+                    replica_id, self.union)
+            self.roles[replica_id] = role
+        return rs
+
+    def set_role(self, replica_id: str, role: str) -> None:
+        with self._lock:
+            self.roles[replica_id] = role
+
+    # -- federation reads --------------------------------------------------
+
+    def merged(self, name: str) -> QuantileSketch:
+        """A fresh sketch holding the merge of every replica's ``name``
+        sketch — the federation read.  Merge-safe by construction (same
+        gamma everywhere; ``QuantileSketch.merge`` adds buckets,
+        exemplars ride along)."""
+        with self._lock:
+            reps = list(self.replicas.values())
+        out = QuantileSketch(alpha=self.union._alpha)
+        for rs in reps:
+            out.merge(getattr(rs, name))
+        return out
+
+    def merged_rate(self, name: str) -> float:
+        with self._lock:
+            reps = list(self.replicas.values())
+        return sum(getattr(rs, name).rate() for rs in reps)
+
+    def _role_groups(self) -> dict[str, list[ReplicaStats]]:
+        with self._lock:
+            return {
+                role: [self.replicas[rid]
+                       for rid, r in self.roles.items() if r == role
+                       and rid in self.replicas]
+                for role in sorted(set(self.roles.values()))
+            }
+
+    def role_skew(self) -> float:
+        """The same-role skew detector: per role, the p99 of the
+        role-appropriate sketch (``ttft_ms`` for prefill — first tokens
+        land there; ``request_ms`` for decode — completions land there)
+        across that role's replicas, reported as ``max/min - 1`` (0.0 =
+        perfectly balanced).  The fleet number is the worst role."""
+        worst = 0.0
+        for role, reps in self._role_groups().items():
+            name = "ttft_ms" if role == "prefill" else "request_ms"
+            p99s = [getattr(rs, name).quantile(0.99) for rs in reps
+                    if getattr(rs, name).count > 0]
+            if len(p99s) < 2 or min(p99s) <= 0.0:
+                continue
+            worst = max(worst, max(p99s) / min(p99s) - 1.0)
+        return worst
+
+    def imbalance(self, router=None) -> dict[str, float]:
+        """The imbalance gauges: ``occupancy_spread`` (max-min pool
+        occupancy among same-role ADMITTING replicas, worst role) needs
+        the live router; ``routing_concentration`` (fraction of the
+        window's admission decisions landing on the most-picked
+        replica) reads the ledger."""
+        spread = 0.0
+        if router is not None:
+            by_role: dict[str, list[float]] = {}
+            for rep in router.replicas:
+                if router._admitting(rep):
+                    by_role.setdefault(rep.role, []).append(
+                        rep.scheduler.pool.occupancy())
+            for occ in by_role.values():
+                if len(occ) >= 2:
+                    spread = max(spread, max(occ) - min(occ))
+        routes: dict[str, int] = {}
+        for rec in decisions.query(step_range=(self._win_start, 1 << 62)):
+            if rec.kind in ROUTE_KINDS and rec.replica is not None:
+                routes[rec.replica] = routes.get(rec.replica, 0) + 1
+        total = sum(routes.values())
+        conc = max(routes.values()) / total if total else 0.0
+        return {"fleet_occupancy_spread": spread,
+                "fleet_routing_concentration": conc}
+
+    # -- the window loop ---------------------------------------------------
+
+    def on_step(self, step: int, router=None) -> list[FleetAnomalyEvent]:
+        """The router's per-step hook: rotate a window (and run the
+        band comparator) every ``window_steps`` fleet steps.  Returns
+        the new window's breaches (empty off-boundary)."""
+        if step % self.window_steps != 0:
+            return []
+        return self._rotate(step, router)
+
+    def _rotate(self, step: int, router=None) -> list[FleetAnomalyEvent]:
+        win = (self._win_start, step)
+        recs = decisions.query(step_range=win)
+        totals = {
+            "fleet_ttft_ms_p99": self.merged("ttft_ms").quantile(0.99),
+            "fleet_request_ms_p99":
+                self.merged("request_ms").quantile(0.99),
+            "fleet_tokens_per_s": self.merged_rate("tokens"),
+            "fleet_requests_per_s": self.merged_rate("requests"),
+            "fleet_decision_rate": len(recs) / float(self.window_steps),
+            "fleet_role_skew": self.role_skew(),
+        }
+        totals.update(self.imbalance(router))
+        events: list[FleetAnomalyEvent] = []
+        for metric, band in self.bands.items():
+            value = totals.get(metric)
+            if not isinstance(value, (int, float)) \
+                    or isinstance(value, bool):
+                continue
+            drift = band.breach(float(value))
+            if drift is None:
+                continue
+            exemplar = None
+            for name in ("request_ms", "ttft_ms"):
+                exemplar = getattr(self.union, name).exemplar(0.99)
+                if exemplar:
+                    break
+            events.append(FleetAnomalyEvent(
+                metric=metric, value=float(value),
+                band=(band.lo, band.hi), direction=band.direction,
+                drift_pct=drift, window=self.windows,
+                step_start=win[0], step_end=win[1],
+                exemplar=exemplar,
+                decisions=tuple(r.to_dict() for r in recs),
+            ))
+        with self._lock:
+            self.windows += 1
+            self.last_totals = dict(totals)
+            self._win_start = step + 1
+            if self.record:
+                self._current = tuple(events)
+                for e in events:
+                    self._events.append(e)
+                    self.anomalies_total += 1
+        return events
+
+    # -- read side ---------------------------------------------------------
+
+    def current(self) -> list[FleetAnomalyEvent]:
+        """The latest completed window's breaches (the warning
+        state)."""
+        return list(self._current)
+
+    def recent_events(self, n: int = 8) -> list[FleetAnomalyEvent]:
+        with self._lock:
+            return list(self._events)[-int(n):]
+
+    def health_fragment(self) -> dict | None:
+        """Attached under ``fleet_obs`` by ``FleetRouter.health()`` when
+        the latest window breached: a WARNING state, never a status
+        flip (``/healthz`` stays 200 — drift never 503s, the PR-15
+        rule).  None when healthy, so an unarmed snapshot is
+        byte-identical."""
+        cur = self.current()
+        if not cur:
+            return None
+        return {
+            "status": "warn",
+            "anomalies": [e.summary() for e in cur],
+            "total": self.anomalies_total,
+        }
+
+    def snapshot(self) -> dict:
+        """The ``/debug/fleet`` stats block: merged views, per-replica
+        drill-down, the last window's imbalance gauges, retained
+        anomalies."""
+        with self._lock:
+            reps = dict(self.replicas)
+            roles = dict(self.roles)
+            totals = dict(self.last_totals)
+            windows = self.windows
+            cur = list(self._current)
+            recent = list(self._events)[-8:]
+            total = self.anomalies_total
+        merged_ttft = self.merged("ttft_ms")
+        merged_req = self.merged("request_ms")
+        return {
+            "window_steps": self.window_steps,
+            "windows": windows,
+            "merged": {
+                "ttft_ms": merged_ttft.to_dict(),
+                "request_ms": merged_req.to_dict(),
+                "tokens_per_s_window": self.merged_rate("tokens"),
+                "requests_per_s_window": self.merged_rate("requests"),
+                "requests_total": sum(rs.requests.total
+                                      for rs in reps.values()),
+            },
+            "replicas": {
+                rid: {
+                    "role": roles.get(rid),
+                    "ttft_ms_p99": rs.ttft_ms.quantile(0.99),
+                    "request_ms_p99": rs.request_ms.quantile(0.99),
+                    "tokens_per_s_window": rs.tokens.rate(),
+                    "tokens_total": rs.tokens.total,
+                    "requests_total": rs.requests.total,
+                    "sheds_total": rs.sheds.total,
+                    "preemptions_total": rs.preemptions.total,
+                }
+                for rid, rs in sorted(reps.items())
+            },
+            "last_window_totals": totals,
+            "anomalies": [e.to_dict() for e in cur],
+            "recent_anomalies": [e.summary() for e in recent],
+            "anomalies_total": total,
+        }
+
+    def to_prometheus(self) -> str:
+        """The ``tdt_fleet_*`` series ``obs.server.metrics_text``
+        appends: merged sketch summaries, fleet gauges, per-replica
+        labelled drill-down gauges.  Empty with no replicas installed
+        (the plane never pollutes a non-fleet scrape)."""
+        with self._lock:
+            reps = dict(self.replicas)
+            roles = dict(self.roles)
+            totals = dict(self.last_totals)
+        if not reps:
+            return ""
+        lines: list[str] = []
+
+        def sk(name: str, sketch: QuantileSketch) -> None:
+            lines.append(f"# TYPE {name} summary")
+            for q in SERVE_QUANTILES:
+                lines.append(
+                    f'{name}{{quantile="{q:g}"}} {sketch.quantile(q)!r}')
+            lines.append(f"{name}_sum {sketch.sum!r}")
+            lines.append(f"{name}_count {sketch.count}")
+
+        sk("tdt_fleet_ttft_ms", self.merged("ttft_ms"))
+        sk("tdt_fleet_request_ms", self.merged("request_ms"))
+
+        def g(name: str, v: float) -> None:
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {float(v)!r}")
+
+        g("tdt_fleet_replicas", len(reps))
+        g("tdt_fleet_windows", self.windows)
+        g("tdt_fleet_tokens_per_s_window", self.merged_rate("tokens"))
+        g("tdt_fleet_requests_per_s_window", self.merged_rate("requests"))
+        g("tdt_fleet_role_skew", self.role_skew())
+        g("tdt_fleet_anomalies_total", self.anomalies_total)
+        for name in ("fleet_occupancy_spread",
+                     "fleet_routing_concentration",
+                     "fleet_decision_rate"):
+            if name in totals:
+                g("tdt_" + name, totals[name])
+        for metric in ("ttft_ms_p99", "request_ms_p99",
+                       "tokens_per_s_window", "requests_total"):
+            lines.append(f"# TYPE tdt_fleet_replica_{metric} gauge")
+            for rid, rs in sorted(reps.items()):
+                if metric == "ttft_ms_p99":
+                    v = rs.ttft_ms.quantile(0.99)
+                elif metric == "request_ms_p99":
+                    v = rs.request_ms.quantile(0.99)
+                elif metric == "tokens_per_s_window":
+                    v = rs.tokens.rate()
+                else:
+                    v = rs.requests.total
+                role = roles.get(rid, "")
+                lines.append(
+                    f'tdt_fleet_replica_{metric}{{replica="{rid}",'
+                    f'role="{role}"}} {float(v)!r}')
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ---------------------------------------------------------------------------
+# module singleton + the FleetRouter hooks
+
+
+def current() -> FleetStats | None:
+    """The process federation plane, if a router attached one (or a
+    harness installed one)."""
+    return _FLEET
+
+
+def install(fs: FleetStats | None) -> FleetStats | None:
+    """Install (or clear, with None) the process plane; returns the
+    previous one."""
+    global _FLEET
+    with _LOCK:
+        prev, _FLEET = _FLEET, fs
+    return prev
+
+
+def reset() -> None:
+    install(None)
+
+
+def attach(router) -> FleetStats | None:
+    """The ``FleetRouter.__init__`` hook: with ``TDT_FLEET_OBS`` armed,
+    build a fresh :class:`FleetStats`, install it as the process plane
+    (latest router wins, the ``obs.server`` register_engine rule), and
+    swap a :class:`ReplicaStats` tee into every replica's scheduler.
+    Returns None (and touches nothing) when the plane is off — the
+    byte-identical pin."""
+    if not _ENABLED:
+        return None
+    fs = FleetStats()
+    install(fs)
+    for rep in router.replicas:
+        rep.scheduler.stats = fs.replica(rep.replica_id, rep.role)
+    return fs
+
+
+def snapshot_dump() -> dict:
+    """The fleet-stats block of ``/debug/fleet`` (stub when the plane
+    never armed, so a dashboard can probe for the capability)."""
+    fs = _FLEET
+    if fs is None:
+        return {"enabled": enabled(),
+                "hint": "set TDT_FLEET_OBS=1 (docs/observability.md)"}
+    out = fs.snapshot()
+    out["enabled"] = enabled()
+    return out
+
+
+def health_fragment() -> dict | None:
+    fs = _FLEET
+    return None if fs is None else fs.health_fragment()
+
+
+def to_prometheus() -> str:
+    fs = _FLEET
+    return "" if fs is None else fs.to_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# Chrome fleet timeline
+
+
+def to_chrome(records, *, replica_order=None) -> list[dict]:
+    """Chrome-trace events synthesized from ledger records: one pid
+    LANE per replica (ordering stable: ``replica_order`` first, then
+    first-seen), quarantine (drain -> readmit/evict-end) and lost spans
+    as ``X`` events, conversions/failovers/recruits as instants.  The
+    high-volume admission kinds (route/affinity) are omitted — the
+    request chains themselves carry that story when merged."""
+    recs = [r.to_dict() if hasattr(r, "to_dict") else dict(r)
+            for r in records]
+    lanes: dict[str, int] = {}
+    for rid in (replica_order or ()):
+        lanes.setdefault(str(rid), 8000 + len(lanes))
+    for d in recs:
+        rid = d.get("replica")
+        if rid is not None:
+            lanes.setdefault(str(rid), 8000 + len(lanes))
+    evs: list[dict] = []
+    t_max = max((float(d.get("t_us", 0.0)) for d in recs), default=0.0)
+    open_spans: dict[tuple[str, str], dict] = {}
+
+    def close(rid: str, name: str, t1: float, end_kind: str) -> None:
+        span = open_spans.pop((rid, name), None)
+        if span is not None:
+            span["dur"] = max(0.0, t1 - span["ts"])
+            span["args"]["end"] = end_kind
+            evs.append(span)
+
+    for d in recs:
+        rid = str(d.get("replica")) if d.get("replica") is not None \
+            else None
+        if rid is None:
+            continue
+        kind = d.get("kind")
+        t = float(d.get("t_us", 0.0))
+        pid = lanes[rid]
+        args = {"seq": d.get("seq"), "step": d.get("step"),
+                "inputs": d.get("inputs") or {}}
+        if kind == "quarantine_drain":
+            open_spans.setdefault(
+                (rid, "quarantine"),
+                {"name": "quarantine", "cat": "fleet", "ph": "X",
+                 "ts": t, "dur": 0.0, "pid": pid, "tid": 0,
+                 "args": dict(args)})
+        elif kind in ("readmit", "quarantine_evict"):
+            if kind == "readmit":
+                close(rid, "quarantine", t, "readmit")
+            evs.append({"name": kind, "cat": "fleet", "ph": "i",
+                        "s": "p", "ts": t, "pid": pid, "tid": 0,
+                        "args": args})
+        elif kind == "replica_lost":
+            open_spans[(rid, "lost")] = {
+                "name": "lost", "cat": "fleet", "ph": "X", "ts": t,
+                "dur": 0.0, "pid": pid, "tid": 0, "args": dict(args)}
+        elif kind == "convert":
+            close(rid, "recruit", t, "convert")
+            evs.append({"name": "convert", "cat": "fleet", "ph": "i",
+                        "s": "p", "ts": t, "pid": pid, "tid": 0,
+                        "args": args})
+        elif kind == "recruit":
+            open_spans.setdefault(
+                (rid, "recruit"),
+                {"name": "recruit", "cat": "fleet", "ph": "X", "ts": t,
+                 "dur": 0.0, "pid": pid, "tid": 0, "args": dict(args)})
+        elif kind in ("failover", "failover_shed", "reprefill", "shed",
+                      "rebalance_streak", "readmit_probe"):
+            evs.append({"name": kind, "cat": "fleet", "ph": "i",
+                        "s": "p", "ts": t, "pid": pid, "tid": 0,
+                        "args": {**args,
+                                 "request_id": d.get("request_id")}})
+    for (rid, name), span in open_spans.items():
+        # still open at export time: extend to the newest record
+        span["dur"] = max(0.0, t_max - span["ts"])
+        span["args"]["end"] = "open"
+        evs.append(span)
+    for rid, pid in lanes.items():
+        evs.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "tid": 0, "args": {"name": f"replica {rid}"}})
+    return evs
+
+
+def export_chrome(path: str, records=None, *,
+                  replica_order=None) -> str:
+    """Write the fleet lanes as Chrome-trace JSON in the envelope
+    layout ``obs.tracing.export`` / ``obs.request_trace.export_chrome``
+    use, so ``tools.trace_merge`` accepts it like any per-process span
+    file."""
+    if records is None:
+        led = decisions.ledger()
+        records = led.tail() if led is not None else []
+    with open(path, "w") as f:
+        f.write('{"displayTimeUnit":"ms","traceEvents":')
+        f.write(json.dumps(
+            to_chrome(records, replica_order=replica_order),
+            separators=(",", ":"), default=str))
+        f.write("}")
+    return path
+
+
+def export_fleet_timeline(out_path: str, *, records=None, traces=None,
+                          replica_order=None) -> str:
+    """The merged fleet timeline: replica lanes (ledger spans) overlaid
+    with the cross-replica request chains (``obs.request_trace`` — its
+    tiers ARE replica ids under the fleet router), merged through
+    ``tools.trace_merge.merge_traces`` with explicit ``ts_offsets``.
+    Both planes are wall-anchored on this host (ledger ``t_us`` =
+    ``time.time_ns()/1e3``; traces anchor wall then advance by
+    monotonic deltas), so the offsets are 0.0 here — the parameter is
+    the alignment hook for replicas on OTHER hosts, whose ledger dumps
+    carry their own clock."""
+    import tempfile
+
+    from ..tools import trace_merge
+    from . import request_trace
+
+    with tempfile.TemporaryDirectory(prefix="tdt-fleet-tl-") as td:
+        fleet_path = os.path.join(td, "fleet_lanes.json")
+        export_chrome(fleet_path, records, replica_order=replica_order)
+        if traces is None:
+            traces = request_trace.RING.recent(len(request_trace.RING))
+        inputs, offsets = [fleet_path], [0.0]
+        if traces:
+            trace_path = os.path.join(td, "request_chains.json")
+            request_trace.export_chrome(trace_path, traces)
+            inputs.append(trace_path)
+            offsets.append(0.0)
+        trace_merge.merge_traces(inputs, list(range(len(inputs))),
+                                 out_path, ts_offsets=offsets)
+    return out_path
+
+
+# ---------------------------------------------------------------------------
+# selftest (tdt_lint --fleetobs + tier-1)
+
+
+def selftest(seed: int = 0) -> list[str]:
+    """Both-direction fleet anomaly check, no router needed: a clean
+    2-replica feed judged against its own healthy band must stay
+    quiet; an inflated replay (one replica's latencies x100 — both a
+    p99 breach and a same-role skew) must be caught, with the event
+    naming the p99 exemplar and carrying the ledger decisions from its
+    window.  Perturbs the decisions singleton; restores it.  Returns
+    problems (empty = pass)."""
+    problems: list[str] = []
+    prev_dec_enabled = decisions.enable(True)
+    prev_led = decisions.install(
+        decisions.DecisionLedger(cap=64, out_dir=None))
+    try:
+        def run(inflate: float) -> tuple[FleetStats, list]:
+            union = ServeStats()
+            fs = FleetStats(union=union, window_steps=4, record=False)
+            a = fs.replica("p0", "prefill")
+            b = fs.replica("p1", "prefill")
+            for i in range(16):
+                a.observe_ttft(10.0 + (i % 4),
+                               exemplar=f"req-fleet-selftest-{seed}-a{i}")
+                b.observe_ttft(10.0 + ((i + 1) % 4) * inflate,
+                               exemplar=f"req-fleet-selftest-{seed}-b{i}")
+            return fs, fs.on_step(4)
+
+        # the healthy band from a clean run's own totals
+        base, _ = run(1.0)
+        t = dict(base.last_totals)
+        bands = {
+            "fleet_ttft_ms_p99": history.healthy_band(
+                [t["fleet_ttft_ms_p99"] * 0.9,
+                 t["fleet_ttft_ms_p99"] * 1.1], "lower"),
+            "fleet_role_skew": history.healthy_band(
+                [0.0, max(t["fleet_role_skew"], 0.05)], "lower"),
+        }
+        bands = {k: v for k, v in bands.items() if v is not None}
+        if len(bands) < 2:
+            return ["selftest: could not build both healthy bands from "
+                    "the clean feed"]
+
+        # a ledger decision inside the window, for events to carry
+        decisions.record("quarantine_drain", step=2, replica="p1",
+                         inputs={"selftest": True, "seed": seed})
+
+        # direction 1: the clean replay must stay quiet
+        fs_clean = FleetStats(union=ServeStats(), window_steps=4,
+                              bands=bands, record=False)
+        a = fs_clean.replica("p0", "prefill")
+        b = fs_clean.replica("p1", "prefill")
+        for i in range(16):
+            a.observe_ttft(10.0 + (i % 4),
+                           exemplar=f"req-fleet-selftest-{seed}-a{i}")
+            b.observe_ttft(10.0 + ((i + 1) % 4),
+                           exemplar=f"req-fleet-selftest-{seed}-b{i}")
+        clean = fs_clean.on_step(4)
+        if clean:
+            problems.append(
+                f"selftest: clean replay flagged "
+                f"{[e.metric for e in clean]} — an identical feed must "
+                f"stay inside its own band")
+
+        # direction 2: the inflated replay must be caught on BOTH axes
+        fs_bad = FleetStats(union=ServeStats(), window_steps=4,
+                            bands=bands, record=False)
+        a = fs_bad.replica("p0", "prefill")
+        b = fs_bad.replica("p1", "prefill")
+        for i in range(16):
+            a.observe_ttft(10.0 + (i % 4),
+                           exemplar=f"req-fleet-selftest-{seed}-a{i}")
+            b.observe_ttft((10.0 + ((i + 1) % 4)) * 100.0,
+                           exemplar=f"req-fleet-selftest-{seed}-b{i}")
+        bad = fs_bad.on_step(4)
+        hit = {e.metric for e in bad}
+        for metric in ("fleet_ttft_ms_p99", "fleet_role_skew"):
+            if metric not in hit:
+                problems.append(
+                    f"selftest: the 100x single-replica inflation did "
+                    f"not breach {metric} — the fleet comparator is "
+                    f"blind on that axis")
+        for e in bad:
+            if not e.exemplar:
+                problems.append(
+                    f"selftest: breach {e.metric} names no p99 "
+                    f"exemplar")
+            if not e.decisions:
+                problems.append(
+                    f"selftest: breach {e.metric} carries no ledger "
+                    f"decisions from its window")
+    finally:
+        decisions.install(prev_led)
+        decisions.enable(prev_dec_enabled)
+    return problems

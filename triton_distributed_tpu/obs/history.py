@@ -169,6 +169,7 @@ def load_rounds(root: str) -> list[Round]:
 
 
 _PROFILE_SEGMENT_RE = re.compile(r"profile_(\d+)\.jsonl$")
+_DECISION_SEGMENT_RE = re.compile(r"decisions_(\d+)\.jsonl$")
 
 
 def load_profile_windows(dirpath: str) -> list[dict]:
@@ -195,6 +196,34 @@ def load_profile_windows(dirpath: str) -> list[dict]:
                 except ValueError:
                     continue
                 if isinstance(rec, dict) and "window" in rec:
+                    out.append(rec)
+    return out
+
+
+def load_decision_records(dirpath: str) -> list[dict]:
+    """Parse the control-decision ledger's on-disk time-series
+    (``obs.decisions`` writes one JSONL line per record into
+    ``decisions_NNNN.jsonl`` segments under ``TDT_DECISION_DIR``, the
+    profiler's rotation discipline).  Returns the record dicts in
+    ledger order — ascending (segment, line) — skipping unparseable
+    lines exactly like :func:`load_profile_windows`."""
+    paths = []
+    for p in glob.glob(os.path.join(dirpath, "decisions_*.jsonl")):
+        m = _DECISION_SEGMENT_RE.search(p)
+        if m:
+            paths.append((int(m.group(1)), p))
+    out: list[dict] = []
+    for _, p in sorted(paths):
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "kind" in rec:
                     out.append(rec)
     return out
 
@@ -242,6 +271,16 @@ def direction_for(metric: str, unit: str) -> str:
     # the wrong membership — growth is the regression (fleet_ttft_ms_
     # p99_under_loss rides the ms rule above)
     if u == "steps" or "convergence" in metric:
+        return "lower"
+    # fleet-obs control-plane health (ISSUE 19): a rising decision
+    # RATE means the controller is actuating more (sheds, failovers,
+    # quarantine walks — a healthy fleet routes and little else), and
+    # rising same-role SKEW or occupancy SPREAD means the balancer is
+    # losing — growth is the regression for all three.  Federation
+    # merge counts (fleet_requests_*, fleet_tokens_*) keep the
+    # throughput default below.
+    if any(tok in metric for tok in ("decision_rate", "skew",
+                                     "spread")):
         return "lower"
     return "higher"
 

@@ -37,6 +37,11 @@
   ``/debug/trace/<id>`` one trace's spans, overlay events and SLO
   attribution (``obs.request_trace``) — the SLO-debugging workflow's
   last hop: 503 -> exemplar id -> waterfall (docs/serving.md).
+- ``GET /debug/fleet``   — the fleet observability plane
+  (``TDT_FLEET_OBS=1``): the federation snapshot (merged sketches,
+  per-replica drill-down, imbalance gauges, retained fleet anomalies)
+  plus the control-decision ledger tail (``obs.decisions``; last 64
+  records, ``?n=`` up to 512).  Disarmed processes answer a stub.
 
 The health source registered via ``maybe_start`` / ``register_engine``
 may be an :class:`~..models.engine.Engine` or a
@@ -68,6 +73,10 @@ FLIGHT_DUMP_DEFAULT = 256
 FLIGHT_DUMP_MAX = 2048
 TIMELINE_DUMP_DEFAULT = 4096
 TIMELINE_DUMP_MAX = 16384
+# decision-ledger tail bounds for /debug/fleet (the ring holds up to
+# TDT_DECISION_RING records; one scrape must stay bounded)
+FLEET_DUMP_DEFAULT = 64
+FLEET_DUMP_MAX = 512
 
 
 def _query_n(query: str, default: int, cap: int) -> int:
@@ -149,6 +158,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(self._telemetry().serve_dump(),
                                            default=str),
                            "application/json")
+            elif path == "/debug/fleet":
+                n = _query_n(query, FLEET_DUMP_DEFAULT, FLEET_DUMP_MAX)
+                self._send(200, json.dumps(self._telemetry().fleet_dump(n),
+                                           default=str),
+                           "application/json")
             elif path == "/debug/trace" or path.startswith("/debug/trace/"):
                 trace_id = path[len("/debug/trace/"):] \
                     if path.startswith("/debug/trace/") else None
@@ -160,7 +174,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "error": f"unknown path {path!r}",
                     "endpoints": ["/metrics", "/healthz", "/debug/flight",
                                   "/debug/timeline", "/debug/profile",
-                                  "/debug/serve", "/debug/trace"],
+                                  "/debug/serve", "/debug/fleet",
+                                  "/debug/trace"],
                 }), "application/json")
         except BrokenPipeError:
             pass
@@ -210,10 +225,13 @@ class TelemetryServer:
     # -- endpoint bodies ---------------------------------------------------
 
     def metrics_text(self) -> str:
-        from . import continuous, dump_prometheus, serve_stats
+        from . import continuous, decisions, dump_prometheus, fleet_stats
+        from . import serve_stats
 
         return (dump_prometheus() + serve_stats.STATS.to_prometheus()
-                + continuous.to_prometheus())
+                + continuous.to_prometheus()
+                + fleet_stats.to_prometheus()
+                + decisions.to_prometheus())
 
     def health(self) -> tuple[int, dict]:
         engine = self._engine_ref()
@@ -302,6 +320,20 @@ class TelemetryServer:
             return {"enabled": True, "windows_total": 0,
                     "anomalies_total": 0, "last_window": None}
         return prof.snapshot()
+
+    def fleet_dump(self, n: int = FLEET_DUMP_DEFAULT) -> dict:
+        """``/debug/fleet``: the federation plane's snapshot (merged
+        sketches, per-replica drill-down, imbalance gauges, retained
+        fleet anomalies) plus the decision-ledger tail (last ``n``
+        records, ``?n=`` clamped to [1, 512]).  Disarmed processes
+        answer a stub rather than 404, the ``/debug/profile`` rule."""
+        from . import decisions, fleet_stats
+
+        n = max(1, min(int(n), FLEET_DUMP_MAX))
+        return {
+            "fleet_stats": fleet_stats.snapshot_dump(),
+            "decisions": decisions.tail_dump(n),
+        }
 
     def timeline_dump(self, n: int = TIMELINE_DUMP_DEFAULT) -> dict:
         """The attribution view.  Armed (``TDT_PROFILE=1``) with a

@@ -53,6 +53,8 @@ import enum
 import time
 
 from .. import obs
+from ..obs import decisions
+from ..obs import fleet_stats as fleet_obs
 from . import handoff as handoff_mod
 from .budget import pages_needed
 from .queue import Request, RequestState
@@ -250,6 +252,18 @@ class FleetRouter:
         self._dom_role: str | None = None
         self._dom_count = 0
         self._dom_first_step = 0
+        # the fleet observability plane (TDT_FLEET_OBS=1): per-replica
+        # tee collectors + fleet-merged windows; None when off, and
+        # nothing above pays for it
+        self.fleet_stats = fleet_obs.attach(self)
+
+    def _decide(self, kind: str, **kw) -> None:
+        """Ledger one control-plane actuation (``obs.decisions``).
+        Every call site gates on ``decisions.enabled()`` BEFORE
+        building its inputs dict, so an unarmed fleet pays one bool
+        read per actuation; ``analysis.completeness`` pins these sites
+        against the ``DECISION_KINDS`` golden both directions."""
+        decisions.record(kind, step=self.steps, **kw)
 
     # -- membership predicates ---------------------------------------------
 
@@ -310,6 +324,7 @@ class FleetRouter:
         prefill replica, else — every prefill replica pressured or
         quarantined — the least-loaded admitting decode replica runs it
         COLOCATED.  No admitting replica anywhere -> terminal shed."""
+        home: str | None = None
         if session is not None:
             self._session_of[req.req_id] = session
             home = self._affinity.get(session)
@@ -319,6 +334,13 @@ class FleetRouter:
                     and not self._pressured(rep.scheduler):
                 if obs.enabled():
                     obs.counter("fleet_affinity_hits").inc()
+                if decisions.enabled():
+                    self._decide(
+                        "affinity_hit", replica=rep.replica_id,
+                        request_id=req.req_id, session=session,
+                        inputs={"home": home,
+                                "load": self._load(rep.scheduler),
+                                "pressured": False, "role": rep.role})
                 if rep.role == "decode":
                     self.colocated += 1
                 return rep.scheduler.submit(req, now=now)
@@ -349,7 +371,28 @@ class FleetRouter:
             if obs.enabled():
                 obs.serve_stats.STATS.request_shed()
                 obs.counter("fleet_shed_no_replica").inc()
+            if decisions.enabled():
+                self._decide(
+                    "shed", request_id=req.req_id, session=session,
+                    inputs={"reason": req.shed_reason,
+                            "prompt_len": req.prompt_len,
+                            "max_new_tokens": req.max_new_tokens})
             return False
+        if decisions.enabled():
+            inputs = {"home": home, "role": target.role,
+                      "load": self._load(target.scheduler),
+                      "pressured": self._pressured(target.scheduler)}
+            if home is not None and home != target.replica_id:
+                # the session HAD a home replica and didn't get it
+                self._decide(
+                    "affinity_redirect", replica=target.replica_id,
+                    request_id=req.req_id, session=session,
+                    inputs=inputs)
+            else:
+                self._decide(
+                    "route", replica=target.replica_id,
+                    request_id=req.req_id, session=session,
+                    inputs=inputs)
         if target.role == "decode":
             self.colocated += 1
             if obs.enabled():
@@ -386,6 +429,8 @@ class FleetRouter:
         if self.cfg.rebalance_enabled:
             self._rebalance_tick()
         self._publish_gauges()
+        if self.fleet_stats is not None:
+            self.fleet_stats.on_step(self.steps, router=self)
         return FleetStepResult(
             results=results,
             handoffs=self.handoffs - h0,
@@ -420,6 +465,15 @@ class FleetRouter:
         rep = self._by_id[replica_id]
         if rep.lost:
             return []
+        if decisions.enabled():
+            self._decide(
+                "replica_lost", replica=replica_id,
+                inputs={"reason": reason,
+                        "residents": sum(
+                            1 for s in rep.scheduler.slots
+                            if s is not None),
+                        "queue_depth": rep.scheduler.queue.depth,
+                        "stamp_carry_ok": self._stamp_carry_ok})
         rep.lost = True
         rep.evicted = True
         rep.draining = True
@@ -478,6 +532,18 @@ class FleetRouter:
                 rep.draining = True
                 if obs.enabled():
                     obs.counter("fleet_quarantine_drains").inc()
+                if decisions.enabled():
+                    # the failing request's trace id IS the exemplar
+                    # that drove the quarantine — the lint replay
+                    # asserts it resolves in the trace ring
+                    self._decide(
+                        "quarantine_drain", replica=rep.replica_id,
+                        request_id=req.req_id,
+                        inputs={"error": req.error,
+                                "flap_threshold":
+                                    self.cfg.flap_threshold,
+                                "exemplar": getattr(
+                                    req.trace, "trace_id", None)})
             if self._failover_count.get(req.req_id, 0) \
                     >= self.cfg.max_failovers_per_request:
                 continue   # replaying it again would replay the fault
@@ -519,6 +585,13 @@ class FleetRouter:
             if obs.enabled():
                 obs.serve_stats.STATS.request_shed()
                 obs.counter("fleet_failover_shed").inc()
+            if decisions.enabled():
+                self._decide(
+                    "failover_shed", replica=from_rid,
+                    request_id=req.req_id,
+                    inputs={"reason": reason,
+                            "failover_count":
+                                self._failover_count[req.req_id]})
             self.failover_shed += 1
             return False
         target = targets[0]
@@ -526,6 +599,15 @@ class FleetRouter:
         self.failover_ids.add(req.req_id)
         if obs.enabled():
             obs.counter("fleet_failovers").inc()
+        if decisions.enabled():
+            self._decide(
+                "failover", replica=target.replica_id,
+                request_id=req.req_id,
+                inputs={"from": from_rid, "to": target.replica_id,
+                        "reason": reason,
+                        "load": self._load(target.scheduler),
+                        "failover_count":
+                            self._failover_count[req.req_id]})
         ok = target.scheduler.submit(req)
         if ok:
             sess = self._session_of.get(req.req_id)
@@ -558,12 +640,28 @@ class FleetRouter:
                 rep.draining = True
                 if obs.enabled():
                     obs.counter("fleet_quarantine_drains").inc()
+                if decisions.enabled():
+                    # breaker walked open outside the flap watcher
+                    # (e.g. failed readmission probes): the best
+                    # exemplar is the live p99's
+                    self._decide(
+                        "quarantine_drain", replica=rep.replica_id,
+                        inputs={"flap_threshold":
+                                    self.cfg.flap_threshold,
+                                "exemplar": obs.serve_stats.STATS
+                                    .request_ms.exemplar(0.99)})
             if not rep.evicted and self._drained(rep):
                 rep.evicted = True
                 rep.probe_successes = 0
                 self.quarantined_history.append(rep.replica_id)
                 if obs.enabled():
                     obs.counter("fleet_quarantine_evictions").inc()
+                if decisions.enabled():
+                    self._decide(
+                        "quarantine_evict", replica=rep.replica_id,
+                        inputs={"drained": True,
+                                "probe_interval_steps":
+                                    self.cfg.probe_interval_steps})
 
     def _probe_tick(self) -> None:
         """Readmission probes: every ``probe_interval_steps`` each
@@ -578,7 +676,18 @@ class FleetRouter:
         for rep in self.replicas:
             if not rep.quarantined:
                 continue
-            if self._probe(rep):
+            ok = self._probe(rep)
+            if decisions.enabled():
+                # recorded OUTSIDE the suppressed probe run: the probe
+                # traffic stays out of the sketches, the DECISION to
+                # probe (and its outcome) lands in the ledger
+                self._decide(
+                    "readmit_probe", replica=rep.replica_id,
+                    inputs={"ok": ok,
+                            "probe_successes": rep.probe_successes,
+                            "interval":
+                                self.cfg.probe_interval_steps})
+            if ok:
                 rep.probe_successes += 1
                 if rep.probe_successes >= self.cfg.readmit_probe_successes:
                     self.readmit(rep.replica_id)
@@ -626,6 +735,12 @@ class FleetRouter:
                 f"replica {replica_id!r} was LOST, not quarantined — "
                 f"readmission needs a replacement replica, not a "
                 f"breaker reset")
+        if decisions.enabled():
+            self._decide(
+                "readmit", replica=replica_id,
+                inputs={"probe_successes": rep.probe_successes,
+                        "required":
+                            self.cfg.readmit_probe_successes})
         resilience.reset_breaker(replica_breaker_name(replica_id))
         rep.draining = False
         rep.evicted = False
@@ -642,7 +757,8 @@ class FleetRouter:
         return bool(admitting) and all(
             self._pressured(r.scheduler) for r in admitting)
 
-    def _dominant_role_demand(self) -> str | None:
+    def _dominant_role_demand(self, detail: dict | None = None) \
+            -> str | None:
         """The measurement half of the loop: the attributor's
         ``dominant_phase`` over the live p99 sketch exemplars,
         cross-checked against role-wide pressure.  Decode demand reads
@@ -651,22 +767,34 @@ class FleetRouter:
         ARE decode-capacity shortage) and ``handoff`` (prompts parked
         because no decode replica can adopt).  Prefill demand reads the
         ``ttft_ms`` p99: ``prefill`` or ``queue`` dominance with the
-        prefill role pressured."""
+        prefill role pressured.  ``detail`` (when given) is filled with
+        the inputs actually read — exemplar ids, dominant phases, role
+        pressure — verbatim for the decision ledger."""
         from ..obs import request_trace as rtrace
 
         stats = obs.serve_stats.STATS
 
-        def dom(sketch):
+        def dom(sketch, label):
             ex = sketch.exemplar(0.99)
+            if detail is not None:
+                detail[f"{label}_exemplar"] = ex
             if ex is None:
                 return None
             tr = rtrace.RING.get(ex)
             if tr is None:
                 return None
-            return rtrace.attribute_request(tr).get("dominant_phase")
+            phase = rtrace.attribute_request(tr).get("dominant_phase")
+            if detail is not None:
+                detail[f"{label}_dominant_phase"] = phase
+            return phase
 
-        if self._role_pressured("decode"):
-            d = dom(stats.request_ms)
+        decode_pressured = self._role_pressured("decode")
+        prefill_pressured = self._role_pressured("prefill")
+        if detail is not None:
+            detail["decode_pressured"] = decode_pressured
+            detail["prefill_pressured"] = prefill_pressured
+        if decode_pressured:
+            d = dom(stats.request_ms, "request_ms")
             if d in ("decode", "preempted", "handoff"):
                 return "decode"
             # queue-dominated end-to-end p99 with the decode role
@@ -674,10 +802,11 @@ class FleetRouter:
             # backing up BEHIND the saturated decode tier (prefill
             # slots parked in handoff with nowhere to adopt), so the
             # binding constraint is still decode capacity
-            if d == "queue" and not self._role_pressured("prefill"):
+            if d == "queue" and not prefill_pressured:
                 return "decode"
-        if self._role_pressured("prefill") \
-                and dom(stats.ttft_ms) in ("prefill", "queue"):
+        if prefill_pressured \
+                and dom(stats.ttft_ms, "ttft_ms") in ("prefill",
+                                                      "queue"):
             return "prefill"
         return None
 
@@ -693,7 +822,8 @@ class FleetRouter:
             return
         if self.steps % self.cfg.rebalance_interval_steps != 0:
             return
-        want = self._dominant_role_demand()
+        detail: dict | None = {} if decisions.enabled() else None
+        want = self._dominant_role_demand(detail)
         if want is None:
             # the demand read is SPARSE (the p99 exemplar only moves
             # when a request completes; pressure flickers as pools
@@ -705,8 +835,20 @@ class FleetRouter:
             self._dom_role = want
             self._dom_count = 1
             self._dom_first_step = self.steps
+            if detail is not None:
+                self._decide(
+                    "rebalance_streak",
+                    inputs={"want": want, "streak": 1,
+                            "sustain": self.cfg.rebalance_sustain,
+                            **detail})
             return
         self._dom_count += 1
+        if detail is not None:
+            self._decide(
+                "rebalance_streak",
+                inputs={"want": want, "streak": self._dom_count,
+                        "sustain": self.cfg.rebalance_sustain,
+                        **detail})
         if self._dom_count < self.cfg.rebalance_sustain:
             return
         donor_role = "prefill" if want == "decode" else "decode"
@@ -719,6 +861,14 @@ class FleetRouter:
         donor.recruiting = True
         donor.draining = True
         self._recruit = (donor, want, self._dom_first_step)
+        if detail is not None:
+            self._decide(
+                "recruit", replica=donor.replica_id,
+                inputs={"role": want, "donor_role": donor_role,
+                        "streak": self._dom_count,
+                        "first_seen_step": self._dom_first_step,
+                        "donor_load": self._load(donor.scheduler),
+                        **detail})
         self._dom_role = None
         self._dom_count = 0
         if obs.enabled():
@@ -738,6 +888,13 @@ class FleetRouter:
             "replica": rep.replica_id, "from": from_role, "to": to_role,
             "step": self.steps, "convergence_steps": steps,
         })
+        if decisions.enabled():
+            self._decide(
+                "convert", replica=rep.replica_id,
+                inputs={"from": from_role, "to": to_role,
+                        "convergence_steps": steps})
+        if self.fleet_stats is not None:
+            self.fleet_stats.set_role(rep.replica_id, to_role)
         if obs.enabled():
             obs.counter("fleet_rebalances").inc()
             obs.serve_stats.STATS.set_gauge(
@@ -846,6 +1003,12 @@ class FleetRouter:
         return None
 
     def _colocate(self, rep: Replica, i: int, req: Request) -> None:
+        if decisions.enabled():
+            self._decide(
+                "colocate", replica=rep.replica_id,
+                request_id=req.req_id,
+                inputs={"occupancy": rep.scheduler.pool.occupancy(),
+                        "queue_depth": rep.scheduler.queue.depth})
         rep.scheduler.colocate(i)
         self.colocated += 1
         sess = self._session_of.get(req.req_id)
@@ -878,6 +1041,13 @@ class FleetRouter:
         if req.trace is not None:
             req.trace.annotate("reprefill", tier=target.replica_id,
                                reason=reason)
+        if decisions.enabled():
+            self._decide(
+                "reprefill", replica=target.replica_id,
+                request_id=req.req_id,
+                inputs={"from": rep.replica_id, "reason": reason,
+                        "pages": payload.n_pages,
+                        "stamp_carry": req.kv_stamps is not None})
         if obs.enabled():
             obs.counter("handoff_reprefills").inc()
         if target.scheduler.submit(req):
@@ -933,6 +1103,13 @@ class FleetRouter:
             snap["status"] = "unavailable"
         elif snap["status"] == "ok" and saturated:
             snap["status"] = "saturated"
+        if self.fleet_stats is not None:
+            frag = self.fleet_stats.health_fragment()
+            if frag is not None:
+                # a WARNING, never a status flip: fleet-scope drift is
+                # an operator signal, not an outage (the PR-15 rule —
+                # drift never 503s)
+                snap["fleet_obs"] = frag
         return snap
 
     def snapshot(self) -> dict:

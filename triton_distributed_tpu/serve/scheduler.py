@@ -170,6 +170,12 @@ class Scheduler:
         # router renames its tiers "prefill"/"decode" so cross-tier
         # span chains name where each hop ran
         self.trace_tier = "serve"
+        # telemetry sink: defaults to the process-global sketches; the
+        # fleet observability plane (obs.fleet_stats, TDT_FLEET_OBS=1)
+        # swaps in a per-replica ``ReplicaStats`` that TEES every
+        # observation into the global union, so per-replica drill-down
+        # costs nothing when federation is off
+        self.stats = obs.serve_stats.STATS
 
     # -- submission --------------------------------------------------------
 
@@ -432,7 +438,7 @@ class Scheduler:
                         req.trace.mark_first_token()
                     ttft = req.ttft_ms()
                     if obs.enabled() and ttft is not None:
-                        obs.serve_stats.STATS.observe_ttft(
+                        self.stats.observe_ttft(
                             ttft,
                             exemplar=None if req.trace is None
                             else req.trace.trace_id)
@@ -538,7 +544,7 @@ class Scheduler:
             if len(req.tokens) >= req.max_new_tokens:
                 self._finish_slot(i)
         if obs.enabled():
-            obs.serve_stats.STATS.tokens.add(float(len(active) * window))
+            self.stats.tokens.add(float(len(active) * window))
             obs.counter("serve_decode_steps").inc(window)
             obs.counter("serve_decode_windows").inc()
         self.decode_windows += 1
@@ -912,7 +918,7 @@ class Scheduler:
         if obs.enabled():
             e2e_ms = (req.finished_s - (req.submitted_s or req.finished_s)) \
                 * 1e3
-            obs.serve_stats.STATS.request_completed(
+            self.stats.request_completed(
                 e2e_ms, tokens=len(req.tokens),
                 exemplar=None if req.trace is None else req.trace.trace_id)
             obs.counter("serve_completed").inc()
@@ -926,7 +932,7 @@ class Scheduler:
         self.failed.append(req)
         obs.request_trace.finish(req)
         if obs.enabled():
-            obs.serve_stats.STATS.request_failed()
+            self.stats.request_failed()
             obs.counter("serve_failed").inc()
 
     def _preempt_slot(self, i: int) -> None:
@@ -955,7 +961,7 @@ class Scheduler:
             slot.request.kv_stamps = carry or None
         self.queue.requeue_preempted(slot.request)
         if obs.enabled():
-            obs.serve_stats.STATS.request_preempted(pages=npages)
+            self.stats.request_preempted(pages=npages)
             obs.counter("serve_preemptions").inc()
             obs.counter("serve_evicted_pages").inc(npages)
 
@@ -963,7 +969,7 @@ class Scheduler:
         self.shed.append(req)
         obs.request_trace.finish(req)
         if obs.enabled():
-            obs.serve_stats.STATS.request_shed()
+            self.stats.request_shed()
             obs.counter("serve_shed").inc()
 
     # -- device-state reconciliation ---------------------------------------

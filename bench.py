@@ -1438,6 +1438,106 @@ def bench_profile_overhead_disagg():
                                     run_once)
 
 
+def _diff_overhead_record(metric: str, run_once, *,
+                          rounds: int = 3) -> dict:
+    """Regression-forensics tax on the ARMED profiler (ISSUE 20
+    satellite): both arms run with the continuous profiler recording;
+    the "diffed" arm additionally computes the window-vs-baseline
+    causal decomposition (``obs.diff.diff_windows`` + the
+    band-representative baseline pick) on EVERY rotation — the worst
+    case, since production only diffs on a band breach.  Healthy
+    windows must stay retained as future baselines, so the harness
+    detector attributes without raising events.  Interleaved,
+    min-of-rounds — the ``_trace_overhead_record`` discipline."""
+    import time as _time
+
+    from triton_distributed_tpu import obs
+    from triton_distributed_tpu.obs import anomaly, continuous, flight
+    from triton_distributed_tpu.obs import diff as diff_mod
+
+    class _AlwaysDiff(anomaly.AnomalyDetector):
+        """Event-free harness detector: full attribution per window,
+        no breach (the window stays a future baseline candidate)."""
+
+        def __init__(self):
+            super().__init__(bands={}, record=False)
+            self.diffs = 0
+
+        def check_window(self, window, baseline=None):
+            if baseline is not None:
+                diff_mod.diff_windows(baseline, window)
+                self.diffs += 1
+            return []
+
+    prev_obs = obs.enabled()
+    obs.enable(True)
+    prev_flight = flight.enabled()
+    prev_prof = continuous.enabled()
+    walls = {False: [], True: []}
+    diffs = 0
+    windows = 0
+    try:
+        run_once()                      # compile warmup, untimed
+        for _ in range(rounds):
+            for diffed in (False, True):
+                flight.enable(True)
+                continuous.enable(True)
+                flight.clear()
+                continuous.install(continuous.ContinuousProfiler(
+                    window_steps=32, out_dir=""))
+                det = _AlwaysDiff() if diffed \
+                    else anomaly.AnomalyDetector(bands={}, record=False)
+                anomaly.set_detector(det)
+                t0 = _time.perf_counter()
+                run_once()
+                walls[diffed].append(_time.perf_counter() - t0)
+                windows = continuous.profiler().snapshot()[
+                    "windows_total"]
+                if diffed:
+                    diffs += det.diffs
+    finally:
+        anomaly.set_detector(None)
+        continuous.reset()
+        flight.clear()
+        continuous.enable(prev_prof)
+        flight.enable(prev_flight)
+        obs.enable(prev_obs)
+    t_off, t_on = min(walls[False]), min(walls[True])
+    return {
+        "metric": metric,
+        "value": round(100.0 * (t_on - t_off) / max(t_off, 1e-9), 2),
+        "unit": "% over undiffed profiling",
+        "undiffed_s": round(t_off, 4),
+        "diffed_s": round(t_on, 4),
+        "windows_rotated": windows,
+        "diffs_computed": diffs,
+        "interpret": True,   # SimBackend replay on this box
+        "devices": jax.device_count(),
+    }
+
+
+def bench_diff_overhead():
+    """Per-rotation differential-attribution tax on the single-tier
+    scheduler replay (`bench.py serve`): the same seeded 48-request
+    overcommit mix replayed with the profiler armed, undiffed vs
+    diffing every window against its healthy baseline."""
+    from triton_distributed_tpu import serve
+
+    vocab = 512
+
+    def run_once():
+        backend = serve.SimBackend(slots=8, page_size=16, pool_pages=65,
+                                   max_length=256, vocab=vocab)
+        sched = serve.Scheduler(backend, serve.SchedulerConfig(
+            max_queue_depth=128, prefill_chunk_tokens=32))
+        arrivals = serve.synthetic_trace(
+            7, 48, mean_interarrival_steps=0.25,
+            prompt_len=(8, 48), max_new=(8, 48), vocab=vocab)
+        serve.replay(sched, arrivals, max_steps=100_000)
+
+    return _diff_overhead_record("diff_overhead_pct", run_once)
+
+
 _DISAGG_RUN = None
 
 
@@ -2277,6 +2377,7 @@ def main():
         print(json.dumps(bench_serve_kv_quant()))
         print(json.dumps(bench_trace_overhead()))
         print(json.dumps(bench_profile_overhead()))
+        print(json.dumps(bench_diff_overhead()))
     elif mode == "serve_disagg":
         # the disaggregated prefill/decode topology (ISSUE 12): TTFT
         # plus the KV-handoff plane's latency/throughput/retry surface,
@@ -2345,6 +2446,7 @@ def main():
         _emit(bench_trace_overhead_disagg)
         _emit(bench_profile_overhead)
         _emit(bench_profile_overhead_disagg)
+        _emit(bench_diff_overhead)
         _emit(bench_wire_bytes)
         _emit(bench_wire_parity)
         _emit(bench_hier_ar_dcn_bytes)

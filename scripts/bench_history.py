@@ -12,6 +12,13 @@ and WARN annotations for
   above its claims-registry floor (a dip whose symmetric ``retry_value``
   is back inside the band reports as transient).
 
+Every WARN line carries its round-over-round attribution (ISSUE 20,
+``obs.diff.rounds_attribution`` via ``history.analyze``): the
+co-regressed metrics between the same two rounds, ranked by
+worse-direction drift — so the table answers "what ELSE moved when this
+regressed" without a separate forensics pass.  ``obs_report.py --diff
+rA rB`` is the full two-round decomposition.
+
 Usage:
     python scripts/bench_history.py [root]            # trajectory table
     python scripts/bench_history.py --markdown        # docs-pasteable

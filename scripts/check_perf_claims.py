@@ -361,6 +361,18 @@ CLAIMS = {
     "fleet_obs_overhead_pct": {
         "warn_max": 2.0, "value_max": 100.0, "since": 19,
     },
+    # -- regression forensics (ISSUE 20; `bench.py serve`) --
+    # Differential-attribution tax on the ARMED profiler: the same
+    # seeded replay with the diff computed on EVERY window rotation vs
+    # none (production only diffs on a band breach, so this is the
+    # worst case).  warn_max 2.0 is the issue's acceptance ceiling —
+    # forensics you cannot afford at detection time arrive too late;
+    # value_max is the gross tripwire.  Interpret-marked on this box's
+    # SimBackend replays; binds on real captures, and the trend
+    # sentinel ("overhead" -> lower-is-better) guards growth everywhere
+    "diff_overhead_pct": {
+        "warn_max": 2.0, "value_max": 100.0, "since": 20,
+    },
 }
 
 def parse_record(path: str) -> tuple[list[dict], int | None, bool]:

@@ -16,6 +16,10 @@ Usage:
     python scripts/obs_report.py --timeline ag_gemm --ranks 4
     python scripts/obs_report.py --timeline flight_streams.json --chrome t.json
     python scripts/obs_report.py --live http://127.0.0.1:9100
+    python scripts/obs_report.py --live --json -          # machine-clean JSON
+    python scripts/obs_report.py --diff profile_dir/ live_dir/
+    python scripts/obs_report.py --diff r18 r19
+    python scripts/obs_report.py --request p99 --trace-file traces.json
 
 Multiple inputs are merged with ``tools.trace_merge`` (rank i = argv
 order), so per-rank lanes stay disjoint; a single input may already be a
@@ -30,7 +34,23 @@ attribution footer.  Traces resolve against ``--trace-file`` (a JSON
 dump from ``obs.request_trace.export_traces`` or a saved
 ``/debug/trace/<id>`` payload); without a file the in-process ring is
 consulted (useful from a REPL or test).  ``--request list`` prints the
-available ids.
+available ids.  ``--request p99`` (or ``p50``) is the cohort view
+(ISSUE 20): it selects the p99-exemplar cohort, diffs it against the
+p50 cohort span-by-span (``obs.diff.diff_cohorts``) so the answer to
+"what do the slow requests spend their extra time on" is one ranked
+phase decomposition, then prints the slowest exemplar's waterfall.
+
+``--diff A B`` is the regression-forensics leg (ISSUE 20,
+docs/observability.md "Regression forensics"): given any two
+comparable captures it prints the ranked causal decomposition of the
+delta via ``obs.diff``.  Each operand is sniffed by shape — ``r<N>``
+names a committed bench round (``obs.history.load_rounds``), a
+directory or ``profile_*.jsonl`` segment is a continuous-profiler
+time-series (the LAST rotated window is the capture), and a JSON file
+is either a saved window / ``/debug/profile`` snapshot or a trace dump
+(``export_traces`` → the whole file is the cohort).  Both operands
+must resolve to the same capture kind.  ``--json`` dumps the raw
+attribution dict for machine consumers.
 
 ``--timeline`` is the flight-recorder view (docs/observability.md
 "Flight recorder"): given a kernel family name it records every rank of
@@ -57,7 +77,11 @@ URL it fetches ``/debug/profile`` and renders the per-(family x
 topology x tier) rollup table with the window/anomaly state; with no
 operand it snapshots the IN-PROCESS profiler (a REPL or harness that
 armed ``TDT_PROFILE=1`` locally).  Exit code 1 when the latest window
-carries anomalies, so a cron probe can page on it.
+carries anomalies, so a cron probe can page on it.  With ``--json``
+stdout is machine-clean — the human table and diagnostics move to
+stderr, and ``--json -`` writes the JSON payload to stdout (the
+``bench_history --json`` discipline), so
+``obs_report.py --live URL --json - | jq .`` just works.
 """
 
 from __future__ import annotations
@@ -101,7 +125,14 @@ def main(argv: list[str] | None = None) -> int:
                          "arrows")
     ap.add_argument("--request", metavar="TRACE_ID",
                     help="per-request waterfall for one trace id "
-                         "('list' prints the available ids)")
+                         "('list' prints the available ids; 'p99'/'p50' "
+                         "prints the quantile cohort diffed against the "
+                         "p50 cohort)")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                    help="regression forensics: ranked causal "
+                         "decomposition of the delta between two "
+                         "comparable captures (r<N> round ids, profiler "
+                         "window files/dirs, or trace dumps)")
     ap.add_argument("--trace-file", metavar="PATH",
                     help="with --request: resolve trace ids from this "
                          "JSON dump (obs.request_trace.export_traces / "
@@ -121,6 +152,8 @@ def main(argv: list[str] | None = None) -> int:
 
     from triton_distributed_tpu.obs import report
 
+    if args.diff:
+        return _run_diff(args)
     if args.fleet:
         return _run_fleet_view(args)
     if args.live:
@@ -160,8 +193,17 @@ def main(argv: list[str] | None = None) -> int:
 def _run_live(args) -> int:
     """The ``--live`` leg: one continuous-profiler snapshot (remote
     ``/debug/profile`` or the in-process profiler), rendered as the
-    rollup table.  Exit 1 when the latest window carries anomalies."""
+    rollup table.  Exit 1 when the latest window carries anomalies.
+
+    With ``--json``, stdout is machine-clean: the human table and
+    diagnostics go to stderr and ``--json -`` writes the payload to
+    stdout (the ``bench_history --json`` discipline), so piping into
+    ``jq`` never sees a table row."""
     from triton_distributed_tpu.obs import continuous
+
+    # Human output: stdout normally, stderr under --json so a pipe
+    # consumer gets ONLY the JSON document.
+    human = sys.stderr if args.json else sys.stdout
 
     if args.live == "local":
         prof = continuous.profiler() if continuous.enabled() else None
@@ -175,14 +217,22 @@ def _run_live(args) -> int:
         with urllib.request.urlopen(url, timeout=10) as r:
             snap = json.load(r)
         where = url
-    sys.stdout.write(continuous.format_snapshot(snap))
+    human.write(continuous.format_snapshot(snap))
     if not snap.get("enabled"):
         print(f"profiler not armed at {where} "
-              f"(set TDT_PROFILE=1; docs/observability.md)")
-        return 0
+              f"(set TDT_PROFILE=1; docs/observability.md)", file=human)
+        if not args.json:
+            return 0
     if args.json:
-        with open(args.json, "w") as f:
-            json.dump(snap, f, indent=1, sort_keys=True, default=str)
+        if args.json == "-":
+            json.dump(snap, sys.stdout, indent=1, sort_keys=True,
+                      default=str)
+            sys.stdout.write("\n")
+        else:
+            with open(args.json, "w") as f:
+                json.dump(snap, f, indent=1, sort_keys=True, default=str)
+        if not snap.get("enabled"):
+            return 0
     last = snap.get("last_window") or {}
     return 1 if last.get("anomalies") else 0
 
@@ -259,6 +309,87 @@ def _run_fleet_view(args) -> int:
     return 1 if anomalies else 0
 
 
+def _load_capture(spec: str):
+    """Sniff one ``--diff`` operand into ``(kind, label, payload)``.
+
+    ``kind`` is the pairing axis (``round`` / ``window`` / ``cohort``)
+    — both operands must land on the same one.  ``r<N>`` (or a bare
+    integer) is a committed bench round; a directory or a
+    ``profile_*.jsonl`` segment is a continuous-profiler time-series
+    whose LAST rotated window is the capture; a JSON file is a saved
+    window dict, a ``/debug/profile`` snapshot (its ``last_window``),
+    or a trace dump (the whole file becomes the cohort)."""
+    import re
+
+    from triton_distributed_tpu.obs import history, request_trace
+
+    m = re.fullmatch(r"r?(\d+)", spec)
+    if m and not os.path.exists(spec):
+        want = int(m.group(1))
+        rounds = {r.round: r for r in history.load_rounds(".")}
+        if want not in rounds:
+            raise SystemExit(
+                f"--diff: round {spec!r} not committed "
+                f"(have {sorted(rounds)})")
+        return "round", f"r{want}", rounds[want]
+    if os.path.isdir(spec):
+        windows = history.load_profile_windows(spec)
+        if not windows:
+            raise SystemExit(f"--diff: no profile_*.jsonl windows "
+                             f"under {spec!r}")
+        return "window", f"{spec} (window {len(windows)})", windows[-1]
+    if not os.path.exists(spec):
+        raise SystemExit(f"--diff: {spec!r} is neither a committed "
+                         f"round id nor a file")
+    if spec.endswith(".jsonl"):
+        windows = [json.loads(ln) for ln in open(spec)
+                   if ln.strip()]
+        if not windows:
+            raise SystemExit(f"--diff: {spec!r} holds no windows")
+        return "window", f"{spec} (window {len(windows)})", windows[-1]
+    with open(spec) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "last_window" in doc:   # /debug/profile
+        if not doc["last_window"]:
+            raise SystemExit(f"--diff: snapshot {spec!r} has no "
+                             f"rotated window yet")
+        return "window", f"{spec} (last_window)", doc["last_window"]
+    if isinstance(doc, dict) and "rollups" in doc:       # one window
+        return "window", spec, doc
+    traces = request_trace.load_traces(spec)             # trace dump
+    if not traces:
+        raise SystemExit(f"--diff: {spec!r} is not a recognised "
+                         f"capture (no rounds/windows/traces)")
+    return "cohort", f"{spec} ({len(traces)} traces)", traces
+
+
+def _run_diff(args) -> int:
+    """The ``--diff A B`` leg (ISSUE 20): resolve both operands to the
+    same capture kind and print the ranked causal decomposition of the
+    delta (``obs.diff``).  A is the reference, B the suspect — positive
+    deltas are regressions in B."""
+    from triton_distributed_tpu.obs import diff
+
+    kind_a, label_a, a = _load_capture(args.diff[0])
+    kind_b, label_b, b = _load_capture(args.diff[1])
+    if kind_a != kind_b:
+        print(f"--diff: captures are not comparable — "
+              f"{args.diff[0]!r} is a {kind_a}, "
+              f"{args.diff[1]!r} is a {kind_b}")
+        return 2
+    if kind_a == "round":
+        d = diff.diff_rounds(a, b)
+    elif kind_a == "window":
+        d = diff.diff_windows(a, b)
+    else:
+        d = diff.diff_cohorts(a, b, label_a=label_a, label_b=label_b)
+    sys.stdout.write(diff.format_diff(d))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(d, f, indent=1, sort_keys=True, default=str)
+    return 0
+
+
 def _run_request(args) -> int:
     """The ``--request`` leg: resolve one trace (file dump or the
     in-process ring) and print its waterfall + attribution."""
@@ -278,6 +409,8 @@ def _run_request(args) -> int:
             print(tid)
         print(f"{len(traces)} trace(s) in {where}")
         return 0
+    if args.request in ("p50", "p99"):
+        return _run_request_cohort(args, list(traces.values()), where)
     tr = traces.get(args.request)
     if tr is None:
         print(f"trace {args.request!r} not found in {where} "
@@ -287,6 +420,38 @@ def _run_request(args) -> int:
     if args.json:
         with open(args.json, "w") as f:
             json.dump(tr.to_dict(), f, indent=1, sort_keys=True)
+    return 0
+
+
+def _run_request_cohort(args, traces, where: str) -> int:
+    """``--request p99`` / ``--request p50``: the quantile-cohort view
+    (ISSUE 20).  Select the requested quantile's cohort, diff it against
+    the p50 cohort span-by-span so the extra time the slow requests
+    spend is a RANKED per-phase decomposition (``obs.diff``), then print
+    the slowest exemplar's waterfall for drill-down."""
+    from triton_distributed_tpu.obs import diff, request_trace
+
+    q = 0.99 if args.request == "p99" else 0.5
+    # p99 exemplars are by definition few — a narrow width keeps the
+    # cohort the actual tail rather than the upper half.
+    cohort = request_trace.select_cohort(
+        traces, q, width=0.02 if q >= 0.9 else 0.2)
+    if not cohort:
+        print(f"no closed traces in {where} "
+              f"(arm TDT_TRACE=1; docs/observability.md)")
+        return 1
+    base = request_trace.select_cohort(traces, 0.5)
+    d = diff.diff_cohorts(base, cohort,
+                          label_a=f"p50 cohort (n={len(base)})",
+                          label_b=f"{args.request} cohort "
+                                  f"(n={len(cohort)})")
+    sys.stdout.write(diff.format_diff(d))
+    exemplar = max(cohort, key=lambda t: t.total_ms)
+    print(f"\nslowest exemplar {exemplar.trace_id}:")
+    sys.stdout.write(request_trace.format_waterfall(exemplar))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(d, f, indent=1, sort_keys=True, default=str)
     return 0
 
 

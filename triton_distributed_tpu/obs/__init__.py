@@ -38,9 +38,14 @@ This package is that layer:
   band breaches that carry the ledger decisions from their window.
 - ``obs.server``    the ``TDT_OBS_HTTP`` endpoint: ``/metrics``,
   ``/healthz``, ``/debug/flight``, ``/debug/timeline``,
-  ``/debug/profile``, ``/debug/fleet``.
+  ``/debug/profile``, ``/debug/diff``, ``/debug/fleet``.
 - ``obs.history``   the perf-trajectory sentinel over the committed
   ``BENCH_r*`` rounds (``scripts/bench_history.py``).
+- ``obs.diff``      regression forensics: differential root-cause
+  attribution between any two comparable captures (profiler windows,
+  bench rounds, trace cohorts, fleet replicas) — ranked causal
+  decomposition with an exactness contract, wired into every
+  detection site (``docs/observability.md``).
 
 Everything is OFF by default and gated by ``TDT_OBS=1`` (or
 :func:`enable`); a disabled call site costs one cached-bool check, so the
@@ -55,9 +60,9 @@ import contextlib
 import threading
 
 from . import (
-    anomaly, continuous, costs, decisions, export, flight, fleet_stats,
-    history, registry, report, request_trace, serve_stats, timeline,
-    tracing,
+    anomaly, continuous, costs, decisions, diff, export, flight,
+    fleet_stats, history, registry, report, request_trace, serve_stats,
+    timeline, tracing,
 )
 
 

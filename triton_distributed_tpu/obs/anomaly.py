@@ -73,6 +73,10 @@ class AnomalyEvent:
     stall: tuple | None        # dominant (sem, chunk, peer, exposed_us)
     exemplar: str | None       # p99 exemplar trace id, if traced
     excerpt: tuple[str, ...]   # flight-ring tail at detection time
+    # window-vs-baseline attribution (obs.diff.diff_windows against
+    # the profiler's band-representative healthy window) — the
+    # "why", when a baseline was available at detection time
+    diff: dict | None = None
 
     def summary(self) -> str:
         s = (f"{self.metric}={self.value:g} outside healthy band "
@@ -85,6 +89,8 @@ class AnomalyEvent:
                   f"peer={peer}")
         if self.exemplar:
             s += f"; p99 exemplar {self.exemplar}"
+        if self.diff and self.diff.get("terms"):
+            s += f"; diff: {self.diff['summary']}"
         return s
 
     def to_dict(self) -> dict:
@@ -103,7 +109,11 @@ class AnomalyDetector:
         self.bands = dict(bands)
         self.record = record
 
-    def check_window(self, window: dict) -> list[AnomalyEvent]:
+    def check_window(self, window: dict,
+                     baseline: dict | None = None) -> list[AnomalyEvent]:
+        """``baseline`` is the band-representative healthy window the
+        profiler retains (``obs.diff.baseline_window``): when present,
+        every breach carries its window-vs-baseline attribution."""
         from . import flight, serve_stats
 
         totals = window.get("totals") or {}
@@ -122,6 +132,16 @@ class AnomalyDetector:
                 exemplar = sk.exemplar(0.99)
                 if exemplar:
                     break
+            attribution = None
+            if baseline is not None:
+                try:
+                    from . import diff as diff_mod
+
+                    attribution = diff_mod.diff_windows(
+                        baseline, window, metric=metric,
+                        exemplar=exemplar)
+                except Exception:
+                    attribution = None
             out.append(AnomalyEvent(
                 metric=metric, value=float(value),
                 band=(band.lo, band.hi), direction=band.direction,
@@ -130,6 +150,7 @@ class AnomalyDetector:
                 stall=totals.get("dominant_stall"),
                 exemplar=exemplar,
                 excerpt=flight.recent_lines(16),
+                diff=attribution,
             ))
         if self.record:
             _publish(window, out)
@@ -148,14 +169,16 @@ def _publish(window: dict, events: list[AnomalyEvent]) -> None:
             _TOTAL += 1
 
 
-def check_window(window: dict) -> list[AnomalyEvent]:
+def check_window(window: dict,
+                 baseline: dict | None = None) -> list[AnomalyEvent]:
     """The profiler's rotation hook: run the process detector (built
-    lazily from the committed rounds) over a finished window."""
+    lazily from the committed rounds) over a finished window, diffing
+    breaches against ``baseline`` when the profiler retained one."""
     det = _detector()
     if det is None:
         _publish(window, [])
         return []
-    return det.check_window(window)
+    return det.check_window(window, baseline)
 
 
 def _detector() -> AnomalyDetector | None:
@@ -210,6 +233,18 @@ def recent(n: int = 8) -> list[AnomalyEvent]:
     """The newest retained breaches across windows."""
     with _LOCK:
         return list(_EVENTS)[-int(n):]
+
+
+def latest_attributed() -> AnomalyEvent | None:
+    """The newest retained breach that carries a window-vs-baseline
+    attribution — what ``/debug/diff`` serves.  Events are frozen and
+    their ``diff`` dicts are built once at detection time, so the
+    returned payload is scrape-safe during window rotation."""
+    with _LOCK:
+        for e in reversed(_EVENTS):
+            if e.diff:
+                return e
+    return None
 
 
 def total() -> int:

@@ -53,6 +53,18 @@ import threading
 from . import serve_stats
 
 DEFAULT_WINDOW_STEPS = 32
+# rotated windows retained in memory as baseline candidates for the
+# window-vs-baseline attribution (obs.diff.baseline_window picks the
+# band-representative healthy one)
+RECENT_WINDOWS = 8
+# calibration-drift sentinel (ISSUE 20 satellite): achieved wire GB/s
+# per wire class (rollup wire_bytes / wire time) vs the persisted
+# LinkCalibration rate SOL attribution assumes.  Divergence past
+# LINKCAL_DRIFT_PCT for LINKCAL_SUSTAIN consecutive windows marks the
+# wire class stale — a /healthz WARNING (never a 503), because a rotten
+# rate silently corrupts every pct_sol number downstream.
+LINKCAL_DRIFT_PCT = 0.20
+LINKCAL_SUSTAIN = 3
 # on-disk time-series bounds: segments rotate at this size, oldest
 # segments beyond the cap are deleted — the series is downsampled (one
 # line per window) AND bounded (docs/observability.md)
@@ -296,6 +308,14 @@ class ContinuousProfiler:
         self.overlap_sketch = serve_stats.QuantileSketch()
         self._segment_idx = 0
         self._segment_path: str | None = None
+        # baseline candidates for the window-vs-baseline diff (the
+        # published dicts are immutable, so retaining references is
+        # scrape-safe) and the calibration-drift streaks per wire class
+        from collections import deque
+
+        self._recent_windows: deque = deque(maxlen=RECENT_WINDOWS)
+        self._linkcal_streak: dict[str, int] = {}
+        self._linkcal_stale: dict[str, dict] = {}
 
     # -- drain -------------------------------------------------------------
 
@@ -424,13 +444,20 @@ class ContinuousProfiler:
         stats.set_gauge("profile_exposed_ms", tot["exposed_ms"])
         stats.set_gauge("profile_windows", float(self.windows_total + 1))
         self._persist(window)
-        # live-vs-baseline comparison (obs.anomaly): breaches carry the
-        # dominant stall triple + p99 exemplar + ring excerpt, surface
-        # in health() and nudge the AdmissionGovernor (advisory)
         try:
-            from . import anomaly
+            self._check_calibration(rollups)
+        except Exception:
+            pass
+        # live-vs-baseline comparison (obs.anomaly): breaches carry the
+        # dominant stall triple + p99 exemplar + ring excerpt, AND the
+        # window-vs-baseline attribution (obs.diff) against the
+        # band-representative healthy window retained below; they
+        # surface in health() and nudge the AdmissionGovernor (advisory)
+        try:
+            from . import anomaly, diff
 
-            events = anomaly.check_window(window)
+            baseline = diff.baseline_window(list(self._recent_windows))
+            events = anomaly.check_window(window, baseline)
         except Exception:
             events = []
         if events:
@@ -445,10 +472,63 @@ class ContinuousProfiler:
         # never mutated after — a concurrent scrape sees old or new,
         # never a torn mix
         self._last_window = window
+        self._recent_windows.append(window)
         self.windows_total += 1
         self._window_id += 1
         self._steps_in_window = 0
         self._accum = {}
+
+    # -- calibration-drift sentinel ---------------------------------------
+
+    def _check_calibration(self, rollups) -> None:
+        """Live achieved wire GB/s per wire class (rollup wire bytes /
+        wire time; the handoff tier is the DCN class, everything else
+        ICI) vs the persisted ``LinkCalibration`` rate —
+        ``tools.calibrate.wire_gbps``, the SAME number the SOL /
+        ``pct_sol`` attribution divides by.  Sustained divergence
+        (> ``LINKCAL_DRIFT_PCT`` for ``LINKCAL_SUSTAIN`` consecutive
+        windows) marks the class stale so attributions can't silently
+        rot when topology changes.  A class with no wire signal this
+        window (no bytes or no wire time) gets no verdict — the streak
+        holds."""
+        sums: dict[str, list[float]] = {}
+        for r in rollups:
+            cls = "dcn" if r.tier == "handoff" else "ici"
+            cur = sums.setdefault(cls, [0.0, 0.0])
+            cur[0] += float(r.wire_bytes)
+            cur[1] += float(r.wire_us)
+        for cls, (nbytes, us) in sums.items():
+            if nbytes <= 0 or us <= 0:
+                continue
+            try:
+                from ..tools import calibrate
+
+                expected = float(calibrate.wire_gbps(cls))
+            except Exception:
+                continue
+            if expected <= 0:
+                continue
+            achieved = nbytes / (us * 1e3)     # bytes/us -> GB/s
+            divergence = abs(achieved - expected) / expected
+            if divergence > LINKCAL_DRIFT_PCT:
+                n = self._linkcal_streak.get(cls, 0) + 1
+                self._linkcal_streak[cls] = n
+                if n >= LINKCAL_SUSTAIN:
+                    self._linkcal_stale[cls] = {
+                        "wire_class": cls,
+                        "achieved_gbps": round(achieved, 3),
+                        "calibrated_gbps": round(expected, 3),
+                        "divergence_pct": round(100 * divergence, 1),
+                        "windows": n,
+                    }
+            else:
+                self._linkcal_streak[cls] = 0
+                self._linkcal_stale.pop(cls, None)
+
+    def calibration_drift(self) -> dict[str, dict]:
+        """Per-class stale-calibration verdicts (empty = healthy)."""
+        with self._lock:
+            return dict(self._linkcal_stale)
 
     # -- persistence -------------------------------------------------------
 
@@ -579,6 +659,28 @@ def on_step(tier: str, step: int, governor=None) -> None:
 def reset() -> None:
     """Drop the process profiler (tests / lint harness hygiene)."""
     install(None)
+
+
+def calibration_fragment() -> dict | None:
+    """What ``resilience.health_snapshot`` attaches under ``linkcal``
+    when a wire class's live achieved rate has diverged from the
+    persisted calibration for ``LINKCAL_SUSTAIN`` consecutive windows:
+    a WARNING naming the stale wire class — never a status flip
+    (drift must not 503 a serving replica; the PR-15 rule), and None
+    when healthy so an unarmed snapshot is byte-identical."""
+    prof = _PROFILER
+    if prof is None:
+        return None
+    stale = prof.calibration_drift()
+    if not stale:
+        return None
+    return {
+        "status": "warn",
+        "stale_wire_classes": sorted(stale),
+        "detail": stale,
+        "hint": "re-run tools/calibrate.py — SOL/pct_sol attributions "
+                "assume the persisted rates",
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,665 @@
+"""Differential root-cause attribution between two comparable captures.
+
+Six detection layers now end at "metric X fell out of band" — the
+trend sentinel (``obs.history``), the live window comparator
+(``obs.anomaly``), the fleet bands (``obs.fleet_stats``) — and every
+one of them leaves the operator to hand-correlate timelines, traces,
+and ledgers to learn WHY.  This module closes the loop from *detected*
+to *explained*: give it any two comparable captures and it returns a
+RANKED causal decomposition of the delta.
+
+Four pairings, one engine:
+
+=========  ==========================================================
+pairing    captures
+=========  ==========================================================
+windows    two rotated profiler windows (:func:`diff_windows` — the
+           live breach vs the band-representative healthy window the
+           profiler retains; see :func:`baseline_window`)
+rounds     two committed bench rounds (:func:`diff_rounds`, via the
+           ``obs.history`` local streams)
+cohorts    two trace cohorts (:func:`diff_cohorts` — e.g. the p99
+           exemplar vs a p50 cohort, span-aligned waterfall diff)
+replicas   two fleet replicas (:func:`diff_replicas`, over
+           ``fleet_stats.ReplicaStats`` sketches)
+=========  ==========================================================
+
+Each ranked term names the phase (the PR-13 attributor vocabulary:
+queue / prefill / handoff / decode / preempted), the (collective
+family x topology x tier) rollup, the dominant (semaphore, chunk,
+peer) stall triple, the exposed-vs-overlapped split of the delta, and
+a resolving exemplar trace id — whichever of those the pairing's
+captures carry.
+
+Exactness contract (the PR-13 ``gap_ms`` discipline): for the additive
+pairings (windows, cohorts) the ranked term deltas plus the reported
+``residual`` sum to ``total_delta`` EXACTLY — ``residual`` is defined
+as the closing difference, and ``exact`` asserts it stays within the
+float-rounding budget of the captures' own rounded fields
+(:data:`EXACT_TOL_PER_TERM` per contributing key).  The metric-set
+pairings (rounds, replicas) have no cross-metric additive total —
+each term IS one metric's own delta, ``total_delta`` is ``None``, and
+the contract binds per term trivially.
+
+Every number is read from the existing machinery: the window terms are
+the credit-replay sums ``Rollup`` already accumulated
+(``obs.timeline`` -> ``obs.continuous``), the cohort terms are
+``request_trace.attribute_request`` phase budgets, bands come from
+``history.healthy_band``.  Nothing is re-derived here — this module
+subtracts and ranks, it never re-implements an attribution.
+
+``tdt_lint --regress`` runs :func:`selftest` both directions: an
+identical-capture diff must rank nothing, and a wire-inflated replay
+must attribute the delta to the injected family/phase/stall with a
+resolving exemplar and an exact residual.
+"""
+
+from __future__ import annotations
+
+from . import history
+
+# a delta this small is "no change": it never ranks (the
+# identical-capture direction of the selftest depends on this)
+ZERO_TOL = 1e-9
+
+# per-contributing-key rounding budget for the residual: window
+# captures round ``*_us`` fields at 3 decimals (``Rollup.to_dict``)
+# and totals at 6 (``ContinuousProfiler._totals``), so each key can
+# contribute up to ~5e-7 ms of closing dust
+EXACT_TOL_PER_TERM = 1e-6
+
+# window-total metrics that ARE additive over rollups — the substrate
+# a window diff decomposes.  A non-additive breach metric (pct_sol,
+# overlap_hidden_pct) is recorded as ``observed`` but decomposed on
+# the exposed_ms substrate: exposed wait is where the delta lives.
+_SUBSTRATES = {
+    "exposed_ms": "exposed_us",
+    "wire_ms": "wire_us",
+    "compute_ms": "compute_us",
+}
+
+# canonical phase order for cohort terms (request_trace.PHASE_OF
+# vocabulary); unknown phases append after, in first-seen order
+_PHASE_ORDER = ("queue", "prefill", "handoff", "decode", "preempted")
+
+# which serving phase each fleet sketch measures (None = whole-request)
+_SKETCH_PHASE = {
+    "prefill_ms": "prefill",
+    "decode_ms_per_token": "decode",
+    "handoff_ms": "handoff",
+}
+
+
+# ---------------------------------------------------------------------------
+# shared term plumbing
+
+
+def _term(**kw) -> dict:
+    out = {
+        "rank": None,
+        "metric": None,
+        "phase": None,
+        "family": None,
+        "topology": None,
+        "tier": None,
+        "delta": 0.0,
+        "unit": "ms",
+        "exposed_delta_ms": None,
+        "overlapped_delta_ms": None,
+        "stall": None,
+        "exemplar": None,
+        "pct_of_total": None,
+        "summary": "",
+    }
+    out.update(kw)
+    return out
+
+
+def _close(terms: list[dict], total_delta: float | None,
+           sort_key=None) -> tuple[list[dict], float, bool]:
+    """Drop no-change terms, rank the rest, and close the additive
+    identity: ``sum(kept deltas) + residual == total_delta`` holds
+    EXACTLY (residual is defined as that difference)."""
+    n_keys = max(1, len(terms))
+    kept = [t for t in terms if abs(t["delta"]) > ZERO_TOL]
+    kept.sort(key=sort_key or (lambda t: abs(t["delta"])), reverse=True)
+    for i, t in enumerate(kept):
+        t["rank"] = i + 1
+        if total_delta is not None and abs(total_delta) > ZERO_TOL:
+            t["pct_of_total"] = round(100.0 * t["delta"] / total_delta, 1)
+    if total_delta is None:
+        return kept, 0.0, True
+    residual = total_delta - sum(t["delta"] for t in kept)
+    return kept, residual, abs(residual) <= EXACT_TOL_PER_TERM * n_keys
+
+
+def _result(kind: str, a, b, *, metric: str, unit: str,
+            total_delta: float | None, terms: list[dict],
+            residual: float, exact: bool, exemplar=None,
+            observed=None) -> dict:
+    out = {
+        "kind": kind,
+        "a": a,
+        "b": b,
+        "metric": metric,
+        "unit": unit,
+        "total_delta": total_delta,
+        "terms": terms,
+        "residual": residual,
+        "exact": exact,
+        "exemplar": exemplar,
+    }
+    if observed is not None:
+        out["observed"] = observed
+    out["summary"] = attribution_summary(out)
+    return out
+
+
+def attribution_summary(d: dict) -> str:
+    """The one-line explanation a WARN line / event summary carries:
+    the total move plus the top-ranked term."""
+    head = d["metric"]
+    if d.get("total_delta") is not None:
+        head += f" {d['total_delta']:+.3f} {d['unit']}".rstrip()
+    terms = d.get("terms") or []
+    if not terms:
+        return f"{head}: no attributable delta"
+    t = terms[0]
+    where = t["metric"] or ""
+    if t["family"]:
+        where = f"{t['family']} x {t['topology']} x {t['tier']}"
+    elif t["phase"]:
+        where = f"phase {t['phase']}"
+    s = f"{head}: #1 {where} ({t['delta']:+.3f} {t['unit']}".rstrip()
+    if t.get("pct_of_total") is not None:
+        s += f", {t['pct_of_total']:g}% of delta"
+    s += ")"
+    if t["stall"]:
+        sem, chunk, peer = t["stall"][:3]
+        s += f"; stall sem={sem} chunk={chunk} peer={peer}"
+    ex = t["exemplar"] or d.get("exemplar")
+    if ex:
+        s += f"; exemplar {ex}"
+    return s
+
+
+# ---------------------------------------------------------------------------
+# pairing 1: two profiler windows
+
+
+def diff_windows(a: dict, b: dict, *, metric: str = "exposed_ms",
+                 exemplar: str | None = None) -> dict:
+    """Ranked (family x topology x tier) decomposition of window ``b``
+    minus window ``a`` (baseline first — positive deltas are growth in
+    the live window).
+
+    ``metric`` names the breached window-total; the decomposition runs
+    on its additive substrate (``exposed_ms`` unless the metric is
+    itself one of ``wire_ms`` / ``compute_ms``).  Every term's numbers
+    are the credit-replay ``Rollup`` sums the windows already carry —
+    this function only subtracts and ranks.  The tier axis IS the
+    serving-phase vocabulary (the scheduler feeds ``on_step`` per
+    tier), so each term's ``phase`` is its rollup tier."""
+    substrate = metric if metric in _SUBSTRATES else "exposed_ms"
+    us_field = _SUBSTRATES[substrate]
+
+    def _key(r):
+        return (r.get("family", "?"), r.get("topology", "?"),
+                r.get("tier", "?"))
+
+    ra = {_key(r): r for r in (a.get("rollups") or [])}
+    rb = {_key(r): r for r in (b.get("rollups") or [])}
+    keys = list(ra) + [k for k in rb if k not in ra]
+    terms = []
+    for key in keys:
+        xa = ra.get(key) or {}
+        xb = rb.get(key) or {}
+        delta = (float(xb.get(us_field, 0.0))
+                 - float(xa.get(us_field, 0.0))) / 1e3
+        exposed_d = (float(xb.get("exposed_us", 0.0))
+                     - float(xa.get("exposed_us", 0.0))) / 1e3
+        hidden_b = float(xb.get("wire_us", 0.0)) \
+            - float(xb.get("exposed_us", 0.0))
+        hidden_a = float(xa.get("wire_us", 0.0)) \
+            - float(xa.get("exposed_us", 0.0))
+        worse = xb if delta >= 0 else xa
+        stall = worse.get("dominant_stall") or \
+            (xb or xa).get("dominant_stall")
+        fam, topo, tier = key
+        terms.append(_term(
+            metric=f"{fam}/{topo}/{tier}", phase=tier, family=fam,
+            topology=topo, tier=tier, delta=delta, unit="ms",
+            exposed_delta_ms=exposed_d,
+            overlapped_delta_ms=(hidden_b - hidden_a) / 1e3,
+            stall=tuple(stall) if stall else None,
+            exemplar=exemplar,
+            summary=(f"{fam} x {topo} x {tier}: {delta:+.3f} ms "
+                     f"({substrate})"),
+        ))
+    ta = a.get("totals") or {}
+    tb = b.get("totals") or {}
+    total_delta = float(tb.get(substrate, 0.0) or 0.0) \
+        - float(ta.get(substrate, 0.0) or 0.0)
+    kept, residual, exact = _close(terms, total_delta)
+    return _result(
+        "windows",
+        {"window": a.get("window"), "step_end": a.get("step_end")},
+        {"window": b.get("window"), "step_end": b.get("step_end")},
+        metric=substrate if metric in _SUBSTRATES else metric,
+        unit="ms", total_delta=total_delta, terms=kept,
+        residual=residual, exact=exact, exemplar=exemplar,
+        observed={"metric": metric, "a": ta.get(metric),
+                  "b": tb.get(metric)},
+    )
+
+
+def baseline_window(windows: list[dict], *,
+                    metric: str = "exposed_ms") -> dict | None:
+    """The band-representative healthy window: among retained PRIOR
+    windows that did not themselves breach, the one whose ``metric``
+    total sits nearest the healthy-band median —
+    ``history.healthy_band`` is the ONE band implementation, reused
+    here for representativeness, never re-derived."""
+    cand = []
+    for w in windows:
+        if w.get("anomalies"):
+            continue
+        v = (w.get("totals") or {}).get(metric)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            cand.append((float(v), w))
+    if not cand:
+        return None
+    band = history.healthy_band([v for v, _ in cand], "lower")
+    target = band.median if band is not None else cand[0][0]
+    return min(cand, key=lambda p: abs(p[0] - target))[1]
+
+
+# ---------------------------------------------------------------------------
+# pairing 2: two bench rounds
+
+
+def diff_rounds(a, b) -> dict:
+    """Per-metric regression ranking between two committed bench
+    rounds (``history.Round``), newest second.  Terms are ranked by
+    worse-direction drift under each metric's ``direction_for``
+    classification; there is no cross-metric additive total, so the
+    exactness contract binds per term (each term IS one metric's own
+    delta)."""
+
+    def _vals(rnd):
+        out = {}
+        for rec in rnd.metrics:
+            name, v = rec.get("metric"), rec.get("value")
+            if (not name or rec.get("interpret")
+                    or not isinstance(v, (int, float))
+                    or isinstance(v, bool)):
+                continue
+            out[name] = (float(v), str(rec.get("unit", "")))
+        return out
+
+    ma, mb = _vals(a), _vals(b)
+    terms = []
+    for name in sorted(set(ma) & set(mb)):
+        (va, unit), (vb, _) = ma[name], mb[name]
+        direction = history.direction_for(name, unit)
+        if direction == "exact":
+            drift = 0.0 if va == vb else 1.0
+        else:
+            drift = history._drift_pct(direction, vb, va)
+        terms.append(_term(
+            metric=name, delta=vb - va, unit=unit,
+            summary=(f"{name}: {va:g} -> {vb:g} {unit} "
+                     f"({100 * drift:+.1f}% "
+                     f"{'worse' if drift > 0 else 'better'}, "
+                     f"{direction})"),
+            # drift rides the term for ranking and for the WARN notes
+            pct_of_total=None,
+        ))
+        terms[-1]["drift_pct"] = drift
+        terms[-1]["direction"] = direction
+    kept, residual, exact = _close(
+        terms, None, sort_key=lambda t: t["drift_pct"])
+    kept = [t for t in kept if abs(t["drift_pct"]) > ZERO_TOL]
+    for i, t in enumerate(kept):
+        t["rank"] = i + 1
+    return _result(
+        "rounds", {"round": a.round}, {"round": b.round},
+        metric=f"r{a.round}->r{b.round}", unit="", total_delta=None,
+        terms=kept, residual=residual, exact=exact,
+    )
+
+
+def rounds_attribution(trajectories: dict, metric: str, *,
+                       top: int = 3, min_drift: float = 0.02
+                       ) -> str | None:
+    """The round-over-round note a trend WARN line carries: which
+    OTHER metrics co-moved in their worse direction between the warned
+    metric's last two rounds.  A co-regressed overhead or latency
+    metric is the first causal lead; None when nothing co-moved (the
+    regression is isolated — also a lead)."""
+    tr = trajectories.get(metric)
+    if tr is None or len(tr.draws) < 2:
+        return None
+    r_prev, r_new = tr.draws[-2].round, tr.draws[-1].round
+    movers = []
+    for name, other in trajectories.items():
+        if name == metric or other.direction == "exact" \
+                or len(other.draws) < 2:
+            continue
+        d_new, d_prev = other.draws[-1], other.draws[-2]
+        if d_new.round != r_new or d_prev.round != r_prev:
+            continue
+        drift = history._drift_pct(other.direction,
+                                   d_new.value, d_prev.value)
+        if drift > min_drift:
+            movers.append((drift, name))
+    if not movers:
+        return None
+    movers.sort(reverse=True)
+    note = ", ".join(f"{n} ({100 * d:.0f}% worse)"
+                     for d, n in movers[:top])
+    return f" | co-regressed r{r_prev}->r{r_new}: {note}"
+
+
+# ---------------------------------------------------------------------------
+# pairing 3: two trace cohorts
+
+
+def diff_cohorts(a: list, b: list, *, label_a: str = "cohort-a",
+                 label_b: str = "cohort-b") -> dict:
+    """Span-aligned phase diff of two trace cohorts: per-phase
+    mean-exposed deltas (``attribute_request`` budgets — the ONE phase
+    arithmetic) plus a chain-gap term, closing to the mean e2e delta
+    exactly (a trace's phases partition [submit, terminal]:
+    ``e2e_ms == sum(exposed) + gap_ms``).  The resolving exemplar is
+    the slowest trace of the second cohort."""
+    from . import request_trace as rtrace
+
+    if not a or not b:
+        raise ValueError("diff_cohorts: both cohorts must be non-empty")
+
+    def _mean(traces):
+        ph: dict[str, list[float]] = {}
+        e2e = gap = 0.0
+        worst = None
+        for t in traces:
+            att = rtrace.attribute_request(t)
+            e2e += att["e2e_ms"]
+            gap += att["gap_ms"]
+            if worst is None or att["e2e_ms"] > worst[0]:
+                worst = (att["e2e_ms"], att["trace_id"])
+            for p, d in att["phases"].items():
+                cur = ph.setdefault(p, [0.0, 0.0])
+                cur[0] += d["exposed_ms"]
+                cur[1] += d["overlapped_ms"]
+        n = float(len(traces))
+        return ({p: (e / n, o / n) for p, (e, o) in ph.items()},
+                e2e / n, gap / n, worst[1] if worst else None)
+
+    pa, e2e_a, gap_a, _ = _mean(a)
+    pb, e2e_b, gap_b, exemplar = _mean(b)
+    phases = [p for p in _PHASE_ORDER if p in pa or p in pb]
+    phases += [p for p in list(pa) + list(pb)
+               if p not in phases and (p in pa or p in pb)]
+    seen = set()
+    phases = [p for p in phases if not (p in seen or seen.add(p))]
+    terms = []
+    for p in phases:
+        ea, oa = pa.get(p, (0.0, 0.0))
+        eb, ob = pb.get(p, (0.0, 0.0))
+        terms.append(_term(
+            metric=f"phase/{p}", phase=p, delta=eb - ea, unit="ms",
+            exposed_delta_ms=eb - ea, overlapped_delta_ms=ob - oa,
+            exemplar=exemplar,
+            summary=(f"phase {p}: exposed {eb - ea:+.3f} ms, "
+                     f"overlapped {ob - oa:+.3f} ms"),
+        ))
+    if abs(gap_b - gap_a) > ZERO_TOL:
+        terms.append(_term(
+            metric="phase/(chain-gap)", phase="(chain-gap)",
+            delta=gap_b - gap_a, unit="ms", exemplar=exemplar,
+            summary=f"chain gap: {gap_b - gap_a:+.3f} ms",
+        ))
+    total_delta = e2e_b - e2e_a
+    kept, residual, exact = _close(terms, total_delta)
+    return _result(
+        "cohorts", {"label": label_a, "n": len(a), "e2e_ms": e2e_a},
+        {"label": label_b, "n": len(b), "e2e_ms": e2e_b},
+        metric="e2e_ms", unit="ms", total_delta=total_delta,
+        terms=kept, residual=residual, exact=exact, exemplar=exemplar,
+    )
+
+
+def diff_traces(a, b) -> dict:
+    """Two single traces as one-element cohorts (the ``--request p99``
+    exemplar-vs-p50 view builds on :func:`diff_cohorts` directly)."""
+    return diff_cohorts([a], [b], label_a=a.trace_id, label_b=b.trace_id)
+
+
+# ---------------------------------------------------------------------------
+# pairing 4: two fleet replicas
+
+
+def diff_replicas(a, b, *, quantile: float = 0.99) -> dict:
+    """Per-sketch quantile deltas between two replicas'
+    ``ReplicaStats`` (baseline first).  All fleet sketches are
+    latencies in ms, so terms rank by absolute delta; each term's
+    exemplar is the worse side's quantile exemplar — trace ids survive
+    the federation union merge (pinned by test), so the id resolves
+    against the ring / a trace dump."""
+    from . import fleet_stats
+
+    terms = []
+    for name in fleet_stats.SKETCH_NAMES:
+        sa, sb = getattr(a, name, None), getattr(b, name, None)
+        if sa is None or sb is None:
+            continue
+        va, vb = float(sa.quantile(quantile)), float(sb.quantile(quantile))
+        if va == 0.0 and vb == 0.0:
+            continue
+        delta = vb - va
+        worse = sb if delta >= 0 else sa
+        exemplar = worse.exemplar(quantile)
+        label = f"{name}_p{int(round(quantile * 100))}"
+        terms.append(_term(
+            metric=label, phase=_SKETCH_PHASE.get(name),
+            delta=delta, unit="ms", exemplar=exemplar,
+            summary=f"{label}: {va:g} -> {vb:g} ms ({delta:+.3f})",
+        ))
+    kept, residual, exact = _close(terms, None)
+    ida = getattr(a, "replica_id", "a")
+    idb = getattr(b, "replica_id", "b")
+    return _result(
+        "replicas", {"replica": ida}, {"replica": idb},
+        metric=f"{ida}->{idb}", unit="ms", total_delta=None,
+        terms=kept, residual=residual, exact=exact,
+        exemplar=kept[0]["exemplar"] if kept else None,
+    )
+
+
+# ---------------------------------------------------------------------------
+# rendering (obs_report --diff / --request p99)
+
+
+def format_diff(d: dict) -> str:
+    """The operator view: header, ranked terms, closing residual."""
+    lines = [f"regression forensics [{d['kind']}]  "
+             f"{d['a']} -> {d['b']}"]
+    if d.get("observed"):
+        o = d["observed"]
+        lines.append(f"  observed {o['metric']}: "
+                     f"{o.get('a')} -> {o.get('b')}")
+    if d.get("total_delta") is not None:
+        lines.append(f"  total delta: {d['total_delta']:+.6f} "
+                     f"{d['unit']}".rstrip())
+    if not d["terms"]:
+        lines.append("  (no attributable delta — captures are "
+                     "equivalent)")
+    for t in d["terms"]:
+        row = f"  #{t['rank']:<2d} {t['summary']}"
+        if t["exposed_delta_ms"] is not None and t["family"]:
+            row += (f" [exposed {t['exposed_delta_ms']:+.3f} / "
+                    f"overlapped {t['overlapped_delta_ms']:+.3f} ms]")
+        if t["stall"]:
+            sem, chunk, peer = t["stall"][:3]
+            row += f" stall(sem={sem}, chunk={chunk}, peer={peer})"
+        if t["exemplar"]:
+            row += f" exemplar={t['exemplar']}"
+        lines.append(row)
+    if d.get("total_delta") is not None:
+        lines.append(f"  residual: {d['residual']:+.9f} {d['unit']} "
+                     f"({'exact' if d['exact'] else 'NOT EXACT'})"
+                     .rstrip())
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# selftest (tdt_lint --regress + tier-1)
+
+
+def _synthetic_trace(trace_id: str, decode_ms: float) -> object:
+    """A minimal closed trace with fixed phase budgets (model-clock
+    style determinism: literal timestamps, no wall reads)."""
+    from . import request_trace as rtrace
+
+    t0 = 1_000_000.0
+    q, pf = 250.0, 2_000.0
+    spans = [
+        {"name": "queue_wait", "tier": "prefill", "t0_us": t0,
+         "t1_us": t0 + q, "tags": {}},
+        {"name": "prefill", "tier": "prefill", "t0_us": t0 + q,
+         "t1_us": t0 + q + pf, "tags": {}},
+        {"name": "decode", "tier": "decode", "t0_us": t0 + q + pf,
+         "t1_us": t0 + q + pf + decode_ms * 1e3, "tags": {}},
+    ]
+    return rtrace.from_dict({
+        "trace_id": trace_id, "req_id": 0, "state": "completed",
+        "t0_us": t0, "first_token_us": t0 + q + pf,
+        "dropped_spans": 0, "spans": spans, "events": [],
+    })
+
+
+def selftest(seed: int = 0) -> list[str]:
+    """Both-direction regress check over a REAL recorded capture run
+    through the REAL profiler path (the ``obs.anomaly`` selftest
+    harness): an identical-capture diff must rank NOTHING, and the
+    65536x wire-inflated replay must attribute the delta to the
+    injected family ("allgather"), the fed phase/tier, and a
+    (sem, chunk, peer) stall triple, with a resolving exemplar trace
+    id and an exact residual.  A planted trace-cohort slowdown must
+    likewise attribute to the planted phase.  Perturbs the flight ring
+    and serve stats; callers reset.  Returns problems (empty = pass)."""
+    from . import anomaly, continuous, flight, serve_stats
+    from . import request_trace as rtrace
+
+    problems: list[str] = []
+    prev_flight = flight.enabled()
+    prev_prof = continuous.enabled()
+    flight.enable(True)
+    continuous.enable(True)
+    tid = f"req-regress-selftest-{seed}"
+    try:
+        # a resolving exemplar: the id is both the p99 bucket exemplar
+        # AND a retained ring trace, so the attribution's trace id
+        # dereferences to a real waterfall
+        serve_stats.STATS.reset()
+        serve_stats.STATS.request_ms.observe(123.0, exemplar=tid)
+        rtrace.RING.retire(_synthetic_trace(tid, decode_ms=40.0))
+        _, streams = flight.record_family("allgather", 2)
+
+        def window_of(streams_):
+            prof = continuous.ContinuousProfiler(window_steps=1,
+                                                 out_dir="")
+            flight.clear()
+            flight.feed_streams("allgather", streams_)
+            prof.on_step("decode", 1)
+            return prof.last_window()
+
+        healthy = window_of(streams)
+        if healthy is None or not healthy["totals"]["episodes"]:
+            return ["regress selftest: the recorded capture produced "
+                    "no profiler window"]
+
+        # direction 1: identical captures must rank nothing
+        same = diff_windows(healthy, healthy)
+        if same["terms"]:
+            problems.append(
+                f"regress selftest: identical-capture diff ranked "
+                f"{[t['metric'] for t in same['terms']]} — a clean "
+                f"pair must produce no terms")
+        if not same["exact"] or same["residual"] != 0.0:
+            problems.append(
+                f"regress selftest: identical-capture residual "
+                f"{same['residual']!r} != 0")
+
+        # direction 2: the seeded regression must be attributed to the
+        # injected family/phase/stall, exactly
+        bad = window_of(anomaly._inflate_wire(streams, 1 << 16))
+        d = diff_windows(healthy, bad,
+                         exemplar=serve_stats.STATS.request_ms
+                         .exemplar(0.99))
+        if not d["terms"]:
+            problems.append("regress selftest: the 65536x wire "
+                            "inflation produced no ranked terms")
+        else:
+            top = d["terms"][0]
+            if top["family"] != "allgather":
+                problems.append(
+                    f"regress selftest: top term names family "
+                    f"{top['family']!r}, not the injected 'allgather'")
+            if top["phase"] != "decode":
+                problems.append(
+                    f"regress selftest: top term names phase "
+                    f"{top['phase']!r}, not the fed 'decode' tier")
+            if not top["stall"] or top["stall"][0] is None:
+                problems.append(
+                    "regress selftest: top term carries no dominant "
+                    "(sem, chunk, peer) stall triple")
+            if top["delta"] <= 0:
+                problems.append(
+                    f"regress selftest: injected inflation attributed "
+                    f"a non-positive delta ({top['delta']:g} ms)")
+            ex = top["exemplar"] or d["exemplar"]
+            if not ex:
+                problems.append(
+                    "regress selftest: attribution names no exemplar")
+            elif rtrace.RING.get(ex) is None:
+                problems.append(
+                    f"regress selftest: exemplar {ex!r} does not "
+                    f"resolve in the trace ring")
+        if d["total_delta"] <= 0:
+            problems.append(
+                f"regress selftest: total delta "
+                f"{d['total_delta']:g} ms — the inflated window must "
+                f"grow the exposed substrate")
+        if not d["exact"]:
+            problems.append(
+                f"regress selftest: residual {d['residual']:g} ms "
+                f"breaks the exactness contract")
+
+        # direction 2b: a planted cohort slowdown attributes to the
+        # planted phase with the same exactness
+        fast = _synthetic_trace(f"req-regress-p50-{seed}",
+                                decode_ms=10.0)
+        slow = _synthetic_trace(f"req-regress-p99-{seed}",
+                                decode_ms=90.0)
+        cd = diff_traces(fast, slow)
+        if not cd["terms"] or cd["terms"][0]["phase"] != "decode":
+            problems.append(
+                f"regress selftest: planted decode slowdown "
+                f"attributed to "
+                f"{cd['terms'][0]['phase'] if cd['terms'] else None!r}")
+        if not cd["exact"]:
+            problems.append(
+                f"regress selftest: cohort residual "
+                f"{cd['residual']:g} ms breaks the exactness contract")
+        same_c = diff_traces(fast, fast)
+        if same_c["terms"]:
+            problems.append(
+                "regress selftest: identical-cohort diff ranked "
+                "terms")
+    finally:
+        flight.clear()
+        flight.enable(prev_flight)
+        continuous.enable(prev_prof)
+    return problems

@@ -183,6 +183,10 @@ class FleetAnomalyEvent:
     step_end: int
     exemplar: str | None               # p99 exemplar trace id, if traced
     decisions: tuple[dict, ...]        # ledger records inside the window
+    # best-vs-worst replica attribution (obs.diff.diff_replicas over
+    # the breached metric's sketch) — the "why", when >= 2 replicas
+    # carried samples at detection time
+    diff: dict | None = None
 
     def summary(self) -> str:
         s = (f"fleet {self.metric}={self.value:g} outside healthy band "
@@ -199,6 +203,8 @@ class FleetAnomalyEvent:
                   + ")")
         if self.exemplar:
             s += f"; p99 exemplar {self.exemplar}"
+        if self.diff and self.diff.get("terms"):
+            s += f"; diff: {self.diff['summary']}"
         return s
 
     def to_dict(self) -> dict:
@@ -363,6 +369,26 @@ class FleetStats:
                 exemplar = getattr(self.union, name).exemplar(0.99)
                 if exemplar:
                     break
+            # best-vs-worst replica attribution on the breached
+            # metric's sketch (fallback: whole-request latency) — the
+            # two-fleet-replicas pairing of obs.diff
+            attribution = None
+            try:
+                from . import diff as diff_mod
+
+                sketch = {"fleet_ttft_ms_p99": "ttft_ms",
+                          "fleet_request_ms_p99": "request_ms"}.get(
+                              metric, "request_ms")
+                with self._lock:
+                    reps = [r for r in self.replicas.values()
+                            if getattr(r, sketch).count > 0]
+                if len(reps) >= 2:
+                    reps.sort(
+                        key=lambda r: getattr(r, sketch).quantile(0.99))
+                    attribution = diff_mod.diff_replicas(
+                        reps[0], reps[-1])
+            except Exception:
+                attribution = None
             events.append(FleetAnomalyEvent(
                 metric=metric, value=float(value),
                 band=(band.lo, band.hi), direction=band.direction,
@@ -370,6 +396,7 @@ class FleetStats:
                 step_start=win[0], step_end=win[1],
                 exemplar=exemplar,
                 decisions=tuple(r.to_dict() for r in recs),
+                diff=attribution,
             ))
         with self._lock:
             self.windows += 1

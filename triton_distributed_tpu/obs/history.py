@@ -240,38 +240,48 @@ def profile_series(windows: list[dict], metric: str) -> list[float]:
     return out
 
 
-def direction_for(metric: str, unit: str) -> str:
-    u = (unit or "").lower()
-    if "bytes/token" in u or u == "bool":
-        return "exact"
-    if u.startswith("ms") or u.startswith("us") or "ms/" in u \
-            or metric.startswith("latency"):
-        return "lower"
+# Ordered (rule_id, direction, predicate) rows — THE trend-direction
+# classification, exported as a golden table so
+# analysis/completeness.py::check_direction_coverage can pin it both
+# ways: every metric bench.py emits must classify under a named rule
+# (metrics riding the catch-all must be listed in completeness.py's
+# DEFAULT_HIGHER_OK golden set), and a rule no emitted metric
+# exercises is flagged dead.  First match wins.
+DIRECTION_RULES: tuple = (
+    # value equality is the contract (bench_sweep_complete "bool",
+    # moe_ep_a2a_fp8_wire_bytes "bytes/token/hop")
+    ("exact-unit", "exact",
+     lambda m, u: "bytes/token" in u or u == "bool"),
+    # wall-clock latencies
+    ("latency-unit", "lower",
+     lambda m, u: u.startswith("ms") or u.startswith("us")
+     or "ms/" in u or m.startswith("latency")),
     # cost/tax metrics (integrity_overhead_pct "% over plain",
     # trace_overhead_pct "% over untraced" — ISSUE 14): growth is the
     # regression the sentinel must warn on
-    if "overhead" in metric or "over plain" in u or "over untraced" in u:
-        return "lower"
+    ("overhead-tax", "lower",
+     lambda m, u: "overhead" in m or "over plain" in u
+     or "over untraced" in u),
     # per-bundle dispatch counts (decode_dispatches_per_bundle, unit
     # "dispatches/bundle"): every extra launch is a host seam the
     # persistent loop exists to remove — growth is the regression.
     # (The older decode_step_dispatches metric is a HIGHER-is-better
     # ratio, unit "x fewer dispatches", and keeps the default.)
-    if "dispatches/" in u:
-        return "lower"
+    ("dispatch-count", "lower", lambda m, u: "dispatches/" in u),
     # failure-pressure counts (handoff_retries, *_failures, *_failed_*):
     # every one is a burned retry/ladder rung or a lost request — growth
     # is the regression even though the unit is a bare count (ISSUE 12;
     # handoff_ms_p99 and serve_disagg_ttft_ms_p99 ride the ms rule
     # above, handoff_pages_per_s the throughput default below)
-    if any(tok in metric for tok in ("retries", "failures", "failed")):
-        return "lower"
+    ("failure-pressure", "lower",
+     lambda m, u: any(tok in m for tok in ("retries", "failures",
+                                           "failed"))),
     # convergence latencies in scheduler steps (fleet_rebalance_
     # convergence_steps — ISSUE 18): every extra step is load served by
     # the wrong membership — growth is the regression (fleet_ttft_ms_
     # p99_under_loss rides the ms rule above)
-    if u == "steps" or "convergence" in metric:
-        return "lower"
+    ("convergence-steps", "lower",
+     lambda m, u: u == "steps" or "convergence" in m),
     # fleet-obs control-plane health (ISSUE 19): a rising decision
     # RATE means the controller is actuating more (sheds, failovers,
     # quarantine walks — a healthy fleet routes and little else), and
@@ -279,10 +289,27 @@ def direction_for(metric: str, unit: str) -> str:
     # losing — growth is the regression for all three.  Federation
     # merge counts (fleet_requests_*, fleet_tokens_*) keep the
     # throughput default below.
-    if any(tok in metric for tok in ("decision_rate", "skew",
-                                     "spread")):
-        return "lower"
-    return "higher"
+    ("control-plane-pressure", "lower",
+     lambda m, u: any(tok in m for tok in ("decision_rate", "skew",
+                                           "spread"))),
+    # the deliberate catch-all: rates/ratios where more is better
+    # (TFLOP/s, tok/s, pages/s, hidden-overlap fractions)
+    ("throughput-default", "higher", lambda m, u: True),
+)
+
+
+def classify_direction(metric: str, unit: str) -> tuple[str, str]:
+    """``(rule_id, direction)`` under the golden table — the ONE
+    classification; :func:`direction_for` delegates here."""
+    u = (unit or "").lower()
+    for rule_id, direction, pred in DIRECTION_RULES:
+        if pred(metric, u):
+            return rule_id, direction
+    return "throughput-default", "higher"   # unreachable: catch-all
+
+
+def direction_for(metric: str, unit: str) -> str:
+    return classify_direction(metric, unit)[1]
 
 
 def trajectories(rounds: list[Round]) -> dict[str, Trajectory]:
@@ -436,6 +463,21 @@ def analyze(rounds: list[Round], *, decline_rounds: int = DECLINE_ROUNDS,
                     f"rounds' healthy band [{lo:g}, {hi:g}] (median "
                     f"{med:g}) — above any floor, but the trajectory "
                     f"regressed")
+    # regression forensics (obs.diff): a WARN line should be an
+    # explanation candidate, not just a flag — append the
+    # round-over-round co-movement note so bench_history and
+    # check_perf_claims --trend carry their first causal lead inline
+    for tr in trs.values():
+        if not tr.warnings:
+            continue
+        try:
+            from . import diff as _diff
+
+            note = _diff.rounds_attribution(trs, tr.metric)
+        except Exception:
+            note = None
+        if note:
+            tr.warnings[:] = [w + note for w in tr.warnings]
     return trs
 
 

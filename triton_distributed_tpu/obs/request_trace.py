@@ -549,6 +549,26 @@ def attribute_request(trace: TraceContext) -> dict:
     }
 
 
+def select_cohort(traces: list, q: float, *,
+                  width: float = 0.2) -> list:
+    """The closed traces whose end-to-end latency sits in the quantile
+    band ``[q - width/2, q + width/2]`` — the cohort-selection half of
+    the regression-forensics pairing (``obs.diff.diff_cohorts``): the
+    p50 cohort is ``select_cohort(ts, 0.5)``, the p99 exemplars
+    ``select_cohort(ts, 0.99, width=0.02)`` (which degenerates to the
+    slowest trace(s) of a small ring).  Always returns at least one
+    trace when any closed trace exists."""
+    closed = [t for t in traces
+              if t.spans and t.spans[-1].t1_us is not None]
+    if not closed:
+        return []
+    closed.sort(key=lambda t: t.total_ms)
+    n = len(closed)
+    lo = max(0, min(n - 1, int((q - width / 2) * n)))
+    hi = max(lo + 1, min(n, int((q + width / 2) * n + 1)))
+    return closed[lo:hi]
+
+
 # ---------------------------------------------------------------------------
 # export: waterfall text, Chrome trace, JSON dump
 

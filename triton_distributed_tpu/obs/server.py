@@ -154,6 +154,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(self._telemetry().profile_dump(),
                                            default=str),
                            "application/json")
+            elif path == "/debug/diff":
+                self._send(200, json.dumps(self._telemetry().diff_dump(),
+                                           default=str),
+                           "application/json")
             elif path == "/debug/serve":
                 self._send(200, json.dumps(self._telemetry().serve_dump(),
                                            default=str),
@@ -174,8 +178,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "error": f"unknown path {path!r}",
                     "endpoints": ["/metrics", "/healthz", "/debug/flight",
                                   "/debug/timeline", "/debug/profile",
-                                  "/debug/serve", "/debug/fleet",
-                                  "/debug/trace"],
+                                  "/debug/diff", "/debug/serve",
+                                  "/debug/fleet", "/debug/trace"],
                 }), "application/json")
         except BrokenPipeError:
             pass
@@ -320,6 +324,38 @@ class TelemetryServer:
             return {"enabled": True, "windows_total": 0,
                     "anomalies_total": 0, "last_window": None}
         return prof.snapshot()
+
+    def diff_dump(self) -> dict:
+        """``/debug/diff``: the latest anomaly's window-vs-baseline
+        attribution (``obs.diff`` via ``obs.anomaly``), plus the fleet
+        plane's latest attributed breach when armed.  Disarmed
+        processes answer a stub rather than 404, the
+        ``/debug/profile`` rule.  Scrape-safe during window rotation:
+        events are frozen and their attribution dicts are built once
+        at detection time, never mutated after publish."""
+        from . import anomaly, continuous, fleet_stats
+
+        if not continuous.enabled():
+            return {"enabled": False,
+                    "hint": "set TDT_PROFILE=1 (docs/observability.md)"}
+        ev = anomaly.latest_attributed()
+        out = {
+            "enabled": True,
+            "anomalies_total": anomaly.total(),
+            "anomaly": ev.to_dict() if ev else None,
+            "diff": ev.diff if ev else None,
+        }
+        if ev is None:
+            out["hint"] = ("no attributed anomaly yet — breaches gain "
+                           "a diff once a healthy baseline window has "
+                           "rotated")
+        fleet = fleet_stats.current()
+        if fleet is not None:
+            fev = next((e for e in reversed(fleet.recent_events())
+                        if e.diff), None)
+            if fev is not None:
+                out["fleet_anomaly"] = fev.to_dict()
+        return out
 
     def fleet_dump(self, n: int = FLEET_DUMP_DEFAULT) -> dict:
         """``/debug/fleet``: the federation plane's snapshot (merged

@@ -392,6 +392,16 @@ def health_snapshot() -> dict:
     frag = anomaly.health_fragment()
     if frag is not None:
         out["profile"] = frag
+    # calibration-drift sentinel (ISSUE 20): live achieved wire GB/s
+    # diverging from the persisted LinkCalibration for sustained
+    # windows names the stale wire class here — the same WARNING-only
+    # rule as the anomaly fragment above (SOL attributions rot
+    # silently otherwise, but drift must never 503 a replica)
+    from ..obs import continuous
+
+    cal = continuous.calibration_fragment()
+    if cal is not None:
+        out["linkcal"] = cal
     return out
 
 
